@@ -1,0 +1,43 @@
+"""accelerate_tpu — a TPU-native training/inference harness.
+
+A brand-new framework with the capability surface of huggingface/accelerate
+(reference mounted at /root/reference), designed TPU-first: one GSPMD device
+mesh subsumes DDP/FSDP/HSDP/TP/CP/SP/EP/PP as sharding rules; collectives are
+XLA HLO over ICI/DCN; params and optimizer state are functional pytrees.
+"""
+
+__version__ = "0.1.0"
+
+from .state import AcceleratorState, DistributedType, GradientState, PartialState
+from .parallelism_config import ParallelismConfig
+from .logging import get_logger
+from .utils.random import set_seed, synchronize_rng_states
+
+__all__ = [
+    "AcceleratorState",
+    "DistributedType",
+    "GradientState",
+    "PartialState",
+    "ParallelismConfig",
+    "get_logger",
+    "set_seed",
+    "synchronize_rng_states",
+    "Accelerator",
+]
+
+
+def __getattr__(name):
+    # Lazy import of the heavy facade so `import accelerate_tpu` stays cheap.
+    if name == "Accelerator":
+        from .accelerator import Accelerator
+
+        return Accelerator
+    if name == "notebook_launcher":
+        from .launchers import notebook_launcher
+
+        return notebook_launcher
+    if name == "debug_launcher":
+        from .launchers import debug_launcher
+
+        return debug_launcher
+    raise AttributeError(f"module 'accelerate_tpu' has no attribute {name!r}")

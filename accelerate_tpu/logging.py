@@ -1,0 +1,72 @@
+"""Rank-aware logging.
+
+TPU-native analogue of the reference's ``logging.py``
+(/root/reference/src/accelerate/logging.py:23-92 ``MultiProcessAdapter``,
+:93 ``get_logger``): ``main_process_only`` filtering, ``in_order`` sequenced
+emission across processes, per-rank prefixes, ``warning_once``.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import os
+
+
+class MultiProcessAdapter(logging.LoggerAdapter):
+    """LoggerAdapter that only emits on the main process unless told otherwise.
+
+    ``log(..., main_process_only=False)`` emits on every process;
+    ``log(..., in_order=True)`` emits rank by rank (barrier between ranks).
+    """
+
+    @staticmethod
+    def _should_log(main_process_only: bool) -> bool:
+        from .state import PartialState
+
+        state = PartialState(_allow_uninitialized=True)
+        return not main_process_only or state.is_main_process
+
+    def log(self, level, msg, *args, **kwargs):
+        if os.environ.get("ACCELERATE_LOG_ON_ALL_PROCESSES", None) == "1":
+            kwargs.setdefault("main_process_only", False)
+        main_process_only = kwargs.pop("main_process_only", True)
+        in_order = kwargs.pop("in_order", False)
+        if self.isEnabledFor(level):
+            if in_order:
+                from .state import PartialState
+
+                state = PartialState(_allow_uninitialized=True)
+                for i in range(state.num_processes):
+                    if i == state.process_index:
+                        msg, kwargs = self.process(msg, kwargs)
+                        self.logger.log(level, msg, *args, **kwargs)
+                    state.wait_for_everyone()
+            elif self._should_log(main_process_only):
+                msg, kwargs = self.process(msg, kwargs)
+                self.logger.log(level, msg, *args, **kwargs)
+
+    def process(self, msg, kwargs):
+        from .state import PartialState
+
+        state = PartialState(_allow_uninitialized=True)
+        prefix = f"[rank {state.process_index}] " if state.num_processes > 1 else ""
+        kwargs.pop("main_process_only", None)
+        kwargs.pop("in_order", None)
+        return f"{prefix}{msg}", kwargs
+
+    @functools.lru_cache(None)
+    def warning_once(self, *args, **kwargs):
+        """Emit a warning only once per unique message (reference logging.py:82-91)."""
+        self.warning(*args, **kwargs)
+
+
+def get_logger(name: str, log_level: str | None = None) -> MultiProcessAdapter:
+    """Return a rank-aware logger (reference logging.py:93-133)."""
+    logger = logging.getLogger(name)
+    if log_level is None:
+        log_level = os.environ.get("ACCELERATE_LOG_LEVEL", None)
+    if log_level is not None:
+        logger.setLevel(log_level.upper())
+        logger.root.setLevel(log_level.upper())
+    return MultiProcessAdapter(logger, {})

@@ -1,0 +1,1 @@
+from .mesh import build_mesh, build_hybrid_mesh, canonical_axis_sizes

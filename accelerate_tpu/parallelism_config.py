@@ -1,0 +1,219 @@
+"""N-D parallelism configuration.
+
+TPU-native re-design of the reference's ``parallelism_config.py``
+(/root/reference/src/accelerate/parallelism_config.py:34 ``ParallelismConfig``):
+the same torchtitan-style named dims (``dp_replicate``, ``dp_shard``, ``cp``,
+``sp``, ``tp``) plus two first-class axes the reference lacks or delegates —
+``pp`` (pipeline, reference only has inference-only PiPPy) and ``ep``
+(expert parallel, reference has no first-class EP; SURVEY §2.4).
+
+Under GSPMD all strategies are expressed as shardings over ONE mesh, so this
+config fully determines parallel execution — there is no plugin/engine
+selection step like the reference's ``distributed_type`` promotion
+(state.py:972-1022).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from .utils.constants import JOINT_AXES, MESH_AXIS_ORDER
+
+_ENV_PREFIX = "PARALLELISM_CONFIG_"  # same env protocol as the reference
+_AXIS_TO_FIELD = {
+    "dp_replicate": "dp_replicate_size",
+    "dp_shard": "dp_shard_size",
+    "pp": "pp_size",
+    "cp": "cp_size",
+    "sp": "sp_size",
+    "tp": "tp_size",
+    "ep": "ep_size",
+}
+
+
+@dataclass
+class ParallelismConfig:
+    """Sizes for each mesh axis; ``dp_shard_size=-1`` infers from the device
+    count (reference parallelism_config.py:274-289 env defaults).
+
+    Axis semantics:
+      * ``dp_replicate`` — pure data-parallel replicas (DDP); rides DCN first.
+      * ``dp_shard``     — FSDP/ZeRO parameter+optimizer sharding axis.
+      * ``pp``           — pipeline stages (native addition).
+      * ``cp``           — context parallel (ring attention over sequence).
+      * ``sp``           — Ulysses-style sequence parallel (all-to-all heads).
+      * ``tp``           — tensor parallel (Megatron column/row rules).
+      * ``ep``           — expert parallel (native addition).
+    """
+
+    dp_replicate_size: int = 1
+    dp_shard_size: int = 1
+    pp_size: int = 1
+    cp_size: int = 1
+    sp_size: int = 1
+    tp_size: int = 1
+    ep_size: int = 1
+    # strategy sub-configs (handlers in the reference's terms)
+    cp_config: Optional[object] = None  # ContextParallelConfig
+    tp_config: Optional[object] = None  # TensorParallelConfig
+    # Allow cp and sp together. The reference forbids it
+    # (parallelism_config.py:328-334) because its two backends (torch CP vs
+    # DeepSpeed Ulysses) cannot compose; ours compose on one mesh, but we keep
+    # the reference's default for drop-in behavioral parity.
+    allow_cp_with_sp: bool = False
+    _total_devices: Optional[int] = field(default=None, repr=False)
+
+    # ------------------------------------------------------------ properties
+    @property
+    def axis_sizes(self) -> dict[str, int]:
+        return {axis: getattr(self, fieldname) for axis, fieldname in _AXIS_TO_FIELD.items()}
+
+    @property
+    def dp_dim_names(self) -> tuple[str, ...]:
+        """Axes a data batch is sharded over (reference flattens these into a
+        joint "dp" mesh, parallelism_config.py:211-244)."""
+        return tuple(n for n in JOINT_AXES["dp"] if self.axis_sizes[n] > 1)
+
+    @property
+    def fsdp_dim_names(self) -> tuple[str, ...]:
+        """Axes parameters are sharded over for FSDP/HSDP
+        (reference parallelism_config.py:157-164)."""
+        return tuple(n for n in JOINT_AXES["fsdp"] if self.axis_sizes[n] > 1)
+
+    @property
+    def loss_dim_names(self) -> tuple[str, ...]:
+        """Axes a scalar loss must be averaged over ("dp_cp" in the reference,
+        parallelism_config.py:146-155)."""
+        return tuple(n for n in JOINT_AXES["dp_cp"] if self.axis_sizes[n] > 1)
+
+    @property
+    def batch_dim_names(self) -> tuple[str, ...]:
+        """Axes the global batch dim is sharded over when building arrays."""
+        return tuple(n for n in ("dp_replicate", "dp_shard") if self.axis_sizes[n] > 1)
+
+    @property
+    def seq_dim_names(self) -> tuple[str, ...]:
+        """Axes the sequence dim is sharded over (cp and/or sp)."""
+        return tuple(n for n in ("cp", "sp") if self.axis_sizes[n] > 1)
+
+    @property
+    def data_parallel_size(self) -> int:
+        return self.dp_replicate_size * self.dp_shard_size
+
+    @property
+    def non_data_parallel_size(self) -> int:
+        return self.pp_size * self.cp_size * self.sp_size * self.tp_size * self.ep_size
+
+    @property
+    def total_size(self) -> int:
+        return self.data_parallel_size * self.non_data_parallel_size
+
+    @property
+    def dp_enabled(self) -> bool:
+        return self.data_parallel_size > 1
+
+    @property
+    def fsdp_enabled(self) -> bool:
+        return self.dp_shard_size > 1
+
+    @property
+    def hsdp_enabled(self) -> bool:
+        return self.dp_replicate_size > 1 and self.dp_shard_size > 1
+
+    @property
+    def tp_enabled(self) -> bool:
+        return self.tp_size > 1
+
+    @property
+    def cp_enabled(self) -> bool:
+        return self.cp_size > 1
+
+    @property
+    def sp_enabled(self) -> bool:
+        return self.sp_size > 1
+
+    @property
+    def pp_enabled(self) -> bool:
+        return self.pp_size > 1
+
+    @property
+    def ep_enabled(self) -> bool:
+        return self.ep_size > 1
+
+    @property
+    def active_mesh_dims(self) -> tuple[str, ...]:
+        return tuple(n for n in MESH_AXIS_ORDER if self.axis_sizes[n] > 1)
+
+    # ------------------------------------------------------------ validation
+    def _infer_and_validate(self, total_devices: int) -> None:
+        sizes = self.axis_sizes
+        for axis, size in sizes.items():
+            if axis != "dp_shard" and size < 1:
+                raise ValueError(f"{axis} size must be >= 1, got {size}")
+        if self.dp_shard_size == -1:
+            rest = int(np.prod([s for a, s in sizes.items() if a != "dp_shard"]))
+            if total_devices % rest != 0:
+                raise ValueError(
+                    f"Cannot infer dp_shard: {total_devices} devices not divisible by "
+                    f"product of other axes {rest}"
+                )
+            self.dp_shard_size = total_devices // rest
+        if self.cp_enabled and self.sp_enabled and not self.allow_cp_with_sp:
+            raise ValueError(
+                "cp_size>1 and sp_size>1 are mutually exclusive by default "
+                "(reference parallelism_config.py:328-334); pass allow_cp_with_sp=True "
+                "to compose them on one mesh."
+            )
+        if self.total_size != total_devices:
+            raise ValueError(
+                f"ParallelismConfig total size {self.total_size} "
+                f"({self.axis_sizes}) != available devices {total_devices}"
+            )
+        self._total_devices = total_devices
+
+    # ------------------------------------------------------------ construction
+    @classmethod
+    def from_env(cls, total_devices: Optional[int] = None) -> "ParallelismConfig":
+        """Read PARALLELISM_CONFIG_* env vars (producer: the launcher;
+        reference parallelism_config.py:274-289)."""
+        kwargs = {}
+        for axis, fieldname in _AXIS_TO_FIELD.items():
+            env_key = f"{_ENV_PREFIX}{axis.upper()}_SIZE"
+            if env_key in os.environ:
+                kwargs[fieldname] = int(os.environ[env_key])
+        if not kwargs and total_devices is not None:
+            # No config at all → pure data parallel over every device, the
+            # analogue of the reference's DDP default.
+            kwargs["dp_replicate_size"] = total_devices
+        cfg = cls(**kwargs)
+        if total_devices is not None:
+            cfg._infer_and_validate(total_devices)
+        return cfg
+
+    def build_device_mesh(self, device_type: Optional[str] = None):
+        """Construct the jax.sharding.Mesh in canonical axis order
+        (MESH_AXIS_ORDER keeps size-1 axes so sharding rules can always name
+        any axis — unlike the reference which creates only active dims,
+        parallelism_config.py:260-272)."""
+        import jax
+
+        from .parallel.mesh import build_mesh, canonical_axis_sizes
+
+        total = self._total_devices or len(jax.devices())
+        self._infer_and_validate(total)
+        sizes, names = canonical_axis_sizes(self.axis_sizes)
+        return build_mesh(sizes, names)
+
+    def get_device_mesh(self, device_type: Optional[str] = None):
+        return self.build_device_mesh(device_type)
+
+    def to_json(self) -> dict:
+        return {axis: size for axis, size in self.axis_sizes.items()}
+
+    def __repr__(self) -> str:
+        active = ", ".join(f"{a}={s}" for a, s in self.axis_sizes.items() if s != 1)
+        return f"ParallelismConfig({active or 'single-device'})"

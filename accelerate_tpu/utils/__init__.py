@@ -1,0 +1,29 @@
+from .constants import MESH_AXIS_ORDER, JOINT_AXES
+from .environment import (
+    clear_environment,
+    parse_choice_from_env,
+    parse_flag_from_env,
+    patch_environment,
+    purge_accelerate_environment,
+    str_to_bool,
+)
+from .imports import (
+    is_flax_available,
+    is_jax_available,
+    is_optax_available,
+    is_orbax_available,
+    is_safetensors_available,
+    is_tensorboard_available,
+    is_torch_available,
+    is_tpu_available,
+    is_transformers_available,
+    is_wandb_available,
+)
+from .memory import (
+    clear_device_cache,
+    find_executable_batch_size,
+    get_device_memory_stats,
+    release_memory,
+)
+from .random import make_rng_key, set_seed, synchronize_rng_state, synchronize_rng_states
+from .versions import compare_versions, is_package_version

@@ -1,0 +1,44 @@
+"""Constants shared across the framework.
+
+Mirrors the role of the reference's ``utils/constants.py`` (checkpoint file
+naming, env-var prefixes) re-designed for a JAX/XLA checkpoint layout
+(reference: /root/reference/src/accelerate/utils/constants.py).
+"""
+
+MODEL_NAME = "model"
+OPTIMIZER_NAME = "optimizer"
+SCHEDULER_NAME = "scheduler"
+SAMPLER_NAME = "sampler"
+DATALOADER_STATE_NAME = "dl_state"
+RNG_STATE_NAME = "random_states"
+CUSTOM_STATE_PATTERN = "custom_checkpoint_{}"
+PROFILE_PATTERN_NAME = "profile_{suffix}"
+
+SAFE_WEIGHTS_NAME = "model.safetensors"
+SAFE_WEIGHTS_INDEX_NAME = "model.safetensors.index.json"
+SAFE_WEIGHTS_PATTERN_NAME = "model{suffix}.safetensors"
+
+CHECKPOINT_DIR_PREFIX = "checkpoint"
+
+# Env-var protocol prefix (reference uses ACCELERATE_*; we keep the same
+# prefix so existing accelerate launch configs can map over).
+ENV_PREFIX = "ACCELERATE_"
+
+# Canonical mesh axis order, mirroring the reference's DeviceMesh dim order
+# ["dp_replicate", "dp_shard", "cp", "sp", "tp"]
+# (reference: parallelism_config.py:260-272), extended with first-class
+# expert-parallel and pipeline axes which the reference lacks.
+MESH_AXIS_ORDER = ("dp_replicate", "dp_shard", "pp", "cp", "sp", "tp", "ep")
+
+# Joint (flattened) logical axes used for batch sharding and loss averaging,
+# mirroring the reference's flattened joint meshes "dp", "dp_shard_cp",
+# "dp_cp" (parallelism_config.py:211-244).
+JOINT_AXES = {
+    "dp": ("dp_replicate", "dp_shard"),
+    "dp_shard_cp": ("dp_shard", "cp"),
+    "dp_cp": ("dp_replicate", "dp_shard", "cp"),
+    "batch": ("dp_replicate", "dp_shard", "cp", "sp"),
+    "fsdp": ("dp_shard", "cp"),
+}
+
+MITA_VERSION = "0.1.0"

@@ -1,0 +1,121 @@
+"""Availability probes for optional dependencies.
+
+TPU-native analogue of the reference's ``utils/imports.py`` (~60 ``is_*_available``
+probes, /root/reference/src/accelerate/utils/imports.py). Ours probes the JAX
+ecosystem plus the optional tracker/interchange backends.
+"""
+
+from __future__ import annotations
+
+import functools
+import importlib.util
+
+
+@functools.lru_cache(maxsize=None)
+def _is_package_available(name: str) -> bool:
+    try:
+        return importlib.util.find_spec(name) is not None
+    except (ImportError, ModuleNotFoundError, ValueError):
+        return False
+
+
+def is_jax_available() -> bool:
+    return _is_package_available("jax")
+
+
+def is_flax_available() -> bool:
+    return _is_package_available("flax")
+
+
+def is_optax_available() -> bool:
+    return _is_package_available("optax")
+
+
+def is_orbax_available() -> bool:
+    return _is_package_available("orbax")
+
+
+def is_torch_available() -> bool:
+    return _is_package_available("torch")
+
+
+def is_transformers_available() -> bool:
+    return _is_package_available("transformers")
+
+
+def is_safetensors_available() -> bool:
+    return _is_package_available("safetensors")
+
+
+def is_tensorboard_available() -> bool:
+    return _is_package_available("tensorboardX") or _is_package_available(
+        "tensorboard"
+    ) or _is_package_available("torch.utils.tensorboard")
+
+
+def is_wandb_available() -> bool:
+    return _is_package_available("wandb")
+
+
+def is_comet_ml_available() -> bool:
+    return _is_package_available("comet_ml")
+
+
+def is_mlflow_available() -> bool:
+    return _is_package_available("mlflow")
+
+
+def is_aim_available() -> bool:
+    return _is_package_available("aim")
+
+
+def is_clearml_available() -> bool:
+    return _is_package_available("clearml")
+
+
+def is_dvclive_available() -> bool:
+    return _is_package_available("dvclive")
+
+
+def is_swanlab_available() -> bool:
+    return _is_package_available("swanlab")
+
+
+def is_trackio_available() -> bool:
+    return _is_package_available("trackio")
+
+
+def is_datasets_available() -> bool:
+    return _is_package_available("datasets")
+
+
+def is_rich_available() -> bool:
+    return _is_package_available("rich")
+
+
+def is_tqdm_available() -> bool:
+    return _is_package_available("tqdm")
+
+
+def is_pandas_available() -> bool:
+    return _is_package_available("pandas")
+
+
+@functools.lru_cache(maxsize=None)
+def is_tpu_available() -> bool:
+    """True when JAX sees at least one TPU device. Mirrors the role of the
+    reference's ``is_torch_xla_available(check_is_tpu=True)``
+    (utils/imports.py:131)."""
+    import jax
+
+    try:
+        return any(d.platform == "tpu" for d in jax.devices())
+    except RuntimeError:
+        return False
+
+
+@functools.lru_cache(maxsize=None)
+def is_multihost() -> bool:
+    import jax
+
+    return jax.process_count() > 1

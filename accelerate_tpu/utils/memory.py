@@ -1,0 +1,111 @@
+"""Memory utilities: cache clearing, OOM-retry batch-size finder.
+
+TPU-native analogue of the reference's ``utils/memory.py``
+(/root/reference/src/accelerate/utils/memory.py:40 ``clear_device_cache``,
+:70 ``release_memory``, :119 ``find_executable_batch_size``).
+"""
+
+from __future__ import annotations
+
+import functools
+import gc
+import inspect
+from typing import Callable, Optional
+
+
+def clear_device_cache(garbage_collection: bool = True) -> None:
+    """Free dead device buffers. On JAX backends, live buffers are freed when
+    their last Python reference dies, so this is gc + backend defrag hints."""
+    if garbage_collection:
+        gc.collect()
+    try:
+        import jax
+
+        jax.clear_caches()
+    except Exception:
+        pass
+
+
+def release_memory(*objects):
+    """Drop references and collect; returns Nones matching arity
+    (reference utils/memory.py:70-116)."""
+    if not isinstance(objects, list):
+        objects = list(objects)
+    for i in range(len(objects)):
+        objects[i] = None
+    gc.collect()
+    return objects
+
+
+def is_oom_error(exception: BaseException) -> bool:
+    """Heuristic for XLA/JAX out-of-memory errors (the analogue of catching
+    torch.cuda.OutOfMemoryError in reference utils/memory.py:132-146)."""
+    msg = str(exception).lower()
+    return any(
+        s in msg
+        for s in (
+            "resource_exhausted",
+            "resource exhausted",
+            "out of memory",
+            "oom",
+            "hbm",
+            "allocation failure",
+        )
+    )
+
+
+def find_executable_batch_size(
+    function: Optional[Callable] = None,
+    starting_batch_size: int = 128,
+    reduce_batch_size_fn: Optional[Callable[[int], int]] = None,
+):
+    """Decorator: call ``function(batch_size, ...)``; on OOM, clear caches and
+    retry with a reduced batch size (reference halves ×0.9 at
+    utils/memory.py:119-188 — we halve, which matches XLA's preference for
+    power-of-two batch shapes and avoids a long recompile ladder).
+    """
+    if function is None:
+        return functools.partial(
+            find_executable_batch_size,
+            starting_batch_size=starting_batch_size,
+            reduce_batch_size_fn=reduce_batch_size_fn,
+        )
+
+    if reduce_batch_size_fn is None:
+        reduce_batch_size_fn = lambda b: b // 2
+
+    @functools.wraps(function)
+    def wrapper(*args, **kwargs):
+        batch_size = starting_batch_size
+        params = list(inspect.signature(function).parameters.keys())
+        if len(params) < (1 + len(args)) and params[0] != "batch_size":
+            raise TypeError(
+                f"Batch size was passed into `{function.__name__}` as the first argument, "
+                "but it should accept `batch_size` first."
+            )
+        while True:
+            if batch_size == 0:
+                raise RuntimeError("No executable batch size found, reached zero.")
+            try:
+                return function(batch_size, *args, **kwargs)
+            except Exception as e:  # noqa: BLE001 - we re-raise non-OOM
+                if is_oom_error(e):
+                    clear_device_cache(garbage_collection=True)
+                    batch_size = reduce_batch_size_fn(batch_size)
+                else:
+                    raise
+
+    return wrapper
+
+
+def get_device_memory_stats(device=None) -> dict:
+    """Per-device memory stats (bytes). TPU-native replacement for the
+    torch.cuda memory introspection used across the reference."""
+    import jax
+
+    if device is None:
+        device = jax.local_devices()[0]
+    stats = getattr(device, "memory_stats", lambda: None)()
+    if not stats:
+        return {}
+    return dict(stats)

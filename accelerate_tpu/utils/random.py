@@ -1,0 +1,102 @@
+"""Seeding and cross-process RNG synchronization.
+
+TPU-native re-design of the reference's ``utils/random.py``
+(/root/reference/src/accelerate/utils/random.py:40 ``set_seed``,
+:81-160 ``synchronize_rng_state(s)`` which broadcasts rank-0 RNG state).
+
+Under JAX, RNG is explicit and functional (``jax.random.key``), so the
+framework's primary path never needs mutable-state sync: every process
+derives the same key from the same seed, and per-device randomness is folded
+in deterministically. We still synchronize Python/NumPy (and torch, when the
+user's data pipeline uses it) global RNG states across processes, because
+host-side data augmentation uses them.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Iterable, Optional
+
+import numpy as np
+
+from .imports import is_torch_available
+
+_DISTRIBUTED_SEED_OFFSET = 0
+
+
+def set_seed(seed: int, device_specific: bool = False, deterministic: bool = False) -> None:
+    """Seed python/numpy(/torch) global RNGs.
+
+    ``device_specific=True`` offsets the seed by the process index, mirroring
+    reference utils/random.py:40-66 — use for host-side augmentation that must
+    differ per data shard.
+    """
+    if device_specific:
+        from ..state import PartialState
+
+        seed += PartialState().process_index
+    random.seed(seed)
+    np.random.seed(seed % (2**32))
+    if is_torch_available():
+        import torch
+
+        torch.manual_seed(seed)
+        if deterministic:
+            torch.use_deterministic_algorithms(True)
+
+
+def make_rng_key(seed: int, fold_in: Optional[Iterable[int]] = None):
+    """Canonical JAX key derivation: one global seed, deterministically folded
+    with any per-axis indices (epoch, step, process)."""
+    import jax
+
+    key = jax.random.key(seed)
+    if fold_in is not None:
+        for x in fold_in:
+            key = jax.random.fold_in(key, x)
+    return key
+
+
+def synchronize_rng_state(generator=None) -> None:
+    """Broadcast the main process's host RNG state to all processes.
+
+    Covers python ``random``, ``numpy``, and (if present) ``torch`` CPU RNG,
+    plus an optional ``torch.Generator``. Semantics follow reference
+    utils/random.py:81-160; the wire transfer uses the multihost broadcast
+    from :mod:`accelerate_tpu.ops.operations`.
+    """
+    from ..state import PartialState
+    from ..ops.operations import broadcast_object_list
+
+    state = PartialState()
+    if state.num_processes <= 1:
+        return
+
+    payload = None
+    if state.is_main_process:
+        payload = {
+            "python": random.getstate(),
+            "numpy": np.random.get_state(),
+        }
+        if is_torch_available():
+            import torch
+
+            payload["torch"] = torch.get_rng_state()
+        if generator is not None:
+            payload["generator"] = generator.get_state()
+    payload = broadcast_object_list([payload], from_process=0)[0]
+
+    random.setstate(payload["python"])
+    np.random.set_state(payload["numpy"])
+    if "torch" in payload and is_torch_available():
+        import torch
+
+        torch.set_rng_state(payload["torch"])
+    if generator is not None and "generator" in payload:
+        generator.set_state(payload["generator"])
+
+
+def synchronize_rng_states(rng_types: Iterable[str] = ("python", "numpy"), generator=None) -> None:
+    """Compat entry point mirroring reference utils/random.py:163."""
+    # rng_types kept for API parity; all host RNGs sync in one broadcast.
+    synchronize_rng_state(generator=generator)

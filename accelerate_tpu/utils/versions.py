@@ -1,0 +1,40 @@
+"""Version comparison helpers (reference: utils/versions.py)."""
+
+from __future__ import annotations
+
+import importlib.metadata
+import operator
+
+_OPS = {
+    "<": operator.lt,
+    "<=": operator.le,
+    "==": operator.eq,
+    "!=": operator.ne,
+    ">=": operator.ge,
+    ">": operator.gt,
+}
+
+
+def _parse(v: str):
+    parts = []
+    for piece in v.split("."):
+        num = ""
+        for ch in piece:
+            if ch.isdigit():
+                num += ch
+            else:
+                break
+        parts.append(int(num) if num else 0)
+    return tuple(parts)
+
+
+def compare_versions(version_a: str, op: str, version_b: str) -> bool:
+    return _OPS[op](_parse(version_a), _parse(version_b))
+
+
+def is_package_version(package: str, op: str, version: str) -> bool:
+    try:
+        got = importlib.metadata.version(package)
+    except importlib.metadata.PackageNotFoundError:
+        return False
+    return compare_versions(got, op, version)

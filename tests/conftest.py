@@ -1,0 +1,36 @@
+"""Test harness: force a virtual 8-device CPU platform before jax imports.
+
+This mirrors (and strengthens — real SPMD semantics, not a gloo fork) the
+reference's CPU-multiprocess test trick (`debug_launcher`, SURVEY §4): all
+sharding/mesh tests run on 8 virtual CPU devices.
+"""
+
+import os
+
+# Force-override: the session environment pins JAX_PLATFORMS to the real TPU
+# (axon); the test suite always runs on virtual CPU devices.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# sitecustomize.py (axon) imports jax at interpreter startup, capturing
+# JAX_PLATFORMS=axon before this file runs — override via jax.config too.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def reset_state():
+    """Reset the Borg singletons between tests (the analogue of the
+    reference's AccelerateTestCase.tearDown → _reset_state())."""
+    yield
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()

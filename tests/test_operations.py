@@ -1,0 +1,146 @@
+import collections
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from accelerate_tpu.ops import operations as ops
+from accelerate_tpu.parallelism_config import ParallelismConfig
+
+Point = collections.namedtuple("Point", ["x", "y"])
+
+
+def test_recursively_apply_preserves_structure():
+    data = {"a": np.ones(2), "b": [np.zeros(3), Point(x=np.ones(1), y=2)]}
+    out = ops.recursively_apply(lambda t: t + 1, data)
+    assert isinstance(out["b"][1], Point)
+    np.testing.assert_array_equal(out["a"], np.full(2, 2.0))
+    assert out["b"][1].y == 2  # non-tensor passthrough
+
+
+def test_find_batch_size():
+    assert ops.find_batch_size({"x": np.zeros((4, 3))}) == 4
+    assert ops.find_batch_size([np.zeros((2,)), np.zeros((5, 2))]) == 2
+    assert ops.find_batch_size("nope") is None
+
+
+def test_concatenate_nested():
+    a = {"x": np.ones((2, 3))}
+    b = {"x": np.zeros((3, 3))}
+    out = ops.concatenate([a, b])
+    assert out["x"].shape == (5, 3)
+
+
+def test_get_data_structure_roundtrip():
+    data = {"x": np.ones((2, 3), dtype=np.float32), "y": [np.zeros(4, dtype=np.int32)]}
+    skeleton = ops.get_data_structure(data)
+    rebuilt = ops.initialize_tensors(skeleton)
+    assert rebuilt["x"].shape == (2, 3)
+    assert rebuilt["x"].dtype == np.float32
+    assert rebuilt["y"][0].dtype == np.int32
+
+
+def test_gather_sharded_global_array():
+    """A dp-sharded global jax.Array gathers to the full value."""
+    cfg = ParallelismConfig(dp_shard_size=8)
+    mesh = cfg.build_device_mesh()
+    x = np.arange(16.0).reshape(16, 1)
+    sharding = NamedSharding(mesh, P(("dp_shard",), None))
+    gx = jax.device_put(x, sharding)
+    out = ops.gather({"x": gx})
+    np.testing.assert_array_equal(np.asarray(out["x"]), x)
+
+
+def test_gather_single_process_numpy():
+    out = ops.gather(np.ones((3, 2)))
+    assert out.shape == (3, 2)
+
+
+def test_pad_across_processes_noop_single():
+    t = np.ones((3, 2))
+    out = ops.pad_across_processes(t, dim=0)
+    np.testing.assert_array_equal(out, t)
+
+
+def test_pad_input_tensors():
+    t = np.arange(5)[:, None]
+    out = ops.pad_input_tensors(t, batch_size=5, num_processes=4)
+    assert out.shape[0] == 8
+    assert out[-1] == out[4]  # repeated last element
+
+
+def test_reduce_mean_single():
+    out = ops.reduce(np.array([2.0, 4.0]), reduction="mean")
+    np.testing.assert_allclose(out, [2.0, 4.0])
+
+
+def test_convert_to_fp32():
+    data = {"half": jnp.ones(2, dtype=jnp.bfloat16), "int": jnp.ones(2, dtype=jnp.int32)}
+    out = ops.convert_to_fp32(data)
+    assert out["half"].dtype == jnp.float32
+    assert out["int"].dtype == jnp.int32  # untouched
+
+
+def _bf16_forward(x):
+    return jnp.asarray(x, dtype=jnp.bfloat16)
+
+
+def test_convert_outputs_to_fp32_pickleable():
+    import pickle
+
+    fn = ops.ConvertOutputsToFp32(_bf16_forward)
+    fn2 = pickle.loads(pickle.dumps(fn))
+    assert fn2(np.ones(2)).dtype == jnp.float32
+
+
+def test_send_to_device_with_sharding():
+    cfg = ParallelismConfig(dp_shard_size=8)
+    mesh = cfg.build_device_mesh()
+    sharding = NamedSharding(mesh, P("dp_shard"))
+    batch = {"x": np.arange(8.0)}
+    out = ops.send_to_device(batch, sharding)
+    assert isinstance(out["x"], jax.Array)
+    assert out["x"].sharding == sharding
+
+
+def test_broadcast_single_process_identity():
+    t = {"x": np.ones(2)}
+    out = ops.broadcast(t)
+    np.testing.assert_array_equal(out["x"], t["x"])
+
+
+def test_gather_object_single():
+    assert ops.gather_object(["a", "b"]) == ["a", "b"]
+
+
+def test_collectives_inside_shard_map():
+    """The in-jit collective layer: psum/all_gather/ring_shift on a mesh axis."""
+    from jax import shard_map
+
+    from accelerate_tpu.ops import collectives as col
+
+    cfg = ParallelismConfig(dp_shard_size=8)
+    mesh = cfg.build_device_mesh()
+    x = np.arange(8.0)
+
+    def body(x):
+        s = col.psum(x, "dp_shard")
+        g = col.all_gather(x, "dp_shard")
+        shifted = col.ring_shift(x, "dp_shard", 1)
+        return s, g, shifted
+
+    spec = P(("dp_shard",))
+    fn = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(spec,),
+        out_specs=(P(), P(None), spec),
+        check_vma=False,
+    )
+    s, g, shifted = fn(x)
+    assert float(np.asarray(s)[0] if np.asarray(s).ndim else s) == 28.0
+    np.testing.assert_array_equal(np.asarray(g), x)
+    np.testing.assert_array_equal(np.asarray(shifted), np.roll(x, 1))

@@ -1,0 +1,64 @@
+import pytest
+
+from accelerate_tpu.parallelism_config import ParallelismConfig
+from accelerate_tpu.utils.environment import patch_environment
+
+
+def test_defaults_single():
+    cfg = ParallelismConfig()
+    assert cfg.total_size == 1
+    assert not cfg.dp_enabled
+
+
+def test_dp_shard_inference():
+    cfg = ParallelismConfig(dp_shard_size=-1, tp_size=2)
+    cfg._infer_and_validate(8)
+    assert cfg.dp_shard_size == 4
+    assert cfg.total_size == 8
+    assert cfg.fsdp_enabled
+    assert cfg.tp_enabled
+
+
+def test_invalid_total():
+    cfg = ParallelismConfig(dp_shard_size=3)
+    with pytest.raises(ValueError):
+        cfg._infer_and_validate(8)
+
+
+def test_cp_sp_exclusive():
+    cfg = ParallelismConfig(cp_size=2, sp_size=2, dp_shard_size=2)
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        cfg._infer_and_validate(8)
+
+
+def test_cp_sp_composable_when_allowed():
+    cfg = ParallelismConfig(cp_size=2, sp_size=2, dp_shard_size=2, allow_cp_with_sp=True)
+    cfg._infer_and_validate(8)
+    assert cfg.seq_dim_names == ("cp", "sp")
+
+
+def test_from_env():
+    with patch_environment(
+        PARALLELISM_CONFIG_DP_SHARD_SIZE=4, PARALLELISM_CONFIG_TP_SIZE=2
+    ):
+        cfg = ParallelismConfig.from_env(total_devices=8)
+    assert cfg.dp_shard_size == 4
+    assert cfg.tp_size == 2
+
+
+def test_joint_axes():
+    cfg = ParallelismConfig(dp_replicate_size=2, dp_shard_size=2, cp_size=2)
+    cfg._infer_and_validate(8)
+    assert cfg.dp_dim_names == ("dp_replicate", "dp_shard")
+    assert cfg.fsdp_dim_names == ("dp_shard", "cp")
+    assert cfg.loss_dim_names == ("dp_replicate", "dp_shard", "cp")
+    assert cfg.hsdp_enabled
+
+
+def test_build_mesh():
+    cfg = ParallelismConfig(dp_shard_size=4, tp_size=2)
+    mesh = cfg.build_device_mesh()
+    assert mesh.shape["dp_shard"] == 4
+    assert mesh.shape["tp"] == 2
+    assert mesh.shape["dp_replicate"] == 1
+    assert mesh.devices.size == 8

@@ -1,0 +1,63 @@
+import pytest
+
+import jax
+import numpy as np
+
+from accelerate_tpu.state import AcceleratorState, DistributedType, GradientState, PartialState
+
+
+def test_partial_state_basics():
+    state = PartialState()
+    assert state.num_devices == 8
+    assert state.num_processes == 1
+    assert state.process_index == 0
+    assert state.is_main_process
+    assert state.is_last_process
+    assert state.distributed_type == DistributedType.SPMD
+    assert state.platform == "cpu"
+
+
+def test_partial_state_is_borg():
+    a = PartialState()
+    b = PartialState()
+    assert a.__dict__ is b.__dict__
+
+
+def test_split_between_processes_single():
+    state = PartialState()
+    with state.split_between_processes([1, 2, 3]) as inputs:
+        assert inputs == [1, 2, 3]
+
+
+def test_on_main_process_decorator():
+    state = PartialState()
+    calls = []
+    state.on_main_process(lambda: calls.append(1))()
+    assert calls == [1]
+
+
+def test_accelerator_state_mixed_precision_conflict():
+    AcceleratorState(mixed_precision="bf16")
+    with pytest.raises(ValueError):
+        AcceleratorState(mixed_precision="fp16")
+
+
+def test_accelerator_state_proxies_partial():
+    state = AcceleratorState()
+    assert state.num_devices == 8
+    assert state.is_main_process
+
+
+def test_gradient_state_defaults():
+    gs = GradientState()
+    assert gs.sync_gradients
+    assert gs.num_steps == 1
+    assert not gs.end_of_dataloader
+    assert gs.remainder == -1
+
+
+def test_accelerator_state_builds_mesh():
+    state = AcceleratorState()
+    mesh = state.get_device_mesh()
+    assert mesh.devices.size == 8
+    assert "dp_shard" in mesh.axis_names
