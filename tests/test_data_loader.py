@@ -1,0 +1,211 @@
+import numpy as np
+import pytest
+
+import jax
+
+from accelerate_tpu import data_loader as dl
+from accelerate_tpu.parallelism_config import ParallelismConfig
+from accelerate_tpu.state import AcceleratorState, GradientState
+
+
+def make_mesh(**sizes):
+    cfg = ParallelismConfig(**sizes)
+    return cfg.build_device_mesh()
+
+
+# ---------------------------------------------------------------- samplers
+def test_seedable_random_sampler_deterministic():
+    s1 = dl.SeedableRandomSampler(10, seed=42, epoch=0)
+    s2 = dl.SeedableRandomSampler(10, seed=42, epoch=0)
+    assert list(s1) == list(s2)
+    s2.set_epoch(1)
+    assert list(s1) != list(s2)
+    assert sorted(list(s2)) == list(range(10))
+
+
+def _batches(n, bs, drop_last=False):
+    return dl._SimpleBatchSampler(range(n), bs, drop_last)
+
+
+def test_batch_sampler_shard_even_division():
+    base = _batches(16, 2)  # 8 batches of 2
+    shards = [
+        list(dl.BatchSamplerShard(base, num_processes=4, process_index=i)) for i in range(4)
+    ]
+    # each process gets 2 batches, strided
+    assert shards[0] == [[0, 1], [8, 9]]
+    assert shards[3] == [[6, 7], [14, 15]]
+    assert all(len(s) == 2 for s in shards)
+
+
+def test_batch_sampler_shard_uneven_loops_to_even():
+    base = _batches(10, 2)  # 5 batches of 2
+    shards = [
+        list(dl.BatchSamplerShard(base, num_processes=2, process_index=i)) for i in range(2)
+    ]
+    # both processes must yield the same number of full-size batches
+    assert len(shards[0]) == len(shards[1]) == 3
+    for s in shards:
+        for b in s:
+            assert len(b) == 2
+
+
+def test_batch_sampler_shard_short_last_batch_padded():
+    base = _batches(9, 2)  # 4 full batches + [8]
+    shards = [
+        list(dl.BatchSamplerShard(base, num_processes=2, process_index=i)) for i in range(2)
+    ]
+    assert len(shards[0]) == len(shards[1])
+    for s in shards:
+        for b in s:
+            assert len(b) == 2
+
+
+def test_batch_sampler_shard_drop_last():
+    # drop_last propagates from the inner sampler: 9 samples, bs 2, drop_last
+    # → 4 batches → 2 per process, no refill needed
+    base = _batches(9, 2, drop_last=True)
+    shards = [
+        list(dl.BatchSamplerShard(base, num_processes=2, process_index=i)) for i in range(2)
+    ]
+    assert shards[0] == [[0, 1], [4, 5]]
+    assert shards[1] == [[2, 3], [6, 7]]
+
+
+def test_batch_sampler_shard_split_mode():
+    base = _batches(8, 4)  # global batches of 4
+    shards = [
+        list(
+            dl.BatchSamplerShard(
+                base, num_processes=2, process_index=i, split_batches=True
+            )
+        )
+        for i in range(2)
+    ]
+    assert shards[0] == [[0, 1], [4, 5]]
+    assert shards[1] == [[2, 3], [6, 7]]
+
+
+def test_iterable_dataset_shard():
+    data = list(range(10))
+    shards = [
+        list(
+            dl.IterableDatasetShard(
+                data, batch_size=2, num_processes=2, process_index=i
+            )
+        )
+        for i in range(2)
+    ]
+    # buffer of 4: p0 takes [0,1], p1 takes [2,3], etc.
+    assert shards[0][:2] == [0, 1]
+    assert shards[1][:2] == [2, 3]
+    # all elements covered (with tail padding)
+    assert len(shards[0]) == len(shards[1])
+
+
+# ----------------------------------------------------------------- loaders
+def test_prepare_dict_dataset_single_process():
+    mesh = make_mesh(dp_shard_size=8)
+    data = {"x": np.arange(16.0)[:, None]}
+    loader = dl.prepare_data_loader(data, mesh=mesh, batch_size=8, drop_last=True)
+    batches = list(loader)
+    assert len(batches) == 2
+    b = batches[0]
+    assert isinstance(b["x"], jax.Array)
+    # sharded over dp_shard
+    assert b["x"].sharding.spec[0] in ("dp_shard", ("dp_shard",))
+    np.testing.assert_array_equal(np.asarray(b["x"]).ravel(), np.arange(8.0))
+
+
+def test_end_of_dataloader_flag_and_gradient_state():
+    mesh = make_mesh(dp_shard_size=8)
+    data = {"x": np.arange(8.0)[:, None]}
+    loader = dl.prepare_data_loader(data, mesh=mesh, batch_size=4, drop_last=True)
+    gs = GradientState()
+    seen = []
+    for batch in loader:
+        seen.append(loader.end_of_dataloader)
+        assert gs.in_dataloader
+    assert seen == [False, True]
+    assert not gs.in_dataloader
+
+
+def test_shuffle_deterministic_across_epochs():
+    mesh = make_mesh(dp_shard_size=8)
+    data = {"x": np.arange(16.0)[:, None]}
+    loader = dl.prepare_data_loader(
+        data, mesh=mesh, batch_size=8, shuffle=True, seed=7, drop_last=True
+    )
+    e0_a = [np.asarray(b["x"]).ravel().tolist() for b in loader]
+    loader.set_epoch(0)
+    e0_b = [np.asarray(b["x"]).ravel().tolist() for b in loader]
+    assert e0_a == e0_b
+    loader.set_epoch(1)
+    e1 = [np.asarray(b["x"]).ravel().tolist() for b in loader]
+    assert e0_a != e1
+
+
+def test_skip_first_batches():
+    mesh = make_mesh(dp_shard_size=8)
+    data = {"x": np.arange(32.0)[:, None]}
+    loader = dl.prepare_data_loader(data, mesh=mesh, batch_size=8, drop_last=True)
+    all_batches = [np.asarray(b["x"]).ravel().tolist() for b in loader]
+    loader2 = dl.skip_first_batches(loader, 2)
+    rest = [np.asarray(b["x"]).ravel().tolist() for b in loader2]
+    assert rest == all_batches[2:]
+    assert len(loader2) == 2
+
+
+def test_remainder_tracked():
+    mesh = make_mesh(dp_shard_size=8)
+    data = {"x": np.arange(10.0)[:, None]}
+    loader = dl.prepare_data_loader(data, mesh=mesh, batch_size=8)
+    for _ in loader:
+        pass
+    assert loader.remainder == 2  # 10 % 8
+
+
+def test_dispatcher_single_process():
+    mesh = make_mesh(dp_shard_size=8)
+    data = {"x": np.arange(16.0)[:, None]}
+    loader = dl.prepare_data_loader(
+        data, mesh=mesh, batch_size=8, dispatch_batches=True, drop_last=True
+    )
+    batches = list(loader)
+    assert len(batches) == 2
+    assert isinstance(batches[0]["x"], jax.Array)
+
+
+def test_torch_dataloader_roundtrip():
+    torch = pytest.importorskip("torch")
+    import torch.utils.data as tud
+
+    mesh = make_mesh(dp_shard_size=8)
+
+    class DS(tud.Dataset):
+        def __len__(self):
+            return 16
+
+        def __getitem__(self, i):
+            return {"x": torch.tensor([float(i)])}
+
+    loader = tud.DataLoader(DS(), batch_size=8)
+    prepared = dl.prepare_data_loader(loader, mesh=mesh)
+    batches = list(prepared)
+    assert len(batches) == 2
+    assert isinstance(batches[0]["x"], jax.Array)
+    np.testing.assert_array_equal(
+        np.asarray(batches[0]["x"]).ravel(), np.arange(8.0)
+    )
+
+
+def test_prefetch_iterator_propagates_errors():
+    def boom():
+        yield 1
+        raise RuntimeError("boom")
+
+    pf = dl._DevicePrefetcher(boom(), lambda x: x)
+    assert next(pf) == 1
+    with pytest.raises(RuntimeError, match="boom"):
+        next(pf)
+        next(pf)
