@@ -1,0 +1,119 @@
+"""Functional model bundle — the unit ``Accelerator.prepare`` works on.
+
+The reference wraps ``torch.nn.Module`` objects in engine wrappers (DDP/FSDP/
+deepspeed engines) and monkey-patches ``forward`` (accelerator.py:1769-2068,
+hooks.py:186). A TPU-native design has no module objects to mutate: a model is
+``apply_fn(params, *args, **kwargs)`` plus a parameter pytree. :class:`Model`
+packages the two with optional mixed-precision policy and sharding metadata,
+and stays *callable* so user loops read like the reference's.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+import jax
+
+__all__ = ["Model", "wrap_flax_model", "unwrap_model"]
+
+
+class Model:
+    """A (apply_fn, params) bundle.
+
+    ``model(*args)`` runs a jit-compiled forward with the CURRENT params —
+    eval/inference reads exactly like torch. Inside a compiled train step the
+    step function uses :meth:`bind` / :attr:`apply_fn` functionally.
+    """
+
+    def __init__(
+        self,
+        apply_fn: Callable,
+        params: Any,
+        name: str = "model",
+        mixed_precision_policy=None,
+    ):
+        self.apply_fn = apply_fn
+        self.params = params
+        self.name = name
+        self.policy = mixed_precision_policy
+        self.shardings = None  # set by Accelerator.prepare
+        self.mesh = None
+        self._jitted_forward: Optional[Callable] = None
+
+    # ------------------------------------------------------------ construction
+    @classmethod
+    def from_flax(cls, module, params: Any, name: str = "model", method=None) -> "Model":
+        """Wrap a flax.linen module + its params."""
+
+        def apply_fn(p, *args, **kwargs):
+            variables = {"params": p} if not (isinstance(p, dict) and "params" in p) else p
+            if method is not None:
+                return module.apply(variables, *args, method=method, **kwargs)
+            return module.apply(variables, *args, **kwargs)
+
+        return cls(apply_fn, params, name=name)
+
+    # ------------------------------------------------------------ forward path
+    def _mp_apply(self, params, *args, **kwargs):
+        """Mixed-precision forward: params→compute dtype, outputs→fp32 — the
+        analogue of the reference's autocast wrap + ConvertOutputsToFp32
+        (accelerator.py:1818-1829)."""
+        if self.policy is not None:
+            params = self.policy.cast_to_compute(params)
+            out = self.apply_fn(params, *args, **kwargs)
+            return self.policy.cast_to_output(out)
+        return self.apply_fn(params, *args, **kwargs)
+
+    def bind(self, params) -> Callable:
+        """Functional view for use inside traced step functions."""
+        return functools.partial(self._mp_apply, params)
+
+    def __call__(self, *args, **kwargs):
+        if self._jitted_forward is None:
+            self._jitted_forward = jax.jit(self._mp_apply)
+        return self._jitted_forward(self.params, *args, **kwargs)
+
+    # ------------------------------------------------------------- inspection
+    @property
+    def num_parameters(self) -> int:
+        return sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(self.params))
+
+    def parameter_bytes(self) -> int:
+        return sum(
+            int(np.prod(p.shape)) * p.dtype.itemsize
+            for p in jax.tree_util.tree_leaves(self.params)
+        )
+
+    def state_dict(self) -> Any:
+        """Host copy of params (reference Accelerator.get_state_dict,
+        accelerator.py:4002)."""
+        return jax.tree_util.tree_map(lambda p: np.asarray(p), self.params)
+
+    def load_state_dict(self, state: Any) -> None:
+        """Load a host pytree, preserving current shardings."""
+        if self.shardings is not None:
+            self.params = jax.tree_util.tree_map(
+                lambda t, s: jax.device_put(np.asarray(t), s), state, self.shardings
+            )
+        else:
+            self.params = jax.tree_util.tree_map(jax.numpy.asarray, state)
+
+    def __repr__(self) -> str:
+        return (
+            f"Model({self.name}, params={self.num_parameters:,}, "
+            f"sharded={self.shardings is not None})"
+        )
+
+
+def wrap_flax_model(module, params, **kwargs) -> Model:
+    return Model.from_flax(module, params, **kwargs)
+
+
+def unwrap_model(model) -> Any:
+    """API parity with reference ``extract_model_from_parallel``
+    (utils/other.py:248): our Model is never engine-wrapped, so this is a
+    pass-through that also accepts the raw (apply_fn, params) shape."""
+    return model

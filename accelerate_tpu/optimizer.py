@@ -1,0 +1,260 @@
+"""Optimizer wrapper over optax with gradient accumulation and loss scaling.
+
+TPU-native re-design of the reference's ``optimizer.py`` (213 LoC,
+/root/reference/src/accelerate/optimizer.py): same observable semantics —
+``step`` is skipped while accumulating (:112,162), fp16 overflow detection
+skips the step (:163-177), ``step_was_skipped`` is queryable — but the
+mechanics are functional: gradients accumulate into a device-resident buffer
+pytree (sharded like the gradients), and the parameter update is one fused
+jitted apply. The reference's device-placement of optimizer state
+(optimizer.py:69-75) is replaced by sharding propagation: ``tx.init`` runs
+under jit on sharded params, so moment buffers inherit the param shardings
+(ZeRO for free — SURVEY §2.4 "ZeRO ≈ sharded optimizer pytree").
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .state import AcceleratorState, GradientState
+
+__all__ = ["AcceleratedOptimizer", "DynamicScale"]
+
+
+class DynamicScale:
+    """fp16 dynamic loss scaling (the role of torch GradScaler in reference
+    accelerator.py:561-583 / optimizer.py:163-177)."""
+
+    def __init__(
+        self,
+        init_scale: float = 2.0**16,
+        growth_factor: float = 2.0,
+        backoff_factor: float = 0.5,
+        growth_interval: int = 2000,
+    ):
+        self.growth_factor = growth_factor
+        self.backoff_factor = backoff_factor
+        self.growth_interval = growth_interval
+        self.state = {
+            "scale": jnp.float32(init_scale),
+            "good_steps": jnp.int32(0),
+        }
+
+    def scale_loss(self, loss):
+        return loss * self.state["scale"]
+
+    def unscale(self, grads):
+        inv = 1.0 / self.state["scale"]
+        return jax.tree_util.tree_map(lambda g: g * inv, grads)
+
+    @staticmethod
+    def grads_finite(grads) -> jax.Array:
+        leaves = jax.tree_util.tree_leaves(grads)
+        finite = jnp.bool_(True)
+        for g in leaves:
+            finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(g)))
+        return finite
+
+    def update(self, is_finite) -> None:
+        scale, good = self.state["scale"], self.state["good_steps"]
+        new_scale = jnp.where(
+            is_finite,
+            jnp.where(
+                good + 1 >= self.growth_interval, scale * self.growth_factor, scale
+            ),
+            scale * self.backoff_factor,
+        )
+        new_good = jnp.where(
+            is_finite, jnp.where(good + 1 >= self.growth_interval, 0, good + 1), 0
+        )
+        self.state = {"scale": new_scale, "good_steps": new_good}
+
+    def state_dict(self):
+        return {k: float(v) if k == "scale" else int(v) for k, v in self.state.items()}
+
+    def load_state_dict(self, sd):
+        self.state = {
+            "scale": jnp.float32(sd["scale"]),
+            "good_steps": jnp.int32(sd["good_steps"]),
+        }
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _tree_add(acc, grads):
+    return jax.tree_util.tree_map(jnp.add, acc, grads)
+
+
+@jax.jit
+def _tree_scale(tree, factor):
+    return jax.tree_util.tree_map(lambda t: t * factor, tree)
+
+
+@jax.jit
+def _global_norm(tree):
+    return jnp.sqrt(
+        sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in jax.tree_util.tree_leaves(tree))
+    )
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _clip_by_global_norm(grads, max_norm):
+    norm = _global_norm(grads)
+    factor = jnp.minimum(1.0, max_norm / (norm + 1e-6))
+    return jax.tree_util.tree_map(lambda g: g * factor, grads), norm
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _clip_by_value(grads, clip_value):
+    return jax.tree_util.tree_map(lambda g: jnp.clip(g, -clip_value, clip_value), grads)
+
+
+class AcceleratedOptimizer:
+    """Wraps an ``optax.GradientTransformation``.
+
+    Lifecycle: ``Accelerator.prepare`` calls :meth:`init` with the sharded
+    params (and keeps ``model`` linked so ``step()`` can write updated params
+    back, preserving the reference's in-place mental model). During the loop:
+
+    * ``accelerator.backward(...)`` calls :meth:`accumulate_grads`;
+    * ``optimizer.step()`` applies the update iff ``GradientState.
+      sync_gradients`` (reference optimizer.py:162) and grads are finite
+      (fp16, reference :163-177);
+    * ``optimizer.zero_grad()`` drops the accumulation buffer.
+    """
+
+    def __init__(self, optimizer, scaler: Optional[DynamicScale] = None):
+        import optax
+
+        if isinstance(optimizer, AcceleratedOptimizer):
+            raise ValueError("optimizer is already wrapped by AcceleratedOptimizer")
+        if not (hasattr(optimizer, "init") and hasattr(optimizer, "update")):
+            raise TypeError(
+                f"Expected an optax.GradientTransformation, got {type(optimizer)}"
+            )
+        self.tx = optimizer
+        self.scaler = scaler
+        self.gradient_state = GradientState()
+        self.opt_state = None
+        self.model = None  # linked by Accelerator.prepare
+        self._accum_grads = None
+        self._accum_count = 0
+        self.step_was_skipped = False
+        self._step_count = 0
+        self._update_fn = None
+
+    # ------------------------------------------------------------------ setup
+    def init(self, model) -> None:
+        self.model = model
+        # jit so moment buffers inherit param shardings via GSPMD propagation
+        self.opt_state = jax.jit(self.tx.init)(model.params)
+
+        def apply(params, opt_state, grads):
+            updates, new_opt_state = self.tx.update(grads, opt_state, params)
+            import optax
+
+            new_params = optax.apply_updates(params, updates)
+            return new_params, new_opt_state
+
+        self._update_fn = jax.jit(apply, donate_argnums=(0, 1, 2))
+
+    @property
+    def params(self):
+        return self.model.params if self.model is not None else None
+
+    # ------------------------------------------------------------------ grads
+    def accumulate_grads(self, grads) -> None:
+        """Add a microbatch's grads into the buffer. Grads arrive already
+        divided by ``gradient_accumulation_steps`` (reference divides the loss,
+        accelerator.py:2840 — same arithmetic)."""
+        if self._accum_grads is None:
+            self._accum_grads = grads
+        else:
+            self._accum_grads = _tree_add(self._accum_grads, grads)
+        self._accum_count += 1
+
+    @property
+    def grads(self):
+        return self._accum_grads
+
+    def clip_grad_norm_(self, max_norm: float):
+        if self._accum_grads is None:
+            return jnp.float32(0.0)
+        if self.scaler is not None:
+            self._accum_grads = self.scaler.unscale(self._accum_grads)
+            self._unscaled = True
+        self._accum_grads, norm = _clip_by_global_norm(self._accum_grads, max_norm)
+        return norm
+
+    def clip_grad_value_(self, clip_value: float):
+        if self._accum_grads is None:
+            return
+        if self.scaler is not None:
+            self._accum_grads = self.scaler.unscale(self._accum_grads)
+            self._unscaled = True
+        self._accum_grads = _clip_by_value(self._accum_grads, clip_value)
+
+    # ------------------------------------------------------------------- step
+    def step(self) -> None:
+        if not self.gradient_state.sync_gradients:
+            self.step_was_skipped = True
+            return
+        if self._accum_grads is None:
+            self.step_was_skipped = True
+            return
+        grads = self._accum_grads
+        if self.scaler is not None:
+            if not getattr(self, "_unscaled", False):
+                grads = self.scaler.unscale(grads)
+            finite = self.scaler.grads_finite(grads)
+            self.scaler.update(finite)
+            if not bool(finite):
+                # overflow: skip step (reference optimizer.py:163-177)
+                self.step_was_skipped = True
+                self._accum_grads = None
+                self._accum_count = 0
+                self._unscaled = False
+                return
+        self._unscaled = False
+        new_params, self.opt_state = self._update_fn(
+            self.model.params, self.opt_state, grads
+        )
+        self.model.params = new_params
+        self._accum_grads = None
+        self._accum_count = 0
+        self.step_was_skipped = False
+        self._step_count += 1
+
+    def zero_grad(self, set_to_none: bool = True) -> None:
+        """Clear accumulated grads — only once synced, mirroring reference
+        optimizer.py:112 (zero_grad is a no-op mid-accumulation)."""
+        if self.gradient_state.sync_gradients:
+            self._accum_grads = None
+            self._accum_count = 0
+
+    # ------------------------------------------------------------- state dict
+    def state_dict(self):
+        host = jax.tree_util.tree_map(lambda t: jax.device_get(t), self.opt_state)
+        sd = {"opt_state": host, "step_count": self._step_count}
+        if self.scaler is not None:
+            sd["scaler"] = self.scaler.state_dict()
+        return sd
+
+    def load_state_dict(self, sd) -> None:
+        target = self.opt_state
+
+        def place(ref, val):
+            if isinstance(ref, jax.Array):
+                return jax.device_put(jnp.asarray(val), ref.sharding)
+            return val
+
+        self.opt_state = jax.tree_util.tree_map(place, target, sd["opt_state"])
+        self._step_count = sd.get("step_count", 0)
+        if self.scaler is not None and "scaler" in sd:
+            self.scaler.load_state_dict(sd["scaler"])
+
+    def __repr__(self):
+        return f"AcceleratedOptimizer({type(self.tx).__name__}, steps={self._step_count})"

@@ -1,0 +1,47 @@
+"""Tensor-parallel sharding rules (Megatron column/row parallelism).
+
+TPU-native replacement for the reference's TP path, which requires models to
+arrive pre-sharded by transformers ``tp_plan="auto"`` as DTensors and then
+validates/remaps (reference ``_prepare_tp``, accelerator.py:1580-1656). Here
+TP is just PartitionSpec rules over the ``tp`` mesh axis: column-parallel
+weights shard their output dim, row-parallel their input dim; XLA inserts the
+(two per block) all-reduces that Megatron does by hand.
+
+Rules match common parameter naming across our models/, flax, and
+transformers-flax checkpoints.
+"""
+
+from __future__ import annotations
+
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["tensor_parallel_rules", "COLUMN_PARALLEL_PATTERNS", "ROW_PARALLEL_PATTERNS"]
+
+# Output-dim (column) parallel: QKV projections, MLP up/gate, embedding vocab
+COLUMN_PARALLEL_PATTERNS = [
+    r"(q_proj|k_proj|v_proj|qkv|query|key|value)/kernel",
+    r"(up_proj|gate_proj|wi|fc1|w1|w3|intermediate/dense)/kernel",
+    r"(embed_tokens|wte|word_embeddings|embedding)/(embedding|weight)",
+    r"lm_head/kernel",
+]
+
+# Input-dim (row) parallel: attention output proj, MLP down
+ROW_PARALLEL_PATTERNS = [
+    r"(o_proj|out_proj|dense_out|wo|fc2|w2|down_proj|attention/dense|output/dense)/kernel",
+]
+
+
+def tensor_parallel_rules(tp_axis: str = "tp") -> list[tuple[str, P]]:
+    """(regex, spec) rules for 2-D kernels stored (in_features, out_features)
+    — the flax convention. Column-parallel shards dim 1 (output), row-parallel
+    shards dim 0 (input). Embedding tables (vocab, hidden) shard the vocab dim.
+    """
+    rules: list[tuple[str, P]] = []
+    for pat in COLUMN_PARALLEL_PATTERNS:
+        if "embed" in pat or "wte" in pat:
+            rules.append((pat, P(tp_axis, None)))
+        else:
+            rules.append((pat, P(None, tp_axis)))
+    for pat in ROW_PARALLEL_PATTERNS:
+        rules.append((pat, P(tp_axis, None)))
+    return rules

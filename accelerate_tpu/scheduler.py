@@ -1,0 +1,70 @@
+"""Learning-rate scheduler wrapper.
+
+TPU-native analogue of the reference's ``scheduler.py`` (98 LoC,
+/root/reference/src/accelerate/scheduler.py): steps only when the optimizer
+really stepped (:69-82). The reference also steps ``num_processes``× when not
+``split_batches`` because each of its processes runs an independent loop; a
+single-controller SPMD program takes exactly one global step per global batch,
+so that multiplier is structurally unnecessary — kept as an explicit opt-in
+knob for users porting step-count-sensitive schedules.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+__all__ = ["AcceleratedScheduler"]
+
+
+class AcceleratedScheduler:
+    """Wraps an optax schedule fn ``step -> lr`` (or any object with
+    ``.step()``/``.get_last_lr()``)."""
+
+    def __init__(
+        self,
+        scheduler: Union[Callable[[int], float], object],
+        optimizer=None,
+        step_with_optimizer: bool = True,
+        split_batches: bool = False,
+        step_multiplier: int = 1,
+    ):
+        self.scheduler = scheduler
+        self.optimizer = optimizer
+        self.step_with_optimizer = step_with_optimizer
+        self.split_batches = split_batches
+        self.step_multiplier = step_multiplier
+        self.step_count = 0
+        from .state import GradientState
+
+        self.gradient_state = GradientState()
+
+    def _is_schedule_fn(self) -> bool:
+        return callable(self.scheduler) and not hasattr(self.scheduler, "step")
+
+    def step(self, *args, **kwargs) -> None:
+        if self.step_with_optimizer:
+            # only advance when the optimizer actually stepped
+            if not self.gradient_state.sync_gradients:
+                return
+            if self.optimizer is not None and self.optimizer.step_was_skipped:
+                return
+        increment = 1 if self.split_batches else self.step_multiplier
+        self.step_count += increment
+        if not self._is_schedule_fn():
+            self.scheduler.step(*args, **kwargs)
+
+    def get_last_lr(self) -> list:
+        if self._is_schedule_fn():
+            return [float(self.scheduler(self.step_count))]
+        return list(self.scheduler.get_last_lr())
+
+    def state_dict(self) -> dict:
+        sd = {"step_count": self.step_count}
+        if not self._is_schedule_fn() and hasattr(self.scheduler, "state_dict"):
+            sd["inner"] = self.scheduler.state_dict()
+        return sd
+
+    def load_state_dict(self, sd: dict) -> None:
+        self.step_count = sd.get("step_count", 0)
+        if "inner" in sd and hasattr(self.scheduler, "load_state_dict"):
+            self.scheduler.load_state_dict(sd["inner"])

@@ -1,0 +1,1 @@
+from .training import RegressionDataset, RegressionModel, make_regression_data

@@ -1,0 +1,48 @@
+"""Tiny regression fixtures for exact-parity training checks.
+
+Analogue of the reference's ``test_utils/training.py`` RegressionDataset /
+RegressionModel, used to assert distributed-vs-single-device training parity
+(reference test_utils/scripts/test_script.py:449 ``training_check``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..model import Model
+
+
+def make_regression_data(n: int = 96, seed: int = 42, a: float = 2.0, b: float = 3.0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 1)).astype(np.float32)
+    y = (a * x + b).astype(np.float32)
+    return {"x": x, "y": y}
+
+
+class RegressionDataset:
+    def __init__(self, length: int = 96, seed: int = 42):
+        self.data = make_regression_data(length, seed)
+        self.length = length
+
+    def __len__(self):
+        return self.length
+
+    def __getitem__(self, i):
+        return {"x": self.data["x"][i], "y": self.data["y"][i]}
+
+
+def RegressionModel(a: float = 0.0, b: float = 0.0) -> Model:
+    """y = a*x + b with scalar params (reference RegressionModel)."""
+
+    def apply_fn(params, x):
+        return params["a"] * x + params["b"]
+
+    params = {"a": jnp.float32(a), "b": jnp.float32(b)}
+    return Model(apply_fn, params, name="regression")
+
+
+def regression_loss(model_view, batch):
+    pred = model_view(batch["x"])
+    return jnp.mean((pred - batch["y"]) ** 2)

@@ -1,0 +1,535 @@
+"""Experiment trackers.
+
+TPU-native analogue of the reference's ``tracking.py`` (1,377 LoC,
+/root/reference/src/accelerate/tracking.py): the same ``GeneralTracker`` ABC
+(name / requires_logging_directory / tracker property / start /
+store_init_configuration / log / finish, reference :102-177), the
+``@on_main_process`` guard (:78), a registry + ``filter_trackers`` (:1311),
+and backends for tensorboard, wandb, mlflow, comet_ml, aim, clearml, dvclive,
+swanlab, trackio plus an always-available JSONL tracker (ours; useful on
+hermetic TPU pods with no tracker deps)."""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import time
+from typing import Any, Optional, Union
+
+from .logging import get_logger
+from .state import PartialState
+from .utils import imports
+
+logger = get_logger(__name__)
+
+__all__ = [
+    "GeneralTracker",
+    "TensorBoardTracker",
+    "WandBTracker",
+    "MLflowTracker",
+    "CometMLTracker",
+    "AimTracker",
+    "ClearMLTracker",
+    "DVCLiveTracker",
+    "SwanLabTracker",
+    "TrackioTracker",
+    "JSONLTracker",
+    "filter_trackers",
+    "register_tracker_class",
+    "on_main_process",
+]
+
+
+def on_main_process(function):
+    """Run only on the main process (reference tracking.py:78)."""
+
+    @functools.wraps(function)
+    def wrapper(*args, **kwargs):
+        if PartialState().is_main_process:
+            return function(*args, **kwargs)
+
+    return wrapper
+
+
+class GeneralTracker:
+    """Tracker ABC (reference tracking.py:102-177)."""
+
+    name: str = "general"
+    requires_logging_directory: bool = False
+    main_process_only: bool = True
+
+    def __init__(self, run_name: str, logging_dir: Optional[str] = None, **kwargs):
+        self.run_name = run_name
+        self.logging_dir = logging_dir
+
+    @property
+    def tracker(self):
+        """The underlying native run object."""
+        raise NotImplementedError
+
+    def start(self):
+        pass
+
+    def store_init_configuration(self, values: dict):
+        pass
+
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        raise NotImplementedError
+
+    def log_images(self, values: dict, step: Optional[int] = None, **kwargs):
+        pass
+
+    def finish(self):
+        pass
+
+
+class JSONLTracker(GeneralTracker):
+    """Dependency-free tracker writing one JSON line per log call — always
+    available (no reference equivalent; hermetic-pod friendly)."""
+
+    name = "jsonl"
+    requires_logging_directory = True
+
+    def __init__(self, run_name: str, logging_dir: Optional[str] = None, **kwargs):
+        super().__init__(run_name, logging_dir)
+        base = os.path.join(logging_dir or ".", run_name)
+        os.makedirs(base, exist_ok=True)
+        self.path = os.path.join(base, "metrics.jsonl")
+        self._fh = None
+
+    @property
+    def tracker(self):
+        return self.path
+
+    @on_main_process
+    def start(self):
+        self._fh = open(self.path, "a")
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        with open(os.path.join(os.path.dirname(self.path), "config.json"), "w") as f:
+            json.dump(values, f, indent=2, default=str)
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        if self._fh is None:
+            self.start()
+        rec = {"_step": step, "_time": time.time()}
+        rec.update({k: (float(v) if hasattr(v, "__float__") else v) for k, v in values.items()})
+        self._fh.write(json.dumps(rec, default=str) + "\n")
+        self._fh.flush()
+
+    @on_main_process
+    def finish(self):
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+
+class TensorBoardTracker(GeneralTracker):
+    """TensorBoard via torch.utils.tensorboard or tensorboardX
+    (reference tracking.py:179-293)."""
+
+    name = "tensorboard"
+    requires_logging_directory = True
+
+    def __init__(self, run_name: str, logging_dir: Optional[str] = None, **kwargs):
+        super().__init__(run_name, logging_dir)
+        try:
+            from torch.utils import tensorboard
+        except ImportError:
+            import tensorboardX as tensorboard
+        self._writer_cls = tensorboard.SummaryWriter
+        self.writer = None
+        self._kwargs = kwargs
+
+    @property
+    def tracker(self):
+        return self.writer
+
+    @on_main_process
+    def start(self):
+        self.writer = self._writer_cls(
+            os.path.join(self.logging_dir or ".", self.run_name), **self._kwargs
+        )
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        self.writer.add_hparams(
+            {k: v for k, v in values.items() if isinstance(v, (int, float, str, bool))}, {}
+        )
+        self.writer.flush()
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        for k, v in values.items():
+            if isinstance(v, str):
+                self.writer.add_text(k, v, global_step=step)
+            elif isinstance(v, dict):
+                self.writer.add_scalars(k, v, global_step=step)
+            else:
+                self.writer.add_scalar(k, float(v), global_step=step, **kwargs)
+        self.writer.flush()
+
+    @on_main_process
+    def finish(self):
+        if self.writer is not None:
+            self.writer.close()
+
+
+class WandBTracker(GeneralTracker):
+    """Weights & Biases (reference tracking.py:294-418)."""
+
+    name = "wandb"
+    requires_logging_directory = False
+
+    def __init__(self, run_name: str, logging_dir: Optional[str] = None, **kwargs):
+        super().__init__(run_name, logging_dir)
+        self._kwargs = kwargs
+        self.run = None
+
+    @property
+    def tracker(self):
+        return self.run
+
+    @on_main_process
+    def start(self):
+        import wandb
+
+        self.run = wandb.init(project=self.run_name, **self._kwargs)
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        import wandb
+
+        wandb.config.update(values, allow_val_change=True)
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        self.run.log(values, step=step, **kwargs)
+
+    @on_main_process
+    def finish(self):
+        if self.run is not None:
+            self.run.finish()
+
+
+class MLflowTracker(GeneralTracker):
+    """MLflow (reference tracking.py:693-901)."""
+
+    name = "mlflow"
+    requires_logging_directory = False
+
+    def __init__(self, run_name: str, logging_dir: Optional[str] = None, **kwargs):
+        super().__init__(run_name, logging_dir)
+        self._kwargs = kwargs
+        self.run = None
+
+    @property
+    def tracker(self):
+        return self.run
+
+    @on_main_process
+    def start(self):
+        import mlflow
+
+        exp = mlflow.set_experiment(self.run_name)
+        self.run = mlflow.start_run(experiment_id=exp.experiment_id, **self._kwargs)
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        import mlflow
+
+        for k, v in values.items():
+            mlflow.log_param(k, v)
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        import mlflow
+
+        mlflow.log_metrics(
+            {k: float(v) for k, v in values.items() if isinstance(v, (int, float))}, step=step
+        )
+
+    @on_main_process
+    def finish(self):
+        import mlflow
+
+        mlflow.end_run()
+
+
+class CometMLTracker(GeneralTracker):
+    """Comet ML (reference tracking.py:496-589)."""
+
+    name = "comet_ml"
+    requires_logging_directory = False
+
+    def __init__(self, run_name: str, logging_dir: Optional[str] = None, **kwargs):
+        super().__init__(run_name, logging_dir)
+        self._kwargs = kwargs
+        self.experiment = None
+
+    @property
+    def tracker(self):
+        return self.experiment
+
+    @on_main_process
+    def start(self):
+        import comet_ml
+
+        self.experiment = comet_ml.Experiment(project_name=self.run_name, **self._kwargs)
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        self.experiment.log_parameters(values)
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        if step is not None:
+            self.experiment.set_step(step)
+        self.experiment.log_metrics(values, step=step, **kwargs)
+
+    @on_main_process
+    def finish(self):
+        if self.experiment is not None:
+            self.experiment.end()
+
+
+class AimTracker(GeneralTracker):
+    """Aim (reference tracking.py:590-692)."""
+
+    name = "aim"
+    requires_logging_directory = True
+
+    def __init__(self, run_name: str, logging_dir: Optional[str] = None, **kwargs):
+        super().__init__(run_name, logging_dir)
+        self._kwargs = kwargs
+        self.run = None
+
+    @property
+    def tracker(self):
+        return self.run
+
+    @on_main_process
+    def start(self):
+        from aim import Run
+
+        self.run = Run(repo=self.logging_dir, experiment=self.run_name, **self._kwargs)
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        self.run["hparams"] = values
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        for k, v in values.items():
+            self.run.track(v, name=k, step=step, **kwargs)
+
+    @on_main_process
+    def finish(self):
+        if self.run is not None:
+            self.run.close()
+
+
+class ClearMLTracker(GeneralTracker):
+    """ClearML (reference tracking.py:902-1059)."""
+
+    name = "clearml"
+    requires_logging_directory = False
+
+    def __init__(self, run_name: str, logging_dir: Optional[str] = None, **kwargs):
+        super().__init__(run_name, logging_dir)
+        self._kwargs = kwargs
+        self.task = None
+
+    @property
+    def tracker(self):
+        return self.task
+
+    @on_main_process
+    def start(self):
+        from clearml import Task
+
+        self.task = Task.init(project_name=self.run_name, **self._kwargs)
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        self.task.connect_configuration(values)
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        clogger = self.task.get_logger()
+        for k, v in values.items():
+            clogger.report_scalar(title=k, series=k, value=float(v), iteration=step or 0)
+
+    @on_main_process
+    def finish(self):
+        if self.task is not None:
+            self.task.close()
+
+
+class DVCLiveTracker(GeneralTracker):
+    """DVC Live (reference tracking.py:1060-1147)."""
+
+    name = "dvclive"
+    requires_logging_directory = False
+
+    def __init__(self, run_name: str, logging_dir: Optional[str] = None, live=None, **kwargs):
+        super().__init__(run_name, logging_dir)
+        self._kwargs = kwargs
+        self.live = live
+
+    @property
+    def tracker(self):
+        return self.live
+
+    @on_main_process
+    def start(self):
+        if self.live is None:
+            from dvclive import Live
+
+            self.live = Live(**self._kwargs)
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        self.live.log_params(values)
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        if step is not None:
+            self.live.step = step
+        for k, v in values.items():
+            self.live.log_metric(k, float(v))
+        self.live.next_step()
+
+    @on_main_process
+    def finish(self):
+        if self.live is not None:
+            self.live.end()
+
+
+class SwanLabTracker(GeneralTracker):
+    """SwanLab (reference tracking.py:1148-1260)."""
+
+    name = "swanlab"
+    requires_logging_directory = False
+
+    def __init__(self, run_name: str, logging_dir: Optional[str] = None, **kwargs):
+        super().__init__(run_name, logging_dir)
+        self._kwargs = kwargs
+        self.run = None
+
+    @property
+    def tracker(self):
+        return self.run
+
+    @on_main_process
+    def start(self):
+        import swanlab
+
+        self.run = swanlab.init(project=self.run_name, **self._kwargs)
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        import swanlab
+
+        swanlab.config.update(values)
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        self.run.log(values, step=step)
+
+    @on_main_process
+    def finish(self):
+        import swanlab
+
+        swanlab.finish()
+
+
+class TrackioTracker(GeneralTracker):
+    """trackio (reference tracking.py:419-495)."""
+
+    name = "trackio"
+    requires_logging_directory = False
+
+    def __init__(self, run_name: str, logging_dir: Optional[str] = None, **kwargs):
+        super().__init__(run_name, logging_dir)
+        self._kwargs = kwargs
+        self.run = None
+
+    @property
+    def tracker(self):
+        return self.run
+
+    @on_main_process
+    def start(self):
+        import trackio
+
+        self.run = trackio.init(project=self.run_name, **self._kwargs)
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        self.run.config.update(values)
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        self.run.log(values)
+
+    @on_main_process
+    def finish(self):
+        import trackio
+
+        trackio.finish()
+
+
+_TRACKERS: dict[str, tuple[type, Any]] = {
+    "jsonl": (JSONLTracker, lambda: True),
+    "tensorboard": (TensorBoardTracker, imports.is_tensorboard_available),
+    "wandb": (WandBTracker, imports.is_wandb_available),
+    "mlflow": (MLflowTracker, imports.is_mlflow_available),
+    "comet_ml": (CometMLTracker, imports.is_comet_ml_available),
+    "aim": (AimTracker, imports.is_aim_available),
+    "clearml": (ClearMLTracker, imports.is_clearml_available),
+    "dvclive": (DVCLiveTracker, imports.is_dvclive_available),
+    "swanlab": (SwanLabTracker, imports.is_swanlab_available),
+    "trackio": (TrackioTracker, imports.is_trackio_available),
+}
+
+
+def register_tracker_class(name: str, tracker_cls: type, availability=lambda: True):
+    """Register a custom tracker backend (reference tracking.py:1261)."""
+    _TRACKERS[name] = (tracker_cls, availability)
+
+
+def filter_trackers(log_with: list, logging_dir: Optional[str] = None) -> list[type]:
+    """Resolve requested trackers to available classes
+    (reference tracking.py:1311-1377). ``"all"`` selects every available one.
+    """
+    if not log_with:
+        return []
+    names = []
+    for entry in log_with:
+        if isinstance(entry, GeneralTracker):
+            names.append(entry)
+            continue
+        entry = str(entry).lower()
+        if entry == "all":
+            names.extend(n for n, (_, avail) in _TRACKERS.items() if avail())
+        else:
+            names.append(entry)
+    out = []
+    for name in names:
+        if isinstance(name, GeneralTracker):
+            out.append(type(name))
+            continue
+        if name not in _TRACKERS:
+            raise ValueError(f"Unknown tracker {name!r}; known: {sorted(_TRACKERS)}")
+        cls, avail = _TRACKERS[name]
+        if not avail():
+            logger.warning(f"Tracker {name} requested but its package is unavailable; skipping")
+            continue
+        if cls.requires_logging_directory and logging_dir is None:
+            raise ValueError(f"Tracker {name} requires a logging_dir")
+        out.append(cls)
+    return out

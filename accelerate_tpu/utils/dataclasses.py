@@ -1,0 +1,275 @@
+"""Plugin/config dataclasses and kwargs handlers.
+
+TPU-native analogue of the reference's ``utils/dataclasses.py`` (3,228 LoC).
+The reference needs one plugin per external engine (DeepSpeedPlugin,
+FullyShardedDataParallelPlugin, MegatronLMPlugin, ...); under GSPMD those
+collapse into :class:`accelerate_tpu.parallelism_config.ParallelismConfig`
+plus the small strategy configs here. Env-var consumption mirrors the
+reference's ``__post_init__`` pattern (utils/dataclasses.py:1815-1945).
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import enum
+import os
+from dataclasses import dataclass, field
+from datetime import timedelta
+from typing import Any, Callable, Optional
+
+from .environment import parse_flag_from_env
+
+
+class KwargsHandler:
+    """Base: diff against defaults → kwargs dict (reference
+    utils/dataclasses.py:70-88)."""
+
+    def to_dict(self) -> dict:
+        return copy.deepcopy(self.__dict__)
+
+    def to_kwargs(self) -> dict:
+        default = self.__class__()
+        this = self.to_dict()
+        return {k: v for k, v in this.items() if getattr(default, k, None) != v}
+
+
+@dataclass
+class GradientAccumulationPlugin(KwargsHandler):
+    """Gradient accumulation settings (reference utils/dataclasses.py
+    ``GradientAccumulationPlugin``).
+
+    ``sync_with_dataloader``: force a sync step when the dataloader ends even
+    if mid-accumulation-window (reference GradientState semantics).
+    """
+
+    num_steps: int = 1
+    adjust_scheduler: bool = True
+    sync_with_dataloader: bool = True
+    sync_each_batch: bool = False
+
+    def __post_init__(self):
+        if self.num_steps < 1:
+            raise ValueError(f"num_steps must be >= 1, got {self.num_steps}")
+
+
+@dataclass
+class AutocastKwargs(KwargsHandler):
+    """Mixed-precision autocast knobs (reference utils/dataclasses.py:
+    ``AutocastKwargs``): enabled flag + cache control is torch-specific, our
+    knob is the compute dtype override."""
+
+    enabled: bool = True
+    cache_enabled: bool = True  # accepted for parity; XLA caches compiled fns
+
+
+@dataclass
+class GradScalerKwargs(KwargsHandler):
+    """Dynamic loss-scaling config for fp16 (reference GradScalerKwargs /
+    torch GradScaler defaults)."""
+
+    init_scale: float = 2.0**16
+    growth_factor: float = 2.0
+    backoff_factor: float = 0.5
+    growth_interval: int = 2000
+    enabled: bool = True
+
+
+@dataclass
+class InitProcessGroupKwargs(KwargsHandler):
+    """Process bootstrap kwargs (reference InitProcessGroupKwargs — timeout
+    for jax.distributed.initialize)."""
+
+    backend: Optional[str] = "xla"
+    init_method: Optional[str] = None
+    timeout: Optional[timedelta] = None
+
+
+class PrecisionType(str, enum.Enum):
+    NO = "no"
+    BF16 = "bf16"
+    FP16 = "fp16"
+    FP8 = "fp8"
+
+    @classmethod
+    def list(cls):
+        return [e.value for e in cls]
+
+
+@dataclass
+class MixedPrecisionPolicy(KwargsHandler):
+    """Three-dtype policy (param/compute/output), the jmp-style TPU-native
+    replacement for torch autocast (reference wraps torch.autocast,
+    accelerator.py:561-612)."""
+
+    param_dtype: str = "float32"
+    compute_dtype: str = "float32"
+    output_dtype: str = "float32"
+
+    @classmethod
+    def from_mixed_precision(cls, mixed_precision: str) -> "MixedPrecisionPolicy":
+        if mixed_precision == "bf16":
+            return cls(param_dtype="float32", compute_dtype="bfloat16", output_dtype="float32")
+        if mixed_precision == "fp16":
+            return cls(param_dtype="float32", compute_dtype="float16", output_dtype="float32")
+        if mixed_precision == "fp8":
+            # fp8 matmul inputs; accumulation still bf16/f32 (see ops/fp8.py)
+            return cls(param_dtype="float32", compute_dtype="bfloat16", output_dtype="float32")
+        return cls()
+
+    def cast_to_compute(self, tree):
+        import jax.numpy as jnp
+        from ..ops.operations import recursively_apply, is_tensor
+
+        dtype = jnp.dtype(self.compute_dtype)
+
+        def cast(t):
+            if hasattr(t, "dtype") and jnp.issubdtype(t.dtype, jnp.floating):
+                return t.astype(dtype)
+            return t
+
+        return recursively_apply(cast, tree)
+
+    def cast_to_output(self, tree):
+        import jax.numpy as jnp
+        from ..ops.operations import recursively_apply
+
+        dtype = jnp.dtype(self.output_dtype)
+
+        def cast(t):
+            if hasattr(t, "dtype") and jnp.issubdtype(t.dtype, jnp.floating):
+                return t.astype(dtype)
+            return t
+
+        return recursively_apply(cast, tree)
+
+
+@dataclass
+class DataLoaderConfiguration(KwargsHandler):
+    """Dataloader behavior knobs (reference utils/dataclasses.py
+    ``DataLoaderConfiguration``)."""
+
+    split_batches: bool = False
+    dispatch_batches: Optional[bool] = None
+    even_batches: bool = True
+    use_seedable_sampler: bool = True
+    data_seed: Optional[int] = None
+    non_blocking: bool = True  # parity; JAX transfers are async by default
+    use_stateful_dataloader: bool = True
+
+
+@dataclass
+class ProjectConfiguration(KwargsHandler):
+    """Checkpoint/log directory layout (reference utils/dataclasses.py
+    ``ProjectConfiguration``)."""
+
+    project_dir: Optional[str] = None
+    logging_dir: Optional[str] = None
+    automatic_checkpoint_naming: bool = False
+    total_limit: Optional[int] = None
+    iteration: int = 0
+    save_on_each_node: bool = False
+
+    def set_directories(self, project_dir: Optional[str] = None):
+        self.project_dir = project_dir
+        if self.logging_dir is None:
+            self.logging_dir = project_dir
+
+    def __post_init__(self):
+        if self.logging_dir is None:
+            self.logging_dir = self.project_dir
+
+
+@dataclass
+class FSDPPlugin(KwargsHandler):
+    """FSDP strategy knobs mapped to GSPMD equivalents
+    (reference FullyShardedDataParallelPlugin, utils/dataclasses.py:1586-2191).
+
+    Under GSPMD there is no wrapping step: parameters whose size exceeds
+    ``min_weight_size`` are sharded along their largest divisible dim over the
+    ``dp_shard``(×``cp``) axes; XLA inserts all-gather/reduce-scatter.
+    ``reshard_after_forward`` maps to rematerialization policy: True → params
+    are re-gathered in backward (XLA default under sharding); False keeps the
+    tail block gathered (the reference's embed/lm_head carve-out).
+    """
+
+    min_weight_size: int = 2**10
+    reshard_after_forward: bool = True
+    cpu_offload: bool = False  # params resident in host RAM, streamed per-step
+    state_dict_type: str = "sharded"  # "sharded" | "full"
+    activation_checkpointing: bool = False
+    sharding_rules: Optional[list] = None  # extra (regex, PartitionSpec) pairs
+
+    def __post_init__(self):
+        if os.environ.get("FSDP_MIN_WEIGHT_SIZE"):
+            self.min_weight_size = int(os.environ["FSDP_MIN_WEIGHT_SIZE"])
+        if os.environ.get("FSDP_ACTIVATION_CHECKPOINTING"):
+            self.activation_checkpointing = parse_flag_from_env("FSDP_ACTIVATION_CHECKPOINTING")
+        if os.environ.get("FSDP_STATE_DICT_TYPE"):
+            self.state_dict_type = os.environ["FSDP_STATE_DICT_TYPE"].lower()
+
+
+@dataclass
+class ContextParallelConfig(KwargsHandler):
+    """Context-parallel (ring attention) config (reference
+    TorchContextParallelConfig, utils/dataclasses.py:2208-2232).
+
+    ``rotate_method``: "allgather" gathers all KV once; "alltoall" rotates KV
+    shards around the cp ring (ring attention) — same vocabulary as the
+    reference's ``set_rotate_method``.
+    """
+
+    rotate_method: str = "alltoall"
+    use_pallas_kernel: bool = True
+    causal: bool = True
+
+    def __post_init__(self):
+        if self.rotate_method not in ("allgather", "alltoall"):
+            raise ValueError(f"rotate_method must be allgather|alltoall, got {self.rotate_method}")
+
+
+@dataclass
+class TensorParallelConfig(KwargsHandler):
+    """TP knobs (reference TorchTensorParallelConfig,
+    utils/dataclasses.py:2295-2314)."""
+
+    tp_size: int = 1
+    enable_async_tp: bool = False  # parity; XLA overlaps collectives itself
+    sharding_rules: Optional[list] = None
+
+
+@dataclass
+class SequenceParallelConfig(KwargsHandler):
+    """Ulysses-style SP (reference DeepSpeedSequenceParallelConfig,
+    utils/dataclasses.py:2235-2292)."""
+
+    sp_size: int = 1
+    attention_heads_must_divide: bool = True
+
+
+@dataclass
+class ProfileKwargs(KwargsHandler):
+    """Profiler config → jax.profiler (reference ProfileKwargs builds a
+    torch.profiler.profile, utils/dataclasses.py:486-599)."""
+
+    activities: Optional[list] = None
+    schedule_option: Optional[dict] = None
+    profile_memory: bool = False
+    with_flops: bool = False
+    record_shapes: bool = False
+    with_stack: bool = False
+    output_trace_dir: Optional[str] = None
+    on_trace_ready: Optional[Callable] = None
+
+
+# Registry used by Accelerator's kwargs_handlers argument
+KWARGS_HANDLER_TYPES = (
+    GradientAccumulationPlugin,
+    AutocastKwargs,
+    GradScalerKwargs,
+    InitProcessGroupKwargs,
+    MixedPrecisionPolicy,
+    DataLoaderConfiguration,
+    ProjectConfiguration,
+    ProfileKwargs,
+)

@@ -1,0 +1,135 @@
+"""Safetensors interchange: sharded save with index, flat-dict utilities.
+
+Mirrors the reference's sharded-safetensors export (Accelerator.save_model,
+accelerator.py:3439-3551; shard split via huggingface_hub split_state_dict,
+index file ``model.safetensors.index.json``) so checkpoints interchange with
+the torch ecosystem. bfloat16 round-trips via ml_dtypes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any
+
+import numpy as np
+
+from .constants import SAFE_WEIGHTS_INDEX_NAME, SAFE_WEIGHTS_NAME, SAFE_WEIGHTS_PATTERN_NAME
+
+__all__ = [
+    "flatten_dict",
+    "unflatten_dict",
+    "parse_size",
+    "save_sharded_safetensors",
+    "load_sharded_safetensors",
+]
+
+_SIZE_UNITS = {"KB": 2**10, "MB": 2**20, "GB": 2**30, "TB": 2**40}
+
+
+def parse_size(size: str) -> int:
+    m = re.fullmatch(r"(\d+(?:\.\d+)?)\s*(KB|MB|GB|TB)?", size.strip(), re.IGNORECASE)
+    if not m:
+        raise ValueError(f"Cannot parse size {size!r}")
+    value = float(m.group(1))
+    unit = (m.group(2) or "").upper()
+    return int(value * _SIZE_UNITS.get(unit, 1))
+
+
+def flatten_dict(tree: Any, sep: str = ".", prefix: str = "") -> dict[str, Any]:
+    out = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            key = f"{prefix}{sep}{k}" if prefix else str(k)
+            if isinstance(v, (dict, list, tuple)):
+                out.update(flatten_dict(v, sep=sep, prefix=key))
+            else:
+                out[key] = v
+    elif isinstance(tree, (list, tuple)):
+        for i, v in enumerate(tree):
+            key = f"{prefix}{sep}{i}" if prefix else str(i)
+            if isinstance(v, (dict, list, tuple)):
+                out.update(flatten_dict(v, sep=sep, prefix=key))
+            else:
+                out[key] = v
+    else:
+        out[prefix or "value"] = tree
+    return out
+
+
+def unflatten_dict(flat: dict[str, Any], sep: str = ".") -> dict:
+    tree: dict = {}
+    for key, value in flat.items():
+        parts = key.split(sep)
+        node = tree
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = value
+    return tree
+
+
+def save_sharded_safetensors(
+    params: Any, save_directory: str, max_shard_size: str = "10GB"
+) -> list[str]:
+    """Split a param pytree into ≤max_shard_size safetensors files + index."""
+    from safetensors.numpy import save_file
+
+    flat = flatten_dict(params)
+    flat = {k: np.asarray(v) for k, v in flat.items()}
+    limit = parse_size(max_shard_size)
+
+    shards: list[dict[str, np.ndarray]] = [{}]
+    sizes = [0]
+    for key, arr in flat.items():
+        nbytes = arr.nbytes
+        if sizes[-1] + nbytes > limit and shards[-1]:
+            shards.append({})
+            sizes.append(0)
+        shards[-1][key] = arr
+        sizes[-1] += nbytes
+
+    os.makedirs(save_directory, exist_ok=True)
+    written = []
+    if len(shards) == 1:
+        path = os.path.join(save_directory, SAFE_WEIGHTS_NAME)
+        save_file(shards[0], path)
+        written.append(path)
+        return written
+
+    index = {"metadata": {"total_size": sum(sizes)}, "weight_map": {}}
+    n = len(shards)
+    for i, shard in enumerate(shards):
+        fname = SAFE_WEIGHTS_PATTERN_NAME.format(suffix=f"-{i + 1:05d}-of-{n:05d}")
+        save_file(shard, os.path.join(save_directory, fname))
+        written.append(os.path.join(save_directory, fname))
+        for key in shard:
+            index["weight_map"][key] = fname
+    with open(os.path.join(save_directory, SAFE_WEIGHTS_INDEX_NAME), "w") as f:
+        json.dump(index, f, indent=2)
+    return written
+
+
+def load_sharded_safetensors(load_directory: str) -> dict[str, np.ndarray]:
+    """Load a (possibly sharded) safetensors checkpoint into a flat dict."""
+    from safetensors.numpy import load_file
+
+    single = os.path.join(load_directory, SAFE_WEIGHTS_NAME)
+    if os.path.exists(single):
+        return load_file(single)
+    index_path = os.path.join(load_directory, SAFE_WEIGHTS_INDEX_NAME)
+    if os.path.exists(index_path):
+        with open(index_path) as f:
+            index = json.load(f)
+        flat: dict[str, np.ndarray] = {}
+        for fname in sorted(set(index["weight_map"].values())):
+            flat.update(load_file(os.path.join(load_directory, fname)))
+        return flat
+    # fall back: any .safetensors files in dir
+    flat = {}
+    for fname in sorted(os.listdir(load_directory)):
+        if fname.endswith(".safetensors"):
+            flat.update(load_file(os.path.join(load_directory, fname)))
+    if not flat:
+        raise FileNotFoundError(f"No safetensors files under {load_directory}")
+    return flat

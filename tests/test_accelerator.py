@@ -1,0 +1,270 @@
+"""End-to-end Accelerator tests: the port of the reference's canonical
+``training_check`` (test_utils/scripts/test_script.py:449) — sharded training
+must match single-device training exactly."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.model import Model
+from accelerate_tpu.parallelism_config import ParallelismConfig
+from accelerate_tpu.state import GradientState
+from accelerate_tpu.test_utils.training import (
+    RegressionModel,
+    make_regression_data,
+    regression_loss,
+)
+
+LR = 0.1
+ATOL = 1e-6
+
+
+def _single_device_reference(data, steps_data, lr=LR, accum=1):
+    """Hand-rolled single-device SGD baseline (no framework)."""
+    params = {"a": jnp.float32(0.0), "b": jnp.float32(0.0)}
+
+    def loss_fn(p, batch):
+        pred = p["a"] * batch["x"] + p["b"]
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    grad_buf = None
+    count = 0
+    for batch in steps_data:
+        g = jax.grad(loss_fn)(params, batch)
+        g = jax.tree_util.tree_map(lambda t: t / accum, g)
+        grad_buf = g if grad_buf is None else jax.tree_util.tree_map(jnp.add, grad_buf, g)
+        count += 1
+        if count % accum == 0:
+            params = jax.tree_util.tree_map(lambda p, gg: p - lr * gg, params, grad_buf)
+            grad_buf = None
+    return {k: float(v) for k, v in params.items()}
+
+
+def _batches(data, bs):
+    n = len(data["x"])
+    return [
+        {k: v[i : i + bs] for k, v in data.items()} for i in range(0, n, bs)
+    ]
+
+
+def make_accelerator(**kwargs):
+    pcfg = kwargs.pop("parallelism_config", ParallelismConfig(dp_shard_size=8))
+    return Accelerator(parallelism_config=pcfg, **kwargs)
+
+
+def test_training_parity_eager_loop():
+    """Reference-shaped loop (backward → clip → step → zero_grad) on an
+    8-way-sharded mesh matches the single-device baseline to 1e-6."""
+    accelerator = make_accelerator()
+    model = RegressionModel()
+    optimizer = optax.sgd(LR)
+    data = make_regression_data(64)
+    loader = accelerator.prepare_data_loader(data, batch_size=16, drop_last=True)
+    model, optimizer = accelerator.prepare(model, optimizer)
+
+    for epoch in range(2):
+        for batch in loader:
+            with accelerator.accumulate(model):
+                loss = accelerator.backward(regression_loss, batch)
+                optimizer.step()
+                optimizer.zero_grad()
+
+    expected = _single_device_reference(data, _batches(data, 16) * 2)
+    assert abs(float(model.params["a"]) - expected["a"]) < ATOL
+    assert abs(float(model.params["b"]) - expected["b"]) < ATOL
+    # moving towards y=2x+3
+    assert float(model.params["a"]) > 1.0
+
+
+def test_training_parity_gradient_accumulation():
+    """accum=2 halves update frequency; parity with baseline accumulating 2."""
+    accelerator = make_accelerator(gradient_accumulation_steps=2)
+    model = RegressionModel()
+    optimizer = optax.sgd(LR)
+    data = make_regression_data(64)
+    loader = accelerator.prepare_data_loader(data, batch_size=16, drop_last=True)
+    model, optimizer = accelerator.prepare(model, optimizer)
+
+    sync_flags = []
+    for batch in loader:
+        with accelerator.accumulate(model):
+            accelerator.backward(regression_loss, batch)
+            sync_flags.append(accelerator.sync_gradients)
+            optimizer.step()
+            optimizer.zero_grad()
+
+    # 4 batches, accum 2 → sync on batches 2 and 4
+    assert sync_flags == [False, True, False, True]
+    expected = _single_device_reference(data, _batches(data, 16), accum=2)
+    assert abs(float(model.params["a"]) - expected["a"]) < ATOL
+    assert abs(float(model.params["b"]) - expected["b"]) < ATOL
+
+
+def test_end_of_dataloader_forces_sync():
+    """Odd batch count with accum=2: the last batch syncs anyway
+    (reference GradientState sync_with_dataloader)."""
+    accelerator = make_accelerator(gradient_accumulation_steps=2)
+    model = RegressionModel()
+    optimizer = optax.sgd(LR)
+    data = make_regression_data(48)  # 3 batches of 16
+    loader = accelerator.prepare_data_loader(data, batch_size=16, drop_last=True)
+    model, optimizer = accelerator.prepare(model, optimizer)
+
+    sync_flags = []
+    for batch in loader:
+        with accelerator.accumulate(model):
+            accelerator.backward(regression_loss, batch)
+            sync_flags.append(accelerator.sync_gradients)
+            optimizer.step()
+            optimizer.zero_grad()
+    assert sync_flags == [False, True, True]
+
+
+def test_fused_train_step_matches_eager():
+    data = make_regression_data(64)
+
+    # eager
+    acc1 = make_accelerator()
+    m1 = RegressionModel()
+    o1 = optax.sgd(LR)
+    loader1 = acc1.prepare_data_loader(data, batch_size=16, drop_last=True)
+    m1, o1 = acc1.prepare(m1, o1)
+    for batch in loader1:
+        with acc1.accumulate(m1):
+            acc1.backward(regression_loss, batch)
+            o1.step()
+            o1.zero_grad()
+
+    # fused — fresh singletons
+    from accelerate_tpu.state import AcceleratorState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+    acc2 = make_accelerator()
+    m2 = RegressionModel()
+    o2 = optax.sgd(LR)
+    loader2 = acc2.prepare_data_loader(data, batch_size=16, drop_last=True)
+    m2, o2 = acc2.prepare(m2, o2)
+    step = acc2.train_step(regression_loss, model=m2, optimizer=o2)
+    for batch in loader2:
+        loss = step(batch)
+    assert np.isfinite(float(loss))
+    assert abs(float(m1.params["a"]) - float(m2.params["a"])) < ATOL
+    assert abs(float(m1.params["b"]) - float(m2.params["b"])) < ATOL
+
+
+def test_clip_grad_norm():
+    accelerator = make_accelerator()
+    model = RegressionModel()
+    optimizer = optax.sgd(LR)
+    data = make_regression_data(16)
+    loader = accelerator.prepare_data_loader(data, batch_size=16, drop_last=True)
+    model, optimizer = accelerator.prepare(model, optimizer)
+    for batch in loader:
+        with accelerator.accumulate(model):
+            accelerator.backward(regression_loss, batch)
+            norm = accelerator.clip_grad_norm_(max_norm=1e-4)
+            optimizer.step()
+    assert float(norm) > 0
+    # grads were clipped to tiny norm → params barely moved
+    assert abs(float(model.params["a"])) < 1e-3
+
+
+def test_scheduler_steps_with_optimizer():
+    accelerator = make_accelerator(gradient_accumulation_steps=2)
+    model = RegressionModel()
+    schedule = optax.linear_schedule(0.1, 0.0, 10)
+    optimizer = optax.sgd(schedule)
+    data = make_regression_data(64)
+    loader = accelerator.prepare_data_loader(data, batch_size=16, drop_last=True)
+    model, optimizer, scheduler = accelerator.prepare(model, optimizer, schedule)
+    for batch in loader:
+        with accelerator.accumulate(model):
+            accelerator.backward(regression_loss, batch)
+            optimizer.step()
+            optimizer.zero_grad()
+            scheduler.step()
+    # 4 batches, accum 2 → 2 real optimizer steps → scheduler stepped twice
+    assert scheduler.step_count == 2
+    assert scheduler.get_last_lr()[0] == pytest.approx(float(schedule(2)))
+
+
+def test_gather_for_metrics_drops_duplicates():
+    accelerator = make_accelerator()
+    data = make_regression_data(20)  # 20 % 16 = 4 → last batch padded
+    loader = accelerator.prepare_data_loader(data, batch_size=16)
+    seen = []
+    for batch in loader:
+        out = accelerator.gather_for_metrics(batch["y"])
+        seen.append(np.asarray(out))
+    total = np.concatenate(seen, axis=0)
+    assert total.shape[0] == 20  # duplicates dropped
+    np.testing.assert_allclose(total.ravel(), data["y"].ravel(), atol=1e-6)
+
+
+def test_mixed_precision_bf16_forward():
+    accelerator = make_accelerator(mixed_precision="bf16")
+    model = RegressionModel()
+    model = accelerator.prepare(model)
+    out = model(np.ones((8, 1), dtype=np.float32))
+    # outputs come back fp32 (policy output dtype)
+    assert out.dtype == jnp.float32
+
+
+def test_fp16_dynamic_scaler_runs():
+    from accelerate_tpu.utils.dataclasses import GradScalerKwargs
+
+    accelerator = make_accelerator(
+        mixed_precision="fp16", kwargs_handlers=[GradScalerKwargs(init_scale=256.0)]
+    )
+    model = RegressionModel()
+    optimizer = optax.sgd(LR)
+    data = make_regression_data(32)
+    loader = accelerator.prepare_data_loader(data, batch_size=16, drop_last=True)
+    model, optimizer = accelerator.prepare(model, optimizer)
+    for batch in loader:
+        with accelerator.accumulate(model):
+            accelerator.backward(regression_loss, batch)
+            optimizer.step()
+            optimizer.zero_grad()
+    assert not optimizer.step_was_skipped
+    assert abs(float(model.params["a"])) > 0  # learned something
+
+
+def test_prepare_returns_same_order():
+    accelerator = make_accelerator()
+    model = RegressionModel()
+    optimizer = optax.sgd(LR)
+    out = accelerator.prepare(optimizer, model)
+    assert isinstance(out[1], Model)
+    from accelerate_tpu.optimizer import AcceleratedOptimizer
+
+    assert isinstance(out[0], AcceleratedOptimizer)
+
+
+def test_fsdp_shards_large_params():
+    """Params above min_weight_size get sharded over dp_shard."""
+    accelerator = make_accelerator()
+
+    def apply_fn(params, x):
+        return x @ params["w"]
+
+    w = np.ones((256, 128), dtype=np.float32)
+    model = Model(apply_fn, {"w": jnp.asarray(w)})
+    model = accelerator.prepare(model)
+    spec = model.shardings["w"].spec
+    assert "dp_shard" in str(spec)
+    # sharded dim is the largest divisible one (256)
+    assert spec[0] == "dp_shard" or spec[0] == ("dp_shard",)
+
+
+def test_small_params_replicated():
+    accelerator = make_accelerator()
+    model = RegressionModel()  # scalar params
+    model = accelerator.prepare(model)
+    assert model.shardings["a"].spec == ()  # replicated

@@ -1,0 +1,174 @@
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.parallelism_config import ParallelismConfig
+from accelerate_tpu.test_utils.training import (
+    RegressionModel,
+    make_regression_data,
+    regression_loss,
+)
+
+LR = 0.1
+
+
+def _train(accelerator, model, optimizer, loader, epochs=1):
+    for _ in range(epochs):
+        for batch in loader:
+            with accelerator.accumulate(model):
+                accelerator.backward(regression_loss, batch)
+                optimizer.step()
+                optimizer.zero_grad()
+
+
+def _fresh(tmp_path, **kwargs):
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+    return Accelerator(
+        parallelism_config=ParallelismConfig(dp_shard_size=8),
+        project_dir=str(tmp_path),
+        **kwargs,
+    )
+
+
+def test_save_load_state_roundtrip(tmp_path):
+    acc = _fresh(tmp_path)
+    model = RegressionModel()
+    optimizer = optax.adam(LR)
+    data = make_regression_data(32)
+    loader = acc.prepare_data_loader(data, batch_size=16, drop_last=True)
+    model, optimizer = acc.prepare(model, optimizer)
+    _train(acc, model, optimizer, loader)
+    a_after, b_after = float(model.params["a"]), float(model.params["b"])
+
+    ckpt = acc.save_state(str(tmp_path / "ckpt"))
+    assert os.path.isdir(ckpt)
+
+    # perturb then restore
+    model.params = {"a": jnp.float32(-5.0), "b": jnp.float32(-5.0)}
+    acc.load_state(str(tmp_path / "ckpt"))
+    assert float(model.params["a"]) == pytest.approx(a_after)
+    assert float(model.params["b"]) == pytest.approx(b_after)
+
+    # training continues identically from restored state (optimizer momenta intact)
+    _train(acc, model, optimizer, loader)
+    resumed = float(model.params["a"])
+
+    acc2 = _fresh(tmp_path)
+    model2 = RegressionModel()
+    optimizer2 = optax.adam(LR)
+    loader2 = acc2.prepare_data_loader(data, batch_size=16, drop_last=True)
+    model2, optimizer2 = acc2.prepare(model2, optimizer2)
+    _train(acc2, model2, optimizer2, loader2, epochs=2)
+    assert resumed == pytest.approx(float(model2.params["a"]), abs=1e-6)
+
+
+def test_automatic_checkpoint_naming_and_total_limit(tmp_path):
+    from accelerate_tpu.utils.dataclasses import ProjectConfiguration
+
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+    acc = Accelerator(
+        parallelism_config=ParallelismConfig(dp_shard_size=8),
+        project_config=ProjectConfiguration(
+            project_dir=str(tmp_path), automatic_checkpoint_naming=True, total_limit=2
+        ),
+    )
+    model = RegressionModel()
+    optimizer = optax.sgd(LR)
+    model, optimizer = acc.prepare(model, optimizer)
+    for _ in range(3):
+        acc.save_state()
+    base = tmp_path / "checkpoints"
+    names = sorted(os.listdir(base))
+    assert names == ["checkpoint_1", "checkpoint_2"]  # oldest GC'd
+    # load_state with no dir → latest
+    acc.load_state()
+
+
+def test_register_for_checkpointing(tmp_path):
+    acc = _fresh(tmp_path)
+
+    class Counter:
+        def __init__(self):
+            self.value = 0
+
+        def state_dict(self):
+            return {"value": self.value}
+
+        def load_state_dict(self, sd):
+            self.value = sd["value"]
+
+    c = Counter()
+    c.value = 41
+    acc.register_for_checkpointing(c)
+    model = RegressionModel()
+    optimizer = optax.sgd(LR)
+    model, optimizer = acc.prepare(model, optimizer)
+    acc.save_state(str(tmp_path / "ckpt"))
+    c.value = 0
+    acc.load_state(str(tmp_path / "ckpt"))
+    assert c.value == 41
+
+    with pytest.raises(ValueError):
+        acc.register_for_checkpointing(object())
+
+
+def test_save_model_safetensors_roundtrip(tmp_path):
+    acc = _fresh(tmp_path)
+
+    def apply_fn(params, x):
+        return x @ params["layer"]["w"] + params["layer"]["b"]
+
+    from accelerate_tpu.model import Model
+
+    model = Model(
+        apply_fn,
+        {
+            "layer": {
+                "w": jnp.arange(32.0).reshape(8, 4),
+                "b": jnp.ones((4,), dtype=jnp.bfloat16),
+            }
+        },
+    )
+    model = acc.prepare(model)
+    acc.save_model(model, str(tmp_path / "export"))
+    assert os.path.exists(tmp_path / "export" / "model.safetensors")
+
+    from accelerate_tpu.checkpointing import load_model_checkpoint
+
+    model.params = {
+        "layer": {"w": jnp.zeros((8, 4)), "b": jnp.zeros((4,), dtype=jnp.bfloat16)}
+    }
+    load_model_checkpoint(model, str(tmp_path / "export"))
+    np.testing.assert_array_equal(
+        np.asarray(model.params["layer"]["w"]), np.arange(32.0).reshape(8, 4)
+    )
+    assert model.params["layer"]["b"].dtype == jnp.bfloat16
+
+
+def test_sharded_safetensors_index(tmp_path):
+    from accelerate_tpu.utils.serialization import (
+        load_sharded_safetensors,
+        save_sharded_safetensors,
+    )
+
+    params = {f"w{i}": np.full((128, 16), float(i), dtype=np.float32) for i in range(4)}
+    written = save_sharded_safetensors(params, str(tmp_path), max_shard_size="10KB")
+    assert len(written) == 4  # each tensor 8KB → one per shard
+    assert os.path.exists(tmp_path / "model.safetensors.index.json")
+    flat = load_sharded_safetensors(str(tmp_path))
+    assert set(flat) == set(params)
+    np.testing.assert_array_equal(flat["w3"], params["w3"])

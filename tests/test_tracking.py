@@ -1,0 +1,94 @@
+import json
+import os
+
+import pytest
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.parallelism_config import ParallelismConfig
+from accelerate_tpu.tracking import (
+    GeneralTracker,
+    JSONLTracker,
+    filter_trackers,
+    register_tracker_class,
+)
+
+
+def _fresh(tmp_path, **kwargs):
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+    return Accelerator(
+        parallelism_config=ParallelismConfig(dp_shard_size=8),
+        project_dir=str(tmp_path),
+        **kwargs,
+    )
+
+
+def test_jsonl_tracker_end_to_end(tmp_path):
+    acc = _fresh(tmp_path, log_with="jsonl")
+    acc.init_trackers("myrun", config={"lr": 0.1, "epochs": 2})
+    acc.log({"loss": 1.5, "acc": 0.7}, step=0)
+    acc.log({"loss": 1.2}, step=1)
+    acc.end_training()
+
+    base = tmp_path / "myrun"
+    with open(base / "config.json") as f:
+        assert json.load(f)["lr"] == 0.1
+    lines = [json.loads(l) for l in open(base / "metrics.jsonl")]
+    assert lines[0]["loss"] == 1.5
+    assert lines[1]["_step"] == 1
+
+
+def test_get_tracker(tmp_path):
+    acc = _fresh(tmp_path, log_with="jsonl")
+    acc.init_trackers("run2")
+    tracker = acc.get_tracker("jsonl")
+    assert isinstance(tracker, JSONLTracker)
+    with pytest.raises(ValueError):
+        acc.get_tracker("wandb")
+
+
+def test_filter_trackers_unknown():
+    with pytest.raises(ValueError, match="Unknown tracker"):
+        filter_trackers(["nope"], None)
+
+
+def test_filter_requires_logging_dir():
+    with pytest.raises(ValueError, match="requires a logging_dir"):
+        filter_trackers(["jsonl"], None)
+
+
+def test_register_custom_tracker(tmp_path):
+    logged = []
+
+    class MyTracker(GeneralTracker):
+        name = "mytracker"
+        requires_logging_directory = False
+
+        @property
+        def tracker(self):
+            return logged
+
+        def log(self, values, step=None, **kwargs):
+            logged.append((step, values))
+
+    register_tracker_class("mytracker", MyTracker)
+    acc = _fresh(tmp_path, log_with="mytracker")
+    acc.init_trackers("run3")
+    acc.log({"x": 1}, step=5)
+    assert logged == [(5, {"x": 1})]
+
+
+@pytest.mark.skipif(
+    not pytest.importorskip("accelerate_tpu.utils.imports").is_tensorboard_available(),
+    reason="tensorboard not installed",
+)
+def test_tensorboard_tracker(tmp_path):
+    acc = _fresh(tmp_path, log_with="tensorboard")
+    acc.init_trackers("tbrun")
+    acc.log({"loss": 0.5}, step=0)
+    acc.end_training()
+    run_dir = tmp_path / "tbrun"
+    assert any(f.startswith("events") for f in os.listdir(run_dir))
