@@ -434,6 +434,7 @@ class Accelerator:
         optimizer: Optional[AcceleratedOptimizer] = None,
         max_grad_norm: Optional[float] = None,
         donate: bool = True,
+        multi_step: bool = False,
     ) -> Callable:
         """Build ONE compiled step: forward+backward+accumulate+update fused
         (the high-MFU path; no reference equivalent — its engines keep these
@@ -445,6 +446,10 @@ class Accelerator:
         objects, and honors gradient accumulation (update fires every
         ``gradient_accumulation_steps`` calls — inside the compiled program,
         no recompilation; reference GradientState semantics).
+
+        ``multi_step=True``: the returned callable takes batches with an extra
+        leading steps dim (N, ...) and runs all N steps in ONE program via
+        ``lax.scan`` — amortizes dispatch overhead; returns the (N,) losses.
         """
         import optax
 
@@ -509,8 +514,26 @@ class Accelerator:
                 )
             return params, opt_state, accum, new_count % (k if k > 1 else 1), scaler_state, loss
 
+        if multi_step:
+
+            def multi(params, opt_state, accum, count, scaler_state, *batches):
+                def body(carry, batch):
+                    params, opt_state, accum, count, scaler_state = carry
+                    params, opt_state, accum, count, scaler_state, loss = fused(
+                        params, opt_state, accum, count, scaler_state, *batch
+                    )
+                    return (params, opt_state, accum, count, scaler_state), loss
+
+                (params, opt_state, accum, count, scaler_state), losses = jax.lax.scan(
+                    body, (params, opt_state, accum, count, scaler_state), batches
+                )
+                return params, opt_state, accum, count, scaler_state, losses
+
+            target = multi
+        else:
+            target = fused
         donate_args = (0, 1, 2) if donate else ()
-        compiled = jax.jit(fused, donate_argnums=donate_args)
+        compiled = jax.jit(target, donate_argnums=donate_args)
 
         zeros_accum = jax.tree_util.tree_map(jnp.zeros_like, model.params) if k > 1 else model.params
         state = {

@@ -1,0 +1,1 @@
+from .llama import LlamaConfig, create_llama, llama_apply, llama_loss, init_llama_params

@@ -1,0 +1,280 @@
+"""Llama-family decoder, TPU-first.
+
+The flagship workload for the FSDP2 Llama-2-7B north-star benchmark
+(BASELINE.json; reference benchmarks/fsdp2/main.py fine-tunes Llama-2-7B).
+Built for XLA, not ported:
+
+* **scan over layers** — one compiled layer body, stacked params (L, ...):
+  compile time O(1) in depth, and the pattern XLA pipelines best;
+* **remat** — ``jax.checkpoint`` on the layer body with a selectable policy
+  ("nothing", "dots" saves matmul outputs, "full" saves everything);
+* bf16 compute / fp32 master params; RMSNorm + rotary + SwiGLU + GQA;
+* attention implementation is injectable: "xla" (materialized), "blockwise"
+  (online softmax), "flash" (Pallas kernel), or "ring"/"ulysses" wired by the
+  CP/SP preparers.
+
+Sharding: parameter names match parallel/tp.py rules (q_proj/k_proj/... →
+column, o_proj/down_proj → row); stacked layer params put the layer dim first
+so the FSDP heuristic shards hidden dims, never the scan dim.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..model import Model
+from ..ops.attention import blockwise_attention, dot_product_attention
+
+__all__ = ["LlamaConfig", "init_llama_params", "llama_apply", "create_llama", "llama_loss"]
+
+
+@dataclasses.dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+    param_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.bfloat16
+    remat_policy: str = "nothing"  # "nothing" | "dots" | "full"
+    attention_impl: str = "blockwise"  # "xla" | "blockwise" | "flash"
+    attention_kv_block: int = 512
+    scan_layers: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @classmethod
+    def llama2_7b(cls, **overrides) -> "LlamaConfig":
+        return cls(**{**dict(
+            vocab_size=32000, hidden_size=4096, intermediate_size=11008,
+            num_hidden_layers=32, num_attention_heads=32, num_key_value_heads=32,
+        ), **overrides})
+
+    @classmethod
+    def tiny(cls, **overrides) -> "LlamaConfig":
+        """Test-size config."""
+        return cls(**{**dict(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=128,
+        ), **overrides})
+
+
+# ------------------------------------------------------------------- params
+def _init_dense(key, in_dim, out_dim, dtype):
+    scale = 1.0 / np.sqrt(in_dim)
+    return (jax.random.normal(key, (in_dim, out_dim)) * scale).astype(dtype)
+
+
+def init_llama_params(config: LlamaConfig, key: jax.Array) -> dict:
+    """Stacked-layer parameter pytree."""
+    d, i, v = config.hidden_size, config.intermediate_size, config.vocab_size
+    h, kvh, hd = config.num_attention_heads, config.num_key_value_heads, config.head_dim
+    L = config.num_hidden_layers
+    dt = config.param_dtype
+    keys = jax.random.split(key, 8)
+
+    def stack_init(k, in_dim, out_dim):
+        ks = jax.random.split(k, L)
+        return jnp.stack([_init_dense(kk, in_dim, out_dim, dt) for kk in ks])
+
+    params = {
+        "embed_tokens": {"embedding": (jax.random.normal(keys[0], (v, d)) * 0.02).astype(dt)},
+        "layers": {
+            "attn": {
+                "q_proj": {"kernel": stack_init(keys[1], d, h * hd)},
+                "k_proj": {"kernel": stack_init(keys[2], d, kvh * hd)},
+                "v_proj": {"kernel": stack_init(keys[3], d, kvh * hd)},
+                "o_proj": {"kernel": stack_init(keys[4], h * hd, d)},
+            },
+            "mlp": {
+                "gate_proj": {"kernel": stack_init(keys[5], d, i)},
+                "up_proj": {"kernel": stack_init(keys[6], d, i)},
+                "down_proj": {"kernel": stack_init(keys[7], i, d)},
+            },
+            "input_norm": {"scale": jnp.ones((L, d), dtype=dt)},
+            "post_attn_norm": {"scale": jnp.ones((L, d), dtype=dt)},
+        },
+        "final_norm": {"scale": jnp.ones((d,), dtype=dt)},
+    }
+    if not config.tie_word_embeddings:
+        params["lm_head"] = {"kernel": _init_dense(keys[0], d, v, dt)}
+    return params
+
+
+# ------------------------------------------------------------------ forward
+def rms_norm(x, scale, eps):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    y = x.astype(jnp.float32) * jax.lax.rsqrt(var + eps)
+    return (y * scale.astype(jnp.float32)).astype(x.dtype)
+
+
+@functools.lru_cache(maxsize=8)
+def _rope_tables(seq_len: int, head_dim: int, theta: float):
+    # host-side cache (numpy) — jnp conversion happens per-trace so no tracers
+    # leak into the cache
+    pos = np.arange(seq_len)
+    freqs = 1.0 / (theta ** (np.arange(0, head_dim, 2) / head_dim))
+    angles = np.outer(pos, freqs)  # (S, hd/2)
+    return np.cos(angles).astype(np.float32), np.sin(angles).astype(np.float32)
+
+
+def apply_rope(x: jax.Array, position_offset: int, theta: float) -> jax.Array:
+    """Rotary embedding on (B, S, H, D); ``position_offset`` supports CP/SP
+    shards that start mid-sequence."""
+    b, s, h, d = x.shape
+    cos_np, sin_np = _rope_tables(s + position_offset, d, theta)
+    cos = jnp.asarray(cos_np[position_offset : position_offset + s])[None, :, None, :]
+    sin = jnp.asarray(sin_np[position_offset : position_offset + s])[None, :, None, :]
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    y1 = x1 * cos - x2 * sin
+    y2 = x2 * cos + x1 * sin
+    out = jnp.stack([y1, y2], axis=-1).reshape(b, s, h, d)
+    return out.astype(x.dtype)
+
+
+def _remat_policy(name: str):
+    if name == "dots":
+        return jax.checkpoint_policies.checkpoint_dots
+    if name == "dots_no_batch":
+        return jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims
+    return None
+
+
+def _attention(config: LlamaConfig, q, k, v, attention_fn=None, q_offset: int = 0):
+    if attention_fn is not None:
+        return attention_fn(q, k, v, causal=True)
+    if config.attention_impl == "flash":
+        from ..ops.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=True)
+    if config.attention_impl == "blockwise":
+        return blockwise_attention(
+            q, k, v, causal=True, kv_block=config.attention_kv_block, q_offset=q_offset
+        )
+    return dot_product_attention(q, k, v, causal=True, q_offset=q_offset)
+
+
+def _layer(config: LlamaConfig, layer_params, x, position_offset: int, attention_fn):
+    """One transformer block on (B, S, D) activations."""
+    h, kvh, hd = config.num_attention_heads, config.num_key_value_heads, config.head_dim
+    b, s, d = x.shape
+    cdt = config.compute_dtype
+
+    residual = x
+    y = rms_norm(x, layer_params["input_norm"]["scale"], config.rms_norm_eps)
+    q = (y @ layer_params["attn"]["q_proj"]["kernel"].astype(cdt)).reshape(b, s, h, hd)
+    k = (y @ layer_params["attn"]["k_proj"]["kernel"].astype(cdt)).reshape(b, s, kvh, hd)
+    v = (y @ layer_params["attn"]["v_proj"]["kernel"].astype(cdt)).reshape(b, s, kvh, hd)
+    q = apply_rope(q, position_offset, config.rope_theta)
+    k = apply_rope(k, position_offset, config.rope_theta)
+    attn = _attention(config, q, k, v, attention_fn, q_offset=position_offset)
+    attn = attn.reshape(b, s, h * hd) @ layer_params["attn"]["o_proj"]["kernel"].astype(cdt)
+    x = residual + attn
+
+    residual = x
+    y = rms_norm(x, layer_params["post_attn_norm"]["scale"], config.rms_norm_eps)
+    gate = y @ layer_params["mlp"]["gate_proj"]["kernel"].astype(cdt)
+    up = y @ layer_params["mlp"]["up_proj"]["kernel"].astype(cdt)
+    y = jax.nn.silu(gate) * up
+    y = y @ layer_params["mlp"]["down_proj"]["kernel"].astype(cdt)
+    return residual + y
+
+
+def llama_apply(
+    config: LlamaConfig,
+    params: dict,
+    input_ids: jax.Array,
+    position_offset: int = 0,
+    attention_fn: Optional[Callable] = None,
+) -> jax.Array:
+    """Forward: (B, S) int tokens → (B, S, V) float32 logits."""
+    cdt = config.compute_dtype
+    x = params["embed_tokens"]["embedding"].astype(cdt)[input_ids]
+
+    layer_fn = functools.partial(
+        _layer, config, position_offset=position_offset, attention_fn=attention_fn
+    )
+    policy = _remat_policy(config.remat_policy)
+    if config.remat_policy != "full":
+        layer_fn = jax.checkpoint(layer_fn, policy=policy)
+
+    if config.scan_layers:
+        def scan_body(x, layer_params):
+            return layer_fn(layer_params, x), None
+
+        x, _ = lax.scan(scan_body, x, params["layers"])
+    else:
+        L = config.num_hidden_layers
+        for li in range(L):
+            lp = jax.tree_util.tree_map(lambda p: p[li], params["layers"])
+            x = layer_fn(lp, x)
+
+    x = rms_norm(x, params["final_norm"]["scale"], config.rms_norm_eps)
+    if config.tie_word_embeddings:
+        logits = x @ params["embed_tokens"]["embedding"].astype(cdt).T
+    else:
+        logits = x @ params["lm_head"]["kernel"].astype(cdt)
+    return logits.astype(jnp.float32)
+
+
+def llama_loss(model_view, batch):
+    """Next-token cross entropy; ``batch = {"input_ids": (B,S)}`` with
+    optional ``"labels"`` (defaults to shifted input_ids) and
+    ``"loss_mask"``."""
+    input_ids = batch["input_ids"]
+    logits = model_view(input_ids)
+    labels = batch.get("labels")
+    if labels is None:
+        labels = input_ids[:, 1:]
+        logits = logits[:, :-1]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    mask = batch.get("loss_mask")
+    if mask is not None:
+        mask = mask[:, : nll.shape[1]]
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1)
+    return jnp.mean(nll)
+
+
+def create_llama(config: LlamaConfig, seed: int = 0) -> Model:
+    params = init_llama_params(config, jax.random.key(seed))
+    model = Model(
+        functools.partial(llama_apply, config), params, name="llama"
+    )
+    model.config = config
+    return model
+
+
+def llama_flops_per_token(config: LlamaConfig, seq_len: int, include_remat: bool = True) -> float:
+    """Approximate *useful* training FLOPs/token (6ND + attention) for MFU.
+
+    MFU convention counts fwd + 2×bwd only; rematerialized recompute is NOT
+    useful work, so it is never included (``include_remat`` kept for
+    hardware-utilization accounting, where full remat adds one extra fwd).
+    """
+    d, i, v = config.hidden_size, config.intermediate_size, config.vocab_size
+    h, kvh, hd = config.num_attention_heads, config.num_key_value_heads, config.head_dim
+    L = config.num_hidden_layers
+    per_layer = 2 * d * (h * hd) + 2 * 2 * d * (kvh * hd) + 2 * (h * hd) * d  # qkvo
+    per_layer += 3 * 2 * d * i  # swiglu
+    attn = 2 * 2 * seq_len * h * hd  # qk + pv per token (upper bound; causal ≈ /2)
+    embed = 2 * d * v  # lm head
+    fwd = L * (per_layer + attn) + embed
+    return 3.0 * fwd  # fwd + 2x bwd

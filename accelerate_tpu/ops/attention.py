@@ -1,0 +1,162 @@
+"""Attention implementations: XLA reference, blockwise, and dispatch.
+
+The compute core shared by models/ and the context/sequence-parallel paths.
+The reference delegates attention entirely to the user's model (torch SDPA);
+a TPU-native framework owns it because CP/SP reshape the attention math
+itself (SURVEY §5 "Long-context").
+
+Layouts: q/k/v are (batch, seq, heads, head_dim) — the layout that keeps the
+head_dim contiguous for the MXU and makes seq the shardable dim for CP/SP.
+GQA is supported via n_kv_heads < n_heads (kv repeated on the fly).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["dot_product_attention", "blockwise_attention", "repeat_kv"]
+
+
+def repeat_kv(k: jax.Array, n_rep: int) -> jax.Array:
+    """(B, S, Hkv, D) → (B, S, Hkv*n_rep, D) for grouped-query attention."""
+    if n_rep == 1:
+        return k
+    b, s, h, d = k.shape
+    return jnp.broadcast_to(k[:, :, :, None, :], (b, s, h, n_rep, d)).reshape(b, s, h * n_rep, d)
+
+
+# Finite mask value: ±inf NaNs XLA autodiff through max/where when a whole
+# block is masked, and magnitudes ≳1e9 NaN on TPU where exp()'s internal
+# range reduction (n = round(x/ln2)) overflows int32 in the transpose pass.
+# -1e6 is unreachable by any real score (|scores| ≲ 1e3 after 1/√d scaling)
+# yet exp(-1e6 - m) underflows to exactly 0 on every backend.
+NEG_INF = -1.0e6
+
+
+def _causal_mask_bias(q_len: int, kv_len: int, q_offset: int = 0, dtype=jnp.float32):
+    """Additive causal bias: 0 where kv_pos <= q_pos (+offset), NEG_INF
+    otherwise. ``q_offset`` supports ring attention where the local q block
+    starts at a global position > 0."""
+    q_pos = lax.broadcasted_iota(jnp.int32, (q_len, kv_len), 0) + q_offset
+    kv_pos = lax.broadcasted_iota(jnp.int32, (q_len, kv_len), 1)
+    return jnp.where(q_pos >= kv_pos, 0.0, NEG_INF).astype(dtype)
+
+
+def dot_product_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    bias: Optional[jax.Array] = None,
+    q_offset: int = 0,
+    kv_offset: int = 0,
+    softmax_dtype=jnp.float32,
+) -> jax.Array:
+    """Reference attention, fully materialized scores. XLA fuses this well for
+    moderate sequence lengths; use the Pallas flash kernel (ops/flash_attention)
+    for long sequences on TPU."""
+    b, sq, h, d = q.shape
+    n_rep = h // k.shape[2]
+    k = repeat_kv(k, n_rep)
+    v = repeat_kv(v, n_rep)
+    scale = 1.0 / math.sqrt(d)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(softmax_dtype) * scale
+    if causal:
+        mask = _causal_mask_bias(sq, k.shape[1], q_offset=q_offset - kv_offset, dtype=softmax_dtype)
+        scores = scores + mask[None, None, :, :]
+    if bias is not None:
+        scores = scores + bias
+    weights = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", weights.astype(v.dtype), v)
+    return out
+
+
+def _attend_block(q, k, v, bias):
+    """One block's contribution with running log-sum-exp stats.
+
+    ``q`` must arrive PRE-SCALED by 1/sqrt(d) — scaling must happen outside
+    the block loop both for flash-kernel convention and because a scalar
+    multiply of the scores inside a scanned body miscompiles to NaN gradients
+    on some TPU stacks.
+
+    Returns (unnormalized_out, row_max, row_sumexp) for online-softmax
+    combination across blocks (the flash/ring attention core). All values
+    stay finite: a fully-masked block yields m=NEG_INF whose contribution is
+    rescaled to exactly 0 when merged with any real block."""
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    if bias is not None:
+        scores = scores + bias
+    m = jnp.max(scores, axis=-1)  # (b,h,q), >= NEG_INF (finite)
+    p = jnp.exp(scores - m[..., None])
+    l = jnp.sum(p, axis=-1)  # (b,h,q)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    return out, m, l
+
+
+def combine_blocks(out_a, m_a, l_a, out_b, m_b, l_b):
+    """Merge two online-softmax partial results (flash attention merge rule)."""
+    m_new = jnp.maximum(m_a, m_b)
+    alpha = jnp.exp(m_a - m_new)
+    beta = jnp.exp(m_b - m_new)
+    l_new = alpha * l_a + beta * l_b
+    # out arrays are (b,q,h,d); stats are (b,h,q) → transpose factor
+    a_f = jnp.swapaxes(alpha, 1, 2)[..., None]
+    b_f = jnp.swapaxes(beta, 1, 2)[..., None]
+    out_new = out_a * a_f.astype(out_a.dtype) + out_b * b_f.astype(out_b.dtype)
+    return out_new, m_new, l_new
+
+
+def finalize_blocks(out, m, l):
+    """Divide by the accumulated softmax denominator."""
+    denom = jnp.swapaxes(l, 1, 2)[..., None]
+    return out / jnp.maximum(denom, 1e-30).astype(out.dtype)
+
+
+def blockwise_attention(
+    q, k, v, *, causal: bool = True, kv_block: int = 512, q_offset: int = 0
+) -> jax.Array:
+    """Memory-efficient attention: iterate KV blocks with online softmax —
+    the same math the ring-attention CP path runs across chips
+    (ops/ring_attention.py), here within one device."""
+    b, sq, h, d = q.shape
+    n_rep = h // k.shape[2]
+    k = repeat_kv(k, n_rep)
+    v = repeat_kv(v, n_rep)
+    skv = k.shape[1]
+    q = q * (1.0 / math.sqrt(d))  # pre-scale (see _attend_block)
+    num_blocks = (skv + kv_block - 1) // kv_block
+    pad = num_blocks * kv_block - skv
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    k = k.reshape(b, num_blocks, kv_block, h, d)
+    v = v.reshape(b, num_blocks, kv_block, h, d)
+
+    def body(carry, blk):
+        out, m, l = carry
+        k_blk, v_blk, idx = blk
+        kv_start = idx * kv_block
+        q_pos = lax.broadcasted_iota(jnp.int32, (sq, kv_block), 0) + q_offset
+        kv_pos = lax.broadcasted_iota(jnp.int32, (sq, kv_block), 1) + kv_start
+        bias = jnp.where(kv_pos < skv, 0.0, NEG_INF)
+        if causal:
+            bias = jnp.where(q_pos >= kv_pos, bias, NEG_INF)
+        o_b, m_b, l_b = _attend_block(q, k_blk, v_blk, bias[None, None])
+        return combine_blocks(out, m, l, o_b, m_b, l_b), None
+
+    init = (
+        jnp.zeros((b, sq, h, d), dtype=q.dtype),
+        jnp.full((b, h, sq), NEG_INF, dtype=jnp.float32),
+        jnp.zeros((b, h, sq), dtype=jnp.float32),
+    )
+    k_t = jnp.moveaxis(k, 1, 0)
+    v_t = jnp.moveaxis(v, 1, 0)
+    (out, m, l), _ = lax.scan(body, init, (k_t, v_t, jnp.arange(num_blocks)))
+    return finalize_blocks(out, m, l)
