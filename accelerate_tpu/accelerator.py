@@ -275,9 +275,40 @@ class Accelerator:
         model.params = apply_shardings(model.params, shardings)
         model.shardings = shardings
         model.mesh = self.mesh
+
+        # CP/SP: inject the mesh-aware attention (the reference instead swaps
+        # torch CP buffers / registers DeepSpeed Ulysses hooks —
+        # accelerator.py:1658-1671, :2386-2437)
+        attention_fn = self.build_attention_fn()
+        if attention_fn is not None:
+            if hasattr(model, "set_attention_fn"):
+                model.set_attention_fn(attention_fn)
+            else:
+                logger.warning(
+                    "cp/sp parallelism configured but the model exposes no "
+                    "set_attention_fn hook; attention will not be sequence-parallel"
+                )
         if model not in self._models:
             self._models.append(model)
         return model
+
+    def build_attention_fn(self):
+        """The attention implementation this mesh calls for: ring attention
+        over cp, Ulysses over sp, or None (single-device attention)."""
+        pcfg = self.parallelism_config
+        if pcfg.cp_enabled:
+            from .ops.ring_attention import make_ring_attention
+            from .utils.dataclasses import ContextParallelConfig
+
+            cp_cfg = pcfg.cp_config or ContextParallelConfig()
+            return make_ring_attention(
+                self.mesh, rotate_method=cp_cfg.rotate_method
+            )
+        if pcfg.sp_enabled:
+            from .ops.ulysses import make_ulysses_attention
+
+            return make_ulysses_attention(self.mesh)
+        return None
 
     def prepare_optimizer(self, optimizer, device_placement=None) -> AcceleratedOptimizer:
         if not isinstance(optimizer, AcceleratedOptimizer):
@@ -310,6 +341,7 @@ class Accelerator:
         kwargs.setdefault("split_batches", cfg.split_batches)
         kwargs.setdefault("even_batches", cfg.even_batches)
         kwargs.setdefault("dispatch_batches", cfg.dispatch_batches)
+        kwargs.setdefault("seq_axes", self.parallelism_config.seq_dim_names)
         if cfg.data_seed is not None:
             kwargs.setdefault("seed", cfg.data_seed)
         prepared = prepare_data_loader(

@@ -61,11 +61,20 @@ def default_collate(samples: Sequence[Any]):
     return np.stack(arrs, axis=0)
 
 
-def batch_sharding(mesh: Mesh, batch_axes: Sequence[str] = ("dp_replicate", "dp_shard")) -> NamedSharding:
-    """Sharding for a batch pytree: dim 0 over the data axes, rest replicated."""
+def batch_sharding(
+    mesh: Mesh,
+    batch_axes: Sequence[str] = ("dp_replicate", "dp_shard"),
+    seq_axes: Sequence[str] = (),
+) -> NamedSharding:
+    """Sharding for a batch pytree: dim 0 over the data axes; when CP/SP is
+    active, dim 1 (sequence) over the seq axes. Rank-1 leaves only get the
+    batch axes (see ``_BaseAcceleratedLoader._place``)."""
     axes = tuple(a for a in batch_axes if a in mesh.axis_names and mesh.shape[a] > 1)
-    if not axes:
+    s_axes = tuple(a for a in seq_axes if a in mesh.axis_names and mesh.shape[a] > 1)
+    if not axes and not s_axes:
         return NamedSharding(mesh, P())
+    if s_axes:
+        return NamedSharding(mesh, P(axes if axes else None, s_axes))
     return NamedSharding(mesh, P(axes))
 
 
@@ -328,19 +337,32 @@ class _BaseAcceleratedLoader:
     def total_batch_size(self) -> Optional[int]:
         return self._total_batch_size
 
-    @property
-    def _data_axes_size(self) -> int:
-        """Number of shards the batch dim is split into on the mesh."""
+    def _spec_axes_size(self, dim: int) -> int:
+        """Number of shards the given dim is split into on the mesh."""
         if self.sharding is None:
             return 1
-        spec0 = self.sharding.spec[0] if len(self.sharding.spec) else None
-        if spec0 is None:
+        spec = self.sharding.spec
+        entry = spec[dim] if len(spec) > dim else None
+        if entry is None:
             return 1
-        axes = (spec0,) if isinstance(spec0, str) else tuple(spec0)
+        axes = (entry,) if isinstance(entry, str) else tuple(entry)
         size = 1
         for a in axes:
             size *= self.sharding.mesh.shape[a]
         return size
+
+    @property
+    def _data_axes_size(self) -> int:
+        return self._spec_axes_size(0)
+
+    def _leaf_sharding(self, t):
+        """Per-leaf sharding: rank-1 leaves drop the sequence axes."""
+        if self.sharding is None:
+            return None
+        spec = self.sharding.spec
+        if t.ndim >= len(spec):
+            return self.sharding
+        return NamedSharding(self.sharding.mesh, P(*spec[: t.ndim]))
 
     def _place(self, batch):
         """Assemble the global sharded batch array from host data.
@@ -362,10 +384,11 @@ class _BaseAcceleratedLoader:
             if t.ndim >= 1 and t.shape[0] % n_shards != 0:
                 missing = n_shards - (t.shape[0] % n_shards)
                 t = np.concatenate([t, np.repeat(t[-1:], missing, axis=0)], axis=0)
+            sharding = self._leaf_sharding(t)
             if state.num_processes > 1:
                 global_shape = (t.shape[0] * state.num_processes,) + t.shape[1:]
-                return jax.make_array_from_process_local_data(self.sharding, t, global_shape)
-            return jax.device_put(t, self.sharding)
+                return jax.make_array_from_process_local_data(sharding, t, global_shape)
+            return jax.device_put(t, sharding)
 
         from .ops.operations import recursively_apply
 
@@ -540,7 +563,9 @@ class DataLoaderDispatcher(_BaseAcceleratedLoader):
             return batch
         from .ops.operations import recursively_apply
 
-        return recursively_apply(lambda t: jax.device_put(np.asarray(t), self.sharding), batch)
+        return recursively_apply(
+            lambda t: jax.device_put(np.asarray(t), self._leaf_sharding(np.asarray(t))), batch
+        )
 
     def __iter__(self):
         if self.total_dataset_length is not None and self.total_batch_size:
@@ -616,6 +641,7 @@ def prepare_data_loader(
     device_prefetch: bool = True,
     rng_types: Optional[Sequence[str]] = None,
     batch_axes: Sequence[str] = ("dp_replicate", "dp_shard"),
+    seq_axes: Sequence[str] = (),
     put_on_device: bool = True,
 ):
     """Turn a dataset/dataloader into a mesh-sharded loader
@@ -634,7 +660,9 @@ def prepare_data_loader(
 
         if is_initialized():
             mesh = AcceleratorState().get_device_mesh()
-    sharding = batch_sharding(mesh, batch_axes) if (mesh is not None and put_on_device) else None
+    sharding = (
+        batch_sharding(mesh, batch_axes, seq_axes) if (mesh is not None and put_on_device) else None
+    )
 
     # Data sharding happens at process granularity (each process feeds its
     # local devices); single-process SPMD feeds the whole global batch.

@@ -259,6 +259,15 @@ def create_llama(config: LlamaConfig, seed: int = 0) -> Model:
         functools.partial(llama_apply, config), params, name="llama"
     )
     model.config = config
+
+    def set_attention_fn(attention_fn):
+        """Hook used by Accelerator.prepare to inject mesh-aware attention
+        (ring/Ulysses) — activations stay GLOBAL; the shard_map boundary
+        lives inside attention_fn."""
+        model.apply_fn = functools.partial(llama_apply, config, attention_fn=attention_fn)
+        model._jitted_forward = None
+
+    model.set_attention_fn = set_attention_fn
     return model
 
 

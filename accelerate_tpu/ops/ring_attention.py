@@ -1,0 +1,137 @@
+"""Ring attention: context parallelism over the ``cp`` mesh axis.
+
+TPU-native replacement for the reference's CP path, which delegates to
+``torch.distributed.tensor.experimental.context_parallel`` with
+``allgather``/``alltoall`` KV rotation (reference ``_prepare_cp``
+accelerator.py:1658-1671, ``TorchContextParallelConfig``
+utils/dataclasses.py:2208-2232; SURVEY §5 "Long-context"). Here we own the
+math: each cp rank holds a sequence shard of q/k/v; KV shards rotate around
+the ICI ring via ``ppermute`` while each rank accumulates its q-block's attention
+with online softmax (blockwise/flash combination rule from ops/attention.py).
+
+Two rotation methods, mirroring the reference's vocabulary:
+  * ``alltoall`` → true ring: n-1 ppermute hops, memory O(S/n), overlaps
+    compute with neighbor transfers (XLA pipelines the ppermute);
+  * ``allgather`` → gather all KV once, one local attention: lower latency
+    for short sequences, memory O(S).
+
+Usage: build the attention fn with :func:`make_ring_attention` and inject it
+into the model (models/llama.py ``attention_fn``); the fn takes GLOBAL
+(B, S, H, D) arrays inside jit — the shard_map boundary is internal.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .attention import (
+    NEG_INF,
+    _attend_block,
+    combine_blocks,
+    finalize_blocks,
+    repeat_kv,
+)
+
+__all__ = ["ring_attention_local", "make_ring_attention"]
+
+
+def _ring_bias(sq_local: int, skv_local: int, q_start, kv_start, causal: bool):
+    """Additive bias for one ring step; offsets are traced scalars."""
+    if not causal:
+        return None
+    q_pos = lax.broadcasted_iota(jnp.int32, (sq_local, skv_local), 0) + q_start
+    kv_pos = lax.broadcasted_iota(jnp.int32, (sq_local, skv_local), 1) + kv_start
+    return jnp.where(q_pos >= kv_pos, 0.0, NEG_INF)[None, None]
+
+
+def ring_attention_local(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = "cp",
+    causal: bool = True,
+    rotate_method: str = "alltoall",
+) -> jax.Array:
+    """Attention over sequence-sharded q/k/v — call INSIDE shard_map with
+    ``axis_name`` bound. Shapes are local shards (B, S/n, H, D)."""
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    b, sq, h, d = q.shape
+    n_rep = h // k.shape[2]
+    k = repeat_kv(k, n_rep)
+    v = repeat_kv(v, n_rep)
+    q = q * (1.0 / math.sqrt(d))
+    q_start = idx * sq
+
+    if rotate_method == "allgather":
+        k_all = lax.all_gather(k, axis_name, axis=1, tiled=True)
+        v_all = lax.all_gather(v, axis_name, axis=1, tiled=True)
+        bias = _ring_bias(sq, k_all.shape[1], q_start, 0, causal)
+        out, m, l = _attend_block(q, k_all, v_all, bias)
+        return finalize_blocks(out, m, l)
+
+    # true ring: rotate KV shards n times; shard s lives on rank
+    # (idx - step) % n at step `step`
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    out = jnp.zeros((b, sq, h, d), dtype=q.dtype)
+    m = jnp.full((b, h, sq), NEG_INF, dtype=jnp.float32)
+    l = jnp.zeros((b, h, sq), dtype=jnp.float32)
+
+    # unrolled python loop: n is static; final rotation skipped so the ring
+    # does exactly n-1 hops
+    carry = (out, m, l, k, v)
+    for step in range(n):
+        out, m, l, k_cur, v_cur = carry
+        kv_rank = (idx - step) % n
+        bias = _ring_bias(sq, sq, q_start, kv_rank * sq, causal)
+        o2, m2, l2 = _attend_block(q, k_cur, v_cur, bias)
+        out, m, l = combine_blocks(out, m, l, o2, m2, l2)
+        if step < n - 1:
+            k_cur = lax.ppermute(k_cur, axis_name, perm)
+            v_cur = lax.ppermute(v_cur, axis_name, perm)
+        carry = (out, m, l, k_cur, v_cur)
+    out, m, l, _, _ = carry
+    return finalize_blocks(out, m, l)
+
+
+def make_ring_attention(
+    mesh: Mesh,
+    *,
+    cp_axis: str = "cp",
+    batch_axes: Sequence[str] = ("dp_replicate", "dp_shard"),
+    head_axes: Sequence[str] = ("tp", "sp"),
+    rotate_method: str = "alltoall",
+):
+    """Build an attention fn over GLOBAL (B, S, H, D) arrays that runs ring
+    attention across the cp axis (composing with dp batch sharding and tp
+    head sharding). Inject into a model as its ``attention_fn``."""
+    batch = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1) or None
+    heads = tuple(a for a in head_axes if mesh.shape.get(a, 1) > 1) or None
+    spec = P(batch, cp_axis, heads, None)
+
+    def attention_fn(q, k, v, causal: bool = True):
+        body = functools.partial(
+            ring_attention_local,
+            axis_name=cp_axis,
+            causal=causal,
+            rotate_method=rotate_method,
+        )
+        fn = jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            check_vma=False,
+        )
+        return fn(q, k, v)
+
+    return attention_fn

@@ -1,0 +1,87 @@
+"""Ulysses-style sequence parallelism: all-to-all head-scatter/seq-gather.
+
+TPU-native replacement for the reference's DeepSpeed ALST integration
+(``UlyssesSPAttentionHF`` registration + SP dataloader adapter, reference
+accelerator.py:2386-2437, utils/dataclasses.py:2235-2292; SURVEY §2.4 SP row).
+
+The math: activations arrive sequence-sharded over the ``sp`` axis. Before
+attention, an all-to-all redistributes so each rank holds ALL sequence
+positions for H/n of the heads; attention runs locally (any inner impl —
+blockwise, flash); a second all-to-all restores sequence sharding. Two
+``lax.all_to_all`` per attention vs ring's n-1 ppermute hops — better for
+moderate sequence lengths on fat ICI, worse at extreme lengths (memory O(S)).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional, Sequence
+
+import jax
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .attention import blockwise_attention
+
+__all__ = ["ulysses_attention_local", "make_ulysses_attention"]
+
+
+def ulysses_attention_local(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = "sp",
+    causal: bool = True,
+    inner: Optional[Callable] = None,
+) -> jax.Array:
+    """Call INSIDE shard_map. Local shapes (B, S/n, H, D); requires H (and KV
+    heads) divisible by the sp axis size."""
+    inner = inner or functools.partial(blockwise_attention, kv_block=512)
+    n = lax.axis_size(axis_name)
+
+    def scatter_heads(x):
+        # (B, S/n, H, D) → (B, S, H/n, D)
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    def gather_seq(x):
+        # (B, S, H/n, D) → (B, S/n, H, D)
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+    if n == 1:
+        return inner(q, k, v, causal=causal)
+    q_full = scatter_heads(q)
+    k_full = scatter_heads(k)
+    v_full = scatter_heads(v)
+    out = inner(q_full, k_full, v_full, causal=causal)
+    return gather_seq(out)
+
+
+def make_ulysses_attention(
+    mesh: Mesh,
+    *,
+    sp_axis: str = "sp",
+    batch_axes: Sequence[str] = ("dp_replicate", "dp_shard"),
+    head_axes: Sequence[str] = ("tp",),
+    inner: Optional[Callable] = None,
+):
+    """Attention fn over GLOBAL (B, S, H, D) arrays running Ulysses SP over
+    the sp axis (composes with dp batch and tp head sharding)."""
+    batch = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1) or None
+    heads = tuple(a for a in head_axes if mesh.shape.get(a, 1) > 1) or None
+    spec = P(batch, sp_axis, heads, None)
+
+    def attention_fn(q, k, v, causal: bool = True):
+        body = functools.partial(
+            ulysses_attention_local, axis_name=sp_axis, causal=causal, inner=inner
+        )
+        fn = jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            check_vma=False,
+        )
+        return fn(q, k, v)
+
+    return attention_fn
