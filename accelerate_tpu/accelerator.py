@@ -265,9 +265,20 @@ class Accelerator:
         from .parallel.tp import tensor_parallel_rules
 
         pcfg = self.parallelism_config
+        layer_axis = "pp" if pcfg.pp_enabled else None
         rules = []
+        if pcfg.ep_enabled:
+            from .parallel.ep import expert_parallel_rules
+
+            rules += expert_parallel_rules(layer_axis=layer_axis)
         if pcfg.tp_enabled:
-            rules += tensor_parallel_rules()
+            rules += tensor_parallel_rules(layer_axis=layer_axis)
+        if pcfg.pp_enabled:
+            # catch-all for remaining stacked layer params (norms, plain MLP
+            # kernels without a TP rule): shard the layer dim over pp stages
+            from jax.sharding import PartitionSpec as _P
+
+            rules.append((r"^layers/", _P("pp")))
         fsdp_axes = pcfg.fsdp_dim_names
         shardings = infer_shardings(
             model.params, self.mesh, rules=rules, fsdp_axes=fsdp_axes
@@ -287,6 +298,19 @@ class Accelerator:
                 logger.warning(
                     "cp/sp parallelism configured but the model exposes no "
                     "set_attention_fn hook; attention will not be sequence-parallel"
+                )
+        if pcfg.pp_enabled:
+            from .parallel.pp import make_pipeline_layer_stack
+            from .utils.dataclasses import PipelineParallelConfig
+
+            pp_cfg = pcfg.pp_config or PipelineParallelConfig()
+            stack_fn = make_pipeline_layer_stack(self.mesh, pp_cfg.num_microbatches)
+            if hasattr(model, "set_layer_stack_fn"):
+                model.set_layer_stack_fn(stack_fn)
+            else:
+                logger.warning(
+                    "pp parallelism configured but the model exposes no "
+                    "set_layer_stack_fn hook; layers will not be pipelined"
                 )
         if model not in self._models:
             self._models.append(model)

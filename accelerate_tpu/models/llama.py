@@ -54,6 +54,13 @@ class LlamaConfig:
     attention_impl: str = "blockwise"  # "xla" | "blockwise" | "flash"
     attention_kv_block: int = 512
     scan_layers: bool = True
+    # MoE (Mixtral-style) — num_experts > 1 replaces the dense MLP with a
+    # top-k routed expert FFN (ops/moe.py); a native EP extension over the
+    # reference (SURVEY §2.4 EP row)
+    num_experts: int = 1
+    num_experts_per_tok: int = 2
+    expert_capacity_factor: float = 1.25
+    moe_aux_loss_coef: float = 0.01
 
     @property
     def head_dim(self) -> int:
@@ -94,6 +101,27 @@ def init_llama_params(config: LlamaConfig, key: jax.Array) -> dict:
         ks = jax.random.split(k, L)
         return jnp.stack([_init_dense(kk, in_dim, out_dim, dt) for kk in ks])
 
+    if config.num_experts > 1:
+        E = config.num_experts
+        scale_e = 1.0 / np.sqrt(d)
+        mlp = {
+            "router": {"kernel": stack_init(keys[5], d, E)},
+            "experts": {
+                "w_gate": (jax.random.normal(keys[6], (L, E, d, i)) * scale_e).astype(dt),
+                "w_up": (jax.random.normal(keys[7], (L, E, d, i)) * scale_e).astype(dt),
+                "w_down": (
+                    jax.random.normal(jax.random.fold_in(keys[7], 1), (L, E, i, d))
+                    * (1.0 / np.sqrt(i))
+                ).astype(dt),
+            },
+        }
+    else:
+        mlp = {
+            "gate_proj": {"kernel": stack_init(keys[5], d, i)},
+            "up_proj": {"kernel": stack_init(keys[6], d, i)},
+            "down_proj": {"kernel": stack_init(keys[7], i, d)},
+        }
+
     params = {
         "embed_tokens": {"embedding": (jax.random.normal(keys[0], (v, d)) * 0.02).astype(dt)},
         "layers": {
@@ -103,11 +131,7 @@ def init_llama_params(config: LlamaConfig, key: jax.Array) -> dict:
                 "v_proj": {"kernel": stack_init(keys[3], d, kvh * hd)},
                 "o_proj": {"kernel": stack_init(keys[4], h * hd, d)},
             },
-            "mlp": {
-                "gate_proj": {"kernel": stack_init(keys[5], d, i)},
-                "up_proj": {"kernel": stack_init(keys[6], d, i)},
-                "down_proj": {"kernel": stack_init(keys[7], i, d)},
-            },
+            "mlp": mlp,
             "input_norm": {"scale": jnp.ones((L, d), dtype=dt)},
             "post_attn_norm": {"scale": jnp.ones((L, d), dtype=dt)},
         },
@@ -190,11 +214,26 @@ def _layer(config: LlamaConfig, layer_params, x, position_offset: int, attention
 
     residual = x
     y = rms_norm(x, layer_params["post_attn_norm"]["scale"], config.rms_norm_eps)
-    gate = y @ layer_params["mlp"]["gate_proj"]["kernel"].astype(cdt)
-    up = y @ layer_params["mlp"]["up_proj"]["kernel"].astype(cdt)
-    y = jax.nn.silu(gate) * up
-    y = y @ layer_params["mlp"]["down_proj"]["kernel"].astype(cdt)
-    return residual + y
+    if config.num_experts > 1:
+        from ..ops.moe import moe_ffn
+
+        y, aux = moe_ffn(
+            y,
+            layer_params["mlp"]["router"]["kernel"],
+            layer_params["mlp"]["experts"]["w_gate"],
+            layer_params["mlp"]["experts"]["w_up"],
+            layer_params["mlp"]["experts"]["w_down"],
+            num_selected=config.num_experts_per_tok,
+            capacity_factor=config.expert_capacity_factor,
+            compute_dtype=cdt,
+        )
+    else:
+        gate = y @ layer_params["mlp"]["gate_proj"]["kernel"].astype(cdt)
+        up = y @ layer_params["mlp"]["up_proj"]["kernel"].astype(cdt)
+        y = jax.nn.silu(gate) * up
+        y = y @ layer_params["mlp"]["down_proj"]["kernel"].astype(cdt)
+        aux = jnp.float32(0.0)
+    return residual + y, aux
 
 
 def llama_apply(
@@ -203,8 +242,14 @@ def llama_apply(
     input_ids: jax.Array,
     position_offset: int = 0,
     attention_fn: Optional[Callable] = None,
-) -> jax.Array:
-    """Forward: (B, S) int tokens → (B, S, V) float32 logits."""
+    layer_stack_fn: Optional[Callable] = None,
+    return_aux: bool = False,
+):
+    """Forward: (B, S) int tokens → (B, S, V) float32 logits.
+
+    ``return_aux=True`` additionally returns {"aux_loss": scalar} (MoE
+    load-balancing loss summed over layers). ``layer_stack_fn`` overrides how
+    the stacked layers run (injected by pipeline parallelism)."""
     cdt = config.compute_dtype
     x = params["embed_tokens"]["embedding"].astype(cdt)[input_ids]
 
@@ -215,31 +260,46 @@ def llama_apply(
     if config.remat_policy != "full":
         layer_fn = jax.checkpoint(layer_fn, policy=policy)
 
-    if config.scan_layers:
+    if layer_stack_fn is not None:
+        x, aux_raw = layer_stack_fn(params["layers"], x, layer_fn)
+        aux_total = aux_raw * config.moe_aux_loss_coef
+    elif config.scan_layers:
         def scan_body(x, layer_params):
-            return layer_fn(layer_params, x), None
+            x, aux = layer_fn(layer_params, x)
+            return x, aux
 
-        x, _ = lax.scan(scan_body, x, params["layers"])
+        x, aux_per_layer = lax.scan(scan_body, x, params["layers"])
+        aux_total = jnp.sum(aux_per_layer) * config.moe_aux_loss_coef
     else:
         L = config.num_hidden_layers
+        aux_total = jnp.float32(0.0)
         for li in range(L):
             lp = jax.tree_util.tree_map(lambda p: p[li], params["layers"])
-            x = layer_fn(lp, x)
+            x, aux = layer_fn(lp, x)
+            aux_total = aux_total + aux
+        aux_total = aux_total * config.moe_aux_loss_coef
 
     x = rms_norm(x, params["final_norm"]["scale"], config.rms_norm_eps)
     if config.tie_word_embeddings:
         logits = x @ params["embed_tokens"]["embedding"].astype(cdt).T
     else:
         logits = x @ params["lm_head"]["kernel"].astype(cdt)
-    return logits.astype(jnp.float32)
+    logits = logits.astype(jnp.float32)
+    if return_aux:
+        return logits, {"aux_loss": aux_total}
+    return logits
 
 
 def llama_loss(model_view, batch):
     """Next-token cross entropy; ``batch = {"input_ids": (B,S)}`` with
     optional ``"labels"`` (defaults to shifted input_ids) and
-    ``"loss_mask"``."""
+    ``"loss_mask"``. MoE models fold the load-balancing aux loss in."""
     input_ids = batch["input_ids"]
-    logits = model_view(input_ids)
+    out = model_view(input_ids)
+    if isinstance(out, tuple):
+        logits, aux = out
+    else:
+        logits, aux = out, None
     labels = batch.get("labels")
     if labels is None:
         labels = input_ids[:, 1:]
@@ -249,25 +309,47 @@ def llama_loss(model_view, batch):
     mask = batch.get("loss_mask")
     if mask is not None:
         mask = mask[:, : nll.shape[1]]
-        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1)
-    return jnp.mean(nll)
+        loss = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1)
+    else:
+        loss = jnp.mean(nll)
+    if aux is not None:
+        loss = loss + aux["aux_loss"]
+    return loss
 
 
 def create_llama(config: LlamaConfig, seed: int = 0) -> Model:
     params = init_llama_params(config, jax.random.key(seed))
+    return_aux = config.num_experts > 1
+    overrides = {"attention_fn": None, "layer_stack_fn": None}
+
+    def _rebind():
+        model.apply_fn = functools.partial(
+            llama_apply,
+            config,
+            return_aux=return_aux,
+            **{k: v for k, v in overrides.items() if v is not None},
+        )
+        model._jitted_forward = None
+
     model = Model(
-        functools.partial(llama_apply, config), params, name="llama"
+        functools.partial(llama_apply, config, return_aux=return_aux),
+        params,
+        name="llama" if not return_aux else "llama-moe",
     )
     model.config = config
 
     def set_attention_fn(attention_fn):
-        """Hook used by Accelerator.prepare to inject mesh-aware attention
-        (ring/Ulysses) — activations stay GLOBAL; the shard_map boundary
-        lives inside attention_fn."""
-        model.apply_fn = functools.partial(llama_apply, config, attention_fn=attention_fn)
-        model._jitted_forward = None
+        """Accelerator.prepare hook: mesh-aware attention (ring/Ulysses)."""
+        overrides["attention_fn"] = attention_fn
+        _rebind()
+
+    def set_layer_stack_fn(layer_stack_fn):
+        """Accelerator.prepare hook: pipelined layer-stack execution (pp)."""
+        overrides["layer_stack_fn"] = layer_stack_fn
+        _rebind()
 
     model.set_attention_fn = set_attention_fn
+    model.set_layer_stack_fn = set_layer_stack_fn
     return model
 
 

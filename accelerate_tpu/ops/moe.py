@@ -1,0 +1,123 @@
+"""Mixture-of-Experts routing and expert-parallel FFN.
+
+The reference has NO first-class expert parallelism — only DeepSpeed MoE
+leaf-module marking and Megatron MoE config parsing (SURVEY §2.4 EP row:
+"Build EP natively ... a genuine extension beyond the reference").
+
+Design: GShard/Switch-style *dense dispatch* — top-k routing materialized as
+a (tokens, experts, capacity) one-hot dispatch tensor consumed by two
+einsums. No ragged shapes, no host control flow: the dispatch einsums lower
+to all-to-alls when the expert dim is sharded over the ``ep`` mesh axis, and
+the MXU stays busy on the expert FFN matmuls. Capacity bounds make every
+shape static (XLA requirement); overflow tokens are dropped (standard Switch
+behavior) and counted in the aux metrics.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["Routing", "route_topk", "moe_ffn", "load_balancing_loss"]
+
+
+class Routing(NamedTuple):
+    dispatch: jax.Array  # (N, E, C) 0/1 — token n → expert e at slot c
+    combine: jax.Array  # (N, E, C) float — gating weights for the way back
+    aux_loss: jax.Array  # scalar load-balancing loss
+    router_probs: jax.Array  # (N, E)
+
+
+def route_topk(
+    router_logits: jax.Array,
+    num_selected: int,
+    capacity: int,
+    *,
+    jitter_key: Optional[jax.Array] = None,
+) -> Routing:
+    """Top-k token→expert assignment with per-expert capacity.
+
+    ``router_logits``: (N, E). Position within each expert's capacity buffer
+    is assigned first-come-first-served by token order (cumsum trick).
+    """
+    n, e = router_logits.shape
+    if jitter_key is not None:
+        router_logits = router_logits + 1e-2 * jax.random.normal(jitter_key, router_logits.shape)
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)  # (N, E)
+
+    dispatch = jnp.zeros((n, e), dtype=jnp.float32)
+    gates = jnp.zeros((n, e), dtype=jnp.float32)
+    remaining = probs
+    for _ in range(num_selected):
+        choice = jnp.argmax(remaining, axis=-1)  # (N,)
+        onehot = jax.nn.one_hot(choice, e, dtype=jnp.float32)
+        dispatch = dispatch + onehot
+        gates = gates + onehot * probs
+        remaining = remaining * (1.0 - onehot)
+
+    # capacity: position of each token within its expert's queue
+    position_in_expert = (jnp.cumsum(dispatch, axis=0) - dispatch) * dispatch  # (N, E)
+    within_capacity = (position_in_expert < capacity).astype(jnp.float32) * dispatch
+    gates = gates * within_capacity
+
+    # renormalize the surviving gates per token (Mixtral convention)
+    denom = jnp.sum(gates, axis=-1, keepdims=True)
+    gates = gates / jnp.maximum(denom, 1e-9)
+
+    slot = jax.nn.one_hot(position_in_expert.astype(jnp.int32), capacity, dtype=jnp.float32)
+    dispatch_tensor = within_capacity[..., None] * slot  # (N, E, C)
+    combine_tensor = gates[..., None] * slot  # (N, E, C)
+
+    aux = load_balancing_loss(probs, dispatch)
+    return Routing(dispatch_tensor, combine_tensor, aux, probs)
+
+
+def load_balancing_loss(router_probs: jax.Array, dispatch_mask: jax.Array) -> jax.Array:
+    """Switch-Transformer aux loss: E * Σ_e fraction_tokens_e · mean_prob_e —
+    minimized by a uniform assignment."""
+    e = router_probs.shape[-1]
+    fraction = jnp.mean(dispatch_mask, axis=0)  # (E,)
+    mean_prob = jnp.mean(router_probs, axis=0)  # (E,)
+    return e * jnp.sum(fraction * mean_prob)
+
+
+def moe_ffn(
+    x: jax.Array,
+    router_kernel: jax.Array,
+    w_gate: jax.Array,
+    w_up: jax.Array,
+    w_down: jax.Array,
+    *,
+    num_selected: int = 2,
+    capacity_factor: float = 1.25,
+    compute_dtype=jnp.bfloat16,
+) -> tuple[jax.Array, jax.Array]:
+    """SwiGLU expert FFN with top-k routing.
+
+    Shapes: x (B, S, D); router (D, E); experts w_gate/w_up (E, D, I),
+    w_down (E, I, D). Shard E over the ``ep`` mesh axis (parallel/ep.py
+    rules): the dispatch/combine einsums then lower to all-to-alls over ICI.
+    Returns (output (B, S, D), aux_loss scalar).
+    """
+    b, s, d = x.shape
+    e = router_kernel.shape[1]
+    n = b * s
+    tokens = x.reshape(n, d)
+    capacity = max(1, int(capacity_factor * num_selected * n / e))
+
+    router_logits = tokens.astype(jnp.float32) @ router_kernel.astype(jnp.float32)
+    routing = route_topk(router_logits, num_selected, capacity)
+
+    # dispatch: (N,E,C) × (N,D) → (E,C,D)
+    expert_in = jnp.einsum(
+        "nec,nd->ecd", routing.dispatch.astype(compute_dtype), tokens.astype(compute_dtype)
+    )
+    gate = jnp.einsum("ecd,edi->eci", expert_in, w_gate.astype(compute_dtype))
+    up = jnp.einsum("ecd,edi->eci", expert_in, w_up.astype(compute_dtype))
+    act = jax.nn.silu(gate) * up
+    expert_out = jnp.einsum("eci,eid->ecd", act, w_down.astype(compute_dtype))
+    # combine: (N,E,C) × (E,C,D) → (N,D)
+    out = jnp.einsum("nec,ecd->nd", routing.combine.astype(compute_dtype), expert_out)
+    return out.reshape(b, s, d), routing.aux_loss

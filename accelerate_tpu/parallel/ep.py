@@ -1,0 +1,27 @@
+"""Expert-parallel sharding rules.
+
+Experts weights are stacked (L, E, in, out) in our MoE models: shard the
+expert dim over the ``ep`` mesh axis; the token dispatch einsums (ops/moe.py)
+then lower to all-to-alls across ep. Router weights stay replicated. Composes
+with TP (intermediate dim) and FSDP (hidden dims) via the rule-composition
+path in parallel/sharding.py. ``layer_axis`` carries ``pp`` when pipelined.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["expert_parallel_rules"]
+
+
+def expert_parallel_rules(
+    ep_axis: str = "ep", tp_axis: str = "tp", layer_axis: Optional[str] = None
+) -> list[tuple[str, P]]:
+    L = layer_axis
+    return [
+        (r"experts/(w_gate|w_up)$", P(L, ep_axis, None, tp_axis)),
+        (r"experts/w_down$", P(L, ep_axis, tp_axis, None)),
+        (r"router/kernel$", P(L)),
+    ]

@@ -1,0 +1,102 @@
+"""Pipeline parallelism: GPipe schedule over the ``pp`` mesh axis.
+
+The reference supports pipeline TRAINING only by delegating to Megatron-LM
+(SURVEY §2.4 PP row; its own ``inference.py`` PiPPy path is inference-only).
+This is a native training pipeline:
+
+* the stacked layer dim (L, ...) is sharded over ``pp`` — each stage holds
+  L/n contiguous layers (rule added by Accelerator.prepare_model);
+* inside a ``shard_map`` that is manual ONLY over ``pp`` (``axis_names=
+  {'pp'}``), microbatches flow stage→stage via ``ppermute`` in a GPipe
+  fill/drain loop; dp/fsdp/tp axes stay automatic, so FSDP all-gathers and TP
+  collectives still come from GSPMD *inside* each stage;
+* reverse-mode autodiff through ``ppermute`` is exact (its transpose is the
+  reverse permute), so ``jax.grad`` of the pipelined forward yields a correct
+  pipelined backward — schedule 1F1B-style optimization is a later round's
+  perf work.
+
+Embedding / final norm / lm_head run outside the pipelined region,
+replicated across pp.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = ["make_pipeline_layer_stack"]
+
+
+def make_pipeline_layer_stack(
+    mesh: Mesh,
+    num_microbatches: int,
+    pp_axis: str = "pp",
+) -> Callable:
+    """Build a ``layer_stack_fn(layers_params, x, layer_fn) -> (x, aux)``
+    running the stacked layers as a GPipe pipeline over ``pp``."""
+    n_stages = mesh.shape[pp_axis]
+
+    def layer_stack_fn(layers_params, x, layer_fn):
+        b = x.shape[0]
+        m = num_microbatches
+        if b % m != 0:
+            raise ValueError(f"batch {b} not divisible by num_microbatches {m}")
+        mb = b // m
+        x_mb = x.reshape(m, mb, *x.shape[1:])
+
+        def stage_body(layers_local, x_all):
+            idx = lax.axis_index(pp_axis)
+
+            def run_stage(h):
+                def body(h, lp):
+                    h, aux = layer_fn(lp, h)
+                    return h, aux
+
+                h, auxs = lax.scan(body, h, layers_local)
+                return h, jnp.sum(auxs)
+
+            total = m + n_stages - 1
+            out_buf = jnp.zeros_like(x_all)
+            aux_acc = jnp.float32(0.0)
+            recv = jnp.zeros_like(x_all[0])
+            perm = [(i, i + 1) for i in range(n_stages - 1)]
+            for t in range(total):
+                # stage 0 feeds microbatch t; later stages consume the wire
+                feed = x_all[min(t, m - 1)]
+                inp = jnp.where(idx == 0, feed, recv)
+                out, aux = run_stage(inp)
+                # stage `idx` processes microbatch t-idx at tick t
+                valid = jnp.logical_and(t - idx >= 0, t - idx < m)
+                aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
+                if n_stages > 1:
+                    recv = lax.ppermute(out, pp_axis, perm)
+                k = t - (n_stages - 1)
+                if 0 <= k < m:
+                    out_buf = out_buf.at[k].set(
+                        jnp.where(idx == n_stages - 1, out, out_buf[k])
+                    )
+            # results live on the last stage; broadcast across pp so the
+            # (replicated-over-pp) head can consume them
+            out_buf = lax.psum(
+                jnp.where(idx == n_stages - 1, out_buf, jnp.zeros_like(out_buf)), pp_axis
+            )
+            aux_total = lax.psum(aux_acc, pp_axis)
+            return out_buf, aux_total
+
+        fn = jax.shard_map(
+            stage_body,
+            mesh=mesh,
+            in_specs=(P(pp_axis), P()),
+            out_specs=(P(), P()),
+            axis_names={pp_axis},
+            check_vma=False,
+        )
+        out, aux = fn(layers_params, x_mb)
+        return out.reshape(b, *x.shape[1:]), aux
+
+    return layer_stack_fn

@@ -7,41 +7,33 @@ TP is just PartitionSpec rules over the ``tp`` mesh axis: column-parallel
 weights shard their output dim, row-parallel their input dim; XLA inserts the
 (two per block) all-reduces that Megatron does by hand.
 
-Rules match common parameter naming across our models/, flax, and
-transformers-flax checkpoints.
+Our models stack per-layer kernels as (L, in, out) for scan-over-layers, so
+the layer dim occupies position 0 — it stays unsharded (or carries the ``pp``
+axis under pipeline parallelism via ``layer_axis``).
 """
 
 from __future__ import annotations
 
+from typing import Optional
+
 from jax.sharding import PartitionSpec as P
 
-__all__ = ["tensor_parallel_rules", "COLUMN_PARALLEL_PATTERNS", "ROW_PARALLEL_PATTERNS"]
-
-# Output-dim (column) parallel: QKV projections, MLP up/gate, embedding vocab
-COLUMN_PARALLEL_PATTERNS = [
-    r"(q_proj|k_proj|v_proj|qkv|query|key|value)/kernel",
-    r"(up_proj|gate_proj|wi|fc1|w1|w3|intermediate/dense)/kernel",
-    r"(embed_tokens|wte|word_embeddings|embedding)/(embedding|weight)",
-    r"lm_head/kernel",
-]
-
-# Input-dim (row) parallel: attention output proj, MLP down
-ROW_PARALLEL_PATTERNS = [
-    r"(o_proj|out_proj|dense_out|wo|fc2|w2|down_proj|attention/dense|output/dense)/kernel",
-]
+__all__ = ["tensor_parallel_rules"]
 
 
-def tensor_parallel_rules(tp_axis: str = "tp") -> list[tuple[str, P]]:
-    """(regex, spec) rules for 2-D kernels stored (in_features, out_features)
-    — the flax convention. Column-parallel shards dim 1 (output), row-parallel
-    shards dim 0 (input). Embedding tables (vocab, hidden) shard the vocab dim.
-    """
-    rules: list[tuple[str, P]] = []
-    for pat in COLUMN_PARALLEL_PATTERNS:
-        if "embed" in pat or "wte" in pat:
-            rules.append((pat, P(tp_axis, None)))
-        else:
-            rules.append((pat, P(None, tp_axis)))
-    for pat in ROW_PARALLEL_PATTERNS:
-        rules.append((pat, P(tp_axis, None)))
-    return rules
+def tensor_parallel_rules(
+    tp_axis: str = "tp", layer_axis: Optional[str] = None
+) -> list[tuple[str, P]]:
+    """(regex, spec) rules. ``layer_axis``: entry for the stacked layer dim
+    (None → replicated; "pp" → pipeline stages)."""
+    L = layer_axis  # None is a valid PartitionSpec entry (replicated dim)
+    return [
+        # column parallel (shard output dim): attention q/k/v, MLP gate/up
+        (r"(q_proj|k_proj|v_proj|qkv|query|key|value)/kernel", P(L, None, tp_axis)),
+        (r"(gate_proj|up_proj|wi|fc1|w1|w3)/kernel", P(L, None, tp_axis)),
+        # row parallel (shard input dim): attention out, MLP down
+        (r"(o_proj|out_proj|wo|fc2|w2|down_proj)/kernel", P(L, tp_axis, None)),
+        # unstacked head/embedding tables
+        (r"(embed_tokens|wte|word_embeddings)/(embedding|weight)", P(tp_axis, None)),
+        (r"lm_head/kernel", P(None, tp_axis)),
+    ]

@@ -60,6 +60,7 @@ class ParallelismConfig:
     # strategy sub-configs (handlers in the reference's terms)
     cp_config: Optional[object] = None  # ContextParallelConfig
     tp_config: Optional[object] = None  # TensorParallelConfig
+    pp_config: Optional[object] = None  # PipelineParallelConfig
     # Allow cp and sp together. The reference forbids it
     # (parallelism_config.py:328-334) because its two backends (torch CP vs
     # DeepSpeed Ulysses) cannot compose; ours compose on one mesh, but we keep

@@ -239,6 +239,19 @@ class TensorParallelConfig(KwargsHandler):
 
 
 @dataclass
+class PipelineParallelConfig(KwargsHandler):
+    """Training pipeline parallelism (native; the reference only pipelines
+    inference via PiPPy — SURVEY §2.4 PP row)."""
+
+    num_microbatches: int = 4
+    schedule: str = "gpipe"  # 1F1B is a later round's perf work
+
+    def __post_init__(self):
+        if self.schedule not in ("gpipe",):
+            raise ValueError(f"Unknown pipeline schedule {self.schedule}")
+
+
+@dataclass
 class SequenceParallelConfig(KwargsHandler):
     """Ulysses-style SP (reference DeepSpeedSequenceParallelConfig,
     utils/dataclasses.py:2235-2292)."""
