@@ -1,0 +1,286 @@
+"""Flash attention as Pallas TPU kernels (forward + custom-VJP backward).
+
+The hot-op ownership the reference never needs (it rides torch SDPA): tiled
+online-softmax attention that never materializes the (S, S) score matrix in
+HBM. Layout (B, S, H, D) → kernels run per (batch·head) on (block_q, D) ×
+(block_k, D) tiles living in VMEM, with the MXU doing qk^T and pv.
+
+Backward uses the standard recompute formulation (Dao et al.): the forward
+saves only out and the per-row logsumexp L; dq and dk/dv kernels recompute
+p = exp(qk - L) per tile. Set ``interpret=True`` (or run under
+``pltpu.force_tpu_interpret_mode``) to validate on CPU.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+from .attention import NEG_INF, repeat_kv
+
+__all__ = ["flash_attention"]
+
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
+
+
+def _pick_block(s: int, preferred: int) -> int:
+    b = min(preferred, s)
+    while s % b != 0:
+        b //= 2
+    return max(b, 1)
+
+
+# ---------------------------------------------------------------- forward
+def _fwd_kernel(q_ref, k_ref, v_ref, out_ref, lse_ref, acc_ref, m_ref, l_ref, *, causal, block_q, block_k, scale):
+    i = pl.program_id(1)  # q block
+    j = pl.program_id(2)  # kv block
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    q = q_ref[0].astype(jnp.float32) * scale  # (bq, d)
+    k = k_ref[0].astype(jnp.float32)  # (bk, d)
+    v = v_ref[0].astype(jnp.float32)
+
+    s = q @ k.T  # (bq, bk) on the MXU
+    if causal:
+        q_pos = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + i * block_q
+        k_pos = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + j * block_k
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+
+    m_prev = m_ref[:, 0]
+    l_prev = l_ref[:, 0]
+    m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+    alpha = jnp.exp(m_prev - m_cur)
+    p = jnp.exp(s - m_cur[:, None])
+    l_cur = alpha * l_prev + jnp.sum(p, axis=-1)
+    acc_ref[:] = acc_ref[:] * alpha[:, None] + p @ v
+    m_ref[:, 0] = m_cur
+    l_ref[:, 0] = l_cur
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        l = l_ref[:, 0]
+        out_ref[0] = (acc_ref[:] / jnp.maximum(l, 1e-30)[:, None]).astype(out_ref.dtype)
+        lse_ref[0] = m_ref[:, 0] + jnp.log(jnp.maximum(l, 1e-30))
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, s, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    nq = s // block_q
+    nk = s // block_k
+    grid = (bh, nq, nk)
+    out, lse = pl.pallas_call(
+        functools.partial(
+            _fwd_kernel, causal=causal, block_q=block_q, block_k=block_k, scale=scale
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, s), jnp.float32),
+        ],
+        scratch_shapes=[
+            # acc, m, l accumulators live in VMEM across the kv grid dim
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------- backward
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc, *, causal, block_q, block_k, scale):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    q = q_ref[0].astype(jnp.float32) * scale
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]
+    delta = delta_ref[0]
+
+    s = q @ k.T
+    if causal:
+        q_pos = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + i * block_q
+        k_pos = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + j * block_k
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    p = jnp.exp(s - lse[:, None])
+    dp = do @ v.T
+    ds = p * (dp - delta[:, None])
+    dq_acc[:] = dq_acc[:] + (ds @ k) * scale
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc, *, causal, block_q, block_k, scale):
+    j = pl.program_id(1)  # kv block
+    i = pl.program_id(2)  # q block
+    nq = pl.num_programs(2)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    q = q_ref[0].astype(jnp.float32) * scale
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]
+    delta = delta_ref[0]
+
+    s = q @ k.T  # (bq, bk)
+    if causal:
+        q_pos = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + i * block_q
+        k_pos = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + j * block_k
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    p = jnp.exp(s - lse[:, None])
+    dv_acc[:] = dv_acc[:] + p.T @ do
+    dp = do @ v.T
+    ds = p * (dp - delta[:, None])
+    dk_acc[:] = dk_acc[:] + (ds.T @ q)  # q already scaled
+
+    @pl.when(i == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, out, lse, do, causal, block_q, block_k, interpret):
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, s, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32), axis=-1)  # (bh, s)
+    nq = s // block_q
+    nk = s // block_k
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, causal=causal, block_q=block_q, block_k=block_k, scale=scale
+        ),
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, causal=causal, block_q=block_q, block_k=block_k, scale=scale
+        ),
+        grid=(bh, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, s, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------- public op
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_core(q, k, v, causal, block_q, block_k, interpret):
+    out, _ = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+    return out
+
+
+def _flash_core_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out, lse = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_core_bwd(causal, block_q, block_k, interpret, residuals, do):
+    q, k, v, out, lse = residuals
+    dq, dk, dv = _flash_bwd(q, k, v, out, lse, do, causal, block_q, block_k, interpret)
+    return dq, dk, dv
+
+
+_flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """(B, S, H, D) flash attention with GQA support."""
+    b, s, h, d = q.shape
+    n_rep = h // k.shape[2]
+    k = repeat_kv(k, n_rep)
+    v = repeat_kv(v, n_rep)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    block_q = _pick_block(s, block_q)
+    block_k = _pick_block(s, block_k)
+
+    # (B, S, H, D) → (B·H, S, D)
+    def merge(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+    out = _flash_core(merge(q), merge(k), merge(v), causal, block_q, block_k, interpret)
+    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
