@@ -1,0 +1,29 @@
+# Test shards mirroring the reference's Makefile:18-56.
+# PALLAS_AXON_POOL_IPS is unset so CPU runs never touch the TPU relay.
+PY := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu python
+
+.PHONY: test test_core test_data test_parallel test_models test_cli test_big_modeling quality
+
+test:
+	$(PY) -m pytest tests/ -q
+
+test_core:
+	$(PY) -m pytest tests/test_state.py tests/test_operations.py tests/test_parallelism_config.py tests/test_accelerator.py tests/test_checkpointing.py tests/test_tracking.py -q
+
+test_data:
+	$(PY) -m pytest tests/test_data_loader.py -q
+
+test_parallel:
+	$(PY) -m pytest tests/test_context_parallel.py tests/test_pipeline.py tests/test_moe.py -q
+
+test_models:
+	$(PY) -m pytest tests/test_llama.py tests/test_bert.py tests/test_attention.py tests/test_flash_attention.py -q
+
+test_cli:
+	$(PY) -m pytest tests/test_cli.py -q
+
+test_big_modeling:
+	$(PY) -m pytest tests/test_big_modeling.py -q
+
+bench:
+	python bench.py

@@ -26,18 +26,36 @@ __all__ = [
 ]
 
 
+_LAZY = {
+    "Accelerator": ("accelerator", "Accelerator"),
+    "Model": ("model", "Model"),
+    "wrap_flax_model": ("model", "wrap_flax_model"),
+    "unwrap_model": ("model", "unwrap_model"),
+    "AcceleratedOptimizer": ("optimizer", "AcceleratedOptimizer"),
+    "AcceleratedScheduler": ("scheduler", "AcceleratedScheduler"),
+    "prepare_data_loader": ("data_loader", "prepare_data_loader"),
+    "skip_first_batches": ("data_loader", "skip_first_batches"),
+    "notebook_launcher": ("launchers", "notebook_launcher"),
+    "debug_launcher": ("launchers", "debug_launcher"),
+    "init_empty_weights": ("big_modeling", "init_empty_weights"),
+    "load_checkpoint_and_dispatch": ("big_modeling", "load_checkpoint_and_dispatch"),
+    "load_checkpoint_in_model": ("big_modeling", "load_checkpoint_in_model"),
+    "dispatch_model": ("big_modeling", "dispatch_model"),
+    "cpu_offload": ("big_modeling", "cpu_offload"),
+    "generate": ("inference", "generate"),
+    "prepare_inference": ("inference", "prepare_inference"),
+    "LocalSGD": ("local_sgd", "LocalSGD"),
+    "GeneralTracker": ("tracking", "GeneralTracker"),
+    "find_executable_batch_size": ("utils.memory", "find_executable_batch_size"),
+}
+
+
 def __getattr__(name):
-    # Lazy import of the heavy facade so `import accelerate_tpu` stays cheap.
-    if name == "Accelerator":
-        from .accelerator import Accelerator
+    # Lazy imports so `import accelerate_tpu` stays cheap.
+    if name in _LAZY:
+        import importlib
 
-        return Accelerator
-    if name == "notebook_launcher":
-        from .launchers import notebook_launcher
-
-        return notebook_launcher
-    if name == "debug_launcher":
-        from .launchers import debug_launcher
-
-        return debug_launcher
+        module_name, attr = _LAZY[name]
+        module = importlib.import_module(f".{module_name}", __name__)
+        return getattr(module, attr)
     raise AttributeError(f"module 'accelerate_tpu' has no attribute {name!r}")

@@ -1,0 +1,41 @@
+"""``accelerate-tpu`` CLI entry point.
+
+TPU-native analogue of the reference's ``commands/accelerate_cli.py:28``:
+subcommands launch / config / env / test / estimate-memory / merge-weights
+(the reference's ``to-fsdp2`` and ``tpu-config`` have no TPU-native meaning:
+strategy conversion is a no-op under one GSPMD path, and pod fan-out lives in
+``launch --pod``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        "accelerate-tpu", description="TPU-native training harness CLI"
+    )
+    subparsers = parser.add_subparsers(dest="command", required=True)
+
+    from . import config as config_cmd
+    from . import env as env_cmd
+    from . import estimate as estimate_cmd
+    from . import launch as launch_cmd
+    from . import merge as merge_cmd
+    from . import test as test_cmd
+
+    launch_cmd.add_parser(subparsers)
+    config_cmd.add_parser(subparsers)
+    env_cmd.add_parser(subparsers)
+    test_cmd.add_parser(subparsers)
+    estimate_cmd.add_parser(subparsers)
+    merge_cmd.add_parser(subparsers)
+
+    args, extra = parser.parse_known_args(argv)
+    return args.func(args, extra) or 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

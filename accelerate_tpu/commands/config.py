@@ -1,0 +1,103 @@
+"""``accelerate-tpu config`` — write/read the default config file.
+
+Analogue of the reference's interactive questionnaire + ClusterConfig yaml
+(commands/config/cluster.py:59, config_args.py:252). Ours asks the handful of
+questions that matter on one GSPMD path and stores yaml at
+``~/.cache/accelerate_tpu/default_config.yaml``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+DEFAULT_CONFIG_DIR = os.path.expanduser(
+    os.environ.get("ACCELERATE_TPU_CONFIG_DIR", "~/.cache/accelerate_tpu")
+)
+DEFAULT_CONFIG_FILE = os.path.join(DEFAULT_CONFIG_DIR, "default_config.yaml")
+
+
+@dataclass
+class ClusterConfig:
+    """Launch-relevant settings (reference ClusterConfig, config_args.py:252)."""
+
+    mixed_precision: str = "no"
+    num_processes: int = 1
+    coordinator_address: Optional[str] = None
+    dp_replicate_size: int = 1
+    dp_shard_size: int = -1
+    pp_size: int = 1
+    cp_size: int = 1
+    sp_size: int = 1
+    tp_size: int = 1
+    ep_size: int = 1
+    gradient_accumulation_steps: int = 1
+    debug: bool = False
+
+    def to_env(self) -> dict[str, str]:
+        env = {
+            "ACCELERATE_MIXED_PRECISION": self.mixed_precision,
+            "ACCELERATE_GRADIENT_ACCUMULATION_STEPS": str(self.gradient_accumulation_steps),
+        }
+        for axis in ("dp_replicate", "dp_shard", "pp", "cp", "sp", "tp", "ep"):
+            size = getattr(self, f"{axis}_size")
+            if size != 1:
+                env[f"PARALLELISM_CONFIG_{axis.upper()}_SIZE"] = str(size)
+        if self.debug:
+            env["ACCELERATE_DEBUG_MODE"] = "1"
+        if self.num_processes > 1:
+            env["ACCELERATE_NUM_PROCESSES"] = str(self.num_processes)
+            if self.coordinator_address:
+                env["ACCELERATE_COORDINATOR_ADDRESS"] = self.coordinator_address
+        return env
+
+    def save(self, path: str = DEFAULT_CONFIG_FILE) -> str:
+        import yaml
+
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            yaml.safe_dump(dataclasses.asdict(self), f)
+        return path
+
+    @classmethod
+    def load(cls, path: str = DEFAULT_CONFIG_FILE) -> "ClusterConfig":
+        import yaml
+
+        with open(path) as f:
+            data = yaml.safe_load(f) or {}
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in data.items() if k in known})
+
+
+def _ask(prompt: str, default, cast=str):
+    raw = input(f"{prompt} [{default}]: ").strip()
+    return cast(raw) if raw else default
+
+
+def config_command(args, extra) -> int:
+    if args.default:
+        cfg = ClusterConfig()
+    else:
+        print("accelerate-tpu configuration (enter to accept defaults)")
+        cfg = ClusterConfig(
+            mixed_precision=_ask("mixed precision (no/bf16/fp16/fp8)", "bf16"),
+            num_processes=_ask("number of host processes", 1, int),
+            dp_shard_size=_ask("FSDP shard size (-1 = all remaining devices)", -1, int),
+            tp_size=_ask("tensor parallel size", 1, int),
+            cp_size=_ask("context parallel size", 1, int),
+            gradient_accumulation_steps=_ask("gradient accumulation steps", 1, int),
+        )
+        if cfg.num_processes > 1:
+            cfg.coordinator_address = _ask("coordinator address (host:port)", "localhost:12345")
+    path = cfg.save(args.config_file or DEFAULT_CONFIG_FILE)
+    print(f"Configuration saved to {path}")
+    return 0
+
+
+def add_parser(subparsers) -> None:
+    p = subparsers.add_parser("config", help="create the default launch config")
+    p.add_argument("--config_file", default=None)
+    p.add_argument("--default", action="store_true", help="write defaults without prompting")
+    p.set_defaults(func=config_command)

@@ -1,0 +1,47 @@
+"""``accelerate-tpu env`` — report platform/config (reference commands/env.py)."""
+
+from __future__ import annotations
+
+import json
+import os
+import platform
+
+
+def env_command(args, extra) -> int:
+    import jax
+
+    import accelerate_tpu
+
+    info = {
+        "accelerate_tpu version": accelerate_tpu.__version__,
+        "platform": platform.platform(),
+        "python": platform.python_version(),
+        "jax": jax.__version__,
+        "backend": jax.default_backend(),
+        "devices": [str(d) for d in jax.devices()],
+        "process_count": jax.process_count(),
+    }
+    try:
+        import flax
+
+        info["flax"] = flax.__version__
+    except ImportError:
+        pass
+    try:
+        import optax
+
+        info["optax"] = optax.__version__
+    except ImportError:
+        pass
+    from .config import DEFAULT_CONFIG_FILE
+
+    if os.path.exists(DEFAULT_CONFIG_FILE):
+        with open(DEFAULT_CONFIG_FILE) as f:
+            info["default_config"] = f.read()
+    print(json.dumps(info, indent=2))
+    return 0
+
+
+def add_parser(subparsers) -> None:
+    p = subparsers.add_parser("env", help="print environment info")
+    p.set_defaults(func=env_command)

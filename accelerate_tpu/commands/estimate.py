@@ -1,0 +1,96 @@
+"""``accelerate-tpu estimate-memory`` — dtype-wise model memory table.
+
+Analogue of the reference's ``commands/estimate.py:224-310`` (hub model →
+size table incl. Adam training estimate). Works on our model presets or any
+transformers config id available locally; zero-egress safe (falls back to the
+preset table when the hub is unreachable).
+"""
+
+from __future__ import annotations
+
+import json
+
+
+def _params_from_preset(name: str) -> float:
+    from ..models.bert import BertConfig, init_bert_params
+    from ..models.llama import LlamaConfig, init_llama_params
+    import jax
+    import numpy as np
+
+    presets = {
+        "llama2-7b": lambda: LlamaConfig.llama2_7b(),
+        "llama-tiny": lambda: LlamaConfig.tiny(),
+        "bert-base": lambda: BertConfig.base(),
+        "bert-tiny": lambda: BertConfig.tiny(),
+    }
+    if name in presets:
+        cfg = presets[name]()
+        if isinstance(cfg, LlamaConfig):
+            abstract = jax.eval_shape(lambda: init_llama_params(cfg, jax.random.key(0)))
+        else:
+            abstract = jax.eval_shape(lambda: init_bert_params(cfg, jax.random.key(0)))
+        return float(
+            sum(np.prod(l.shape) for l in jax.tree_util.tree_leaves(abstract))
+        )
+    # try a local transformers config
+    try:
+        from transformers import AutoConfig
+
+        config = AutoConfig.from_pretrained(name)
+        d = getattr(config, "hidden_size", 0)
+        L = getattr(config, "num_hidden_layers", 0)
+        i = getattr(config, "intermediate_size", 4 * d)
+        v = getattr(config, "vocab_size", 32000)
+        return float(L * (4 * d * d + 3 * d * i) + 2 * v * d)
+    except Exception as e:  # noqa: BLE001
+        raise SystemExit(
+            f"Unknown model {name!r}; use a preset (llama2-7b, bert-base, ...) or a "
+            f"locally cached transformers id ({e})"
+        )
+
+
+def _human(n: float) -> str:
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(n) < 1024:
+            return f"{n:.2f} {unit}"
+        n /= 1024
+    return f"{n:.2f} PB"
+
+
+def estimate_command(args, extra) -> int:
+    from ..utils.modeling import estimate_training_memory
+
+    num_params = _params_from_preset(args.model_name)
+    rows = []
+    for dtype in args.dtypes:
+        inference = num_params * {"float32": 4, "bfloat16": 2, "float16": 2, "int8": 1, "int4": 0.5}[dtype]
+        training = estimate_training_memory(num_params, dtype=dtype)["total"]
+        rows.append((dtype, inference, training))
+    if args.json:
+        print(json.dumps(
+            {
+                "model": args.model_name,
+                "num_params": num_params,
+                "rows": [
+                    {"dtype": d, "inference_bytes": i, "adam_training_bytes": t}
+                    for d, i, t in rows
+                ],
+            }
+        ))
+        return 0
+    print(f"Model: {args.model_name}  ({num_params/1e9:.2f} B params)")
+    print(f"{'dtype':10s} {'inference':>12s} {'Adam training':>15s}")
+    for d, i, t in rows:
+        print(f"{d:10s} {_human(i):>12s} {_human(t):>15s}")
+    return 0
+
+
+def add_parser(subparsers) -> None:
+    p = subparsers.add_parser("estimate-memory", help="estimate model memory usage")
+    p.add_argument("model_name", help="preset (llama2-7b, bert-base) or transformers id")
+    p.add_argument(
+        "--dtypes", nargs="+", default=["float32", "bfloat16", "int8", "int4"],
+        choices=["float32", "bfloat16", "float16", "int8", "int4"],
+    )
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(func=estimate_command)

@@ -1,0 +1,98 @@
+"""``accelerate-tpu launch`` — run a training script with the right env.
+
+TPU-native analogue of the reference's launcher (commands/launch.py:986-1193).
+The reference fans out one process per GPU (torchrun/deepspeed/xmp.spawn);
+JAX runs ONE process per host addressing all local devices, so:
+
+* single host → set env, exec the script (reference ``simple_launcher``);
+* multi-host (``--num_processes N --coordinator_address host:port
+  --process_id i``) → same, plus jax.distributed bootstrap env consumed by
+  PartialState (state.py);
+* TPU pod (``--pod``) → fan the SAME command out to every worker over
+  ``gcloud compute tpus tpu-vm ssh --worker=all`` (the reference's
+  ``tpu_pod_launcher``/``tpu-config``, commands/launch.py:1117 + tpu.py).
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import subprocess
+import sys
+
+from .config import DEFAULT_CONFIG_FILE, ClusterConfig
+
+
+def launch_command(args, script_args) -> int:
+    cfg = None
+    config_file = args.config_file or DEFAULT_CONFIG_FILE
+    if os.path.exists(config_file):
+        cfg = ClusterConfig.load(config_file)
+    else:
+        cfg = ClusterConfig()
+
+    # CLI flags override the config file (reference _validate_launch_command)
+    for name in (
+        "mixed_precision",
+        "num_processes",
+        "coordinator_address",
+        "gradient_accumulation_steps",
+    ):
+        val = getattr(args, name, None)
+        if val is not None:
+            setattr(cfg, name, val)
+    for axis in ("dp_replicate", "dp_shard", "pp", "cp", "sp", "tp", "ep"):
+        val = getattr(args, f"{axis}_size", None)
+        if val is not None:
+            setattr(cfg, f"{axis}_size", val)
+    if args.debug:
+        cfg.debug = True
+
+    env = dict(os.environ)
+    env.update(cfg.to_env())
+    if args.process_id is not None:
+        env["ACCELERATE_PROCESS_ID"] = str(args.process_id)
+
+    if not args.training_script:
+        print("error: no training script given", file=sys.stderr)
+        return 2
+    cmd = [sys.executable, args.training_script, *script_args]
+
+    if args.pod:
+        inner = " ".join(
+            [f"{k}={shlex.quote(v)}" for k, v in cfg.to_env().items()]
+            + ["python", shlex.quote(args.training_script)]
+            + [shlex.quote(a) for a in script_args]
+        )
+        pod_cmd = [
+            "gcloud", "compute", "tpus", "tpu-vm", "ssh", args.pod,
+            "--worker=all", f"--command={inner}",
+        ]
+        if args.dry_run:
+            print(" ".join(shlex.quote(c) for c in pod_cmd))
+            return 0
+        return subprocess.call(pod_cmd)
+
+    if args.dry_run:
+        print(" ".join(shlex.quote(c) for c in cmd))
+        for k, v in sorted(cfg.to_env().items()):
+            print(f"  {k}={v}")
+        return 0
+    return subprocess.call(cmd, env=env)
+
+
+def add_parser(subparsers) -> None:
+    p = subparsers.add_parser("launch", help="launch a training script")
+    p.add_argument("--config_file", default=None)
+    p.add_argument("--mixed_precision", default=None, choices=["no", "bf16", "fp16", "fp8"])
+    p.add_argument("--num_processes", type=int, default=None, help="number of host processes")
+    p.add_argument("--coordinator_address", default=None, help="host:port of process 0")
+    p.add_argument("--process_id", type=int, default=None, help="this host's process index")
+    p.add_argument("--gradient_accumulation_steps", type=int, default=None)
+    for axis in ("dp_replicate", "dp_shard", "pp", "cp", "sp", "tp", "ep"):
+        p.add_argument(f"--{axis}_size", type=int, default=None)
+    p.add_argument("--pod", default=None, help="TPU pod name: fan out over gcloud ssh --worker=all")
+    p.add_argument("--debug", action="store_true", help="enable collective shape verification")
+    p.add_argument("--dry_run", action="store_true", help="print the command and env, don't run")
+    p.add_argument("training_script", nargs="?")
+    p.set_defaults(func=launch_command)

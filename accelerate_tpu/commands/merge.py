@@ -1,0 +1,34 @@
+"""``accelerate-tpu merge-weights`` — consolidate a sharded training
+checkpoint into interchange safetensors (reference commands/merge.py →
+merge_fsdp_weights, utils/fsdp_utils.py:462)."""
+
+from __future__ import annotations
+
+import os
+
+
+def merge_command(args, extra) -> int:
+    import numpy as np
+    import jax
+
+    from ..checkpointing import load_pytree
+    from ..utils.serialization import save_sharded_safetensors
+
+    model_dir = args.checkpoint_dir
+    if os.path.isdir(os.path.join(model_dir, "model")):
+        model_dir = os.path.join(model_dir, "model")
+    tree = load_pytree(model_dir)
+    host = jax.tree_util.tree_map(lambda t: np.asarray(t), tree)
+    written = save_sharded_safetensors(host, args.output_dir, max_shard_size=args.max_shard_size)
+    print(f"Merged {len(written)} file(s) into {args.output_dir}")
+    return 0
+
+
+def add_parser(subparsers) -> None:
+    p = subparsers.add_parser(
+        "merge-weights", help="consolidate a sharded checkpoint into safetensors"
+    )
+    p.add_argument("checkpoint_dir")
+    p.add_argument("output_dir")
+    p.add_argument("--max_shard_size", default="10GB")
+    p.set_defaults(func=merge_command)

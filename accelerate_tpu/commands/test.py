@@ -1,0 +1,24 @@
+"""``accelerate-tpu test`` — run the bundled sanity script under launch
+(reference commands/test.py:22-58)."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+
+def test_command(args, extra) -> int:
+    script = os.path.join(
+        os.path.dirname(os.path.dirname(__file__)), "test_utils", "scripts", "test_script.py"
+    )
+    print(f"Running {script}")
+    result = subprocess.call([sys.executable, script])
+    if result == 0:
+        print("Test is a success! You are ready for your distributed training!")
+    return result
+
+
+def add_parser(subparsers) -> None:
+    p = subparsers.add_parser("test", help="run the bundled end-to-end sanity check")
+    p.set_defaults(func=test_command)

@@ -1,1 +1,2 @@
 from .llama import LlamaConfig, create_llama, llama_apply, llama_loss, init_llama_params
+from .bert import BertConfig, create_bert, bert_apply, bert_classification_loss, init_bert_params

@@ -1,0 +1,202 @@
+"""BERT-style encoder for sequence classification.
+
+The reference's canonical example workload (``examples/nlp_example.py``:
+BERT-base on GLUE/MRPC — one of BASELINE.json's driver configs). TPU-first
+like models/llama.py: stacked params + scan over layers, bf16 compute, fp32
+logits; post-LN architecture with learned position embeddings.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..model import Model
+from ..ops.attention import dot_product_attention
+
+__all__ = ["BertConfig", "init_bert_params", "bert_apply", "create_bert", "bert_classification_loss"]
+
+
+@dataclasses.dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    num_labels: int = 2
+    param_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.float32
+    scan_layers: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @classmethod
+    def base(cls, **overrides) -> "BertConfig":
+        return cls(**overrides)
+
+    @classmethod
+    def tiny(cls, **overrides) -> "BertConfig":
+        return cls(**{**dict(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            max_position_embeddings=64,
+        ), **overrides})
+
+
+def _dense(key, in_dim, out_dim, dtype):
+    scale = 1.0 / np.sqrt(in_dim)
+    return {
+        "kernel": (jax.random.normal(key, (in_dim, out_dim)) * scale).astype(dtype),
+        "bias": jnp.zeros((out_dim,), dtype=dtype),
+    }
+
+
+def init_bert_params(config: BertConfig, key: jax.Array) -> dict:
+    d, i, L = config.hidden_size, config.intermediate_size, config.num_hidden_layers
+    dt = config.param_dtype
+    keys = jax.random.split(key, 12)
+
+    def stack_dense(k, in_dim, out_dim):
+        ks = jax.random.split(k, L)
+        sub = [_dense(kk, in_dim, out_dim, dt) for kk in ks]
+        return {
+            "kernel": jnp.stack([s["kernel"] for s in sub]),
+            "bias": jnp.stack([s["bias"] for s in sub]),
+        }
+
+    def stack_ln():
+        return {"scale": jnp.ones((L, d), dt), "bias": jnp.zeros((L, d), dt)}
+
+    return {
+        "embeddings": {
+            "word_embeddings": (jax.random.normal(keys[0], (config.vocab_size, d)) * 0.02).astype(dt),
+            "position_embeddings": (
+                jax.random.normal(keys[1], (config.max_position_embeddings, d)) * 0.02
+            ).astype(dt),
+            "token_type_embeddings": (
+                jax.random.normal(keys[2], (config.type_vocab_size, d)) * 0.02
+            ).astype(dt),
+            "layer_norm": {"scale": jnp.ones((d,), dt), "bias": jnp.zeros((d,), dt)},
+        },
+        "layers": {
+            "attn": {
+                "q_proj": stack_dense(keys[3], d, d),
+                "k_proj": stack_dense(keys[4], d, d),
+                "v_proj": stack_dense(keys[5], d, d),
+                "o_proj": stack_dense(keys[6], d, d),
+            },
+            "attn_norm": stack_ln(),
+            "mlp": {
+                "up_proj": stack_dense(keys[7], d, i),
+                "down_proj": stack_dense(keys[8], i, d),
+            },
+            "mlp_norm": stack_ln(),
+        },
+        "pooler": _dense(keys[9], d, d, dt),
+        "classifier": _dense(keys[10], d, config.num_labels, dt),
+    }
+
+
+def layer_norm(x, scale, bias, eps):
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    y = (x32 - mean) * lax.rsqrt(var + eps)
+    return (y * scale.astype(jnp.float32) + bias.astype(jnp.float32)).astype(x.dtype)
+
+
+def _apply_dense(p, x, cdt):
+    return x @ p["kernel"].astype(cdt) + p["bias"].astype(cdt)
+
+
+def _bert_layer(config: BertConfig, lp, x, mask_bias):
+    cdt = config.compute_dtype
+    b, s, d = x.shape
+    h, hd = config.num_attention_heads, config.head_dim
+
+    q = _apply_dense(lp["attn"]["q_proj"], x, cdt).reshape(b, s, h, hd)
+    k = _apply_dense(lp["attn"]["k_proj"], x, cdt).reshape(b, s, h, hd)
+    v = _apply_dense(lp["attn"]["v_proj"], x, cdt).reshape(b, s, h, hd)
+    attn = dot_product_attention(q, k, v, causal=False, bias=mask_bias)
+    attn = _apply_dense(lp["attn"]["o_proj"], attn.reshape(b, s, d), cdt)
+    x = layer_norm(x + attn, lp["attn_norm"]["scale"], lp["attn_norm"]["bias"], config.layer_norm_eps)
+
+    y = jax.nn.gelu(_apply_dense(lp["mlp"]["up_proj"], x, cdt))
+    y = _apply_dense(lp["mlp"]["down_proj"], y, cdt)
+    x = layer_norm(x + y, lp["mlp_norm"]["scale"], lp["mlp_norm"]["bias"], config.layer_norm_eps)
+    return x
+
+
+def bert_apply(
+    config: BertConfig,
+    params: dict,
+    input_ids: jax.Array,
+    attention_mask: Optional[jax.Array] = None,
+    token_type_ids: Optional[jax.Array] = None,
+):
+    """Returns (logits (B, num_labels), pooled (B, D))."""
+    from ..ops.attention import NEG_INF
+
+    cdt = config.compute_dtype
+    b, s = input_ids.shape
+    emb = params["embeddings"]
+    x = emb["word_embeddings"].astype(cdt)[input_ids]
+    x = x + emb["position_embeddings"].astype(cdt)[jnp.arange(s)][None]
+    if token_type_ids is None:
+        token_type_ids = jnp.zeros_like(input_ids)
+    x = x + emb["token_type_embeddings"].astype(cdt)[token_type_ids]
+    x = layer_norm(
+        x, emb["layer_norm"]["scale"], emb["layer_norm"]["bias"], config.layer_norm_eps
+    )
+
+    mask_bias = None
+    if attention_mask is not None:
+        mask_bias = jnp.where(attention_mask[:, None, None, :] > 0, 0.0, NEG_INF).astype(
+            jnp.float32
+        )
+
+    layer_fn = functools.partial(_bert_layer, config)
+    if config.scan_layers:
+        def body(x, lp):
+            return layer_fn(lp, x, mask_bias), None
+
+        x, _ = lax.scan(body, x, params["layers"])
+    else:
+        for li in range(config.num_hidden_layers):
+            lp = jax.tree_util.tree_map(lambda p: p[li], params["layers"])
+            x = layer_fn(lp, x, mask_bias)
+
+    pooled = jnp.tanh(_apply_dense(params["pooler"], x[:, 0], cdt))
+    logits = _apply_dense(params["classifier"], pooled, cdt).astype(jnp.float32)
+    return logits, pooled
+
+
+def create_bert(config: BertConfig, seed: int = 0) -> Model:
+    params = init_bert_params(config, jax.random.key(seed))
+    model = Model(functools.partial(bert_apply, config), params, name="bert")
+    model.config = config
+    return model
+
+
+def bert_classification_loss(model_view, batch):
+    logits, _ = model_view(
+        batch["input_ids"],
+        batch.get("attention_mask"),
+        batch.get("token_type_ids"),
+    )
+    labels = batch["labels"]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=-1))

@@ -317,6 +317,100 @@ def llama_loss(model_view, batch):
     return loss
 
 
+# ----------------------------------------------------------------- decoding
+def init_kv_cache(config: LlamaConfig, batch_size: int, max_len: int, dtype=None):
+    """Per-layer stacked KV cache (L, B, max_len, Hkv, hd)."""
+    dtype = dtype or config.compute_dtype
+    shape = (
+        config.num_hidden_layers,
+        batch_size,
+        max_len,
+        config.num_key_value_heads,
+        config.head_dim,
+    )
+    return {"k": jnp.zeros(shape, dtype=dtype), "v": jnp.zeros(shape, dtype=dtype)}
+
+
+def _decode_layer(config: LlamaConfig, layer_params, x, cache_k, cache_v, pos):
+    """One block, one new position; returns updated (cache_k, cache_v)."""
+    h, kvh, hd = config.num_attention_heads, config.num_key_value_heads, config.head_dim
+    b, s, d = x.shape  # s == 1
+    cdt = config.compute_dtype
+
+    residual = x
+    y = rms_norm(x, layer_params["input_norm"]["scale"], config.rms_norm_eps)
+    q = (y @ layer_params["attn"]["q_proj"]["kernel"].astype(cdt)).reshape(b, s, h, hd)
+    k = (y @ layer_params["attn"]["k_proj"]["kernel"].astype(cdt)).reshape(b, s, kvh, hd)
+    v = (y @ layer_params["attn"]["v_proj"]["kernel"].astype(cdt)).reshape(b, s, kvh, hd)
+    q = apply_rope_at(q, pos, config.rope_theta)
+    k = apply_rope_at(k, pos, config.rope_theta)
+    cache_k = lax.dynamic_update_slice(cache_k, k.astype(cache_k.dtype), (0, pos, 0, 0))
+    cache_v = lax.dynamic_update_slice(cache_v, v.astype(cache_v.dtype), (0, pos, 0, 0))
+    # attend over positions 0..pos (mask the tail)
+    kk = repeat_kv_cache(cache_k, h // kvh)
+    vv = repeat_kv_cache(cache_v, h // kvh)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q * (1.0 / np.sqrt(hd)), kk.astype(cdt)).astype(
+        jnp.float32
+    )
+    k_pos = lax.broadcasted_iota(jnp.int32, scores.shape, 3)
+    scores = jnp.where(k_pos <= pos, scores, -1e6)
+    weights = jax.nn.softmax(scores, axis=-1)
+    attn = jnp.einsum("bhqk,bkhd->bqhd", weights.astype(cdt), vv.astype(cdt))
+    attn = attn.reshape(b, s, h * hd) @ layer_params["attn"]["o_proj"]["kernel"].astype(cdt)
+    x = residual + attn
+
+    residual = x
+    y = rms_norm(x, layer_params["post_attn_norm"]["scale"], config.rms_norm_eps)
+    gate = y @ layer_params["mlp"]["gate_proj"]["kernel"].astype(cdt)
+    up = y @ layer_params["mlp"]["up_proj"]["kernel"].astype(cdt)
+    y = jax.nn.silu(gate) * up
+    y = y @ layer_params["mlp"]["down_proj"]["kernel"].astype(cdt)
+    return residual + y, cache_k, cache_v
+
+
+def repeat_kv_cache(c, n_rep):
+    if n_rep == 1:
+        return c
+    b, s, h, d = c.shape
+    return jnp.broadcast_to(c[:, :, :, None, :], (b, s, h, n_rep, d)).reshape(b, s, h * n_rep, d)
+
+
+def apply_rope_at(x, pos, theta):
+    """RoPE for a single traced position ``pos`` (decode step)."""
+    b, s, h, d = x.shape
+    freqs = jnp.asarray(
+        1.0 / (theta ** (np.arange(0, d, 2, dtype=np.float32) / d)), dtype=jnp.float32
+    )
+    angles = pos.astype(jnp.float32) * freqs  # (d/2,)
+    cos = jnp.cos(angles)[None, None, None, :]
+    sin = jnp.sin(angles)[None, None, None, :]
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    y1 = x1 * cos - x2 * sin
+    y2 = x2 * cos + x1 * sin
+    return jnp.stack([y1, y2], axis=-1).reshape(b, s, h, d).astype(x.dtype)
+
+
+def llama_decode_step(config: LlamaConfig, params, cache, token, pos):
+    """One greedy-decode step: token (B, 1) at position ``pos`` (traced
+    scalar). Returns (logits (B, V), new cache)."""
+    cdt = config.compute_dtype
+    x = params["embed_tokens"]["embedding"].astype(cdt)[token]
+
+    def body(carry, inputs):
+        x = carry
+        layer_params, ck, cv = inputs
+        x, ck, cv = _decode_layer(config, layer_params, x, ck, cv, pos)
+        return x, (ck, cv)
+
+    x, (new_k, new_v) = lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
+    x = rms_norm(x, params["final_norm"]["scale"], config.rms_norm_eps)
+    if config.tie_word_embeddings:
+        logits = x @ params["embed_tokens"]["embedding"].astype(cdt).T
+    else:
+        logits = x @ params["lm_head"]["kernel"].astype(cdt)
+    return logits[:, 0].astype(jnp.float32), {"k": new_k, "v": new_v}
+
+
 def create_llama(config: LlamaConfig, seed: int = 0) -> Model:
     params = init_llama_params(config, jax.random.key(seed))
     return_aux = config.num_experts > 1

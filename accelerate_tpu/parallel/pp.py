@@ -81,10 +81,13 @@ def make_pipeline_layer_stack(
                         jnp.where(idx == n_stages - 1, out, out_buf[k])
                     )
             # results live on the last stage; broadcast across pp so the
-            # (replicated-over-pp) head can consume them
-            out_buf = lax.psum(
-                jnp.where(idx == n_stages - 1, out_buf, jnp.zeros_like(out_buf)), pp_axis
-            )
+            # (replicated-over-pp) head can consume them. psum in f32: a bf16
+            # all-reduce trips XLA:CPU's AllReducePromotion pass (compiler
+            # crash "Invalid binary instruction opcode copy").
+            masked = jnp.where(
+                idx == n_stages - 1, out_buf, jnp.zeros_like(out_buf)
+            ).astype(jnp.float32)
+            out_buf = lax.psum(masked, pp_axis).astype(out_buf.dtype)
             aux_total = lax.psum(aux_acc, pp_axis)
             return out_buf, aux_total
 

@@ -1,0 +1,123 @@
+"""Model size/memory estimation utilities over param pytrees.
+
+TPU-native analogue of the estimation half of the reference's
+``utils/modeling.py`` (dtype byte-size tables :664, ``calculate_maximum_sizes``
+:1067, ``compute_module_sizes`` :1085) — the part SURVEY §2.6 says to keep for
+the ``estimate-memory`` CLI. The hook/device-map half is replaced by sharded
+loading (big_modeling.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+__all__ = [
+    "dtype_byte_size",
+    "compute_module_sizes",
+    "calculate_maximum_sizes",
+    "estimate_training_memory",
+    "find_tied_parameters",
+]
+
+_DTYPE_BYTES = {
+    "float64": 8,
+    "float32": 4,
+    "float16": 2,
+    "bfloat16": 2,
+    "int64": 8,
+    "int32": 4,
+    "int16": 2,
+    "int8": 1,
+    "uint8": 1,
+    "bool": 1,
+    "float8_e4m3fn": 1,
+    "float8_e5m2": 1,
+    "int4": 0.5,
+}
+
+
+def dtype_byte_size(dtype) -> float:
+    """Bytes per element (reference utils/modeling.py:664)."""
+    name = str(np.dtype(dtype)) if not isinstance(dtype, str) else dtype
+    for key, size in _DTYPE_BYTES.items():
+        if key in name:
+            return size
+    return 4
+
+
+def _iter_leaves(params: Any, prefix: str = ""):
+    import jax
+
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    from ..parallel.sharding import path_of
+
+    for key_path, leaf in flat:
+        yield path_of(key_path), leaf
+
+
+def compute_module_sizes(params: Any, dtype=None) -> dict[str, float]:
+    """Size in bytes per module prefix (reference utils/modeling.py:1085)."""
+    sizes: dict[str, float] = {"": 0}
+    for path, leaf in _iter_leaves(params):
+        nbytes = float(np.prod(getattr(leaf, "shape", ()) or (1,))) * (
+            dtype_byte_size(dtype) if dtype is not None else dtype_byte_size(leaf.dtype)
+        )
+        parts = path.split("/")
+        for i in range(len(parts) + 1):
+            prefix = "/".join(parts[:i])
+            sizes[prefix] = sizes.get(prefix, 0) + nbytes
+    return sizes
+
+
+def calculate_maximum_sizes(params: Any) -> tuple[float, tuple[str, float]]:
+    """(total bytes, (largest leaf path, bytes)) — reference
+    utils/modeling.py:1067."""
+    total = 0.0
+    largest = ("", 0.0)
+    for path, leaf in _iter_leaves(params):
+        nbytes = float(np.prod(getattr(leaf, "shape", ()) or (1,))) * dtype_byte_size(leaf.dtype)
+        total += nbytes
+        if nbytes > largest[1]:
+            largest = (path, nbytes)
+    return total, largest
+
+
+def estimate_training_memory(
+    num_params: float,
+    dtype: str = "bfloat16",
+    optimizer: str = "adam",
+    gradient_dtype: str = "float32",
+    master_dtype: str = "float32",
+) -> dict[str, float]:
+    """Adam-training memory estimate in bytes (role of the reference's
+    estimate-memory training table, commands/estimate.py:224-310)."""
+    p = num_params
+    weights = p * dtype_byte_size(dtype)
+    master = p * dtype_byte_size(master_dtype) if master_dtype != dtype else 0
+    grads = p * dtype_byte_size(gradient_dtype)
+    opt_mult = {"adam": 2, "adamw": 2, "adafactor": 0.5, "sgd": 0, "momentum": 1}.get(
+        optimizer.lower(), 2
+    )
+    opt_states = p * 4 * opt_mult
+    total = weights + master + grads + opt_states
+    return {
+        "weights": weights,
+        "master_weights": master,
+        "gradients": grads,
+        "optimizer_states": opt_states,
+        "total": total,
+    }
+
+
+def find_tied_parameters(params: Any) -> list[list[str]]:
+    """Groups of leaves aliasing the same buffer (reference
+    utils/modeling.py:567 over torch storages; here: identical array objects
+    or numpy bases)."""
+    seen: dict[int, list[str]] = {}
+    for path, leaf in _iter_leaves(params):
+        base = getattr(leaf, "base", None)
+        key = id(base) if base is not None else id(leaf)
+        seen.setdefault(key, []).append(path)
+    return [group for group in seen.values() if len(group) > 1]

@@ -1,0 +1,104 @@
+"""Misc utilities (reference utils/other.py, 594 LoC).
+
+``extract_model_from_parallel`` (:248) is trivially the identity here (no
+engine wrappers exist); ``compile_regions`` (:106) has no analogue because
+scan-over-layers already gives O(1)-in-depth compilation — the property the
+reference's regional torch.compile approximates (its own benchmark:
+compile 5-9× faster than full compile; scan is the structural fix).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+from typing import Any
+
+import numpy as np
+
+__all__ = [
+    "extract_model_from_parallel",
+    "wait_for_everyone",
+    "save",
+    "get_free_port",
+    "is_port_in_use",
+    "check_os_kernel",
+    "main_process_tqdm",
+]
+
+
+def extract_model_from_parallel(model, keep_fp32_wrapper: bool = True, recursive: bool = False):
+    """Identity: our Model is never engine-wrapped (reference
+    utils/other.py:248 unwraps DDP/FSDP/DS/compiled)."""
+    return model
+
+
+def wait_for_everyone() -> None:
+    from ..state import PartialState
+
+    PartialState().wait_for_everyone()
+
+
+def save(obj: Any, f, save_on_each_node: bool = False, safe_serialization: bool = False) -> None:
+    """Save an object only on the main process (reference utils/other.py:384)."""
+    from ..state import PartialState
+
+    state = PartialState()
+    if state.is_main_process or save_on_each_node:
+        if safe_serialization:
+            from .serialization import save_sharded_safetensors
+
+            save_sharded_safetensors(obj, f)
+        else:
+            import pickle
+
+            import jax
+
+            host = jax.tree_util.tree_map(
+                lambda t: np.asarray(t) if hasattr(t, "shape") else t, obj
+            )
+            with open(f, "wb") as fh:
+                pickle.dump(host, fh)
+
+
+def is_port_in_use(port: int) -> bool:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        return s.connect_ex(("localhost", port)) == 0
+
+
+def get_free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def check_os_kernel() -> None:
+    """Warn on OS configs known to hurt (reference utils/other.py:531 warns on
+    old Linux kernels)."""
+    import platform
+
+    from ..logging import get_logger
+
+    logger = get_logger(__name__)
+    if platform.system() == "Linux":
+        release = platform.release().split(".")
+        try:
+            if int(release[0]) < 5:
+                logger.warning(
+                    f"Linux kernel {platform.release()} < 5.5 can hang with heavy host "
+                    "threading; consider upgrading."
+                )
+        except ValueError:
+            pass
+
+
+def main_process_tqdm(iterable=None, main_process_only: bool = True, *args, **kwargs):
+    """tqdm that only renders on the main process (reference utils/tqdm.py)."""
+    from ..state import PartialState
+
+    try:
+        from tqdm.auto import tqdm
+    except ImportError:
+        return iterable if iterable is not None else None
+    if main_process_only and not PartialState().is_main_process:
+        kwargs["disable"] = True
+    return tqdm(iterable, *args, **kwargs)

@@ -1,0 +1,46 @@
+"""Gradient accumulation example (reference
+examples/by_feature/gradient_accumulation.py): same loop as nlp_example with
+``gradient_accumulation_steps`` and the ``accumulate`` context."""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.models.bert import BertConfig, bert_classification_loss, create_bert
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--gradient_accumulation_steps", type=int, default=4)
+    parser.add_argument("--batch_size", type=int, default=8)
+    args = parser.parse_args()
+
+    accelerator = Accelerator(gradient_accumulation_steps=args.gradient_accumulation_steps)
+    cfg = BertConfig.tiny()
+    model = create_bert(cfg)
+    rng = np.random.default_rng(0)
+    data = {
+        "input_ids": rng.integers(0, cfg.vocab_size, size=(128, 32)).astype(np.int32),
+        "labels": rng.integers(0, 2, size=(128,)).astype(np.int32),
+    }
+    loader = accelerator.prepare_data_loader(data, batch_size=args.batch_size, drop_last=True)
+    model, optimizer = accelerator.prepare(model, optax.adamw(1e-3))
+
+    for batch in loader:
+        with accelerator.accumulate(model):
+            loss = accelerator.backward(bert_classification_loss, batch)
+            optimizer.step()
+            optimizer.zero_grad()
+        # optimizer really stepped only when sync_gradients was True
+        accelerator.print(
+            f"loss={float(loss):.4f} synced={accelerator.sync_gradients} "
+            f"skipped={optimizer.step_was_skipped}"
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,100 @@
+"""BERT sequence-classification training — the reference's canonical
+``examples/nlp_example.py`` (BERT-base GLUE/MRPC) re-shaped TPU-first.
+
+Uses GLUE/MRPC via `datasets` when available, else a synthetic separable
+dataset (zero-egress environments). The loop is the reference's shape:
+prepare → accumulate → backward → clip → step → zero_grad → scheduler.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+import jax.numpy as jnp
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.models.bert import BertConfig, bert_classification_loss, create_bert
+
+
+def get_dataset(cfg, n=512, seq_len=64, seed=0):
+    try:
+        from datasets import load_dataset
+        from transformers import AutoTokenizer
+
+        raw = load_dataset("glue", "mrpc")
+        tok = AutoTokenizer.from_pretrained("bert-base-cased")
+
+        def encode(ex):
+            out = tok(
+                ex["sentence1"], ex["sentence2"], truncation=True,
+                padding="max_length", max_length=seq_len,
+            )
+            out["labels"] = ex["label"]
+            return out
+
+        train = raw["train"].map(encode, batched=True)
+        return {
+            "input_ids": np.asarray(train["input_ids"], dtype=np.int32),
+            "attention_mask": np.asarray(train["attention_mask"], dtype=np.int32),
+            "labels": np.asarray(train["labels"], dtype=np.int32),
+        }
+    except Exception:
+        rng = np.random.default_rng(seed)
+        labels = rng.integers(0, 2, size=(n,)).astype(np.int32)
+        ids = rng.integers(4, cfg.vocab_size, size=(n, seq_len)).astype(np.int32)
+        ids[:, 0] = labels + 1  # separable signal
+        return {
+            "input_ids": ids,
+            "attention_mask": np.ones((n, seq_len), dtype=np.int32),
+            "labels": labels,
+        }
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--mixed_precision", default=None)
+    parser.add_argument("--batch_size", type=int, default=32)
+    parser.add_argument("--epochs", type=int, default=3)
+    parser.add_argument("--lr", type=float, default=2e-4)
+    parser.add_argument("--tiny", action="store_true", help="tiny model for smoke runs")
+    args = parser.parse_args()
+
+    accelerator = Accelerator(mixed_precision=args.mixed_precision, log_with="jsonl",
+                              project_dir="runs/nlp_example")
+    accelerator.init_trackers("nlp_example", config=vars(args))
+
+    cfg = BertConfig.tiny() if args.tiny else BertConfig.base()
+    model = create_bert(cfg, seed=0)
+    data = get_dataset(cfg, seq_len=64)
+
+    steps_per_epoch = len(data["labels"]) // args.batch_size
+    schedule = optax.linear_schedule(args.lr, 0.0, steps_per_epoch * args.epochs)
+    optimizer = optax.adamw(schedule, weight_decay=0.01)
+
+    loader = accelerator.prepare_data_loader(
+        data, batch_size=args.batch_size, shuffle=True, drop_last=True
+    )
+    model, optimizer, scheduler = accelerator.prepare(model, optimizer, schedule)
+
+    step = 0
+    for epoch in range(args.epochs):
+        loader.set_epoch(epoch)
+        for batch in loader:
+            with accelerator.accumulate(model):
+                loss = accelerator.backward(bert_classification_loss, batch)
+                accelerator.clip_grad_norm_(max_norm=1.0)
+                optimizer.step()
+                optimizer.zero_grad()
+                scheduler.step()
+            step += 1
+            if step % 10 == 0:
+                accelerator.log({"loss": float(loss), "lr": scheduler.get_last_lr()[0]}, step=step)
+        accelerator.print(f"epoch {epoch}: loss={float(loss):.4f}")
+    accelerator.end_training()
+
+
+if __name__ == "__main__":
+    main()

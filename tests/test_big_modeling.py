@@ -1,0 +1,137 @@
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from accelerate_tpu.big_modeling import (
+    abstract_params,
+    cpu_offload,
+    dispatch_model,
+    load_checkpoint_and_dispatch,
+    plan_shardings,
+)
+from accelerate_tpu.model import Model
+from accelerate_tpu.parallelism_config import ParallelismConfig
+from accelerate_tpu.utils.modeling import (
+    calculate_maximum_sizes,
+    compute_module_sizes,
+    dtype_byte_size,
+    estimate_training_memory,
+    find_tied_parameters,
+)
+
+
+def _mlp_model():
+    def apply_fn(params, x):
+        h = jax.nn.relu(x @ params["fc1"]["w"] + params["fc1"]["b"])
+        return h @ params["fc2"]["w"] + params["fc2"]["b"]
+
+    params = {
+        "fc1": {"w": jnp.ones((64, 128)), "b": jnp.zeros(128)},
+        "fc2": {"w": jnp.ones((128, 8)), "b": jnp.zeros(8)},
+    }
+    return Model(apply_fn, params, name="mlp")
+
+
+def test_dtype_byte_size():
+    assert dtype_byte_size("bfloat16") == 2
+    assert dtype_byte_size(np.float32) == 4
+    assert dtype_byte_size("int4") == 0.5
+
+
+def test_compute_module_sizes():
+    model = _mlp_model()
+    sizes = compute_module_sizes(model.params)
+    assert sizes["fc1"] == (64 * 128 + 128) * 4
+    assert sizes[""] == sizes["fc1"] + sizes["fc2"]
+
+
+def test_calculate_maximum_sizes():
+    model = _mlp_model()
+    total, (largest_path, largest) = calculate_maximum_sizes(model.params)
+    assert largest_path == "fc1/w"
+    assert largest == 64 * 128 * 4
+
+
+def test_estimate_training_memory():
+    est = estimate_training_memory(1e9, dtype="bfloat16", optimizer="adam")
+    assert est["weights"] == 2e9
+    assert est["optimizer_states"] == 8e9
+    assert est["total"] > 1.4e10
+
+
+def test_find_tied_parameters():
+    w = jnp.ones((4, 4))
+    params = {"a": {"k": w}, "b": {"k": w}, "c": jnp.zeros(2)}
+    tied = find_tied_parameters(params)
+    assert ["a/k", "b/k"] in tied
+
+
+def test_abstract_params_no_allocation():
+    from accelerate_tpu.models.llama import LlamaConfig, init_llama_params
+
+    cfg = LlamaConfig.tiny()
+    abstract = abstract_params(lambda: init_llama_params(cfg, jax.random.key(0)))
+    leaf = abstract["embed_tokens"]["embedding"]
+    assert isinstance(leaf, jax.ShapeDtypeStruct)
+    assert leaf.shape == (cfg.vocab_size, cfg.hidden_size)
+
+
+def test_plan_shardings_budget():
+    mesh = ParallelismConfig(dp_shard_size=8).build_device_mesh()
+    model = _mlp_model()
+    abstract = jax.tree_util.tree_map(
+        lambda p: jax.ShapeDtypeStruct(p.shape, p.dtype), model.params
+    )
+    shardings = plan_shardings(abstract, mesh, fsdp_axes=("dp_shard",), hbm_budget_bytes=2**20)
+    assert shardings["fc1"]["w"] is not None
+    with pytest.raises(MemoryError):
+        plan_shardings(abstract, mesh, fsdp_axes=(), hbm_budget_bytes=10)
+
+
+def test_load_checkpoint_and_dispatch_roundtrip(tmp_path):
+    from accelerate_tpu.utils.serialization import save_sharded_safetensors
+
+    model = _mlp_model()
+    rng = np.random.default_rng(0)
+    flat = {
+        "fc1.w": rng.normal(size=(64, 128)).astype(np.float32),
+        "fc1.b": rng.normal(size=(128,)).astype(np.float32),
+        "fc2.w": rng.normal(size=(128, 8)).astype(np.float32),
+        "fc2.b": rng.normal(size=(8,)).astype(np.float32),
+    }
+    save_sharded_safetensors(flat, str(tmp_path))
+
+    mesh = ParallelismConfig(dp_shard_size=8).build_device_mesh()
+    model = load_checkpoint_and_dispatch(
+        model, str(tmp_path), mesh=mesh, fsdp_axes=("dp_shard",)
+    )
+    np.testing.assert_allclose(
+        np.asarray(jax.device_get(model.params["fc1"]["w"])), flat["fc1.w"]
+    )
+    # large weights got sharded
+    assert "dp_shard" in str(model.shardings["fc1"]["w"].spec)
+    # model still runs
+    out = model(np.ones((2, 64), dtype=np.float32))
+    assert out.shape == (2, 8)
+
+
+def test_load_checkpoint_missing_key_strict(tmp_path):
+    from accelerate_tpu.utils.serialization import save_sharded_safetensors
+
+    model = _mlp_model()
+    save_sharded_safetensors({"fc1.w": np.zeros((64, 128), np.float32)}, str(tmp_path))
+    mesh = ParallelismConfig(dp_shard_size=8).build_device_mesh()
+    with pytest.raises(KeyError):
+        load_checkpoint_and_dispatch(model, str(tmp_path), mesh=mesh)
+
+
+def test_cpu_offload_forward():
+    model = _mlp_model()
+    model = cpu_offload(model)
+    assert isinstance(model.params["fc1"]["w"], np.ndarray)
+    out = model(np.ones((2, 64), dtype=np.float32))
+    assert out.shape == (2, 8)

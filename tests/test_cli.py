@@ -1,0 +1,84 @@
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from accelerate_tpu.commands.accelerate_cli import main
+from accelerate_tpu.commands.config import ClusterConfig
+
+
+def test_cluster_config_roundtrip(tmp_path):
+    cfg = ClusterConfig(mixed_precision="bf16", tp_size=2, dp_shard_size=4)
+    path = cfg.save(str(tmp_path / "cfg.yaml"))
+    loaded = ClusterConfig.load(path)
+    assert loaded.mixed_precision == "bf16"
+    assert loaded.tp_size == 2
+    env = loaded.to_env()
+    assert env["PARALLELISM_CONFIG_TP_SIZE"] == "2"
+    assert env["ACCELERATE_MIXED_PRECISION"] == "bf16"
+
+
+def test_config_default_command(tmp_path):
+    rc = main(["config", "--default", "--config_file", str(tmp_path / "c.yaml")])
+    assert rc == 0
+    assert os.path.exists(tmp_path / "c.yaml")
+
+
+def test_launch_dry_run(tmp_path, capsys):
+    script = tmp_path / "train.py"
+    script.write_text("print('hi')")
+    rc = main(
+        [
+            "launch",
+            "--dry_run",
+            "--mixed_precision", "bf16",
+            "--tp_size", "2",
+            str(script),
+        ]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "train.py" in out
+    assert "PARALLELISM_CONFIG_TP_SIZE=2" in out
+
+
+def test_launch_pod_dry_run(tmp_path, capsys):
+    script = tmp_path / "train.py"
+    script.write_text("print('hi')")
+    rc = main(["launch", "--pod", "my-pod", "--dry_run", str(script)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "gcloud compute tpus tpu-vm ssh my-pod" in out
+    assert "--worker=all" in out
+
+
+def test_estimate_memory_preset(capsys):
+    rc = main(["estimate-memory", "llama-tiny", "--json"])
+    assert rc == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["num_params"] > 0
+    assert len(payload["rows"]) == 4
+
+
+def test_env_command(capsys):
+    rc = main(["env"])
+    assert rc == 0
+    info = json.loads(capsys.readouterr().out)
+    assert "jax" in info
+
+
+def test_merge_weights(tmp_path):
+    import numpy as np
+    import jax
+
+    from accelerate_tpu.checkpointing import save_pytree
+    from accelerate_tpu.utils.serialization import load_sharded_safetensors
+
+    tree = {"layer": {"w": np.arange(16.0).reshape(4, 4).astype(np.float32)}}
+    save_pytree(tree, str(tmp_path / "ckpt" / "model"))
+    rc = main(["merge-weights", str(tmp_path / "ckpt"), str(tmp_path / "out")])
+    assert rc == 0
+    flat = load_sharded_safetensors(str(tmp_path / "out"))
+    np.testing.assert_array_equal(flat["layer.w"], tree["layer"]["w"])
