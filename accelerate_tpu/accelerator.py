@@ -260,6 +260,13 @@ class Accelerator:
             model = Model(model[0], model[1])
         if model.policy is None and self.state.mixed_precision != "no":
             model.policy = self.policy
+        if self.state.mixed_precision == "fp8" and hasattr(
+            getattr(model, "config", None), "use_fp8"
+        ):
+            # fp8 projections in-model (ops/fp8.py); the bf16 policy still
+            # covers non-matmul math (reference picks AO→TE→MSAMP here,
+            # accelerator.py:487-503 — one native path instead)
+            model.config.use_fp8 = True
 
         from .parallel.sharding import infer_shardings, apply_shardings
         from .parallel.tp import tensor_parallel_rules

@@ -61,6 +61,9 @@ class LlamaConfig:
     num_experts_per_tok: int = 2
     expert_capacity_factor: float = 1.25
     moe_aux_loss_coef: float = 0.01
+    # fp8 projections (ops/fp8.py): e4m3 fwd / e5m2 bwd current scaling;
+    # set by Accelerator when mixed_precision="fp8"
+    use_fp8: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -181,6 +184,15 @@ def _remat_policy(name: str):
     return None
 
 
+def _dot(config: LlamaConfig, x, w):
+    """Projection matmul, optionally via the fp8 path."""
+    if config.use_fp8:
+        from ..ops.fp8 import fp8_dot
+
+        return fp8_dot(x, w)
+    return x @ w
+
+
 def _attention(config: LlamaConfig, q, k, v, attention_fn=None, q_offset: int = 0):
     if attention_fn is not None:
         return attention_fn(q, k, v, causal=True)
@@ -203,13 +215,13 @@ def _layer(config: LlamaConfig, layer_params, x, position_offset: int, attention
 
     residual = x
     y = rms_norm(x, layer_params["input_norm"]["scale"], config.rms_norm_eps)
-    q = (y @ layer_params["attn"]["q_proj"]["kernel"].astype(cdt)).reshape(b, s, h, hd)
-    k = (y @ layer_params["attn"]["k_proj"]["kernel"].astype(cdt)).reshape(b, s, kvh, hd)
-    v = (y @ layer_params["attn"]["v_proj"]["kernel"].astype(cdt)).reshape(b, s, kvh, hd)
+    q = _dot(config, y, layer_params["attn"]["q_proj"]["kernel"].astype(cdt)).reshape(b, s, h, hd)
+    k = _dot(config, y, layer_params["attn"]["k_proj"]["kernel"].astype(cdt)).reshape(b, s, kvh, hd)
+    v = _dot(config, y, layer_params["attn"]["v_proj"]["kernel"].astype(cdt)).reshape(b, s, kvh, hd)
     q = apply_rope(q, position_offset, config.rope_theta)
     k = apply_rope(k, position_offset, config.rope_theta)
     attn = _attention(config, q, k, v, attention_fn, q_offset=position_offset)
-    attn = attn.reshape(b, s, h * hd) @ layer_params["attn"]["o_proj"]["kernel"].astype(cdt)
+    attn = _dot(config, attn.reshape(b, s, h * hd), layer_params["attn"]["o_proj"]["kernel"].astype(cdt))
     x = residual + attn
 
     residual = x
@@ -228,10 +240,10 @@ def _layer(config: LlamaConfig, layer_params, x, position_offset: int, attention
             compute_dtype=cdt,
         )
     else:
-        gate = y @ layer_params["mlp"]["gate_proj"]["kernel"].astype(cdt)
-        up = y @ layer_params["mlp"]["up_proj"]["kernel"].astype(cdt)
+        gate = _dot(config, y, layer_params["mlp"]["gate_proj"]["kernel"].astype(cdt))
+        up = _dot(config, y, layer_params["mlp"]["up_proj"]["kernel"].astype(cdt))
         y = jax.nn.silu(gate) * up
-        y = y @ layer_params["mlp"]["down_proj"]["kernel"].astype(cdt)
+        y = _dot(config, y, layer_params["mlp"]["down_proj"]["kernel"].astype(cdt))
         aux = jnp.float32(0.0)
     return residual + y, aux
 

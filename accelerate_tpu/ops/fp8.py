@@ -1,0 +1,95 @@
+"""FP8 training primitives (current-scaling recipe).
+
+TPU-native analogue of the reference's three fp8 engine integrations
+(torchao Float8Linear utils/ao.py, TransformerEngine utils/transformer_engine.py,
+MS-AMP — SURVEY §2.5): one implementation instead of three adapters.
+
+Recipe: e4m3 for activations/weights in the forward dot, e5m2 for gradients
+in the backward dots, per-tensor *current* scaling (amax computed on the
+value being cast — stateless, vs TE's delayed amax history; simpler and
+within noise for LLM training at these scales). The quantize→dot→dequantize
+pattern lowers to native fp8 MXU ops on TPU generations that support it and
+falls back to bf16 math elsewhere — numerics are identical either way.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["fp8_dot", "quantize_e4m3", "quantize_e5m2", "Fp8Config"]
+
+E4M3_MAX = 448.0
+E5M2_MAX = 57344.0
+
+
+def _amax_scale(x, fmax):
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)))
+    return fmax / jnp.maximum(amax, 1e-12)
+
+
+def quantize_e4m3(x):
+    """Returns (q, inv_scale): x ≈ q.astype(f32) * inv_scale."""
+    scale = _amax_scale(x, E4M3_MAX)
+    q = (x.astype(jnp.float32) * scale).astype(jnp.float8_e4m3fn)
+    return q, 1.0 / scale
+
+
+def quantize_e5m2(x):
+    scale = _amax_scale(x, E5M2_MAX)
+    q = (x.astype(jnp.float32) * scale).astype(jnp.float8_e5m2)
+    return q, 1.0 / scale
+
+
+@jax.custom_vjp
+def fp8_dot(x, w):
+    """x @ w with e4m3 forward and e5m2 gradient quantization.
+
+    x: (..., K), w: (K, N). Output in x.dtype.
+    """
+    qx, sx = quantize_e4m3(x)
+    qw, sw = quantize_e4m3(w)
+    out = jnp.einsum(
+        "...k,kn->...n", qx.astype(jnp.bfloat16), qw.astype(jnp.bfloat16),
+        preferred_element_type=jnp.float32,
+    )
+    return (out * (sx * sw)).astype(x.dtype)
+
+
+def _fp8_dot_fwd(x, w):
+    return fp8_dot(x, w), (x, w)
+
+
+def _fp8_dot_bwd(res, g):
+    x, w = res
+    qg, sg = quantize_e5m2(g)
+    qx, sx = quantize_e4m3(x)
+    qw, sw = quantize_e4m3(w)
+    gb = qg.astype(jnp.bfloat16)
+    dx = jnp.einsum(
+        "...n,kn->...k", gb, qw.astype(jnp.bfloat16), preferred_element_type=jnp.float32
+    ) * (sg * sw)
+    dw = jnp.einsum(
+        "...k,...n->kn", qx.astype(jnp.bfloat16), gb, preferred_element_type=jnp.float32
+    ) * (sg * sx)
+    return dx.astype(x.dtype), dw.astype(w.dtype)
+
+
+fp8_dot.defvjp(_fp8_dot_fwd, _fp8_dot_bwd)
+
+
+class Fp8Config:
+    """Knob container (reference AORecipeKwargs/TERecipeKwargs role)."""
+
+    def __init__(self, use_fp8_dots: bool = True, min_dim: int = 256):
+        self.use_fp8_dots = use_fp8_dots
+        # skip tiny matmuls where quantization overhead dominates
+        self.min_dim = min_dim
+
+    def maybe_dot(self, x, w):
+        if self.use_fp8_dots and w.shape[0] >= self.min_dim and w.shape[-1] >= self.min_dim:
+            return fp8_dot(x, w)
+        return x @ w

@@ -1,0 +1,155 @@
+"""ctypes bridge to the native (C++) host-side kernels in csrc/.
+
+Builds ``libaccel_packing.so`` on demand with g++ -O3 (cached under
+``~/.cache/accelerate_tpu``); every entry point has a NumPy fallback so the
+framework works on toolchain-less machines.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import functools
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["get_packing_lib", "pack_ffd", "pack_contiguous", "fill_packed", "pack_dataset"]
+
+_CACHE_DIR = os.path.expanduser(
+    os.environ.get("ACCELERATE_TPU_CACHE", "~/.cache/accelerate_tpu")
+)
+
+
+def _source_path() -> str:
+    here = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    return os.path.join(here, "csrc", "packing.cpp")
+
+
+@functools.lru_cache(maxsize=1)
+def get_packing_lib() -> Optional[ctypes.CDLL]:
+    """Compile (once) and load the native library; None on any failure."""
+    src = _source_path()
+    if not os.path.exists(src):
+        return None
+    os.makedirs(_CACHE_DIR, exist_ok=True)
+    out = os.path.join(_CACHE_DIR, "libaccel_packing.so")
+    try:
+        if not os.path.exists(out) or os.path.getmtime(out) < os.path.getmtime(src):
+            subprocess.run(
+                ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", src, "-o", out],
+                check=True,
+                capture_output=True,
+            )
+        lib = ctypes.CDLL(out)
+    except (OSError, subprocess.CalledProcessError):
+        return None
+    i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+    i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+    lib.pack_ffd.restype = ctypes.c_int64
+    lib.pack_ffd.argtypes = [i64p, ctypes.c_int64, ctypes.c_int64, i64p]
+    lib.pack_contiguous.restype = ctypes.c_int64
+    lib.pack_contiguous.argtypes = [i64p, ctypes.c_int64, ctypes.c_int64, i64p]
+    lib.fill_packed.restype = None
+    lib.fill_packed.argtypes = [
+        i32p, i64p, i64p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, i32p, i32p,
+    ]
+    return lib
+
+
+def _pack_ffd_py(lengths: np.ndarray, capacity: int, bin_ids: np.ndarray) -> int:
+    order = np.argsort(-lengths, kind="stable")
+    remaining: list[int] = []
+    for doc in order:
+        ln = int(lengths[doc])
+        if ln > capacity:
+            bin_ids[doc] = -1
+            continue
+        for b, rem in enumerate(remaining):
+            if rem >= ln:
+                remaining[b] -= ln
+                bin_ids[doc] = b
+                break
+        else:
+            remaining.append(capacity - ln)
+            bin_ids[doc] = len(remaining) - 1
+    return len(remaining)
+
+
+def pack_ffd(lengths, capacity: int):
+    """First-fit-decreasing packing → (bin_ids, n_bins)."""
+    lengths = np.ascontiguousarray(lengths, dtype=np.int64)
+    bin_ids = np.empty_like(lengths)
+    lib = get_packing_lib()
+    if lib is not None:
+        n_bins = int(lib.pack_ffd(lengths, len(lengths), capacity, bin_ids))
+    else:
+        n_bins = _pack_ffd_py(lengths, capacity, bin_ids)
+    return bin_ids, n_bins
+
+
+def pack_contiguous(lengths, capacity: int):
+    """Order-preserving greedy packing → (bin_ids, n_bins)."""
+    lengths = np.ascontiguousarray(lengths, dtype=np.int64)
+    bin_ids = np.empty_like(lengths)
+    lib = get_packing_lib()
+    if lib is not None:
+        n_bins = int(lib.pack_contiguous(lengths, len(lengths), capacity, bin_ids))
+        return bin_ids, n_bins
+    bin_id = 0
+    used = 0
+    n_bins = 0
+    for i, ln in enumerate(lengths):
+        if ln > capacity:
+            bin_ids[i] = -1
+            continue
+        if used + ln > capacity:
+            bin_id += 1
+            used = 0
+        bin_ids[i] = bin_id
+        used += int(ln)
+        n_bins = bin_id + 1
+    return bin_ids, n_bins
+
+
+def fill_packed(tokens, doc_starts, bin_ids, capacity: int, n_bins: int, pad_id: int = 0):
+    """Materialize (n_bins, capacity) token + segment-id matrices."""
+    tokens = np.ascontiguousarray(tokens, dtype=np.int32)
+    doc_starts = np.ascontiguousarray(doc_starts, dtype=np.int64)
+    bin_ids = np.ascontiguousarray(bin_ids, dtype=np.int64)
+    out_tokens = np.full((n_bins, capacity), pad_id, dtype=np.int32)
+    out_segments = np.zeros((n_bins, capacity), dtype=np.int32)
+    lib = get_packing_lib()
+    if lib is not None:
+        lib.fill_packed(
+            tokens, doc_starts, bin_ids, len(bin_ids), capacity, n_bins,
+            out_tokens.reshape(-1), out_segments.reshape(-1),
+        )
+        return out_tokens, out_segments
+    cursor = np.zeros(n_bins, dtype=np.int64)
+    seg = np.zeros(n_bins, dtype=np.int32)
+    for i, b in enumerate(bin_ids):
+        if b < 0:
+            continue
+        ln = int(doc_starts[i + 1] - doc_starts[i])
+        if cursor[b] + ln > capacity:
+            continue
+        seg[b] += 1
+        sl = slice(int(cursor[b]), int(cursor[b]) + ln)
+        out_tokens[b, sl] = tokens[doc_starts[i] : doc_starts[i + 1]]
+        out_segments[b, sl] = seg[b]
+        cursor[b] += ln
+    return out_tokens, out_segments
+
+
+def pack_dataset(documents, seq_len: int, pad_id: int = 0, preserve_order: bool = False):
+    """Pack a list of variable-length token sequences into fixed (N, seq_len)
+    training rows + segment ids (for segment-masked attention)."""
+    lengths = np.asarray([len(d) for d in documents], dtype=np.int64)
+    doc_starts = np.zeros(len(documents) + 1, dtype=np.int64)
+    np.cumsum(lengths, out=doc_starts[1:])
+    tokens = np.concatenate([np.asarray(d, dtype=np.int32) for d in documents]) if documents else np.zeros(0, np.int32)
+    packer = pack_contiguous if preserve_order else pack_ffd
+    bin_ids, n_bins = packer(lengths, seq_len)
+    return fill_packed(tokens, doc_starts, bin_ids, seq_len, n_bins, pad_id=pad_id)

@@ -1,0 +1,124 @@
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from accelerate_tpu.ops.fp8 import E4M3_MAX, fp8_dot, quantize_e4m3
+from accelerate_tpu.utils.quantization import (
+    QuantizationConfig,
+    QuantizedLeaf,
+    quantize_model,
+    quantize_params,
+)
+
+
+def test_quantize_e4m3_roundtrip():
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(64, 64)), dtype=jnp.float32)
+    q, inv_scale = quantize_e4m3(x)
+    assert q.dtype == jnp.float8_e4m3fn
+    recon = q.astype(jnp.float32) * inv_scale
+    # e4m3 has ~2 decimal digits; tolerance relative to amax
+    np.testing.assert_allclose(np.asarray(recon), np.asarray(x), atol=float(jnp.abs(x).max()) * 0.07)
+
+
+def test_fp8_dot_close_to_f32():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(32, 128)), dtype=jnp.float32)
+    w = jnp.asarray(rng.normal(size=(128, 64)), dtype=jnp.float32)
+    ref = x @ w
+    out = fp8_dot(x, w)
+    err = np.abs(np.asarray(out) - np.asarray(ref)).mean() / np.abs(np.asarray(ref)).mean()
+    assert err < 0.1  # fp8 relative error budget
+
+
+def test_fp8_dot_grads():
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(16, 64)), dtype=jnp.float32)
+    w = jnp.asarray(rng.normal(size=(64, 32)), dtype=jnp.float32)
+
+    def loss(x, w):
+        return jnp.sum(fp8_dot(x, w) ** 2)
+
+    gx, gw = jax.grad(loss, argnums=(0, 1))(x, w)
+    rgx, rgw = jax.grad(lambda x, w: jnp.sum((x @ w) ** 2), argnums=(0, 1))(x, w)
+    assert np.all(np.isfinite(np.asarray(gx)))
+    rel = np.abs(np.asarray(gw) - np.asarray(rgw)).mean() / np.abs(np.asarray(rgw)).mean()
+    assert rel < 0.15
+
+
+def test_llama_fp8_training_runs():
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.models.llama import LlamaConfig, create_llama, llama_loss
+    from accelerate_tpu.parallelism_config import ParallelismConfig
+
+    acc = Accelerator(
+        parallelism_config=ParallelismConfig(dp_shard_size=8), mixed_precision="fp8"
+    )
+    cfg = LlamaConfig.tiny()
+    model = create_llama(cfg, seed=0)
+    assert not cfg.use_fp8
+    model, opt = acc.prepare(model, optax.adamw(1e-3))
+    assert model.config.use_fp8  # switched on by prepare
+    rng = np.random.default_rng(0)
+    data = {"input_ids": rng.integers(0, cfg.vocab_size, size=(16, 32)).astype(np.int32)}
+    loader = acc.prepare_data_loader(data, batch_size=8, drop_last=True)
+    losses = []
+    for _ in range(3):
+        for batch in loader:
+            with acc.accumulate(model):
+                loss = acc.backward(llama_loss, batch)
+                opt.step()
+                opt.zero_grad()
+                losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+def test_quantize_params_selective():
+    params = {
+        "big": {"kernel": jnp.ones((128, 64), jnp.float32)},
+        "norm": {"scale": jnp.ones((4096,), jnp.float32)},  # skipped by pattern
+        "small": jnp.ones((4,), jnp.float32),  # too small
+    }
+    out = quantize_params(params, QuantizationConfig(load_in_8bit=True, min_weight_size=1024))
+    assert isinstance(out["big"]["kernel"], QuantizedLeaf)
+    assert not isinstance(out["norm"]["scale"], QuantizedLeaf)
+    assert not isinstance(out["small"], QuantizedLeaf)
+
+
+def test_quantized_model_forward_close():
+    from accelerate_tpu.model import Model
+
+    rng = np.random.default_rng(0)
+    w = rng.normal(size=(64, 32)).astype(np.float32)
+
+    def apply_fn(params, x):
+        return x @ params["w"]
+
+    model = Model(apply_fn, {"w": jnp.asarray(w)})
+    x = rng.normal(size=(4, 64)).astype(np.float32)
+    ref = np.asarray(model(x))
+    model = quantize_model(model, QuantizationConfig(load_in_8bit=True, min_weight_size=1))
+    out = np.asarray(model(x))
+    rel = np.abs(out - ref).mean() / np.abs(ref).mean()
+    assert rel < 0.02  # int8 per-channel error budget
+    # storage really is int8
+    assert model.params["w"].q.dtype == jnp.int8
+
+
+def test_quantized_4bit():
+    from accelerate_tpu.model import Model
+
+    rng = np.random.default_rng(0)
+    w = rng.normal(size=(64, 32)).astype(np.float32)
+    model = Model(lambda p, x: x @ p["w"], {"w": jnp.asarray(w)})
+    x = rng.normal(size=(4, 64)).astype(np.float32)
+    ref = np.asarray(model(x))
+    model = quantize_model(
+        model, QuantizationConfig(load_in_4bit=True, min_weight_size=1)
+    )
+    out = np.asarray(model(x))
+    rel = np.abs(out - ref).mean() / np.abs(ref).mean()
+    assert rel < 0.15

@@ -1,0 +1,75 @@
+import numpy as np
+import pytest
+
+from accelerate_tpu.utils import native
+
+
+def test_native_lib_compiles():
+    lib = native.get_packing_lib()
+    assert lib is not None, "g++ available in this image; native build should work"
+
+
+@pytest.mark.parametrize("use_native", [True, False])
+def test_pack_ffd_valid(use_native, monkeypatch):
+    if not use_native:
+        monkeypatch.setattr(native, "get_packing_lib", lambda: None)
+    rng = np.random.default_rng(0)
+    lengths = rng.integers(10, 500, size=200)
+    bin_ids, n_bins = native.pack_ffd(lengths, capacity=512)
+    assert n_bins >= 1
+    # every bin within capacity
+    fill = np.zeros(n_bins, dtype=np.int64)
+    for ln, b in zip(lengths, bin_ids):
+        assert b >= 0
+        fill[b] += ln
+    assert fill.max() <= 512
+    # FFD should be near the lower bound
+    assert n_bins <= int(np.ceil(lengths.sum() / 512)) + max(3, n_bins // 5)
+
+
+def test_pack_ffd_oversize_doc():
+    bin_ids, n_bins = native.pack_ffd(np.array([600, 100]), capacity=512)
+    assert bin_ids[0] == -1
+    assert bin_ids[1] >= 0
+
+
+def test_native_matches_python_fallback(monkeypatch):
+    rng = np.random.default_rng(1)
+    lengths = rng.integers(1, 300, size=100)
+    native_ids, native_bins = native.pack_ffd(lengths, 512)
+    monkeypatch.setattr(native, "get_packing_lib", lambda: None)
+    py_ids, py_bins = native.pack_ffd(lengths, 512)
+    np.testing.assert_array_equal(native_ids, py_ids)
+    assert native_bins == py_bins
+
+
+def test_pack_contiguous_preserves_order():
+    lengths = np.array([100, 200, 300, 250, 50])
+    bin_ids, n_bins = native.pack_contiguous(lengths, capacity=512)
+    assert bin_ids.tolist() == [0, 0, 1, 2, 2]
+    assert n_bins == 3
+
+
+def test_pack_dataset_end_to_end():
+    docs = [[1, 2, 3], [4, 5], [6, 7, 8, 9], [10]]
+    tokens, segments = native.pack_dataset(docs, seq_len=8, pad_id=0)
+    assert tokens.shape[1] == 8
+    # all tokens present exactly once
+    flat = tokens[tokens > 0]
+    assert sorted(flat.tolist()) == list(range(1, 11))
+    # segment ids distinguish docs within a row
+    for row_t, row_s in zip(tokens, segments):
+        boundaries = set()
+        for t, s in zip(row_t, row_s):
+            if t > 0:
+                boundaries.add(s)
+        assert len(boundaries) >= 1
+
+
+def test_fill_packed_native_vs_python(monkeypatch):
+    docs = [list(range(1, 6)), list(range(6, 9)), list(range(9, 16)), [20]]
+    t1, s1 = native.pack_dataset(docs, seq_len=8)
+    monkeypatch.setattr(native, "get_packing_lib", lambda: None)
+    t2, s2 = native.pack_dataset(docs, seq_len=8)
+    np.testing.assert_array_equal(t1, t2)
+    np.testing.assert_array_equal(s1, s2)
