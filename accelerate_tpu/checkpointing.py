@@ -58,17 +58,36 @@ __all__ = [
 
 
 # ------------------------------------------------------------------ orbax io
+_ASYNC_CKPTRS: list = []
+
+
 def save_pytree(tree, path: str, async_save: bool = False) -> None:
     """Write a (possibly sharded) pytree with orbax; every host writes only
-    its own shards."""
+    its own shards. ``async_save=True`` returns immediately — device buffers
+    are snapshotted and serialization happens on background threads (the
+    SURVEY §5 "async sharded ckpt" goal); call :func:`wait_for_async_saves`
+    (or save again / exit) to join."""
     import orbax.checkpoint as ocp
 
     path = os.path.abspath(path)
     if os.path.exists(path):
         shutil.rmtree(path)
+    if async_save:
+        ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+        ckptr.save(path, args=ocp.args.StandardSave(tree))
+        _ASYNC_CKPTRS.append(ckptr)
+        return
     with ocp.StandardCheckpointer() as ckptr:
         ckptr.save(path, tree)
         ckptr.wait_until_finished()
+
+
+def wait_for_async_saves() -> None:
+    """Block until all in-flight async checkpoint writes are durable."""
+    while _ASYNC_CKPTRS:
+        ckptr = _ASYNC_CKPTRS.pop()
+        ckptr.wait_until_finished()
+        ckptr.close()
 
 
 def load_pytree(path: str, target=None, shardings=None):
@@ -139,11 +158,17 @@ def _resolve_dir(accelerator, output_dir: Optional[str], for_save: bool) -> str:
     return output_dir
 
 
-def save_accelerator_state(accelerator, output_dir: Optional[str] = None, safe_serialization: bool = True) -> str:
+def save_accelerator_state(
+    accelerator,
+    output_dir: Optional[str] = None,
+    safe_serialization: bool = True,
+    async_save: bool = False,
+) -> str:
     """Save the complete training state (reference save_accelerator_state,
     checkpointing.py:63-182 + Accelerator.save_state accelerator.py:3584)."""
     state = PartialState()
     pc = accelerator.project_configuration
+    wait_for_async_saves()  # join any previous in-flight save first
     output_dir = _resolve_dir(accelerator, output_dir, for_save=True)
 
     if pc.automatic_checkpoint_naming and state.is_main_process:
@@ -160,11 +185,17 @@ def save_accelerator_state(accelerator, output_dir: Optional[str] = None, safe_s
 
     for i, model in enumerate(accelerator._models):
         suffix = "" if i == 0 else f"_{i}"
-        save_pytree(model.params, os.path.join(output_dir, f"{MODEL_NAME}{suffix}"))
+        save_pytree(
+            model.params, os.path.join(output_dir, f"{MODEL_NAME}{suffix}"), async_save=async_save
+        )
     for i, opt in enumerate(accelerator._optimizers):
         suffix = "" if i == 0 else f"_{i}"
         if opt.opt_state is not None:
-            save_pytree(opt.opt_state, os.path.join(output_dir, f"{OPTIMIZER_NAME}{suffix}"))
+            save_pytree(
+                opt.opt_state,
+                os.path.join(output_dir, f"{OPTIMIZER_NAME}{suffix}"),
+                async_save=async_save,
+            )
 
     if state.is_main_process:
         for i, sched in enumerate(accelerator._schedulers):
@@ -206,6 +237,7 @@ def load_accelerator_state(accelerator, input_dir: Optional[str] = None, **kwarg
     """Restore the training state (reference load_accelerator_state,
     checkpointing.py:183-320 + Accelerator.load_state accelerator.py:3750)."""
     state = PartialState()
+    wait_for_async_saves()  # ensure no half-written checkpoint is read
     input_dir = _resolve_dir(accelerator, input_dir, for_save=False)
 
     for i, model in enumerate(accelerator._models):
