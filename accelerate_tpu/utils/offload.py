@@ -1,0 +1,98 @@
+"""Disk offload: memmap-backed weights.
+
+Analogue of the reference's ``utils/offload.py`` (per-weight ``.dat`` memmap
+files + ``index.json``, :25-104; lazy ``OffloadedWeightsLoader`` :127): params
+beyond host RAM live on disk and stream device-ward per forward call.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+import numpy as np
+
+__all__ = ["offload_state_dict", "OffloadedWeightsLoader", "disk_offload"]
+
+
+def offload_state_dict(save_dir: str, params: Any) -> dict:
+    """Write every leaf to ``<path>.dat`` + index.json; returns the index."""
+    import jax
+
+    from ..parallel.sharding import path_of
+
+    os.makedirs(save_dir, exist_ok=True)
+    index = {}
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    for key_path, leaf in flat:
+        name = path_of(key_path).replace("/", ".")
+        arr = np.asarray(jax.device_get(leaf))
+        fname = os.path.join(save_dir, f"{name}.dat")
+        m = np.memmap(fname, dtype=arr.dtype, mode="w+", shape=arr.shape or (1,))
+        m[...] = arr if arr.shape else arr.reshape(1)
+        m.flush()
+        index[name] = {"dtype": str(arr.dtype), "shape": list(arr.shape)}
+    with open(os.path.join(save_dir, "index.json"), "w") as f:
+        json.dump(index, f)
+    return index
+
+
+class OffloadedWeightsLoader:
+    """Lazy dict-like view over an offload directory (reference :127)."""
+
+    def __init__(self, save_dir: str):
+        self.save_dir = save_dir
+        with open(os.path.join(save_dir, "index.json")) as f:
+            self.index = json.load(f)
+
+    def keys(self):
+        return self.index.keys()
+
+    def __len__(self):
+        return len(self.index)
+
+    def __contains__(self, key):
+        return key in self.index
+
+    def __getitem__(self, key: str) -> np.ndarray:
+        meta = self.index[key]
+        shape = tuple(meta["shape"])
+        m = np.memmap(
+            os.path.join(self.save_dir, f"{key}.dat"),
+            dtype=np.dtype(meta["dtype"]),
+            mode="r",
+            shape=shape or (1,),
+        )
+        return m if shape else m.reshape(())
+
+
+def disk_offload(model, offload_dir: str):
+    """Move a model's params to disk memmaps; forward streams them in
+    (reference disk_offload big_modeling.py)."""
+    import jax
+
+    from ..parallel.sharding import path_of
+
+    offload_state_dict(offload_dir, model.params)
+    loader = OffloadedWeightsLoader(offload_dir)
+
+    def to_memmap(key_path, leaf):
+        return loader[path_of(key_path).replace("/", ".")]
+
+    model.params = jax.tree_util.tree_map_with_path(to_memmap, model.params)
+    base_apply = model.apply_fn
+    inner_jit = jax.jit(base_apply)
+
+    def offloaded_apply(params, *args, **kwargs):
+        import jax.numpy as jnp
+
+        # memmap → host array → device happens EAGERLY (outside any trace);
+        # only the model math is jitted
+        device_params = jax.tree_util.tree_map(lambda p: jnp.asarray(np.asarray(p)), params)
+        return inner_jit(device_params, *args, **kwargs)
+
+    model.apply_fn = offloaded_apply
+    # the outer forward must stay un-jitted — offloaded_apply manages its own
+    model._jitted_forward = model._mp_apply
+    return model

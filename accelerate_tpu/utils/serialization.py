@@ -76,7 +76,9 @@ def save_sharded_safetensors(
     from safetensors.numpy import save_file
 
     flat = flatten_dict(params)
-    flat = {k: np.asarray(v) for k, v in flat.items()}
+    # ascontiguousarray: transposed views (e.g. torch-layout exports) must be
+    # materialized or safetensors serializes the underlying buffer layout
+    flat = {k: np.ascontiguousarray(v) for k, v in flat.items()}
     limit = parse_size(max_shard_size)
 
     shards: list[dict[str, np.ndarray]] = [{}]

@@ -1,0 +1,119 @@
+"""Interop: HF torch-format checkpoints, flax modules, disk offload."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from accelerate_tpu.model import Model, wrap_flax_model
+from accelerate_tpu.models.llama import (
+    LlamaConfig,
+    convert_hf_state_dict,
+    create_llama,
+    export_hf_state_dict,
+    init_llama_params,
+    llama_apply,
+)
+
+
+def test_hf_roundtrip_exact():
+    """export → convert recovers the exact pytree (transposes + stacking)."""
+    cfg = LlamaConfig.tiny()
+    params = init_llama_params(cfg, jax.random.key(0))
+    flat = export_hf_state_dict(cfg, params)
+    assert "model.layers.0.self_attn.q_proj.weight" in flat
+    # torch layout: (out_features, in_features)
+    assert flat["model.layers.0.self_attn.q_proj.weight"].shape == (
+        cfg.num_attention_heads * cfg.head_dim,
+        cfg.hidden_size,
+    )
+    back = convert_hf_state_dict(cfg, flat)
+    for (pa, a), (pb, b) in zip(
+        jax.tree_util.tree_flatten_with_path(params)[0],
+        jax.tree_util.tree_flatten_with_path(back)[0],
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b), err_msg=str(pa))
+
+
+def test_hf_tied_embeddings_fallback():
+    cfg = LlamaConfig.tiny()
+    params = init_llama_params(cfg, jax.random.key(0))
+    flat = export_hf_state_dict(cfg, params)
+    del flat["lm_head.weight"]  # tied checkpoint
+    back = convert_hf_state_dict(cfg, flat)
+    np.testing.assert_array_equal(
+        np.asarray(back["lm_head"]["kernel"]),
+        np.asarray(back["embed_tokens"]["embedding"]).T,
+    )
+
+
+def test_load_hf_checkpoint_from_safetensors(tmp_path):
+    from accelerate_tpu.models.llama import load_hf_checkpoint
+    from accelerate_tpu.utils.serialization import save_sharded_safetensors
+
+    cfg = LlamaConfig.tiny(compute_dtype=jnp.float32)
+    src = create_llama(cfg, seed=7)
+    flat = export_hf_state_dict(cfg, src.params)
+    save_sharded_safetensors(flat, str(tmp_path))
+
+    dst = create_llama(cfg, seed=0)
+    ids = jnp.ones((1, 8), dtype=jnp.int32)
+    before = np.asarray(llama_apply(cfg, dst.params, ids))
+    load_hf_checkpoint(dst, str(tmp_path))
+    after = np.asarray(llama_apply(cfg, dst.params, ids))
+    expected = np.asarray(llama_apply(cfg, src.params, ids))
+    assert not np.allclose(before, expected, atol=1e-5)
+    np.testing.assert_allclose(after, expected, atol=1e-6)
+
+
+def test_flax_module_interop():
+    flax = pytest.importorskip("flax")
+    import flax.linen as nn
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.Dense(16)(x)
+            return nn.Dense(4)(nn.relu(x))
+
+    module = MLP()
+    x = np.ones((2, 8), dtype=np.float32)
+    variables = module.init(jax.random.key(0), x)
+    model = wrap_flax_model(module, variables["params"])
+    out = model(x)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(module.apply(variables, x)), atol=1e-6
+    )
+
+    # prepare() shards flax params like any pytree
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.parallelism_config import ParallelismConfig
+
+    acc = Accelerator(parallelism_config=ParallelismConfig(dp_shard_size=8))
+    model = acc.prepare(model)
+    assert model.shardings is not None
+    out2 = model(x)
+    np.testing.assert_allclose(np.asarray(out2), np.asarray(out), atol=1e-6)
+
+
+def test_disk_offload(tmp_path):
+    from accelerate_tpu.utils.offload import OffloadedWeightsLoader, disk_offload
+
+    def apply_fn(params, x):
+        return x @ params["w"] + params["b"]
+
+    rng = np.random.default_rng(0)
+    w = rng.normal(size=(8, 4)).astype(np.float32)
+    model = Model(apply_fn, {"w": jnp.asarray(w), "b": jnp.zeros(4)})
+    x = rng.normal(size=(2, 8)).astype(np.float32)
+    ref = np.asarray(model(x))
+
+    model = disk_offload(model, str(tmp_path / "offload"))
+    assert isinstance(model.params["w"], np.memmap)
+    out = np.asarray(model(x))
+    np.testing.assert_allclose(out, ref, atol=1e-6)
+
+    loader = OffloadedWeightsLoader(str(tmp_path / "offload"))
+    assert "w" in loader
+    np.testing.assert_array_equal(np.asarray(loader["w"]), w)
