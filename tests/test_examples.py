@@ -1,0 +1,51 @@
+"""Example smoke tests (role of reference tests/test_examples.py): every
+example must run end-to-end in tiny mode inside the virtual mesh."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLES = os.path.join(_ROOT, "examples")
+
+_ENV = {
+    **os.environ,
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+    "PYTHONPATH": _ROOT + os.pathsep + os.environ.get("PYTHONPATH", ""),
+}
+_ENV.pop("PALLAS_AXON_POOL_IPS", None)
+
+
+def _run(script, *args, timeout=420):
+    return subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, script), *args],
+        env=_ENV,
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+    )
+
+
+@pytest.mark.slow
+def test_nlp_example_tiny(tmp_path):
+    result = _run("nlp_example.py", "--tiny", "--epochs", "1", "--batch_size", "16")
+    assert result.returncode == 0, result.stderr[-2000:]
+    assert "epoch 0" in result.stdout
+
+
+@pytest.mark.slow
+def test_llama_finetune_tiny():
+    result = _run("llama_finetune.py", "--preset", "tiny", "--steps", "4", "--seq_len", "64")
+    assert result.returncode == 0, result.stderr[-2000:]
+    assert "tokens/s" in result.stdout
+
+
+@pytest.mark.slow
+def test_gradient_accumulation_example():
+    result = _run(os.path.join("by_feature", "gradient_accumulation.py"))
+    assert result.returncode == 0, result.stderr[-2000:]
+    assert "synced=True" in result.stdout
+    assert "synced=False" in result.stdout

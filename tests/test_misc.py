@@ -1,0 +1,144 @@
+"""Coverage for facade/utility surfaces not exercised elsewhere."""
+
+import logging
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.parallelism_config import ParallelismConfig
+from accelerate_tpu.utils.environment import (
+    clear_environment,
+    patch_environment,
+    str_to_bool,
+)
+
+
+def make_acc(**kw):
+    return Accelerator(parallelism_config=ParallelismConfig(dp_shard_size=8), **kw)
+
+
+def test_profile_context_writes_trace(tmp_path):
+    acc = make_acc(project_dir=str(tmp_path))
+    with acc.profile():
+        _ = jax.jit(lambda x: x * 2)(np.ones(8))
+    prof_dir = tmp_path / "profile"
+    assert prof_dir.exists()
+    # xplane trace files appear under plugins/profile/...
+    found = any("profile" in r for r, d, f in os.walk(prof_dir) for _ in f)
+    assert found
+
+
+def test_autocast_context_noop():
+    acc = make_acc(mixed_precision="bf16")
+    with acc.autocast():
+        pass
+
+
+def test_join_uneven_inputs_overrides_even_batches():
+    acc = make_acc()
+    data = {"x": np.arange(32.0)[:, None]}
+    loader = acc.prepare_data_loader(data, batch_size=8)
+    sampler = loader.batch_sampler
+    if sampler is not None and hasattr(sampler, "even_batches"):
+        with acc.join_uneven_inputs([None], even_batches=False):
+            assert sampler.even_batches is False
+        assert sampler.even_batches is True
+
+
+def test_multiprocess_adapter_logging(caplog):
+    from accelerate_tpu.logging import get_logger
+
+    logger = get_logger("test_logger", log_level="INFO")
+    with caplog.at_level(logging.INFO, logger="test_logger"):
+        logger.info("hello")
+    assert any("hello" in r.message for r in caplog.records)
+
+
+def test_patch_environment():
+    with patch_environment(my_test_var="42"):
+        assert os.environ["MY_TEST_VAR"] == "42"
+    assert "MY_TEST_VAR" not in os.environ
+
+
+def test_clear_environment():
+    os.environ["KEEP_ME"] = "1"
+    with clear_environment():
+        assert "KEEP_ME" not in os.environ
+    assert os.environ["KEEP_ME"] == "1"
+    del os.environ["KEEP_ME"]
+
+
+def test_str_to_bool():
+    assert str_to_bool("TRUE") == 1
+    assert str_to_bool("0") == 0
+    with pytest.raises(ValueError):
+        str_to_bool("maybe")
+
+
+def test_free_memory_clears_registries():
+    acc = make_acc()
+    from accelerate_tpu.test_utils.training import RegressionModel
+
+    model = acc.prepare(RegressionModel())
+    assert acc._models
+    acc.free_memory()
+    assert not acc._models
+
+
+def test_local_sgd_context():
+    from accelerate_tpu.local_sgd import LocalSGD
+
+    acc = make_acc()
+    with LocalSGD(acc, local_sgd_steps=2) as lsgd:
+        for _ in range(4):
+            lsgd.step()
+    assert lsgd._counter == 4
+
+
+def test_gradient_accumulation_plugin_validation():
+    from accelerate_tpu.utils.dataclasses import GradientAccumulationPlugin
+
+    with pytest.raises(ValueError):
+        GradientAccumulationPlugin(num_steps=0)
+
+
+def test_find_executable_batch_size_backoff():
+    from accelerate_tpu.utils.memory import find_executable_batch_size
+
+    attempts = []
+
+    @find_executable_batch_size(starting_batch_size=16)
+    def run(batch_size):
+        attempts.append(batch_size)
+        if batch_size > 4:
+            raise RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+        return batch_size
+
+    assert run() == 4
+    assert attempts == [16, 8, 4]
+
+
+def test_mixed_precision_policy_casts():
+    import jax.numpy as jnp
+
+    from accelerate_tpu.utils.dataclasses import MixedPrecisionPolicy
+
+    policy = MixedPrecisionPolicy.from_mixed_precision("bf16")
+    tree = {"w": jnp.ones(2, jnp.float32), "i": jnp.ones(2, jnp.int32)}
+    out = policy.cast_to_compute(tree)
+    assert out["w"].dtype == jnp.bfloat16
+    assert out["i"].dtype == jnp.int32
+    back = policy.cast_to_output(out)
+    assert back["w"].dtype == jnp.float32
+
+
+def test_kwargs_handler_to_kwargs():
+    from accelerate_tpu.utils.dataclasses import GradScalerKwargs
+
+    kw = GradScalerKwargs(init_scale=128.0)
+    assert kw.to_kwargs() == {"init_scale": 128.0}
