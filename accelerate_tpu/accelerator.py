@@ -112,13 +112,18 @@ class Accelerator:
             self.project_configuration.set_directories(project_dir)
 
         # kwargs handlers (reference accelerator.py:415-452)
+        from .utils.dataclasses import DistributedDataParallelKwargs
+
         self.scaler_kwargs = None
         self.mp_policy_override = None
+        self.ddp_handler = None
         for handler in kwargs_handlers or []:
             if isinstance(handler, GradScalerKwargs):
                 self.scaler_kwargs = handler
             elif isinstance(handler, MixedPrecisionPolicy):
                 self.mp_policy_override = handler
+            elif isinstance(handler, DistributedDataParallelKwargs):
+                self.ddp_handler = handler
             elif isinstance(handler, DataLoaderConfiguration) and dataloader_config is None:
                 dataloader_config = handler
             elif isinstance(handler, GradientAccumulationPlugin) and gradient_accumulation_plugin is None:
@@ -164,6 +169,8 @@ class Accelerator:
         self._custom_objects: list = []
         self._grad_fns: dict = {}
         self._fused_steps: dict = {}
+        self._save_state_pre_hooks: list = []
+        self._load_state_pre_hooks: list = []
 
         self.mesh = self.state.get_device_mesh()
 
@@ -391,12 +398,26 @@ class Accelerator:
         fn = self._grad_fns.get(key)
         if fn is None:
 
+            grad_dtype = self.ddp_handler.gradient_dtype if self.ddp_handler else None
+
             def wrapped(params, scale, *args, **kwargs):
                 out = loss_fn(model.bind(params), *args, **kwargs)
                 loss, aux = out if isinstance(out, tuple) else (out, None)
                 return loss * scale / num_steps, (loss, aux)
 
-            fn = jax.jit(jax.value_and_grad(wrapped, has_aux=True))
+            raw = jax.value_and_grad(wrapped, has_aux=True)
+            if grad_dtype is not None:
+                # gradient-compression comm hook analogue: reduce/accumulate
+                # gradients in the compressed dtype
+                def raw_compressed(*a, **k):
+                    val, grads = raw(*a, **k)
+                    return val, jax.tree_util.tree_map(
+                        lambda g: g.astype(grad_dtype), grads
+                    )
+
+                fn = jax.jit(raw_compressed)
+            else:
+                fn = jax.jit(raw)
             self._grad_fns[key] = fn
         return fn
 
@@ -721,14 +742,26 @@ class Accelerator:
             )
         self._custom_objects.extend(objects)
 
+    def register_save_state_pre_hook(self, hook: Callable) -> None:
+        """hook(models, weights_placeholder, output_dir) runs before
+        save_state writes (reference accelerator.py register_save_state_pre_hook)."""
+        self._save_state_pre_hooks.append(hook)
+
+    def register_load_state_pre_hook(self, hook: Callable) -> None:
+        self._load_state_pre_hooks.append(hook)
+
     def save_state(self, output_dir: Optional[str] = None, **save_kwargs) -> str:
         from .checkpointing import save_accelerator_state
 
+        for hook in self._save_state_pre_hooks:
+            hook(self._models, None, output_dir)
         return save_accelerator_state(self, output_dir, **save_kwargs)
 
     def load_state(self, input_dir: Optional[str] = None, **load_kwargs) -> None:
         from .checkpointing import load_accelerator_state
 
+        for hook in self._load_state_pre_hooks:
+            hook(self._models, input_dir)
         load_accelerator_state(self, input_dir, **load_kwargs)
 
     def save_model(self, model: Model, save_directory: str, max_shard_size: str = "10GB", safe_serialization: bool = True):

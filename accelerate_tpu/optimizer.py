@@ -153,6 +153,9 @@ class AcceleratedOptimizer:
         self.opt_state = jax.jit(self.tx.init)(model.params)
 
         def apply(params, opt_state, grads):
+            # grads may arrive in a compressed comm dtype (bf16/fp16 DDP
+            # comm-hook analogue); the update math runs in param dtype
+            grads = jax.tree_util.tree_map(lambda g, p: g.astype(p.dtype), grads, params)
             updates, new_opt_state = self.tx.update(grads, opt_state, params)
             import optax
 

@@ -54,6 +54,32 @@ class GradientAccumulationPlugin(KwargsHandler):
 
 
 @dataclass
+class DistributedDataParallelKwargs(KwargsHandler):
+    """DDP tuning knobs (reference DistributedDataParallelKwargs +
+    DDPCommunicationHookType, utils/dataclasses.py:136-242).
+
+    Most reference fields (bucket_cap_mb, static_graph, find_unused_parameters)
+    tune torch DDP's bucketed autograd hooks and have no GSPMD meaning — XLA
+    schedules gradient collectives itself. The surviving semantic is the
+    *communication hook*: compressing gradient reduction to bf16/fp16
+    (``comm_hook``), realized by casting gradients before accumulation/
+    reduction in the train step."""
+
+    comm_hook: str = "no"  # "no" | "bf16" | "fp16"
+    comm_wrapper: str = "no"  # parity placeholder (powerSGD not applicable)
+
+    def __post_init__(self):
+        if self.comm_hook not in ("no", "bf16", "fp16"):
+            raise ValueError(f"comm_hook must be no|bf16|fp16, got {self.comm_hook}")
+
+    @property
+    def gradient_dtype(self):
+        import jax.numpy as jnp
+
+        return {"no": None, "bf16": jnp.bfloat16, "fp16": jnp.float16}[self.comm_hook]
+
+
+@dataclass
 class AutocastKwargs(KwargsHandler):
     """Mixed-precision autocast knobs (reference utils/dataclasses.py:
     ``AutocastKwargs``): enabled flag + cache control is torch-specific, our

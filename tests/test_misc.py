@@ -142,3 +142,39 @@ def test_kwargs_handler_to_kwargs():
 
     kw = GradScalerKwargs(init_scale=128.0)
     assert kw.to_kwargs() == {"init_scale": 128.0}
+
+
+def test_ddp_comm_hook_bf16_grads():
+    import jax.numpy as jnp
+    import optax
+
+    from accelerate_tpu.utils.dataclasses import DistributedDataParallelKwargs
+    from accelerate_tpu.test_utils.training import RegressionModel, make_regression_data, regression_loss
+
+    acc = make_acc(kwargs_handlers=[DistributedDataParallelKwargs(comm_hook="bf16")])
+    model = RegressionModel()
+    model, opt = acc.prepare(model, optax.sgd(0.1))
+    data = make_regression_data(16)
+    loader = acc.prepare_data_loader(data, batch_size=16, drop_last=True)
+    for batch in loader:
+        with acc.accumulate(model):
+            acc.backward(regression_loss, batch)
+            assert opt.grads["a"].dtype == jnp.bfloat16  # compressed
+            opt.step()
+            opt.zero_grad()
+    assert abs(float(model.params["a"])) > 0
+
+
+def test_save_load_state_hooks(tmp_path):
+    import optax
+
+    from accelerate_tpu.test_utils.training import RegressionModel
+
+    acc = make_acc(project_dir=str(tmp_path))
+    calls = []
+    acc.register_save_state_pre_hook(lambda models, w, d: calls.append(("save", d)))
+    acc.register_load_state_pre_hook(lambda models, d: calls.append(("load", d)))
+    model, opt = acc.prepare(RegressionModel(), optax.sgd(0.1))
+    acc.save_state(str(tmp_path / "ckpt"))
+    acc.load_state(str(tmp_path / "ckpt"))
+    assert [c[0] for c in calls] == ["save", "load"]
