@@ -39,6 +39,7 @@ from .scheduler import AcceleratedScheduler
 from .state import AcceleratorState, DistributedType, GradientState, PartialState
 from .utils.dataclasses import (
     DataLoaderConfiguration,
+    DistributedDataParallelKwargs,
     GradientAccumulationPlugin,
     GradScalerKwargs,
     KwargsHandler,
@@ -112,8 +113,6 @@ class Accelerator:
             self.project_configuration.set_directories(project_dir)
 
         # kwargs handlers (reference accelerator.py:415-452)
-        from .utils.dataclasses import DistributedDataParallelKwargs
-
         self.scaler_kwargs = None
         self.mp_policy_override = None
         self.ddp_handler = None
@@ -542,6 +541,7 @@ class Accelerator:
         k = int(self.gradient_state.num_steps)
         tx = optimizer.tx
         use_scaler = self.scaler is not None
+        grad_comm_dtype = self.ddp_handler.gradient_dtype if self.ddp_handler else None
 
         def fused(params, opt_state, accum, count, scaler_state, *batch):
             def wrapped(p):
@@ -551,6 +551,12 @@ class Accelerator:
                 return loss * scale / k, (loss, aux)
 
             (_, (loss, _aux)), grads = jax.value_and_grad(wrapped, has_aux=True)(params)
+            if grad_comm_dtype is not None:
+                # comm-hook compression: gradients reduce/accumulate in the
+                # compressed dtype (same semantic as the eager path)
+                grads = jax.tree_util.tree_map(
+                    lambda g: g.astype(grad_comm_dtype), grads
+                )
             accum = jax.tree_util.tree_map(jnp.add, accum, grads) if k > 1 else grads
             new_count = count + 1
             do_update = (new_count % k) == 0 if k > 1 else jnp.bool_(True)
@@ -558,6 +564,10 @@ class Accelerator:
             def apply_branch(operand):
                 params, opt_state, accum, scaler_state = operand
                 g = accum
+                if grad_comm_dtype is not None:
+                    g = jax.tree_util.tree_map(
+                        lambda x, p: x.astype(p.dtype), g, params
+                    )
                 if use_scaler:
                     inv = 1.0 / scaler_state["scale"]
                     g = jax.tree_util.tree_map(lambda x: x * inv, g)
@@ -619,9 +629,13 @@ class Accelerator:
         donate_args = (0, 1, 2) if donate else ()
         compiled = jax.jit(target, donate_argnums=donate_args)
 
-        zeros_accum = jax.tree_util.tree_map(jnp.zeros_like, model.params) if k > 1 else model.params
+        accum_dtype_of = (
+            (lambda p: grad_comm_dtype) if grad_comm_dtype is not None else (lambda p: p.dtype)
+        )
         state = {
-            "accum": jax.tree_util.tree_map(jnp.zeros_like, model.params),
+            "accum": jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, dtype=accum_dtype_of(p)), model.params
+            ),
             "count": jnp.int32(0),
             "scaler": self.scaler.state if use_scaler else {"scale": jnp.float32(1.0), "good_steps": jnp.int32(0)},
         }
@@ -751,15 +765,17 @@ class Accelerator:
         self._load_state_pre_hooks.append(hook)
 
     def save_state(self, output_dir: Optional[str] = None, **save_kwargs) -> str:
-        from .checkpointing import save_accelerator_state
+        from .checkpointing import _resolve_dir, save_accelerator_state
 
+        output_dir = _resolve_dir(self, output_dir, for_save=True)
         for hook in self._save_state_pre_hooks:
             hook(self._models, None, output_dir)
         return save_accelerator_state(self, output_dir, **save_kwargs)
 
     def load_state(self, input_dir: Optional[str] = None, **load_kwargs) -> None:
-        from .checkpointing import load_accelerator_state
+        from .checkpointing import _resolve_dir, load_accelerator_state
 
+        input_dir = _resolve_dir(self, input_dir, for_save=False)
         for hook in self._load_state_pre_hooks:
             hook(self._models, input_dir)
         load_accelerator_state(self, input_dir, **load_kwargs)

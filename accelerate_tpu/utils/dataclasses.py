@@ -71,6 +71,11 @@ class DistributedDataParallelKwargs(KwargsHandler):
     def __post_init__(self):
         if self.comm_hook not in ("no", "bf16", "fp16"):
             raise ValueError(f"comm_hook must be no|bf16|fp16, got {self.comm_hook}")
+        if self.comm_wrapper != "no":
+            raise ValueError(
+                "comm_wrapper variants (e.g. powerSGD) are torch-DDP bucket "
+                f"machinery with no GSPMD analogue; got {self.comm_wrapper!r}"
+            )
 
     @property
     def gradient_dtype(self):

@@ -178,3 +178,59 @@ def test_save_load_state_hooks(tmp_path):
     acc.save_state(str(tmp_path / "ckpt"))
     acc.load_state(str(tmp_path / "ckpt"))
     assert [c[0] for c in calls] == ["save", "load"]
+
+
+def test_ddp_comm_hook_fused_path():
+    import jax.numpy as jnp
+    import optax
+
+    from accelerate_tpu.utils.dataclasses import DistributedDataParallelKwargs
+    from accelerate_tpu.test_utils.training import RegressionModel, make_regression_data, regression_loss
+
+    acc = make_acc(kwargs_handlers=[DistributedDataParallelKwargs(comm_hook="bf16")])
+    model, opt = acc.prepare(RegressionModel(), optax.sgd(0.1))
+    step = acc.train_step(regression_loss)
+    data = make_regression_data(16)
+    loader = acc.prepare_data_loader(data, batch_size=16, drop_last=True)
+    for batch in loader:
+        loss = step(batch)
+    import numpy as np
+
+    assert np.isfinite(float(loss))
+    assert abs(float(model.params["a"])) > 0
+
+
+def test_hooks_receive_resolved_dir(tmp_path):
+    import optax
+
+    from accelerate_tpu.utils.dataclasses import ProjectConfiguration
+    from accelerate_tpu.test_utils.training import RegressionModel
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.parallelism_config import ParallelismConfig
+
+    acc = Accelerator(
+        parallelism_config=ParallelismConfig(dp_shard_size=8),
+        project_config=ProjectConfiguration(
+            project_dir=str(tmp_path), automatic_checkpoint_naming=True
+        ),
+    )
+    seen = []
+    acc.register_save_state_pre_hook(lambda m, w, d: seen.append(d))
+    acc.register_load_state_pre_hook(lambda m, d: seen.append(d))
+    model, opt = acc.prepare(RegressionModel(), optax.sgd(0.1))
+    acc.save_state()  # no explicit dir
+    acc.load_state()
+    assert seen[0] is not None and "checkpoint_0" in seen[0]
+    assert seen[1] is not None and "checkpoint_0" in seen[1]
+
+
+def test_comm_wrapper_rejected():
+    from accelerate_tpu.utils.dataclasses import DistributedDataParallelKwargs
+
+    with pytest.raises(ValueError, match="comm_wrapper"):
+        DistributedDataParallelKwargs(comm_wrapper="power_sgd")
