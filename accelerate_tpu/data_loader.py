@@ -87,6 +87,58 @@ def _is_torch_loader(obj) -> bool:
         return False
 
 
+def data_shard_info(
+    sharding: NamedSharding,
+    process_index: Optional[int] = None,
+    num_processes: Optional[int] = None,
+    process_of_device: Optional[Callable] = None,
+) -> tuple[int, int, int]:
+    """Mesh-aware data-shard math: which slice of the batch dim must THIS
+    process read, given that non-data axes (tp/cp/sp/pp) may span processes
+    that therefore need IDENTICAL rows (reference data_loader.py:1129-1165
+    derives effective process_index/num_processes from the device mesh).
+
+    Returns (num_shards, shard_index, rows_per_shard_factor) where the
+    dataset is read in ``num_shards`` distinct slices and this process reads
+    slice ``shard_index``; each slice covers ``rows_per_shard_factor`` of the
+    per-process batch rows (== local dp rows).
+    """
+    state = PartialState()
+    process_index = state.process_index if process_index is None else process_index
+    num_processes = state.num_processes if num_processes is None else num_processes
+    if process_of_device is None:
+        process_of_device = lambda d: d.process_index
+    mesh = sharding.mesh
+    spec0 = sharding.spec[0] if len(sharding.spec) else None
+    axes = () if spec0 is None else ((spec0,) if isinstance(spec0, str) else tuple(spec0))
+    n_rows = 1
+    for a in axes:
+        n_rows *= mesh.shape[a]
+    if n_rows <= 1 or num_processes <= 1:
+        return 1, 0, 1
+    # map each dim-0 row block to the set of processes whose devices own it
+    idx_map = sharding.devices_indices_map((n_rows,))
+    proc_rows: dict[int, set] = {}
+    for dev, slices in idx_map.items():
+        sl = slices[0]
+        start = sl.start or 0
+        stop = sl.stop if sl.stop is not None else n_rows
+        proc_rows.setdefault(process_of_device(dev), set()).update(range(start, stop))
+    # group processes by identical row sets → distinct data shards
+    groups: dict[frozenset, list[int]] = {}
+    for proc, rows in proc_rows.items():
+        groups.setdefault(frozenset(rows), []).append(proc)
+    ordered = sorted(groups.items(), key=lambda kv: min(kv[0]))
+    num_shards = len(ordered)
+    shard_index = 0
+    for i, (rows, procs) in enumerate(ordered):
+        if process_index in procs:
+            shard_index = i
+            break
+    rows_per_shard = n_rows // num_shards
+    return num_shards, shard_index, rows_per_shard
+
+
 # --------------------------------------------------------------------- sampler
 class SeedableRandomSampler:
     """Deterministic shuffling sampler: reseeds with ``seed + epoch`` each
@@ -379,6 +431,11 @@ class _BaseAcceleratedLoader:
         state = PartialState()
         n_shards = self._data_axes_size
 
+        if state.num_processes > 1 and not hasattr(self, "_num_row_shards"):
+            # distinct row slices being read across processes — processes
+            # spanned by tp/cp read the SAME rows, so this can be < n_proc
+            self._num_row_shards = data_shard_info(self.sharding)[0]
+
         def put(t):
             t = np.asarray(t)
             if t.ndim >= 1 and t.shape[0] % n_shards != 0:
@@ -386,7 +443,7 @@ class _BaseAcceleratedLoader:
                 t = np.concatenate([t, np.repeat(t[-1:], missing, axis=0)], axis=0)
             sharding = self._leaf_sharding(t)
             if state.num_processes > 1:
-                global_shape = (t.shape[0] * state.num_processes,) + t.shape[1:]
+                global_shape = (t.shape[0] * self._num_row_shards,) + t.shape[1:]
                 return jax.make_array_from_process_local_data(sharding, t, global_shape)
             return jax.device_put(t, sharding)
 
@@ -666,8 +723,14 @@ def prepare_data_loader(
 
     # Data sharding happens at process granularity (each process feeds its
     # local devices); single-process SPMD feeds the whole global batch.
-    num_shards = state.num_processes
-    shard_index = state.process_index
+    # The shard index comes from the MESH, not the raw process index:
+    # processes spanned by tp/cp/pp axes must read identical rows
+    # (reference data_loader.py:1129-1165).
+    if sharding is not None and state.num_processes > 1:
+        num_shards, shard_index, _ = data_shard_info(sharding)
+    else:
+        num_shards = state.num_processes
+        shard_index = state.process_index
     if dispatch_batches is None:
         dispatch_batches = False
 

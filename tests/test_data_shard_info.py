@@ -1,0 +1,67 @@
+"""Mesh-aware data-shard math (multihost TP/CP correctness) with synthetic
+process→device mappings (real multihost can't run in one test process)."""
+
+import numpy as np
+import pytest
+
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from accelerate_tpu.data_loader import data_shard_info
+from accelerate_tpu.parallelism_config import ParallelismConfig
+
+
+def _mesh(**sizes):
+    return ParallelismConfig(**sizes).build_device_mesh()
+
+
+def _proc_of_device_factory(mesh, n_procs):
+    """Assign the mesh's devices to n_procs fake processes in id order."""
+    devices = sorted(mesh.devices.flatten().tolist(), key=lambda d: d.id)
+    per = len(devices) // n_procs
+    mapping = {d.id: i // per for i, d in enumerate(devices)}
+    return lambda d: mapping[d.id]
+
+
+def test_pure_dp_each_process_distinct_rows():
+    mesh = _mesh(dp_shard_size=8)
+    sharding = NamedSharding(mesh, P(("dp_shard",)))
+    proc_of = _proc_of_device_factory(mesh, 4)
+    shards = [
+        data_shard_info(sharding, process_index=p, num_processes=4, process_of_device=proc_of)
+        for p in range(4)
+    ]
+    # 4 processes × 2 devices each, batch dim fully dp → 4 distinct shards
+    assert [s[0] for s in shards] == [4] * 4
+    assert sorted(s[1] for s in shards) == [0, 1, 2, 3]
+
+
+def test_tp_spanning_processes_share_rows():
+    # tp innermost (contiguous devices) with 4 processes of 2 devices each:
+    # each process's 2 devices are the 2 tp ranks of ONE dp row → 4 shards;
+    # but with tp=4 spanning two processes, pairs of processes share rows.
+    mesh = _mesh(dp_shard_size=2, tp_size=4)
+    sharding = NamedSharding(mesh, P(("dp_shard",)))
+    proc_of = _proc_of_device_factory(mesh, 4)
+    shards = [
+        data_shard_info(sharding, process_index=p, num_processes=4, process_of_device=proc_of)
+        for p in range(4)
+    ]
+    # batch dim has 2 rows; processes 0,1 own row 0 (tp ranks), 2,3 own row 1
+    assert [s[0] for s in shards] == [2, 2, 2, 2]
+    assert [s[1] for s in shards] == [0, 0, 1, 1]
+
+
+def test_replicated_batch_single_shard():
+    mesh = _mesh(tp_size=8)
+    sharding = NamedSharding(mesh, P())  # batch replicated
+    proc_of = _proc_of_device_factory(mesh, 4)
+    num, idx, _ = data_shard_info(
+        sharding, process_index=2, num_processes=4, process_of_device=proc_of
+    )
+    assert (num, idx) == (1, 0)
+
+
+def test_single_process_trivial():
+    mesh = _mesh(dp_shard_size=8)
+    sharding = NamedSharding(mesh, P(("dp_shard",)))
+    assert data_shard_info(sharding) == (1, 0, 1)
