@@ -659,6 +659,21 @@ class Accelerator:
 
         return step
 
+    def eval_step(self, eval_fn: Callable, model: Optional[Model] = None) -> Callable:
+        """Compiled forward-only step: ``eval_fn(model_view, *batch)`` jitted
+        over the current params (no donation — params are reused)."""
+        model = model or self._models[-1]
+
+        def fused(params, *batch):
+            return eval_fn(model.bind(params), *batch)
+
+        compiled = jax.jit(fused)
+
+        def step(*batch):
+            return compiled(model.params, *batch)
+
+        return step
+
     # ------------------------------------------------------------ collectives
     def gather(self, tensor):
         from .ops.operations import gather

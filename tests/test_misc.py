@@ -234,3 +234,22 @@ def test_comm_wrapper_rejected():
 
     with pytest.raises(ValueError, match="comm_wrapper"):
         DistributedDataParallelKwargs(comm_wrapper="power_sgd")
+
+
+def test_eval_step():
+    import optax
+
+    from accelerate_tpu.test_utils.training import RegressionModel, make_regression_data
+
+    acc = make_acc()
+    model = acc.prepare(RegressionModel(a=2.0, b=3.0))
+    ev = acc.eval_step(lambda m, batch: m(batch["x"]))
+    data = make_regression_data(16)
+    loader = acc.prepare_data_loader(data, batch_size=16, drop_last=True)
+    for batch in loader:
+        preds = ev(batch)
+    import numpy as np
+
+    np.testing.assert_allclose(
+        np.asarray(preds).ravel(), np.asarray(batch["y"]).ravel() if hasattr(batch["y"], "ravel") else np.asarray(batch["y"]), atol=1e-5
+    )
