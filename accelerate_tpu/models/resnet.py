@@ -1,0 +1,150 @@
+"""ResNet-style ConvNet for image classification.
+
+The reference's CV workload (``examples/cv_example.py``: ResNet50 on pets,
+bf16 — a BASELINE.json driver config). TPU-first choices:
+
+* GroupNorm instead of BatchNorm — stateless, so the model stays a pure
+  (params, x) → logits function (no running-stat threading), and it is the
+  norm that actually behaves under heavy data-parallel sharding (BatchNorm's
+  per-replica statistics are a classic DDP divergence trap);
+* NHWC layout (XLA:TPU's native conv layout);
+* bf16 compute / fp32 params, fp32 logits.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..model import Model
+
+__all__ = ["ResNetConfig", "init_resnet_params", "resnet_apply", "create_resnet", "resnet_classification_loss"]
+
+
+@dataclasses.dataclass
+class ResNetConfig:
+    num_classes: int = 1000
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)  # resnet50 layout
+    widths: Sequence[int] = (64, 128, 256, 512)
+    stem_width: int = 64
+    groups: int = 32
+    param_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.bfloat16
+
+    @classmethod
+    def resnet50(cls, num_classes: int = 1000, **overrides) -> "ResNetConfig":
+        return cls(num_classes=num_classes, **overrides)
+
+    @classmethod
+    def tiny(cls, **overrides) -> "ResNetConfig":
+        return cls(**{**dict(
+            num_classes=10, stage_sizes=(1, 1), widths=(8, 16), stem_width=8, groups=4,
+        ), **overrides})
+
+
+def _conv_init(key, kh, kw, cin, cout, dtype):
+    fan_in = kh * kw * cin
+    return (jax.random.normal(key, (kh, kw, cin, cout)) * np.sqrt(2.0 / fan_in)).astype(dtype)
+
+
+def init_resnet_params(config: ResNetConfig, key: jax.Array) -> dict:
+    dt = config.param_dtype
+    keys = iter(jax.random.split(key, 256))
+
+    def gn(c):
+        return {"scale": jnp.ones((c,), dt), "bias": jnp.zeros((c,), dt)}
+
+    params: dict = {
+        "stem": {"conv": _conv_init(next(keys), 7, 7, 3, config.stem_width, dt), "norm": gn(config.stem_width)}
+    }
+    cin = config.stem_width
+    for si, (n_blocks, width) in enumerate(zip(config.stage_sizes, config.widths)):
+        stage = []
+        for bi in range(n_blocks):
+            block = {
+                "conv1": _conv_init(next(keys), 3, 3, cin if bi == 0 else width, width, dt),
+                "norm1": gn(width),
+                "conv2": _conv_init(next(keys), 3, 3, width, width, dt),
+                "norm2": gn(width),
+            }
+            if bi == 0 and cin != width:
+                block["proj"] = _conv_init(next(keys), 1, 1, cin, width, dt)
+            stage.append(block)
+        params[f"stage{si}"] = stage
+        cin = width
+    params["classifier"] = {
+        "kernel": (jax.random.normal(next(keys), (cin, config.num_classes)) * 0.01).astype(dt),
+        "bias": jnp.zeros((config.num_classes,), dt),
+    }
+    return params
+
+
+def group_norm(x, scale, bias, groups, eps=1e-5):
+    b, h, w, c = x.shape
+    g = min(groups, c)
+    x32 = x.astype(jnp.float32).reshape(b, h, w, g, c // g)
+    mean = jnp.mean(x32, axis=(1, 2, 4), keepdims=True)
+    var = jnp.var(x32, axis=(1, 2, 4), keepdims=True)
+    x32 = (x32 - mean) * lax.rsqrt(var + eps)
+    x32 = x32.reshape(b, h, w, c)
+    return (x32 * scale.astype(jnp.float32) + bias.astype(jnp.float32)).astype(x.dtype)
+
+
+def _conv(x, kernel, stride=1):
+    return lax.conv_general_dilated(
+        x,
+        kernel.astype(x.dtype),
+        window_strides=(stride, stride),
+        padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def resnet_apply(config: ResNetConfig, params: dict, images: jax.Array) -> jax.Array:
+    """(B, H, W, 3) float images → (B, num_classes) fp32 logits."""
+    cdt = config.compute_dtype
+    x = images.astype(cdt)
+    x = _conv(x, params["stem"]["conv"], stride=2)
+    x = group_norm(x, params["stem"]["norm"]["scale"], params["stem"]["norm"]["bias"], config.groups)
+    x = jax.nn.relu(x)
+    x = lax.reduce_window(x, -jnp.inf, lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME")
+
+    for si, n_blocks in enumerate(config.stage_sizes):
+        for bi in range(n_blocks):
+            block = params[f"stage{si}"][bi]
+            stride = 2 if (bi == 0 and si > 0) else 1
+            residual = x
+            y = _conv(x, block["conv1"], stride=stride)
+            y = group_norm(y, block["norm1"]["scale"], block["norm1"]["bias"], config.groups)
+            y = jax.nn.relu(y)
+            y = _conv(y, block["conv2"])
+            y = group_norm(y, block["norm2"]["scale"], block["norm2"]["bias"], config.groups)
+            if "proj" in block:
+                residual = _conv(residual, block["proj"], stride=stride)
+            elif stride != 1:
+                residual = residual[:, ::stride, ::stride, :]
+            x = jax.nn.relu(residual + y)
+
+    x = jnp.mean(x, axis=(1, 2))  # global average pool
+    logits = x @ params["classifier"]["kernel"].astype(cdt) + params["classifier"]["bias"].astype(cdt)
+    return logits.astype(jnp.float32)
+
+
+def create_resnet(config: ResNetConfig, seed: int = 0) -> Model:
+    params = init_resnet_params(config, jax.random.key(seed))
+    model = Model(functools.partial(resnet_apply, config), params, name="resnet")
+    model.config = config
+    return model
+
+
+def resnet_classification_loss(model_view, batch):
+    logits = model_view(batch["image"])
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.mean(jnp.take_along_axis(logp, batch["label"][:, None], axis=-1))
