@@ -52,23 +52,25 @@ def main():
     from accelerate_tpu.parallelism_config import ParallelismConfig
     from accelerate_tpu.utils.memory import find_executable_batch_size
 
+    import os
+
     device = jax.devices()[0]
     on_tpu = device.platform == "tpu"
-    seq_len = 2048 if on_tpu else 128
+    seq_len = int(os.environ.get("BENCH_SEQ", 2048 if on_tpu else 128))
     if on_tpu:
         config = LlamaConfig(
             vocab_size=32000,
-            hidden_size=1024,
-            intermediate_size=2816,
-            num_hidden_layers=16,
+            hidden_size=int(os.environ.get("BENCH_HIDDEN", 1024)),
+            intermediate_size=int(os.environ.get("BENCH_INTER", 2816)),
+            num_hidden_layers=int(os.environ.get("BENCH_LAYERS", 16)),
             num_attention_heads=16,
             num_key_value_heads=16,
             max_position_embeddings=seq_len,
-            remat_policy="nothing",
-            attention_impl="blockwise",
+            remat_policy=os.environ.get("BENCH_REMAT", "minimal"),
+            attention_impl=os.environ.get("BENCH_ATTN", "blockwise"),
         )
-        starting_batch = 8
-        steps = 16
+        starting_batch = int(os.environ.get("BENCH_BATCH", 8))
+        steps = int(os.environ.get("BENCH_STEPS", 16))
         warmup = 1
     else:  # CPU smoke mode
         config = LlamaConfig.tiny(max_position_embeddings=seq_len)

@@ -253,3 +253,48 @@ def test_eval_step():
     np.testing.assert_allclose(
         np.asarray(preds).ravel(), np.asarray(batch["y"]).ravel() if hasattr(batch["y"], "ravel") else np.asarray(batch["y"]), atol=1e-5
     )
+
+
+def test_no_sync_context_blocks_step():
+    import optax
+
+    from accelerate_tpu.test_utils.training import RegressionModel, make_regression_data, regression_loss
+
+    acc = make_acc()
+    model, opt = acc.prepare(RegressionModel(), optax.sgd(0.1))
+    data = make_regression_data(16)
+    loader = acc.prepare_data_loader(data, batch_size=16, drop_last=True)
+    (batch,) = list(loader)
+    with acc.no_sync(model):
+        acc.backward(regression_loss, batch)
+        opt.step()
+    assert opt.step_was_skipped
+    assert float(model.params["a"]) == 0.0
+    # outside no_sync the same grads apply
+    acc.gradient_state._set_sync_gradients(True)
+    opt.step()
+    assert not opt.step_was_skipped
+    assert float(model.params["a"]) != 0.0
+
+
+def test_multiple_models_checkpoint_suffixes(tmp_path):
+    import os
+
+    import optax
+
+    from accelerate_tpu.test_utils.training import RegressionModel
+
+    acc = make_acc(project_dir=str(tmp_path))
+    m1 = acc.prepare(RegressionModel(a=1.0))
+    m2 = acc.prepare(RegressionModel(a=2.0))
+    o1 = acc.prepare_optimizer(optax.sgd(0.1))
+    ckpt = acc.save_state(str(tmp_path / "ckpt"))
+    assert os.path.isdir(os.path.join(ckpt, "model"))
+    assert os.path.isdir(os.path.join(ckpt, "model_1"))
+    import jax.numpy as jnp
+
+    m1.params = {"a": jnp.float32(0.0), "b": jnp.float32(0.0)}
+    m2.params = {"a": jnp.float32(0.0), "b": jnp.float32(0.0)}
+    acc.load_state(str(tmp_path / "ckpt"))
+    assert float(m1.params["a"]) == 1.0
+    assert float(m2.params["a"]) == 2.0
