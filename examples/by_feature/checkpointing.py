@@ -1,0 +1,57 @@
+"""Checkpoint/resume example (reference examples/by_feature/checkpointing.py):
+save_state every epoch, then resume mid-training with skip_first_batches."""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.models.bert import BertConfig, bert_classification_loss, create_bert
+
+
+def build(accelerator, data, batch_size):
+    cfg = BertConfig.tiny()
+    model = create_bert(cfg, seed=0)
+    loader = accelerator.prepare_data_loader(data, batch_size=batch_size, drop_last=True)
+    model, optimizer = accelerator.prepare(model, optax.adamw(1e-3))
+    return model, optimizer, loader
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--output_dir", default="runs/checkpointing")
+    parser.add_argument("--resume_from_checkpoint", default=None)
+    parser.add_argument("--epochs", type=int, default=2)
+    args = parser.parse_args()
+
+    accelerator = Accelerator(project_dir=args.output_dir)
+    rng = np.random.default_rng(0)
+    cfg = BertConfig.tiny()
+    data = {
+        "input_ids": rng.integers(0, cfg.vocab_size, size=(64, 32)).astype(np.int32),
+        "labels": rng.integers(0, 2, size=(64,)).astype(np.int32),
+    }
+    model, optimizer, loader = build(accelerator, data, batch_size=16)
+
+    start_epoch = 0
+    if args.resume_from_checkpoint:
+        accelerator.load_state(args.resume_from_checkpoint)
+        start_epoch = accelerator.step  # stored by save_state
+
+    for epoch in range(start_epoch, args.epochs):
+        loader.set_epoch(epoch)
+        for batch in loader:
+            with accelerator.accumulate(model):
+                loss = accelerator.backward(bert_classification_loss, batch)
+                optimizer.step()
+                optimizer.zero_grad()
+        accelerator.step = epoch + 1
+        ckpt = accelerator.save_state(f"{args.output_dir}/epoch_{epoch}")
+        accelerator.print(f"epoch {epoch}: loss={float(loss):.4f} saved={ckpt}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,40 @@
+"""Distributed eval metrics (reference examples/by_feature/
+multi_process_metrics.py): gather_for_metrics drops the duplicated samples
+batch padding introduces, so metrics see each sample exactly once."""
+
+from __future__ import annotations
+
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.models.bert import BertConfig, bert_classification_loss, create_bert
+
+
+def main():
+    accelerator = Accelerator()
+    cfg = BertConfig.tiny()
+    model = create_bert(cfg, seed=0)
+    rng = np.random.default_rng(0)
+    n_eval = 52  # deliberately NOT divisible by the global batch
+    data = {
+        "input_ids": rng.integers(0, cfg.vocab_size, size=(n_eval, 32)).astype(np.int32),
+        "labels": rng.integers(0, 2, size=(n_eval,)).astype(np.int32),
+    }
+    model = accelerator.prepare(model)
+    loader = accelerator.prepare_data_loader(data, batch_size=16)
+    eval_step = accelerator.eval_step(lambda m, b: m(b["input_ids"])[0].argmax(-1))
+
+    all_preds, all_labels = [], []
+    for batch in loader:
+        preds = eval_step(batch)
+        all_preds.append(np.asarray(accelerator.gather_for_metrics(preds)))
+        all_labels.append(np.asarray(accelerator.gather_for_metrics(batch["labels"])))
+    preds = np.concatenate(all_preds)
+    labels = np.concatenate(all_labels)
+    assert len(preds) == n_eval, f"duplicates not dropped: {len(preds)} != {n_eval}"
+    accelerator.print(f"accuracy over exactly {len(preds)} samples: {(preds == labels).mean():.3f}")
+
+
+if __name__ == "__main__":
+    main()

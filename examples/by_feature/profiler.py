@@ -1,0 +1,44 @@
+"""Profiler example (reference examples/by_feature/profiler.py): capture an
+XLA trace of a few training steps, viewable in TensorBoard/Perfetto."""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.models.llama import LlamaConfig, create_llama, llama_loss
+from accelerate_tpu.utils.dataclasses import ProfileKwargs
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--trace_dir", default="runs/profile")
+    args = parser.parse_args()
+
+    handler = ProfileKwargs(
+        output_trace_dir=args.trace_dir,
+        on_trace_ready=lambda d: print(f"trace written to {d}"),
+    )
+    accelerator = Accelerator(kwargs_handlers=[handler])
+    cfg = LlamaConfig.tiny()
+    model = create_llama(cfg)
+    model, optimizer = accelerator.prepare(model, optax.adamw(1e-3))
+    step = accelerator.train_step(llama_loss)
+
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, cfg.vocab_size, size=(8, 64)).astype(np.int32)}
+    loader = accelerator.prepare_data_loader(batch, batch_size=8, drop_last=True)
+    (device_batch,) = list(loader)
+
+    step(device_batch)  # compile outside the trace
+    with accelerator.profile(handler):
+        for _ in range(3):
+            loss = step(device_batch)
+    accelerator.print(f"profiled 3 steps, loss={float(loss):.4f}")
+
+
+if __name__ == "__main__":
+    main()
