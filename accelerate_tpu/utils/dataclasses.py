@@ -247,7 +247,9 @@ class ContextParallelConfig(KwargsHandler):
 
     ``rotate_method``: "allgather" gathers all KV once; "alltoall" rotates KV
     shards around the cp ring (ring attention) — same vocabulary as the
-    reference's ``set_rotate_method``.
+    reference's ``set_rotate_method``; "zigzag" additionally balances causal
+    work across ranks (each holds one early + one late sequence chunk) for
+    ~2× causal ring efficiency — no reference equivalent.
     """
 
     rotate_method: str = "alltoall"
@@ -255,8 +257,10 @@ class ContextParallelConfig(KwargsHandler):
     causal: bool = True
 
     def __post_init__(self):
-        if self.rotate_method not in ("allgather", "alltoall"):
-            raise ValueError(f"rotate_method must be allgather|alltoall, got {self.rotate_method}")
+        if self.rotate_method not in ("allgather", "alltoall", "zigzag"):
+            raise ValueError(
+                f"rotate_method must be allgather|alltoall|zigzag, got {self.rotate_method}"
+            )
 
 
 @dataclass

@@ -133,3 +133,60 @@ def test_llama_sp_training_runs():
                 losses.append(float(loss))
     assert all(np.isfinite(losses))
     assert losses[-1] < losses[0]
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_zigzag_ring_matches_reference(causal):
+    cfg = ParallelismConfig(cp_size=8)
+    mesh = cfg.build_device_mesh()
+    q, k, v = _qkv()
+    ref = dot_product_attention(q, k, v, causal=causal)
+    ring = make_ring_attention(mesh, rotate_method="zigzag")
+    out = jax.jit(lambda q, k, v: ring(q, k, v, causal=causal))(q, k, v)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), atol=1e-5)
+
+
+def test_zigzag_gqa_and_grads():
+    cfg = ParallelismConfig(cp_size=4, dp_shard_size=2)
+    mesh = cfg.build_device_mesh()
+    q, k, v = _qkv(h=8, kvh=2)
+    ring = make_ring_attention(mesh, rotate_method="zigzag")
+    ref = dot_product_attention(q, k, v, causal=True)
+    out = jax.jit(lambda q, k, v: ring(q, k, v, causal=True))(q, k, v)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), atol=1e-5)
+
+    ref_grads = jax.grad(
+        lambda q, k, v: jnp.sum(dot_product_attention(q, k, v, causal=True) ** 2),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    grads = jax.jit(
+        jax.grad(lambda q, k, v: jnp.sum(ring(q, k, v, causal=True) ** 2), argnums=(0, 1, 2))
+    )(q, k, v)
+    for g, r in zip(grads, ref_grads):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r), atol=1e-4)
+
+
+def test_zigzag_llama_training():
+    from accelerate_tpu.models.llama import LlamaConfig, create_llama, llama_loss
+    from accelerate_tpu.utils.dataclasses import ContextParallelConfig
+
+    pcfg = ParallelismConfig(
+        dp_shard_size=2, cp_size=4, cp_config=ContextParallelConfig(rotate_method="zigzag")
+    )
+    acc = Accelerator(parallelism_config=pcfg)
+    cfg = LlamaConfig.tiny()
+    model = create_llama(cfg, seed=0)
+    model, opt = acc.prepare(model, optax.adamw(1e-3))
+    rng = np.random.default_rng(0)
+    data = {"input_ids": rng.integers(0, 256, size=(8, 64)).astype(np.int32)}
+    loader = acc.prepare_data_loader(data, batch_size=8, drop_last=True)
+    losses = []
+    for _ in range(3):
+        for batch in loader:
+            with acc.accumulate(model):
+                loss = acc.backward(llama_loss, batch)
+                opt.step()
+                opt.zero_grad()
+                losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
