@@ -49,25 +49,32 @@ def _fwd_kernel(q_ref, k_ref, v_ref, out_ref, lse_ref, acc_ref, m_ref, l_ref, *,
         m_ref[:] = jnp.full_like(m_ref, NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    q = q_ref[0].astype(jnp.float32) * scale  # (bq, d)
-    k = k_ref[0].astype(jnp.float32)  # (bk, d)
-    v = v_ref[0].astype(jnp.float32)
+    # causal grid pruning: skip blocks strictly above the diagonal (the MXU
+    # work is predicated out; block DMAs still occur — acceptable, compute
+    # dominates at these tile sizes)
+    visible = (j * block_k <= i * block_q + block_q - 1) if causal else True
 
-    s = q @ k.T  # (bq, bk) on the MXU
-    if causal:
-        q_pos = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + i * block_q
-        k_pos = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + j * block_k
-        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    @pl.when(visible)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale  # (bq, d)
+        k = k_ref[0].astype(jnp.float32)  # (bk, d)
+        v = v_ref[0].astype(jnp.float32)
 
-    m_prev = m_ref[:, 0]
-    l_prev = l_ref[:, 0]
-    m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1))
-    alpha = jnp.exp(m_prev - m_cur)
-    p = jnp.exp(s - m_cur[:, None])
-    l_cur = alpha * l_prev + jnp.sum(p, axis=-1)
-    acc_ref[:] = acc_ref[:] * alpha[:, None] + p @ v
-    m_ref[:, 0] = m_cur
-    l_ref[:, 0] = l_cur
+        s = q @ k.T  # (bq, bk) on the MXU
+        if causal:
+            q_pos = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + i * block_q
+            k_pos = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + j * block_k
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+
+        m_prev = m_ref[:, 0]
+        l_prev = l_ref[:, 0]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m_prev - m_cur)
+        p = jnp.exp(s - m_cur[:, None])
+        l_cur = alpha * l_prev + jnp.sum(p, axis=-1)
+        acc_ref[:] = acc_ref[:] * alpha[:, None] + p @ v
+        m_ref[:, 0] = m_cur
+        l_ref[:, 0] = l_cur
 
     @pl.when(j == nk - 1)
     def _finalize():
@@ -123,22 +130,26 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_a
     def _init():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    q = q_ref[0].astype(jnp.float32) * scale
-    k = k_ref[0].astype(jnp.float32)
-    v = v_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0]
-    delta = delta_ref[0]
+    visible = (j * block_k <= i * block_q + block_q - 1) if causal else True
 
-    s = q @ k.T
-    if causal:
-        q_pos = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + i * block_q
-        k_pos = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + j * block_k
-        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-    p = jnp.exp(s - lse[:, None])
-    dp = do @ v.T
-    ds = p * (dp - delta[:, None])
-    dq_acc[:] = dq_acc[:] + (ds @ k) * scale
+    @pl.when(visible)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+
+        s = q @ k.T
+        if causal:
+            q_pos = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + i * block_q
+            k_pos = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + j * block_k
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dp = do @ v.T
+        ds = p * (dp - delta[:, None])
+        dq_acc[:] = dq_acc[:] + (ds @ k) * scale
 
     @pl.when(j == nk - 1)
     def _finalize():
@@ -155,23 +166,27 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    q = q_ref[0].astype(jnp.float32) * scale
-    k = k_ref[0].astype(jnp.float32)
-    v = v_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0]
-    delta = delta_ref[0]
+    visible = (i * block_q + block_q - 1 >= j * block_k) if causal else True
 
-    s = q @ k.T  # (bq, bk)
-    if causal:
-        q_pos = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + i * block_q
-        k_pos = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + j * block_k
-        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-    p = jnp.exp(s - lse[:, None])
-    dv_acc[:] = dv_acc[:] + p.T @ do
-    dp = do @ v.T
-    ds = p * (dp - delta[:, None])
-    dk_acc[:] = dk_acc[:] + (ds.T @ q)  # q already scaled
+    @pl.when(visible)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+
+        s = q @ k.T  # (bq, bk)
+        if causal:
+            q_pos = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + i * block_q
+            k_pos = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + j * block_k
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dv_acc[:] = dv_acc[:] + p.T @ do
+        dp = do @ v.T
+        ds = p * (dp - delta[:, None])
+        dk_acc[:] = dk_acc[:] + (ds.T @ q)  # q already scaled
 
     @pl.when(i == nq - 1)
     def _finalize():

@@ -39,6 +39,15 @@ def ulysses_attention_local(
     heads) divisible by the sp axis size."""
     inner = inner or functools.partial(blockwise_attention, kv_block=512)
     n = lax.axis_size(axis_name)
+    if q.shape[2] % n != 0:
+        raise ValueError(
+            f"Ulysses SP requires attention heads ({q.shape[2]}) divisible by sp={n}"
+        )
+    if k.shape[2] % n != 0:
+        raise ValueError(
+            f"Ulysses SP requires KV heads ({k.shape[2]}) divisible by sp={n}; "
+            "repeat KV heads (GQA) before SP or lower sp_size"
+        )
 
     def scatter_heads(x):
         # (B, S/n, H, D) → (B, S, H/n, D)
