@@ -473,10 +473,24 @@ def _decode_layer(config: LlamaConfig, layer_params, x, cache_k, cache_v, pos):
 
     residual = x
     y = rms_norm(x, layer_params["post_attn_norm"]["scale"], config.rms_norm_eps)
-    gate = y @ layer_params["mlp"]["gate_proj"]["kernel"].astype(cdt)
-    up = y @ layer_params["mlp"]["up_proj"]["kernel"].astype(cdt)
-    y = jax.nn.silu(gate) * up
-    y = y @ layer_params["mlp"]["down_proj"]["kernel"].astype(cdt)
+    if config.num_experts > 1:
+        from ..ops.moe import moe_ffn
+
+        y, _aux = moe_ffn(
+            y,
+            layer_params["mlp"]["router"]["kernel"],
+            layer_params["mlp"]["experts"]["w_gate"],
+            layer_params["mlp"]["experts"]["w_up"],
+            layer_params["mlp"]["experts"]["w_down"],
+            num_selected=config.num_experts_per_tok,
+            capacity_factor=max(config.expert_capacity_factor, float(config.num_experts)),
+            compute_dtype=cdt,
+        )
+    else:
+        gate = y @ layer_params["mlp"]["gate_proj"]["kernel"].astype(cdt)
+        up = y @ layer_params["mlp"]["up_proj"]["kernel"].astype(cdt)
+        y = jax.nn.silu(gate) * up
+        y = y @ layer_params["mlp"]["down_proj"]["kernel"].astype(cdt)
     return residual + y, cache_k, cache_v
 
 

@@ -66,3 +66,36 @@ def test_sampled_generation_deterministic_by_seed():
     c = np.asarray(generate(model, ids, max_new_tokens=5, temperature=1.0, seed=4))
     np.testing.assert_array_equal(a, b)
     assert not np.array_equal(a, c)
+
+
+def test_moe_decode_matches_full_forward():
+    # ample capacity so the full forward drops nothing — otherwise capacity
+    # drops (batch-global) differ from decode routing (per position)
+    cfg = LlamaConfig.tiny(
+        compute_dtype=jnp.float32, num_experts=4, expert_capacity_factor=8.0
+    )
+    from accelerate_tpu.models.llama import create_llama as _create
+
+    model = _create(cfg, seed=0)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, size=(2, 8)).astype(np.int32))
+    full_logits, _aux = llama_apply(cfg, model.params, ids, return_aux=True)
+
+    cache = init_kv_cache(cfg, 2, 8)
+    for t in range(8):
+        step_logits, cache = llama_decode_step(
+            cfg, model.params, cache, ids[:, t : t + 1], jnp.int32(t)
+        )
+        np.testing.assert_allclose(
+            np.asarray(step_logits), np.asarray(full_logits[:, t]), atol=2e-3, rtol=2e-3
+        )
+
+
+def test_moe_generate_runs():
+    cfg = LlamaConfig.tiny(num_experts=4)
+    from accelerate_tpu.models.llama import create_llama as _create
+
+    model = _create(cfg, seed=0)
+    ids = np.ones((1, 4), dtype=np.int32)
+    out = generate(model, ids, max_new_tokens=3)
+    assert out.shape == (1, 7)
