@@ -38,7 +38,7 @@ def generate(
     Prefill runs the full forward once; decode is a single compiled scan with
     a static-size KV cache. Returns (B, prompt+new) token ids.
     """
-    from .models.llama import init_kv_cache, llama_apply, llama_decode_step
+    from .models.llama import llama_decode_step, llama_prefill
 
     config = model.config
     input_ids = jnp.asarray(input_ids, dtype=jnp.int32)
@@ -47,21 +47,9 @@ def generate(
     if pad_to is not None:
         total_len = max(total_len, pad_to)
 
-    cache = init_kv_cache(config, b, total_len)
-
-    # prefill: full forward for logits AND cache warm-up via decode steps
-    # (cache filled by scanning prompt tokens through the decode path keeps
-    # one code path; prompt_len is usually << max context for this path)
-    def prefill_body(carry, t):
-        cache, last_logits = carry
-        token = lax.dynamic_slice(input_ids, (0, t), (b, 1))
-        logits, cache = llama_decode_step(config, model.params, cache, token, t)
-        return (cache, logits), None
-
-    (cache, logits), _ = lax.scan(
-        prefill_body, (cache, jnp.zeros((b, config.vocab_size), jnp.float32)),
-        jnp.arange(prompt_len),
-    )
+    # prefill: ONE full forward fills the cache (O(S) matmul work vs O(S²)
+    # for token-by-token decode over the prompt)
+    logits, cache = llama_prefill(config, model.params, input_ids, total_len)
 
     key = jax.random.key(seed)
 

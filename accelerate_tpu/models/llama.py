@@ -214,8 +214,16 @@ def _attention(config: LlamaConfig, q, k, v, attention_fn=None, q_offset: int = 
     return dot_product_attention(q, k, v, causal=True, q_offset=q_offset)
 
 
-def _layer(config: LlamaConfig, layer_params, x, position_offset: int, attention_fn):
-    """One transformer block on (B, S, D) activations."""
+def _layer(
+    config: LlamaConfig,
+    layer_params,
+    x,
+    position_offset: int,
+    attention_fn,
+    collect_kv: bool = False,
+):
+    """One transformer block on (B, S, D) activations. ``collect_kv=True``
+    additionally returns the (post-RoPE) k/v for prefill cache building."""
     h, kvh, hd = config.num_attention_heads, config.num_key_value_heads, config.head_dim
     b, s, d = x.shape
     cdt = config.compute_dtype
@@ -227,6 +235,7 @@ def _layer(config: LlamaConfig, layer_params, x, position_offset: int, attention
     v = _dot(config, y, layer_params["attn"]["v_proj"]["kernel"].astype(cdt)).reshape(b, s, kvh, hd)
     q = apply_rope(q, position_offset, config.rope_theta)
     k = apply_rope(k, position_offset, config.rope_theta)
+    kv_out = (k, v) if collect_kv else None
     attn = _attention(config, q, k, v, attention_fn, q_offset=position_offset)
     attn = _dot(config, attn.reshape(b, s, h * hd), layer_params["attn"]["o_proj"]["kernel"].astype(cdt))
     attn = checkpoint_name(attn, "attn_block_out")
@@ -254,6 +263,8 @@ def _layer(config: LlamaConfig, layer_params, x, position_offset: int, attention
         y = _dot(config, y, layer_params["mlp"]["down_proj"]["kernel"].astype(cdt))
         aux = jnp.float32(0.0)
     y = checkpoint_name(y, "mlp_block_out")
+    if collect_kv:
+        return residual + y, aux, kv_out
     return residual + y, aux
 
 
@@ -514,6 +525,33 @@ def apply_rope_at(x, pos, theta):
     y1 = x1 * cos - x2 * sin
     y2 = x2 * cos + x1 * sin
     return jnp.stack([y1, y2], axis=-1).reshape(b, s, h, d).astype(x.dtype)
+
+
+def llama_prefill(config: LlamaConfig, params, input_ids, max_len: int):
+    """Full-forward prefill: one pass over the prompt (vs token-by-token
+    decode), returning (last-position logits (B, V), filled KV cache sized
+    ``max_len``)."""
+    cdt = config.compute_dtype
+    b, s = input_ids.shape
+    x = params["embed_tokens"]["embedding"].astype(cdt)[input_ids]
+    layer_fn = functools.partial(_layer, config, position_offset=0, attention_fn=None, collect_kv=True)
+
+    def body(x, layer_params):
+        x, _aux, (k, v) = layer_fn(layer_params, x)
+        return x, (k, v)
+
+    x, (ks, vs) = lax.scan(body, x, params["layers"])  # ks: (L, B, S, kvh, hd)
+    x = rms_norm(x, params["final_norm"]["scale"], config.rms_norm_eps)
+    if config.tie_word_embeddings:
+        logits = x @ params["embed_tokens"]["embedding"].astype(cdt).T
+    else:
+        logits = x @ params["lm_head"]["kernel"].astype(cdt)
+    pad = max_len - s
+    cache = {
+        "k": jnp.pad(ks, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0))),
+        "v": jnp.pad(vs, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0))),
+    }
+    return logits[:, -1].astype(jnp.float32), cache
 
 
 def llama_decode_step(config: LlamaConfig, params, cache, token, pos):
