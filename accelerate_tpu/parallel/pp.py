@@ -69,9 +69,16 @@ def make_pipeline_layer_stack(
                 # stage 0 feeds microbatch t; later stages consume the wire
                 feed = x_all[min(t, m - 1)]
                 inp = jnp.where(idx == 0, feed, recv)
-                out, aux = run_stage(inp)
-                # stage `idx` processes microbatch t-idx at tick t
+                # stage `idx` processes microbatch t-idx at tick t; fill/drain
+                # ticks are skipped (lax.cond) instead of burning FLOPs on
+                # garbage inputs
                 valid = jnp.logical_and(t - idx >= 0, t - idx < m)
+                out, aux = jax.lax.cond(
+                    valid,
+                    run_stage,
+                    lambda h: (jnp.zeros_like(h), jnp.float32(0.0)),
+                    inp,
+                )
                 aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
                 if n_stages > 1:
                     recv = lax.ppermute(out, pp_axis, perm)
