@@ -683,16 +683,11 @@ class Accelerator:
     def gather_for_metrics(self, input_data, use_gather_object: bool = False):
         """Gather eval outputs, dropping the duplicate samples introduced by
         batch padding on the final batch (reference accelerator.py:3068-3140)."""
-        from .ops.operations import gather, gather_object
+        from .ops.operations import find_batch_size, gather, gather_object
 
-        try:
-            recursively = False
-            from .ops.operations import find_batch_size
-
-            find_batch_size(input_data)
-        except Exception:
-            recursively = True
-        if use_gather_object or recursively:
+        # non-tensor payloads (lists of strings, nested python objects) take
+        # the object path (reference accelerator.py:3068 try/except TypeError)
+        if use_gather_object or find_batch_size(input_data) is None:
             return gather_object(input_data)
         data = gather(input_data)
         gs = self.gradient_state

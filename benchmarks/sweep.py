@@ -1,0 +1,70 @@
+"""Bench sweep harness: run bench.py across config combos, collect JSON.
+
+Usage (on TPU):  python benchmarks/sweep.py [--quick]
+Writes benchmarks/sweep_results.jsonl (one bench line per combo + env).
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import os
+import subprocess
+import sys
+
+SWEEPS = {
+    "remat": ["nothing", "minimal", "dots"],
+    "attn": ["blockwise", "flash", "xla"],
+    "batch": ["8", "16", "4"],
+}
+
+QUICK = [
+    {"BENCH_REMAT": "minimal", "BENCH_ATTN": "blockwise", "BENCH_BATCH": "8"},
+    {"BENCH_REMAT": "minimal", "BENCH_ATTN": "flash", "BENCH_BATCH": "8"},
+    {"BENCH_REMAT": "nothing", "BENCH_ATTN": "blockwise", "BENCH_BATCH": "8"},
+    {"BENCH_REMAT": "minimal", "BENCH_ATTN": "flash", "BENCH_BATCH": "16"},
+]
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--quick", action="store_true", help="4 curated combos only")
+    parser.add_argument("--timeout", type=int, default=600)
+    args = parser.parse_args()
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out_path = os.path.join(root, "benchmarks", "sweep_results.jsonl")
+
+    if args.quick:
+        combos = QUICK
+    else:
+        combos = [
+            {"BENCH_REMAT": r, "BENCH_ATTN": a, "BENCH_BATCH": b}
+            for r, a, b in itertools.product(SWEEPS["remat"], SWEEPS["attn"], SWEEPS["batch"])
+        ]
+
+    with open(out_path, "a") as out:
+        for combo in combos:
+            env = {**os.environ, **combo, "BENCH_STEPS": "12"}
+            print(f"=== {combo} ===", flush=True)
+            try:
+                proc = subprocess.run(
+                    [sys.executable, os.path.join(root, "bench.py")],
+                    env=env, capture_output=True, text=True, timeout=args.timeout,
+                )
+                line = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
+                record = {"combo": combo}
+                try:
+                    record["result"] = json.loads(line)
+                except json.JSONDecodeError:
+                    record["error"] = (proc.stderr or line)[-500:]
+            except subprocess.TimeoutExpired:
+                record = {"combo": combo, "error": "timeout"}
+            print(json.dumps(record), flush=True)
+            out.write(json.dumps(record) + "\n")
+            out.flush()
+
+
+if __name__ == "__main__":
+    main()
