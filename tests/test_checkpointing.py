@@ -172,3 +172,35 @@ def test_sharded_safetensors_index(tmp_path):
     flat = load_sharded_safetensors(str(tmp_path))
     assert set(flat) == set(params)
     np.testing.assert_array_equal(flat["w3"], params["w3"])
+
+
+def test_cross_layout_restore(tmp_path):
+    """Save under FSDP-8, restore under TP-2 × FSDP-4 — orbax reshards."""
+    import numpy as np
+
+    import jax
+
+    from accelerate_tpu.models.llama import LlamaConfig, create_llama
+
+    def fresh(pcfg):
+        from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+        PartialState._reset_state()
+        return Accelerator(parallelism_config=pcfg)
+
+    cfg = LlamaConfig.tiny()
+    acc1 = fresh(ParallelismConfig(dp_shard_size=8))
+    m1, o1 = acc1.prepare(create_llama(cfg, seed=7), optax.adam(1e-3))
+    ref = np.asarray(jax.device_get(m1.params["layers"]["mlp"]["gate_proj"]["kernel"]))
+    acc1.save_state(str(tmp_path / "ckpt"))
+
+    acc2 = fresh(ParallelismConfig(dp_shard_size=4, tp_size=2))
+    m2, o2 = acc2.prepare(create_llama(cfg, seed=0), optax.adam(1e-3))
+    spec_before = m2.shardings["layers"]["mlp"]["gate_proj"]["kernel"]
+    acc2.load_state(str(tmp_path / "ckpt"))
+    got = np.asarray(jax.device_get(m2.params["layers"]["mlp"]["gate_proj"]["kernel"]))
+    np.testing.assert_array_equal(ref, got)
+    # restored into the NEW layout's sharding
+    assert m2.params["layers"]["mlp"]["gate_proj"]["kernel"].sharding == spec_before
