@@ -438,10 +438,13 @@ class Accelerator:
         grad_fn = self._grad_fn_for(loss_fn, model, self.gradient_state.num_steps)
         scale = self.scaler.state["scale"] if self.scaler is not None else jnp.float32(1.0)
         (_, (loss, aux)), grads = grad_fn(model.params, scale, *args, **kwargs)
-        if optimizer is not None:
-            optimizer.accumulate_grads(grads)
-        else:
-            self._pending_grads = grads
+        if optimizer is None:
+            raise RuntimeError(
+                "backward() needs a prepared optimizer to accumulate gradients "
+                "into — pass the optimizer to prepare(), or use "
+                "accelerator.train_step for a self-contained compiled step."
+            )
+        optimizer.accumulate_grads(grads)
         return loss if aux is None else (loss, aux)
 
     def clip_grad_norm_(self, parameters=None, max_norm: float = 1.0, norm_type: float = 2.0):
@@ -587,10 +590,19 @@ class Accelerator:
                     new_os = jax.tree_util.tree_map(
                         lambda new, old: jnp.where(finite, new, old), maybe_os, opt_state
                     )
+                    # full DynamicScale semantics (growth + backoff), matching
+                    # the eager path's scaler.update()
+                    scale, good = scaler_state["scale"], scaler_state["good_steps"]
+                    grown = good + 1 >= self.scaler.growth_interval
                     new_scale = jnp.where(
-                        finite, scaler_state["scale"], scaler_state["scale"] * 0.5
+                        finite,
+                        jnp.where(grown, scale * self.scaler.growth_factor, scale),
+                        scale * self.scaler.backoff_factor,
                     )
-                    scaler_state = {"scale": new_scale, "good_steps": scaler_state["good_steps"] + 1}
+                    new_good = jnp.where(
+                        finite, jnp.where(grown, 0, good + 1), 0
+                    ).astype(good.dtype)
+                    scaler_state = {"scale": new_scale, "good_steps": new_good}
                     params, opt_state = new_params, new_os
                 else:
                     updates, opt_state = tx.update(g, opt_state, params)

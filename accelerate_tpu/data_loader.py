@@ -183,7 +183,14 @@ class BatchSamplerShard:
         split_batches: bool = False,
         even_batches: bool = True,
     ):
-        if split_batches and num_processes > 1:
+        import collections.abc
+
+        if (
+            split_batches
+            and num_processes > 1
+            # probing a one-shot iterator would consume its first batch
+            and not isinstance(batch_sampler, collections.abc.Iterator)
+        ):
             first = next(iter(batch_sampler), None)
             if first is not None and len(first) % num_processes != 0:
                 raise ValueError(
@@ -435,15 +442,19 @@ class _BaseAcceleratedLoader:
             # distinct row slices being read across processes — processes
             # spanned by tp/cp read the SAME rows, so this can be < n_proc
             self._num_row_shards = data_shard_info(self.sharding)[0]
+        num_row_shards = getattr(self, "_num_row_shards", 1)
+        # a process's LOCAL rows only need to divide by the shards it itself
+        # feeds (global divisibility = local divisor × num_row_shards)
+        local_divisor = max(n_shards // num_row_shards, 1)
 
         def put(t):
             t = np.asarray(t)
-            if t.ndim >= 1 and t.shape[0] % n_shards != 0:
-                missing = n_shards - (t.shape[0] % n_shards)
+            if t.ndim >= 1 and t.shape[0] % local_divisor != 0:
+                missing = local_divisor - (t.shape[0] % local_divisor)
                 t = np.concatenate([t, np.repeat(t[-1:], missing, axis=0)], axis=0)
             sharding = self._leaf_sharding(t)
             if state.num_processes > 1:
-                global_shape = (t.shape[0] * self._num_row_shards,) + t.shape[1:]
+                global_shape = (t.shape[0] * num_row_shards,) + t.shape[1:]
                 return jax.make_array_from_process_local_data(sharding, t, global_shape)
             return jax.device_put(t, sharding)
 
@@ -846,12 +857,14 @@ def _prepare_from_torch_loader(
 
     batch_sampler = loader.batch_sampler
     if dispatch_batches:
+        # the torch loader's own batches ARE the broadcast global batches, so
+        # its batch_size is the total batch size regardless of split_batches
         return DataLoaderDispatcher(
             _TorchBatchIterator(loader),
             sharding=sharding,
             device_prefetch=device_prefetch,
             total_dataset_length=len(dataset),
-            total_batch_size=(loader.batch_size or 1) * (1 if split_batches else num_shards),
+            total_batch_size=loader.batch_size or 1,
         )
     shard_sampler = BatchSamplerShard(
         batch_sampler,

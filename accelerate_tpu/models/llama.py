@@ -350,6 +350,31 @@ def llama_loss(model_view, batch):
 
 
 # --------------------------------------------------------- HF checkpoint IO
+def _rope_unpermute(w: np.ndarray, n_heads: int, head_dim: int) -> np.ndarray:
+    """HF rotate-half convention → our interleaved RoPE convention.
+
+    HF checkpoints store q/k so that rotary pairs head-dim rows (i, i+d/2)
+    ("rotate half"); our apply_rope pairs (2i, 2i+1) (the original Meta
+    interleaved/complex form). This is the inverse of the permute() in
+    transformers' convert_llama_weights_to_hf: for torch-layout (out, in),
+    ours[h, 2i+m] = hf[h, m*d/2 + i].
+    """
+    out_dim, in_dim = w.shape
+    half = head_dim // 2
+    v = w.reshape(n_heads, 2, half, in_dim)  # (h, member m, pair i, in)
+    v = v.transpose(0, 2, 1, 3)  # (h, pair i, member m, in)
+    return v.reshape(out_dim, in_dim)
+
+
+def _rope_permute(w: np.ndarray, n_heads: int, head_dim: int) -> np.ndarray:
+    """Inverse of :func:`_rope_unpermute` (ours → HF) for export."""
+    out_dim, in_dim = w.shape
+    half = head_dim // 2
+    v = w.reshape(n_heads, half, 2, in_dim)  # (h, pair i, member m, in)
+    v = v.transpose(0, 2, 1, 3)  # (h, member m, pair i, in)
+    return v.reshape(out_dim, in_dim)
+
+
 _HF_LAYER_MAP = {
     "self_attn.q_proj.weight": ("attn", "q_proj"),
     "self_attn.k_proj.weight": ("attn", "k_proj"),
@@ -374,8 +399,15 @@ def convert_hf_state_dict(config: LlamaConfig, flat: dict) -> dict:
 
     def stacked(suffix: str, transpose: bool) -> jnp.ndarray:
         parts = []
+        rope_heads = None
+        if suffix.startswith("self_attn.q_proj"):
+            rope_heads = config.num_attention_heads
+        elif suffix.startswith("self_attn.k_proj"):
+            rope_heads = config.num_key_value_heads
         for i in range(L):
             w = get(f"model.layers.{i}.{suffix}")
+            if rope_heads is not None:
+                w = _rope_unpermute(w, rope_heads, config.head_dim)
             parts.append(w.T if transpose else w)
         return jnp.asarray(np.stack(parts), dtype=config.param_dtype)
 
@@ -416,8 +448,16 @@ def export_hf_state_dict(config: LlamaConfig, params: dict) -> dict:
     L = config.num_hidden_layers
     for hf_suffix, (group, name) in _HF_LAYER_MAP.items():
         stacked = np.asarray(params["layers"][group][name]["kernel"])
+        rope_heads = None
+        if name == "q_proj":
+            rope_heads = config.num_attention_heads
+        elif name == "k_proj":
+            rope_heads = config.num_key_value_heads
         for i in range(L):
-            out[f"model.layers.{i}.{hf_suffix}"] = stacked[i].T
+            w = stacked[i].T  # → torch layout (out, in)
+            if rope_heads is not None:
+                w = _rope_permute(w, rope_heads, config.head_dim)
+            out[f"model.layers.{i}.{hf_suffix}"] = w
     for i in range(L):
         out[f"model.layers.{i}.input_layernorm.weight"] = np.asarray(
             params["layers"]["input_norm"]["scale"]

@@ -257,7 +257,9 @@ class PartialState:
         if function is None:
             import functools
 
-            return functools.partial(self.on_process, local_process_index=local_process_index)
+            return functools.partial(
+                self.on_local_process, local_process_index=local_process_index
+            )
 
         def wrapper(*args, **kwargs):
             if self.local_process_index == local_process_index:
