@@ -1,0 +1,89 @@
+"""Big-model inference benchmark: load time + per-token decode latency.
+
+The reference's headline table (BASELINE.md: GPT-J-6B 8.7s load / 0.05s per
+token on 2 GPUs with hook-based dispatch). Our equivalents: sharded param
+init/dispatch time, one-pass prefill time, and compiled-decode per-token
+latency (measured over a fused multi-token scan + forced fetch — see
+bench.py for why on tunneled TPUs).
+
+Prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from accelerate_tpu.inference import generate
+    from accelerate_tpu.models.llama import LlamaConfig, create_llama
+    from accelerate_tpu.parallelism_config import ParallelismConfig
+    from accelerate_tpu.big_modeling import dispatch_model
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        config = LlamaConfig(
+            vocab_size=32000,
+            hidden_size=int(os.environ.get("IBENCH_HIDDEN", 2048)),
+            intermediate_size=int(os.environ.get("IBENCH_INTER", 5504)),
+            num_hidden_layers=int(os.environ.get("IBENCH_LAYERS", 24)),
+            num_attention_heads=16,
+            num_key_value_heads=16,
+            max_position_embeddings=2048,
+            param_dtype=jnp.bfloat16,
+        )
+        prompt_len, new_tokens = 128, 64
+    else:
+        config = LlamaConfig.tiny(param_dtype=jnp.bfloat16)
+        prompt_len, new_tokens = 16, 8
+
+    n_dev = len(jax.devices())
+    pcfg = ParallelismConfig(tp_size=n_dev) if n_dev > 1 else ParallelismConfig()
+    mesh = pcfg.build_device_mesh()
+    from accelerate_tpu.parallel.tp import tensor_parallel_rules
+
+    t0 = time.perf_counter()
+    model = create_llama(config, seed=0)
+    model = dispatch_model(model, mesh=mesh, rules=tensor_parallel_rules() if n_dev > 1 else None)
+    jax.block_until_ready(jax.tree_util.tree_leaves(model.params)[0])
+    load_s = time.perf_counter() - t0
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, config.vocab_size, size=(1, prompt_len)).astype(np.int32)
+
+    # compile + warm
+    out = generate(model, ids, max_new_tokens=new_tokens)
+    _ = np.asarray(out)
+
+    t0 = time.perf_counter()
+    out = generate(model, ids, max_new_tokens=new_tokens)
+    _ = np.asarray(out)  # force completion through the relay
+    total_s = time.perf_counter() - t0
+    per_token_s = total_s / new_tokens
+
+    result = {
+        "metric": "llama_decode_latency_per_token",
+        "value": round(per_token_s, 5),
+        "unit": "s/token",
+        "vs_baseline": round(0.05 / per_token_s, 3) if per_token_s > 0 else None,
+        "detail": {
+            "params_m": round(model.num_parameters / 1e6, 1),
+            "load_s": round(load_s, 2),
+            "prompt_len": prompt_len,
+            "new_tokens": new_tokens,
+            "n_devices": n_dev,
+            "generate_total_s": round(total_s, 3),
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()
