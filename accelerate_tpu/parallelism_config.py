@@ -66,6 +66,10 @@ class ParallelismConfig:
     # DeepSpeed Ulysses) cannot compose; ours compose on one mesh, but we keep
     # the reference's default for drop-in behavioral parity.
     allow_cp_with_sp: bool = False
+    # Multi-slice pods: place dp_replicate across slices (DCN) and everything
+    # else within a slice (ICI) — the HSDP placement (SURVEY §2.4 HSDP row).
+    # Falls back to a flat mesh when the runtime reports a single slice.
+    hybrid_dcn_replicate: bool = False
     _total_devices: Optional[int] = field(default=None, repr=False)
 
     # ------------------------------------------------------------ properties
@@ -202,11 +206,21 @@ class ParallelismConfig:
         parallelism_config.py:260-272)."""
         import jax
 
-        from .parallel.mesh import build_mesh, canonical_axis_sizes
+        from .parallel.mesh import build_hybrid_mesh, build_mesh, canonical_axis_sizes
 
         total = self._total_devices or len(jax.devices())
         self._infer_and_validate(total)
         sizes, names = canonical_axis_sizes(self.axis_sizes)
+        if self.hybrid_dcn_replicate and self.dp_replicate_size > 1:
+            try:
+                ici_sizes = (1,) + sizes[1:]  # everything but dp_replicate
+                return build_hybrid_mesh(
+                    dcn_axis_sizes=(self.dp_replicate_size,) + (1,) * (len(sizes) - 1),
+                    ici_axis_sizes=ici_sizes,
+                    axis_names=names,
+                )
+            except (ValueError, AssertionError, NotImplementedError):
+                pass  # single slice / topology unknown → flat mesh
         return build_mesh(sizes, names)
 
     def get_device_mesh(self, device_type: Optional[str] = None):

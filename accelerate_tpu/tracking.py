@@ -173,6 +173,14 @@ class TensorBoardTracker(GeneralTracker):
         self.writer.flush()
 
     @on_main_process
+    def log_images(self, values: dict, step: Optional[int] = None, **kwargs):
+        import numpy as np
+
+        for k, v in values.items():
+            self.writer.add_images(k, np.asarray(v), global_step=step, dataformats="NHWC")
+        self.writer.flush()
+
+    @on_main_process
     def finish(self):
         if self.writer is not None:
             self.writer.close()
@@ -208,6 +216,12 @@ class WandBTracker(GeneralTracker):
     @on_main_process
     def log(self, values: dict, step: Optional[int] = None, **kwargs):
         self.run.log(values, step=step, **kwargs)
+
+    @on_main_process
+    def log_images(self, values: dict, step: Optional[int] = None, **kwargs):
+        import wandb
+
+        self.run.log({k: [wandb.Image(img) for img in v] for k, v in values.items()}, step=step)
 
     @on_main_process
     def finish(self):
