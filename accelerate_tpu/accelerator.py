@@ -94,6 +94,7 @@ class Accelerator:
         mixed_precision: Optional[str] = None,
         gradient_accumulation_steps: int = 1,
         gradient_accumulation_plugin: Optional[GradientAccumulationPlugin] = None,
+        fsdp_plugin=None,
         parallelism_config: Optional[ParallelismConfig] = None,
         dataloader_config: Optional[DataLoaderConfiguration] = None,
         project_dir: Optional[str] = None,
@@ -129,6 +130,11 @@ class Accelerator:
                 gradient_accumulation_plugin = handler
 
         self.dataloader_config = dataloader_config or DataLoaderConfiguration()
+        if fsdp_plugin is None and os.environ.get("ACCELERATE_USE_FSDP", "") == "true":
+            from .utils.dataclasses import FSDPPlugin
+
+            fsdp_plugin = FSDPPlugin()
+        self.fsdp_plugin = fsdp_plugin
         self.state = AcceleratorState(
             mixed_precision=mixed_precision, cpu=cpu, parallelism_config=parallelism_config
         )
@@ -292,9 +298,24 @@ class Accelerator:
             from jax.sharding import PartitionSpec as _P
 
             rules.append((r"^layers/", _P("pp")))
+        # user-supplied rule extensions (FSDPPlugin / TensorParallelConfig —
+        # the reference's plugin knobs, utils/dataclasses.py:1586,2295)
+        if pcfg.tp_config is not None and getattr(pcfg.tp_config, "sharding_rules", None):
+            rules = list(pcfg.tp_config.sharding_rules) + rules
+        min_weight_size = 2**10
+        if self.fsdp_plugin is not None:
+            min_weight_size = self.fsdp_plugin.min_weight_size
+            if self.fsdp_plugin.sharding_rules:
+                rules = list(self.fsdp_plugin.sharding_rules) + rules
+            if (
+                self.fsdp_plugin.activation_checkpointing
+                and getattr(getattr(model, "config", None), "remat_policy", None) == "nothing"
+            ):
+                model.config.remat_policy = "minimal"
         fsdp_axes = pcfg.fsdp_dim_names
         shardings = infer_shardings(
-            model.params, self.mesh, rules=rules, fsdp_axes=fsdp_axes
+            model.params, self.mesh, rules=rules, fsdp_axes=fsdp_axes,
+            min_weight_size=min_weight_size,
         )
         model.params = apply_shardings(model.params, shardings)
         model.shardings = shardings

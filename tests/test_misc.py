@@ -298,3 +298,42 @@ def test_multiple_models_checkpoint_suffixes(tmp_path):
     acc.load_state(str(tmp_path / "ckpt"))
     assert float(m1.params["a"]) == 1.0
     assert float(m2.params["a"]) == 2.0
+
+
+def test_fsdp_plugin_wiring():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from accelerate_tpu.model import Model
+    from accelerate_tpu.utils.dataclasses import FSDPPlugin
+
+    # min_weight_size raised → medium param stays replicated
+    acc = make_acc(fsdp_plugin=FSDPPlugin(min_weight_size=2**20))
+    model = Model(lambda p, x: x @ p["w"], {"w": jnp.ones((256, 128))})
+    model = acc.prepare(model)
+    assert model.shardings["w"].spec == P()
+
+    # custom rule wins
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state(); GradientState._reset_state(); PartialState._reset_state()
+    acc2 = make_acc(
+        fsdp_plugin=FSDPPlugin(sharding_rules=[(r"^w$", P(None, "dp_shard"))])
+    )
+    model2 = Model(lambda p, x: x @ p["w"], {"w": jnp.ones((256, 128))})
+    model2 = acc2.prepare(model2)
+    assert model2.shardings["w"].spec == P(None, "dp_shard")
+
+
+def test_fsdp_plugin_activation_checkpointing():
+    import optax
+
+    from accelerate_tpu.models.llama import LlamaConfig, create_llama
+    from accelerate_tpu.utils.dataclasses import FSDPPlugin
+
+    acc = make_acc(fsdp_plugin=FSDPPlugin(activation_checkpointing=True))
+    cfg = LlamaConfig.tiny(remat_policy="nothing")
+    model = create_llama(cfg)
+    model = acc.prepare(model)
+    assert model.config.remat_policy == "minimal"
