@@ -122,3 +122,36 @@ def test_quantized_4bit():
     out = np.asarray(model(x))
     rel = np.abs(out - ref).mean() / np.abs(ref).mean()
     assert rel < 0.15
+
+
+def test_quantized_matmul_pallas_matches_dequant():
+    from accelerate_tpu.ops.quant_matmul import quantized_matmul
+    from accelerate_tpu.utils.quantization import _quantize_array
+
+    rng = np.random.default_rng(0)
+    w = rng.normal(size=(64, 32)).astype(np.float32)
+    q, scales = _quantize_array(w, bits=8)
+    x = jnp.asarray(rng.normal(size=(4, 8, 64)), dtype=jnp.float32)
+
+    # kernel computes the dot in bf16 (the MXU path) → compare vs a bf16 ref
+    ref = (
+        x.astype(jnp.bfloat16) @ jnp.asarray(q, dtype=jnp.bfloat16)
+    ).astype(jnp.float32) * jnp.asarray(scales.reshape(1, -1))
+    out = quantized_matmul(
+        x, jnp.asarray(q), jnp.asarray(scales.reshape(-1)), block_m=16, block_n=16,
+        interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-3, rtol=1e-3)
+    # and stays within int8-quantization error of the true f32 product
+    true = np.asarray(x @ jnp.asarray(q.astype(np.float32) * scales))
+    rel = np.abs(np.asarray(out) - true).mean() / np.abs(true).mean()
+    assert rel < 0.02
+
+
+def test_quantized_matmul_shape_validation():
+    from accelerate_tpu.ops.quant_matmul import quantized_matmul
+
+    with pytest.raises(ValueError, match="Inner dims"):
+        quantized_matmul(
+            jnp.ones((2, 8)), jnp.ones((4, 16), jnp.int8), jnp.ones(16), interpret=True
+        )
