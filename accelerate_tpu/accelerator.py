@@ -478,6 +478,17 @@ class Accelerator:
             return jnp.float32(0.0)
         return self._optimizers[-1].clip_grad_norm_(max_norm)
 
+    def unscale_gradients(self, optimizer=None):
+        """Divide accumulated grads by the loss scale before manual gradient
+        ops (reference accelerator.py unscale_gradients)."""
+        if self.scaler is None:
+            return
+        opts = [optimizer] if optimizer is not None else self._optimizers
+        for opt in opts:
+            if opt._accum_grads is not None and not getattr(opt, "_unscaled", False):
+                opt._accum_grads = self.scaler.unscale(opt._accum_grads)
+                opt._unscaled = True
+
     def clip_grad_value_(self, parameters=None, clip_value: float = 1.0):
         if not self.gradient_state.sync_gradients:
             return

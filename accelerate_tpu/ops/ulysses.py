@@ -21,7 +21,7 @@ import jax
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from .attention import blockwise_attention
+from .attention import blockwise_attention, repeat_kv
 
 __all__ = ["ulysses_attention_local", "make_ulysses_attention"]
 
@@ -44,10 +44,17 @@ def ulysses_attention_local(
             f"Ulysses SP requires attention heads ({q.shape[2]}) divisible by sp={n}"
         )
     if k.shape[2] % n != 0:
-        raise ValueError(
-            f"Ulysses SP requires KV heads ({k.shape[2]}) divisible by sp={n}; "
-            "repeat KV heads (GQA) before SP or lower sp_size"
-        )
+        # GQA with fewer KV heads than sp: materialize the repeat so the
+        # head-scatter divides (costs KV memory, standard ALST fallback)
+        if q.shape[2] % k.shape[2] == 0:
+            rep = q.shape[2] // k.shape[2]
+            k = repeat_kv(k, rep)
+            v = repeat_kv(v, rep)
+        else:
+            raise ValueError(
+                f"Ulysses SP requires KV heads ({k.shape[2]}) divisible by sp={n} "
+                f"or by the query heads ({q.shape[2]})"
+            )
 
     def scatter_heads(x):
         # (B, S/n, H, D) → (B, S, H/n, D)

@@ -190,3 +190,14 @@ def test_zigzag_llama_training():
                 losses.append(float(loss))
     assert all(np.isfinite(losses))
     assert losses[-1] < losses[0]
+
+
+def test_ulysses_gqa_kv_repeat_fallback():
+    """kvh=2 with sp=4: KV heads repeat so the head scatter divides."""
+    cfg = ParallelismConfig(sp_size=4, dp_shard_size=2)
+    mesh = cfg.build_device_mesh()
+    q, k, v = _qkv(h=8, kvh=2)
+    ref = dot_product_attention(q, k, v, causal=True)
+    uly = make_ulysses_attention(mesh)
+    out = jax.jit(lambda q, k, v: uly(q, k, v, causal=True))(q, k, v)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), atol=1e-5)
