@@ -1,0 +1,58 @@
+"""Big-model inference example (reference benchmarks/big_model_inference):
+shard a model across the mesh, load weights (or init), and measure load +
+per-token generation latency."""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import numpy as np
+
+import jax
+
+from accelerate_tpu.big_modeling import dispatch_model, load_checkpoint_and_dispatch
+from accelerate_tpu.inference import generate
+from accelerate_tpu.models.llama import LlamaConfig, create_llama
+from accelerate_tpu.parallel.tp import tensor_parallel_rules
+from accelerate_tpu.parallelism_config import ParallelismConfig
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--checkpoint", default=None, help="safetensors dir (ours or HF layout)")
+    parser.add_argument("--preset", default="tiny", choices=["tiny", "7b"])
+    parser.add_argument("--prompt_len", type=int, default=32)
+    parser.add_argument("--new_tokens", type=int, default=32)
+    parser.add_argument("--tp", type=int, default=0, help="0 = all devices")
+    args = parser.parse_args()
+
+    n_dev = len(jax.devices())
+    tp = args.tp or n_dev
+    pcfg = ParallelismConfig(tp_size=tp) if tp > 1 else ParallelismConfig()
+    mesh = pcfg.build_device_mesh()
+
+    cfg = LlamaConfig.tiny() if args.preset == "tiny" else LlamaConfig.llama2_7b()
+    t0 = time.perf_counter()
+    model = create_llama(cfg, seed=0)
+    rules = tensor_parallel_rules() if tp > 1 else None
+    if args.checkpoint:
+        model = load_checkpoint_and_dispatch(model, args.checkpoint, mesh=mesh, rules=rules, strict=False)
+    else:
+        model = dispatch_model(model, mesh=mesh, rules=rules)
+    jax.block_until_ready(jax.tree_util.tree_leaves(model.params)[0])
+    print(f"load: {time.perf_counter() - t0:.2f}s  params={model.num_parameters/1e6:.1f}M  tp={tp}")
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, size=(1, args.prompt_len)).astype(np.int32)
+    out = generate(model, ids, max_new_tokens=args.new_tokens)
+    _ = np.asarray(out)  # compile + force
+    t0 = time.perf_counter()
+    out = generate(model, ids, max_new_tokens=args.new_tokens)
+    _ = np.asarray(out)
+    dt = time.perf_counter() - t0
+    print(f"generate: {dt:.3f}s total, {dt / args.new_tokens * 1e3:.1f} ms/token")
+
+
+if __name__ == "__main__":
+    main()

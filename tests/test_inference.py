@@ -99,3 +99,17 @@ def test_moe_generate_runs():
     ids = np.ones((1, 4), dtype=np.int32)
     out = generate(model, ids, max_new_tokens=3)
     assert out.shape == (1, 7)
+
+
+def test_generate_tp_sharded():
+    cfg = LlamaConfig.tiny()
+    model = create_llama(cfg, seed=0)
+    from accelerate_tpu.parallel.tp import tensor_parallel_rules
+
+    mesh = ParallelismConfig(tp_size=4, dp_shard_size=2).build_device_mesh()
+    model = prepare_inference(model, mesh=mesh, rules=tensor_parallel_rules())
+    specs = [str(s.spec) for s in jax.tree_util.tree_leaves(model.shardings)]
+    assert any("tp" in s for s in specs)
+    ids = np.ones((2, 4), dtype=np.int32)
+    out = generate(model, ids, max_new_tokens=3)
+    assert out.shape == (2, 7)
