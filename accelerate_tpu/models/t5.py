@@ -1,0 +1,239 @@
+"""T5-style encoder-decoder, TPU-first.
+
+Fourth model family (decoder: llama, encoder: bert, CNN: resnet) — the
+reference's inference baselines include T0pp-11B (BASELINE.md). Same design
+rules as the others: stacked params + scan over layers, bf16 compute / fp32
+logits, stateless ops only. T5 specifics: relative-position-bucket attention
+bias (shared across layers, per-head), pre-LN RMSNorm, ReLU MLP, no biases.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..model import Model
+from ..ops.attention import NEG_INF, dot_product_attention
+from .llama import rms_norm
+
+__all__ = ["T5Config", "init_t5_params", "t5_apply", "create_t5", "t5_loss"]
+
+
+@dataclasses.dataclass
+class T5Config:
+    vocab_size: int = 32128
+    hidden_size: int = 512
+    intermediate_size: int = 2048
+    num_layers: int = 6  # encoder AND decoder depth
+    num_attention_heads: int = 8
+    relative_attention_num_buckets: int = 32
+    relative_attention_max_distance: int = 128
+    layer_norm_eps: float = 1e-6
+    param_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.bfloat16
+    scan_layers: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @classmethod
+    def tiny(cls, **overrides) -> "T5Config":
+        return cls(**{**dict(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_layers=2, num_attention_heads=4,
+            relative_attention_num_buckets=8, relative_attention_max_distance=32,
+        ), **overrides})
+
+
+def _dense(key, i, o, dt):
+    return {"kernel": (jax.random.normal(key, (i, o)) / np.sqrt(i)).astype(dt)}
+
+
+def init_t5_params(config: T5Config, key: jax.Array) -> dict:
+    d, i, L, h = config.hidden_size, config.intermediate_size, config.num_layers, config.num_attention_heads
+    dt = config.param_dtype
+    keys = iter(jax.random.split(key, 64))
+
+    def stack(i_dim, o_dim):
+        ks = jax.random.split(next(keys), L)
+        return {"kernel": jnp.stack([_dense(k, i_dim, o_dim, dt)["kernel"] for k in ks])}
+
+    def norm():
+        return {"scale": jnp.ones((L, d), dt)}
+
+    def attn_block():
+        return {
+            "q": stack(d, d), "k": stack(d, d), "v": stack(d, d), "o": stack(d, d),
+        }
+
+    return {
+        "shared_embedding": (jax.random.normal(next(keys), (config.vocab_size, d)) * 0.02).astype(dt),
+        "encoder": {
+            "rel_bias": (jax.random.normal(next(keys), (config.relative_attention_num_buckets, h)) * 0.02).astype(dt),
+            "layers": {
+                "attn": attn_block(), "attn_norm": norm(),
+                "mlp": {"wi": stack(d, i), "wo": stack(i, d)}, "mlp_norm": norm(),
+            },
+            "final_norm": {"scale": jnp.ones((d,), dt)},
+        },
+        "decoder": {
+            "rel_bias": (jax.random.normal(next(keys), (config.relative_attention_num_buckets, h)) * 0.02).astype(dt),
+            "layers": {
+                "self_attn": attn_block(), "self_norm": norm(),
+                "cross_attn": attn_block(), "cross_norm": norm(),
+                "mlp": {"wi": stack(d, i), "wo": stack(i, d)}, "mlp_norm": norm(),
+            },
+            "final_norm": {"scale": jnp.ones((d,), dt)},
+        },
+    }
+
+
+def _relative_buckets(qlen: int, klen: int, num_buckets: int, max_distance: int, bidirectional: bool):
+    """T5 relative-position bucketing (host-side ints → constant)."""
+    ctx = np.arange(qlen)[:, None]
+    mem = np.arange(klen)[None, :]
+    rel = mem - ctx
+    buckets = np.zeros_like(rel)
+    n = num_buckets
+    if bidirectional:
+        n //= 2
+        buckets += (rel > 0).astype(np.int64) * n
+        rel = np.abs(rel)
+    else:
+        rel = -np.minimum(rel, 0)
+    max_exact = n // 2
+    is_small = rel < max_exact
+    large = max_exact + (
+        np.log(np.maximum(rel, 1) / max_exact)
+        / np.log(max_distance / max_exact)
+        * (n - max_exact)
+    ).astype(np.int64)
+    large = np.minimum(large, n - 1)
+    buckets += np.where(is_small, rel, large)
+    return buckets  # (qlen, klen)
+
+
+def _attn(config, block, lp_idx, x, kv, bias):
+    cdt = config.compute_dtype
+    b, s, d = x.shape
+    h, hd = config.num_attention_heads, config.head_dim
+    q = (x @ block["q"]["kernel"].astype(cdt)).reshape(b, s, h, hd)
+    k = (kv @ block["k"]["kernel"].astype(cdt)).reshape(b, kv.shape[1], h, hd)
+    v = (kv @ block["v"]["kernel"].astype(cdt)).reshape(b, kv.shape[1], h, hd)
+    # T5 does NOT scale by sqrt(d); emulate by pre-multiplying q
+    q = q * np.sqrt(hd)
+    out = dot_product_attention(q, k, v, causal=False, bias=bias)
+    return out.reshape(b, s, h * hd) @ block["o"]["kernel"].astype(cdt)
+
+
+def _mlp(config, mlp, x):
+    cdt = config.compute_dtype
+    y = jax.nn.relu(x @ mlp["wi"]["kernel"].astype(cdt))
+    return y @ mlp["wo"]["kernel"].astype(cdt)
+
+
+def t5_apply(
+    config: T5Config,
+    params: dict,
+    input_ids: jax.Array,
+    decoder_input_ids: jax.Array,
+    attention_mask: Optional[jax.Array] = None,
+):
+    """Returns (B, S_dec, V) fp32 logits."""
+    cdt = config.compute_dtype
+    h = config.num_attention_heads
+    emb = params["shared_embedding"].astype(cdt)
+    b, s_enc = input_ids.shape
+    s_dec = decoder_input_ids.shape[1]
+
+    # --- encoder
+    enc_buckets = _relative_buckets(
+        s_enc, s_enc, config.relative_attention_num_buckets,
+        config.relative_attention_max_distance, bidirectional=True,
+    )
+    enc_bias = params["encoder"]["rel_bias"].astype(jnp.float32)[enc_buckets]  # (s,s,h)
+    enc_bias = enc_bias.transpose(2, 0, 1)[None]  # (1,h,s,s)
+    if attention_mask is not None:
+        enc_bias = enc_bias + jnp.where(attention_mask[:, None, None, :] > 0, 0.0, NEG_INF)
+
+    x = emb[input_ids]
+
+    def enc_layer(x, lp):
+        y = rms_norm(x, lp["attn_norm"]["scale"], config.layer_norm_eps)
+        x = x + _attn(config, lp["attn"], None, y, y, enc_bias)
+        y = rms_norm(x, lp["mlp_norm"]["scale"], config.layer_norm_eps)
+        x = x + _mlp(config, lp["mlp"], y)
+        return x, None
+
+    if config.scan_layers:
+        x, _ = lax.scan(enc_layer, x, params["encoder"]["layers"])
+    else:
+        for li in range(config.num_layers):
+            lp = jax.tree_util.tree_map(lambda p: p[li], params["encoder"]["layers"])
+            x, _ = enc_layer(x, lp)
+    enc_out = rms_norm(x, params["encoder"]["final_norm"]["scale"], config.layer_norm_eps)
+
+    # --- decoder
+    dec_buckets = _relative_buckets(
+        s_dec, s_dec, config.relative_attention_num_buckets,
+        config.relative_attention_max_distance, bidirectional=False,
+    )
+    dec_bias = params["decoder"]["rel_bias"].astype(jnp.float32)[dec_buckets]
+    dec_bias = dec_bias.transpose(2, 0, 1)[None]
+    causal = np.tril(np.ones((s_dec, s_dec), dtype=bool))
+    dec_bias = dec_bias + jnp.where(jnp.asarray(causal)[None, None], 0.0, NEG_INF)
+    cross_bias = None
+    if attention_mask is not None:
+        cross_bias = jnp.where(attention_mask[:, None, None, :] > 0, 0.0, NEG_INF)
+
+    y = emb[decoder_input_ids]
+
+    def dec_layer(y, lp):
+        z = rms_norm(y, lp["self_norm"]["scale"], config.layer_norm_eps)
+        y = y + _attn(config, lp["self_attn"], None, z, z, dec_bias)
+        z = rms_norm(y, lp["cross_norm"]["scale"], config.layer_norm_eps)
+        y = y + _attn(config, lp["cross_attn"], None, z, enc_out, cross_bias)
+        z = rms_norm(y, lp["mlp_norm"]["scale"], config.layer_norm_eps)
+        y = y + _mlp(config, lp["mlp"], z)
+        return y, None
+
+    if config.scan_layers:
+        y, _ = lax.scan(dec_layer, y, params["decoder"]["layers"])
+    else:
+        for li in range(config.num_layers):
+            lp = jax.tree_util.tree_map(lambda p: p[li], params["decoder"]["layers"])
+            y, _ = dec_layer(y, lp)
+    y = rms_norm(y, params["decoder"]["final_norm"]["scale"], config.layer_norm_eps)
+    # T5 scales output by d^-0.5 with tied embedding head
+    logits = (y * (config.hidden_size ** -0.5)) @ emb.T
+    return logits.astype(jnp.float32)
+
+
+def create_t5(config: T5Config, seed: int = 0) -> Model:
+    params = init_t5_params(config, jax.random.key(seed))
+    model = Model(functools.partial(t5_apply, config), params, name="t5")
+    model.config = config
+    return model
+
+
+def t5_loss(model_view, batch):
+    """Teacher-forced seq2seq CE: batch needs input_ids, decoder_input_ids,
+    labels (and optional attention_mask, decoder_loss_mask)."""
+    logits = model_view(
+        batch["input_ids"], batch["decoder_input_ids"], batch.get("attention_mask")
+    )
+    labels = batch["labels"]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    mask = batch.get("decoder_loss_mask")
+    if mask is not None:
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1)
+    return jnp.mean(nll)
