@@ -121,7 +121,7 @@ def _relative_buckets(qlen: int, klen: int, num_buckets: int, max_distance: int,
     return buckets  # (qlen, klen)
 
 
-def _attn(config, block, lp_idx, x, kv, bias):
+def _attn(config, block, x, kv, bias):
     cdt = config.compute_dtype
     b, s, d = x.shape
     h, hd = config.num_attention_heads, config.head_dim
@@ -168,7 +168,7 @@ def t5_apply(
 
     def enc_layer(x, lp):
         y = rms_norm(x, lp["attn_norm"]["scale"], config.layer_norm_eps)
-        x = x + _attn(config, lp["attn"], None, y, y, enc_bias)
+        x = x + _attn(config, lp["attn"], y, y, enc_bias)
         y = rms_norm(x, lp["mlp_norm"]["scale"], config.layer_norm_eps)
         x = x + _mlp(config, lp["mlp"], y)
         return x, None
@@ -198,9 +198,9 @@ def t5_apply(
 
     def dec_layer(y, lp):
         z = rms_norm(y, lp["self_norm"]["scale"], config.layer_norm_eps)
-        y = y + _attn(config, lp["self_attn"], None, z, z, dec_bias)
+        y = y + _attn(config, lp["self_attn"], z, z, dec_bias)
         z = rms_norm(y, lp["cross_norm"]["scale"], config.layer_norm_eps)
-        y = y + _attn(config, lp["cross_attn"], None, z, enc_out, cross_bias)
+        y = y + _attn(config, lp["cross_attn"], z, enc_out, cross_bias)
         z = rms_norm(y, lp["mlp_norm"]["scale"], config.layer_norm_eps)
         y = y + _mlp(config, lp["mlp"], z)
         return y, None

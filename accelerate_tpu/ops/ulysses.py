@@ -44,17 +44,24 @@ def ulysses_attention_local(
             f"Ulysses SP requires attention heads ({q.shape[2]}) divisible by sp={n}"
         )
     if k.shape[2] % n != 0:
-        # GQA with fewer KV heads than sp: materialize the repeat so the
-        # head-scatter divides (costs KV memory, standard ALST fallback)
-        if q.shape[2] % k.shape[2] == 0:
-            rep = q.shape[2] // k.shape[2]
-            k = repeat_kv(k, rep)
-            v = repeat_kv(v, rep)
-        else:
+        # GQA with fewer KV heads than sp: materialize the MINIMAL repeat that
+        # makes the head-scatter divide (standard ALST fallback). rep must
+        # also divide the GQA group size so the inner attention's kv-repeat
+        # stays integral; fall back to the full group repeat otherwise.
+        import math
+
+        kvh = k.shape[2]
+        group = q.shape[2] // max(kvh, 1)
+        if q.shape[2] % max(kvh, 1) != 0 or (kvh * group) % n != 0:
             raise ValueError(
-                f"Ulysses SP requires KV heads ({k.shape[2]}) divisible by sp={n} "
-                f"or by the query heads ({q.shape[2]})"
+                f"Ulysses SP needs query heads ({q.shape[2]}) to be a multiple of "
+                f"KV heads ({kvh}) and total heads divisible by sp={n}"
             )
+        rep = n // math.gcd(kvh, n)
+        if group % rep != 0:
+            rep = group  # full repeat always satisfies both constraints
+        k = repeat_kv(k, rep)
+        v = repeat_kv(v, rep)
 
     def scatter_heads(x):
         # (B, S/n, H, D) → (B, S, H/n, D)

@@ -219,8 +219,15 @@ class ParallelismConfig:
                     ici_axis_sizes=ici_sizes,
                     axis_names=names,
                 )
-            except (ValueError, AssertionError, NotImplementedError):
-                pass  # single slice / topology unknown → flat mesh
+            except (ValueError, AssertionError, NotImplementedError) as e:
+                from .logging import get_logger
+
+                get_logger(__name__).warning(
+                    "hybrid_dcn_replicate requested but hybrid mesh construction "
+                    f"failed ({e}); falling back to a FLAT mesh — on a real "
+                    "multi-slice pod this can put fsdp/tp collectives on DCN. "
+                    "Check dp_replicate_size equals the slice count."
+                )
         return build_mesh(sizes, names)
 
     def get_device_mesh(self, device_type: Optional[str] = None):

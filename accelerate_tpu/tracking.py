@@ -176,8 +176,9 @@ class TensorBoardTracker(GeneralTracker):
     def log_images(self, values: dict, step: Optional[int] = None, **kwargs):
         import numpy as np
 
+        kwargs.setdefault("dataformats", "NHWC")
         for k, v in values.items():
-            self.writer.add_images(k, np.asarray(v), global_step=step, dataformats="NHWC")
+            self.writer.add_images(k, np.asarray(v), global_step=step, **kwargs)
         self.writer.flush()
 
     @on_main_process
@@ -221,7 +222,10 @@ class WandBTracker(GeneralTracker):
     def log_images(self, values: dict, step: Optional[int] = None, **kwargs):
         import wandb
 
-        self.run.log({k: [wandb.Image(img) for img in v] for k, v in values.items()}, step=step)
+        self.run.log(
+            {k: [wandb.Image(img, **kwargs) for img in v] for k, v in values.items()},
+            step=step,
+        )
 
     @on_main_process
     def finish(self):
