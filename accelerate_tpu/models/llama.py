@@ -65,6 +65,10 @@ class LlamaConfig:
     # fp8 projections (ops/fp8.py): e4m3 fwd / e5m2 bwd current scaling;
     # set by Accelerator when mixed_precision="fp8"
     use_fp8: bool = False
+    # chunked cross-entropy (ops/losses.py): the (B,S,V) logits tensor never
+    # materializes — the head matmul is fused into the CE reduction
+    use_chunked_ce: bool = False
+    ce_chunk_size: int = 4096
 
     @property
     def head_dim(self) -> int:
@@ -312,22 +316,55 @@ def llama_apply(
         aux_total = aux_total * config.moe_aux_loss_coef
 
     x = rms_norm(x, params["final_norm"]["scale"], config.rms_norm_eps)
-    if config.tie_word_embeddings:
-        logits = x @ params["embed_tokens"]["embedding"].astype(cdt).T
-    else:
-        logits = x @ params["lm_head"]["kernel"].astype(cdt)
-    logits = logits.astype(jnp.float32)
+    head = (
+        params["embed_tokens"]["embedding"].T
+        if config.tie_word_embeddings
+        else params["lm_head"]["kernel"]
+    )
+    if config.use_chunked_ce:
+        # hand the pre-head hidden + head kernel to the fused CE path
+        # (training-only mode: llama_loss consumes this; use the decode path
+        # or use_chunked_ce=False for inference logits)
+        out = {"hidden": x, "head_kernel": head}
+        if return_aux:
+            out["aux_loss"] = aux_total
+        return out
+    logits = (x @ head.astype(cdt)).astype(jnp.float32)
     if return_aux:
         return logits, {"aux_loss": aux_total}
     return logits
 
 
-def llama_loss(model_view, batch):
+def llama_loss(model_view, batch, ce_chunk_size: int = 4096):
     """Next-token cross entropy; ``batch = {"input_ids": (B,S)}`` with
     optional ``"labels"`` (defaults to shifted input_ids) and
-    ``"loss_mask"``. MoE models fold the load-balancing aux loss in."""
+    ``"loss_mask"``. MoE models fold the load-balancing aux loss in. With
+    ``config.use_chunked_ce`` the head matmul fuses into the CE reduction
+    (ops/losses.py) and full logits never materialize (``ce_chunk_size``
+    vocab slices; static)."""
     input_ids = batch["input_ids"]
     out = model_view(input_ids)
+    if isinstance(out, dict) and "hidden" in out:
+        from ..ops.losses import chunked_softmax_cross_entropy
+
+        hidden = out["hidden"]
+        labels = batch.get("labels")
+        mask = batch.get("loss_mask")
+        if labels is None:
+            labels = input_ids[:, 1:]
+            hidden = hidden[:, :-1]
+            if mask is not None:
+                mask = mask[:, : hidden.shape[1]]
+        loss = chunked_softmax_cross_entropy(
+            hidden,
+            out["head_kernel"].astype(hidden.dtype),
+            labels,
+            chunk_size=ce_chunk_size,
+            loss_mask=mask,
+        )
+        if "aux_loss" in out:
+            loss = loss + out["aux_loss"]
+        return loss
     if isinstance(out, tuple):
         logits, aux = out
     else:
