@@ -68,6 +68,7 @@ def main():
             max_position_embeddings=seq_len,
             remat_policy=os.environ.get("BENCH_REMAT", "minimal"),
             attention_impl=os.environ.get("BENCH_ATTN", "blockwise"),
+            use_chunked_ce=os.environ.get("BENCH_CHUNKED_CE", "1") == "1",
         )
         starting_batch = int(os.environ.get("BENCH_BATCH", 8))
         steps = int(os.environ.get("BENCH_STEPS", 16))
