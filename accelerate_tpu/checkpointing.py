@@ -70,8 +70,12 @@ def save_pytree(tree, path: str, async_save: bool = False) -> None:
     import orbax.checkpoint as ocp
 
     path = os.path.abspath(path)
-    if os.path.exists(path):
+    # stale-dir cleanup must happen on ONE process: on a multi-host shared
+    # filesystem every-process rmtree races the other hosts' orbax writers
+    state = PartialState()
+    if state.is_main_process and os.path.exists(path):
         shutil.rmtree(path)
+    state.wait_for_everyone()
     if async_save:
         ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
         ckptr.save(path, args=ocp.args.StandardSave(tree))

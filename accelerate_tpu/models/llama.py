@@ -373,14 +373,16 @@ def llama_loss(model_view, batch, ce_chunk_size: int = 4096):
     if labels is None:
         labels = input_ids[:, 1:]
         logits = logits[:, :-1]
+    mask = batch.get("loss_mask")
+    if mask is None:
+        # HF-style ignore index: labels < 0 contribute zero loss
+        mask = (labels >= 0).astype(jnp.float32)
+    else:
+        mask = mask[:, : labels.shape[1]]
+    labels = jnp.maximum(labels, 0)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
-    mask = batch.get("loss_mask")
-    if mask is not None:
-        mask = mask[:, : nll.shape[1]]
-        loss = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1)
-    else:
-        loss = jnp.mean(nll)
+    loss = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1)
     if aux is not None:
         loss = loss + aux["aux_loss"]
     return loss

@@ -32,9 +32,15 @@ def chunked_softmax_cross_entropy(
     """Mean CE of ``softmax(hidden @ head_kernel)`` against ``labels``.
 
     hidden: (B, S, D); head_kernel: (D, V); labels: (B, S) int. The vocab dim
-    is processed in ``chunk_size`` slices via ``lax.scan`` — autodiff through
-    the scan recomputes per-chunk logits in backward, trading ~1 extra head
-    matmul for the 2·(B,S,V) forward+saved memory.
+    is processed in ``chunk_size`` slices via ``lax.scan`` with the body under
+    ``jax.checkpoint``: backward recomputes per-chunk logits instead of saving
+    the stacked (n_chunks, B, S, chunk) residuals (which would add back the
+    very (B, S, V) footprint this kernel exists to avoid), trading ~1 extra
+    head matmul for the 2·(B,S,V) forward+saved memory.
+
+    Labels < 0 (e.g. HF's -100 ignore index) are excluded from the loss: when
+    ``loss_mask`` is None a mask is derived from ``labels >= 0``; an explicit
+    ``loss_mask`` takes precedence.
     """
     b, s, d = hidden.shape
     v = head_kernel.shape[1]
@@ -48,6 +54,11 @@ def chunked_softmax_cross_entropy(
     )
 
     neg_big = jnp.float32(-1e30)
+
+    if loss_mask is None:
+        # HF-style ignore index: negative labels contribute zero loss.
+        loss_mask = (labels >= 0).astype(jnp.float32)
+    labels = jnp.maximum(labels, 0)  # safe for the in-chunk gather
 
     def body(carry, inputs):
         m, l, label_logit = carry
@@ -76,10 +87,11 @@ def chunked_softmax_cross_entropy(
         jnp.zeros((b, s), dtype=jnp.float32),
         jnp.zeros((b, s), dtype=jnp.float32),
     )
+    # checkpoint the body: without it, scan autodiff stacks every chunk's
+    # residuals (the exp(logits-m) tensors, totalling ~(B,S,V)) and the
+    # "full logits never materialize" guarantee silently fails in training.
     (m, l, label_logit), _ = lax.scan(
-        body, init, (kernel_chunks, jnp.arange(n_chunks))
+        jax.checkpoint(body), init, (kernel_chunks, jnp.arange(n_chunks))
     )
     nll = (m + jnp.log(jnp.maximum(l, 1e-30))) - label_logit
-    if loss_mask is not None:
-        return jnp.sum(nll * loss_mask) / jnp.maximum(jnp.sum(loss_mask), 1)
-    return jnp.mean(nll)
+    return jnp.sum(nll * loss_mask) / jnp.maximum(jnp.sum(loss_mask), 1)

@@ -29,8 +29,11 @@ def _qmm_kernel(x_ref, q_ref, s_ref, out_ref):
     x = x_ref[:]  # (bm, K)
     q = q_ref[:]  # (K, bn) int8
     s = s_ref[:]  # (1, bn) f32 per-output-channel scale
+    # compute dtype follows the activations: f32 inputs keep full mantissa
+    # (the MXU runs f32 via multi-pass); bf16 inputs take the fast path
+    compute = jnp.float32 if x.dtype == jnp.float32 else jnp.bfloat16
     acc = jnp.dot(
-        x.astype(jnp.bfloat16), q.astype(jnp.bfloat16), preferred_element_type=jnp.float32
+        x.astype(compute), q.astype(compute), preferred_element_type=jnp.float32
     )
     out_ref[:] = (acc * s).astype(out_ref.dtype)
 

@@ -186,7 +186,10 @@ class AcceleratedOptimizer:
     def clip_grad_norm_(self, max_norm: float):
         if self._accum_grads is None:
             return jnp.float32(0.0)
-        if self.scaler is not None:
+        # guard against double-unscale after accelerator.unscale_gradients()
+        # (torch GradScaler raises on the second unscale; we must not divide
+        # by the loss scale twice)
+        if self.scaler is not None and not getattr(self, "_unscaled", False):
             self._accum_grads = self.scaler.unscale(self._accum_grads)
             self._unscaled = True
         self._accum_grads, norm = _clip_by_global_norm(self._accum_grads, max_norm)
@@ -195,7 +198,7 @@ class AcceleratedOptimizer:
     def clip_grad_value_(self, clip_value: float):
         if self._accum_grads is None:
             return
-        if self.scaler is not None:
+        if self.scaler is not None and not getattr(self, "_unscaled", False):
             self._accum_grads = self.scaler.unscale(self._accum_grads)
             self._unscaled = True
         self._accum_grads = _clip_by_value(self._accum_grads, clip_value)
@@ -207,6 +210,7 @@ class AcceleratedOptimizer:
             return
         if self._accum_grads is None:
             self.step_was_skipped = True
+            self._unscaled = False
             return
         grads = self._accum_grads
         if self.scaler is not None:
@@ -237,6 +241,7 @@ class AcceleratedOptimizer:
         if self.gradient_state.sync_gradients:
             self._accum_grads = None
             self._accum_count = 0
+            self._unscaled = False
 
     # ------------------------------------------------------------- state dict
     def state_dict(self):

@@ -12,10 +12,55 @@ find_executable_batch_size.
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 
 import numpy as np
+
+
+METRIC = "llama_train_tokens_per_sec_per_chip"
+
+
+def _run_child(env_overrides: dict, timeout: float):
+    """Run the measurement (``bench.py --child``) in a subprocess under a
+    wall-clock watchdog. A flaky TPU relay can hang *anywhere* — backend init,
+    compile, or the first device fetch — with no way to interrupt it in-process
+    (round-1 failure mode: rc=1/124 with no JSON). Returns the JSON dict the
+    child printed, or None. An override of None REMOVES the variable."""
+    env = dict(os.environ)
+    for key, value in env_overrides.items():
+        if value is None:
+            env.pop(key, None)
+        else:
+            env[key] = value
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child"],
+            capture_output=True, text=True, timeout=timeout, env=env,
+        )
+    except subprocess.TimeoutExpired as exc:
+        # keep the hang diagnostics — they say WHERE the backend stalled
+        if exc.stderr:
+            err = exc.stderr
+            if isinstance(err, bytes):
+                err = err.decode(errors="replace")
+            sys.stderr.write(err[-4000:])
+        return None
+    except OSError:
+        return None
+    sys.stderr.write(out.stderr[-4000:])
+    for line in reversed(out.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                parsed = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if parsed.get("metric") == METRIC and "value" in parsed:
+                return parsed
+    return None
 
 
 PEAK_FLOPS = {
@@ -37,8 +82,14 @@ def detect_peak_flops(device) -> float:
     return PEAK_FLOPS["v5e"] if device.platform == "tpu" else PEAK_FLOPS["cpu"]
 
 
-def main():
+def main(note=None):
     import jax
+
+    if os.environ.get("BENCH_FORCE_CPU") == "1":
+        # env JAX_PLATFORMS is NOT enough: a sitecustomize-registered TPU
+        # plugin can override platform selection via jax config at interpreter
+        # startup, so force it back at the config level before any device probe
+        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
     import optax
 
@@ -51,8 +102,6 @@ def main():
     )
     from accelerate_tpu.parallelism_config import ParallelismConfig
     from accelerate_tpu.utils.memory import find_executable_batch_size
-
-    import os
 
     device = jax.devices()[0]
     on_tpu = device.platform == "tpu"
@@ -120,7 +169,7 @@ def main():
     mfu = (tok_per_sec_per_chip * flops_per_token) / detect_peak_flops(device)
 
     result = {
-        "metric": "llama_train_tokens_per_sec_per_chip",
+        "metric": METRIC,
         "value": round(tok_per_sec_per_chip, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu / 0.45, 4),
@@ -135,8 +184,41 @@ def main():
             "loss": round(loss, 4),
         },
     }
-    print(json.dumps(result))
+    if note:
+        result["error"] = note
+    print(json.dumps(result), flush=True)
 
 
 if __name__ == "__main__":
-    main()
+    if "--child" in sys.argv:
+        # the actual measurement; parent enforces the wall-clock watchdog
+        try:
+            main(note=os.environ.get("BENCH_NOTE") or None)
+        except Exception as exc:  # noqa: BLE001 — emit the line no matter what
+            print(json.dumps({
+                "metric": METRIC, "value": 0.0, "unit": "tokens/s/chip",
+                "vs_baseline": 0.0,
+                "error": f"{type(exc).__name__}: {exc}"[:500],
+            }), flush=True)
+        sys.exit(0)
+
+    # Parent: the JSON line must ALWAYS appear and rc must be 0 (VERDICT
+    # weak #2). Attempt the configured backend under a watchdog; if it hangs
+    # or fails, fall back to a CPU smoke run; if even that fails, emit an
+    # error line.
+    result = _run_child({}, float(os.environ.get("BENCH_TPU_TIMEOUT", 1200)))
+    if result is None or (result.get("value", 0) == 0 and "error" in result):
+        sys.stderr.write("bench: configured backend failed; CPU smoke fallback\n")
+        cpu = _run_child(
+            {"JAX_PLATFORMS": "cpu", "BENCH_FORCE_CPU": "1",
+             # without this the TPU sitecustomize dials the (dead) relay at
+             # interpreter start and the CPU fallback hangs before main()
+             "PALLAS_AXON_POOL_IPS": None,
+             "BENCH_NOTE": "configured backend unreachable/hung; CPU smoke numbers only"},
+            float(os.environ.get("BENCH_CPU_TIMEOUT", 600)),
+        )
+        result = cpu or result
+    if result is None:
+        result = {"metric": METRIC, "value": 0.0, "unit": "tokens/s/chip",
+                  "vs_baseline": 0.0, "error": "benchmark timed out on all backends"}
+    print(json.dumps(result), flush=True)

@@ -53,6 +53,51 @@ def test_chunked_ce_grads_match():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
+def test_chunked_ce_ignore_index():
+    """Labels < 0 (HF's -100) are excluded from the loss automatically."""
+    rng = np.random.default_rng(3)
+    hidden = jnp.asarray(rng.normal(size=(2, 8, 16)), dtype=jnp.float32)
+    kernel = jnp.asarray(rng.normal(size=(16, 96)), dtype=jnp.float32)
+    labels = rng.integers(0, 96, size=(2, 8)).astype(np.int32)
+    labels[0, :3] = -100
+    labels[1, 7] = -100
+    mask = (labels >= 0).astype(np.float32)
+    ref = _ref_ce(hidden, kernel, jnp.asarray(np.maximum(labels, 0)), jnp.asarray(mask))
+    got = chunked_softmax_cross_entropy(hidden, kernel, jnp.asarray(labels), chunk_size=32)
+    np.testing.assert_allclose(float(got), float(ref), atol=1e-5)
+    # gradient stays finite (the -100 rows must not poison the gather)
+    g = jax.grad(
+        lambda h: chunked_softmax_cross_entropy(h, kernel, jnp.asarray(labels), chunk_size=32)
+    )(hidden)
+    assert np.isfinite(np.asarray(g)).all()
+
+
+def test_chunked_ce_no_stacked_residuals():
+    """The scan body is under jax.checkpoint: backward must NOT save stacked
+    (n_chunks, B, S, chunk) residuals — that would re-materialize the very
+    (B, S, V) footprint the kernel exists to avoid (ADVICE r1 medium #1)."""
+    b, s, d, v, chunk = 2, 16, 8, 4096, 256
+    n_chunks = v // chunk
+    hidden = jnp.zeros((b, s, d), dtype=jnp.float32)
+    kernel = jnp.zeros((d, v), dtype=jnp.float32)
+    labels = jnp.zeros((b, s), dtype=jnp.int32)
+
+    def loss(h, k):
+        return chunked_softmax_cross_entropy(h, k, labels, chunk_size=chunk)
+
+    jaxpr = jax.make_jaxpr(jax.grad(loss, argnums=(0, 1)))(hidden, kernel)
+    # any residual holding all chunks' (b, s, chunk) slabs ≈ full logits
+    bad = [
+        var.aval.shape
+        for eqn in jaxpr.jaxpr.eqns
+        for var in eqn.outvars
+        if hasattr(var, "aval")
+        and getattr(var.aval, "shape", None) is not None
+        and np.prod(var.aval.shape or (1,)) >= n_chunks * b * s * chunk
+    ]
+    assert not bad, f"stacked residuals the size of full logits found: {bad}"
+
+
 def test_llama_chunked_ce_matches_standard():
     from accelerate_tpu.models.llama import LlamaConfig, create_llama, llama_loss
 
