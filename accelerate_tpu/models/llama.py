@@ -33,6 +33,7 @@ from jax.ad_checkpoint import checkpoint_name
 
 from ..model import Model
 from ..ops.attention import blockwise_attention, dot_product_attention
+from ..parallel.sharding import constrain_activation
 
 __all__ = ["LlamaConfig", "init_llama_params", "llama_apply", "create_llama", "llama_loss"]
 
@@ -243,7 +244,7 @@ def _layer(
     attn = _attention(config, q, k, v, attention_fn, q_offset=position_offset)
     attn = _dot(config, attn.reshape(b, s, h * hd), layer_params["attn"]["o_proj"]["kernel"].astype(cdt))
     attn = checkpoint_name(attn, "attn_block_out")
-    x = residual + attn
+    x = constrain_activation(residual + attn)
 
     residual = x
     y = rms_norm(x, layer_params["post_attn_norm"]["scale"], config.rms_norm_eps)
@@ -263,13 +264,14 @@ def _layer(
     else:
         gate = _dot(config, y, layer_params["mlp"]["gate_proj"]["kernel"].astype(cdt))
         up = _dot(config, y, layer_params["mlp"]["up_proj"]["kernel"].astype(cdt))
-        y = jax.nn.silu(gate) * up
+        y = constrain_activation(jax.nn.silu(gate) * up, "intermediate")
         y = _dot(config, y, layer_params["mlp"]["down_proj"]["kernel"].astype(cdt))
         aux = jnp.float32(0.0)
     y = checkpoint_name(y, "mlp_block_out")
+    out = constrain_activation(residual + y)
     if collect_kv:
-        return residual + y, aux, kv_out
-    return residual + y, aux
+        return out, aux, kv_out
+    return out, aux
 
 
 def llama_apply(
@@ -287,7 +289,7 @@ def llama_apply(
     load-balancing loss summed over layers). ``layer_stack_fn`` overrides how
     the stacked layers run (injected by pipeline parallelism)."""
     cdt = config.compute_dtype
-    x = params["embed_tokens"]["embedding"].astype(cdt)[input_ids]
+    x = constrain_activation(params["embed_tokens"]["embedding"].astype(cdt)[input_ids])
 
     layer_fn = functools.partial(
         _layer, config, position_offset=position_offset, attention_fn=attention_fn
@@ -380,8 +382,17 @@ def llama_loss(model_view, batch, ce_chunk_size: int = 4096):
     else:
         mask = mask[:, : labels.shape[1]]
     labels = jnp.maximum(labels, 0)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    # one-hot einsum instead of take_along_axis: its transpose is a clean
+    # matmul (softmax - onehot), where the gather's backward is a scatter-add
+    # the SPMD partitioner reshards involuntarily under dp×cp meshes
+    lse = jax.scipy.special.logsumexp(logits.astype(jnp.float32), axis=-1)
+    # one-hot in the logits dtype — a float32 copy would double the (B,S,V)
+    # transient; the f32 accumulation happens inside the einsum
+    onehot = jax.nn.one_hot(labels, logits.shape[-1], dtype=logits.dtype)
+    label_logit = jnp.einsum(
+        "bsv,bsv->bs", logits, onehot, preferred_element_type=jnp.float32
+    )
+    nll = lse - label_logit
     loss = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1)
     if aux is not None:
         loss = loss + aux["aux_loss"]

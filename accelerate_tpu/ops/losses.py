@@ -61,11 +61,17 @@ def chunked_softmax_cross_entropy(
     labels = jnp.maximum(labels, 0)  # safe for the in-chunk gather
 
     def body(carry, inputs):
+        from ..parallel.sharding import constrain_activation
+
         m, l, label_logit = carry
         k_chunk, c_idx = inputs
         logits = jnp.einsum(
             "bsd,dc->bsc", hidden, k_chunk.astype(hidden.dtype)
         ).astype(logit_dtype)
+        # anchor the per-chunk logits to the activation layout (vocab chunk
+        # stays tp-sharded): without this the transpose (backward) program
+        # reshards them involuntarily
+        logits = constrain_activation(logits, "vocab")
         base = c_idx * chunk_size
         col = lax.broadcasted_iota(jnp.int32, (b, s, chunk_size), 2) + base
         valid = col < v

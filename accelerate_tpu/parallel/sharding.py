@@ -172,3 +172,80 @@ def sharding_summary(params: Any, shardings: Any) -> str:
 
     jax.tree_util.tree_map_with_path(visit, params, shardings)
     return "\n".join(lines)
+
+
+# ------------------------------------------------------- activation anchors
+# Batch/sequence/feature mesh axes that activations shard over. Anchoring
+# activations at block boundaries stops the SPMD partitioner from picking a
+# different layout for the transpose (backward) program — without these, the
+# FSDP×CP fused train step hits "Involuntary full rematerialization"
+# replicate-and-reshard cliffs in the chunked-CE/MLP backward.
+_ACT_BATCH_AXES = ("dp_replicate", "dp_shard")
+_ACT_SEQ_AXES = ("cp", "sp")
+_ACT_TP_AXIS = ("tp",)
+
+
+def current_mesh() -> Optional[Mesh]:
+    """The Accelerator's device mesh if one is live, else None. Peeks the
+    Borg state without initializing it — model code must stay usable with
+    plain jax.jit outside any Accelerator."""
+    from ..state import AcceleratorState
+
+    return AcceleratorState._shared_state.get("mesh")
+
+
+def _axis_entry(mesh: Mesh, axes: Sequence[str], dim_size: int):
+    """The subset of ``axes`` present in ``mesh`` with size>1, as a
+    PartitionSpec entry — or None when nothing applies or ``dim_size`` isn't
+    divisible (uneven activation sharding is never worth the padding)."""
+    use = [a for a in axes if mesh.shape.get(a, 1) > 1]
+    if not use:
+        return None
+    prod = int(np.prod([mesh.shape[a] for a in use]))
+    if prod <= 1 or dim_size % prod != 0:
+        return None
+    return tuple(use) if len(use) > 1 else use[0]
+
+
+def constrain_activation(x, kind: str = "residual", mesh: Optional[Mesh] = None):
+    """``with_sharding_constraint`` for a (B, S, ..., F) activation.
+
+    kind: "residual" leaves the feature dim replicated (post-o_proj /
+    post-down_proj block outputs); "intermediate" shards the feature dim over
+    ``tp`` (gate/up MLP activations, Megatron column-parallel outputs);
+    "vocab" likewise for logits. No-op when no mesh is live, inside fully
+    manual shard_map regions, or when no named axis applies.
+    """
+    if mesh is None:
+        mesh = current_mesh()
+    if mesh is None or getattr(x, "ndim", 0) < 2:
+        return x
+    try:
+        if jax.sharding.get_abstract_mesh().manual_axes:
+            # inside a shard_map manual region (pp/cp/sp internals) the named
+            # layout is already explicit — constraining again is at best a
+            # no-op and on some backends a compiler crash
+            return x
+    except Exception:
+        pass
+    batch = _axis_entry(mesh, _ACT_BATCH_AXES, x.shape[0])
+    seq = _axis_entry(mesh, _ACT_SEQ_AXES, x.shape[1]) if x.ndim >= 3 else None
+    feat = (
+        _axis_entry(mesh, _ACT_TP_AXIS, x.shape[-1])
+        if kind in ("intermediate", "vocab")
+        else None
+    )
+    if batch is None and seq is None and feat is None:
+        return x
+    if x.ndim == 2:  # (B, F) — e.g. single-token decode logits
+        entries = [batch, feat]
+    else:
+        entries = [batch, seq] + [None] * (x.ndim - 3) + [feat]
+    try:
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P(*entries))
+        )
+    except Exception:
+        # e.g. a shard_map region where these axes are manual — the anchor is
+        # an optimization, never a correctness requirement
+        return x
