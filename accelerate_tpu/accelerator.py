@@ -466,7 +466,50 @@ class Accelerator:
                 "accelerator.train_step for a self-contained compiled step."
             )
         optimizer.accumulate_grads(grads)
+        self._touch_heartbeat()
         return loss if aux is None else (loss, aux)
+
+    def _touch_heartbeat(self) -> None:
+        """Liveness signal for the launch supervisor's hang watchdog: touch
+        ``ACCELERATE_HEARTBEAT_FILE`` (exported by ``accelerate-tpu launch
+        --watchdog_timeout``) once per training step. No-op otherwise."""
+        hb = os.environ.get("ACCELERATE_HEARTBEAT_FILE")
+        if hb:
+            try:
+                os.utime(hb, None)
+            except OSError:
+                pass
+
+    def resume_from_latest(self, input_dir: Optional[str] = None) -> bool:
+        """Auto-resume glue for the fault-tolerant launcher: load the latest
+        checkpoint under ``project_dir`` (or ``input_dir``) if one exists.
+        Returns True when state was restored, False when there is nothing to
+        resume from — so a script can call it unconditionally and get
+        identical behavior on first launch and on a supervisor restart
+        (``ACCELERATE_RESTART_COUNT`` > 0). PREPARED dataloaders resume their
+        exact mid-epoch position automatically (their state rides
+        ``save_state``); ``skip_first_batches`` is only for loaders the
+        Accelerator does not manage — do not apply it on top of a restored
+        prepared loader, that would skip twice."""
+        try:
+            self.load_state(input_dir)
+        except FileNotFoundError:
+            return False
+        pc = self.project_configuration
+        if input_dir is None and pc.automatic_checkpoint_naming and pc.project_dir:
+            # a fresh process restarts iteration at 0 — fast-forward past the
+            # checkpoints already on disk so the next save doesn't overwrite
+            from .utils.constants import CHECKPOINT_DIR_PREFIX
+
+            base = os.path.join(pc.project_dir, "checkpoints")
+            indices = [
+                int(d.rsplit("_", 1)[-1])
+                for d in os.listdir(base)
+                if d.startswith(CHECKPOINT_DIR_PREFIX)
+            ]
+            if indices:
+                pc.iteration = max(indices) + 1
+        return True
 
     def clip_grad_norm_(self, parameters=None, max_norm: float = 1.0, norm_type: float = 2.0):
         """Clip accumulated grads by global norm (reference accelerator.py:
@@ -699,6 +742,7 @@ class Accelerator:
             if use_scaler:
                 self.scaler.state = scaler_state
             optimizer._step_count += 1
+            self._touch_heartbeat()
             return loss
 
         return step
@@ -824,7 +868,10 @@ class Accelerator:
         output_dir = _resolve_dir(self, output_dir, for_save=True)
         for hook in self._save_state_pre_hooks:
             hook(self._models, None, output_dir)
-        return save_accelerator_state(self, output_dir, **save_kwargs)
+        self._touch_heartbeat()  # a long orbax write is progress, not a hang
+        result = save_accelerator_state(self, output_dir, **save_kwargs)
+        self._touch_heartbeat()
+        return result
 
     def load_state(self, input_dir: Optional[str] = None, **load_kwargs) -> None:
         from .checkpointing import _resolve_dir, load_accelerator_state
@@ -832,7 +879,9 @@ class Accelerator:
         input_dir = _resolve_dir(self, input_dir, for_save=False)
         for hook in self._load_state_pre_hooks:
             hook(self._models, input_dir)
+        self._touch_heartbeat()
         load_accelerator_state(self, input_dir, **load_kwargs)
+        self._touch_heartbeat()
 
     def save_model(self, model: Model, save_directory: str, max_shard_size: str = "10GB", safe_serialization: bool = True):
         from .checkpointing import save_model_checkpoint

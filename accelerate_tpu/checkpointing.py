@@ -142,6 +142,22 @@ def _restore_rng_state(state: dict) -> None:
         torch.set_rng_state(state["torch"])
 
 
+def _json_safe(obj):
+    """Recursively coerce numpy scalars/arrays (and tuples/sets) to plain
+    JSON types; unknown objects fall back to repr() rather than crashing."""
+    if isinstance(obj, dict):
+        return {str(k): _json_safe(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple, set)):
+        return [_json_safe(v) for v in obj]
+    if isinstance(obj, np.generic):
+        return obj.item()
+    if isinstance(obj, (np.ndarray, jax.Array)):
+        return np.asarray(obj).tolist()
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    return repr(obj)
+
+
 # ----------------------------------------------------------------- save/load
 def _resolve_dir(accelerator, output_dir: Optional[str], for_save: bool) -> str:
     pc = accelerator.project_configuration
@@ -210,7 +226,11 @@ def save_accelerator_state(
         for dl in accelerator._dataloaders:
             samplers.append(dl.state_dict() if hasattr(dl, "state_dict") else {})
         with open(os.path.join(output_dir, f"{SAMPLER_NAME}.json"), "w") as f:
-            json.dump({"dataloaders": samplers, "step": accelerator.step}, f)
+            # stateful datasets may put numpy scalars/arrays in their state —
+            # coerce so one such leaf can't crash the whole save
+            json.dump(
+                _json_safe({"dataloaders": samplers, "step": accelerator.step}), f
+            )
         if accelerator.scaler is not None:
             with open(os.path.join(output_dir, "scaler.json"), "w") as f:
                 json.dump(accelerator.scaler.state_dict(), f)

@@ -11,6 +11,19 @@ JAX runs ONE process per host addressing all local devices, so:
 * TPU pod (``--pod``) → fan the SAME command out to every worker over
   ``gcloud compute tpus tpu-vm ssh --worker=all`` (the reference's
   ``tpu_pod_launcher``/``tpu-config``, commands/launch.py:1117 + tpu.py).
+
+Fault tolerance (the reference forwards ``--max_restarts``/
+``--monitor_interval`` to torchrun's elastic agent, commands/launch.py:
+589-620,998): each host runs a local supervisor. ``--max_restarts N``
+relaunches the script when it dies; ``--monitor_interval``/
+``--watchdog_timeout`` add a heartbeat hang detector (the Accelerator
+touches ``ACCELERATE_HEARTBEAT_FILE`` every optimizer step). On a
+multi-host SPMD job a single dead host makes every other host's
+collectives fail, so all supervisors restart their worker together and
+``jax.distributed`` re-forms with the same process count — recovery is
+whole-job restart + resume from the latest checkpoint
+(``Accelerator.resume_from_latest`` + ``skip_first_batches``), which is
+the only sound recovery on a TPU pod (no per-rank elasticity).
 """
 
 from __future__ import annotations
@@ -19,8 +32,64 @@ import os
 import shlex
 import subprocess
 import sys
+import tempfile
+import time
 
 from .config import DEFAULT_CONFIG_FILE, ClusterConfig
+
+
+def _supervise(cmd, env, max_restarts: int, monitor_interval: float,
+               watchdog_timeout: float) -> int:
+    """Run ``cmd`` under a restart supervisor; returns the final exit code.
+
+    The child is polled every ``monitor_interval`` seconds. With
+    ``watchdog_timeout > 0`` a heartbeat file is exported as
+    ``ACCELERATE_HEARTBEAT_FILE``; if the child stops touching it for longer
+    than the timeout (hung collective, dead relay) it is killed and counted
+    as a failure."""
+    hb_file = None
+    if watchdog_timeout > 0:
+        fd, hb_file = tempfile.mkstemp(prefix="accelerate_hb_")
+        os.close(fd)
+        env["ACCELERATE_HEARTBEAT_FILE"] = hb_file
+    attempt = 0
+    try:
+        while True:
+            env["ACCELERATE_RESTART_COUNT"] = str(attempt)
+            if hb_file:
+                os.utime(hb_file, None)
+            proc = subprocess.Popen(cmd, env=env)
+            rc = None
+            while rc is None:
+                try:
+                    rc = proc.wait(timeout=monitor_interval)
+                except subprocess.TimeoutExpired:
+                    if hb_file and (
+                        time.time() - os.path.getmtime(hb_file) > watchdog_timeout
+                    ):
+                        print(
+                            f"[launch] heartbeat stale >{watchdog_timeout}s; "
+                            "killing hung worker",
+                            file=sys.stderr,
+                        )
+                        proc.kill()
+                        proc.wait()
+                        rc = 1
+            if rc == 0:
+                return 0
+            if attempt >= max_restarts:
+                return rc
+            attempt += 1
+            print(
+                f"[launch] worker exited rc={rc}; restart {attempt}/{max_restarts}",
+                file=sys.stderr,
+            )
+    finally:
+        if hb_file:
+            try:
+                os.unlink(hb_file)
+            except OSError:
+                pass
 
 
 def launch_command(args, script_args) -> int:
@@ -59,9 +128,21 @@ def launch_command(args, script_args) -> int:
     cmd = [sys.executable, args.training_script, *script_args]
 
     if args.pod:
+        # each pod worker runs its OWN local supervisor: forward the restart/
+        # watchdog flags through the inner launch command rather than bare
+        # `python script` (a crash on one host then restarts everywhere, and
+        # jax.distributed re-forms — the whole-job restart recovery model)
+        supervisor_flags: list[str] = []
+        if args.max_restarts:
+            supervisor_flags += ["--max_restarts", str(args.max_restarts)]
+            supervisor_flags += ["--monitor_interval", str(args.monitor_interval)]
+            if args.watchdog_timeout:
+                supervisor_flags += ["--watchdog_timeout", str(args.watchdog_timeout)]
         inner = " ".join(
             [f"{k}={shlex.quote(v)}" for k, v in cfg.to_env().items()]
-            + ["python", shlex.quote(args.training_script)]
+            + ["python", "-m", "accelerate_tpu.commands.accelerate_cli", "launch"]
+            + supervisor_flags
+            + [shlex.quote(args.training_script)]
             + [shlex.quote(a) for a in script_args]
         )
         pod_cmd = [
@@ -78,6 +159,10 @@ def launch_command(args, script_args) -> int:
         for k, v in sorted(cfg.to_env().items()):
             print(f"  {k}={v}")
         return 0
+    if args.max_restarts and args.max_restarts > 0:
+        return _supervise(
+            cmd, env, args.max_restarts, args.monitor_interval, args.watchdog_timeout
+        )
     return subprocess.call(cmd, env=env)
 
 
@@ -92,6 +177,16 @@ def add_parser(subparsers) -> None:
     for axis in ("dp_replicate", "dp_shard", "pp", "cp", "sp", "tp", "ep"):
         p.add_argument(f"--{axis}_size", type=int, default=None)
     p.add_argument("--pod", default=None, help="TPU pod name: fan out over gcloud ssh --worker=all")
+    p.add_argument("--max_restarts", type=int, default=0,
+                   help="relaunch the script up to N times when it dies (per-host supervisor)")
+    p.add_argument("--monitor_interval", type=float, default=5.0,
+                   help="seconds between child liveness polls")
+    p.add_argument("--watchdog_timeout", type=float, default=0.0,
+                   help=">0: kill the worker if it stops heartbeating for this many "
+                        "seconds. The heartbeat ticks per optimizer step and around "
+                        "checkpoint save/load — set this comfortably above the first-"
+                        "step XLA compile time or the watchdog will kill a healthy "
+                        "worker mid-compile")
     p.add_argument("--debug", action="store_true", help="enable collective shape verification")
     p.add_argument("--dry_run", action="store_true", help="print the command and env, don't run")
     p.add_argument("training_script", nargs="?")

@@ -21,6 +21,8 @@ TPU-native re-design of the reference's ``data_loader.py`` (1,473 LoC,
 
 from __future__ import annotations
 
+import collections
+import copy
 import math
 import queue
 import threading
@@ -391,6 +393,17 @@ class _BaseAcceleratedLoader:
         self.total_dataset_length = total_dataset_length
         self._total_batch_size = total_batch_size
         self.iteration = 0
+        # exact mid-epoch position: batches handed to the training loop this
+        # epoch (skipped batches count). The sampler.bin role — reference
+        # checkpointing.py:154-179 + torchdata StatefulDataLoader backing.
+        self._position = 0
+        self._skip_once = 0  # one-shot resume skip set by load_state_dict
+        # stateful-dataset support: snapshots taken at PRODUCTION time ride a
+        # FIFO so the state reported by state_dict() matches the batch the
+        # training loop actually holds — the lookahead + device prefetcher
+        # consume the underlying dataset several batches ahead
+        self._ds_state_fifo: collections.deque = collections.deque()
+        self._last_ds_state = None
 
     @property
     def total_batch_size(self) -> Optional[int]:
@@ -462,6 +475,25 @@ class _BaseAcceleratedLoader:
 
         return recursively_apply(put, batch)
 
+
+    def _with_ds_snapshots(self, it):
+        """When the dataset is stateful, record its state after producing each
+        batch; consumed FIFO-aligned in _iter_with_gradient_state."""
+        ds = self.dataset
+        if not hasattr(ds, "state_dict"):
+            return it
+
+        def snapshotting():
+            self._ds_state_fifo.clear()
+            for batch in it:
+                try:
+                    self._ds_state_fifo.append(copy.deepcopy(ds.state_dict()))
+                except Exception:  # noqa: BLE001 — protocol is best-effort
+                    pass
+                yield batch
+
+        return snapshotting()
+
     def _iter_with_gradient_state(self, raw_iter):
         self.end_of_dataloader = False
         self.gradient_state._add_dataloader(self)
@@ -479,11 +511,23 @@ class _BaseAcceleratedLoader:
             have = False
             for nxt in raw_iter:
                 if have:
+                    # count-then-yield: a batch is "consumed" the moment the
+                    # loop receives it, so a save_state taken while processing
+                    # batch k resumes at k+1
+                    self._position += 1
+                    if self._ds_state_fifo:
+                        self._last_ds_state = self._ds_state_fifo.popleft()
                     yield current
                 current, have = nxt, True
             if have:
                 self.end_of_dataloader = True
+                self._position += 1
+                if self._ds_state_fifo:
+                    self._last_ds_state = self._ds_state_fifo.popleft()
                 yield current
+                # the consumer drained the epoch: a checkpoint taken after
+                # this point must NOT replay-skip into the next epoch
+                self._position = 0
         finally:
             self.gradient_state._remove_dataloader(self)
             self.iteration += 1
@@ -541,25 +585,54 @@ class DataLoaderShard(_BaseAcceleratedLoader):
         if self.total_dataset_length is not None and self.total_batch_size:
             rem = self.total_dataset_length % self.total_batch_size
             self.remainder = rem if rem != 0 else -1
+        # _skip_once is an ABSOLUTE resume position (it already includes any
+        # skip_first_batches offset, since _position counts skipped batches);
+        # summing the two would double-skip on resume
+        skip = self._skip_once if self._skip_once else self._skip_batches
+        self._skip_once = 0
+        self._position = skip
         it = iter(self.inner)
-        for _ in range(self._skip_batches):
+        for _ in range(skip):
             next(it, None)
-        yield from self._iter_with_gradient_state(it)
+        yield from self._iter_with_gradient_state(self._with_ds_snapshots(it))
 
     def state_dict(self) -> dict:
-        """Resumable-iteration state (role of torchdata StatefulDataLoader
-        backing, reference data_loader.py:422-444)."""
-        return {
+        """EXACT resumable-iteration state (the sampler.bin role, reference
+        checkpointing.py:154-179; torchdata StatefulDataLoader backing,
+        reference data_loader.py:422-444): epoch + batches already consumed
+        this epoch, plus the dataset's own state when it implements the
+        stateful protocol (the iterable-dataset story)."""
+        state = {
             "iteration": self.iteration,
             "skip_batches": self._skip_batches,
+            "position": self._position,
             "epoch": getattr(self.sampler, "epoch", 0) if self.sampler is not None else 0,
         }
+        ds = self.dataset
+        if self._last_ds_state is not None:
+            state["dataset_state"] = self._last_ds_state
+        elif hasattr(ds, "state_dict"):
+            try:
+                state["dataset_state"] = ds.state_dict()
+            except Exception:  # noqa: BLE001 — stateful protocol is best-effort
+                pass
+        return state
 
     def load_state_dict(self, state: dict) -> None:
         self.iteration = state.get("iteration", 0)
         self._skip_batches = state.get("skip_batches", 0)
         if self.sampler is not None and hasattr(self.sampler, "set_epoch"):
             self.sampler.set_epoch(state.get("epoch", 0))
+        ds = self.dataset
+        if "dataset_state" in state and hasattr(ds, "load_state_dict"):
+            # stateful dataset resumes itself — no skip replay needed
+            ds.load_state_dict(state["dataset_state"])
+        else:
+            # deterministic replay: seeded samplers re-derive the same order
+            # from (seed, epoch), so skipping `position` batches lands exactly
+            # where the checkpoint was taken (also correct for deterministic
+            # iterables, which are replayed then fast-forwarded)
+            self._skip_once = state.get("position", 0)
 
 
 class DataLoaderDispatcher(_BaseAcceleratedLoader):
@@ -639,7 +712,41 @@ class DataLoaderDispatcher(_BaseAcceleratedLoader):
         if self.total_dataset_length is not None and self.total_batch_size:
             rem = self.total_dataset_length % self.total_batch_size
             self.remainder = rem if rem != 0 else -1
-        yield from self._iter_with_gradient_state(self._fetch())
+        skip = self._skip_once
+        self._skip_once = 0
+        self._position = skip
+        it = self._fetch()
+        for _ in range(skip):
+            next(it, None)
+        yield from self._iter_with_gradient_state(self._with_ds_snapshots(it))
+
+    def set_epoch(self, epoch: int) -> None:
+        if hasattr(self.inner, "set_epoch"):
+            self.inner.set_epoch(epoch)
+        elif hasattr(self.dataset, "set_epoch"):
+            self.dataset.set_epoch(epoch)
+
+    def state_dict(self) -> dict:
+        """Exact resume state; rank-0 reads the data so the position (plus the
+        dataset's own state when stateful) fully describes the stream."""
+        state = {"iteration": self.iteration, "position": self._position}
+        ds = self.dataset
+        if self._last_ds_state is not None:
+            state["dataset_state"] = self._last_ds_state
+        elif hasattr(ds, "state_dict"):
+            try:
+                state["dataset_state"] = ds.state_dict()
+            except Exception:  # noqa: BLE001
+                pass
+        return state
+
+    def load_state_dict(self, state: dict) -> None:
+        self.iteration = state.get("iteration", 0)
+        ds = self.dataset
+        if "dataset_state" in state and hasattr(ds, "load_state_dict"):
+            ds.load_state_dict(state["dataset_state"])
+        else:
+            self._skip_once = state.get("position", 0)
 
 
 # -------------------------------------------------------------- native loader

@@ -53,13 +53,22 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _debug_worker(rank, num_processes, port, function, args, queue):
+def _debug_worker(rank, num_processes, port, function, args, queue, local_devices=1):
     try:
         os.environ["JAX_PLATFORMS"] = "cpu"
         os.environ["ACCELERATE_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
         os.environ["ACCELERATE_NUM_PROCESSES"] = str(num_processes)
         os.environ["ACCELERATE_PROCESS_ID"] = str(rank)
         import jax
+
+        # the env var alone is NOT enough: a sitecustomize-registered TPU
+        # plugin selects its platform via jax config at interpreter startup,
+        # and a worker that touches it hangs on a dead relay
+        jax.config.update("jax_platforms", "cpu")
+        # deterministic cluster size regardless of the parent's XLA_FLAGS
+        # (pytest forces an 8-device host; workers are 1 device each unless
+        # the test asks otherwise)
+        jax.config.update("jax_num_cpu_devices", local_devices)
 
         jax.distributed.initialize(
             coordinator_address=f"127.0.0.1:{port}",
@@ -72,21 +81,29 @@ def _debug_worker(rank, num_processes, port, function, args, queue):
         queue.put((rank, traceback.format_exc()))
 
 
-def debug_launcher(function: Callable, args: Tuple = (), num_processes: int = 2) -> None:
+def debug_launcher(function: Callable, args: Tuple = (), num_processes: int = 2, local_devices: int = 1) -> None:
     """Run ``function`` under a real ``num_processes``-process CPU JAX cluster
     (reference launchers.py:287 uses gloo FileStore; this is true SPMD)."""
     ctx = multiprocessing.get_context("spawn")
     port = _free_port()
     queue = ctx.Queue()
     procs = [
-        ctx.Process(target=_debug_worker, args=(r, num_processes, port, function, args, queue))
+        ctx.Process(target=_debug_worker, args=(r, num_processes, port, function, args, queue, local_devices))
         for r in range(num_processes)
     ]
-    for p in procs:
-        p.start()
+    # children inherit the parent env at spawn: drop the TPU-relay trigger so
+    # their sitecustomize never dials it (workers are CPU by contract)
+    relay = os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    try:
+        for p in procs:
+            p.start()
+    finally:
+        if relay is not None:
+            os.environ["PALLAS_AXON_POOL_IPS"] = relay
+    timeout = float(os.environ.get("ACCELERATE_DEBUG_LAUNCHER_TIMEOUT", 600))
     errors = []
     for _ in procs:
-        rank, err = queue.get(timeout=300)
+        rank, err = queue.get(timeout=timeout)
         if err is not None:
             errors.append(f"--- rank {rank} ---\n{err}")
     for p in procs:

@@ -149,8 +149,7 @@ class AcceleratedOptimizer:
     # ------------------------------------------------------------------ setup
     def init(self, model) -> None:
         self.model = model
-        # jit so moment buffers inherit param shardings via GSPMD propagation
-        self.opt_state = jax.jit(self.tx.init)(model.params)
+        self.opt_state = self._init_opt_state(model.params)
 
         def apply(params, opt_state, grads):
             # grads may arrive in a compressed comm dtype (bf16/fp16 DDP
@@ -163,6 +162,57 @@ class AcceleratedOptimizer:
             return new_params, new_opt_state
 
         self._update_fn = jax.jit(apply, donate_argnums=(0, 1, 2))
+
+    def _init_opt_state(self, params):
+        """Initialize optimizer state with EXPLICIT out_shardings: each state
+        leaf whose tree path ends in a param's path (mu/nu/etc. mirror the
+        param tree) inherits that param's sharding; everything else (step
+        counts, scalars) is replicated.
+
+        This is ZeRO-3 *by construction*: optax's ``init`` never reads the
+        param values, so XLA drops the data dependence and plain
+        ``jit(tx.init)`` places the fresh state uncommitted on one device —
+        sharded-by-accident only after the first update, and a checkpoint
+        restore of that initial state commits it single-device, clashing with
+        the sharded params (reference keeps ZeRO state sharded via its engine
+        config, deepspeed.py / fsdp_utils.py)."""
+        from .parallel.sharding import path_of
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        mesh = None
+        param_entries: dict = {}
+
+        def collect(key_path, p):
+            nonlocal mesh
+            sharding = getattr(p, "sharding", None)
+            if isinstance(sharding, NamedSharding) and mesh is None:
+                mesh = sharding.mesh
+            param_entries[path_of(key_path)] = (
+                tuple(getattr(p, "shape", ())), sharding
+            )
+
+        jax.tree_util.tree_map_with_path(collect, params)
+        if mesh is None:  # unsharded params — plain placement is fine
+            return jax.jit(self.tx.init)(params)
+
+        abstract = jax.eval_shape(self.tx.init, params)
+        replicated = NamedSharding(mesh, PartitionSpec())
+
+        def out_sharding(key_path, aval):
+            path = path_of(key_path)
+            for ppath, (shape, sharding) in param_entries.items():
+                # component-boundary suffix match: "mu/proj_w" must not match
+                # param "w" just because the strings line up
+                if (
+                    sharding is not None
+                    and (path == ppath or path.endswith("/" + ppath))
+                    and tuple(aval.shape) == shape
+                ):
+                    return sharding
+            return replicated
+
+        out_shardings = jax.tree_util.tree_map_with_path(out_sharding, abstract)
+        return jax.jit(self.tx.init, out_shardings=out_shardings)(params)
 
     @property
     def params(self):

@@ -1,1 +1,14 @@
+from .testing import (
+    DEFAULT_LAUNCH_COMMAND,
+    cpu_spmd_env,
+    execute_subprocess,
+    launch_script,
+    parse_flag_from_env,
+    require_cpu,
+    require_module,
+    require_multidevice,
+    require_multihost,
+    require_tpu,
+    slow,
+)
 from .training import RegressionDataset, RegressionModel, make_regression_data

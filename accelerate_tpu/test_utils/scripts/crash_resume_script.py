@@ -1,0 +1,77 @@
+"""Fault-tolerance drive script: train N steps, optionally crash partway,
+resume from the latest checkpoint on supervisor restart.
+
+Run under ``accelerate-tpu launch --max_restarts 1`` (commands/launch.py
+supervisor): the first attempt dies at ``--crash_at``, the restart resumes
+from the last ``save_state`` and must land on a bit-identical final state —
+the recovery contract the reference documents for torchrun elastic restarts
+(reference commands/launch.py:589-620 + usage docs on
+``load_state``/``skip_first_batches``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import numpy as np
+
+import jax
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.models.llama import LlamaConfig, create_llama, llama_loss
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--project_dir", required=True)
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--total_steps", type=int, default=6)
+    ap.add_argument("--save_every", type=int, default=2)
+    ap.add_argument("--crash_at", type=int, default=-1,
+                    help="die (rc 13) at the END of this step — first attempt only")
+    args = ap.parse_args()
+
+    accelerator = Accelerator(project_dir=args.project_dir)
+    accelerator.project_configuration.automatic_checkpoint_naming = True
+
+    config = LlamaConfig.tiny(num_hidden_layers=1)
+    model, optimizer = accelerator.prepare(
+        create_llama(config, seed=0), optax.adamw(1e-2)
+    )
+    resumed = accelerator.resume_from_latest()
+    restart = int(os.environ.get("ACCELERATE_RESTART_COUNT", "0"))
+    print(f"start: resumed={resumed} restart={restart} step={accelerator.step}")
+
+    loss = None
+    for step in range(accelerator.step, args.total_steps):
+        # deterministic per-step batch so a replayed step sees identical data
+        rng = np.random.default_rng(1234 + step)
+        batch = {
+            "input_ids": rng.integers(
+                0, config.vocab_size, size=(4, 16)
+            ).astype(np.int32)
+        }
+        with accelerator.accumulate(model):
+            loss = accelerator.backward(llama_loss, batch)
+            optimizer.step()
+            optimizer.zero_grad()
+        if (step + 1) % args.save_every == 0:
+            accelerator.save_state()
+        if step == args.crash_at and restart == 0:
+            print(f"crashing at step {step}")
+            os._exit(13)
+
+    flat = np.concatenate(
+        [
+            np.asarray(jax.device_get(leaf)).ravel()
+            for leaf in jax.tree_util.tree_leaves(model.params)
+        ]
+    )
+    np.save(args.out, flat)
+    print(f"done: final_loss={float(loss):.6f}")
+
+
+if __name__ == "__main__":
+    main()

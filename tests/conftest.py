@@ -24,6 +24,26 @@ jax.config.update("jax_num_cpu_devices", 8)
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: compile-heavy test, skipped unless RUN_SLOW=1 (reference RUN_SLOW gate)",
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    """Without RUN_SLOW=1, skip tests marked slow — keeps the default suite
+    inside a CI-sized budget; `make test_all` runs everything."""
+    from accelerate_tpu.test_utils.testing import parse_flag_from_env
+
+    if parse_flag_from_env("RUN_SLOW"):
+        return
+    skip = pytest.mark.skip(reason="slow — set RUN_SLOW=1 to run")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip)
+
+
 @pytest.fixture(autouse=True)
 def reset_state():
     """Reset the Borg singletons between tests (the analogue of the

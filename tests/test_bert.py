@@ -53,6 +53,7 @@ def test_bert_scan_matches_unrolled():
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_bert_trains_sharded():
     """The nlp_example workload shape: BERT classification on the 8-dev mesh."""
     acc = Accelerator(parallelism_config=ParallelismConfig(dp_shard_size=8))

@@ -23,6 +23,7 @@ def _reset():
     PartialState._reset_state()
 
 
+@pytest.mark.slow
 def test_3d_tp_pp_fsdp_training():
     """Megatron's 3D layout (tp×pp×dp) as pure sharding rules + one test
     trajectory vs plain FSDP."""

@@ -166,6 +166,7 @@ def test_zigzag_gqa_and_grads():
         np.testing.assert_allclose(np.asarray(g), np.asarray(r), atol=1e-4)
 
 
+@pytest.mark.slow
 def test_zigzag_llama_training():
     from accelerate_tpu.models.llama import LlamaConfig, create_llama, llama_loss
     from accelerate_tpu.utils.dataclasses import ContextParallelConfig

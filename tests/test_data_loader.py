@@ -209,3 +209,88 @@ def test_prefetch_iterator_propagates_errors():
     with pytest.raises(RuntimeError, match="boom"):
         next(pf)
         next(pf)
+
+
+# ----------------------------------------------------- exact mid-epoch resume
+def test_exact_midepoch_resume_shuffled():
+    """Save at batch k of a shuffled epoch, restore into a FRESH loader: the
+    rest of the epoch is bit-identical (the sampler.bin contract)."""
+    mesh = make_mesh(dp_shard_size=8)
+    data = {"x": np.arange(32.0)[:, None]}
+
+    def build():
+        return dl.prepare_data_loader(
+            data, mesh=mesh, batch_size=8, shuffle=True, seed=5, drop_last=True
+        )
+
+    loader = build()
+    loader.set_epoch(1)
+    it = iter(loader)
+    consumed = [np.asarray(next(it)["x"]).ravel().tolist() for _ in range(2)]
+    state = loader.state_dict()
+    remaining_ref = [np.asarray(b["x"]).ravel().tolist() for b in it]
+
+    fresh = build()
+    fresh.load_state_dict(state)
+    resumed = [np.asarray(b["x"]).ravel().tolist() for b in fresh]
+    assert resumed == remaining_ref
+    assert len(resumed) == 4 - 2
+    # the epoch after the resumed one is complete and un-skipped
+    fresh.set_epoch(2)
+    assert len([1 for _ in fresh]) == 4
+
+
+def test_exact_midepoch_resume_iterable():
+    """Deterministic iterable datasets resume by replay+skip."""
+    mesh = make_mesh(dp_shard_size=8)
+
+    class Stream:
+        def __iter__(self):
+            for i in range(6):
+                yield {"x": np.full((8, 1), float(i))}
+
+    loader = dl.prepare_data_loader(Stream(), mesh=mesh)
+    it = iter(loader)
+    for _ in range(2):
+        next(it)
+    state = loader.state_dict()
+    remaining_ref = [float(np.asarray(b["x"]).ravel()[0]) for b in it]
+
+    fresh = dl.prepare_data_loader(Stream(), mesh=mesh)
+    fresh.load_state_dict(state)
+    resumed = [float(np.asarray(b["x"]).ravel()[0]) for b in fresh]
+    assert resumed == remaining_ref == [2.0, 3.0, 4.0, 5.0]
+
+
+def test_exact_midepoch_resume_stateful_dataset():
+    """A dataset implementing the stateful protocol resumes via its own
+    state_dict/load_state_dict (torchdata StatefulDataLoader role)."""
+    mesh = make_mesh(dp_shard_size=8)
+
+    class StatefulStream:
+        def __init__(self):
+            self.cursor = 0
+
+        def __iter__(self):
+            while self.cursor < 6:
+                i = self.cursor
+                self.cursor += 1
+                yield {"x": np.full((8, 1), float(i))}
+
+        def state_dict(self):
+            return {"cursor": self.cursor}
+
+        def load_state_dict(self, sd):
+            self.cursor = sd["cursor"]
+
+    loader = dl.prepare_data_loader(StatefulStream(), mesh=mesh)
+    it = iter(loader)
+    for _ in range(3):
+        next(it)
+    state = loader.state_dict()
+    assert "dataset_state" in state
+
+    fresh = dl.prepare_data_loader(StatefulStream(), mesh=mesh)
+    fresh.load_state_dict(state)
+    resumed = [float(np.asarray(b["x"]).ravel()[0]) for b in fresh]
+    assert resumed == [3.0, 4.0, 5.0]

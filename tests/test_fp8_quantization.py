@@ -48,6 +48,7 @@ def test_fp8_dot_grads():
     assert rel < 0.15
 
 
+@pytest.mark.slow
 def test_llama_fp8_training_runs():
     from accelerate_tpu import Accelerator
     from accelerate_tpu.models.llama import LlamaConfig, create_llama, llama_loss

@@ -57,6 +57,7 @@ def test_generate_sharded():
     assert np.all(np.asarray(out) < cfg.vocab_size)
 
 
+@pytest.mark.slow
 def test_sampled_generation_deterministic_by_seed():
     cfg = LlamaConfig.tiny()
     model = create_llama(cfg, seed=0)
@@ -68,6 +69,7 @@ def test_sampled_generation_deterministic_by_seed():
     assert not np.array_equal(a, c)
 
 
+@pytest.mark.slow
 def test_moe_decode_matches_full_forward():
     # ample capacity so the full forward drops nothing — otherwise capacity
     # drops (batch-global) differ from decode routing (per position)
@@ -101,6 +103,7 @@ def test_moe_generate_runs():
     assert out.shape == (1, 7)
 
 
+@pytest.mark.slow
 def test_generate_tp_sharded():
     cfg = LlamaConfig.tiny()
     model = create_llama(cfg, seed=0)

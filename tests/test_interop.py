@@ -174,6 +174,7 @@ def test_hf_roundtrip_still_exact_with_rope_permute():
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b), err_msg=str(pa))
 
 
+@pytest.mark.slow
 def test_hf_llama_logits_match_torch_transformers():
     """Ground truth: convert an actual transformers LlamaForCausalLM state
     dict and match its logits to ~float precision."""

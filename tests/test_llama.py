@@ -58,6 +58,7 @@ def test_causality():
     assert not np.allclose(np.asarray(a[0, 10:]), np.asarray(b[0, 10:]), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_llama_trains_with_fsdp_and_tp():
     """2-way FSDP × 2-way TP × 2-way DP-replicate on the 8-device mesh."""
     pcfg = ParallelismConfig(dp_replicate_size=2, dp_shard_size=2, tp_size=2)
@@ -86,6 +87,7 @@ def test_llama_trains_with_fsdp_and_tp():
     assert losses[-1] < losses[0]  # learning
 
 
+@pytest.mark.slow
 def test_fused_step_llama():
     pcfg = ParallelismConfig(dp_shard_size=8)
     accelerator = Accelerator(parallelism_config=pcfg)

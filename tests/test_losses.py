@@ -98,6 +98,7 @@ def test_chunked_ce_no_stacked_residuals():
     assert not bad, f"stacked residuals the size of full logits found: {bad}"
 
 
+@pytest.mark.slow
 def test_llama_chunked_ce_matches_standard():
     from accelerate_tpu.models.llama import LlamaConfig, create_llama, llama_loss
 

@@ -62,6 +62,7 @@ def test_moe_ffn_forward():
     assert float(aux) > 0
 
 
+@pytest.mark.slow
 def test_moe_llama_trains_with_ep():
     """2-way EP × 2-way FSDP × 2-way DP on the 8-device mesh."""
     from accelerate_tpu.models.llama import LlamaConfig, create_llama, llama_loss

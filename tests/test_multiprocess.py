@@ -64,3 +64,91 @@ def _worker_body():
 @pytest.mark.slow
 def test_two_process_collectives():
     debug_launcher(_worker_body, num_processes=2)
+
+
+def _ckpt_save_body(path):
+    import numpy as np
+
+    import jax
+
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from accelerate_tpu.checkpointing import save_pytree
+    from accelerate_tpu.state import PartialState
+
+    state = PartialState()
+    assert state.num_processes == 2
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    data = np.arange(32.0, dtype=np.float32).reshape(8, 4)
+    arr = jax.make_array_from_callback(
+        (8, 4), NamedSharding(mesh, P("dp")), lambda idx: data[idx]
+    )
+    save_pytree({"w": arr}, path)
+    state.wait_for_everyone()
+
+
+def _ckpt_restore_body(path, expect_procs):
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from accelerate_tpu.checkpointing import load_pytree
+    from accelerate_tpu.state import PartialState
+
+    state = PartialState()
+    assert state.num_processes == expect_procs
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    sharding = NamedSharding(mesh, P("dp"))
+    target = {
+        "w": jax.make_array_from_callback(
+            (8, 4), sharding, lambda idx: np.zeros((8, 4), np.float32)[idx]
+        )
+    }
+    restored = load_pytree(path, target=target)
+    expect = np.arange(32.0, dtype=np.float32).reshape(8, 4)
+    for shard in restored["w"].addressable_shards:
+        np.testing.assert_array_equal(np.asarray(shard.data), expect[shard.index])
+
+
+@pytest.mark.slow
+def test_multiprocess_checkpoint_restores_under_different_process_count(tmp_path):
+    """Orbax checkpoint written by a 2-process cluster restores correctly in a
+    4-process cluster (resharding restore exercised cross-process — the role
+    of the reference's merge/redistribute FSDP paths)."""
+    path = str(tmp_path / "ckpt")
+    debug_launcher(_ckpt_save_body, args=(path,), num_processes=2)
+    debug_launcher(_ckpt_restore_body, args=(path, 4), num_processes=4)
+
+
+def _loader_body():
+    import numpy as np
+
+    from accelerate_tpu import data_loader as dl
+    from accelerate_tpu.parallelism_config import ParallelismConfig
+    from accelerate_tpu.state import PartialState
+
+    state = PartialState()
+    mesh = ParallelismConfig(dp_shard_size=state.num_processes).build_device_mesh()
+    data = {"x": np.arange(16.0, dtype=np.float32)[:, None]}
+    # batch_size is PER-PROCESS (reference convention): global batch = 8
+    loader = dl.prepare_data_loader(
+        data, mesh=mesh, batch_size=8 // state.num_processes, drop_last=True
+    )
+    batches = list(loader)
+    assert len(batches) == 2
+    for k, batch in enumerate(batches):
+        expect = np.arange(16.0, dtype=np.float32)[:, None][k * 8 : (k + 1) * 8]
+        # every process contributed only its local rows; the assembled global
+        # array (make_array_from_process_local_data) must equal the full batch
+        for shard in batch["x"].addressable_shards:
+            np.testing.assert_array_equal(np.asarray(shard.data), expect[shard.index])
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("procs", [2, 4])
+def test_multiprocess_dataloader_local_rows(procs):
+    """Each process reads only its shard; the assembled global batch is the
+    full dataset in order (mesh-aware shard math, data_loader.py
+    data_shard_info + make_array_from_process_local_data)."""
+    debug_launcher(_loader_body, num_processes=procs)

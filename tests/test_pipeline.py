@@ -38,6 +38,7 @@ def test_pipeline_forward_matches_scan():
     np.testing.assert_allclose(ref, out, atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_pipeline_training_matches_non_pipelined():
     rng = np.random.default_rng(0)
     data = {"input_ids": rng.integers(0, 256, size=(8, 32)).astype(np.int32)}

@@ -24,6 +24,7 @@ def test_forward_shapes():
     assert logits.dtype == jnp.float32
 
 
+@pytest.mark.slow
 def test_resnet50_param_count():
     cfg = ResNetConfig.resnet50(num_classes=10)
     model = create_resnet(cfg)
@@ -31,6 +32,7 @@ def test_resnet50_param_count():
     assert 5e6 < model.num_parameters < 5e7
 
 
+@pytest.mark.slow
 def test_trains_sharded():
     acc = Accelerator(parallelism_config=ParallelismConfig(dp_shard_size=8))
     cfg = ResNetConfig.tiny()

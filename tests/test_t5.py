@@ -63,6 +63,7 @@ def test_scan_matches_unrolled():
     np.testing.assert_allclose(np.asarray(a), np.asarray(c), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_t5_trains_sharded():
     acc = Accelerator(parallelism_config=ParallelismConfig(dp_shard_size=8))
     cfg = T5Config.tiny()
