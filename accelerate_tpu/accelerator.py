@@ -346,6 +346,18 @@ class Accelerator:
                     "pp parallelism configured but the model exposes no "
                     "set_layer_stack_fn hook; layers will not be pipelined"
                 )
+            if pp_cfg.schedule == "1f1b":
+                if hasattr(model, "pipeline_parts"):
+                    # train_step swaps in the hand-scheduled 1F1B grad path;
+                    # forward/eval keeps the GPipe layer stack above
+                    model._pp_1f1b_cfg = pp_cfg
+                else:
+                    logger.warning(
+                        "pp schedule '1f1b' requested but the model exposes no "
+                        "pipeline_parts contract (MoE models fold aux losses "
+                        "the 1F1B path does not yet carry); falling back to "
+                        "the GPipe schedule"
+                    )
         if model not in self._models:
             self._models.append(model)
         return model
@@ -621,6 +633,47 @@ class Accelerator:
         use_scaler = self.scaler is not None
         grad_comm_dtype = self.ddp_handler.gradient_dtype if self.ddp_handler else None
 
+        pp_1f1b_cfg = getattr(model, "_pp_1f1b_cfg", None)
+        if pp_1f1b_cfg is not None and loss_fn is not getattr(
+            model, "canonical_loss", loss_fn
+        ):
+            # the 1F1B schedule owns loss+backward via the model's
+            # pipeline_parts; it cannot honor a custom objective
+            logger.warning(
+                "pp schedule '1f1b' computes the model's built-in loss; the "
+                "custom loss_fn passed to train_step would be silently "
+                "ignored — falling back to the GPipe schedule for this step "
+                "function (set schedule='gpipe' to silence this warning)"
+            )
+            pp_1f1b_cfg = None
+        if pp_1f1b_cfg is not None:
+            from .parallel.pp_1f1b import make_1f1b_value_and_grad
+
+            pipeline_vag = make_1f1b_value_and_grad(
+                self.mesh, pp_1f1b_cfg.num_microbatches
+            )
+            embed_fn, stage_fn, head_loss_fn, loss_denom_fn = model.pipeline_parts()
+
+            def _pipeline_grads(params, scale, batch):
+                """1F1B path: the schedule owns loss+backward (the model's
+                built-in LM loss via pipeline_parts)."""
+                if len(batch) != 1 or not isinstance(batch[0], dict):
+                    raise ValueError(
+                        "the 1f1b schedule expects a single dict batch — use "
+                        "schedule='gpipe' for other batch layouts"
+                    )
+                stage_params = params["layers"]
+                io_params = {kk: v for kk, v in params.items() if kk != "layers"}
+                loss, g_stage, g_io = pipeline_vag(
+                    stage_params, io_params, batch[0],
+                    embed_fn, stage_fn, head_loss_fn,
+                    loss_denom=loss_denom_fn(batch[0]),
+                    cotangent_scale=scale / k,
+                )
+                grads = dict(g_io)
+                grads["layers"] = g_stage
+                return loss, grads
+
         def fused(params, opt_state, accum, count, scaler_state, *batch):
             def wrapped(p):
                 out = loss_fn(model.bind(p), *batch)
@@ -628,7 +681,12 @@ class Accelerator:
                 scale = scaler_state["scale"] if use_scaler else jnp.float32(1.0)
                 return loss * scale / k, (loss, aux)
 
-            (_, (loss, _aux)), grads = jax.value_and_grad(wrapped, has_aux=True)(params)
+            if pp_1f1b_cfg is not None:
+                scale = scaler_state["scale"] if use_scaler else jnp.float32(1.0)
+                loss, grads = _pipeline_grads(params, scale, batch)
+                _aux = None
+            else:
+                (_, (loss, _aux)), grads = jax.value_and_grad(wrapped, has_aux=True)(params)
             if grad_comm_dtype is not None:
                 # comm-hook compression: gradients reduce/accumulate in the
                 # compressed dtype (same semantic as the eager path)

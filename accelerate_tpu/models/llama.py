@@ -337,6 +337,67 @@ def llama_apply(
     return logits
 
 
+def _mask_of(labels, mask):
+    """HF semantics: explicit loss_mask wins (sliced to the label length),
+    else labels < 0 (the -100 ignore index) are excluded."""
+    if mask is None:
+        return (labels >= 0).astype(jnp.float32)
+    return mask[:, : labels.shape[1]].astype(jnp.float32)
+
+
+def _dense_ce_from_logits(logits, labels, mask, reduction="mean"):
+    """Masked CE from full logits. One-hot einsum instead of
+    take_along_axis: its transpose is a clean matmul where the gather's
+    backward is a scatter-add the SPMD partitioner reshards involuntarily
+    under dp×cp meshes. ``reduction="sum"`` returns the masked nll SUM —
+    the caller divides by its own (global) valid-token count."""
+    mask = _mask_of(labels, mask)
+    labels = jnp.maximum(labels, 0)
+    lse = jax.scipy.special.logsumexp(logits.astype(jnp.float32), axis=-1)
+    # one-hot in the logits dtype — a float32 copy would double the (B,S,V)
+    # transient; the f32 accumulation happens inside the einsum
+    onehot = jax.nn.one_hot(labels, logits.shape[-1], dtype=logits.dtype)
+    label_logit = jnp.einsum(
+        "bsv,bsv->bs", logits, onehot, preferred_element_type=jnp.float32
+    )
+    total = jnp.sum((lse - label_logit) * mask)
+    if reduction == "sum":
+        return total
+    return total / jnp.maximum(jnp.sum(mask), 1)
+
+
+def _ce_from_hidden(config, x, head, labels, mask, *, reduction="mean",
+                    ce_chunk_size=None):
+    """Shared CE tail (label mask/-100 handling, chunked or dense) used by
+    both :func:`llama_loss` and the 1F1B pipeline head so the two paths stay
+    provably identical."""
+    if config.use_chunked_ce:
+        from ..ops.losses import chunked_softmax_cross_entropy
+
+        return chunked_softmax_cross_entropy(
+            x, head.astype(x.dtype), jnp.maximum(labels, 0),
+            chunk_size=ce_chunk_size or config.ce_chunk_size,
+            loss_mask=_mask_of(labels, mask), reduction=reduction,
+        )
+    logits = (x @ head.astype(config.compute_dtype)).astype(jnp.float32)
+    return _dense_ce_from_logits(logits, labels, mask, reduction=reduction)
+
+
+def llama_ce_denominator(batch):
+    """Global valid-token count matching :func:`_ce_from_hidden`'s mask —
+    the denominator the 1F1B schedule divides its per-microbatch nll sums
+    by (so cross-microbatch mask imbalance keeps llama_loss semantics)."""
+    labels = batch.get("labels")
+    if labels is None:
+        labels = batch["input_ids"][:, 1:]
+    mask = batch.get("loss_mask")
+    if mask is None:
+        mask = (labels >= 0).astype(jnp.float32)
+    else:
+        mask = mask[:, : labels.shape[1]].astype(jnp.float32)
+    return jnp.maximum(jnp.sum(mask), 1)
+
+
 def llama_loss(model_view, batch, ce_chunk_size: int = 4096):
     """Next-token cross entropy; ``batch = {"input_ids": (B,S)}`` with
     optional ``"labels"`` (defaults to shifted input_ids) and
@@ -346,23 +407,21 @@ def llama_loss(model_view, batch, ce_chunk_size: int = 4096):
     vocab slices; static)."""
     input_ids = batch["input_ids"]
     out = model_view(input_ids)
+    labels = batch.get("labels")
+    mask = batch.get("loss_mask")
     if isinstance(out, dict) and "hidden" in out:
         from ..ops.losses import chunked_softmax_cross_entropy
 
         hidden = out["hidden"]
-        labels = batch.get("labels")
-        mask = batch.get("loss_mask")
         if labels is None:
             labels = input_ids[:, 1:]
             hidden = hidden[:, :-1]
-            if mask is not None:
-                mask = mask[:, : hidden.shape[1]]
         loss = chunked_softmax_cross_entropy(
             hidden,
             out["head_kernel"].astype(hidden.dtype),
-            labels,
+            jnp.maximum(labels, 0),
             chunk_size=ce_chunk_size,
-            loss_mask=mask,
+            loss_mask=_mask_of(labels, mask),
         )
         if "aux_loss" in out:
             loss = loss + out["aux_loss"]
@@ -371,32 +430,62 @@ def llama_loss(model_view, batch, ce_chunk_size: int = 4096):
         logits, aux = out
     else:
         logits, aux = out, None
-    labels = batch.get("labels")
     if labels is None:
         labels = input_ids[:, 1:]
         logits = logits[:, :-1]
-    mask = batch.get("loss_mask")
-    if mask is None:
-        # HF-style ignore index: labels < 0 contribute zero loss
-        mask = (labels >= 0).astype(jnp.float32)
-    else:
-        mask = mask[:, : labels.shape[1]]
-    labels = jnp.maximum(labels, 0)
-    # one-hot einsum instead of take_along_axis: its transpose is a clean
-    # matmul (softmax - onehot), where the gather's backward is a scatter-add
-    # the SPMD partitioner reshards involuntarily under dp×cp meshes
-    lse = jax.scipy.special.logsumexp(logits.astype(jnp.float32), axis=-1)
-    # one-hot in the logits dtype — a float32 copy would double the (B,S,V)
-    # transient; the f32 accumulation happens inside the einsum
-    onehot = jax.nn.one_hot(labels, logits.shape[-1], dtype=logits.dtype)
-    label_logit = jnp.einsum(
-        "bsv,bsv->bs", logits, onehot, preferred_element_type=jnp.float32
-    )
-    nll = lse - label_logit
-    loss = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1)
+    loss = _dense_ce_from_logits(logits, labels, mask)
     if aux is not None:
         loss = loss + aux["aux_loss"]
     return loss
+
+
+def llama_pipeline_parts(config: LlamaConfig, attention_fn: Optional[Callable] = None):
+    """(embed_fn, stage_fn, head_loss_fn) for the hand-scheduled 1F1B
+    pipeline (parallel/pp_1f1b.py). The head loss mirrors :func:`llama_loss`
+    (label shift, loss_mask, HF -100 ignore index, chunked CE).
+
+    MoE aux losses are not yet folded into the 1F1B path — Accelerator falls
+    back to GPipe for expert models."""
+    cdt = config.compute_dtype
+    layer_fn = functools.partial(
+        _layer, config, position_offset=0, attention_fn=attention_fn
+    )
+    policy = _remat_policy(config.remat_policy)
+    if config.remat_policy != "full":
+        layer_fn = jax.checkpoint(layer_fn, policy=policy)
+
+    def embed_fn(params, mb):
+        return constrain_activation(
+            params["embed_tokens"]["embedding"].astype(cdt)[mb["input_ids"]]
+        )
+
+    def stage_fn(stage_params, h):
+        def body(h, lp):
+            h, _aux = layer_fn(lp, h)
+            return h, None
+
+        h, _ = lax.scan(body, h, stage_params)
+        return h
+
+    def head_loss_fn(params, h, mb):
+        """Masked nll SUM over this microbatch (reduction handled by the
+        schedule: it divides by the GLOBAL valid-token count from
+        :func:`llama_ce_denominator`, so per-microbatch mask imbalance keeps
+        exactly llama_loss's sum/count semantics)."""
+        x = rms_norm(h, params["final_norm"]["scale"], config.rms_norm_eps)
+        head = (
+            params["embed_tokens"]["embedding"].T
+            if config.tie_word_embeddings
+            else params["lm_head"]["kernel"]
+        )
+        labels = mb.get("labels")
+        mask = mb.get("loss_mask")
+        if labels is None:
+            labels = mb["input_ids"][:, 1:]
+            x = x[:, :-1]
+        return _ce_from_hidden(config, x, head, labels, mask, reduction="sum")
+
+    return embed_fn, stage_fn, head_loss_fn, llama_ce_denominator
 
 
 # --------------------------------------------------------- HF checkpoint IO
@@ -698,6 +787,13 @@ def create_llama(config: LlamaConfig, seed: int = 0) -> Model:
 
     model.set_attention_fn = set_attention_fn
     model.set_layer_stack_fn = set_layer_stack_fn
+    model.canonical_loss = llama_loss
+    if config.num_experts <= 1:
+        # 1F1B contract (parallel/pp_1f1b.py); lazy so a later
+        # set_attention_fn (ring/Ulysses) is picked up
+        model.pipeline_parts = lambda: llama_pipeline_parts(
+            config, overrides["attention_fn"]
+        )
     return model
 
 

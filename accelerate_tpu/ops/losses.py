@@ -28,6 +28,7 @@ def chunked_softmax_cross_entropy(
     chunk_size: int = 4096,
     loss_mask: Optional[jax.Array] = None,
     logit_dtype=jnp.float32,
+    reduction: str = "mean",
 ):
     """Mean CE of ``softmax(hidden @ head_kernel)`` against ``labels``.
 
@@ -100,4 +101,9 @@ def chunked_softmax_cross_entropy(
         jax.checkpoint(body), init, (kernel_chunks, jnp.arange(n_chunks))
     )
     nll = (m + jnp.log(jnp.maximum(l, 1e-30))) - label_logit
-    return jnp.sum(nll * loss_mask) / jnp.maximum(jnp.sum(loss_mask), 1)
+    total = jnp.sum(nll * loss_mask)
+    if reduction == "sum":
+        # caller owns the denominator (e.g. the 1F1B schedule divides by the
+        # GLOBAL valid-token count so microbatch mask imbalance can't skew it)
+        return total
+    return total / jnp.maximum(jnp.sum(loss_mask), 1)

@@ -1,0 +1,231 @@
+"""1F1B pipeline schedule: hand-interleaved forward/backward over ``pp``.
+
+The reference gets 1F1B only by delegating training to Megatron-LM
+(reference utils/megatron_lm.py:926+ ``train_step``/schedules); this is a
+native JAX implementation. Why hand-scheduled: ``jax.grad`` of a pipelined
+*forward* transposes into an all-forwards-then-all-backwards program — GPipe
+memory, with every microbatch's stage inputs alive at once (m live
+activations per stage). 1F1B starts each microbatch's backward as soon as
+the last stage finishes its forward, which bounds live state to a ring of
+``n_stages + 1`` stage inputs regardless of the microbatch count. That
+requires owning the loss inside the schedule, so this module computes
+(loss, grads) directly instead of composing with an outer
+``jax.value_and_grad``.
+
+Schedule (non-interleaved 1F1B, unit slots; n = stages, m = microbatches):
+
+* stage ``i`` runs forward of microbatch ``f`` at tick ``i + 2f``;
+* stage ``i`` runs backward of microbatch ``f`` at tick ``2n - 1 - i + 2f``;
+* total ticks ``2(m + n - 1)``; per tick a stage does one forward and one
+  backward slot (at most one of them maps to a real microbatch — the two
+  parities never collide), so in-flight inputs per stage ≤ n.
+
+SPMD uniformity: every stage executes the SAME per-tick program — embed,
+stage scan, head+loss, and one vjp — with roles (first/last stage) and
+fill/drain validity applied as ``jnp.where`` masks, never as ``lax.cond``
+branches. Divergent conds would put the dp/fsdp all-gathers inside a branch
+only some pp groups take, and collectives reached in different orders on
+different devices deadlock (observed on XLA:CPU; the same hazard exists on
+TPU). The price is bubble-slot garbage compute (the standard accept for
+SPMD pipelines) and head+embed FLOPs on every stage; the memory bound and
+the constant-in-m trace size are what 1F1B is for.
+
+Backward recomputes the stage forward from the saved stage input
+(``jax.vjp``), i.e. per-stage rematerialization: live memory is the input
+ring, not per-layer residuals. The tick loop is a ``lax.fori_loop`` —
+compile time is constant in the microbatch count (the GPipe path unrolls
+``m + n - 1`` ticks at trace time, parallel/pp.py:68).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["make_1f1b_value_and_grad"]
+
+
+def _tree_add(a, b):
+    return jax.tree_util.tree_map(jnp.add, a, b)
+
+
+def _tree_mask(mask, tree):
+    # where (not multiply): garbage-slot grads may be inf/nan and 0*nan=nan
+    return jax.tree_util.tree_map(lambda g: jnp.where(mask, g, 0), tree)
+
+
+def _index_mb(microbatches, f):
+    return jax.tree_util.tree_map(
+        lambda a: lax.dynamic_index_in_dim(a, f, axis=0, keepdims=False),
+        microbatches,
+    )
+
+
+def make_1f1b_value_and_grad(
+    mesh: Mesh,
+    num_microbatches: int,
+    pp_axis: str = "pp",
+    batch_axes=("dp_replicate", "dp_shard"),
+    seq_axes=("cp", "sp"),
+) -> Callable:
+    """Build ``vag(stage_params, io_params, batch, embed_fn, stage_fn,
+    head_loss_fn, cotangent_scale) -> (loss, stage_grads, io_grads)``.
+
+    * ``stage_params``: pytree with a leading stacked-layer dim sharded
+      ``P(pp)`` (each stage holds L/n layers);
+    * ``io_params``: embedding/norm/head params, replicated over pp;
+    * ``batch``: pytree of (B, ...) arrays (B = m · microbatch rows);
+    * ``embed_fn(io_params, mb) -> h``; ``stage_fn(local_stage_params, h)
+      -> h``; ``head_loss_fn(io_params, h, mb) -> scalar loss SUM`` for that
+      microbatch (not a mean);
+    * ``loss_denom``: the GLOBAL denominator (e.g. total valid-token count)
+      — per-microbatch sums divide by it, so mask imbalance across
+      microbatches reproduces exactly the non-pipelined sum/count loss;
+    * ``cotangent_scale``: seed for the backward (loss-scale / accum-steps —
+      the same factor the non-pipelined path folds into its loss).
+
+    Returns the UNSCALED ``Σ sums / loss_denom`` loss and grads scaled by
+    ``cotangent_scale`` (matching ``jax.grad`` of ``scale * loss``).
+    """
+    n = mesh.shape[pp_axis]
+    m = num_microbatches
+    if n < 2:
+        raise ValueError("1F1B needs pp >= 2")
+
+    def vag(stage_params, io_params, batch, embed_fn, stage_fn, head_loss_fn,
+            loss_denom, cotangent_scale=1.0):
+        leaves = jax.tree_util.tree_leaves(batch)
+        b = leaves[0].shape[0]
+        if b % m != 0:
+            raise ValueError(f"batch {b} not divisible by num_microbatches {m}")
+        mb_rows = b // m
+        micro = jax.tree_util.tree_map(
+            lambda a: a.reshape(m, mb_rows, *a.shape[1:]), batch
+        )
+        # keep the microbatch dim unsharded and the row dim on the data axes
+        # (the flat batch was dp-sharded on dim 0; reshape alone would leave
+        # GSPMD free to shard the m dim)
+        b_axes = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1)
+        s_axes = tuple(a for a in seq_axes if mesh.shape.get(a, 1) > 1)
+        micro = jax.tree_util.tree_map(
+            lambda a: jax.lax.with_sharding_constraint(
+                a,
+                NamedSharding(
+                    mesh,
+                    P(None, b_axes or None,
+                      *([s_axes] if (s_axes and a.ndim > 2) else [])),
+                ),
+            ),
+            micro,
+        )
+
+        def pipeline(stage_local, io_local, micro_local, denom):
+            idx = lax.axis_index(pp_axis)
+            first_mask = (idx == 0)
+            last_mask = (idx == n - 1)
+
+            h_shape = jax.eval_shape(
+                embed_fn, io_local, _index_mb(micro_local, 0)
+            )
+            wire = jnp.zeros(h_shape.shape, h_shape.dtype)
+            # slot n is a scratch slot: invalid (fill/drain) ticks write there
+            ring0 = jnp.zeros((n + 1, *h_shape.shape), h_shape.dtype)
+            g_stage0 = jax.tree_util.tree_map(jnp.zeros_like, stage_local)
+            g_io0 = jax.tree_util.tree_map(jnp.zeros_like, io_local)
+
+            perm_fwd = [(i, i + 1) for i in range(n - 1)]
+            perm_bwd = [(i + 1, i) for i in range(n - 1)]
+            total = 2 * (m + n - 1)
+            ct = jnp.float32(cotangent_scale)
+
+            def objective(sp, io, h_saved, mb):
+                """Uniform per-stage objective: every stage runs embed + stage
+                + head+loss; ``jnp.where`` picks which pieces are real. Its
+                single vjp serves all three roles via the cotangent seed."""
+                h_in = jnp.where(first_mask, embed_fn(io, mb).astype(h_saved.dtype), h_saved)
+                h_out = stage_fn(sp, h_in)
+                loss = head_loss_fn(io, h_out, mb)
+                return loss, h_out
+
+            def tick(t, carry):
+                recv_f, recv_b, ring, loss_acc, g_stage, g_io = carry
+
+                tf = t - idx
+                f_fwd = jnp.clip(tf // 2, 0, m - 1)
+                fwd_valid = (tf >= 0) & (tf % 2 == 0) & (tf // 2 < m)
+                tb = t - (2 * n - 1 - idx)
+                f_bwd = jnp.clip(tb // 2, 0, m - 1)
+                bwd_valid = (tb >= 0) & (tb % 2 == 0) & (tb // 2 < m)
+
+                # ---------- forward slot: bank the input, run the stage
+                mb_f = _index_mb(micro_local, f_fwd)
+                h_in = jnp.where(
+                    first_mask, embed_fn(io_local, mb_f).astype(wire.dtype), recv_f
+                )
+                ring = lax.dynamic_update_index_in_dim(
+                    ring, h_in, jnp.where(fwd_valid, f_fwd % n, n), 0
+                )
+                h_out = stage_fn(stage_local, h_in)
+                h_out = jnp.where(fwd_valid, h_out, 0)
+
+                # ---------- backward slot: one uniform vjp from the ring
+                mb_b = _index_mb(micro_local, f_bwd)
+                h_saved = lax.dynamic_index_in_dim(
+                    ring, f_bwd % n, 0, keepdims=False
+                )
+                (loss_f, _h), vjp = jax.vjp(
+                    objective, stage_local, io_local, h_saved, mb_b
+                )
+                # last stage seeds the loss cotangent; earlier stages seed the
+                # wire cotangent arriving from downstream
+                loss_ct = jnp.where(last_mask, ct / denom, 0.0).astype(jnp.float32)
+                out_ct = jnp.where(last_mask, jnp.zeros_like(recv_b), recv_b)
+                g_sp, g_iod, d_h, _ = vjp((loss_ct, out_ct))
+
+                loss_acc = loss_acc + jnp.where(
+                    bwd_valid & last_mask, loss_f / denom, 0.0
+                )
+                g_stage = _tree_add(g_stage, _tree_mask(bwd_valid, g_sp))
+                g_io = _tree_add(g_io, _tree_mask(bwd_valid, g_iod))
+                d_h = jnp.where(bwd_valid, d_h, 0)
+
+                # serialize the two wires: they are data-independent, and
+                # collectives started in different orders on different devices
+                # deadlock the CPU backend's rendezvous
+                recv_f = lax.ppermute(h_out, pp_axis, perm_fwd)
+                d_h, _ = lax.optimization_barrier((d_h, recv_f))
+                recv_b = lax.ppermute(d_h, pp_axis, perm_bwd)
+                return (recv_f, recv_b, ring, loss_acc, g_stage, g_io)
+
+            carry = (
+                wire, jnp.zeros_like(wire), ring0,
+                jnp.float32(0.0), g_stage0, g_io0,
+            )
+            _, _, _, loss_acc, g_stage, g_io = lax.fori_loop(0, total, tick, carry)
+
+            # loss lives on the last stage, io grads are partial per stage
+            # (embed on first, head on last, garbage-masked zeros elsewhere):
+            # share over pp (f32 trees — safe for XLA:CPU AllReducePromotion)
+            loss = lax.psum(loss_acc, pp_axis)
+            g_io = jax.tree_util.tree_map(
+                lambda g: lax.psum(g.astype(jnp.float32), pp_axis).astype(g.dtype),
+                g_io,
+            )
+            return loss, g_stage, g_io
+
+        spec_stage = jax.tree_util.tree_map(lambda _: P(pp_axis), stage_params)
+        fn = jax.shard_map(
+            pipeline,
+            mesh=mesh,
+            in_specs=(spec_stage, P(), P(), P()),
+            out_specs=(P(), spec_stage, P()),
+            axis_names={pp_axis},
+            check_vma=False,
+        )
+        return fn(stage_params, io_params, micro, jnp.asarray(loss_denom, jnp.float32))
+
+    return vag

@@ -279,10 +279,14 @@ class PipelineParallelConfig(KwargsHandler):
     inference via PiPPy — SURVEY §2.4 PP row)."""
 
     num_microbatches: int = 4
-    schedule: str = "gpipe"  # 1F1B is a later round's perf work
+    # "1f1b": hand-scheduled one-forward-one-backward training pipeline with
+    # a bounded (n_stages) activation ring (parallel/pp_1f1b.py). "gpipe":
+    # forward pipeline + autodiff-transposed backward (parallel/pp.py) —
+    # also what forward-only/eval paths always use.
+    schedule: str = "1f1b"
 
     def __post_init__(self):
-        if self.schedule not in ("gpipe",):
+        if self.schedule not in ("gpipe", "1f1b"):
             raise ValueError(f"Unknown pipeline schedule {self.schedule}")
 
 
