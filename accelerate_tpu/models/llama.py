@@ -673,7 +673,7 @@ def _decode_layer(config: LlamaConfig, layer_params, x, cache_k, cache_v, pos):
             layer_params["mlp"]["experts"]["w_up"],
             layer_params["mlp"]["experts"]["w_down"],
             num_selected=config.num_experts_per_tok,
-            capacity_factor=max(config.expert_capacity_factor, float(config.num_experts)),
+            capacity_factor=config.expert_capacity_factor,
             compute_dtype=cdt,
         )
     else:

@@ -15,6 +15,8 @@ behavior) and counted in the aux metrics.
 
 from __future__ import annotations
 
+import math
+
 from typing import NamedTuple, Optional
 
 import jax
@@ -105,7 +107,10 @@ def moe_ffn(
     e = router_kernel.shape[1]
     n = b * s
     tokens = x.reshape(n, d)
-    capacity = max(1, int(capacity_factor * num_selected * n / e))
+    # ceil (not floor) and a num_selected floor: small decode batches would
+    # otherwise round capacity below what even perfectly-balanced routing
+    # needs, silently dropping tokens to the residual path
+    capacity = max(num_selected, math.ceil(capacity_factor * num_selected * n / e))
 
     router_logits = tokens.astype(jnp.float32) @ router_kernel.astype(jnp.float32)
     routing = route_topk(router_logits, num_selected, capacity)
