@@ -32,21 +32,41 @@ def _params_from_preset(name: str) -> float:
         return float(
             sum(np.prod(l.shape) for l in jax.tree_util.tree_leaves(abstract))
         )
-    # try a local transformers config
+    # any transformers model (hub id, cached id, or local directory): exact
+    # count via meta-device instantiation — the reference's init_empty_weights
+    # path (commands/estimate.py:224-310) without ever allocating weights
     try:
         from transformers import AutoConfig
 
         config = AutoConfig.from_pretrained(name)
+    except Exception as e:  # noqa: BLE001
+        raise SystemExit(
+            f"Unknown model {name!r}; use a preset (llama2-7b, bert-base, ...), a "
+            f"hub/cached transformers id, or a local model directory ({e})"
+        )
+    try:
+        import torch
+        import transformers
+
+        # task classes first: bare AutoModel drops the LM/task head, which
+        # undercounts untied-head models by vocab_size*hidden_size
+        model = None
+        for cls_name in ("AutoModelForCausalLM", "AutoModelForSeq2SeqLM", "AutoModel"):
+            try:
+                with torch.device("meta"):
+                    model = getattr(transformers, cls_name).from_config(config)
+                break
+            except Exception:  # noqa: BLE001 — try the next head class
+                continue
+        if model is None:
+            raise RuntimeError("no AutoModel class accepted the config")
+        return float(sum(p.numel() for p in model.parameters()))
+    except Exception:  # noqa: BLE001 — config-only closed-form fallback
         d = getattr(config, "hidden_size", 0)
         L = getattr(config, "num_hidden_layers", 0)
         i = getattr(config, "intermediate_size", 4 * d)
         v = getattr(config, "vocab_size", 32000)
         return float(L * (4 * d * d + 3 * d * i) + 2 * v * d)
-    except Exception as e:  # noqa: BLE001
-        raise SystemExit(
-            f"Unknown model {name!r}; use a preset (llama2-7b, bert-base, ...) or a "
-            f"locally cached transformers id ({e})"
-        )
 
 
 def _human(n: float) -> str:

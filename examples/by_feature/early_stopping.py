@@ -1,0 +1,61 @@
+"""Early stopping example (reference examples/by_feature/early_stopping.py):
+``set_trigger``/``check_trigger`` make a local decision (loss plateau, nan)
+visible to EVERY process so the whole SPMD job stops together."""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.models.bert import BertConfig, bert_classification_loss, create_bert
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--patience", type=int, default=3)
+    parser.add_argument("--epochs", type=int, default=10)
+    args = parser.parse_args()
+
+    accelerator = Accelerator()
+    cfg = BertConfig.tiny()
+    rng = np.random.default_rng(0)
+    data = {
+        "input_ids": rng.integers(0, cfg.vocab_size, size=(64, 32)).astype(np.int32),
+        "labels": rng.integers(0, 2, size=(64,)).astype(np.int32),
+    }
+    loader = accelerator.prepare_data_loader(data, batch_size=8, drop_last=True)
+    model, optimizer = accelerator.prepare(create_bert(cfg), optax.adamw(1e-3))
+
+    best = float("inf")
+    bad_epochs = 0
+    for epoch in range(args.epochs):
+        epoch_loss = 0.0
+        batches = 0
+        for batch in loader:
+            with accelerator.accumulate(model):
+                loss = accelerator.backward(bert_classification_loss, batch)
+                optimizer.step()
+                optimizer.zero_grad()
+            epoch_loss += float(loss)
+            batches += 1
+        epoch_loss /= max(batches, 1)
+        accelerator.print(f"epoch={epoch} loss={epoch_loss:.4f}")
+
+        if epoch_loss < best - 1e-4:
+            best = epoch_loss
+            bad_epochs = 0
+        else:
+            bad_epochs += 1
+        if bad_epochs >= args.patience:
+            # any process may fire the trigger; every process sees it
+            accelerator.set_trigger()
+        if accelerator.check_trigger():
+            accelerator.print(f"early stop at epoch {epoch} (best={best:.4f})")
+            break
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,63 @@
+"""Fault-tolerance example: run under the supervised launcher and survive
+crashes with exact resume.
+
+    accelerate-tpu launch --max_restarts 3 --watchdog_timeout 600 \
+        examples/by_feature/fault_tolerance.py --project_dir /tmp/run1
+
+The script is restart-agnostic: ``resume_from_latest`` is a no-op on first
+launch and restores model/optimizer/dataloader position after a supervisor
+restart (commands/launch.py supervisor; ACCELERATE_RESTART_COUNT tells you
+which attempt this is)."""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.models.llama import LlamaConfig, create_llama, llama_loss
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--project_dir", required=True)
+    parser.add_argument("--total_steps", type=int, default=20)
+    parser.add_argument("--save_every", type=int, default=5)
+    args = parser.parse_args()
+
+    accelerator = Accelerator(project_dir=args.project_dir)
+    accelerator.project_configuration.automatic_checkpoint_naming = True
+    accelerator.project_configuration.total_limit = 3
+
+    cfg = LlamaConfig.tiny()
+    model, optimizer = accelerator.prepare(create_llama(cfg, seed=0), optax.adamw(1e-3))
+
+    resumed = accelerator.resume_from_latest()
+    restart = int(os.environ.get("ACCELERATE_RESTART_COUNT", "0"))
+    accelerator.print(
+        f"attempt={restart} resumed={resumed} starting at step {accelerator.step}"
+    )
+
+    rng = np.random.default_rng(0)
+    for step in range(accelerator.step, args.total_steps):
+        batch = {
+            "input_ids": np.random.default_rng(1000 + step).integers(
+                0, cfg.vocab_size, size=(8, 64)
+            ).astype(np.int32)
+        }
+        with accelerator.accumulate(model):
+            loss = accelerator.backward(llama_loss, batch)
+            optimizer.step()
+            optimizer.zero_grad()
+        if (step + 1) % args.save_every == 0:
+            accelerator.save_state()
+            accelerator.print(f"step={step + 1} loss={float(loss):.4f} [checkpoint]")
+
+    accelerator.print("training complete")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,54 @@
+"""LocalSGD example (reference examples/by_feature/local_sgd.py): k local
+per-data-shard optimizer steps between parameter averages — one parameter
+all-reduce every ``local_sgd_steps`` instead of a gradient all-reduce per
+step. See accelerate_tpu/local_sgd.py for the TPU-native formulation."""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.local_sgd import LocalSGD
+from accelerate_tpu.models.bert import BertConfig, bert_classification_loss, create_bert
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--local_sgd_steps", type=int, default=4)
+    parser.add_argument("--batch_size", type=int, default=8)
+    parser.add_argument("--steps", type=int, default=8)
+    args = parser.parse_args()
+
+    accelerator = Accelerator()
+    cfg = BertConfig.tiny()
+    model = accelerator.prepare(create_bert(cfg))
+    rng = np.random.default_rng(0)
+    data = {
+        "input_ids": rng.integers(0, cfg.vocab_size, size=(128, 32)).astype(np.int32),
+        "labels": rng.integers(0, 2, size=(128,)).astype(np.int32),
+    }
+    loader = accelerator.prepare_data_loader(
+        data, batch_size=args.batch_size, drop_last=True
+    )
+
+    with LocalSGD(
+        accelerator, model, optax.adamw(1e-3), bert_classification_loss,
+        local_sgd_steps=args.local_sgd_steps,
+    ) as local_sgd:
+        done = 0
+        while done < args.steps:
+            for batch in loader:
+                loss = local_sgd.train_step(batch)
+                local_sgd.step()
+                done += 1
+                accelerator.print(f"step={done} loss={float(loss):.4f}")
+                if done >= args.steps:
+                    break
+    accelerator.print("final params averaged across data shards")
+
+
+if __name__ == "__main__":
+    main()

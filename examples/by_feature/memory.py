@@ -1,0 +1,49 @@
+"""OOM-backoff example (reference examples/by_feature/memory.py):
+``find_executable_batch_size`` halves the batch size on out-of-memory until
+the training function fits — the decorated function re-runs from scratch
+with the new size, so build model/loaders inside it."""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.models.llama import LlamaConfig, create_llama, llama_loss
+from accelerate_tpu.utils.memory import find_executable_batch_size
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--starting_batch_size", type=int, default=64)
+    parser.add_argument("--steps", type=int, default=4)
+    args = parser.parse_args()
+
+    accelerator = Accelerator()
+    cfg = LlamaConfig.tiny()
+    rng = np.random.default_rng(0)
+
+    @find_executable_batch_size(starting_batch_size=args.starting_batch_size)
+    def train(batch_size):
+        accelerator.print(f"trying batch_size={batch_size}")
+        model, optimizer = accelerator.prepare(
+            create_llama(cfg, seed=0), optax.adamw(1e-3)
+        )
+        step = accelerator.train_step(llama_loss, max_grad_norm=1.0)
+        for _ in range(args.steps):
+            batch = {
+                "input_ids": rng.integers(
+                    0, cfg.vocab_size, size=(batch_size, 64)
+                ).astype(np.int32)
+            }
+            loss = step(batch)
+        return batch_size, float(loss)
+
+    batch_size, loss = train()
+    accelerator.print(f"fit at batch_size={batch_size}, final loss={loss:.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -82,3 +82,20 @@ def test_merge_weights(tmp_path):
     assert rc == 0
     flat = load_sharded_safetensors(str(tmp_path / "out"))
     np.testing.assert_array_equal(flat["layer.w"], tree["layer"]["w"])
+
+
+def test_estimate_memory_local_hf_model_dir(tmp_path, capsys):
+    """Arbitrary transformers models (hub id or local dir) get an EXACT param
+    count via meta-device instantiation (reference estimate.py:224-310)."""
+    from transformers import LlamaConfig as HFLlamaConfig
+
+    HFLlamaConfig(
+        vocab_size=1000, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
+    ).save_pretrained(tmp_path)
+    rc = main(["estimate-memory", str(tmp_path), "--json"])
+    assert rc == 0
+    payload = json.loads(capsys.readouterr().out)
+    # exact, incl. the (untied) lm_head: embed 1000*64 + 2 layers *
+    # (4*64*64 + 3*64*128 + 2*64) + final norm 64 + head 64*1000
+    assert payload["num_params"] == 210240.0

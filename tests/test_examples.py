@@ -49,3 +49,35 @@ def test_gradient_accumulation_example():
     assert result.returncode == 0, result.stderr[-2000:]
     assert "synced=True" in result.stdout
     assert "synced=False" in result.stdout
+
+
+@pytest.mark.slow
+def test_local_sgd_example():
+    result = _run("by_feature/local_sgd.py", "--steps", "4")
+    assert result.returncode == 0, result.stderr[-2000:]
+    assert "averaged across data shards" in result.stdout
+
+
+@pytest.mark.slow
+def test_early_stopping_example():
+    result = _run("by_feature/early_stopping.py", "--epochs", "3")
+    assert result.returncode == 0, result.stderr[-2000:]
+    assert "epoch=2" in result.stdout or "early stop" in result.stdout
+
+
+@pytest.mark.slow
+def test_memory_example():
+    result = _run("by_feature/memory.py", "--starting_batch_size", "16", "--steps", "2")
+    assert result.returncode == 0, result.stderr[-2000:]
+    assert "fit at batch_size" in result.stdout
+
+
+@pytest.mark.slow
+def test_fault_tolerance_example(tmp_path):
+    result = _run(
+        "by_feature/fault_tolerance.py",
+        "--project_dir", str(tmp_path),
+        "--total_steps", "6", "--save_every", "3",
+    )
+    assert result.returncode == 0, result.stderr[-2000:]
+    assert "training complete" in result.stdout

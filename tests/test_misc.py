@@ -91,13 +91,21 @@ def test_free_memory_clears_registries():
 
 
 def test_local_sgd_context():
+    """Construction + disabled path; real local-update/averaging semantics
+    are covered in tests/test_local_sgd.py."""
+    import optax
+
     from accelerate_tpu.local_sgd import LocalSGD
+    from accelerate_tpu.test_utils.training import RegressionModel, regression_loss
 
     acc = make_acc()
-    with LocalSGD(acc, local_sgd_steps=2) as lsgd:
+    with LocalSGD(
+        acc, RegressionModel(), optax.sgd(0.1), regression_loss,
+        local_sgd_steps=2, enabled=False,
+    ) as lsgd:
         for _ in range(4):
             lsgd.step()
-    assert lsgd._counter == 4
+    assert lsgd._counter == 0  # disabled: step() is a no-op
 
 
 def test_gradient_accumulation_plugin_validation():
