@@ -181,3 +181,159 @@ def test_wandb_offline_end_to_end(tmp_path, monkeypatch):  # pragma: no cover
     acc.init_trackers("offline-proj", config={"lr": 0.1})
     acc.log({"loss": 1.0}, step=0)
     acc.end_training()
+
+
+def _contract(tmp_path, monkeypatch, name, tracker_cls, fake_module, module_name=None):
+    import sys
+
+    import accelerate_tpu.tracking as tracking_mod
+
+    monkeypatch.setitem(sys.modules, module_name or name, fake_module)
+    monkeypatch.setitem(tracking_mod._TRACKERS, name, (tracker_cls, lambda: True))
+    acc = _fresh(tmp_path, log_with=name)
+    acc.init_trackers("proj", config={"lr": 0.1})
+    acc.log({"loss": 1.5}, step=2)
+    acc.end_training()
+
+
+def test_comet_tracker_contract(tmp_path, monkeypatch):
+    import types
+
+    import accelerate_tpu.tracking as tracking_mod
+
+    calls = []
+    exp = types.SimpleNamespace(
+        log_parameters=lambda v: calls.append(("params", v)),
+        set_step=lambda s: calls.append(("set_step", s)),
+        log_metrics=lambda v, step=None, **kw: calls.append(("metrics", v, step)),
+        end=lambda: calls.append(("end",)),
+    )
+    fake = types.SimpleNamespace(Experiment=lambda project_name, **kw: exp)
+    _contract(tmp_path, monkeypatch, "comet_ml", tracking_mod.CometMLTracker, fake)
+    assert ("params", {"lr": 0.1}) in calls
+    assert ("metrics", {"loss": 1.5}, 2) in calls
+    assert ("end",) in calls
+
+
+def test_aim_tracker_contract(tmp_path, monkeypatch):
+    import types
+
+    import accelerate_tpu.tracking as tracking_mod
+
+    calls = []
+
+    class FakeRun:
+        def __init__(self, repo=None, experiment=None, **kw):
+            calls.append(("init", experiment))
+
+        def __setitem__(self, k, v):
+            calls.append(("set", k, v))
+
+        def track(self, v, name=None, step=None, **kw):
+            calls.append(("track", name, v, step))
+
+        def close(self):
+            calls.append(("close",))
+
+    fake = types.SimpleNamespace(Run=FakeRun)
+    _contract(tmp_path, monkeypatch, "aim", tracking_mod.AimTracker, fake)
+    assert ("init", "proj") in calls
+    assert ("set", "hparams", {"lr": 0.1}) in calls
+    assert ("track", "loss", 1.5, 2) in calls
+    assert ("close",) in calls
+
+
+def test_clearml_tracker_contract(tmp_path, monkeypatch):
+    import types
+
+    import accelerate_tpu.tracking as tracking_mod
+
+    calls = []
+    clogger = types.SimpleNamespace(
+        report_scalar=lambda title, series, value, iteration: calls.append(
+            ("scalar", title, value, iteration)
+        )
+    )
+    task = types.SimpleNamespace(
+        connect_configuration=lambda v: calls.append(("config", v)),
+        get_logger=lambda: clogger,
+        close=lambda: calls.append(("close",)),
+    )
+    fake = types.SimpleNamespace(
+        Task=types.SimpleNamespace(init=lambda project_name, **kw: task)
+    )
+    _contract(tmp_path, monkeypatch, "clearml", tracking_mod.ClearMLTracker, fake)
+    assert ("config", {"lr": 0.1}) in calls
+    assert ("scalar", "loss", 1.5, 2) in calls
+    assert ("close",) in calls
+
+
+def test_dvclive_tracker_contract(tmp_path, monkeypatch):
+    import types
+
+    import accelerate_tpu.tracking as tracking_mod
+
+    calls = []
+
+    class FakeLive:
+        def __init__(self, **kw):
+            calls.append(("init",))
+            self.step = 0
+
+        def log_params(self, v):
+            calls.append(("params", v))
+
+        def log_metric(self, k, v):
+            calls.append(("metric", k, v, self.step))
+
+        def next_step(self):
+            calls.append(("next",))
+
+        def end(self):
+            calls.append(("end",))
+
+    fake = types.SimpleNamespace(Live=FakeLive)
+    _contract(tmp_path, monkeypatch, "dvclive", tracking_mod.DVCLiveTracker, fake)
+    assert ("params", {"lr": 0.1}) in calls
+    assert ("metric", "loss", 1.5, 2) in calls
+    assert ("end",) in calls
+
+
+def test_swanlab_tracker_contract(tmp_path, monkeypatch):
+    import types
+
+    import accelerate_tpu.tracking as tracking_mod
+
+    calls = []
+    run = types.SimpleNamespace(log=lambda v, step=None: calls.append(("log", v, step)))
+    fake = types.SimpleNamespace(
+        init=lambda project, **kw: calls.append(("init", project)) or run,
+        config=types.SimpleNamespace(update=lambda v: calls.append(("config", v))),
+        finish=lambda: calls.append(("finish",)),
+    )
+    _contract(tmp_path, monkeypatch, "swanlab", tracking_mod.SwanLabTracker, fake)
+    assert ("init", "proj") in calls
+    assert ("config", {"lr": 0.1}) in calls
+    assert ("log", {"loss": 1.5}, 2) in calls
+    assert ("finish",) in calls
+
+
+def test_trackio_tracker_contract(tmp_path, monkeypatch):
+    import types
+
+    import accelerate_tpu.tracking as tracking_mod
+
+    calls = []
+    run = types.SimpleNamespace(
+        log=lambda v: calls.append(("log", v)),
+        config=types.SimpleNamespace(update=lambda v: calls.append(("config", v))),
+    )
+    fake = types.SimpleNamespace(
+        init=lambda project, **kw: calls.append(("init", project)) or run,
+        finish=lambda: calls.append(("finish",)),
+    )
+    _contract(tmp_path, monkeypatch, "trackio", tracking_mod.TrackioTracker, fake)
+    assert ("init", "proj") in calls
+    assert ("config", {"lr": 0.1}) in calls
+    assert ("log", {"loss": 1.5}) in calls
+    assert ("finish",) in calls
