@@ -48,6 +48,7 @@ __all__ = [
     "prepare_data_loader",
     "skip_first_batches",
     "default_collate",
+    "make_padded_collate",
 ]
 
 
@@ -61,6 +62,55 @@ def default_collate(samples: Sequence[Any]):
         return type(first)(default_collate([s[i] for s in samples]) for i in range(len(first)))
     arrs = [np.asarray(s) for s in samples]
     return np.stack(arrs, axis=0)
+
+
+def make_padded_collate(
+    pad_token_id: int = 0,
+    max_length: Optional[int] = None,
+    ragged_keys: Sequence[str] = ("input_ids",),
+    emit_loss_mask: bool = True,
+):
+    """Collate_fn for VARIABLE-LENGTH samples: ragged keys are padded to the
+    batch max (or ``max_length``) via the threaded C++ kernel
+    (csrc/packing.cpp collate_padded; NumPy fallback) and a matching
+    ``loss_mask`` is emitted so padding never contributes loss. Non-ragged
+    keys go through :func:`default_collate`. XLA note: pass ``max_length``
+    for a fixed shape — batch-max padding recompiles per distinct length."""
+    from .utils.native import collate_padded
+
+    def collate(samples: Sequence[Any]):
+        if not samples:
+            return {}
+        if not isinstance(samples[0], dict):
+            tokens, mask = collate_padded(samples, max_length, pad_token_id)
+            out = {"input_ids": tokens}
+            if emit_loss_mask:
+                out["loss_mask"] = mask
+            return out
+        # one COMMON width for every ragged key (their shapes must line up —
+        # e.g. labels vs the logits derived from input_ids), and the mask
+        # always describes the PRIMARY ragged key (ragged_keys[0])
+        present = [k for k in ragged_keys if k in samples[0]]
+        width = max_length
+        if width is None and present:
+            width = max(
+                len(np.asarray(s[k]).ravel()) for s in samples for k in present
+            )
+        out = {}
+        mask = None
+        for key in samples[0]:
+            values = [s[key] for s in samples]
+            if key in present:
+                out[key], key_mask = collate_padded(values, width, pad_token_id)
+                if key == present[0]:
+                    mask = key_mask
+            else:
+                out[key] = default_collate(values)
+        if emit_loss_mask and mask is not None and "loss_mask" not in out:
+            out["loss_mask"] = mask
+        return out
+
+    return collate
 
 
 def batch_sharding(

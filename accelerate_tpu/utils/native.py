@@ -15,7 +15,15 @@ from typing import Optional
 
 import numpy as np
 
-__all__ = ["get_packing_lib", "pack_ffd", "pack_contiguous", "fill_packed", "pack_dataset"]
+__all__ = [
+    "get_packing_lib",
+    "pack_ffd",
+    "pack_contiguous",
+    "fill_packed",
+    "pack_dataset",
+    "collate_padded",
+    "collate_padded_flat",
+]
 
 _CACHE_DIR = os.path.expanduser(
     os.environ.get("ACCELERATE_TPU_CACHE", "~/.cache/accelerate_tpu")
@@ -54,6 +62,11 @@ def get_packing_lib() -> Optional[ctypes.CDLL]:
     lib.fill_packed.restype = None
     lib.fill_packed.argtypes = [
         i32p, i64p, i64p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, i32p, i32p,
+    ]
+    f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+    lib.collate_padded.restype = None
+    lib.collate_padded.argtypes = [
+        i32p, i64p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32, i32p, f32p,
     ]
     return lib
 
@@ -141,6 +154,47 @@ def fill_packed(tokens, doc_starts, bin_ids, capacity: int, n_bins: int, pad_id:
         out_segments[b, sl] = seg[b]
         cursor[b] += ln
     return out_tokens, out_segments
+
+
+def collate_padded_flat(flat, offsets, seq_len: int, pad_id: int = 0):
+    """Padded collation straight from a FLAT token buffer + offsets — the hot
+    path for tokenized memmap corpora, where building per-doc arrays would
+    copy everything once extra. flat: (total,) int32; offsets: (n+1,) int64;
+    returns ((n, S) int32 tokens, (n, S) f32 mask)."""
+    flat = np.ascontiguousarray(flat, dtype=np.int32)
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    n = len(offsets) - 1
+    out_tokens = np.empty((n, seq_len), dtype=np.int32)
+    out_mask = np.empty((n, seq_len), dtype=np.float32)
+    lib = get_packing_lib()
+    if lib is not None:
+        lib.collate_padded(
+            flat, offsets, n, seq_len, pad_id,
+            out_tokens.reshape(-1), out_mask.reshape(-1),
+        )
+        return out_tokens, out_mask
+    out_tokens.fill(pad_id)
+    out_mask.fill(0.0)
+    for i in range(n):
+        ln = min(int(offsets[i + 1] - offsets[i]), seq_len)
+        out_tokens[i, :ln] = flat[offsets[i] : offsets[i] + ln]
+        out_mask[i, :ln] = 1.0
+    return out_tokens, out_mask
+
+
+def collate_padded(docs, seq_len: Optional[int] = None, pad_id: int = 0):
+    """Ragged list of 1-D int sequences → ((n, S) int32 tokens, (n, S) f32
+    mask). The threaded C++ kernel plays torch's C++ pad_sequence/collate
+    role; NumPy fallback inside :func:`collate_padded_flat`."""
+    n = len(docs)
+    arrays = [np.asarray(d, dtype=np.int32).ravel() for d in docs]
+    lengths = np.asarray([a.size for a in arrays], dtype=np.int64)
+    if seq_len is None:
+        seq_len = int(lengths.max()) if n else 0
+    offsets = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(lengths, out=offsets[1:])
+    flat = np.concatenate(arrays) if n else np.zeros(0, np.int32)
+    return collate_padded_flat(flat, offsets, seq_len, pad_id)
 
 
 def pack_dataset(documents, seq_len: int, pad_id: int = 0, preserve_order: bool = False):

@@ -12,6 +12,7 @@
 #include <algorithm>
 #include <cstdint>
 #include <numeric>
+#include <thread>
 #include <vector>
 
 extern "C" {
@@ -108,6 +109,42 @@ void fill_packed(const int32_t* tokens, const int64_t* doc_starts,
     }
     cur += len;
   }
+}
+
+
+// Threaded ragged→padded batch collation — the role torch's C++
+// default_collate + pad_sequence play for variable-length token samples.
+//   flat[total]       concatenated tokens of the batch's docs, in order
+//   offsets[n+1]      doc i occupies flat[offsets[i], offsets[i+1])
+//   seq_len           output row width (docs truncate to it)
+//   out_tokens[n*seq_len], out_mask[n*seq_len] — filled completely
+void collate_padded(const int32_t* flat, const int64_t* offsets, int64_t n,
+                    int64_t seq_len, int32_t pad_id, int32_t* out_tokens,
+                    float* out_mask) {
+  auto work = [&](int64_t b0, int64_t b1) {
+    for (int64_t i = b0; i < b1; ++i) {
+      const int64_t len =
+          std::min<int64_t>(offsets[i + 1] - offsets[i], seq_len);
+      int32_t* row = out_tokens + i * seq_len;
+      float* mrow = out_mask + i * seq_len;
+      std::copy(flat + offsets[i], flat + offsets[i] + len, row);
+      std::fill(row + len, row + seq_len, pad_id);
+      std::fill(mrow, mrow + len, 1.0f);
+      std::fill(mrow + len, mrow + seq_len, 0.0f);
+    }
+  };
+  const int64_t nthreads =
+      std::min<int64_t>(8, std::max<int64_t>(1, n / 256));
+  if (nthreads <= 1) {
+    work(0, n);
+    return;
+  }
+  std::vector<std::thread> threads;
+  const int64_t chunk = (n + nthreads - 1) / nthreads;
+  for (int64_t t = 0; t < nthreads; ++t) {
+    threads.emplace_back(work, t * chunk, std::min(n, (t + 1) * chunk));
+  }
+  for (auto& th : threads) th.join();
 }
 
 }  // extern "C"

@@ -73,3 +73,77 @@ def test_fill_packed_native_vs_python(monkeypatch):
     t2, s2 = native.pack_dataset(docs, seq_len=8)
     np.testing.assert_array_equal(t1, t2)
     np.testing.assert_array_equal(s1, s2)
+
+
+def test_collate_padded_native_matches_fallback(monkeypatch):
+    rng = np.random.default_rng(0)
+    # 2048 docs: nthreads = min(8, n/256) = 8 — exercises the THREADED
+    # branch of the C++ kernel, not just the single-thread early return
+    docs = [rng.integers(0, 100, size=rng.integers(1, 40)).astype(np.int32)
+            for _ in range(2048)]
+    t_native, m_native = native.collate_padded(docs, seq_len=32, pad_id=7)
+    monkeypatch.setattr(native, "get_packing_lib", lambda: None)
+    t_py, m_py = native.collate_padded(docs, seq_len=32, pad_id=7)
+    np.testing.assert_array_equal(t_native, t_py)
+    np.testing.assert_array_equal(m_native, m_py)
+    assert t_native.shape == (2048, 32)
+    # truncation + padding semantics
+    lengths = np.asarray([min(len(d), 32) for d in docs])
+    np.testing.assert_array_equal(m_native.sum(axis=1), lengths.astype(np.float32))
+
+
+def test_collate_padded_batch_max_width():
+    docs = [[1, 2, 3], [4], [5, 6]]
+    tokens, mask = native.collate_padded(docs, pad_id=0)
+    assert tokens.shape == (3, 3)
+    np.testing.assert_array_equal(tokens[1], [4, 0, 0])
+    np.testing.assert_array_equal(mask[1], [1.0, 0.0, 0.0])
+
+
+def test_make_padded_collate_through_loader():
+    """Ragged SFT-style dataset → padded batches + loss_mask via the
+    dataloader, consumable by llama_loss (mask zeroes padding)."""
+    from accelerate_tpu import data_loader as dl
+    from accelerate_tpu.parallelism_config import ParallelismConfig
+
+    class Ragged:
+        def __len__(self):
+            return 16
+
+        def __getitem__(self, i):
+            return {"input_ids": list(range(1, 2 + i % 5)), "idx": i}
+
+    mesh = ParallelismConfig(dp_shard_size=8).build_device_mesh()
+    loader = dl.prepare_data_loader(
+        Ragged(), mesh=mesh, batch_size=8, drop_last=True,
+        collate_fn=dl.make_padded_collate(pad_token_id=0, max_length=8),
+    )
+    batches = list(loader)
+    assert len(batches) == 2
+    batch = batches[0]
+    assert batch["input_ids"].shape == (8, 8)
+    assert batch["loss_mask"].shape == (8, 8)
+    assert batch["idx"].shape == (8,)
+    row0 = np.asarray(batch["input_ids"][0])
+    m0 = np.asarray(batch["loss_mask"][0])
+    n_real = int(m0.sum())
+    np.testing.assert_array_equal(row0[:n_real], np.arange(1, n_real + 1))
+    assert (row0[n_real:] == 0).all()
+
+
+def test_make_padded_collate_multiple_ragged_keys_common_width():
+    """input_ids and labels pad to ONE common width; the mask describes the
+    primary key (input_ids), never a shorter secondary key."""
+    from accelerate_tpu.data_loader import make_padded_collate
+
+    collate = make_padded_collate(
+        pad_token_id=0, ragged_keys=("input_ids", "labels")
+    )
+    samples = [
+        {"input_ids": [1, 2, 3, 4, 5], "labels": [2, 3]},
+        {"input_ids": [6, 7], "labels": [7]},
+    ]
+    batch = collate(samples)
+    assert batch["input_ids"].shape == batch["labels"].shape == (2, 5)
+    np.testing.assert_array_equal(batch["loss_mask"][0], [1, 1, 1, 1, 1])
+    np.testing.assert_array_equal(batch["loss_mask"][1], [1, 1, 0, 0, 0])
