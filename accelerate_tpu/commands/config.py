@@ -25,15 +25,20 @@ class ClusterConfig:
 
     mixed_precision: str = "no"
     num_processes: int = 1
+    machine_rank: int = 0
     coordinator_address: Optional[str] = None
     dp_replicate_size: int = 1
     dp_shard_size: int = -1
     pp_size: int = 1
+    pp_num_microbatches: int = 4
+    pp_schedule: str = "1f1b"
     cp_size: int = 1
     sp_size: int = 1
     tp_size: int = 1
     ep_size: int = 1
     gradient_accumulation_steps: int = 1
+    max_restarts: int = 0
+    watchdog_timeout: float = 0.0
     debug: bool = False
 
     def to_env(self) -> dict[str, str]:
@@ -45,10 +50,14 @@ class ClusterConfig:
             size = getattr(self, f"{axis}_size")
             if size != 1:
                 env[f"PARALLELISM_CONFIG_{axis.upper()}_SIZE"] = str(size)
+        if self.pp_size > 1:
+            env["PARALLELISM_CONFIG_PP_MICROBATCHES"] = str(self.pp_num_microbatches)
+            env["PARALLELISM_CONFIG_PP_SCHEDULE"] = self.pp_schedule
         if self.debug:
             env["ACCELERATE_DEBUG_MODE"] = "1"
         if self.num_processes > 1:
             env["ACCELERATE_NUM_PROCESSES"] = str(self.num_processes)
+            env["ACCELERATE_PROCESS_ID"] = str(self.machine_rank)
             if self.coordinator_address:
                 env["ACCELERATE_COORDINATOR_ADDRESS"] = self.coordinator_address
         return env
@@ -84,13 +93,34 @@ def config_command(args, extra) -> int:
         cfg = ClusterConfig(
             mixed_precision=_ask("mixed precision (no/bf16/fp16/fp8)", "bf16"),
             num_processes=_ask("number of host processes", 1, int),
-            dp_shard_size=_ask("FSDP shard size (-1 = all remaining devices)", -1, int),
-            tp_size=_ask("tensor parallel size", 1, int),
-            cp_size=_ask("context parallel size", 1, int),
             gradient_accumulation_steps=_ask("gradient accumulation steps", 1, int),
         )
         if cfg.num_processes > 1:
+            cfg.machine_rank = _ask("rank of this machine (0..N-1)", 0, int)
             cfg.coordinator_address = _ask("coordinator address (host:port)", "localhost:12345")
+        cfg.dp_shard_size = _ask("FSDP shard size (-1 = all remaining devices)", -1, int)
+        if _ask("configure model/sequence parallelism beyond FSDP? (y/n)", "n").lower().startswith("y"):
+            cfg.dp_replicate_size = _ask("DDP replica groups (HSDP outer dim)", 1, int)
+            cfg.tp_size = _ask("tensor parallel size", 1, int)
+            cfg.cp_size = _ask("context parallel size (ring attention)", 1, int)
+            cfg.sp_size = _ask("sequence parallel size (Ulysses)", 1, int)
+            cfg.ep_size = _ask("expert parallel size (MoE)", 1, int)
+            cfg.pp_size = _ask("pipeline parallel stages", 1, int)
+            if cfg.pp_size > 1:
+                cfg.pp_num_microbatches = _ask("pipeline microbatches", 4, int)
+                while True:
+                    schedule = _ask("pipeline schedule (1f1b/gpipe)", "1f1b").lower()
+                    if schedule in ("1f1b", "gpipe"):
+                        cfg.pp_schedule = schedule
+                        break
+                    print("  please answer 1f1b or gpipe")
+        if _ask("enable fault-tolerant supervision? (y/n)", "n").lower().startswith("y"):
+            cfg.max_restarts = _ask("max restarts", 3, int)
+            cfg.watchdog_timeout = _ask(
+                "hang watchdog timeout seconds (0 = off; set above first-step compile time)",
+                0.0, float,
+            )
+        cfg.debug = _ask("collective shape-verification debug mode? (y/n)", "n").lower().startswith("y")
     path = cfg.save(args.config_file or DEFAULT_CONFIG_FILE)
     print(f"Configuration saved to {path}")
     return 0

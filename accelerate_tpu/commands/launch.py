@@ -92,6 +92,15 @@ def _supervise(cmd, env, max_restarts: int, monitor_interval: float,
                 pass
 
 
+def _supervision_settings(args, cfg) -> tuple[int, float]:
+    """CLI flags override the config file; an EXPLICIT --max_restarts 0 /
+    --watchdog_timeout 0 disables supervision (flags default to None so
+    unset and explicit-zero are distinguishable)."""
+    max_restarts = args.max_restarts if args.max_restarts is not None else cfg.max_restarts
+    watchdog = args.watchdog_timeout if args.watchdog_timeout is not None else cfg.watchdog_timeout
+    return int(max_restarts or 0), float(watchdog or 0.0)
+
+
 def launch_command(args, script_args) -> int:
     cfg = None
     config_file = args.config_file or DEFAULT_CONFIG_FILE
@@ -132,12 +141,13 @@ def launch_command(args, script_args) -> int:
         # watchdog flags through the inner launch command rather than bare
         # `python script` (a crash on one host then restarts everywhere, and
         # jax.distributed re-forms — the whole-job restart recovery model)
+        pod_restarts, pod_watchdog = _supervision_settings(args, cfg)
         supervisor_flags: list[str] = []
-        if args.max_restarts:
-            supervisor_flags += ["--max_restarts", str(args.max_restarts)]
+        if pod_restarts:
+            supervisor_flags += ["--max_restarts", str(pod_restarts)]
             supervisor_flags += ["--monitor_interval", str(args.monitor_interval)]
-            if args.watchdog_timeout:
-                supervisor_flags += ["--watchdog_timeout", str(args.watchdog_timeout)]
+            if pod_watchdog:
+                supervisor_flags += ["--watchdog_timeout", str(pod_watchdog)]
         inner = " ".join(
             [f"{k}={shlex.quote(v)}" for k, v in cfg.to_env().items()]
             + ["python", "-m", "accelerate_tpu.commands.accelerate_cli", "launch"]
@@ -159,10 +169,9 @@ def launch_command(args, script_args) -> int:
         for k, v in sorted(cfg.to_env().items()):
             print(f"  {k}={v}")
         return 0
-    if args.max_restarts and args.max_restarts > 0:
-        return _supervise(
-            cmd, env, args.max_restarts, args.monitor_interval, args.watchdog_timeout
-        )
+    max_restarts, watchdog = _supervision_settings(args, cfg)
+    if max_restarts > 0:
+        return _supervise(cmd, env, max_restarts, args.monitor_interval, watchdog)
     return subprocess.call(cmd, env=env)
 
 
@@ -177,11 +186,11 @@ def add_parser(subparsers) -> None:
     for axis in ("dp_replicate", "dp_shard", "pp", "cp", "sp", "tp", "ep"):
         p.add_argument(f"--{axis}_size", type=int, default=None)
     p.add_argument("--pod", default=None, help="TPU pod name: fan out over gcloud ssh --worker=all")
-    p.add_argument("--max_restarts", type=int, default=0,
+    p.add_argument("--max_restarts", type=int, default=None,
                    help="relaunch the script up to N times when it dies (per-host supervisor)")
     p.add_argument("--monitor_interval", type=float, default=5.0,
                    help="seconds between child liveness polls")
-    p.add_argument("--watchdog_timeout", type=float, default=0.0,
+    p.add_argument("--watchdog_timeout", type=float, default=None,
                    help=">0: kill the worker if it stops heartbeating for this many "
                         "seconds. The heartbeat ticks per optimizer step and around "
                         "checkpoint save/load — set this comfortably above the first-"

@@ -190,6 +190,20 @@ class ParallelismConfig:
             env_key = f"{_ENV_PREFIX}{axis.upper()}_SIZE"
             if env_key in os.environ:
                 kwargs[fieldname] = int(os.environ[env_key])
+        if int(kwargs.get("pp_size", 1)) > 1 and (
+            f"{_ENV_PREFIX}PP_MICROBATCHES" in os.environ
+            or f"{_ENV_PREFIX}PP_SCHEDULE" in os.environ
+        ):
+            from .utils.dataclasses import PipelineParallelConfig
+
+            pp_kwargs = {}
+            if f"{_ENV_PREFIX}PP_MICROBATCHES" in os.environ:
+                pp_kwargs["num_microbatches"] = int(
+                    os.environ[f"{_ENV_PREFIX}PP_MICROBATCHES"]
+                )
+            if f"{_ENV_PREFIX}PP_SCHEDULE" in os.environ:
+                pp_kwargs["schedule"] = os.environ[f"{_ENV_PREFIX}PP_SCHEDULE"]
+            kwargs["pp_config"] = PipelineParallelConfig(**pp_kwargs)
         if not kwargs and total_devices is not None:
             # No config at all → pure data parallel over every device, the
             # analogue of the reference's DDP default.

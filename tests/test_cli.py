@@ -99,3 +99,73 @@ def test_estimate_memory_local_hf_model_dir(tmp_path, capsys):
     # exact, incl. the (untied) lm_head: embed 1000*64 + 2 layers *
     # (4*64*64 + 3*64*128 + 2*64) + final norm 64 + head 64*1000
     assert payload["num_params"] == 210240.0
+
+
+def test_pp_env_protocol_roundtrip(monkeypatch, tmp_path):
+    """config → env → ParallelismConfig carries pipeline microbatches and
+    schedule, not just axis sizes."""
+    from accelerate_tpu.parallelism_config import ParallelismConfig
+
+    cfg = ClusterConfig(pp_size=2, pp_num_microbatches=8, pp_schedule="gpipe")
+    env = cfg.to_env()
+    assert env["PARALLELISM_CONFIG_PP_MICROBATCHES"] == "8"
+    assert env["PARALLELISM_CONFIG_PP_SCHEDULE"] == "gpipe"
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
+    pcfg = ParallelismConfig.from_env(total_devices=8)
+    assert pcfg.pp_size == 2
+    assert pcfg.pp_config.num_microbatches == 8
+    assert pcfg.pp_config.schedule == "gpipe"
+
+
+def test_config_questionnaire(monkeypatch, tmp_path, capsys):
+    """Interactive flow: parallelism branch + fault tolerance branch."""
+    answers = iter([
+        "bf16",   # mixed precision
+        "1",      # host processes
+        "2",      # grad accum
+        "2",      # fsdp shard size
+        "y",      # model/sequence parallelism?
+        "1",      # ddp replicas
+        "2",      # tp
+        "1",      # cp
+        "1",      # sp
+        "1",      # ep
+        "2",      # pp
+        "4",      # microbatches
+        "wrong",  # schedule (rejected, re-asked)
+        "1f1b",   # schedule
+        "y",      # fault tolerance?
+        "3",      # max restarts
+        "600",    # watchdog
+        "n",      # debug
+    ])
+    monkeypatch.setattr("builtins.input", lambda prompt="": next(answers))
+    path = str(tmp_path / "cfg.yaml")
+    rc = main(["config", "--config_file", path])
+    assert rc == 0
+    cfg = ClusterConfig.load(path)
+    assert cfg.tp_size == 2 and cfg.pp_size == 2 and cfg.pp_schedule == "1f1b"
+    assert cfg.max_restarts == 3 and cfg.watchdog_timeout == 600.0
+    assert cfg.gradient_accumulation_steps == 2
+
+
+def test_launch_uses_config_supervision(tmp_path, monkeypatch):
+    """launch picks up max_restarts from the config file when no flag given."""
+    import accelerate_tpu.commands.launch as launch_mod
+
+    cfg = ClusterConfig(max_restarts=2, watchdog_timeout=30.0)
+    path = str(tmp_path / "cfg.yaml")
+    cfg.save(path)
+    captured = {}
+
+    def fake_supervise(cmd, env, max_restarts, monitor, watchdog):
+        captured.update(max_restarts=max_restarts, watchdog=watchdog)
+        return 0
+
+    monkeypatch.setattr(launch_mod, "_supervise", fake_supervise)
+    script = tmp_path / "noop.py"
+    script.write_text("print('hi')\n")
+    rc = main(["launch", "--config_file", path, str(script)])
+    assert rc == 0
+    assert captured == {"max_restarts": 2, "watchdog": 30.0}
