@@ -83,6 +83,21 @@ class LlamaConfig:
         ), **overrides})
 
     @classmethod
+    def mixtral_8x7b(cls, **overrides) -> "LlamaConfig":
+        """Mixtral-8x7B shape (HF mistralai/Mixtral-8x7B; block_sparse_moe
+        checkpoints convert via :func:`convert_hf_state_dict`)."""
+        return cls(**{**dict(
+            vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+            num_hidden_layers=32, num_attention_heads=32, num_key_value_heads=8,
+            max_position_embeddings=32768, rope_theta=1e6,
+            num_experts=8, num_experts_per_tok=2,
+            # dropless (capacity = E): HF Mixtral routes every token to its
+            # top-2 unconditionally, so faithful inference must not drop;
+            # lower this for capacity-bounded training at scale
+            expert_capacity_factor=8.0,
+        ), **overrides})
+
+    @classmethod
     def tiny(cls, **overrides) -> "LlamaConfig":
         """Test-size config."""
         return cls(**{**dict(
@@ -564,7 +579,33 @@ def convert_hf_state_dict(config: LlamaConfig, flat: dict) -> dict:
         },
         "final_norm": {"scale": jnp.asarray(get("model.norm.weight"), dtype=config.param_dtype)},
     }
-    for hf_suffix, (group, name) in _HF_LAYER_MAP.items():
+    if config.num_experts > 1:
+        # HF Mixtral layout: block_sparse_moe.gate (router, torch (E, D)) and
+        # experts.{e}.{w1,w3,w2} (gate/up/down, torch (out, in)); ours stacks
+        # layers on dim 0 and experts on dim 1
+        E = config.num_experts
+
+        def stacked_experts(w_name: str) -> jnp.ndarray:
+            per_layer = []
+            for i in range(L):
+                per_layer.append(np.stack([
+                    get(f"model.layers.{i}.block_sparse_moe.experts.{e}.{w_name}.weight").T
+                    for e in range(E)
+                ]))
+            return jnp.asarray(np.stack(per_layer), dtype=config.param_dtype)
+
+        params["layers"]["mlp"] = {
+            "router": {"kernel": stacked("block_sparse_moe.gate.weight", transpose=True)},
+            "experts": {
+                "w_gate": stacked_experts("w1"),
+                "w_up": stacked_experts("w3"),
+                "w_down": stacked_experts("w2"),
+            },
+        }
+        layer_map = {k: v for k, v in _HF_LAYER_MAP.items() if v[0] == "attn"}
+    else:
+        layer_map = _HF_LAYER_MAP
+    for hf_suffix, (group, name) in layer_map.items():
         params["layers"][group][name] = {"kernel": stacked(hf_suffix, transpose=True)}
     if not config.tie_word_embeddings:
         if "lm_head.weight" in flat:
@@ -585,7 +626,20 @@ def export_hf_state_dict(config: LlamaConfig, params: dict) -> dict:
         "model.norm.weight": np.asarray(params["final_norm"]["scale"]),
     }
     L = config.num_hidden_layers
-    for hf_suffix, (group, name) in _HF_LAYER_MAP.items():
+    if config.num_experts > 1:
+        layer_map = {k: v for k, v in _HF_LAYER_MAP.items() if v[0] == "attn"}
+        router = np.asarray(params["layers"]["mlp"]["router"]["kernel"])
+        experts = params["layers"]["mlp"]["experts"]
+        for i in range(L):
+            out[f"model.layers.{i}.block_sparse_moe.gate.weight"] = router[i].T
+            for e in range(config.num_experts):
+                for ours, hf_w in (("w_gate", "w1"), ("w_up", "w3"), ("w_down", "w2")):
+                    out[
+                        f"model.layers.{i}.block_sparse_moe.experts.{e}.{hf_w}.weight"
+                    ] = np.asarray(experts[ours])[i, e].T
+    else:
+        layer_map = _HF_LAYER_MAP
+    for hf_suffix, (group, name) in layer_map.items():
         stacked = np.asarray(params["layers"][group][name]["kernel"])
         rope_heads = None
         if name == "q_proj":

@@ -203,3 +203,58 @@ def test_hf_llama_logits_match_torch_transformers():
     params = convert_hf_state_dict(cfg, flat)
     ours = np.asarray(llama_apply(cfg, params, jnp.asarray(ids.numpy())))
     np.testing.assert_allclose(ours, ref, atol=1e-4)
+
+
+def test_hf_mixtral_logits_match_torch_transformers():
+    """MoE ground truth: convert a transformers MixtralForCausalLM state dict
+    (block_sparse_moe layout) and match its logits. Ample capacity so no
+    tokens drop — Mixtral routes every token to its top-2 unconditionally."""
+    torch = pytest.importorskip("torch")
+    pytest.importorskip("transformers")
+    from transformers import MixtralConfig as HFMixtralConfig, MixtralForCausalLM
+
+    torch.manual_seed(0)
+    hf_cfg = HFMixtralConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rms_norm_eps=1e-5, rope_theta=10000.0,
+        num_local_experts=4, num_experts_per_tok=2, tie_word_embeddings=False,
+    )
+    m = MixtralForCausalLM(hf_cfg).eval()
+    ids = torch.randint(0, 128, (2, 10))
+    with torch.no_grad():
+        ref = m(ids).logits.numpy()
+
+    flat = {k: v.numpy() for k, v in m.state_dict().items()}
+    cfg = LlamaConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, compute_dtype=jnp.float32,
+        num_experts=4, num_experts_per_tok=2, expert_capacity_factor=4.0,
+    )
+    params = convert_hf_state_dict(cfg, flat)
+    ours, _aux = llama_apply(cfg, params, jnp.asarray(ids.numpy()), return_aux=True)
+    np.testing.assert_allclose(np.asarray(ours), ref, atol=2e-4)
+
+
+def test_mixtral_state_dict_roundtrip():
+    """convert ∘ export is identity on MoE params (router + experts)."""
+    from accelerate_tpu.models.llama import export_hf_state_dict
+
+    cfg = LlamaConfig.tiny(num_experts=4, compute_dtype=jnp.float32)
+    from accelerate_tpu.models.llama import init_llama_params
+    import jax as _jax
+
+    params = init_llama_params(cfg, _jax.random.key(0))
+    flat = export_hf_state_dict(cfg, params)
+    back = convert_hf_state_dict(cfg, flat)
+    for path in (
+        ("layers", "mlp", "router", "kernel"),
+        ("layers", "mlp", "experts", "w_gate"),
+        ("layers", "mlp", "experts", "w_down"),
+        ("layers", "attn", "q_proj", "kernel"),
+    ):
+        a, b = params, back
+        for k in path:
+            a, b = a[k], b[k]
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
