@@ -129,6 +129,12 @@ def cpu_spmd_env(n_devices: int = 8, **extra) -> dict:
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n_devices}"
+    # the package may be run from a source tree (not pip-installed): make the
+    # subprocess resolve accelerate_tpu the same way this process does
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
     env.update({k: str(v) for k, v in extra.items()})
     return env
 

@@ -169,3 +169,35 @@ def test_launch_uses_config_supervision(tmp_path, monkeypatch):
     rc = main(["launch", "--config_file", path, str(script)])
     assert rc == 0
     assert captured == {"max_restarts": 2, "watchdog": 30.0}
+
+
+@pytest.mark.slow
+def test_accelerate_test_command_end_to_end(tmp_path):
+    """`accelerate-tpu test` runs the bundled sanity script through a real
+    subprocess (the reference's self-launch pattern, via the exported
+    helpers in test_utils.testing)."""
+    from accelerate_tpu.test_utils import cpu_spmd_env, execute_subprocess
+
+    result = execute_subprocess(
+        [sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli",
+         "test", "--cpu"],
+        env=cpu_spmd_env(8, ACCELERATE_TPU_CONFIG_DIR=str(tmp_path)),
+        timeout=600,
+    )
+    assert "All checks passed" in result.stdout
+
+
+@pytest.mark.slow
+def test_launch_script_helper(tmp_path):
+    """test_utils.launch_script drives a script through the real launch CLI
+    on the virtual mesh."""
+    from accelerate_tpu.test_utils import launch_script
+
+    script = tmp_path / "probe.py"
+    script.write_text(
+        "from accelerate_tpu import Accelerator\n"
+        "acc = Accelerator()\n"
+        "print('num_devices', acc.num_processes, len(__import__('jax').devices()))\n"
+    )
+    result = launch_script(str(script), env=None, n_devices=8)
+    assert "num_devices" in result.stdout
