@@ -41,17 +41,26 @@ def _run_child(env_overrides: dict, timeout: float):
             capture_output=True, text=True, timeout=timeout, env=env,
         )
     except subprocess.TimeoutExpired as exc:
-        # keep the hang diagnostics — they say WHERE the backend stalled
+        # keep the hang diagnostics — they say WHERE the backend stalled —
+        # and salvage any PRELIMINARY result line the child printed before
+        # the watchdog fired (the sweep emits one after its first measurement)
         if exc.stderr:
             err = exc.stderr
             if isinstance(err, bytes):
                 err = err.decode(errors="replace")
             sys.stderr.write(err[-4000:])
-        return None
+        partial = exc.stdout
+        if isinstance(partial, bytes):
+            partial = partial.decode(errors="replace")
+        return _last_result_line(partial or "")
     except OSError:
         return None
     sys.stderr.write(out.stderr[-4000:])
-    for line in reversed(out.stdout.splitlines()):
+    return _last_result_line(out.stdout)
+
+
+def _last_result_line(stdout: str):
+    for line in reversed(stdout.splitlines()):
         line = line.strip()
         if line.startswith("{"):
             try:
@@ -82,66 +91,32 @@ def detect_peak_flops(device) -> float:
     return PEAK_FLOPS["v5e"] if device.platform == "tpu" else PEAK_FLOPS["cpu"]
 
 
-def main(note=None):
+def _measure(config, starting_batch, steps, seq_len):
+    """Build a fresh accelerator+model for ``config``, run one fused
+    multi-step program twice (warmup + timed), return the measurement."""
     import jax
-
-    if os.environ.get("BENCH_FORCE_CPU") == "1":
-        # env JAX_PLATFORMS is NOT enough: a sitecustomize-registered TPU
-        # plugin can override platform selection via jax config at interpreter
-        # startup, so force it back at the config level before any device probe
-        jax.config.update("jax_platforms", "cpu")
-    import jax.numpy as jnp
     import optax
 
     from accelerate_tpu import Accelerator
-    from accelerate_tpu.models.llama import (
-        LlamaConfig,
-        create_llama,
-        llama_flops_per_token,
-        llama_loss,
-    )
+    from accelerate_tpu.models.llama import create_llama, llama_loss
     from accelerate_tpu.parallelism_config import ParallelismConfig
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
     from accelerate_tpu.utils.memory import find_executable_batch_size
 
-    device = jax.devices()[0]
-    on_tpu = device.platform == "tpu"
-    seq_len = int(os.environ.get("BENCH_SEQ", 2048 if on_tpu else 128))
-    if on_tpu:
-        config = LlamaConfig(
-            vocab_size=32000,
-            hidden_size=int(os.environ.get("BENCH_HIDDEN", 1024)),
-            intermediate_size=int(os.environ.get("BENCH_INTER", 2816)),
-            num_hidden_layers=int(os.environ.get("BENCH_LAYERS", 16)),
-            num_attention_heads=16,
-            num_key_value_heads=16,
-            max_position_embeddings=seq_len,
-            remat_policy=os.environ.get("BENCH_REMAT", "minimal"),
-            attention_impl=os.environ.get("BENCH_ATTN", "blockwise"),
-            use_chunked_ce=os.environ.get("BENCH_CHUNKED_CE", "1") == "1",
-        )
-        starting_batch = int(os.environ.get("BENCH_BATCH", 8))
-        steps = int(os.environ.get("BENCH_STEPS", 16))
-        warmup = 1
-    else:  # CPU smoke mode
-        config = LlamaConfig.tiny(max_position_embeddings=seq_len)
-        starting_batch = 8
-        steps = 2
-        warmup = 1
-
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
     n_dev = len(jax.devices())
     pcfg = (
         ParallelismConfig(dp_shard_size=n_dev) if n_dev > 1 else ParallelismConfig()
     )
     accelerator = Accelerator(parallelism_config=pcfg, mixed_precision="bf16")
-
     model = create_llama(config, seed=0)
-    optimizer = optax.adamw(3e-4, weight_decay=0.01)
-    model, optimizer = accelerator.prepare(model, optimizer)
+    model, _optimizer = accelerator.prepare(model, optax.adamw(3e-4, weight_decay=0.01))
     model.policy = None  # model handles bf16 internally
     # all `steps` train steps fuse into ONE program (lax.scan) — amortizes
     # dispatch/relay overhead, which dominates per-call timing on tunneled TPUs
     step_fn = accelerator.train_step(llama_loss, max_grad_norm=1.0, multi_step=True)
-
     rng = np.random.default_rng(0)
 
     @find_executable_batch_size(starting_batch_size=starting_batch)
@@ -161,31 +136,171 @@ def main(note=None):
         return batch_size, dt, last
 
     batch_size, dt, loss = run()
-    tokens = batch_size * seq_len * steps
-    tok_per_sec = tokens / dt
-    tok_per_sec_per_chip = tok_per_sec / n_dev
+    tok_per_sec_per_chip = batch_size * seq_len * steps / dt / n_dev
+    return {
+        "tok_s_chip": tok_per_sec_per_chip,
+        "batch_size": batch_size,
+        "step_time_s": dt / steps,
+        "loss": loss,
+        "params_m": model.num_parameters / 1e6,
+        "n_devices": n_dev,
+    }
+
+
+def _flash_is_valid_on_device() -> bool:
+    """Quick on-device fwd+bwd check of the Pallas flash kernel against the
+    blockwise reference — the kernel was only interpret-mode tested before
+    real hardware was reachable, so never benchmark what isn't correct."""
+    import jax
+    import jax.numpy as jnp
+
+    from accelerate_tpu.ops.attention import blockwise_attention
+    from accelerate_tpu.ops.flash_attention import flash_attention
+
+    try:
+        rng = np.random.default_rng(0)
+        shape = (2, 256, 8, 64)
+        q, k, v = (
+            jnp.asarray(rng.normal(size=shape), dtype=jnp.bfloat16) for _ in range(3)
+        )
+
+        def loss_flash(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal=True).astype(jnp.float32))
+
+        def loss_ref(q, k, v):
+            return jnp.sum(blockwise_attention(q, k, v, causal=True).astype(jnp.float32))
+
+        out_f = jax.jit(flash_attention, static_argnames=("causal",))(q, k, v, causal=True)
+        out_r = jax.jit(blockwise_attention, static_argnames=("causal",))(q, k, v, causal=True)
+        if not np.allclose(
+            np.asarray(out_f, np.float32), np.asarray(out_r, np.float32), atol=2e-2
+        ):
+            return False
+        g_f = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))(q, k, v)
+        g_r = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
+        for a, b in zip(g_f, g_r):
+            if not np.allclose(
+                np.asarray(a, np.float32), np.asarray(b, np.float32), atol=5e-2
+            ):
+                return False
+        return True
+    except Exception as exc:  # noqa: BLE001 — a broken kernel must not kill bench
+        sys.stderr.write(f"bench: flash validation failed: {exc}\n")
+        return False
+
+
+def main(note=None):
+    import jax
+
+    if os.environ.get("BENCH_FORCE_CPU") == "1":
+        # env JAX_PLATFORMS is NOT enough: a sitecustomize-registered TPU
+        # plugin can override platform selection via jax config at interpreter
+        # startup, so force it back at the config level before any device probe
+        jax.config.update("jax_platforms", "cpu")
+    from accelerate_tpu.models.llama import LlamaConfig
+
+    device = jax.devices()[0]
+    on_tpu = device.platform == "tpu" or os.environ.get("BENCH_ASSUME_TPU") == "1"
+    seq_len = int(os.environ.get("BENCH_SEQ", 2048 if on_tpu else 128))
+
+    def make_config(remat, attn):
+        return LlamaConfig(
+            vocab_size=32000,
+            hidden_size=int(os.environ.get("BENCH_HIDDEN", 1024)),
+            intermediate_size=int(os.environ.get("BENCH_INTER", 2816)),
+            num_hidden_layers=int(os.environ.get("BENCH_LAYERS", 16)),
+            num_attention_heads=16,
+            num_key_value_heads=16,
+            max_position_embeddings=seq_len,
+            remat_policy=remat,
+            attention_impl=attn,
+            use_chunked_ce=os.environ.get("BENCH_CHUNKED_CE", "1") == "1",
+        )
+
+    sweep_note = None
+    if on_tpu:
+        starting_batch = int(os.environ.get("BENCH_BATCH", 8))
+        steps = int(os.environ.get("BENCH_STEPS", 16))
+        default = (os.environ.get("BENCH_REMAT", "minimal"),
+                   os.environ.get("BENCH_ATTN", "blockwise"))
+        # validate flash FIRST: nothing flash-configured may run (even an
+        # env-default) unless the kernel is numerically correct on-device
+        flash_ok = _flash_is_valid_on_device()
+        if default[1] == "flash" and not flash_ok:
+            default = (default[0], "blockwise")
+            sweep_note = "flash kernel failed on-device validation; excluded"
+        candidates = [default]
+        if os.environ.get("BENCH_SWEEP", "1") == "1":
+            for cand in [("dots", "blockwise"), ("nothing", "blockwise"),
+                         *( [(default[0], "flash")] if flash_ok else [] )]:
+                if cand not in candidates:
+                    candidates.append(cand)
+            if not flash_ok and sweep_note is None:
+                sweep_note = "flash kernel failed on-device validation; excluded"
+        best = None
+        for remat, attn in candidates:
+            try:
+                m = _measure(make_config(remat, attn), starting_batch,
+                             steps=min(steps, 4), seq_len=seq_len)
+            except Exception as exc:  # noqa: BLE001 — a candidate must not kill bench
+                sys.stderr.write(f"bench: candidate {remat}/{attn} failed: {exc}\n")
+                continue
+            sys.stderr.write(
+                f"bench: sweep {remat}/{attn}: {m['tok_s_chip']:.0f} tok/s/chip\n"
+            )
+            if best is None:
+                # safety line: if the parent's watchdog kills the sweep, it
+                # salvages the LAST printed result — better a real measured
+                # number at the default config than a CPU smoke fallback
+                m_pre = dict(m, remat=remat, attention=attn)
+                _emit(device, make_config(remat, attn), seq_len, m_pre,
+                      "preliminary sweep result")
+            if best is None or m["tok_s_chip"] > best[2]["tok_s_chip"]:
+                best = (remat, attn, m)
+        if best is None:
+            raise RuntimeError("every sweep candidate failed")
+        remat, attn, _ = best
+        config = make_config(remat, attn)
+        measured = _measure(config, starting_batch, steps=steps, seq_len=seq_len)
+        measured["remat"], measured["attention"] = remat, attn
+    else:  # CPU smoke mode
+        config = LlamaConfig.tiny(max_position_embeddings=seq_len)
+        measured = _measure(config, starting_batch=8, steps=2, seq_len=seq_len)
+
+    _emit(device, config, seq_len, measured,
+          "; ".join(x for x in (note, sweep_note) if x))
+
+
+_EMITTED_RESULT = False
+
+
+def _emit(device, config, seq_len, measured, notes=""):
+    global _EMITTED_RESULT
+    from accelerate_tpu.models.llama import llama_flops_per_token
 
     flops_per_token = llama_flops_per_token(config, seq_len)
-    mfu = (tok_per_sec_per_chip * flops_per_token) / detect_peak_flops(device)
-
+    mfu = (measured["tok_s_chip"] * flops_per_token) / detect_peak_flops(device)
     result = {
         "metric": METRIC,
-        "value": round(tok_per_sec_per_chip, 1),
+        "value": round(measured["tok_s_chip"], 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu / 0.45, 4),
         "detail": {
             "device": str(getattr(device, "device_kind", device.platform)),
-            "n_devices": n_dev,
-            "batch_size": batch_size,
+            "n_devices": measured["n_devices"],
+            "batch_size": measured["batch_size"],
             "seq_len": seq_len,
-            "params_m": round(model.num_parameters / 1e6, 1),
-            "step_time_s": round(dt / steps, 4),
+            "params_m": round(measured["params_m"], 1),
+            "step_time_s": round(measured["step_time_s"], 4),
             "mfu": round(mfu, 4),
-            "loss": round(loss, 4),
+            "loss": round(measured["loss"], 4),
+            **({"remat": measured["remat"], "attention": measured["attention"]}
+               if "remat" in measured else {}),
         },
     }
-    if note:
-        result["error"] = note
+    if notes:
+        result["error"] = notes
+    _EMITTED_RESULT = True
     print(json.dumps(result), flush=True)
 
 
@@ -195,11 +310,16 @@ if __name__ == "__main__":
         try:
             main(note=os.environ.get("BENCH_NOTE") or None)
         except Exception as exc:  # noqa: BLE001 — emit the line no matter what
-            print(json.dumps({
-                "metric": METRIC, "value": 0.0, "unit": "tokens/s/chip",
-                "vs_baseline": 0.0,
-                "error": f"{type(exc).__name__}: {exc}"[:500],
-            }), flush=True)
+            if _EMITTED_RESULT:
+                # a real (preliminary) measurement is already on stdout; a
+                # value=0 error line after it would make the parent discard it
+                sys.stderr.write(f"bench: post-emit failure: {exc}\n")
+            else:
+                print(json.dumps({
+                    "metric": METRIC, "value": 0.0, "unit": "tokens/s/chip",
+                    "vs_baseline": 0.0,
+                    "error": f"{type(exc).__name__}: {exc}"[:500],
+                }), flush=True)
         sys.exit(0)
 
     # Parent: the JSON line must ALWAYS appear and rc must be 0 (VERDICT
