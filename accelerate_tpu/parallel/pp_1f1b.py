@@ -20,15 +20,16 @@ Schedule (non-interleaved 1F1B, unit slots; n = stages, m = microbatches):
   backward slot (at most one of them maps to a real microbatch — the two
   parities never collide), so in-flight inputs per stage ≤ n.
 
-SPMD uniformity: every stage executes the SAME per-tick program — embed,
-stage scan, head+loss, and one vjp — with roles (first/last stage) and
-fill/drain validity applied as ``jnp.where`` masks, never as ``lax.cond``
-branches. Divergent conds would put the dp/fsdp all-gathers inside a branch
-only some pp groups take, and collectives reached in different orders on
-different devices deadlock (observed on XLA:CPU; the same hazard exists on
-TPU). The price is bubble-slot garbage compute (the standard accept for
-SPMD pipelines) and head+embed FLOPs on every stage; the memory bound and
-the constant-in-m trace size are what 1F1B is for.
+Role and validity gating uses ``lax.cond``/``lax.switch``, so fill/drain
+ticks and non-last stages skip compute (no head/embed FLOPs where they are
+not needed). This is collective-safe because every predicate is uniform
+within each dp/fsdp/tp collective group (it depends only on the pp index
+and the tick): a taken branch always has its full collective group
+present, and groups in different pp stages own disjoint collectives. The
+one genuine hazard — data-independent collectives being STARTED in
+different orders on different devices, which deadlocks XLA:CPU's
+rendezvous — is handled by explicitly ordering the two wire ppermutes
+with an optimization barrier.
 
 Backward recomputes the stage forward from the saved stage input
 (``jax.vjp``), i.e. per-stage rematerialization: live memory is the input
@@ -51,11 +52,6 @@ __all__ = ["make_1f1b_value_and_grad"]
 
 def _tree_add(a, b):
     return jax.tree_util.tree_map(jnp.add, a, b)
-
-
-def _tree_mask(mask, tree):
-    # where (not multiply): garbage-slot grads may be inf/nan and 0*nan=nan
-    return jax.tree_util.tree_map(lambda g: jnp.where(mask, g, 0), tree)
 
 
 def _index_mb(microbatches, f):
@@ -142,15 +138,6 @@ def make_1f1b_value_and_grad(
             total = 2 * (m + n - 1)
             ct = jnp.float32(cotangent_scale)
 
-            def objective(sp, io, h_saved, mb):
-                """Uniform per-stage objective: every stage runs embed + stage
-                + head+loss; ``jnp.where`` picks which pieces are real. Its
-                single vjp serves all three roles via the cotangent seed."""
-                h_in = jnp.where(first_mask, embed_fn(io, mb).astype(h_saved.dtype), h_saved)
-                h_out = stage_fn(sp, h_in)
-                loss = head_loss_fn(io, h_out, mb)
-                return loss, h_out
-
             def tick(t, carry):
                 recv_f, recv_b, ring, loss_acc, g_stage, g_io = carry
 
@@ -161,37 +148,90 @@ def make_1f1b_value_and_grad(
                 f_bwd = jnp.clip(tb // 2, 0, m - 1)
                 bwd_valid = (tb >= 0) & (tb % 2 == 0) & (tb // 2 < m)
 
+                # Role/validity gating uses lax.cond/switch: predicates are
+                # uniform within every dp/fsdp/tp collective group (they
+                # depend only on the pp index and the tick), so the
+                # collectives inside a taken branch always have their full
+                # group present. Cross-pp groups take different branches —
+                # that is safe because their collectives are disjoint, and
+                # the wire permutes below are explicitly ordered.
+
                 # ---------- forward slot: bank the input, run the stage
                 mb_f = _index_mb(micro_local, f_fwd)
-                h_in = jnp.where(
-                    first_mask, embed_fn(io_local, mb_f).astype(wire.dtype), recv_f
+                h_in = lax.cond(
+                    first_mask & fwd_valid,
+                    lambda: embed_fn(io_local, mb_f).astype(wire.dtype),
+                    lambda: recv_f,
                 )
                 ring = lax.dynamic_update_index_in_dim(
                     ring, h_in, jnp.where(fwd_valid, f_fwd % n, n), 0
                 )
-                h_out = stage_fn(stage_local, h_in)
-                h_out = jnp.where(fwd_valid, h_out, 0)
+                # the last stage's compute is fused into its backward slot
+                # (head+loss need the stage output anyway); fill/drain ticks
+                # skip the stage entirely
+                h_out = lax.cond(
+                    fwd_valid & ~last_mask,
+                    lambda h: stage_fn(stage_local, h),
+                    lambda h: jnp.zeros_like(h),
+                    h_in,
+                )
 
-                # ---------- backward slot: one uniform vjp from the ring
+                # ---------- backward slot: per-role vjp from the banked input
                 mb_b = _index_mb(micro_local, f_bwd)
                 h_saved = lax.dynamic_index_in_dim(
                     ring, f_bwd % n, 0, keepdims=False
                 )
-                (loss_f, _h), vjp = jax.vjp(
-                    objective, stage_local, io_local, h_saved, mb_b
-                )
-                # last stage seeds the loss cotangent; earlier stages seed the
-                # wire cotangent arriving from downstream
-                loss_ct = jnp.where(last_mask, ct / denom, 0.0).astype(jnp.float32)
-                out_ct = jnp.where(last_mask, jnp.zeros_like(recv_b), recv_b)
-                g_sp, g_iod, d_h, _ = vjp((loss_ct, out_ct))
 
-                loss_acc = loss_acc + jnp.where(
-                    bwd_valid & last_mask, loss_f / denom, 0.0
+                def idle_branch(recv_b):
+                    return (
+                        jnp.float32(0.0),
+                        jax.tree_util.tree_map(jnp.zeros_like, stage_local),
+                        jax.tree_util.tree_map(jnp.zeros_like, io_local),
+                        jnp.zeros_like(recv_b),
+                    )
+
+                def last_branch(recv_b):
+                    def objective(sp, io, h):
+                        return head_loss_fn(io, stage_fn(sp, h), mb_b)
+
+                    loss_f, vjp = jax.vjp(
+                        objective, stage_local, io_local, h_saved
+                    )
+                    g_sp, g_iod, d_h = vjp(ct / denom)
+                    return loss_f / denom, g_sp, g_iod, d_h
+
+                def first_branch(recv_b):
+                    def objective(sp, io):
+                        return stage_fn(sp, embed_fn(io, mb_b).astype(recv_b.dtype))
+
+                    _, vjp = jax.vjp(objective, stage_local, io_local)
+                    g_sp, g_iod = vjp(recv_b)
+                    return (
+                        jnp.float32(0.0), g_sp, g_iod, jnp.zeros_like(recv_b)
+                    )
+
+                def mid_branch(recv_b):
+                    _, vjp = jax.vjp(
+                        lambda sp, h: stage_fn(sp, h), stage_local, h_saved
+                    )
+                    g_sp, d_h = vjp(recv_b)
+                    return (
+                        jnp.float32(0.0), g_sp,
+                        jax.tree_util.tree_map(jnp.zeros_like, io_local), d_h,
+                    )
+
+                branch = jnp.where(
+                    ~bwd_valid, 0,
+                    jnp.where(last_mask, 1, jnp.where(first_mask, 2, 3)),
                 )
-                g_stage = _tree_add(g_stage, _tree_mask(bwd_valid, g_sp))
-                g_io = _tree_add(g_io, _tree_mask(bwd_valid, g_iod))
-                d_h = jnp.where(bwd_valid, d_h, 0)
+                loss_f, g_sp, g_iod, d_h = lax.switch(
+                    branch, [idle_branch, last_branch, first_branch, mid_branch],
+                    recv_b,
+                )
+
+                loss_acc = loss_acc + loss_f
+                g_stage = _tree_add(g_stage, g_sp)
+                g_io = _tree_add(g_io, g_iod)
 
                 # serialize the two wires: they are data-independent, and
                 # collectives started in different orders on different devices
