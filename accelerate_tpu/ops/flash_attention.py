@@ -80,7 +80,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, out_ref, lse_ref, acc_ref, m_ref, l_ref, *,
     def _finalize():
         l = l_ref[:, 0]
         out_ref[0] = (acc_ref[:] / jnp.maximum(l, 1e-30)[:, None]).astype(out_ref.dtype)
-        lse_ref[0] = m_ref[:, 0] + jnp.log(jnp.maximum(l, 1e-30))
+        lse_ref[0, 0] = m_ref[:, 0] + jnp.log(jnp.maximum(l, 1e-30))
 
 
 def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
@@ -103,11 +103,14 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            # lse rides a (bh, 1, s) layout: a (1, 1, block_q) block keeps the
+            # last two dims legal for TPU tiling (dim -2 equals the array dim,
+            # lanes on seq) — a flat (bh, s) block of (1, block_q) is not
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, s, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, s), jnp.float32),
+            jax.ShapeDtypeStruct((bh, 1, s), jnp.float32),
         ],
         scratch_shapes=[
             # acc, m, l accumulators live in VMEM across the kv grid dim
@@ -138,8 +141,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_a
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0]
-        delta = delta_ref[0]
+        lse = lse_ref[0, 0]
+        delta = delta_ref[0, 0]
 
         s = q @ k.T
         if causal:
@@ -174,8 +177,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0]
-        delta = delta_ref[0]
+        lse = lse_ref[0, 0]
+        delta = delta_ref[0, 0]
 
         s = q @ k.T  # (bq, bk)
         if causal:
@@ -199,7 +202,8 @@ def _flash_bwd(q, k, v, out, lse, do, causal, block_q, block_k, interpret):
 
     bh, s, d = q.shape
     scale = 1.0 / math.sqrt(d)
-    delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32), axis=-1)  # (bh, s)
+    # (bh, 1, s): same lane-major layout as lse (see _flash_fwd out_specs)
+    delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32), axis=-1)[:, None, :]
     nq = s // block_q
     nk = s // block_k
 
@@ -213,8 +217,8 @@ def _flash_bwd(q, k, v, out, lse, do, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
@@ -232,8 +236,8 @@ def _flash_bwd(q, k, v, out, lse, do, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),

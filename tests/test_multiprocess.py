@@ -186,7 +186,9 @@ def _pp_1f1b_body(expected_loss):
 def test_multihost_1f1b_pipeline_matches_single_process():
     """The 1F1B schedule with the pp axis SPANNING TWO PROCESSES: the wire
     ppermutes ride jax.distributed across hosts, and the loss trajectory
-    matches a single-process run of the identical configuration."""
+    matches a single-process pp=2 run. The reference run adds dp_shard=4
+    (8-device mesh) vs the 2-process run's dp=1 — valid because the loss is
+    dp-invariant up to float reduction order (same global batch either way)."""
     import numpy as np
 
     import jax.numpy as jnp
@@ -198,7 +200,7 @@ def test_multihost_1f1b_pipeline_matches_single_process():
     from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
     from accelerate_tpu.utils.dataclasses import PipelineParallelConfig
 
-    # single-process reference on a local 2-device mesh
+    # single-process reference: pp=2 × dp_shard=4 on the local 8-device mesh
     AcceleratorState._reset_state(); GradientState._reset_state(); PartialState._reset_state()
     import jax
 
