@@ -647,11 +647,22 @@ class Accelerator:
             )
             pp_1f1b_cfg = None
         if pp_1f1b_cfg is not None:
-            from .parallel.pp_1f1b import make_1f1b_value_and_grad
+            if pp_1f1b_cfg.num_virtual_stages > 1:
+                from .parallel.pp_interleaved import (
+                    make_interleaved_1f1b_value_and_grad,
+                )
 
-            pipeline_vag = make_1f1b_value_and_grad(
-                self.mesh, pp_1f1b_cfg.num_microbatches
-            )
+                pipeline_vag = make_interleaved_1f1b_value_and_grad(
+                    self.mesh,
+                    pp_1f1b_cfg.num_microbatches,
+                    pp_1f1b_cfg.num_virtual_stages,
+                )
+            else:
+                from .parallel.pp_1f1b import make_1f1b_value_and_grad
+
+                pipeline_vag = make_1f1b_value_and_grad(
+                    self.mesh, pp_1f1b_cfg.num_microbatches
+                )
             embed_fn, stage_fn, head_loss_fn, loss_denom_fn = model.pipeline_parts()
 
             def _pipeline_grads(params, scale, batch):

@@ -32,6 +32,7 @@ class ClusterConfig:
     pp_size: int = 1
     pp_num_microbatches: int = 4
     pp_schedule: str = "1f1b"
+    pp_virtual_stages: int = 1
     cp_size: int = 1
     sp_size: int = 1
     tp_size: int = 1
@@ -53,6 +54,8 @@ class ClusterConfig:
         if self.pp_size > 1:
             env["PARALLELISM_CONFIG_PP_MICROBATCHES"] = str(self.pp_num_microbatches)
             env["PARALLELISM_CONFIG_PP_SCHEDULE"] = self.pp_schedule
+            if self.pp_virtual_stages > 1:
+                env["PARALLELISM_CONFIG_PP_VIRTUAL_STAGES"] = str(self.pp_virtual_stages)
         if self.debug:
             env["ACCELERATE_DEBUG_MODE"] = "1"
         if self.num_processes > 1:
@@ -114,6 +117,11 @@ def config_command(args, extra) -> int:
                         cfg.pp_schedule = schedule
                         break
                     print("  please answer 1f1b or gpipe")
+                if cfg.pp_schedule == "1f1b":
+                    cfg.pp_virtual_stages = _ask(
+                        "virtual stages per device (interleaved 1F1B; 1 = off)",
+                        1, int,
+                    )
         if _ask("enable fault-tolerant supervision? (y/n)", "n").lower().startswith("y"):
             cfg.max_restarts = _ask("max restarts", 3, int)
             cfg.watchdog_timeout = _ask(

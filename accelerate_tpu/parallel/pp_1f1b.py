@@ -61,6 +61,33 @@ def _index_mb(microbatches, f):
     )
 
 
+def shard_microbatches(mesh, batch, m, batch_axes, seq_axes):
+    """Reshape a flat (B, ...) batch pytree to (m, B/m, ...) microbatches and
+    pin the sharding: microbatch dim unsharded, row dim on the data axes
+    (the flat batch was dp-sharded on dim 0; reshape alone would leave GSPMD
+    free to shard the m dim). Shared by the 1F1B and interleaved schedules."""
+    leaves = jax.tree_util.tree_leaves(batch)
+    b = leaves[0].shape[0]
+    if b % m != 0:
+        raise ValueError(f"batch {b} not divisible by num_microbatches {m}")
+    micro = jax.tree_util.tree_map(
+        lambda a: a.reshape(m, b // m, *a.shape[1:]), batch
+    )
+    b_axes = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1)
+    s_axes = tuple(a for a in seq_axes if mesh.shape.get(a, 1) > 1)
+    return jax.tree_util.tree_map(
+        lambda a: jax.lax.with_sharding_constraint(
+            a,
+            NamedSharding(
+                mesh,
+                P(None, b_axes or None,
+                  *([s_axes] if (s_axes and a.ndim > 2) else [])),
+            ),
+        ),
+        micro,
+    )
+
+
 def make_1f1b_value_and_grad(
     mesh: Mesh,
     num_microbatches: int,
@@ -94,30 +121,7 @@ def make_1f1b_value_and_grad(
 
     def vag(stage_params, io_params, batch, embed_fn, stage_fn, head_loss_fn,
             loss_denom, cotangent_scale=1.0):
-        leaves = jax.tree_util.tree_leaves(batch)
-        b = leaves[0].shape[0]
-        if b % m != 0:
-            raise ValueError(f"batch {b} not divisible by num_microbatches {m}")
-        mb_rows = b // m
-        micro = jax.tree_util.tree_map(
-            lambda a: a.reshape(m, mb_rows, *a.shape[1:]), batch
-        )
-        # keep the microbatch dim unsharded and the row dim on the data axes
-        # (the flat batch was dp-sharded on dim 0; reshape alone would leave
-        # GSPMD free to shard the m dim)
-        b_axes = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1)
-        s_axes = tuple(a for a in seq_axes if mesh.shape.get(a, 1) > 1)
-        micro = jax.tree_util.tree_map(
-            lambda a: jax.lax.with_sharding_constraint(
-                a,
-                NamedSharding(
-                    mesh,
-                    P(None, b_axes or None,
-                      *([s_axes] if (s_axes and a.ndim > 2) else [])),
-                ),
-            ),
-            micro,
-        )
+        micro = shard_microbatches(mesh, batch, m, batch_axes, seq_axes)
 
         def pipeline(stage_local, io_local, micro_local, denom):
             idx = lax.axis_index(pp_axis)

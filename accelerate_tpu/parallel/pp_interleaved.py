@@ -1,0 +1,567 @@
+"""Interleaved (virtual-stage) 1F1B pipeline schedule.
+
+Each of the ``n`` pp devices owns ``v`` non-adjacent chunks of layers
+(device ``i`` runs global stages ``i, n+i, ..., (v-1)n+i``), so a microbatch
+rides the device ring ``v`` times. The warmup fill then costs ~``(n-1)/v``
+full-stage times instead of ``n-1`` — the Megatron-LM interleaved schedule's
+bubble shrink (reference delegates all pipeline training to Megatron,
+reference utils/megatron_lm.py:926+; this is a native JAX implementation).
+
+Design: schedules are DATA, not control flow. A Python event simulator
+(:func:`build_interleaved_schedule`) runs the standard warmup/steady/cooldown
+program per device under the wire latency (+1 tick) and in-flight cap, and
+emits per-device per-tick int32 tables: which (chunk, microbatch) forward and
+backward to run, which ring slots to bank/read. The traced ``lax.fori_loop``
+body just follows the tables — no phase arithmetic under trace, constant
+compile time in both microbatch count and ``v``. The simulator also SIZES the
+three activation rings (forward-input, saved-input, backward-cotangent) and
+proves slot reuse is hazard-free before anything compiles.
+
+Wires are two full-ring ``ppermute``s per tick (forward ``i -> i+1 mod n``,
+backward ``i -> i-1 mod n``): chunk-boundary wraps (device ``n-1 -> 0``
+forward, ``0 -> n-1`` backward) ride the same wire and land in the next
+chunk's ring, so there is no separate wrap path. The two permutes are
+ordered with an optimization barrier (unordered data-independent collectives
+deadlock XLA:CPU's rendezvous).
+
+Layer layout: the stacked layer dim stays in CANONICAL order (layer 0 first)
+with each device holding a contiguous block — the layout every other path
+(GPipe, eval, checkpointing, HF interop) uses. Interleaving needs device
+``i`` to hold layers of stages ``{i, n+i, ...}``, which is a cross-device row
+permutation; the vag applies it to params (and its inverse to grads) per
+step, outside the shard_map. That is one param-sized all-to-all each way per
+step — a few percent of step time at typical batch sizes; pre-permuted
+storage is a later optimization.
+
+Loss/grad semantics exactly match ``parallel/pp_1f1b.py``: per-microbatch
+loss SUMS divided by the global valid-token denominator, cotangents seeded
+with ``cotangent_scale``, io grads psum'd over pp.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["build_interleaved_schedule", "make_interleaved_1f1b_value_and_grad"]
+
+
+# ------------------------------------------------------------------ schedule
+@dataclass
+class InterleavedSchedule:
+    """Per-device per-tick tables, all int32 of shape (n, T).
+
+    ``*_valid`` entries are 0/1; chunk/mb/slot entries are 0 when invalid
+    (safe dummies — every consumer is gated on the valid flag).
+    """
+
+    n: int
+    v: int
+    m: int
+    total_ticks: int
+    ring_f: int  # fwd-input ring slots per chunk
+    ring_s: int  # saved-input ring slots per chunk
+    ring_b: int  # bwd-cotangent ring slots per chunk
+    fwd_valid: np.ndarray
+    fwd_chunk: np.ndarray
+    fwd_mb: np.ndarray
+    fwd_read_slot: np.ndarray  # fwd-input ring slot to read (first stage: 0)
+    fwd_save_slot: np.ndarray  # saved ring slot to write
+    bwd_valid: np.ndarray
+    bwd_chunk: np.ndarray
+    bwd_mb: np.ndarray
+    bwd_read_slot: np.ndarray  # cotangent ring slot to read (last stage: 0)
+    bwd_saved_slot: np.ndarray  # saved ring slot to read
+    bank_f_valid: np.ndarray  # incoming fwd wire: bank into fwd-input ring
+    bank_f_chunk: np.ndarray
+    bank_f_slot: np.ndarray
+    bank_b_valid: np.ndarray  # incoming bwd wire: bank into cotangent ring
+    bank_b_chunk: np.ndarray
+    bank_b_slot: np.ndarray
+
+    def packed(self) -> np.ndarray:
+        """(n, T, 16) int32 — one sharded lookup per tick in the traced loop."""
+        return np.stack(
+            [
+                self.fwd_valid, self.fwd_chunk, self.fwd_mb,
+                self.fwd_read_slot, self.fwd_save_slot,
+                self.bwd_valid, self.bwd_chunk, self.bwd_mb,
+                self.bwd_read_slot, self.bwd_saved_slot,
+                self.bank_f_valid, self.bank_f_chunk, self.bank_f_slot,
+                self.bank_b_valid, self.bank_b_chunk, self.bank_b_slot,
+            ],
+            axis=-1,
+        ).astype(np.int32)
+
+
+def _fwd_order(n: int, v: int, m: int):
+    """Device-local forward op order: groups of ``n`` microbatches sweep the
+    chunks in ascending order (Megatron's grouping)."""
+    ops = []
+    for g in range(m // n):
+        for c in range(v):
+            for r in range(n):
+                ops.append((c, g * n + r))
+    return ops
+
+
+def _bwd_order(n: int, v: int, m: int):
+    """Backward order: same grouping, chunks descending."""
+    ops = []
+    for g in range(m // n):
+        for c in reversed(range(v)):
+            for r in range(n):
+                ops.append((c, g * n + r))
+    return ops
+
+
+def build_interleaved_schedule(n: int, v: int, m: int) -> InterleavedSchedule:
+    """Simulate the interleaved 1F1B program and emit tick tables.
+
+    Self-timed execution: each device walks its op lists in order; a forward
+    fires when its upstream output has ARRIVED (produced at a strictly
+    earlier tick, +1-tick wire) and the in-flight cap allows; a backward
+    fires when its downstream cotangent has arrived and its own forward has
+    banked (same tick allowed — the forward slot precedes the backward slot
+    in the traced body). Deadlock-freedom is checked by construction (the
+    simulation must finish); ring sizes are grown until slot reuse is
+    provably hazard-free.
+    """
+    if n < 2:
+        raise ValueError("interleaved 1F1B needs pp >= 2")
+    if v < 1:
+        raise ValueError("num_virtual_stages must be >= 1")
+    if m % n != 0:
+        raise ValueError(
+            f"interleaved 1F1B needs num_microbatches ({m}) divisible by pp ({n})"
+        )
+
+    fwd_ops = [_fwd_order(n, v, m) for _ in range(n)]
+    bwd_ops = [_bwd_order(n, v, m) for _ in range(n)]
+    # Megatron warmup: stagger by device, plus one full sweep per extra chunk
+    warmup = [min(2 * (n - i - 1) + (v - 1) * n, m * v) for i in range(n)]
+    # in-flight cap keeps memory bounded at warmup+1 banked microbatches
+    cap = [w + 1 for w in warmup]
+
+    fwd_done = {}  # (stage s, mb) -> tick it ran
+    bwd_done = {}  # (stage s, mb) -> tick it ran
+    fp = [0] * n  # per-device next fwd op
+    bp = [0] * n  # per-device next bwd op
+    fwd_events = [[] for _ in range(n)]  # (tick, c, mb)
+    bwd_events = [[] for _ in range(n)]
+    t = 0
+    limit = 4 * (m * v + 2 * n * v) + 64  # generous stall ceiling
+    while (min(bp) < m * v) and t < limit:
+        fired_f = [None] * n
+        fired_b = [None] * n
+        for i in range(n):
+            # ---- forward slot
+            if fp[i] < m * v and (fp[i] - bp[i]) < cap[i]:
+                c, f = fwd_ops[i][fp[i]]
+                s = c * n + i
+                ready = s == 0 or fwd_done.get((s - 1, f), t) < t  # wire: < t
+                if ready:
+                    fired_f[i] = (c, f)
+            # ---- backward slot (only after this device's warmup completes)
+            if bp[i] < m * v and fp[i] >= min(warmup[i], m * v):
+                c, f = bwd_ops[i][bp[i]]
+                s = c * n + i
+                down_ok = s == n * v - 1 or bwd_done.get((s + 1, f), t) < t
+                # own forward banked (same tick OK: fwd slot runs first)
+                own = (s, f) in fwd_done or fired_f[i] == (c, f)
+                if down_ok and own:
+                    fired_b[i] = (c, f)
+        for i in range(n):
+            if fired_f[i] is not None:
+                c, f = fired_f[i]
+                fwd_done[(c * n + i, f)] = t
+                fwd_events[i].append((t, c, f))
+                fp[i] += 1
+            if fired_b[i] is not None:
+                c, f = fired_b[i]
+                bwd_done[(c * n + i, f)] = t
+                bwd_events[i].append((t, c, f))
+                bp[i] += 1
+        t += 1
+    if min(bp) < m * v:
+        raise RuntimeError(
+            f"interleaved schedule deadlocked at tick {t} (n={n}, v={v}, m={m})"
+        )
+    total = t
+
+    # ---------------- ring sizing: lifetime intervals per (device, chunk)
+    def _size_ring(groups):
+        """``groups`` maps (device, chunk) -> [(mb, write_tick, read_tick)].
+        Rings are per (device, chunk) buffers indexed ``mb % R``; find the
+        least R such that within every group no slot is rewritten at or
+        before the previous occupant's read tick."""
+        R = 1
+        while True:
+            ok = True
+            for intervals in groups.values():
+                by_slot = {}
+                for f, w, r in intervals:
+                    by_slot.setdefault(f % R, []).append((w, r))
+                for lst in by_slot.values():
+                    lst.sort()
+                    for (w1, r1), (w2, _r2) in zip(lst, lst[1:]):
+                        if w2 <= r1:  # rewrite at/before last read: hazard
+                            ok = False
+                            break
+                    if not ok:
+                        break
+                if not ok:
+                    break
+            if ok:
+                return R
+            R += 1
+
+    # fwd-input ring: banked at (producer tick + 1), read at consumer fwd tick
+    f_in, saved, b_in = {}, {}, {}
+    for i in range(n):
+        for tick, c, f in fwd_events[i]:
+            s = c * n + i
+            # saved ring: written at fwd tick, read at own bwd tick
+            saved.setdefault((i, c), []).append((f, tick, bwd_done[(s, f)]))
+            if s > 0:
+                f_in.setdefault((i, c), []).append(
+                    (f, fwd_done[(s - 1, f)] + 1, tick)
+                )
+        for tick, c, f in bwd_events[i]:
+            s = c * n + i
+            if s < n * v - 1:
+                b_in.setdefault((i, c), []).append(
+                    (f, bwd_done[(s + 1, f)] + 1, tick)
+                )
+    ring_f = _size_ring(f_in)
+    ring_s = _size_ring(saved)
+    ring_b = _size_ring(b_in)
+
+    # ---------------- tables
+    shape = (n, total)
+    z = lambda: np.zeros(shape, np.int32)  # noqa: E731
+    sch = InterleavedSchedule(
+        n=n, v=v, m=m, total_ticks=total,
+        ring_f=ring_f, ring_s=ring_s, ring_b=ring_b,
+        fwd_valid=z(), fwd_chunk=z(), fwd_mb=z(),
+        fwd_read_slot=z(), fwd_save_slot=z(),
+        bwd_valid=z(), bwd_chunk=z(), bwd_mb=z(),
+        bwd_read_slot=z(), bwd_saved_slot=z(),
+        bank_f_valid=z(), bank_f_chunk=z(), bank_f_slot=z(),
+        bank_b_valid=z(), bank_b_chunk=z(), bank_b_slot=z(),
+    )
+    for i in range(n):
+        for tick, c, f in fwd_events[i]:
+            sch.fwd_valid[i, tick] = 1
+            sch.fwd_chunk[i, tick] = c
+            sch.fwd_mb[i, tick] = f
+            sch.fwd_read_slot[i, tick] = f % ring_f
+            sch.fwd_save_slot[i, tick] = f % ring_s
+            # wire out: stage s output arrives at device (i+1)%n next tick;
+            # the LAST global stage produces nothing (head fused in backward)
+            s = c * n + i
+            if s < n * v - 1 and tick + 1 < total:
+                j = (i + 1) % n
+                cj = c + 1 if i == n - 1 else c  # device-ring wrap = next chunk
+                sch.bank_f_valid[j, tick + 1] = 1
+                sch.bank_f_chunk[j, tick + 1] = cj
+                sch.bank_f_slot[j, tick + 1] = f % ring_f
+        for tick, c, f in bwd_events[i]:
+            sch.bwd_valid[i, tick] = 1
+            sch.bwd_chunk[i, tick] = c
+            sch.bwd_mb[i, tick] = f
+            sch.bwd_read_slot[i, tick] = f % ring_b
+            sch.bwd_saved_slot[i, tick] = f % ring_s
+            # cotangent wire: stage s's d_h goes to stage s-1's device;
+            # stage 0 emits nothing (embed vjp folded into its backward)
+            s = c * n + i
+            if s > 0 and tick + 1 < total:
+                j = (i - 1) % n
+                cj = c - 1 if i == 0 else c
+                sch.bank_b_valid[j, tick + 1] = 1
+                sch.bank_b_chunk[j, tick + 1] = cj
+                sch.bank_b_slot[j, tick + 1] = f % ring_b
+    _check_tables(sch)
+    return sch
+
+
+def _check_tables(sch: InterleavedSchedule) -> None:
+    """Invariants the traced loop relies on: every op runs exactly once, and
+    every banked wire value lands in the ring of the chunk that OWNS the
+    receiving stage (fwd: stage s+1; bwd: stage s-1) at the slot its consumer
+    will read."""
+    n, v, m = sch.n, sch.v, sch.m
+    assert sch.fwd_valid.sum() == n * m * v
+    assert sch.bwd_valid.sum() == n * m * v
+    for i in range(n):
+        for t in range(sch.total_ticks):
+            if sch.bank_f_valid[i, t]:
+                # sender was device (i-1)%n's fwd at t-1 of stage s; the
+                # receiver chunk must own stage s+1 on device i
+                src = (i - 1) % n
+                assert sch.fwd_valid[src, t - 1]
+                s = sch.fwd_chunk[src, t - 1] * n + src
+                c = sch.bank_f_chunk[i, t]
+                assert c * n + i == s + 1, "fwd bank chunk does not own s+1"
+                assert sch.bank_f_slot[i, t] == sch.fwd_mb[src, t - 1] % sch.ring_f
+            if sch.bank_b_valid[i, t]:
+                src = (i + 1) % n
+                assert sch.bwd_valid[src, t - 1]
+                s = sch.bwd_chunk[src, t - 1] * n + src
+                c = sch.bank_b_chunk[i, t]
+                assert c * n + i == s - 1, "bwd bank chunk does not own s-1"
+                assert sch.bank_b_slot[i, t] == sch.bwd_mb[src, t - 1] % sch.ring_b
+
+
+# ------------------------------------------------------------------ traced vag
+from .pp_1f1b import _index_mb, _tree_add, shard_microbatches  # noqa: E402
+
+
+def interleave_permutation(num_layers: int, n: int, v: int) -> np.ndarray:
+    """Row permutation: canonical layer order -> device-major interleaved.
+
+    ``perm[new_row] = old_row`` where device ``i``'s contiguous block
+    ``[i*L/n, (i+1)*L/n)`` holds its chunks ``c = 0..v-1`` (global stage
+    ``c*n + i``) back to back."""
+    lc = num_layers // (n * v)
+    perm = []
+    for i in range(n):
+        for c in range(v):
+            base = (c * n + i) * lc
+            perm.extend(range(base, base + lc))
+    return np.asarray(perm, np.int64)
+
+
+def make_interleaved_1f1b_value_and_grad(
+    mesh: Mesh,
+    num_microbatches: int,
+    num_virtual_stages: int,
+    pp_axis: str = "pp",
+    batch_axes=("dp_replicate", "dp_shard"),
+    seq_axes=("cp", "sp"),
+) -> Callable:
+    """Interleaved-1F1B counterpart of
+    :func:`parallel.pp_1f1b.make_1f1b_value_and_grad` — same vag signature
+    and loss/grad semantics, ``v``-way virtual stages per device."""
+    n = mesh.shape[pp_axis]
+    v = num_virtual_stages
+    m = num_microbatches
+    sch = build_interleaved_schedule(n, v, m)
+    tables_np = sch.packed()  # (n, T, 16)
+    total = sch.total_ticks
+
+    def vag(stage_params, io_params, batch, embed_fn, stage_fn, head_loss_fn,
+            loss_denom, cotangent_scale=1.0):
+        leaves = jax.tree_util.tree_leaves(stage_params)
+        num_layers = leaves[0].shape[0]
+        if num_layers % (n * v) != 0:
+            raise ValueError(
+                f"{num_layers} layers not divisible by pp*virtual ({n}*{v})"
+            )
+        lc = num_layers // (n * v)
+        perm = interleave_permutation(num_layers, n, v)
+        inv_perm = np.argsort(perm)
+
+        spec_stage = jax.tree_util.tree_map(lambda _: P(pp_axis), stage_params)
+        stage_sharding = jax.tree_util.tree_map(
+            lambda _: NamedSharding(mesh, P(pp_axis)), stage_params
+        )
+        # canonical -> interleaved rows (cross-device: one param all-to-all)
+        stage_il = jax.tree_util.tree_map(
+            lambda a, sh: jax.lax.with_sharding_constraint(
+                jnp.take(a, perm, axis=0), sh
+            ),
+            stage_params, stage_sharding,
+        )
+
+        micro = shard_microbatches(mesh, batch, m, batch_axes, seq_axes)
+        tables = jnp.asarray(tables_np)  # (n, T, 16), sharded P(pp) below
+
+        def pipeline(table_local, stage_local, io_local, micro_local, denom):
+            # table_local: (1, T, 16) — this device's schedule
+            idx = lax.axis_index(pp_axis)
+            tab = table_local[0]
+
+            h_shape = jax.eval_shape(embed_fn, io_local, _index_mb(micro_local, 0))
+            hs, hdt = h_shape.shape, h_shape.dtype
+            wire_f0 = jnp.zeros(hs, hdt)
+            wire_b0 = jnp.zeros(hs, hdt)
+            fwd_in0 = jnp.zeros((v, sch.ring_f, *hs), hdt)
+            saved0 = jnp.zeros((v, sch.ring_s, *hs), hdt)
+            bwd_in0 = jnp.zeros((v, sch.ring_b, *hs), hdt)
+            g_stage0 = jax.tree_util.tree_map(jnp.zeros_like, stage_local)
+            g_io0 = jax.tree_util.tree_map(jnp.zeros_like, io_local)
+
+            perm_fwd = [(i, (i + 1) % n) for i in range(n)]
+            perm_bwd = [(i, (i - 1) % n) for i in range(n)]
+            ct = jnp.float32(cotangent_scale)
+
+            def chunk_params(c):
+                return jax.tree_util.tree_map(
+                    lambda a: lax.dynamic_slice_in_dim(a, c * lc, lc, axis=0),
+                    stage_local,
+                )
+
+            def add_chunk_grad(g_stage, c, g_chunk):
+                return jax.tree_util.tree_map(
+                    lambda g, gc: lax.dynamic_update_slice_in_dim(
+                        g,
+                        lax.dynamic_slice_in_dim(g, c * lc, lc, axis=0) + gc,
+                        c * lc,
+                        axis=0,
+                    ),
+                    g_stage, g_chunk,
+                )
+
+            def tick(t, carry):
+                (recv_f, recv_b, fwd_in, saved, bwd_in,
+                 loss_acc, g_stage, g_io) = carry
+                row = lax.dynamic_index_in_dim(tab, t, 0, keepdims=False)
+                (f_val, f_c, f_mb, f_rd, f_sv,
+                 b_val, b_c, b_mb, b_rd, b_sd,
+                 kf_val, kf_c, kf_sl, kb_val, kb_c, kb_sl) = [
+                    row[j] for j in range(16)
+                ]
+
+                # ---------- bank incoming wires (writes precede all reads)
+                fwd_in = lax.cond(
+                    kf_val == 1,
+                    lambda buf: buf.at[kf_c, kf_sl].set(recv_f),
+                    lambda buf: buf,
+                    fwd_in,
+                )
+                bwd_in = lax.cond(
+                    kb_val == 1,
+                    lambda buf: buf.at[kb_c, kb_sl].set(recv_b),
+                    lambda buf: buf,
+                    bwd_in,
+                )
+
+                # ---------- forward slot
+                mb_f = _index_mb(micro_local, jnp.maximum(f_mb, 0))
+                first_stage_f = (idx == 0) & (f_c == 0)
+                last_stage_f = (idx == n - 1) & (f_c == v - 1)
+                h_in = lax.cond(
+                    (f_val == 1) & first_stage_f,
+                    lambda: embed_fn(io_local, mb_f).astype(hdt),
+                    lambda: fwd_in[f_c, f_rd],
+                )
+                saved = lax.cond(
+                    f_val == 1,
+                    lambda s: s.at[f_c, f_sv].set(h_in),
+                    lambda s: s,
+                    saved,
+                )
+                # last global stage's compute is fused into its backward slot
+                h_out = lax.cond(
+                    (f_val == 1) & ~last_stage_f,
+                    lambda h: stage_fn(chunk_params(f_c), h),
+                    lambda h: jnp.zeros_like(h),
+                    h_in,
+                )
+
+                # ---------- backward slot
+                mb_b = _index_mb(micro_local, jnp.maximum(b_mb, 0))
+                h_saved = saved[b_c, b_sd]
+                cot_in = bwd_in[b_c, b_rd]
+                cp = chunk_params(b_c)
+                first_stage_b = (idx == 0) & (b_c == 0)
+                last_stage_b = (idx == n - 1) & (b_c == v - 1)
+
+                def idle_branch(cot):
+                    return (
+                        jnp.float32(0.0),
+                        jax.tree_util.tree_map(jnp.zeros_like, cp),
+                        jax.tree_util.tree_map(jnp.zeros_like, io_local),
+                        jnp.zeros_like(cot),
+                    )
+
+                def last_branch(cot):
+                    def objective(sp, io, h):
+                        return head_loss_fn(io, stage_fn(sp, h), mb_b)
+
+                    loss_f, vjp = jax.vjp(objective, cp, io_local, h_saved)
+                    g_sp, g_iod, d_h = vjp(ct / denom)
+                    return loss_f / denom, g_sp, g_iod, d_h
+
+                def first_branch(cot):
+                    def objective(sp, io):
+                        return stage_fn(sp, embed_fn(io, mb_b).astype(cot.dtype))
+
+                    _, vjp = jax.vjp(objective, cp, io_local)
+                    g_sp, g_iod = vjp(cot)
+                    return jnp.float32(0.0), g_sp, g_iod, jnp.zeros_like(cot)
+
+                def mid_branch(cot):
+                    _, vjp = jax.vjp(lambda sp, h: stage_fn(sp, h), cp, h_saved)
+                    g_sp, d_h = vjp(cot)
+                    return (
+                        jnp.float32(0.0), g_sp,
+                        jax.tree_util.tree_map(jnp.zeros_like, io_local), d_h,
+                    )
+
+                branch = jnp.where(
+                    b_val == 0, 0,
+                    jnp.where(last_stage_b, 1, jnp.where(first_stage_b, 2, 3)),
+                )
+                loss_f, g_sp, g_iod, d_h = lax.switch(
+                    branch, [idle_branch, last_branch, first_branch, mid_branch],
+                    cot_in,
+                )
+                loss_acc = loss_acc + loss_f
+                g_stage = lax.cond(
+                    b_val == 1,
+                    lambda gs: add_chunk_grad(gs, b_c, g_sp),
+                    lambda gs: gs,
+                    g_stage,
+                )
+                g_io = _tree_add(g_io, g_iod)
+
+                # ---------- wires (ordered: see module docstring)
+                recv_f = lax.ppermute(h_out, pp_axis, perm_fwd)
+                d_h, _ = lax.optimization_barrier((d_h, recv_f))
+                recv_b = lax.ppermute(d_h, pp_axis, perm_bwd)
+                return (recv_f, recv_b, fwd_in, saved, bwd_in,
+                        loss_acc, g_stage, g_io)
+
+            carry = (wire_f0, wire_b0, fwd_in0, saved0, bwd_in0,
+                     jnp.float32(0.0), g_stage0, g_io0)
+            carry = lax.fori_loop(0, total, tick, carry)
+            loss_acc, g_stage, g_io = carry[5], carry[6], carry[7]
+
+            loss = lax.psum(loss_acc, pp_axis)
+            g_io = jax.tree_util.tree_map(
+                lambda g: lax.psum(g.astype(jnp.float32), pp_axis).astype(g.dtype),
+                g_io,
+            )
+            return loss, g_stage, g_io
+
+        fn = jax.shard_map(
+            pipeline,
+            mesh=mesh,
+            in_specs=(P(pp_axis), spec_stage, P(), P(), P()),
+            out_specs=(P(), spec_stage, P()),
+            axis_names={pp_axis},
+            check_vma=False,
+        )
+        loss, g_stage_il, g_io = fn(
+            tables, stage_il, io_params, micro,
+            jnp.asarray(loss_denom, jnp.float32),
+        )
+        # interleaved -> canonical grad rows (the inverse all-to-all)
+        g_stage = jax.tree_util.tree_map(
+            lambda a, sh: jax.lax.with_sharding_constraint(
+                jnp.take(a, inv_perm, axis=0), sh
+            ),
+            g_stage_il, stage_sharding,
+        )
+        return loss, g_stage, g_io
+
+    return vag

@@ -193,6 +193,7 @@ class ParallelismConfig:
         if int(kwargs.get("pp_size", 1)) > 1 and (
             f"{_ENV_PREFIX}PP_MICROBATCHES" in os.environ
             or f"{_ENV_PREFIX}PP_SCHEDULE" in os.environ
+            or f"{_ENV_PREFIX}PP_VIRTUAL_STAGES" in os.environ
         ):
             from .utils.dataclasses import PipelineParallelConfig
 
@@ -203,6 +204,10 @@ class ParallelismConfig:
                 )
             if f"{_ENV_PREFIX}PP_SCHEDULE" in os.environ:
                 pp_kwargs["schedule"] = os.environ[f"{_ENV_PREFIX}PP_SCHEDULE"]
+            if f"{_ENV_PREFIX}PP_VIRTUAL_STAGES" in os.environ:
+                pp_kwargs["num_virtual_stages"] = int(
+                    os.environ[f"{_ENV_PREFIX}PP_VIRTUAL_STAGES"]
+                )
             kwargs["pp_config"] = PipelineParallelConfig(**pp_kwargs)
         if not kwargs and total_devices is not None:
             # No config at all → pure data parallel over every device, the

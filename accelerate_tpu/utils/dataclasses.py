@@ -284,10 +284,23 @@ class PipelineParallelConfig(KwargsHandler):
     # forward pipeline + autodiff-transposed backward (parallel/pp.py) —
     # also what forward-only/eval paths always use.
     schedule: str = "1f1b"
+    # >1 turns the 1f1b schedule into the Megatron-style INTERLEAVED
+    # schedule (parallel/pp_interleaved.py): each device runs this many
+    # non-adjacent layer chunks, shrinking the pipeline bubble ~1/v at the
+    # cost of more in-flight activation memory. Requires num_microbatches
+    # divisible by pp_size and layers divisible by pp_size*num_virtual_stages.
+    num_virtual_stages: int = 1
 
     def __post_init__(self):
         if self.schedule not in ("gpipe", "1f1b"):
             raise ValueError(f"Unknown pipeline schedule {self.schedule}")
+        if self.num_virtual_stages < 1:
+            raise ValueError("num_virtual_stages must be >= 1")
+        if self.num_virtual_stages > 1 and self.schedule != "1f1b":
+            raise ValueError(
+                "num_virtual_stages > 1 requires the 1f1b schedule "
+                "(interleaving is a 1F1B refinement)"
+            )
 
 
 @dataclass

@@ -1,0 +1,175 @@
+"""Serial on-TPU probe battery: NaN bisect + flash kernel validation.
+
+One process, smallest-compile-first, keeps going on failure — the relay is
+flaky, so every probe prints its verdict immediately. Run alone (the chip is
+single-tenant; concurrent processes wedge the relay).
+
+Usage: python benchmarks/tpu_probes.py [probe ...]   (default: all)
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+
+def _finite(name, arr):
+    arr = np.asarray(arr, np.float32)
+    ok = bool(np.isfinite(arr).all())
+    print(f"PROBE {name}: {'FINITE' if ok else 'NAN/INF'} "
+          f"(min={arr.min():.4g} max={arr.max():.4g})", flush=True)
+    return ok
+
+
+def probe_blockwise_grad():
+    """Blockwise attention grad at seq 1024 (multi-block scan) vs the dense
+    reference — the NaN suspect: seq<=512 is single-block and clean."""
+    import jax
+    import jax.numpy as jnp
+
+    from accelerate_tpu.ops.attention import blockwise_attention, dot_product_attention
+
+    rng = np.random.default_rng(0)
+    shape = (2, 1024, 8, 64)
+    q, k, v = (jnp.asarray(rng.normal(size=shape), dtype=jnp.bfloat16) for _ in range(3))
+
+    def loss_b(q, k, v):
+        return jnp.sum(blockwise_attention(q, k, v, causal=True).astype(jnp.float32))
+
+    def loss_d(q, k, v):
+        return jnp.sum(dot_product_attention(q, k, v, causal=True).astype(jnp.float32))
+
+    g_b = jax.jit(jax.grad(loss_b, argnums=(0, 1, 2)))(q, k, v)
+    g_d = jax.jit(jax.grad(loss_d, argnums=(0, 1, 2)))(q, k, v)
+    ok = True
+    for name, a, b in zip("qkv", g_b, g_d):
+        ok &= _finite(f"blockwise d{name}", a)
+        err = np.abs(np.asarray(a, np.float32) - np.asarray(b, np.float32)).max()
+        print(f"  d{name} max err vs dense: {err:.4g}", flush=True)
+    return ok
+
+
+def probe_flash():
+    """Flash kernel fwd+bwd on-device vs blockwise (real lowering, not
+    interpret)."""
+    import jax
+    import jax.numpy as jnp
+
+    from accelerate_tpu.ops.attention import blockwise_attention
+    from accelerate_tpu.ops.flash_attention import flash_attention
+
+    rng = np.random.default_rng(0)
+    shape = (2, 1024, 8, 64)
+    q, k, v = (jnp.asarray(rng.normal(size=shape), dtype=jnp.bfloat16) for _ in range(3))
+
+    t0 = time.perf_counter()
+    out_f = jax.jit(flash_attention, static_argnames=("causal",))(q, k, v, causal=True)
+    out_f = np.asarray(out_f, np.float32)
+    print(f"  flash fwd compile+run {time.perf_counter()-t0:.1f}s", flush=True)
+    out_r = np.asarray(
+        jax.jit(blockwise_attention, static_argnames=("causal",))(q, k, v, causal=True),
+        np.float32,
+    )
+    ok = _finite("flash fwd", out_f)
+    print(f"  fwd max err vs blockwise: {np.abs(out_f - out_r).max():.4g}", flush=True)
+
+    def loss_f(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True).astype(jnp.float32))
+
+    def loss_r(q, k, v):
+        return jnp.sum(blockwise_attention(q, k, v, causal=True).astype(jnp.float32))
+
+    g_f = jax.jit(jax.grad(loss_f, argnums=(0, 1, 2)))(q, k, v)
+    g_r = jax.jit(jax.grad(loss_r, argnums=(0, 1, 2)))(q, k, v)
+    for name, a, b in zip("qkv", g_f, g_r):
+        ok &= _finite(f"flash d{name}", a)
+        err = np.abs(np.asarray(a, np.float32) - np.asarray(b, np.float32)).max()
+        print(f"  d{name} max err vs blockwise: {err:.4g}", flush=True)
+    return ok
+
+
+def _bench_model(attn, seq, train, steps=2, batch=2, layers=16):
+    import jax
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.models.llama import LlamaConfig, create_llama, llama_loss
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+    cfg = LlamaConfig(
+        vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+        num_hidden_layers=layers, num_attention_heads=16, num_key_value_heads=16,
+        max_position_embeddings=seq, remat_policy="minimal", attention_impl=attn,
+        use_chunked_ce=False,
+    )
+    acc = Accelerator(mixed_precision="bf16")
+    rng = np.random.default_rng(0)
+    if not train:
+        model = acc.prepare(create_llama(cfg, seed=0))
+        model.policy = None
+        batch_d = {"input_ids": np.asarray(
+            rng.integers(0, 32000, size=(batch, seq)), np.int32)}
+        fn = acc.eval_step(llama_loss)
+        return fn(batch_d)
+    model, _ = acc.prepare(create_llama(cfg, seed=0), optax.adamw(3e-4, weight_decay=0.01))
+    model.policy = None
+    step_fn = acc.train_step(llama_loss, max_grad_norm=1.0, multi_step=True)
+    batches = {"input_ids": np.asarray(
+        rng.integers(0, 32000, size=(steps, batch, seq)), np.int32)}
+    return step_fn(jax.device_put(batches))
+
+
+def probe_fwd2048():
+    """Full-model FORWARD loss at seq 2048 — separates a forward NaN from a
+    gradient/optimizer NaN."""
+    return _finite("fwd loss seq2048 blockwise", _bench_model("blockwise", 2048, train=False))
+
+
+def probe_train2048_losses():
+    """Per-step training losses at seq 2048, blockwise — which step NaNs?"""
+    return _finite("train losses seq2048 blockwise", _bench_model("blockwise", 2048, train=True))
+
+
+def probe_train1024_losses():
+    """Seq 1024 (first multi-block length) training — narrows the threshold."""
+    return _finite("train losses seq1024 blockwise", _bench_model("blockwise", 1024, train=True))
+
+
+def probe_train2048_flash():
+    """Same training step with the flash kernel — if finite where blockwise
+    NaNs, flash is both the fix and the perf win."""
+    return _finite("train losses seq2048 flash", _bench_model("flash", 2048, train=True))
+
+
+PROBES = {
+    "blockwise_grad": probe_blockwise_grad,
+    "flash": probe_flash,
+    "fwd2048": probe_fwd2048,
+    "train1024": probe_train1024_losses,
+    "train2048": probe_train2048_losses,
+    "train2048_flash": probe_train2048_flash,
+}
+
+
+def main():
+    names = sys.argv[1:] or list(PROBES)
+    results = {}
+    for name in names:
+        print(f"=== {name} ===", flush=True)
+        t0 = time.perf_counter()
+        try:
+            results[name] = bool(PROBES[name]())
+        except Exception as exc:  # noqa: BLE001 — keep probing on failure
+            print(f"PROBE {name}: ERROR {type(exc).__name__}: {exc}", flush=True)
+            results[name] = False
+        print(f"  ({time.perf_counter()-t0:.1f}s)", flush=True)
+    print("SUMMARY:", results, flush=True)
+
+
+if __name__ == "__main__":
+    main()

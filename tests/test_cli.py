@@ -135,6 +135,7 @@ def test_config_questionnaire(monkeypatch, tmp_path, capsys):
         "4",      # microbatches
         "wrong",  # schedule (rejected, re-asked)
         "1f1b",   # schedule
+        "2",      # virtual stages (interleaved 1F1B)
         "y",      # fault tolerance?
         "3",      # max restarts
         "600",    # watchdog
@@ -146,6 +147,8 @@ def test_config_questionnaire(monkeypatch, tmp_path, capsys):
     assert rc == 0
     cfg = ClusterConfig.load(path)
     assert cfg.tp_size == 2 and cfg.pp_size == 2 and cfg.pp_schedule == "1f1b"
+    assert cfg.pp_virtual_stages == 2
+    assert cfg.to_env()["PARALLELISM_CONFIG_PP_VIRTUAL_STAGES"] == "2"
     assert cfg.max_restarts == 3 and cfg.watchdog_timeout == 600.0
     assert cfg.gradient_accumulation_steps == 2
 

@@ -46,6 +46,19 @@ def test_from_env():
     assert cfg.tp_size == 2
 
 
+def test_from_env_pp_virtual_stages():
+    with patch_environment(
+        PARALLELISM_CONFIG_PP_SIZE=2,
+        PARALLELISM_CONFIG_DP_SHARD_SIZE=4,
+        PARALLELISM_CONFIG_PP_MICROBATCHES=2,
+        PARALLELISM_CONFIG_PP_VIRTUAL_STAGES=2,
+    ):
+        cfg = ParallelismConfig.from_env(total_devices=8)
+    assert cfg.pp_size == 2
+    assert cfg.pp_config.num_microbatches == 2
+    assert cfg.pp_config.num_virtual_stages == 2
+
+
 def test_joint_axes():
     cfg = ParallelismConfig(dp_replicate_size=2, dp_shard_size=2, cp_size=2)
     cfg._infer_and_validate(8)
