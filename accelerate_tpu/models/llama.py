@@ -33,7 +33,7 @@ from jax.ad_checkpoint import checkpoint_name
 
 from ..model import Model
 from ..ops.attention import blockwise_attention, dot_product_attention
-from ..parallel.sharding import constrain_activation
+from ..parallel.sharding import constrain_activation, replicate_over_fsdp
 
 __all__ = ["LlamaConfig", "init_llama_params", "llama_apply", "create_llama", "llama_loss"]
 
@@ -304,7 +304,11 @@ def llama_apply(
     load-balancing loss summed over layers). ``layer_stack_fn`` overrides how
     the stacked layers run (injected by pipeline parallelism)."""
     cdt = config.compute_dtype
-    x = constrain_activation(params["embed_tokens"]["embedding"].astype(cdt)[input_ids])
+    # explicit use-time all-gather of the (possibly fsdp/tp-sharded) table:
+    # a gather from a sharded table is the partitioner's worst case (it
+    # replicates involuntarily); same bytes moved, no pathological reshard
+    table = replicate_over_fsdp(params["embed_tokens"]["embedding"], keep_tp=False)
+    x = constrain_activation(table.astype(cdt)[input_ids])
 
     layer_fn = functools.partial(
         _layer, config, position_offset=position_offset, attention_fn=attention_fn
@@ -346,7 +350,10 @@ def llama_apply(
         if return_aux:
             out["aux_loss"] = aux_total
         return out
-    logits = (x @ head.astype(cdt)).astype(jnp.float32)
+    # use-time all-gather of the fsdp-sharded head; keeps logits (and their
+    # cotangents) on the batch/seq layout — see replicate_over_fsdp
+    logits = (x @ replicate_over_fsdp(head).astype(cdt)).astype(jnp.float32)
+    logits = constrain_activation(logits, "vocab")
     if return_aux:
         return logits, {"aux_loss": aux_total}
     return logits
@@ -394,7 +401,15 @@ def _ce_from_hidden(config, x, head, labels, mask, *, reduction="mean",
             chunk_size=ce_chunk_size or config.ce_chunk_size,
             loss_mask=_mask_of(labels, mask), reduction=reduction,
         )
+    # all-gather the fsdp-sharded head for the logits matmul (the standard
+    # FSDP use-time gather). Without this the partitioner keeps logits
+    # vocab-sharded to match the head while the CE math runs
+    # batch/seq-sharded, and the backward transpose hits the involuntary
+    # full-rematerialization path (d_logits {batch,seq} -> {vocab} flip).
+    # With a replicated head, d_head is a local partial + psum — clean.
+    head = replicate_over_fsdp(head)
     logits = (x @ head.astype(config.compute_dtype)).astype(jnp.float32)
+    logits = constrain_activation(logits, "vocab")
     return _dense_ce_from_logits(logits, labels, mask, reduction=reduction)
 
 

@@ -88,6 +88,50 @@ def shard_microbatches(mesh, batch, m, batch_axes, seq_axes):
     )
 
 
+def backward_branches(sp, io_local, h_saved, mb_b, embed_fn, stage_fn,
+                      head_loss_fn, ct, denom):
+    """The four per-role backward-slot branches for ``lax.switch`` — shared
+    by the plain and interleaved 1F1B schedules so their loss/grad semantics
+    cannot drift. ``sp`` is whatever parameter tree the stage vjp
+    differentiates (the full local stage here; one chunk's slice in the
+    interleaved schedule). Order: [idle, last, first, mid]; operand: the
+    incoming cotangent. Returns (loss, g_stage, g_io, d_h)."""
+
+    def idle_branch(cot):
+        return (
+            jnp.float32(0.0),
+            jax.tree_util.tree_map(jnp.zeros_like, sp),
+            jax.tree_util.tree_map(jnp.zeros_like, io_local),
+            jnp.zeros_like(cot),
+        )
+
+    def last_branch(cot):
+        def objective(p, io, h):
+            return head_loss_fn(io, stage_fn(p, h), mb_b)
+
+        loss_f, vjp = jax.vjp(objective, sp, io_local, h_saved)
+        g_sp, g_iod, d_h = vjp(ct / denom)
+        return loss_f / denom, g_sp, g_iod, d_h
+
+    def first_branch(cot):
+        def objective(p, io):
+            return stage_fn(p, embed_fn(io, mb_b).astype(cot.dtype))
+
+        _, vjp = jax.vjp(objective, sp, io_local)
+        g_sp, g_iod = vjp(cot)
+        return jnp.float32(0.0), g_sp, g_iod, jnp.zeros_like(cot)
+
+    def mid_branch(cot):
+        _, vjp = jax.vjp(lambda p, h: stage_fn(p, h), sp, h_saved)
+        g_sp, d_h = vjp(cot)
+        return (
+            jnp.float32(0.0), g_sp,
+            jax.tree_util.tree_map(jnp.zeros_like, io_local), d_h,
+        )
+
+    return [idle_branch, last_branch, first_branch, mid_branch]
+
+
 def make_1f1b_value_and_grad(
     mesh: Mesh,
     num_microbatches: int,
@@ -186,50 +230,16 @@ def make_1f1b_value_and_grad(
                     ring, f_bwd % n, 0, keepdims=False
                 )
 
-                def idle_branch(recv_b):
-                    return (
-                        jnp.float32(0.0),
-                        jax.tree_util.tree_map(jnp.zeros_like, stage_local),
-                        jax.tree_util.tree_map(jnp.zeros_like, io_local),
-                        jnp.zeros_like(recv_b),
-                    )
-
-                def last_branch(recv_b):
-                    def objective(sp, io, h):
-                        return head_loss_fn(io, stage_fn(sp, h), mb_b)
-
-                    loss_f, vjp = jax.vjp(
-                        objective, stage_local, io_local, h_saved
-                    )
-                    g_sp, g_iod, d_h = vjp(ct / denom)
-                    return loss_f / denom, g_sp, g_iod, d_h
-
-                def first_branch(recv_b):
-                    def objective(sp, io):
-                        return stage_fn(sp, embed_fn(io, mb_b).astype(recv_b.dtype))
-
-                    _, vjp = jax.vjp(objective, stage_local, io_local)
-                    g_sp, g_iod = vjp(recv_b)
-                    return (
-                        jnp.float32(0.0), g_sp, g_iod, jnp.zeros_like(recv_b)
-                    )
-
-                def mid_branch(recv_b):
-                    _, vjp = jax.vjp(
-                        lambda sp, h: stage_fn(sp, h), stage_local, h_saved
-                    )
-                    g_sp, d_h = vjp(recv_b)
-                    return (
-                        jnp.float32(0.0), g_sp,
-                        jax.tree_util.tree_map(jnp.zeros_like, io_local), d_h,
-                    )
-
                 branch = jnp.where(
                     ~bwd_valid, 0,
                     jnp.where(last_mask, 1, jnp.where(first_mask, 2, 3)),
                 )
                 loss_f, g_sp, g_iod, d_h = lax.switch(
-                    branch, [idle_branch, last_branch, first_branch, mid_branch],
+                    branch,
+                    backward_branches(
+                        stage_local, io_local, h_saved, mb_b,
+                        embed_fn, stage_fn, head_loss_fn, ct, denom,
+                    ),
                     recv_b,
                 )
 

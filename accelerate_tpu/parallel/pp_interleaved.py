@@ -321,7 +321,12 @@ def _check_tables(sch: InterleavedSchedule) -> None:
 
 
 # ------------------------------------------------------------------ traced vag
-from .pp_1f1b import _index_mb, _tree_add, shard_microbatches  # noqa: E402
+from .pp_1f1b import (  # noqa: E402
+    _index_mb,
+    _tree_add,
+    backward_branches,
+    shard_microbatches,
+)
 
 
 def interleave_permutation(num_layers: int, n: int, v: int) -> np.ndarray:
@@ -475,44 +480,16 @@ def make_interleaved_1f1b_value_and_grad(
                 first_stage_b = (idx == 0) & (b_c == 0)
                 last_stage_b = (idx == n - 1) & (b_c == v - 1)
 
-                def idle_branch(cot):
-                    return (
-                        jnp.float32(0.0),
-                        jax.tree_util.tree_map(jnp.zeros_like, cp),
-                        jax.tree_util.tree_map(jnp.zeros_like, io_local),
-                        jnp.zeros_like(cot),
-                    )
-
-                def last_branch(cot):
-                    def objective(sp, io, h):
-                        return head_loss_fn(io, stage_fn(sp, h), mb_b)
-
-                    loss_f, vjp = jax.vjp(objective, cp, io_local, h_saved)
-                    g_sp, g_iod, d_h = vjp(ct / denom)
-                    return loss_f / denom, g_sp, g_iod, d_h
-
-                def first_branch(cot):
-                    def objective(sp, io):
-                        return stage_fn(sp, embed_fn(io, mb_b).astype(cot.dtype))
-
-                    _, vjp = jax.vjp(objective, cp, io_local)
-                    g_sp, g_iod = vjp(cot)
-                    return jnp.float32(0.0), g_sp, g_iod, jnp.zeros_like(cot)
-
-                def mid_branch(cot):
-                    _, vjp = jax.vjp(lambda sp, h: stage_fn(sp, h), cp, h_saved)
-                    g_sp, d_h = vjp(cot)
-                    return (
-                        jnp.float32(0.0), g_sp,
-                        jax.tree_util.tree_map(jnp.zeros_like, io_local), d_h,
-                    )
-
                 branch = jnp.where(
                     b_val == 0, 0,
                     jnp.where(last_stage_b, 1, jnp.where(first_stage_b, 2, 3)),
                 )
                 loss_f, g_sp, g_iod, d_h = lax.switch(
-                    branch, [idle_branch, last_branch, first_branch, mid_branch],
+                    branch,
+                    backward_branches(
+                        cp, io_local, h_saved, mb_b,
+                        embed_fn, stage_fn, head_loss_fn, ct, denom,
+                    ),
                     cot_in,
                 )
                 loss_acc = loss_acc + loss_f

@@ -207,6 +207,33 @@ def _axis_entry(mesh: Mesh, axes: Sequence[str], dim_size: int):
     return tuple(use) if len(use) > 1 else use[0]
 
 
+def replicate_over_fsdp(w, mesh: Optional[Mesh] = None, keep_tp: bool = True):
+    """Use-time all-gather of a 2D fsdp-sharded weight: replicated on every
+    axis except ``tp``, which stays on the last (output) dim when it divides
+    (Megatron column sharding); ``keep_tp=False`` replicates fully (e.g. an
+    embedding table consumed by a gather, where any remaining sharding sends
+    the partitioner down its involuntary-replication path anyway). The
+    explicit constraint keeps the weight's consumers on THEIR layout so the
+    backward computes a local partial + psum for the weight grad instead of
+    resharding the activation gradient (involuntary full rematerialization)."""
+    if mesh is None:
+        mesh = current_mesh()
+    if mesh is None or getattr(w, "ndim", 0) != 2:
+        return w
+    try:
+        if jax.sharding.get_abstract_mesh().manual_axes:
+            return w
+    except Exception:
+        pass
+    tp = _axis_entry(mesh, _ACT_TP_AXIS, w.shape[-1]) if keep_tp else None
+    try:
+        return jax.lax.with_sharding_constraint(
+            w, NamedSharding(mesh, P(None, tp))
+        )
+    except Exception:
+        return w
+
+
 def constrain_activation(x, kind: str = "residual", mesh: Optional[Mesh] = None):
     """``with_sharding_constraint`` for a (B, S, ..., F) activation.
 

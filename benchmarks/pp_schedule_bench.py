@@ -31,7 +31,7 @@ from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
 from accelerate_tpu.utils.dataclasses import PipelineParallelConfig
 
 
-def bench(schedule: str, num_microbatches: int, steps: int = 6):
+def bench(schedule: str, num_microbatches: int, steps: int = 6, virtual: int = 1):
     AcceleratorState._reset_state()
     GradientState._reset_state()
     PartialState._reset_state()
@@ -44,7 +44,8 @@ def bench(schedule: str, num_microbatches: int, steps: int = 6):
         parallelism_config=ParallelismConfig(
             pp_size=pp, dp_shard_size=2,
             pp_config=PipelineParallelConfig(
-                num_microbatches=num_microbatches, schedule=schedule
+                num_microbatches=num_microbatches, schedule=schedule,
+                num_virtual_stages=virtual,
             ),
         )
     )
@@ -72,14 +73,24 @@ def bench(schedule: str, num_microbatches: int, steps: int = 6):
     n = pp
     m = num_microbatches
     live = (n + 1) if schedule == "1f1b" else m  # stage-input activations held
+    if virtual > 1:
+        from accelerate_tpu.parallel.pp_interleaved import build_interleaved_schedule
+
+        sch = build_interleaved_schedule(n, virtual, m)
+        # full fori_loop carry: three per-chunk rings + the two wire buffers
+        live = virtual * (sch.ring_f + sch.ring_s + sch.ring_b) + 2
+        wall = int((sch.fwd_valid + sch.bwd_valid).max(axis=0).sum())
+        bubble = round((wall - 2 * m * virtual) / wall, 3)
+    else:
+        bubble = round((n - 1) / (m + n - 1), 3)
     print(json.dumps({
-        "schedule": schedule,
+        "schedule": schedule if virtual == 1 else f"1f1b@v{virtual}",
         "num_microbatches": m,
         "compile_s": round(compile_s, 2),
         "step_s": round(step_s, 4),
         "loss": round(float(loss), 4),
         "live_stage_inputs": live,
-        "bubble_fraction": round((n - 1) / (m + n - 1), 3),
+        "bubble_fraction": bubble,
     }), flush=True)
 
 
@@ -87,3 +98,5 @@ if __name__ == "__main__":
     for m in (4, 8, 16):
         for schedule in ("gpipe", "1f1b"):
             bench(schedule, m)
+        if m % 4 == 0:  # interleaved needs m % pp == 0; 8 layers / (4*2) chunks
+            bench("1f1b", m, virtual=2)
