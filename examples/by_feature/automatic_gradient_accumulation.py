@@ -1,0 +1,56 @@
+"""Automatic gradient accumulation (reference
+examples/by_feature/automatic_gradient_accumulation.py): combine
+``find_executable_batch_size`` (OOM back-off) with gradient accumulation so
+the EFFECTIVE batch stays constant — when the per-step batch halves, the
+accumulation steps double."""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.models.bert import BertConfig, bert_classification_loss, create_bert
+from accelerate_tpu.utils.memory import find_executable_batch_size
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--target_effective_batch", type=int, default=64)
+    args = parser.parse_args()
+
+    cfg = BertConfig.tiny()
+    rng = np.random.default_rng(0)
+    data = {
+        "input_ids": rng.integers(0, cfg.vocab_size, size=(128, 32)).astype(np.int32),
+        "labels": rng.integers(0, 2, size=(128,)).astype(np.int32),
+    }
+
+    @find_executable_batch_size(starting_batch_size=args.target_effective_batch)
+    def train(batch_size):
+        # a fresh Accelerator per attempt: accumulation steps derive from the
+        # batch size that actually fits
+        accum = max(args.target_effective_batch // batch_size, 1)
+        accelerator = Accelerator(gradient_accumulation_steps=accum)
+        accelerator.print(f"batch_size={batch_size} accumulation={accum}")
+        loader = accelerator.prepare_data_loader(
+            data, batch_size=batch_size, drop_last=True
+        )
+        model, optimizer = accelerator.prepare(create_bert(cfg), optax.adamw(1e-3))
+        loss = None
+        for batch in loader:
+            with accelerator.accumulate(model):
+                loss = accelerator.backward(bert_classification_loss, batch)
+                optimizer.step()
+                optimizer.zero_grad()
+        accelerator.print(f"final loss={float(loss):.4f}")
+        return batch_size
+
+    used = train()
+    print(f"trained with per-step batch {used}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,64 @@
+"""K-fold cross validation (reference
+examples/by_feature/cross_validation.py): rebuild the dataloaders per fold,
+train a fresh model each time, and ``gather_for_metrics`` the per-fold eval
+predictions for an averaged score."""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.models.bert import BertConfig, bert_classification_loss, create_bert
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--folds", type=int, default=3)
+    parser.add_argument("--epochs", type=int, default=1)
+    args = parser.parse_args()
+
+    accelerator = Accelerator()
+    cfg = BertConfig.tiny()
+    rng = np.random.default_rng(0)
+    n = 96
+    ids = rng.integers(0, cfg.vocab_size, size=(n, 32)).astype(np.int32)
+    labels = rng.integers(0, 2, size=(n,)).astype(np.int32)
+
+    fold_ids = np.arange(n) % args.folds
+    scores = []
+    for fold in range(args.folds):
+        train_sel, eval_sel = fold_ids != fold, fold_ids == fold
+        train_loader = accelerator.prepare_data_loader(
+            {"input_ids": ids[train_sel], "labels": labels[train_sel]},
+            batch_size=16, drop_last=True,
+        )
+        eval_loader = accelerator.prepare_data_loader(
+            {"input_ids": ids[eval_sel], "labels": labels[eval_sel]},
+            batch_size=16, shuffle=False,
+        )
+        model, optimizer = accelerator.prepare(create_bert(cfg), optax.adamw(1e-3))
+        for _ in range(args.epochs):
+            for batch in train_loader:
+                accelerator.backward(bert_classification_loss, batch)
+                optimizer.step()
+                optimizer.zero_grad()
+        eval_step = accelerator.eval_step(
+            lambda view, batch: view(batch["input_ids"])[0].argmax(-1)
+        )
+        correct = total = 0
+        for batch in eval_loader:
+            preds = accelerator.gather_for_metrics(eval_step(batch))
+            refs = accelerator.gather_for_metrics(batch["labels"])
+            correct += int((np.asarray(preds) == np.asarray(refs)).sum())
+            total += len(np.asarray(refs))
+        scores.append(correct / max(total, 1))
+        accelerator.print(f"fold {fold}: accuracy={scores[-1]:.3f}")
+        accelerator.free_memory()
+    accelerator.print(f"mean accuracy over {args.folds} folds: {np.mean(scores):.3f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,57 @@
+"""FSDP with peak-memory tracking (reference
+examples/by_feature/fsdp_with_peak_mem_tracking.py): train with fsdp
+(dp_shard) sharding and report device memory stats around the loop —
+``get_device_memory_stats`` reads the XLA allocator's live/peak bytes where
+the backend exposes them (TPU does; CPU returns an empty dict)."""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.models.llama import LlamaConfig, create_llama, llama_loss
+from accelerate_tpu.parallelism_config import ParallelismConfig
+from accelerate_tpu.utils.memory import get_device_memory_stats
+
+
+def fmt(stats: dict) -> str:
+    if not stats:
+        return "n/a (backend exposes no memory_stats)"
+    used = stats.get("bytes_in_use", 0) / 1e6
+    peak = stats.get("peak_bytes_in_use", 0) / 1e6
+    return f"in_use={used:.1f}MB peak={peak:.1f}MB"
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=4)
+    args = parser.parse_args()
+
+    accelerator = Accelerator(
+        parallelism_config=ParallelismConfig(dp_shard_size=-1),
+        mixed_precision="bf16",
+    )
+    accelerator.print(f"before model: {fmt(get_device_memory_stats())}")
+    cfg = LlamaConfig.tiny(num_hidden_layers=4)
+    model, optimizer = accelerator.prepare(
+        create_llama(cfg, seed=0), optax.adamw(3e-4)
+    )
+    accelerator.print(f"after prepare (params+opt sharded): {fmt(get_device_memory_stats())}")
+
+    step = accelerator.train_step(llama_loss, max_grad_norm=1.0)
+    rng = np.random.default_rng(0)
+    for i in range(args.steps):
+        batch = {
+            "input_ids": rng.integers(0, cfg.vocab_size, size=(8, 64)).astype(np.int32)
+        }
+        loss = step(batch)
+        accelerator.print(
+            f"step {i} loss={float(loss):.4f} {fmt(get_device_memory_stats())}"
+        )
+
+
+if __name__ == "__main__":
+    main()

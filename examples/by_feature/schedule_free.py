@@ -1,0 +1,44 @@
+"""Schedule-free optimizer (reference examples/by_feature/schedule_free.py,
+which uses Meta's schedulefree AdamW): the same training style rides optax's
+``optax.contrib.schedule_free_adamw`` — no LR schedule, no
+AcceleratedScheduler; the optimizer interpolates its own averaged iterate."""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.models.bert import BertConfig, bert_classification_loss, create_bert
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--lr", type=float, default=1e-3)
+    parser.add_argument("--epochs", type=int, default=2)
+    args = parser.parse_args()
+
+    accelerator = Accelerator()
+    cfg = BertConfig.tiny()
+    rng = np.random.default_rng(0)
+    data = {
+        "input_ids": rng.integers(0, cfg.vocab_size, size=(128, 32)).astype(np.int32),
+        "labels": rng.integers(0, 2, size=(128,)).astype(np.int32),
+    }
+    loader = accelerator.prepare_data_loader(data, batch_size=16, drop_last=True)
+    model, optimizer = accelerator.prepare(
+        create_bert(cfg), optax.contrib.schedule_free_adamw(args.lr)
+    )
+
+    for epoch in range(args.epochs):
+        for batch in loader:
+            loss = accelerator.backward(bert_classification_loss, batch)
+            optimizer.step()
+            optimizer.zero_grad()
+        accelerator.print(f"epoch {epoch} loss={float(loss):.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,49 @@
+"""Experiment-tracking example (reference examples/by_feature/tracking.py):
+``log_with=...`` + ``init_trackers`` / ``log`` / ``end_training``. The
+dependency-free JSONL tracker is used here so the example runs anywhere;
+swap in "tensorboard", "wandb", etc. — same surface (tracking.py)."""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.models.bert import BertConfig, bert_classification_loss, create_bert
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--project_dir", default="runs/tracking_example")
+    parser.add_argument("--log_with", default="jsonl")
+    args = parser.parse_args()
+
+    accelerator = Accelerator(log_with=args.log_with, project_dir=args.project_dir)
+    accelerator.init_trackers(
+        "tracking_example", config={"lr": 1e-3, "batch_size": 16}
+    )
+    cfg = BertConfig.tiny()
+    rng = np.random.default_rng(0)
+    data = {
+        "input_ids": rng.integers(0, cfg.vocab_size, size=(64, 32)).astype(np.int32),
+        "labels": rng.integers(0, 2, size=(64,)).astype(np.int32),
+    }
+    loader = accelerator.prepare_data_loader(data, batch_size=16, drop_last=True)
+    model, optimizer = accelerator.prepare(create_bert(cfg), optax.adamw(1e-3))
+
+    step = 0
+    for epoch in range(2):
+        for batch in loader:
+            loss = accelerator.backward(bert_classification_loss, batch)
+            optimizer.step()
+            optimizer.zero_grad()
+            accelerator.log({"train_loss": float(loss), "epoch": epoch}, step=step)
+            step += 1
+    accelerator.end_training()
+    accelerator.print(f"logged {step} steps to {args.project_dir}")
+
+
+if __name__ == "__main__":
+    main()

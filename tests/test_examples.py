@@ -81,3 +81,59 @@ def test_fault_tolerance_example(tmp_path):
     )
     assert result.returncode == 0, result.stderr[-2000:]
     assert "training complete" in result.stdout
+
+
+@pytest.mark.slow
+def test_tracking_example(tmp_path):
+    result = _run("by_feature/tracking.py", "--project_dir", str(tmp_path))
+    assert result.returncode == 0, result.stderr[-2000:]
+    assert "logged 8 steps" in result.stdout
+    assert any(f.suffix == ".jsonl" for f in tmp_path.rglob("*")), "no JSONL log written"
+
+
+@pytest.mark.slow
+def test_automatic_gradient_accumulation_example():
+    result = _run(
+        "by_feature/automatic_gradient_accumulation.py",
+        "--target_effective_batch", "32",
+    )
+    assert result.returncode == 0, result.stderr[-2000:]
+    assert "trained with per-step batch" in result.stdout
+
+
+@pytest.mark.slow
+def test_schedule_free_example():
+    result = _run("by_feature/schedule_free.py", "--epochs", "1")
+    assert result.returncode == 0, result.stderr[-2000:]
+    assert "epoch 0 loss=" in result.stdout
+
+
+@pytest.mark.slow
+def test_ddp_comm_hook_example():
+    result = _run("by_feature/ddp_comm_hook.py", "--comm_hook", "bf16")
+    assert result.returncode == 0, result.stderr[-2000:]
+    assert "comm_hook=bf16" in result.stdout
+
+
+@pytest.mark.slow
+def test_pipeline_parallelism_example():
+    result = _run(
+        "by_feature/pipeline_parallelism.py",
+        "--pp", "2", "--virtual", "2", "--steps", "2",
+    )
+    assert result.returncode == 0, result.stderr[-2000:]
+    assert "pp=2 virtual=2" in result.stdout
+
+
+@pytest.mark.slow
+def test_fsdp_peak_mem_example():
+    result = _run("by_feature/fsdp_with_peak_mem_tracking.py", "--steps", "2")
+    assert result.returncode == 0, result.stderr[-2000:]
+    assert "after prepare" in result.stdout
+
+
+@pytest.mark.slow
+def test_cross_validation_example():
+    result = _run("by_feature/cross_validation.py", "--folds", "2")
+    assert result.returncode == 0, result.stderr[-2000:]
+    assert "mean accuracy over 2 folds" in result.stdout
