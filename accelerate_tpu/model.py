@@ -26,7 +26,16 @@ class Model:
     ``model(*args)`` runs a jit-compiled forward with the CURRENT params —
     eval/inference reads exactly like torch. Inside a compiled train step the
     step function uses :meth:`bind` / :attr:`apply_fn` functionally.
+
+    ``params`` may be backed by packed flat buffers (utils/flatbuf.py — the
+    fused-buffer train-step fast path): the pytree then materializes lazily on
+    first read, so per-step bookkeeping never pays the ~hundreds of per-leaf
+    buffer costs; assignment always replaces the packed backing.
     """
+
+    # packed-params backing (None = plain pytree in self._params)
+    _packed_params = None
+    _params = None
 
     def __init__(
         self,
@@ -42,6 +51,36 @@ class Model:
         self.shardings = None  # set by Accelerator.prepare
         self.mesh = None
         self._jitted_forward: Optional[Callable] = None
+
+    # ------------------------------------------------------------ parameters
+    @property
+    def params(self) -> Any:
+        if self._params is None and self._packed_params is not None:
+            buffers, _spec, unpack_fn = self._packed_params
+            self._params = unpack_fn(buffers)
+            # the materialized pytree becomes the single source of truth:
+            # keeping the packed backing authoritative would silently discard
+            # in-place edits to the returned tree (the next step would read
+            # the stale buffers). The step function repacks on demand.
+            self._packed_params = None
+        return self._params
+
+    @params.setter
+    def params(self, value: Any) -> None:
+        self._params = value
+        self._packed_params = None
+
+    def _set_packed_params(self, buffers, spec, unpack_fn) -> None:
+        """Adopt flat buffers as the source of truth (train_step fast path).
+        The pytree view is dropped and rebuilt only if someone reads it."""
+        self._packed_params = (buffers, spec, unpack_fn)
+        self._params = None
+
+    def _packed_for(self, spec):
+        """Current flat buffers iff packed under ``spec``, else None."""
+        if self._packed_params is not None and self._packed_params[1] == spec:
+            return self._packed_params[0]
+        return None
 
     # ------------------------------------------------------------ construction
     @classmethod

@@ -158,5 +158,14 @@ def blockwise_attention(
     )
     k_t = jnp.moveaxis(k, 1, 0)
     v_t = jnp.moveaxis(v, 1, 0)
-    (out, m, l), _ = lax.scan(body, init, (k_t, v_t, jnp.arange(num_blocks)))
+    # jax.checkpoint on the body is load-bearing twice over: (1) the backward
+    # recomputes per-block scores instead of stacking (nb, b, h, sq, kv_block)
+    # residuals (the memory guarantee this op exists for), and (2) it works
+    # around an XLA TPU miscompile — differentiating the un-checkpointed scan
+    # NaNs dq/dk whenever a positional bias touches the scores inside the
+    # body (observed on v5e even with a numerically all-zero bias; the
+    # fused transpose is at fault, not the math — a bias-free body is clean).
+    (out, m, l), _ = lax.scan(
+        jax.checkpoint(body), init, (k_t, v_t, jnp.arange(num_blocks))
+    )
     return finalize_blocks(out, m, l)

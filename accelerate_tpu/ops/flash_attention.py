@@ -30,6 +30,14 @@ DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_K = 512
 
 
+def _dot_f32(a, b, transpose_b=False):
+    """MXU-native matmul: inputs stay in their storage dtype (bf16 on the hot
+    path — f32 operands run the systolic array at a fraction of peak), the
+    accumulator is always f32 via ``preferred_element_type``."""
+    dims = (((1,), (1 if transpose_b else 0,)), ((), ()))
+    return lax.dot_general(a, b, dims, preferred_element_type=jnp.float32)
+
+
 def _pick_block(s: int, preferred: int) -> int:
     b = min(preferred, s)
     while s % b != 0:
@@ -56,11 +64,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, out_ref, lse_ref, acc_ref, m_ref, l_ref, *,
 
     @pl.when(visible)
     def _compute():
-        q = q_ref[0].astype(jnp.float32) * scale  # (bq, d)
-        k = k_ref[0].astype(jnp.float32)  # (bk, d)
-        v = v_ref[0].astype(jnp.float32)
+        q = q_ref[0]  # (bq, d) — storage dtype straight into the MXU
+        k = k_ref[0]  # (bk, d)
+        v = v_ref[0]
 
-        s = q @ k.T  # (bq, bk) on the MXU
+        s = _dot_f32(q, k, transpose_b=True) * scale  # (bq, bk), f32 acc
         if causal:
             q_pos = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + i * block_q
             k_pos = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + j * block_k
@@ -72,7 +80,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, out_ref, lse_ref, acc_ref, m_ref, l_ref, *,
         alpha = jnp.exp(m_prev - m_cur)
         p = jnp.exp(s - m_cur[:, None])
         l_cur = alpha * l_prev + jnp.sum(p, axis=-1)
-        acc_ref[:] = acc_ref[:] * alpha[:, None] + p @ v
+        acc_ref[:] = acc_ref[:] * alpha[:, None] + _dot_f32(p.astype(v.dtype), v)
         m_ref[:, 0] = m_cur
         l_ref[:, 0] = l_cur
 
@@ -137,22 +145,22 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_a
 
     @pl.when(visible)
     def _compute():
-        q = q_ref[0].astype(jnp.float32) * scale
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0, 0]
         delta = delta_ref[0, 0]
 
-        s = q @ k.T
+        s = _dot_f32(q, k, transpose_b=True) * scale
         if causal:
             q_pos = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + i * block_q
             k_pos = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + j * block_k
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         p = jnp.exp(s - lse[:, None])
-        dp = do @ v.T
+        dp = _dot_f32(do, v, transpose_b=True)
         ds = p * (dp - delta[:, None])
-        dq_acc[:] = dq_acc[:] + (ds @ k) * scale
+        dq_acc[:] = dq_acc[:] + _dot_f32(ds.astype(k.dtype), k) * scale
 
     @pl.when(j == nk - 1)
     def _finalize():
@@ -173,23 +181,24 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
 
     @pl.when(visible)
     def _compute():
-        q = q_ref[0].astype(jnp.float32) * scale
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0, 0]
         delta = delta_ref[0, 0]
 
-        s = q @ k.T  # (bq, bk)
+        s = _dot_f32(q, k, transpose_b=True) * scale  # (bq, bk)
         if causal:
             q_pos = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + i * block_q
             k_pos = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + j * block_k
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         p = jnp.exp(s - lse[:, None])
-        dv_acc[:] = dv_acc[:] + p.T @ do
-        dp = do @ v.T
+        p_lo = p.astype(do.dtype)
+        dv_acc[:] = dv_acc[:] + _dot_f32(p_lo.T, do)
+        dp = _dot_f32(do, v, transpose_b=True)
         ds = p * (dp - delta[:, None])
-        dk_acc[:] = dk_acc[:] + (ds.T @ q)  # q already scaled
+        dk_acc[:] = dk_acc[:] + _dot_f32(ds.astype(q.dtype).T, q) * scale
 
     @pl.when(i == nq - 1)
     def _finalize():

@@ -126,6 +126,10 @@ class AcceleratedOptimizer:
     * ``optimizer.zero_grad()`` drops the accumulation buffer.
     """
 
+    # packed opt-state backing (utils/flatbuf.py train-step fast path)
+    _packed_opt_state = None
+    _opt_state = None
+
     def __init__(self, optimizer, scaler: Optional[DynamicScale] = None):
         import optax
 
@@ -145,6 +149,31 @@ class AcceleratedOptimizer:
         self.step_was_skipped = False
         self._step_count = 0
         self._update_fn = None
+
+    # --------------------------------------------------------------- opt state
+    @property
+    def opt_state(self):
+        if self._opt_state is None and self._packed_opt_state is not None:
+            buffers, _spec, unpack_fn = self._packed_opt_state
+            self._opt_state = unpack_fn(buffers)
+            # materialized tree takes over as source of truth (see
+            # Model.params) — in-place edits must never be silently lost
+            self._packed_opt_state = None
+        return self._opt_state
+
+    @opt_state.setter
+    def opt_state(self, value) -> None:
+        self._opt_state = value
+        self._packed_opt_state = None
+
+    def _set_packed_opt_state(self, buffers, spec, unpack_fn) -> None:
+        self._packed_opt_state = (buffers, spec, unpack_fn)
+        self._opt_state = None
+
+    def _packed_for(self, spec):
+        if self._packed_opt_state is not None and self._packed_opt_state[1] == spec:
+            return self._packed_opt_state[0]
+        return None
 
     # ------------------------------------------------------------------ setup
     def init(self, model) -> None:

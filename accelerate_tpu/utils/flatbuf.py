@@ -1,0 +1,113 @@
+"""Fused flat-buffer packing for compiled-step I/O.
+
+A 16-layer LLM's (params, opt_state, accum) is ~400 separate HBM buffers.
+Every one of them is a distinct program input/output — and, under the
+multi-step ``lax.scan``, a distinct carry — so the per-buffer runtime cost
+(allocation bookkeeping, donation aliasing, transfer scheduling on
+remote-attached TPUs) is paid hundreds of times per step. v5e measurement:
+the identical train step costs ~0.46 s with scalar-only outputs and ~1.6 s
+when the full pytree rides the program boundary — a full second of pure
+buffer-count overhead per step.
+
+The fix is the classic fused-buffer layout (the role DeepSpeed's flat fp32
+groups play, reference's engines get it from apex/DS; here it is pure XLA):
+``pack`` concatenates every leaf into ONE 1-D buffer per dtype, ``unpack``
+rebuilds the pytree with reshaped slices *inside* the jitted program, where
+slice/concat are HBM-bandwidth ops that XLA fuses into producers/consumers.
+Program I/O becomes a handful of large buffers; the math (model forward,
+optax update) still sees the original pytree, so structure-keyed transforms
+(masks, per-leaf schedules, multi-chain states) keep exact semantics.
+
+Not used when parameters are mesh-sharded: per-leaf shardings (FSDP's
+largest-dim rule, TP's column/row splits) do not survive 1-D concatenation.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["PackSpec", "build_pack_spec", "pack_tree", "unpack_tree"]
+
+
+@dataclass(frozen=True)
+class _LeafSlot:
+    buffer_idx: int
+    offset: int
+    size: int
+    shape: Tuple[int, ...]
+    dtype: Any
+
+
+@dataclass(frozen=True)
+class PackSpec:
+    treedef: Any
+    slots: Tuple[_LeafSlot, ...]
+    buffer_sizes: Tuple[int, ...]
+    buffer_dtypes: Tuple[Any, ...]
+
+    @property
+    def num_buffers(self) -> int:
+        return len(self.buffer_sizes)
+
+
+def build_pack_spec(tree: Any, dtype_of: Optional[Callable] = None) -> PackSpec:
+    """Lay out every leaf of ``tree`` into per-dtype 1-D buffers.
+
+    ``dtype_of(leaf) -> dtype`` overrides the storage dtype (e.g. a bf16
+    comm-dtype accumulator packed from f32-shaped params).
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    buffer_dtypes: list = []
+    cursors: list = []
+    slots = []
+    for leaf in leaves:
+        dt = jnp.dtype(dtype_of(leaf) if dtype_of is not None else leaf.dtype)
+        try:
+            idx = buffer_dtypes.index(dt)
+        except ValueError:
+            idx = len(buffer_dtypes)
+            buffer_dtypes.append(dt)
+            cursors.append(0)
+        size = int(np.prod(leaf.shape)) if leaf.shape else 1
+        slots.append(
+            _LeafSlot(idx, cursors[idx], size, tuple(leaf.shape), dt)
+        )
+        cursors[idx] += size
+    return PackSpec(
+        treedef=treedef,
+        slots=tuple(slots),
+        buffer_sizes=tuple(cursors),
+        buffer_dtypes=tuple(buffer_dtypes),
+    )
+
+
+def pack_tree(spec: PackSpec, tree: Any) -> Tuple[jax.Array, ...]:
+    """Pytree → per-dtype flat buffers (trace-safe; call inside jit)."""
+    leaves = spec.treedef.flatten_up_to(tree)
+    parts: list = [[] for _ in spec.buffer_sizes]
+    for slot, leaf in zip(spec.slots, leaves):
+        parts[slot.buffer_idx].append(
+            jnp.ravel(leaf).astype(slot.dtype)
+        )
+    return tuple(
+        jnp.concatenate(group)
+        if len(group) > 1
+        else group[0]
+        for group in parts
+    )
+
+
+def unpack_tree(spec: PackSpec, buffers: Sequence[jax.Array]) -> Any:
+    """Flat buffers → pytree in storage dtype (trace-safe)."""
+    leaves = []
+    for slot in spec.slots:
+        flat = jax.lax.dynamic_slice_in_dim(
+            buffers[slot.buffer_idx], slot.offset, slot.size
+        )
+        leaves.append(flat.reshape(slot.shape))
+    return jax.tree_util.tree_unflatten(spec.treedef, leaves)

@@ -137,7 +137,7 @@ def _measure(config, starting_batch, steps, seq_len):
 
     batch_size, dt, loss = run()
     tok_per_sec_per_chip = batch_size * seq_len * steps / dt / n_dev
-    return {
+    result = {
         "tok_s_chip": tok_per_sec_per_chip,
         "batch_size": batch_size,
         "step_time_s": dt / steps,
@@ -145,6 +145,14 @@ def _measure(config, starting_batch, steps, seq_len):
         "params_m": model.num_parameters / 1e6,
         "n_devices": n_dev,
     }
+    # free this candidate's HBM before the next one: the params + adam state
+    # of a prior model otherwise survive via the jit executable cache, and
+    # 4-5 sequential candidates exhaust a 16 GB chip (observed: every
+    # full-steps re-measure RESOURCE_EXHAUSTED after the probe phase)
+    del model, _optimizer, step_fn
+    accelerator.free_memory()
+    jax.clear_caches()
+    return result
 
 
 def _flash_is_valid_on_device() -> bool:
@@ -158,19 +166,32 @@ def _flash_is_valid_on_device() -> bool:
     from accelerate_tpu.ops.flash_attention import flash_attention
 
     try:
+        from accelerate_tpu.models.llama import LlamaConfig
+
         rng = np.random.default_rng(0)
-        shape = (2, 256, 8, 64)
+        # validate at the tiling the benchmark actually runs (tall-q blocks at
+        # the bench seq len) — a default-block check at seq 256 would never
+        # exercise the block_q=2048 lowering the sweep measures
+        seq = int(os.environ.get("BENCH_SEQ", 2048))
+        blocks = dict(
+            block_q=LlamaConfig.attention_block_q, block_k=LlamaConfig.attention_kv_block
+        )
+        shape = (2, seq, 8, 64)
         q, k, v = (
             jnp.asarray(rng.normal(size=shape), dtype=jnp.bfloat16) for _ in range(3)
         )
 
         def loss_flash(q, k, v):
-            return jnp.sum(flash_attention(q, k, v, causal=True).astype(jnp.float32))
+            return jnp.sum(
+                flash_attention(q, k, v, causal=True, **blocks).astype(jnp.float32)
+            )
 
         def loss_ref(q, k, v):
             return jnp.sum(blockwise_attention(q, k, v, causal=True).astype(jnp.float32))
 
-        out_f = jax.jit(flash_attention, static_argnames=("causal",))(q, k, v, causal=True)
+        out_f = jax.jit(
+            lambda q, k, v: flash_attention(q, k, v, causal=True, **blocks)
+        )(q, k, v)
         out_r = jax.jit(blockwise_attention, static_argnames=("causal",))(q, k, v, causal=True)
         if not np.allclose(
             np.asarray(out_f, np.float32), np.asarray(out_r, np.float32), atol=2e-2

@@ -1,0 +1,177 @@
+"""Fused flat-buffer train-step path (utils/flatbuf.py).
+
+The packed program must be numerically identical to the pytree program: the
+packing only changes the I/O layout, never the math. Reference has no
+equivalent (torch keeps per-tensor storage; DeepSpeed's flat fp32 groups play
+this role inside its engines)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.models.llama import LlamaConfig, create_llama, llama_loss
+from accelerate_tpu.utils.flatbuf import build_pack_spec, pack_tree, unpack_tree
+
+
+def _tiny_cfg(**kw):
+    return LlamaConfig.tiny(**kw)
+
+
+def test_pack_unpack_roundtrip():
+    tree = {
+        "a": jnp.arange(6, dtype=jnp.float32).reshape(2, 3),
+        "b": {"c": jnp.ones((4,), jnp.float32), "d": jnp.int32(7)},
+        "e": jnp.zeros((2, 2), jnp.bfloat16),
+    }
+    spec = build_pack_spec(tree)
+    bufs = jax.jit(lambda t: pack_tree(spec, t))(tree)
+    # one buffer per dtype present
+    assert spec.num_buffers == 3
+    out = jax.jit(lambda b: unpack_tree(spec, b))(bufs)
+    flat_in, _ = jax.tree_util.tree_flatten(tree)
+    flat_out, _ = jax.tree_util.tree_flatten(out)
+    for x, y in zip(flat_in, flat_out):
+        assert x.dtype == y.dtype and x.shape == y.shape
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_pack_dtype_override():
+    tree = {"w": jnp.ones((3, 3), jnp.float32)}
+    spec = build_pack_spec(tree, dtype_of=lambda _: jnp.bfloat16)
+    bufs = pack_tree(spec, tree)
+    assert bufs[0].dtype == jnp.bfloat16
+    out = unpack_tree(spec, bufs)
+    assert out["w"].dtype == jnp.bfloat16
+
+
+def _run_training(flatten, multi_step, k=1, mixed="bf16", steps=6):
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+    acc = Accelerator(mixed_precision=mixed, gradient_accumulation_steps=k)
+    cfg = _tiny_cfg()
+    model, opt = acc.prepare(
+        create_llama(cfg, seed=0), optax.adamw(1e-3, weight_decay=0.01)
+    )
+    model.policy = None
+    step = acc.train_step(
+        llama_loss, max_grad_norm=1.0, multi_step=multi_step, flatten_params=flatten
+    )
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, cfg.vocab_size, size=(steps, 2, 16)).astype(np.int32)
+    if multi_step:
+        losses = np.asarray(step({"input_ids": data}))
+    else:
+        losses = np.asarray(
+            [np.asarray(step({"input_ids": data[i]})) for i in range(steps)]
+        )
+    return losses, model, opt
+
+
+@pytest.mark.parametrize("multi_step", [False, True])
+def test_flat_matches_pytree_path(multi_step):
+    losses_ref, model_ref, _ = _run_training(False, multi_step)
+    losses_flat, model_flat, opt_flat = _run_training(True, multi_step)
+    np.testing.assert_allclose(losses_flat, losses_ref, rtol=1e-6, atol=1e-6)
+    # lazy materialization must produce the identical final pytree
+    ref_leaves = jax.tree_util.tree_leaves(model_ref.params)
+    flat_leaves = jax.tree_util.tree_leaves(model_flat.params)
+    for a, b in zip(ref_leaves, flat_leaves):
+        np.testing.assert_allclose(
+            np.asarray(b, np.float32), np.asarray(a, np.float32), rtol=1e-6, atol=1e-6
+        )
+    # opt_state materializes too (checkpointing path)
+    assert jax.tree_util.tree_structure(
+        opt_flat.opt_state
+    ) is not None
+
+
+def test_flat_with_accumulation():
+    losses_ref, model_ref, _ = _run_training(False, True, k=2)
+    losses_flat, model_flat, _ = _run_training(True, True, k=2)
+    np.testing.assert_allclose(losses_flat, losses_ref, rtol=1e-6, atol=1e-6)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(model_ref.params),
+        jax.tree_util.tree_leaves(model_flat.params),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(b, np.float32), np.asarray(a, np.float32), rtol=1e-6, atol=1e-6
+        )
+
+
+def test_flat_with_fp16_scaler():
+    losses_ref, _, _ = _run_training(False, True, mixed="fp16")
+    losses_flat, _, _ = _run_training(True, True, mixed="fp16")
+    np.testing.assert_allclose(losses_flat, losses_ref, rtol=1e-6, atol=1e-6)
+
+
+def test_params_assignment_invalidates_packed():
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+    acc = Accelerator()
+    cfg = _tiny_cfg()
+    model, opt = acc.prepare(create_llama(cfg, seed=0), optax.adamw(1e-3))
+    model.policy = None
+    step = acc.train_step(llama_loss, multi_step=False, flatten_params=True)
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, cfg.vocab_size, size=(2, 16)).astype(np.int32)}
+    step(batch)
+    assert model._packed_params is not None
+    zeroed = jax.tree_util.tree_map(jnp.zeros_like, model.params)
+    model.params = zeroed  # user assignment (e.g. checkpoint restore)
+    assert model._packed_params is None
+    # the next step must repack FROM THE NEW params and keep training: with
+    # all-zero weights the logits are uniform, so the loss is exactly log(V)
+    loss = float(np.asarray(step(batch)))
+    assert model._packed_params is not None
+    np.testing.assert_allclose(loss, np.log(cfg.vocab_size), rtol=1e-3)
+
+
+def test_checkpoint_roundtrip_from_packed(tmp_path):
+    """save_state must see the materialized pytree mid-training."""
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+    acc = Accelerator(project_dir=str(tmp_path))
+    cfg = _tiny_cfg()
+    model, opt = acc.prepare(create_llama(cfg, seed=0), optax.adamw(1e-3))
+    model.policy = None
+    step = acc.train_step(llama_loss, multi_step=False, flatten_params=True)
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, cfg.vocab_size, size=(2, 16)).astype(np.int32)}
+    step(batch)
+    assert model._packed_params is not None
+    acc.save_state()
+    # reading params for the save hands authority back to the pytree (so
+    # in-place edits are never lost); the next step transparently repacks
+    assert model._packed_params is None
+    loss_after_save = float(np.asarray(step(batch)))
+    assert np.isfinite(loss_after_save)
+    assert model._packed_params is not None
+
+
+def test_flatten_true_raises_on_sharded_mesh():
+    from accelerate_tpu.parallelism_config import ParallelismConfig
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+    n = len(jax.devices())
+    if n < 2:
+        pytest.skip("needs a multi-device mesh")
+    acc = Accelerator(parallelism_config=ParallelismConfig(dp_shard_size=n))
+    cfg = _tiny_cfg()
+    model, opt = acc.prepare(create_llama(cfg, seed=0), optax.adamw(1e-3))
+    with pytest.raises(ValueError, match="flatten_params=True"):
+        acc.train_step(llama_loss, flatten_params=True)
