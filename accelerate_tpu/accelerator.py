@@ -1127,6 +1127,14 @@ class Accelerator:
         policy applied in the model's compiled forward, so there is nothing to
         toggle dynamically — the context exists so reference-shaped loops run
         unchanged."""
+        if autocast_handler is not None:
+            logger.warning(
+                "accelerator.autocast(autocast_handler=...) has no dynamic "
+                "effect here: precision is a MixedPrecisionPolicy compiled "
+                "into the model's forward (set mixed_precision=... on the "
+                "Accelerator or model.policy before prepare). The handler "
+                "is ignored."
+            )
         yield
 
     @contextlib.contextmanager
@@ -1153,6 +1161,16 @@ class Accelerator:
         """Parity context (reference accelerator.py:4111-4175): CP here is a
         mesh axis + ring-attention kernel chosen at prepare time, not a
         runtime buffer rewrite, so this is informational."""
+        if (
+            buffers is not None or buffer_seq_dims is not None or no_restore_buffers is not None
+        ) and not self.parallelism_config.cp_enabled:
+            logger.warning(
+                "maybe_context_parallel received buffers but context "
+                "parallelism is not enabled — unlike the reference, CP here "
+                "is not a runtime buffer rewrite: set ParallelismConfig("
+                "cp_size=...) so prepare() installs the ring-attention path. "
+                "The buffer arguments are ignored either way."
+            )
         yield
 
     def __repr__(self):

@@ -56,8 +56,11 @@ class BertConfig:
         ), **overrides})
 
 
-def _dense(key, in_dim, out_dim, dtype):
-    scale = 1.0 / np.sqrt(in_dim)
+def _dense(key, in_dim, out_dim, dtype, scale=None):
+    """Biased dense init shared by the bert/gpt2 families; default scale is
+    1/sqrt(in_dim), GPT-2 passes its fixed/residual-scaled 0.02 variants."""
+    if scale is None:
+        scale = 1.0 / np.sqrt(in_dim)
     return {
         "kernel": (jax.random.normal(key, (in_dim, out_dim)) * scale).astype(dtype),
         "bias": jnp.zeros((out_dim,), dtype=dtype),

@@ -1,0 +1,279 @@
+"""GPT-2-style causal LM: learned positions, pre-LN, fused-QKV, gelu MLP.
+
+The model family behind the reference's Megatron GPT pretraining example
+(/root/reference/examples/by_feature/megatron_lm_gpt_pretraining.py — there
+it is provided by megatron-lm; here it is a first-class native family).
+TPU-first like models/llama.py: stacked per-layer params scanned with
+``lax.scan``, selectable remat policy, bf16 compute with fp32 logits, the
+chunked fused-head CE protocol, and HF ``GPT2LMHeadModel`` checkpoint
+interop in both directions (HF Conv1D stores (in, out) kernels, so weights
+map without transposition).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from jax.ad_checkpoint import checkpoint_name
+
+from ..model import Model
+from ..ops.attention import dispatch_attention
+from ..parallel.sharding import constrain_activation, replicate_over_fsdp
+from .bert import _apply_dense, _dense, layer_norm
+from .llama import _remat_policy, llama_loss
+
+__all__ = [
+    "GPT2Config",
+    "init_gpt2_params",
+    "gpt2_apply",
+    "create_gpt2",
+    "gpt2_loss",
+    "convert_hf_state_dict",
+    "export_hf_state_dict",
+]
+
+
+@dataclasses.dataclass
+class GPT2Config:
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    max_position_embeddings: int = 1024
+    layer_norm_eps: float = 1e-5
+    param_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.bfloat16
+    remat_policy: str = "nothing"  # "nothing" | "dots" | "minimal" | "full"
+    attention_impl: str = "blockwise"  # "xla" | "blockwise" | "flash"
+    attention_kv_block: int = 512
+    attention_block_q: int = 2048
+    scan_layers: bool = True
+    use_chunked_ce: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @property
+    def intermediate_size(self) -> int:
+        return 4 * self.hidden_size
+
+    @classmethod
+    def gpt2_small(cls, **overrides) -> "GPT2Config":
+        return cls(**overrides)
+
+    @classmethod
+    def gpt2_medium(cls, **overrides) -> "GPT2Config":
+        return cls(**{**dict(hidden_size=1024, num_hidden_layers=24,
+                             num_attention_heads=16), **overrides})
+
+    @classmethod
+    def gpt2_large(cls, **overrides) -> "GPT2Config":
+        return cls(**{**dict(hidden_size=1280, num_hidden_layers=36,
+                             num_attention_heads=20), **overrides})
+
+    @classmethod
+    def tiny(cls, **overrides) -> "GPT2Config":
+        return cls(**{**dict(
+            vocab_size=256, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, max_position_embeddings=64,
+        ), **overrides})
+
+
+def init_gpt2_params(config: GPT2Config, key: jax.Array) -> dict:
+    d, i, L = config.hidden_size, config.intermediate_size, config.num_hidden_layers
+    dt = config.param_dtype
+    keys = jax.random.split(key, 6)
+
+    def stack_dense(k, in_dim, out_dim, scale=0.02):
+        ks = jax.random.split(k, L)
+        sub = [_dense(kk, in_dim, out_dim, dt, scale) for kk in ks]
+        return {
+            "kernel": jnp.stack([s["kernel"] for s in sub]),
+            "bias": jnp.stack([s["bias"] for s in sub]),
+        }
+
+    def stack_ln():
+        return {"scale": jnp.ones((L, d), dt), "bias": jnp.zeros((L, d), dt)}
+
+    # GPT-2 initializes residual-path projections scaled down by sqrt(2L)
+    resid_scale = 0.02 / np.sqrt(2 * L)
+    return {
+        "wte": {"embedding": (jax.random.normal(keys[0], (config.vocab_size, d)) * 0.02).astype(dt)},
+        "wpe": {"embedding": (
+            jax.random.normal(keys[1], (config.max_position_embeddings, d)) * 0.01
+        ).astype(dt)},
+        "layers": {
+            "ln_1": stack_ln(),
+            "attn": {
+                "c_attn": stack_dense(keys[2], d, 3 * d),
+                "c_proj": stack_dense(keys[3], d, d, scale=resid_scale),
+            },
+            "ln_2": stack_ln(),
+            "mlp": {
+                "c_fc": stack_dense(keys[4], d, i),
+                "c_proj": stack_dense(keys[5], i, d, scale=resid_scale),
+            },
+        },
+        "ln_f": {"scale": jnp.ones((d,), dt), "bias": jnp.zeros((d,), dt)},
+    }
+
+
+def _gpt2_layer(config: GPT2Config, lp, x, position_offset: int = 0):
+    cdt = config.compute_dtype
+    b, s, d = x.shape
+    h, hd = config.num_attention_heads, config.head_dim
+
+    y = layer_norm(x, lp["ln_1"]["scale"], lp["ln_1"]["bias"], config.layer_norm_eps)
+    qkv = _apply_dense(lp["attn"]["c_attn"], y, cdt)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(b, s, h, hd)
+    k = k.reshape(b, s, h, hd)
+    v = v.reshape(b, s, h, hd)
+    attn = dispatch_attention(
+        config.attention_impl, q, k, v, causal=True, q_offset=position_offset,
+        kv_block=config.attention_kv_block, block_q=config.attention_block_q,
+    )
+    attn = _apply_dense(lp["attn"]["c_proj"], attn.reshape(b, s, d), cdt)
+    attn = checkpoint_name(attn, "attn_block_out")  # saved under remat "minimal"
+    x = constrain_activation(x + attn)
+
+    y = layer_norm(x, lp["ln_2"]["scale"], lp["ln_2"]["bias"], config.layer_norm_eps)
+    # gelu_new (tanh approximation) — matches HF GPT-2 exactly
+    y = jax.nn.gelu(_apply_dense(lp["mlp"]["c_fc"], y, cdt), approximate=True)
+    y = _apply_dense(lp["mlp"]["c_proj"], y, cdt)
+    y = checkpoint_name(y, "mlp_block_out")
+    return constrain_activation(x + y)
+
+
+def gpt2_apply(
+    config: GPT2Config,
+    params: dict,
+    input_ids: jax.Array,
+    position_offset: int = 0,
+):
+    """(B, S) int tokens → (B, S, V) fp32 logits, or the chunked-CE protocol
+    dict {"hidden", "head_kernel"} when ``config.use_chunked_ce`` (the head is
+    always tied to wte, as in GPT-2)."""
+    cdt = config.compute_dtype
+    b, s = input_ids.shape
+    table = replicate_over_fsdp(params["wte"]["embedding"], keep_tp=False)
+    x = table.astype(cdt)[input_ids]
+    pos = jnp.arange(s) + position_offset
+    x = constrain_activation(x + params["wpe"]["embedding"].astype(cdt)[pos][None])
+
+    layer_fn = functools.partial(_gpt2_layer, config, position_offset=position_offset)
+    if config.remat_policy != "full":
+        layer_fn = jax.checkpoint(layer_fn, policy=_remat_policy(config.remat_policy))
+
+    if config.scan_layers:
+        def body(x, lp):
+            return layer_fn(lp, x), None
+
+        x, _ = lax.scan(body, x, params["layers"])
+    else:
+        for li in range(config.num_hidden_layers):
+            lp = jax.tree_util.tree_map(lambda p: p[li], params["layers"])
+            x = layer_fn(lp, x)
+
+    x = layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"], config.layer_norm_eps)
+    head = params["wte"]["embedding"].T
+    if config.use_chunked_ce:
+        return {"hidden": x, "head_kernel": head}
+    logits = (x @ replicate_over_fsdp(head).astype(cdt)).astype(jnp.float32)
+    return constrain_activation(logits, "vocab")
+
+
+def create_gpt2(config: GPT2Config, seed: int = 0) -> Model:
+    params = init_gpt2_params(config, jax.random.key(seed))
+    model = Model(functools.partial(gpt2_apply, config), params, name="gpt2")
+    model.config = config
+    return model
+
+
+# the output protocol (logits | {"hidden","head_kernel"}) matches llama's, so
+# the shifted-label masked CE (incl. the fused chunked path) is shared
+gpt2_loss = llama_loss
+
+
+# ------------------------------------------------------------ HF interop
+def convert_hf_state_dict(config: GPT2Config, flat: dict) -> dict:
+    """HF ``GPT2LMHeadModel.state_dict()`` (numpy arrays) → our stacked
+    pytree. HF's Conv1D keeps (in, out) kernels, so no transposition."""
+    dt = config.param_dtype
+    L = config.num_hidden_layers
+
+    def get(name):
+        return jnp.asarray(np.asarray(flat[name]), dtype=dt)
+
+    def stacked(suffix):
+        return jnp.stack([get(f"transformer.h.{i}.{suffix}") for i in range(L)])
+
+    return {
+        "wte": {"embedding": get("transformer.wte.weight")},
+        "wpe": {"embedding": get("transformer.wpe.weight")},
+        "layers": {
+            "ln_1": {"scale": stacked("ln_1.weight"), "bias": stacked("ln_1.bias")},
+            "attn": {
+                "c_attn": {
+                    "kernel": stacked("attn.c_attn.weight"),
+                    "bias": stacked("attn.c_attn.bias"),
+                },
+                "c_proj": {
+                    "kernel": stacked("attn.c_proj.weight"),
+                    "bias": stacked("attn.c_proj.bias"),
+                },
+            },
+            "ln_2": {"scale": stacked("ln_2.weight"), "bias": stacked("ln_2.bias")},
+            "mlp": {
+                "c_fc": {
+                    "kernel": stacked("mlp.c_fc.weight"),
+                    "bias": stacked("mlp.c_fc.bias"),
+                },
+                "c_proj": {
+                    "kernel": stacked("mlp.c_proj.weight"),
+                    "bias": stacked("mlp.c_proj.bias"),
+                },
+            },
+        },
+        "ln_f": {"scale": get("transformer.ln_f.weight"), "bias": get("transformer.ln_f.bias")},
+    }
+
+
+def export_hf_state_dict(config: GPT2Config, params: dict) -> dict:
+    """Inverse of :func:`convert_hf_state_dict` (torch-ecosystem export).
+    ``lm_head.weight`` is emitted tied to wte, as HF expects."""
+    out = {
+        "transformer.wte.weight": params["wte"]["embedding"],
+        "transformer.wpe.weight": params["wpe"]["embedding"],
+        "transformer.ln_f.weight": params["ln_f"]["scale"],
+        "transformer.ln_f.bias": params["ln_f"]["bias"],
+        "lm_head.weight": params["wte"]["embedding"],
+    }
+    lay = params["layers"]
+    names = {
+        "ln_1.weight": lay["ln_1"]["scale"],
+        "ln_1.bias": lay["ln_1"]["bias"],
+        "attn.c_attn.weight": lay["attn"]["c_attn"]["kernel"],
+        "attn.c_attn.bias": lay["attn"]["c_attn"]["bias"],
+        "attn.c_proj.weight": lay["attn"]["c_proj"]["kernel"],
+        "attn.c_proj.bias": lay["attn"]["c_proj"]["bias"],
+        "ln_2.weight": lay["ln_2"]["scale"],
+        "ln_2.bias": lay["ln_2"]["bias"],
+        "mlp.c_fc.weight": lay["mlp"]["c_fc"]["kernel"],
+        "mlp.c_fc.bias": lay["mlp"]["c_fc"]["bias"],
+        "mlp.c_proj.weight": lay["mlp"]["c_proj"]["kernel"],
+        "mlp.c_proj.bias": lay["mlp"]["c_proj"]["bias"],
+    }
+    for i in range(config.num_hidden_layers):
+        for suffix, stacked in names.items():
+            out[f"transformer.h.{i}.{suffix}"] = stacked[i]
+    return {k: np.asarray(v) for k, v in out.items()}

@@ -226,21 +226,12 @@ def _dot(config: LlamaConfig, x, w):
 def _attention(config: LlamaConfig, q, k, v, attention_fn=None, q_offset: int = 0):
     if attention_fn is not None:
         return attention_fn(q, k, v, causal=True)
-    if config.attention_impl == "flash" and q_offset == 0:
-        from ..ops.flash_attention import flash_attention
+    from ..ops.attention import dispatch_attention
 
-        return flash_attention(
-            q, k, v, causal=True,
-            block_q=config.attention_block_q, block_k=config.attention_kv_block,
-        )
-    if config.attention_impl in ("blockwise", "flash"):
-        # flash with a shifted q block (CP/SP local shard, cached decode)
-        # falls back to blockwise: the Pallas kernel builds its causal mask
-        # from block indices anchored at 0 and would silently mis-mask
-        return blockwise_attention(
-            q, k, v, causal=True, kv_block=config.attention_kv_block, q_offset=q_offset
-        )
-    return dot_product_attention(q, k, v, causal=True, q_offset=q_offset)
+    return dispatch_attention(
+        config.attention_impl, q, k, v, causal=True, q_offset=q_offset,
+        kv_block=config.attention_kv_block, block_q=config.attention_block_q,
+    )
 
 
 def _layer(

@@ -20,7 +20,12 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["dot_product_attention", "blockwise_attention", "repeat_kv"]
+__all__ = [
+    "dot_product_attention",
+    "blockwise_attention",
+    "dispatch_attention",
+    "repeat_kv",
+]
 
 
 def repeat_kv(k: jax.Array, n_rep: int) -> jax.Array:
@@ -76,6 +81,38 @@ def dot_product_attention(
     weights = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", weights.astype(v.dtype), v)
     return out
+
+
+def dispatch_attention(
+    impl: str,
+    q,
+    k,
+    v,
+    *,
+    causal: bool = True,
+    q_offset: int = 0,
+    kv_block: int = 512,
+    block_q: int = 2048,
+):
+    """Select the attention implementation by name — the shared entry every
+    causal-LM family (llama, gpt2, ...) routes through. ``impl``: "flash" |
+    "blockwise" | "xla". Flash with a shifted q block (CP/SP local shard,
+    cached decode) falls back to blockwise: the Pallas kernel anchors its
+    causal mask at block index 0 and would silently mis-mask."""
+    if impl not in ("flash", "blockwise", "xla"):
+        raise ValueError(
+            f"unknown attention impl {impl!r}; expected 'flash', 'blockwise', "
+            "or 'xla'"
+        )
+    if impl == "flash" and q_offset == 0 and causal:
+        from .flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=True, block_q=block_q, block_k=kv_block)
+    if impl in ("blockwise", "flash"):
+        return blockwise_attention(
+            q, k, v, causal=causal, kv_block=kv_block, q_offset=q_offset
+        )
+    return dot_product_attention(q, k, v, causal=causal, q_offset=q_offset)
 
 
 def _attend_block(q, k, v, bias):

@@ -137,3 +137,13 @@ def test_cross_validation_example():
     result = _run("by_feature/cross_validation.py", "--folds", "2")
     assert result.returncode == 0, result.stderr[-2000:]
     assert "mean accuracy over 2 folds" in result.stdout
+
+
+@pytest.mark.slow
+def test_gpt_pretraining_example():
+    result = _run(
+        "by_feature/gpt_pretraining.py",
+        "--tp", "2", "--dp_shard", "4", "--steps", "4",
+    )
+    assert result.returncode == 0, result.stderr[-2000:]
+    assert "tok/s" in result.stdout
