@@ -33,14 +33,20 @@ def generate(
     seed: int = 0,
     pad_to: Optional[int] = None,
 ):
-    """Greedy (temperature=0) or sampled generation for our llama models.
+    """Greedy (temperature=0) or sampled generation for the causal-LM
+    families (llama/mixtral, gpt2 — dispatched on the model's config type).
 
     Prefill runs the full forward once; decode is a single compiled scan with
     a static-size KV cache. Returns (B, prompt+new) token ids.
     """
+    from .models.gpt2 import GPT2Config, gpt2_decode_step, gpt2_prefill
     from .models.llama import llama_decode_step, llama_prefill
 
     config = model.config
+    if isinstance(config, GPT2Config):
+        prefill_fn, decode_fn = gpt2_prefill, gpt2_decode_step
+    else:
+        prefill_fn, decode_fn = llama_prefill, llama_decode_step
     input_ids = jnp.asarray(input_ids, dtype=jnp.int32)
     b, prompt_len = input_ids.shape
     total_len = prompt_len + max_new_tokens
@@ -49,7 +55,7 @@ def generate(
 
     # prefill: ONE full forward fills the cache (O(S) matmul work vs O(S²)
     # for token-by-token decode over the prompt)
-    logits, cache = llama_prefill(config, model.params, input_ids, total_len)
+    logits, cache = prefill_fn(config, model.params, input_ids, total_len)
 
     key = jax.random.key(seed)
 
@@ -62,7 +68,7 @@ def generate(
         cache, logits, key = carry
         key, sub = jax.random.split(key)
         token = sample(logits, sub)[:, None]
-        logits, cache = llama_decode_step(config, model.params, cache, token, t)
+        logits, cache = decode_fn(config, model.params, cache, token, t)
         return (cache, logits, key), token[:, 0]
 
     (_, _, _), new_tokens = lax.scan(

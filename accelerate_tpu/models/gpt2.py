@@ -127,7 +127,10 @@ def init_gpt2_params(config: GPT2Config, key: jax.Array) -> dict:
     }
 
 
-def _gpt2_layer(config: GPT2Config, lp, x, position_offset: int = 0):
+def _gpt2_layer(
+    config: GPT2Config, lp, x, position_offset: int = 0,
+    attention_fn: Optional[Any] = None, collect_kv: bool = False,
+):
     cdt = config.compute_dtype
     b, s, d = x.shape
     h, hd = config.num_attention_heads, config.head_dim
@@ -138,10 +141,13 @@ def _gpt2_layer(config: GPT2Config, lp, x, position_offset: int = 0):
     q = q.reshape(b, s, h, hd)
     k = k.reshape(b, s, h, hd)
     v = v.reshape(b, s, h, hd)
-    attn = dispatch_attention(
-        config.attention_impl, q, k, v, causal=True, q_offset=position_offset,
-        kv_block=config.attention_kv_block, block_q=config.attention_block_q,
-    )
+    if attention_fn is not None:  # mesh-aware CP/SP attention from prepare()
+        attn = attention_fn(q, k, v, causal=True)
+    else:
+        attn = dispatch_attention(
+            config.attention_impl, q, k, v, causal=True, q_offset=position_offset,
+            kv_block=config.attention_kv_block, block_q=config.attention_block_q,
+        )
     attn = _apply_dense(lp["attn"]["c_proj"], attn.reshape(b, s, d), cdt)
     attn = checkpoint_name(attn, "attn_block_out")  # saved under remat "minimal"
     x = constrain_activation(x + attn)
@@ -151,7 +157,10 @@ def _gpt2_layer(config: GPT2Config, lp, x, position_offset: int = 0):
     y = jax.nn.gelu(_apply_dense(lp["mlp"]["c_fc"], y, cdt), approximate=True)
     y = _apply_dense(lp["mlp"]["c_proj"], y, cdt)
     y = checkpoint_name(y, "mlp_block_out")
-    return constrain_activation(x + y)
+    out = constrain_activation(x + y)
+    if collect_kv:
+        return out, (k, v)
+    return out
 
 
 def gpt2_apply(
@@ -159,22 +168,36 @@ def gpt2_apply(
     params: dict,
     input_ids: jax.Array,
     position_offset: int = 0,
+    attention_fn: Optional[Any] = None,
+    layer_stack_fn: Optional[Any] = None,
 ):
     """(B, S) int tokens → (B, S, V) fp32 logits, or the chunked-CE protocol
     dict {"hidden", "head_kernel"} when ``config.use_chunked_ce`` (the head is
-    always tied to wte, as in GPT-2)."""
+    always tied to wte, as in GPT-2). ``attention_fn``/``layer_stack_fn`` are
+    the prepare-time CP/SP and PP hooks (same contract as llama_apply)."""
     cdt = config.compute_dtype
     b, s = input_ids.shape
+    if s + position_offset > config.max_position_embeddings:
+        # learned positions clamp silently in compiled gathers (mode='clip');
+        # unlike RoPE there is no valid extrapolation — fail loudly instead
+        raise ValueError(
+            f"sequence end {s + position_offset} exceeds "
+            f"max_position_embeddings={config.max_position_embeddings}"
+        )
     table = replicate_over_fsdp(params["wte"]["embedding"], keep_tp=False)
     x = table.astype(cdt)[input_ids]
     pos = jnp.arange(s) + position_offset
     x = constrain_activation(x + params["wpe"]["embedding"].astype(cdt)[pos][None])
 
-    layer_fn = functools.partial(_gpt2_layer, config, position_offset=position_offset)
+    layer_fn = functools.partial(
+        _gpt2_layer, config, position_offset=position_offset, attention_fn=attention_fn
+    )
     if config.remat_policy != "full":
         layer_fn = jax.checkpoint(layer_fn, policy=_remat_policy(config.remat_policy))
 
-    if config.scan_layers:
+    if layer_stack_fn is not None:
+        x, _aux = layer_stack_fn(params["layers"], x, lambda lp, x: (layer_fn(lp, x), jnp.float32(0.0)))
+    elif config.scan_layers:
         def body(x, lp):
             return layer_fn(lp, x), None
 
@@ -194,14 +217,116 @@ def gpt2_apply(
 
 def create_gpt2(config: GPT2Config, seed: int = 0) -> Model:
     params = init_gpt2_params(config, jax.random.key(seed))
+    overrides = {"attention_fn": None, "layer_stack_fn": None}
+
+    def _rebind():
+        model.apply_fn = functools.partial(
+            gpt2_apply, config, **{k: v for k, v in overrides.items() if v is not None}
+        )
+        model._jitted_forward = None
+
     model = Model(functools.partial(gpt2_apply, config), params, name="gpt2")
     model.config = config
+
+    def set_attention_fn(attention_fn):
+        """Accelerator.prepare hook: mesh-aware attention (ring/Ulysses)."""
+        overrides["attention_fn"] = attention_fn
+        _rebind()
+
+    def set_layer_stack_fn(layer_stack_fn):
+        """Accelerator.prepare hook: pipelined layer-stack execution (pp)."""
+        overrides["layer_stack_fn"] = layer_stack_fn
+        _rebind()
+
+    model.set_attention_fn = set_attention_fn
+    model.set_layer_stack_fn = set_layer_stack_fn
+    model.canonical_loss = gpt2_loss
     return model
 
 
 # the output protocol (logits | {"hidden","head_kernel"}) matches llama's, so
 # the shifted-label masked CE (incl. the fused chunked path) is shared
 gpt2_loss = llama_loss
+
+
+# ------------------------------------------------------------ generation
+def gpt2_prefill(config: GPT2Config, params, input_ids, max_len: int):
+    """One full forward over the prompt → (last-position logits (B, V),
+    KV cache padded to ``max_len``). Same contract as llama_prefill."""
+    cdt = config.compute_dtype
+    b, s = input_ids.shape
+    if max_len > config.max_position_embeddings:
+        raise ValueError(
+            f"generation length {max_len} exceeds max_position_embeddings="
+            f"{config.max_position_embeddings}: learned positions cannot "
+            "extrapolate (the compiled gather would silently clamp)"
+        )
+    x = params["wte"]["embedding"].astype(cdt)[input_ids]
+    x = x + params["wpe"]["embedding"].astype(cdt)[jnp.arange(s)][None]
+
+    layer_fn = functools.partial(_gpt2_layer, config, collect_kv=True)
+
+    def body(x, lp):
+        x, (k, v) = layer_fn(lp, x)
+        return x, (k, v)
+
+    x, (ks, vs) = lax.scan(body, x, params["layers"])  # (L, B, S, h, hd)
+    x = layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"], config.layer_norm_eps)
+    logits = x @ params["wte"]["embedding"].astype(cdt).T
+    pad = max_len - s
+    cache = {
+        "k": jnp.pad(ks, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0))),
+        "v": jnp.pad(vs, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0))),
+    }
+    return logits[:, -1].astype(jnp.float32), cache
+
+
+def _gpt2_decode_layer(config: GPT2Config, lp, x, cache_k, cache_v, pos):
+    """One block, one new position; updates the (B, max_len, h, hd) caches."""
+    cdt = config.compute_dtype
+    b, s, d = x.shape  # s == 1
+    h, hd = config.num_attention_heads, config.head_dim
+
+    y = layer_norm(x, lp["ln_1"]["scale"], lp["ln_1"]["bias"], config.layer_norm_eps)
+    qkv = _apply_dense(lp["attn"]["c_attn"], y, cdt)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(b, s, h, hd)
+    k = k.reshape(b, s, h, hd)
+    v = v.reshape(b, s, h, hd)
+    cache_k = lax.dynamic_update_slice(cache_k, k.astype(cache_k.dtype), (0, pos, 0, 0))
+    cache_v = lax.dynamic_update_slice(cache_v, v.astype(cache_v.dtype), (0, pos, 0, 0))
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q * (1.0 / np.sqrt(hd)), cache_k.astype(cdt)
+    ).astype(jnp.float32)
+    k_pos = lax.broadcasted_iota(jnp.int32, scores.shape, 3)
+    scores = jnp.where(k_pos <= pos, scores, -1e6)
+    weights = jax.nn.softmax(scores, axis=-1)
+    attn = jnp.einsum("bhqk,bkhd->bqhd", weights.astype(cdt), cache_v.astype(cdt))
+    attn = _apply_dense(lp["attn"]["c_proj"], attn.reshape(b, s, d), cdt)
+    x = x + attn
+
+    y = layer_norm(x, lp["ln_2"]["scale"], lp["ln_2"]["bias"], config.layer_norm_eps)
+    y = jax.nn.gelu(_apply_dense(lp["mlp"]["c_fc"], y, cdt), approximate=True)
+    y = _apply_dense(lp["mlp"]["c_proj"], y, cdt)
+    return x + y, cache_k, cache_v
+
+
+def gpt2_decode_step(config: GPT2Config, params, cache, token, pos):
+    """One decode step: token (B, 1) at traced position ``pos`` →
+    (logits (B, V), new cache). Same contract as llama_decode_step."""
+    cdt = config.compute_dtype
+    x = params["wte"]["embedding"].astype(cdt)[token]
+    x = x + jnp.take(params["wpe"]["embedding"].astype(cdt), pos, axis=0)[None, None]
+
+    def body(x, inputs):
+        lp, ck, cv = inputs
+        x, ck, cv = _gpt2_decode_layer(config, lp, x, ck, cv, pos)
+        return x, (ck, cv)
+
+    x, (new_k, new_v) = lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
+    x = layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"], config.layer_norm_eps)
+    logits = x @ params["wte"]["embedding"].astype(cdt).T
+    return logits[:, 0].astype(jnp.float32), {"k": new_k, "v": new_v}
 
 
 # ------------------------------------------------------------ HF interop
