@@ -95,6 +95,7 @@ def _measure(config, starting_batch, steps, seq_len):
     """Build a fresh accelerator+model for ``config``, run one fused
     multi-step program twice (warmup + timed), return the measurement."""
     import jax
+    import jax.numpy as jnp
     import optax
 
     from accelerate_tpu import Accelerator
@@ -112,7 +113,13 @@ def _measure(config, starting_batch, steps, seq_len):
     )
     accelerator = Accelerator(parallelism_config=pcfg, mixed_precision="bf16")
     model = create_llama(config, seed=0)
-    model, _optimizer = accelerator.prepare(model, optax.adamw(3e-4, weight_decay=0.01))
+    # bf16 first moment (standard for large-model training) frees ~2 bytes/
+    # param of HBM — the difference between the ~1B-param scale-phase
+    # candidates fitting a 16 GB chip or RESOURCE_EXHAUSTED-ing
+    mu_dtype = jnp.bfloat16 if os.environ.get("BENCH_MU_BF16", "1") == "1" else None
+    model, _optimizer = accelerator.prepare(
+        model, optax.adamw(3e-4, weight_decay=0.01, mu_dtype=mu_dtype)
+    )
     model.policy = None  # model handles bf16 internally
     # all `steps` train steps fuse into ONE program (lax.scan) — amortizes
     # dispatch/relay overhead, which dominates per-call timing on tunneled TPUs
