@@ -28,7 +28,7 @@ from ..model import Model
 from ..ops.attention import dispatch_attention
 from ..parallel.sharding import constrain_activation, replicate_over_fsdp
 from .bert import _apply_dense, _dense, layer_norm
-from .llama import _remat_policy, llama_loss
+from .llama import _ce_from_hidden, _remat_policy, llama_ce_denominator, llama_loss
 
 __all__ = [
     "GPT2Config",
@@ -57,6 +57,7 @@ class GPT2Config:
     attention_block_q: int = 2048
     scan_layers: bool = True
     use_chunked_ce: bool = False
+    ce_chunk_size: int = 4096
 
     @property
     def head_dim(self) -> int:
@@ -136,8 +137,18 @@ def _gpt2_layer(
     h, hd = config.num_attention_heads, config.head_dim
 
     y = layer_norm(x, lp["ln_1"]["scale"], lp["ln_1"]["bias"], config.layer_norm_eps)
-    qkv = _apply_dense(lp["attn"]["c_attn"], y, cdt)
-    q, k, v = jnp.split(qkv, 3, axis=-1)
+    # project q/k/v by statically slicing the fused HF c_attn kernel instead
+    # of splitting the fused activation: the auto partitioner is free to
+    # feature-shard a (b, s, 3d) qkv over dp and lower jnp.split into
+    # all-device collective-permutes, which deadlock inside the pipeline
+    # schedules' role-gated cond branches (only some pp ranks run a branch at
+    # a given tick). Weight slices are collective-free: kernels are never
+    # dp-sharded, and tp slices stay within a branch-consistent tp group.
+    wq = lp["attn"]["c_attn"]["kernel"]
+    bq = lp["attn"]["c_attn"]["bias"]
+    q = y @ wq[:, :d].astype(cdt) + bq[:d].astype(cdt)
+    k = y @ wq[:, d : 2 * d].astype(cdt) + bq[d : 2 * d].astype(cdt)
+    v = y @ wq[:, 2 * d :].astype(cdt) + bq[2 * d :].astype(cdt)
     q = q.reshape(b, s, h, hd)
     k = k.reshape(b, s, h, hd)
     v = v.reshape(b, s, h, hd)
@@ -241,12 +252,58 @@ def create_gpt2(config: GPT2Config, seed: int = 0) -> Model:
     model.set_attention_fn = set_attention_fn
     model.set_layer_stack_fn = set_layer_stack_fn
     model.canonical_loss = gpt2_loss
+    # 1F1B contract (parallel/pp_1f1b.py); lazy so a later set_attention_fn
+    # (ring/Ulysses) is picked up
+    model.pipeline_parts = lambda: gpt2_pipeline_parts(
+        config, overrides["attention_fn"]
+    )
     return model
 
 
 # the output protocol (logits | {"hidden","head_kernel"}) matches llama's, so
 # the shifted-label masked CE (incl. the fused chunked path) is shared
 gpt2_loss = llama_loss
+
+
+def gpt2_pipeline_parts(config: GPT2Config, attention_fn=None):
+    """(embed_fn, stage_fn, head_loss_fn, denominator_fn) for the
+    hand-scheduled 1F1B pipeline (parallel/pp_1f1b.py) — same contract as
+    llama_pipeline_parts; the CE tail is the shared ``_ce_from_hidden`` so
+    the pipelined loss provably matches :func:`gpt2_loss`."""
+    cdt = config.compute_dtype
+    layer_fn = functools.partial(
+        _gpt2_layer, config, position_offset=0, attention_fn=attention_fn
+    )
+    if config.remat_policy != "full":
+        layer_fn = jax.checkpoint(layer_fn, policy=_remat_policy(config.remat_policy))
+
+    def embed_fn(params, mb):
+        ids = mb["input_ids"]
+        s = ids.shape[1]
+        x = params["wte"]["embedding"].astype(cdt)[ids]
+        x = x + params["wpe"]["embedding"].astype(cdt)[jnp.arange(s)][None]
+        return constrain_activation(x)
+
+    def stage_fn(stage_params, h):
+        def body(h, lp):
+            return layer_fn(lp, h), None
+
+        h, _ = lax.scan(body, h, stage_params)
+        return h
+
+    def head_loss_fn(params, h, mb):
+        x = layer_norm(
+            h, params["ln_f"]["scale"], params["ln_f"]["bias"], config.layer_norm_eps
+        )
+        head = params["wte"]["embedding"].T
+        labels = mb.get("labels")
+        mask = mb.get("loss_mask")
+        if labels is None:
+            labels = mb["input_ids"][:, 1:]
+            x = x[:, :-1]
+        return _ce_from_hidden(config, x, head, labels, mask, reduction="sum")
+
+    return embed_fn, stage_fn, head_loss_fn, llama_ce_denominator
 
 
 # ------------------------------------------------------------ generation
@@ -288,8 +345,18 @@ def _gpt2_decode_layer(config: GPT2Config, lp, x, cache_k, cache_v, pos):
     h, hd = config.num_attention_heads, config.head_dim
 
     y = layer_norm(x, lp["ln_1"]["scale"], lp["ln_1"]["bias"], config.layer_norm_eps)
-    qkv = _apply_dense(lp["attn"]["c_attn"], y, cdt)
-    q, k, v = jnp.split(qkv, 3, axis=-1)
+    # project q/k/v by statically slicing the fused HF c_attn kernel instead
+    # of splitting the fused activation: the auto partitioner is free to
+    # feature-shard a (b, s, 3d) qkv over dp and lower jnp.split into
+    # all-device collective-permutes, which deadlock inside the pipeline
+    # schedules' role-gated cond branches (only some pp ranks run a branch at
+    # a given tick). Weight slices are collective-free: kernels are never
+    # dp-sharded, and tp slices stay within a branch-consistent tp group.
+    wq = lp["attn"]["c_attn"]["kernel"]
+    bq = lp["attn"]["c_attn"]["bias"]
+    q = y @ wq[:, :d].astype(cdt) + bq[:d].astype(cdt)
+    k = y @ wq[:, d : 2 * d].astype(cdt) + bq[d : 2 * d].astype(cdt)
+    v = y @ wq[:, 2 * d :].astype(cdt) + bq[2 * d :].astype(cdt)
     q = q.reshape(b, s, h, hd)
     k = k.reshape(b, s, h, hd)
     v = v.reshape(b, s, h, hd)

@@ -81,6 +81,13 @@ def make_pipeline_layer_stack(
                 )
                 aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
                 if n_stages > 1:
+                    # serialize successive wire permutes: at fill/drain ticks
+                    # the cond's zero branch makes `out` data-independent of
+                    # the previous recv, so devices can start tick-t and
+                    # tick-t+1 permutes in different orders and deadlock the
+                    # CPU backend's rendezvous (observed with gpt2 stages;
+                    # same fix as pp_1f1b.py's backward/forward wire pair)
+                    out, _ = lax.optimization_barrier((out, recv))
                     recv = lax.ppermute(out, pp_axis, perm)
                 k = t - (n_stages - 1)
                 if 0 <= k < m:

@@ -210,3 +210,39 @@ def test_gpt2_context_parallel_matches_single():
     model.policy = None
     out = np.asarray(model(jnp.asarray(ids)))
     np.testing.assert_allclose(out, ref_logits, atol=2e-4, rtol=1e-4)
+
+
+def test_gpt2_1f1b_training_matches_dp():
+    """GPT-2 under the hand-scheduled 1F1B pipeline reproduces the dp-only
+    trajectory (same bar as tests/test_pipeline.py holds for llama)."""
+    from accelerate_tpu.parallelism_config import ParallelismConfig
+    from accelerate_tpu.utils.dataclasses import PipelineParallelConfig
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    rng = np.random.default_rng(0)
+    data = {"input_ids": rng.integers(0, 256, size=(8, 32)).astype(np.int32)}
+    cfg = GPT2Config.tiny(num_hidden_layers=4, compute_dtype=jnp.float32)
+
+    def run(pcfg, steps=2):
+        _reset()
+        acc = Accelerator(parallelism_config=pcfg)
+        model, opt = acc.prepare(create_gpt2(cfg, seed=0), optax.sgd(1e-2))
+        step = acc.train_step(gpt2_loss, max_grad_norm=None)
+        loader = acc.prepare_data_loader(data, batch_size=8, drop_last=True)
+        losses = []
+        for _ in range(steps):
+            for batch in loader:
+                losses.append(float(step(batch)))
+        w = np.asarray(jax.device_get(model.params["layers"]["attn"]["c_attn"]["kernel"]))
+        return w, losses
+
+    w_ref, l_ref = run(ParallelismConfig(dp_shard_size=8))
+    w_pp, l_pp = run(
+        ParallelismConfig(
+            pp_size=4, dp_shard_size=2,
+            pp_config=PipelineParallelConfig(num_microbatches=4, schedule="1f1b"),
+        )
+    )
+    np.testing.assert_allclose(l_pp, l_ref, atol=1e-4)
+    np.testing.assert_allclose(w_pp, w_ref, atol=1e-4)
