@@ -107,6 +107,7 @@ def init_gpt2_params(config: GPT2Config, key: jax.Array) -> dict:
 
     # GPT-2 initializes residual-path projections scaled down by sqrt(2L)
     resid_scale = 0.02 / np.sqrt(2 * L)
+    kq, kk, kv = jax.random.split(keys[2], 3)
     return {
         "wte": {"embedding": (jax.random.normal(keys[0], (config.vocab_size, d)) * 0.02).astype(dt)},
         "wpe": {"embedding": (
@@ -114,8 +115,19 @@ def init_gpt2_params(config: GPT2Config, key: jax.Array) -> dict:
         ).astype(dt)},
         "layers": {
             "ln_1": stack_ln(),
+            # q/k/v are separate params natively (HF fuses them into one
+            # (d, 3d) Conv1D `c_attn`; conversion splits/fuses at the
+            # checkpoint boundary). Slicing a fused mesh-sharded kernel in
+            # the compiled graph makes GSPMD reshard each slice with
+            # data-independent collective-permutes inside the layer scan —
+            # XLA:CPU's concurrent thunk executor then starts them in
+            # divergent orders across devices and deadlocks its rendezvous;
+            # on TPU they are wasted ICI traffic. Separate params shard
+            # cleanly like llama's q_proj/k_proj/v_proj.
             "attn": {
-                "c_attn": stack_dense(keys[2], d, 3 * d),
+                "c_attn_q": stack_dense(kq, d, d),
+                "c_attn_k": stack_dense(kk, d, d),
+                "c_attn_v": stack_dense(kv, d, d),
                 "c_proj": stack_dense(keys[3], d, d, scale=resid_scale),
             },
             "ln_2": stack_ln(),
@@ -137,21 +149,9 @@ def _gpt2_layer(
     h, hd = config.num_attention_heads, config.head_dim
 
     y = layer_norm(x, lp["ln_1"]["scale"], lp["ln_1"]["bias"], config.layer_norm_eps)
-    # project q/k/v by statically slicing the fused HF c_attn kernel instead
-    # of splitting the fused activation: the auto partitioner is free to
-    # feature-shard a (b, s, 3d) qkv over dp and lower jnp.split into
-    # all-device collective-permutes, which deadlock inside the pipeline
-    # schedules' role-gated cond branches (only some pp ranks run a branch at
-    # a given tick). Weight slices are collective-free: kernels are never
-    # dp-sharded, and tp slices stay within a branch-consistent tp group.
-    wq = lp["attn"]["c_attn"]["kernel"]
-    bq = lp["attn"]["c_attn"]["bias"]
-    q = y @ wq[:, :d].astype(cdt) + bq[:d].astype(cdt)
-    k = y @ wq[:, d : 2 * d].astype(cdt) + bq[d : 2 * d].astype(cdt)
-    v = y @ wq[:, 2 * d :].astype(cdt) + bq[2 * d :].astype(cdt)
-    q = q.reshape(b, s, h, hd)
-    k = k.reshape(b, s, h, hd)
-    v = v.reshape(b, s, h, hd)
+    q = _apply_dense(lp["attn"]["c_attn_q"], y, cdt).reshape(b, s, h, hd)
+    k = _apply_dense(lp["attn"]["c_attn_k"], y, cdt).reshape(b, s, h, hd)
+    v = _apply_dense(lp["attn"]["c_attn_v"], y, cdt).reshape(b, s, h, hd)
     if attention_fn is not None:  # mesh-aware CP/SP attention from prepare()
         attn = attention_fn(q, k, v, causal=True)
     else:
@@ -345,21 +345,9 @@ def _gpt2_decode_layer(config: GPT2Config, lp, x, cache_k, cache_v, pos):
     h, hd = config.num_attention_heads, config.head_dim
 
     y = layer_norm(x, lp["ln_1"]["scale"], lp["ln_1"]["bias"], config.layer_norm_eps)
-    # project q/k/v by statically slicing the fused HF c_attn kernel instead
-    # of splitting the fused activation: the auto partitioner is free to
-    # feature-shard a (b, s, 3d) qkv over dp and lower jnp.split into
-    # all-device collective-permutes, which deadlock inside the pipeline
-    # schedules' role-gated cond branches (only some pp ranks run a branch at
-    # a given tick). Weight slices are collective-free: kernels are never
-    # dp-sharded, and tp slices stay within a branch-consistent tp group.
-    wq = lp["attn"]["c_attn"]["kernel"]
-    bq = lp["attn"]["c_attn"]["bias"]
-    q = y @ wq[:, :d].astype(cdt) + bq[:d].astype(cdt)
-    k = y @ wq[:, d : 2 * d].astype(cdt) + bq[d : 2 * d].astype(cdt)
-    v = y @ wq[:, 2 * d :].astype(cdt) + bq[2 * d :].astype(cdt)
-    q = q.reshape(b, s, h, hd)
-    k = k.reshape(b, s, h, hd)
-    v = v.reshape(b, s, h, hd)
+    q = _apply_dense(lp["attn"]["c_attn_q"], y, cdt).reshape(b, s, h, hd)
+    k = _apply_dense(lp["attn"]["c_attn_k"], y, cdt).reshape(b, s, h, hd)
+    v = _apply_dense(lp["attn"]["c_attn_v"], y, cdt).reshape(b, s, h, hd)
     cache_k = lax.dynamic_update_slice(cache_k, k.astype(cache_k.dtype), (0, pos, 0, 0))
     cache_v = lax.dynamic_update_slice(cache_v, v.astype(cache_v.dtype), (0, pos, 0, 0))
     scores = jnp.einsum(
@@ -399,8 +387,12 @@ def gpt2_decode_step(config: GPT2Config, params, cache, token, pos):
 # ------------------------------------------------------------ HF interop
 def convert_hf_state_dict(config: GPT2Config, flat: dict) -> dict:
     """HF ``GPT2LMHeadModel.state_dict()`` (numpy arrays) → our stacked
-    pytree. HF's Conv1D keeps (in, out) kernels, so no transposition."""
+    pytree. HF's Conv1D keeps (in, out) kernels, so no transposition; its
+    fused (d, 3d) ``c_attn`` is split into our native per-projection
+    q/k/v params here, at the checkpoint boundary (init_gpt2_params explains
+    why the compiled graph never slices a fused kernel)."""
     dt = config.param_dtype
+    d = config.hidden_size
     L = config.num_hidden_layers
 
     def get(name):
@@ -409,15 +401,25 @@ def convert_hf_state_dict(config: GPT2Config, flat: dict) -> dict:
     def stacked(suffix):
         return jnp.stack([get(f"transformer.h.{i}.{suffix}") for i in range(L)])
 
+    qkv_kernel = stacked("attn.c_attn.weight")  # (L, d, 3d)
+    qkv_bias = stacked("attn.c_attn.bias")  # (L, 3d)
     return {
         "wte": {"embedding": get("transformer.wte.weight")},
         "wpe": {"embedding": get("transformer.wpe.weight")},
         "layers": {
             "ln_1": {"scale": stacked("ln_1.weight"), "bias": stacked("ln_1.bias")},
             "attn": {
-                "c_attn": {
-                    "kernel": stacked("attn.c_attn.weight"),
-                    "bias": stacked("attn.c_attn.bias"),
+                "c_attn_q": {
+                    "kernel": qkv_kernel[:, :, :d],
+                    "bias": qkv_bias[:, :d],
+                },
+                "c_attn_k": {
+                    "kernel": qkv_kernel[:, :, d : 2 * d],
+                    "bias": qkv_bias[:, d : 2 * d],
+                },
+                "c_attn_v": {
+                    "kernel": qkv_kernel[:, :, 2 * d :],
+                    "bias": qkv_bias[:, 2 * d :],
                 },
                 "c_proj": {
                     "kernel": stacked("attn.c_proj.weight"),
@@ -451,11 +453,21 @@ def export_hf_state_dict(config: GPT2Config, params: dict) -> dict:
         "lm_head.weight": params["wte"]["embedding"],
     }
     lay = params["layers"]
+    attn = lay["attn"]
+    # re-fuse native q/k/v into HF's (d, 3d) Conv1D c_attn layout
+    qkv_kernel = jnp.concatenate(
+        [attn["c_attn_q"]["kernel"], attn["c_attn_k"]["kernel"],
+         attn["c_attn_v"]["kernel"]], axis=-1,
+    )
+    qkv_bias = jnp.concatenate(
+        [attn["c_attn_q"]["bias"], attn["c_attn_k"]["bias"],
+         attn["c_attn_v"]["bias"]], axis=-1,
+    )
     names = {
         "ln_1.weight": lay["ln_1"]["scale"],
         "ln_1.bias": lay["ln_1"]["bias"],
-        "attn.c_attn.weight": lay["attn"]["c_attn"]["kernel"],
-        "attn.c_attn.bias": lay["attn"]["c_attn"]["bias"],
+        "attn.c_attn.weight": qkv_kernel,
+        "attn.c_attn.bias": qkv_bias,
         "attn.c_proj.weight": lay["attn"]["c_proj"]["kernel"],
         "attn.c_proj.bias": lay["attn"]["c_proj"]["bias"],
         "ln_2.weight": lay["ln_2"]["scale"],

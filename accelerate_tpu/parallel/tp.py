@@ -29,15 +29,14 @@ def tensor_parallel_rules(
     L = layer_axis  # None is a valid PartitionSpec entry (replicated dim)
     return [
         # column parallel (shard output dim): attention q/k/v (incl. GPT-2's
-        # fused c_attn — the packed [q|k|v] column split is re-laid-out by
-        # XLA at the in-trace jnp.split), MLP gate/up (incl. GPT-2 c_fc)
-        (r"(q_proj|k_proj|v_proj|qkv|query|key|value|c_attn)/kernel", P(L, None, tp_axis)),
+        # per-projection c_attn_q/k/v), MLP gate/up (incl. GPT-2 c_fc)
+        (r"(q_proj|k_proj|v_proj|qkv|query|key|value|c_attn_[qkv])/kernel", P(L, None, tp_axis)),
         (r"(gate_proj|up_proj|wi|fc1|w1|w3|c_fc)/kernel", P(L, None, tp_axis)),
         # row parallel (shard input dim): attention out, MLP down, GPT-2's
         # two c_proj kernels (both are residual-path projections)
         (r"(o_proj|out_proj|wo|fc2|w2|down_proj|c_proj)/kernel", P(L, tp_axis, None)),
         # column-parallel biases ride the sharded output dim
-        (r"(c_attn|c_fc)/bias", P(L, tp_axis)),
+        (r"(c_attn_[qkv]|c_fc)/bias", P(L, tp_axis)),
         # unstacked head/embedding tables
         (r"(embed_tokens|wte|word_embeddings)/(embedding|weight)", P(tp_axis, None)),
         (r"lm_head/kernel", P(None, tp_axis)),

@@ -124,7 +124,8 @@ def test_tp_shards_gpt2_kernels():
             jax.tree_util.tree_leaves(model.shardings),
         )
     )
-    for name in ("layers/attn/c_attn/kernel", "layers/mlp/c_fc/kernel",
+    for name in ("layers/attn/c_attn_q/kernel", "layers/attn/c_attn_k/kernel",
+                 "layers/attn/c_attn_v/kernel", "layers/mlp/c_fc/kernel",
                  "layers/attn/c_proj/kernel", "layers/mlp/c_proj/kernel"):
         assert "tp" in str(flat[name].spec), f"{name} not tp-sharded: {flat[name]}"
 
@@ -234,7 +235,7 @@ def test_gpt2_1f1b_training_matches_dp():
         for _ in range(steps):
             for batch in loader:
                 losses.append(float(step(batch)))
-        w = np.asarray(jax.device_get(model.params["layers"]["attn"]["c_attn"]["kernel"]))
+        w = np.asarray(jax.device_get(model.params["layers"]["attn"]["c_attn_q"]["kernel"]))
         return w, losses
 
     w_ref, l_ref = run(ParallelismConfig(dp_shard_size=8))
