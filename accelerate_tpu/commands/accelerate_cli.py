@@ -1,10 +1,10 @@
 """``accelerate-tpu`` CLI entry point.
 
 TPU-native analogue of the reference's ``commands/accelerate_cli.py:28``:
-subcommands launch / config / env / test / estimate-memory / merge-weights
-(the reference's ``to-fsdp2`` and ``tpu-config`` have no TPU-native meaning:
-strategy conversion is a no-op under one GSPMD path, and pod fan-out lives in
-``launch --pod``).
+subcommands launch / config / env / test / estimate-memory / merge-weights /
+tpu-config (pod setup fan-out) / migrate-config (the reference's
+``to-fsdp2`` conversion role — here it converts a *reference* accelerate
+yaml into this framework's config, engine plugins becoming mesh axes).
 """
 
 from __future__ import annotations
@@ -24,7 +24,9 @@ def main(argv=None) -> int:
     from . import estimate as estimate_cmd
     from . import launch as launch_cmd
     from . import merge as merge_cmd
+    from . import migrate as migrate_cmd
     from . import test as test_cmd
+    from . import tpu as tpu_cmd
 
     launch_cmd.add_parser(subparsers)
     config_cmd.add_parser(subparsers)
@@ -32,6 +34,8 @@ def main(argv=None) -> int:
     test_cmd.add_parser(subparsers)
     estimate_cmd.add_parser(subparsers)
     merge_cmd.add_parser(subparsers)
+    tpu_cmd.add_parser(subparsers)
+    migrate_cmd.add_parser(subparsers)
 
     args, extra = parser.parse_known_args(argv)
     return args.func(args, extra) or 0

@@ -13,10 +13,18 @@ import os
 from dataclasses import dataclass, field
 from typing import Optional
 
-DEFAULT_CONFIG_DIR = os.path.expanduser(
-    os.environ.get("ACCELERATE_TPU_CONFIG_DIR", "~/.cache/accelerate_tpu")
-)
-DEFAULT_CONFIG_FILE = os.path.join(DEFAULT_CONFIG_DIR, "default_config.yaml")
+def default_config_dir() -> str:
+    """Resolved per call so ACCELERATE_TPU_CONFIG_DIR set after import (tests,
+    subprocess env) is honored."""
+    return os.path.expanduser(
+        os.environ.get("ACCELERATE_TPU_CONFIG_DIR", "~/.cache/accelerate_tpu")
+    )
+
+
+def default_config_file() -> str:
+    return os.path.join(default_config_dir(), "default_config.yaml")
+
+
 
 
 @dataclass
@@ -41,6 +49,11 @@ class ClusterConfig:
     max_restarts: int = 0
     watchdog_timeout: float = 0.0
     debug: bool = False
+    # TPU pod setup (reference ClusterConfig tpu_* fields, config_args.py:207-214)
+    tpu_name: Optional[str] = None
+    tpu_zone: Optional[str] = None
+    commands: Optional[list] = None
+    command_file: Optional[str] = None
 
     def to_env(self) -> dict[str, str]:
         env = {
@@ -65,18 +78,22 @@ class ClusterConfig:
                 env["ACCELERATE_COORDINATOR_ADDRESS"] = self.coordinator_address
         return env
 
-    def save(self, path: str = DEFAULT_CONFIG_FILE) -> str:
+    def save(self, path: Optional[str] = None) -> str:
         import yaml
 
-        os.makedirs(os.path.dirname(path), exist_ok=True)
+        path = path or default_config_file()
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
         with open(path, "w") as f:
             yaml.safe_dump(dataclasses.asdict(self), f)
         return path
 
     @classmethod
-    def load(cls, path: str = DEFAULT_CONFIG_FILE) -> "ClusterConfig":
+    def load(cls, path: Optional[str] = None) -> "ClusterConfig":
         import yaml
 
+        path = path or default_config_file()
         with open(path) as f:
             data = yaml.safe_load(f) or {}
         known = {f.name for f in dataclasses.fields(cls)}
@@ -129,7 +146,7 @@ def config_command(args, extra) -> int:
                 0.0, float,
             )
         cfg.debug = _ask("collective shape-verification debug mode? (y/n)", "n").lower().startswith("y")
-    path = cfg.save(args.config_file or DEFAULT_CONFIG_FILE)
+    path = cfg.save(args.config_file or default_config_file())
     print(f"Configuration saved to {path}")
     return 0
 

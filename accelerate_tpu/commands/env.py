@@ -33,10 +33,11 @@ def env_command(args, extra) -> int:
         info["optax"] = optax.__version__
     except ImportError:
         pass
-    from .config import DEFAULT_CONFIG_FILE
+    from .config import default_config_file
 
-    if os.path.exists(DEFAULT_CONFIG_FILE):
-        with open(DEFAULT_CONFIG_FILE) as f:
+    cfg_file = default_config_file()
+    if os.path.exists(cfg_file):
+        with open(cfg_file) as f:
             info["default_config"] = f.read()
     print(json.dumps(info, indent=2))
     return 0

@@ -35,7 +35,7 @@ import sys
 import tempfile
 import time
 
-from .config import DEFAULT_CONFIG_FILE, ClusterConfig
+from .config import ClusterConfig, default_config_file
 
 
 def _supervise(cmd, env, max_restarts: int, monitor_interval: float,
@@ -103,7 +103,7 @@ def _supervision_settings(args, cfg) -> tuple[int, float]:
 
 def launch_command(args, script_args) -> int:
     cfg = None
-    config_file = args.config_file or DEFAULT_CONFIG_FILE
+    config_file = args.config_file or default_config_file()
     if os.path.exists(config_file):
         cfg = ClusterConfig.load(config_file)
     else:

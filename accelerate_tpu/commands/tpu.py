@@ -1,0 +1,101 @@
+"""``accelerate-tpu tpu-config`` — run setup commands across a TPU pod.
+
+Analogue of the reference's ``accelerate tpu-config``
+(/root/reference/src/accelerate/commands/tpu.py:29-151): fan a setup
+command list out to every worker of a TPU pod VM over
+``gcloud compute tpus tpu-vm ssh --worker all`` before ``launch`` runs the
+training job there. Commands come from ``--command`` flags, a
+``--command_file``, or the ``commands``/``command_file`` entries of the
+default config; ``--install_package`` prepends a pip install of this
+framework (the reference's ``--install_accelerate``).
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import subprocess
+
+from .config import ClusterConfig, default_config_file
+
+_description = (
+    "Run commands across TPU pod workers for initial setup before "
+    "`accelerate-tpu launch --pod`."
+)
+
+
+def tpu_config_command(args, extra) -> int:
+    cfg = None
+    config_file = args.config_file or default_config_file()
+    if os.path.isfile(config_file):
+        cfg = ClusterConfig.load(config_file)
+    if cfg is not None:
+        if not args.tpu_name:
+            args.tpu_name = cfg.tpu_name
+        if not args.tpu_zone:
+            args.tpu_zone = cfg.tpu_zone
+        if not args.command and not args.command_file:
+            if cfg.commands:
+                args.command = [cfg.commands]
+            elif cfg.command_file:
+                args.command_file = cfg.command_file
+
+    if not args.tpu_name:
+        print("error: no TPU name (pass --tpu_name or set tpu_name in the config)")
+        return 2
+    if not args.command and not args.command_file:
+        print("error: nothing to run (pass --command / --command_file or set "
+              "commands in the config)")
+        return 2
+
+    # argparse nargs="+" + action="append" yields a list of lists; a command
+    # file APPENDS to any --command flags (reference tpu.py behavior)
+    commands: list[str] = []
+    for entry in args.command or []:
+        if isinstance(entry, (list, tuple)):
+            commands.extend(entry)
+        else:
+            commands.append(entry)
+    if args.command_file:
+        if not os.path.isfile(args.command_file):
+            print(f"error: command file {args.command_file} not found")
+            return 2
+        with open(args.command_file) as f:
+            commands.extend(f.read().splitlines())
+
+    setup = [f"cd {args.run_dir}"]
+    if args.install_package:
+        setup.append(f"pip install {args.install_package}")
+    remote = "; ".join(setup + commands)
+
+    cmd = ["gcloud", "compute", "tpus", "tpu-vm", "ssh", args.tpu_name]
+    if args.tpu_zone:
+        cmd += ["--zone", args.tpu_zone]
+    cmd += ["--worker", "all", f"--command={remote}"]
+    if args.debug:
+        print(" ".join(shlex.quote(c) for c in cmd))
+        return 0
+    rc = subprocess.call(cmd)
+    if rc == 0:
+        print("Successfully set up pod.")
+    return rc
+
+
+def add_parser(subparsers) -> None:
+    p = subparsers.add_parser("tpu-config", help=_description)
+    p.add_argument("--config_file", default=None,
+                   help="config yaml supplying tpu_name/tpu_zone/commands defaults")
+    p.add_argument("--tpu_name", default=None, help="TPU pod VM name")
+    p.add_argument("--tpu_zone", default=None, help="GCE zone of the pod")
+    p.add_argument("--command", action="append", nargs="+", default=None,
+                   help="a command to run on every worker; repeatable")
+    p.add_argument("--command_file", default=None,
+                   help="file with one command per line")
+    p.add_argument("--install_package", default=None,
+                   help="pip-install this package spec on every worker first "
+                        "(e.g. a wheel path or 'accelerate-tpu')")
+    p.add_argument("--run_dir", default="/usr/share",
+                   help="directory to run the commands from on each worker")
+    p.add_argument("--debug", action="store_true",
+                   help="print the gcloud command instead of running it")
+    p.set_defaults(func=tpu_config_command)

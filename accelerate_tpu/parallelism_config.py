@@ -156,17 +156,28 @@ class ParallelismConfig:
     # ------------------------------------------------------------ validation
     def _infer_and_validate(self, total_devices: int) -> None:
         sizes = self.axis_sizes
+        # -1 = "all remaining devices", allowed on one data axis at a time
+        # (dp_shard for FSDP-style configs, dp_replicate for pure-DDP ones)
+        inferable = ("dp_shard", "dp_replicate")
         for axis, size in sizes.items():
-            if axis != "dp_shard" and size < 1:
+            if axis in inferable and size == -1:
+                continue
+            if size < 1:
                 raise ValueError(f"{axis} size must be >= 1, got {size}")
-        if self.dp_shard_size == -1:
-            rest = int(np.prod([s for a, s in sizes.items() if a != "dp_shard"]))
+        if self.dp_shard_size == -1 and self.dp_replicate_size == -1:
+            raise ValueError(
+                "only one of dp_shard/dp_replicate may be -1 (inferred)"
+            )
+        for axis in inferable:
+            if sizes[axis] != -1:
+                continue
+            rest = int(np.prod([s for a, s in self.axis_sizes.items() if a != axis]))
             if total_devices % rest != 0:
                 raise ValueError(
-                    f"Cannot infer dp_shard: {total_devices} devices not divisible by "
+                    f"Cannot infer {axis}: {total_devices} devices not divisible by "
                     f"product of other axes {rest}"
                 )
-            self.dp_shard_size = total_devices // rest
+            setattr(self, f"{axis}_size", total_devices // rest)
         if self.cp_enabled and self.sp_enabled and not self.allow_cp_with_sp:
             raise ValueError(
                 "cp_size>1 and sp_size>1 are mutually exclusive by default "

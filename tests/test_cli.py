@@ -204,3 +204,284 @@ def test_launch_script_helper(tmp_path):
     )
     result = launch_script(str(script), env=None, n_devices=8)
     assert "num_devices" in result.stdout
+
+
+def test_tpu_config_debug_prints_gcloud(tmp_path, capsys, monkeypatch):
+    """tpu-config (reference commands/tpu.py:29-151) builds one gcloud ssh
+    --worker all command from flags + config-file defaults; --debug prints
+    it instead of running."""
+    monkeypatch.setenv("ACCELERATE_TPU_CONFIG_DIR", str(tmp_path))
+    cmds = tmp_path / "setup.txt"
+    cmds.write_text("pip install dataset-tools\necho ready\n")
+    rc = main([
+        "tpu-config", "--debug",
+        "--tpu_name", "my-pod", "--tpu_zone", "us-central2-b",
+        "--command_file", str(cmds),
+        "--install_package", "accelerate-tpu",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "gcloud compute tpus tpu-vm ssh my-pod" in out
+    assert "--zone us-central2-b" in out
+    assert "--worker all" in out
+    assert "pip install accelerate-tpu" in out
+    assert "echo ready" in out
+
+
+def test_tpu_config_reads_config_defaults(tmp_path, capsys):
+    cfg = ClusterConfig(tpu_name="cfg-pod", tpu_zone="eu-west4-a",
+                        commands=["echo from-config"])
+    path = cfg.save(str(tmp_path / "cfg.yaml"))
+    rc = main(["tpu-config", "--debug", "--config_file", path])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "cfg-pod" in out and "eu-west4-a" in out and "echo from-config" in out
+
+
+def test_tpu_config_requires_command(tmp_path, capsys):
+    rc = main(["tpu-config", "--debug", "--tpu_name", "p",
+               "--config_file", str(tmp_path / "none.yaml")])
+    assert rc == 2
+
+
+def test_migrate_config_fsdp(tmp_path, capsys):
+    """migrate-config (the reference to_fsdp2.py conversion role): an FSDP
+    reference yaml becomes dp_shard on our mesh, with offload reported as
+    dropped rather than silently discarded."""
+    import yaml
+
+    src = tmp_path / "ref.yaml"
+    src.write_text(yaml.safe_dump({
+        "compute_environment": "LOCAL_MACHINE",
+        "distributed_type": "FSDP",
+        "mixed_precision": "bf16",
+        "num_processes": 8,
+        "num_machines": 2,
+        "machine_rank": 0,
+        "main_process_ip": "10.0.0.1",
+        "main_process_port": 29500,
+        "fsdp_config": {
+            "fsdp_sharding_strategy": "FULL_SHARD",
+            "fsdp_offload_params": True,
+            "fsdp_auto_wrap_policy": "TRANSFORMER_BASED_WRAP",
+        },
+        "dynamo_config": {"dynamo_backend": "INDUCTOR"},
+    }))
+    out_file = tmp_path / "ours.yaml"
+    rc = main(["migrate-config", str(src), "--output_file", str(out_file)])
+    assert rc == 0
+    report = capsys.readouterr().out
+    assert "FULL_SHARD -> dp_shard" in report
+    assert "fsdp_offload_params" in report and "[dropped]" in report
+    cfg = ClusterConfig.load(str(out_file))
+    assert cfg.dp_shard_size == -1
+    assert cfg.mixed_precision == "bf16"
+    assert cfg.num_processes == 2  # num_machines: one process per TPU host
+    assert cfg.coordinator_address == "10.0.0.1:29500"
+
+
+def test_migrate_config_deepspeed_and_megatron(tmp_path):
+    import yaml
+
+    ds = tmp_path / "ds.yaml"
+    ds.write_text(yaml.safe_dump({
+        "distributed_type": "DEEPSPEED",
+        "deepspeed_config": {"zero_stage": 3, "gradient_accumulation_steps": 4,
+                             "offload_optimizer_device": "cpu"},
+    }))
+    out1 = tmp_path / "ds_ours.yaml"
+    assert main(["migrate-config", str(ds), "--output_file", str(out1)]) == 0
+    cfg = ClusterConfig.load(str(out1))
+    assert cfg.dp_shard_size == -1 and cfg.gradient_accumulation_steps == 4
+
+    mega = tmp_path / "mega.yaml"
+    mega.write_text(yaml.safe_dump({
+        "distributed_type": "MEGATRON_LM",
+        "megatron_lm_config": {
+            "megatron_lm_tp_degree": 2, "megatron_lm_pp_degree": 4,
+            "megatron_lm_num_micro_batches": 8,
+            "megatron_lm_sequence_parallelism": True,
+        },
+    }))
+    out2 = tmp_path / "mega_ours.yaml"
+    assert main(["migrate-config", str(mega), "--output_file", str(out2)]) == 0
+    cfg = ClusterConfig.load(str(out2))
+    assert cfg.tp_size == 2 and cfg.pp_size == 4 and cfg.pp_num_microbatches == 8
+    assert cfg.dp_shard_size == -1
+
+
+def test_migrate_config_refuses_overwrite(tmp_path):
+    import yaml
+
+    src = tmp_path / "ref.yaml"
+    src.write_text(yaml.safe_dump({"distributed_type": "MULTI_GPU"}))
+    out = tmp_path / "ours.yaml"
+    out.write_text("existing")
+    assert main(["migrate-config", str(src), "--output_file", str(out)]) == 2
+    assert main(["migrate-config", str(src), "--output_file", str(out),
+                 "--overwrite"]) == 0
+    cfg = ClusterConfig.load(str(out))
+    assert cfg.dp_replicate_size == -1 and cfg.dp_shard_size == 1
+
+
+def test_migrate_config_legacy_int_strategy_and_auto_stage(tmp_path):
+    """Legacy int-encoded fsdp_sharding_strategy (3=NO_SHARD) must map to DDP
+    replication, not silently become FSDP; deepspeed zero_stage 'auto' must
+    not crash."""
+    import yaml
+
+    src = tmp_path / "legacy.yaml"
+    src.write_text(yaml.safe_dump({
+        "distributed_type": "FSDP",
+        "fsdp_config": {"fsdp_sharding_strategy": 3},
+    }))
+    out = tmp_path / "legacy_ours.yaml"
+    assert main(["migrate-config", str(src), "--output_file", str(out)]) == 0
+    cfg = ClusterConfig.load(str(out))
+    assert cfg.dp_replicate_size == -1 and cfg.dp_shard_size == 1
+
+    auto = tmp_path / "auto.yaml"
+    auto.write_text(yaml.safe_dump({
+        "distributed_type": "DEEPSPEED",
+        "deepspeed_config": {"zero_stage": "auto"},
+    }))
+    out2 = tmp_path / "auto_ours.yaml"
+    assert main(["migrate-config", str(auto), "--output_file", str(out2)]) == 0
+    assert ClusterConfig.load(str(out2)).dp_shard_size == -1
+
+
+def test_migrate_config_reports_stray_plugin_block(tmp_path, capsys):
+    import yaml
+
+    src = tmp_path / "stray.yaml"
+    src.write_text(yaml.safe_dump({
+        "distributed_type": "MULTI_GPU",
+        "fsdp_config": {"fsdp_sharding_strategy": "FULL_SHARD"},
+    }))
+    out = tmp_path / "stray_ours.yaml"
+    assert main(["migrate-config", str(src), "--output_file", str(out)]) == 0
+    report = capsys.readouterr().out
+    assert "fsdp_config: present but distributed_type=MULTI_GPU" in report
+
+
+def test_migrate_config_relative_output_path(tmp_path, monkeypatch):
+    import yaml
+
+    monkeypatch.chdir(tmp_path)
+    (tmp_path / "r.yaml").write_text(yaml.safe_dump({"distributed_type": "NO"}))
+    assert main(["migrate-config", "r.yaml", "--output_file", "out.yaml"]) == 0
+    assert (tmp_path / "out.yaml").exists()
+
+
+def test_default_config_file_resolves_env_lazily(tmp_path, monkeypatch):
+    from accelerate_tpu.commands.config import default_config_file
+
+    monkeypatch.setenv("ACCELERATE_TPU_CONFIG_DIR", str(tmp_path / "late"))
+    assert default_config_file() == str(tmp_path / "late" / "default_config.yaml")
+
+
+def test_migrated_ddp_config_is_launchable(tmp_path, capsys):
+    """A MULTI_GPU migration writes dp_replicate_size=-1; ParallelismConfig
+    must infer it (like dp_shard's -1) so the config drives launch as-is."""
+    import yaml
+
+    from accelerate_tpu.parallelism_config import ParallelismConfig
+
+    src = tmp_path / "ref.yaml"
+    src.write_text(yaml.safe_dump({"distributed_type": "MULTI_GPU"}))
+    out = tmp_path / "ours.yaml"
+    assert main(["migrate-config", str(src), "--output_file", str(out)]) == 0
+    cfg = ClusterConfig.load(str(out))
+    pc = ParallelismConfig(
+        dp_replicate_size=cfg.dp_replicate_size, dp_shard_size=cfg.dp_shard_size
+    )
+    pc._infer_and_validate(8)
+    assert pc.dp_replicate_size == 8 and pc.dp_shard_size == 1
+
+    script = tmp_path / "t.py"
+    script.write_text("pass")
+    capsys.readouterr()
+    rc = main(["launch", "--config_file", str(out), "--dry_run", str(script)])
+    assert rc == 0
+    assert "PARALLELISM_CONFIG_DP_REPLICATE_SIZE=-1" in capsys.readouterr().out
+
+
+def test_parallelism_config_rejects_double_inference():
+    import pytest as _pytest
+
+    from accelerate_tpu.parallelism_config import ParallelismConfig
+
+    pc = ParallelismConfig(dp_replicate_size=-1, dp_shard_size=-1)
+    with _pytest.raises(ValueError, match="only one"):
+        pc._infer_and_validate(8)
+
+
+def test_tpu_config_command_file_appends_to_commands(tmp_path, capsys):
+    cmds = tmp_path / "setup.txt"
+    cmds.write_text("echo from-file")
+    rc = main([
+        "tpu-config", "--debug", "--tpu_name", "p",
+        "--config_file", str(tmp_path / "none.yaml"),
+        "--command", "echo from-flag", "--command_file", str(cmds),
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "echo from-flag" in out and "echo from-file" in out
+    assert out.index("echo from-flag") < out.index("echo from-file")
+
+
+def test_migrate_config_reports_engine_knobs_and_noop_axes(tmp_path, capsys):
+    import yaml
+
+    src = tmp_path / "ref.yaml"
+    src.write_text(yaml.safe_dump({
+        "distributed_type": "DEEPSPEED",
+        "deepspeed_config": {"zero_stage": 3, "zero3_init_flag": True},
+        "parallelism_config": {"tp_size": 1, "cp_size": 2},
+    }))
+    out = tmp_path / "ours.yaml"
+    assert main(["migrate-config", str(src), "--output_file", str(out)]) == 0
+    report = capsys.readouterr().out
+    assert "deepspeed zero3_init_flag" in report
+    assert "parallelism_config.tp_size: unset" not in report  # 1 is a real value
+    assert "parallelism_config.tp_size -> tp_size" in report
+    assert ClusterConfig.load(str(out)).cp_size == 2
+
+
+def test_migrate_config_overwrite_check_before_report(tmp_path, capsys):
+    import yaml
+
+    src = tmp_path / "ref.yaml"
+    src.write_text(yaml.safe_dump({"distributed_type": "NO"}))
+    out = tmp_path / "ours.yaml"
+    out.write_text("existing")
+    assert main(["migrate-config", str(src), "--output_file", str(out)]) == 2
+    printed = capsys.readouterr().out
+    assert "Converted" not in printed  # refusal happens before the report
+
+
+def test_tpu_config_missing_command_file_is_friendly(tmp_path, capsys):
+    rc = main(["tpu-config", "--debug", "--tpu_name", "p",
+               "--config_file", str(tmp_path / "none.yaml"),
+               "--command_file", str(tmp_path / "typo.txt")])
+    assert rc == 2
+    assert "not found" in capsys.readouterr().out
+
+
+def test_migrate_config_silent_on_disabled_flags(tmp_path, capsys):
+    """False-valued stock-config keys (tpu_use_sudo: false, ...) are not
+    feature losses and must not clutter the [dropped] report."""
+    import yaml
+
+    src = tmp_path / "ref.yaml"
+    src.write_text(yaml.safe_dump({
+        "distributed_type": "NO",
+        "tpu_use_sudo": False,
+        "enable_cpu_affinity": False,
+        "downcast_bf16": True,
+    }))
+    out = tmp_path / "ours.yaml"
+    assert main(["migrate-config", str(src), "--output_file", str(out)]) == 0
+    report = capsys.readouterr().out
+    assert "tpu_use_sudo" not in report and "enable_cpu_affinity" not in report
+    assert "downcast_bf16" in report  # actually enabled -> reported
