@@ -143,9 +143,26 @@ def _convert(data: dict) -> tuple[ClusterConfig, list[str], list[str]]:
             if k not in ("fsdp_sharding_strategy", "fsdp_offload_params"):
                 dropped.append(f"{k}: wrapping/prefetch policy — GSPMD shards whole pytrees")
     elif dist == "DEEPSPEED":
-        raw_stage = ds.get("zero_stage", 2)
-        # "auto" defers the stage to the ds_config json; ZeRO-2/3 sharding is
-        # the common case and matches our dp_shard default
+        raw_stage = ds.get("zero_stage")
+        if raw_stage in (None, "auto") and ds.get("deepspeed_config_file"):
+            # with a config file the yaml carries no stage — read the JSON
+            # (best effort) rather than guessing silently
+            try:
+                import json
+
+                with open(ds["deepspeed_config_file"]) as f:
+                    raw_stage = (json.load(f).get("zero_optimization") or {}).get("stage")
+                converted.append(
+                    f"deepspeed_config_file: read zero_stage={raw_stage} from "
+                    f"{ds['deepspeed_config_file']}"
+                )
+            except (OSError, ValueError):
+                dropped.append(
+                    f"deepspeed_config_file {ds['deepspeed_config_file']}: "
+                    "unreadable — assuming ZeRO-2/3 (dp_shard); verify"
+                )
+        # "auto"/absent defers the stage; ZeRO-2/3 sharding is the common
+        # case and matches our dp_shard default
         stage = 2 if raw_stage in (None, "auto") else int(raw_stage)
         if stage >= 2:
             cfg.dp_shard_size = -1
@@ -164,7 +181,7 @@ def _convert(data: dict) -> tuple[ClusterConfig, list[str], list[str]]:
                 dropped.append(f"deepspeed {k}: HBM-resident sharded state; use a bigger mesh instead")
         _ds_known = ("zero_stage", "gradient_accumulation_steps",
                      "gradient_clipping", "offload_optimizer_device",
-                     "offload_param_device")
+                     "offload_param_device", "deepspeed_config_file")
         for k in ds:
             if k not in _ds_known:
                 dropped.append(f"deepspeed {k}: engine-specific knob — no GSPMD meaning")
@@ -214,7 +231,11 @@ def _convert(data: dict) -> tuple[ClusterConfig, list[str], list[str]]:
         "ep_size": "ep_size",
     }
     for k, v in pc.items():
-        key = k if k.endswith("_size") else f"{k}_size"
+        # real `accelerate config` yamls prefix every key in this block with
+        # parallelism_config_ (reference cluster.py:522); torchtitan-style
+        # blocks use bare names — accept both
+        bare = k.removeprefix("parallelism_config_")
+        key = bare if bare.endswith("_size") else f"{bare}_size"
         if key not in axis_map:
             dropped.append(f"parallelism_config.{k}: unknown axis")
         elif v in (None, 0):

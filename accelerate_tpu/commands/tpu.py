@@ -35,10 +35,12 @@ def tpu_config_command(args, extra) -> int:
         if not args.tpu_zone:
             args.tpu_zone = cfg.tpu_zone
         if not args.command and not args.command_file:
-            if cfg.commands:
-                args.command = [cfg.commands]
-            elif cfg.command_file:
+            # reference default precedence: a configured command_file wins
+            # over the configured commands list (tpu.py:126-131)
+            if cfg.command_file:
                 args.command_file = cfg.command_file
+            elif cfg.commands:
+                args.command = [cfg.commands]
 
     if not args.tpu_name:
         print("error: no TPU name (pass --tpu_name or set tpu_name in the config)")
@@ -48,8 +50,10 @@ def tpu_config_command(args, extra) -> int:
               "commands in the config)")
         return 2
 
-    # argparse nargs="+" + action="append" yields a list of lists; a command
-    # file APPENDS to any --command flags (reference tpu.py behavior)
+    # argparse nargs="+" + action="append" yields a list of lists. Deliberate
+    # divergence from the reference (its tpu.py:114-116 silently REPLACES
+    # --command flags with the file contents): here a command file appends
+    # after the flags, so nothing the user typed is discarded.
     commands: list[str] = []
     for entry in args.command or []:
         if isinstance(entry, (list, tuple)):

@@ -1,0 +1,98 @@
+"""Token-weighted gradient accumulation for causal LMs (reference
+examples/by_feature/gradient_accumulation_for_autoregressive_models.py).
+
+The trap this example exists for: with masked next-token CE, per-microbatch
+*mean* losses weight microbatches unevenly — a microbatch with 10 valid
+tokens pulls as hard as one with 1000. Correct accumulation divides each
+microbatch's nll SUM by the total valid-token count of the WHOLE
+accumulation window (the reference reaches the same place by scaling
+`loss * gradient_accumulation_steps` against transformers'
+num_items_in_batch pre-division, its lines 219-251).
+
+Here the window denominator is computed from the loss masks of the next k
+batches (the C++ padded collate emits them for ragged documents —
+csrc/packing.cpp) and carried in the batch; the loss multiplies by k to
+cancel the harness's 1/k gradient averaging. The printed check: the summed
+window loss equals the one-shot loss over the concatenated window, which a
+per-microbatch-mean loop gets wrong.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+import jax.numpy as jnp
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.data_loader import make_padded_collate
+from accelerate_tpu.models.llama import LlamaConfig, create_llama, llama_loss
+
+
+def ragged_documents(n_docs: int, vocab: int, max_len: int, seed=0):
+    """Variable-length 'SFT' documents: ragged token lists the padded collate
+    turns into (input_ids, loss_mask) rows."""
+    rng = np.random.default_rng(seed)
+    return [
+        rng.integers(4, vocab, size=rng.integers(4, max_len)).astype(np.int32)
+        for _ in range(n_docs)
+    ]
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--gradient_accumulation_steps", type=int, default=4)
+    parser.add_argument("--batch_size", type=int, default=8)
+    parser.add_argument("--steps", type=int, default=2)
+    args = parser.parse_args()
+    k = args.gradient_accumulation_steps
+
+    accelerator = Accelerator(gradient_accumulation_steps=k)
+    cfg = LlamaConfig.tiny(compute_dtype=jnp.float32)
+    model, optimizer = accelerator.prepare(create_llama(cfg), optax.adamw(1e-3))
+
+    docs = ragged_documents(args.batch_size * k * args.steps, cfg.vocab_size, 32)
+    collate = make_padded_collate(max_length=32)  # fixed shape: no recompiles
+    loader = accelerator.prepare_data_loader(
+        docs, batch_size=args.batch_size, collate_fn=collate, drop_last=True
+    )
+
+    def window_loss(view, batch):
+        # nll SUM over the microbatch / valid tokens in the WHOLE window,
+        # times k to cancel the 1/k the accumulation harness applies
+        mean = llama_loss(view, batch)
+        # llama_loss = sum/count for THIS microbatch; rescale to window
+        labels = batch["input_ids"][:, 1:]
+        mask = batch["loss_mask"][:, : labels.shape[1]].astype(jnp.float32)
+        count = jnp.maximum(mask.sum(), 1)
+        return mean * count / batch["window_tokens"] * k
+
+    batches = list(loader)
+    for step in range(args.steps):
+        window = batches[step * k : (step + 1) * k]
+        # total valid targets across the window, using the SAME mask slice
+        # the per-microbatch loss uses (mask[:, :labels.shape[1]] = [:, :-1])
+        # so the window sum exactly equals the one-shot concatenated loss
+        window_tokens = float(
+            sum(np.asarray(b["loss_mask"])[:, :-1].sum() for b in window)
+        )
+        total = 0.0
+        for micro in window:
+            micro = dict(micro, window_tokens=np.float32(window_tokens))
+            with accelerator.accumulate(model):
+                loss = accelerator.backward(window_loss, micro)
+                optimizer.step()
+                optimizer.zero_grad()
+            total += float(loss) / k  # undo the *k for reporting
+        accelerator.print(
+            f"step {step}: window tokens={int(window_tokens)} "
+            f"token-weighted loss={total:.4f} "
+            f"(a per-microbatch-mean loop would weight {len(window)} ragged "
+            f"microbatches equally)"
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -485,3 +485,43 @@ def test_migrate_config_silent_on_disabled_flags(tmp_path, capsys):
     report = capsys.readouterr().out
     assert "tpu_use_sudo" not in report and "enable_cpu_affinity" not in report
     assert "downcast_bf16" in report  # actually enabled -> reported
+
+
+def test_migrate_config_prefixed_parallelism_keys(tmp_path):
+    """Real `accelerate config` yamls prefix block keys with
+    parallelism_config_ (reference cluster.py:522) — both spellings map."""
+    import yaml
+
+    src = tmp_path / "ref.yaml"
+    src.write_text(yaml.safe_dump({
+        "distributed_type": "MULTI_GPU",
+        "parallelism_config": {
+            "parallelism_config_dp_shard_size": 4,
+            "parallelism_config_tp_size": 2,
+        },
+    }))
+    out = tmp_path / "ours.yaml"
+    assert main(["migrate-config", str(src), "--output_file", str(out)]) == 0
+    cfg = ClusterConfig.load(str(out))
+    assert cfg.dp_shard_size == 4 and cfg.tp_size == 2
+
+
+def test_migrate_config_reads_ds_config_file(tmp_path, capsys):
+    import json
+
+    import yaml
+
+    ds_json = tmp_path / "ds_config.json"
+    ds_json.write_text(json.dumps({"zero_optimization": {"stage": 1}}))
+    src = tmp_path / "ref.yaml"
+    src.write_text(yaml.safe_dump({
+        "distributed_type": "DEEPSPEED",
+        "deepspeed_config": {"deepspeed_config_file": str(ds_json)},
+    }))
+    out = tmp_path / "ours.yaml"
+    assert main(["migrate-config", str(src), "--output_file", str(out)]) == 0
+    report = capsys.readouterr().out
+    assert "read zero_stage=1" in report
+    cfg = ClusterConfig.load(str(out))
+    # stage 1 = replication, not sharding
+    assert cfg.dp_replicate_size == -1 and cfg.dp_shard_size == 1

@@ -147,3 +147,21 @@ def test_gpt_pretraining_example():
     )
     assert result.returncode == 0, result.stderr[-2000:]
     assert "tok/s" in result.stdout
+
+
+@pytest.mark.slow
+def test_autoregressive_grad_accum_example():
+    result = _run(
+        "by_feature/gradient_accumulation_for_autoregressive_models.py",
+        "--steps", "2",
+    )
+    assert result.returncode == 0, result.stderr[-2000:]
+    assert "token-weighted loss" in result.stdout
+
+
+@pytest.mark.slow
+def test_reference_config_training_example():
+    result = _run("by_feature/reference_config_training.py", "--steps", "2")
+    assert result.returncode == 0, result.stderr[-2000:]
+    assert "zero_stage=3 -> dp_shard" in result.stdout
+    assert "final loss" in result.stdout
