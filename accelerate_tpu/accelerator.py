@@ -683,6 +683,16 @@ class Accelerator:
                         "the 1f1b schedule expects a single dict batch — use "
                         "schedule='gpipe' for other batch layouts"
                     )
+                if "segment_ids" in batch[0] or "position_ids" in batch[0]:
+                    # the pipeline_parts stage contract carries only hidden
+                    # states between stages; packed-batch metadata would be
+                    # silently dropped (contaminated attention, unreset
+                    # positions) — fail instead
+                    raise ValueError(
+                        "packed batches (segment_ids/position_ids) are not "
+                        "supported by the 1f1b pipeline schedule — unpack "
+                        "the batch or train packed data without pp"
+                    )
                 stage_params = params["layers"]
                 io_params = {kk: v for kk, v in params.items() if kk != "layers"}
                 loss, g_stage, g_io = pipeline_vag(

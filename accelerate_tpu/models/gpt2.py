@@ -143,6 +143,7 @@ def init_gpt2_params(config: GPT2Config, key: jax.Array) -> dict:
 def _gpt2_layer(
     config: GPT2Config, lp, x, position_offset: int = 0,
     attention_fn: Optional[Any] = None, collect_kv: bool = False,
+    segment_ids: Optional[Any] = None,
 ):
     cdt = config.compute_dtype
     b, s, d = x.shape
@@ -153,11 +154,17 @@ def _gpt2_layer(
     k = _apply_dense(lp["attn"]["c_attn_k"], y, cdt).reshape(b, s, h, hd)
     v = _apply_dense(lp["attn"]["c_attn_v"], y, cdt).reshape(b, s, h, hd)
     if attention_fn is not None:  # mesh-aware CP/SP attention from prepare()
+        if segment_ids is not None:
+            raise ValueError(
+                "segment_ids cannot compose with a mesh-injected "
+                "attention_fn (CP/SP) — see models/llama.py _attention"
+            )
         attn = attention_fn(q, k, v, causal=True)
     else:
         attn = dispatch_attention(
             config.attention_impl, q, k, v, causal=True, q_offset=position_offset,
             kv_block=config.attention_kv_block, block_q=config.attention_block_q,
+            segment_ids=segment_ids,
         )
     attn = _apply_dense(lp["attn"]["c_proj"], attn.reshape(b, s, d), cdt)
     attn = checkpoint_name(attn, "attn_block_out")  # saved under remat "minimal"
@@ -181,6 +188,8 @@ def gpt2_apply(
     position_offset: int = 0,
     attention_fn: Optional[Any] = None,
     layer_stack_fn: Optional[Any] = None,
+    segment_ids: Optional[Any] = None,
+    position_ids: Optional[Any] = None,
 ):
     """(B, S) int tokens → (B, S, V) fp32 logits, or the chunked-CE protocol
     dict {"hidden", "head_kernel"} when ``config.use_chunked_ce`` (the head is
@@ -197,11 +206,17 @@ def gpt2_apply(
         )
     table = replicate_over_fsdp(params["wte"]["embedding"], keep_tp=False)
     x = table.astype(cdt)[input_ids]
-    pos = jnp.arange(s) + position_offset
-    x = constrain_activation(x + params["wpe"]["embedding"].astype(cdt)[pos][None])
+    wpe = params["wpe"]["embedding"].astype(cdt)
+    if position_ids is not None:
+        # packed rows: learned positions restart at each document
+        x = constrain_activation(x + wpe[position_ids])
+    else:
+        pos = jnp.arange(s) + position_offset
+        x = constrain_activation(x + wpe[pos][None])
 
     layer_fn = functools.partial(
-        _gpt2_layer, config, position_offset=position_offset, attention_fn=attention_fn
+        _gpt2_layer, config, position_offset=position_offset,
+        attention_fn=attention_fn, segment_ids=segment_ids,
     )
     if config.remat_policy != "full":
         layer_fn = jax.checkpoint(layer_fn, policy=_remat_policy(config.remat_policy))

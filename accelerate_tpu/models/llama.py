@@ -186,13 +186,20 @@ def _rope_tables(seq_len: int, head_dim: int, theta: float):
     return np.cos(angles).astype(np.float32), np.sin(angles).astype(np.float32)
 
 
-def apply_rope(x: jax.Array, position_offset: int, theta: float) -> jax.Array:
+def apply_rope(x: jax.Array, position_offset: int, theta: float,
+               position_ids=None) -> jax.Array:
     """Rotary embedding on (B, S, H, D); ``position_offset`` supports CP/SP
-    shards that start mid-sequence."""
+    shards that start mid-sequence. ``position_ids`` (B, S) overrides with
+    per-token positions (packed rows restart at each document —
+    utils/native.packed_position_ids)."""
     b, s, h, d = x.shape
     cos_np, sin_np = _rope_tables(s + position_offset, d, theta)
-    cos = jnp.asarray(cos_np[position_offset : position_offset + s])[None, :, None, :]
-    sin = jnp.asarray(sin_np[position_offset : position_offset + s])[None, :, None, :]
+    if position_ids is not None:
+        cos = jnp.asarray(cos_np)[position_ids][:, :, None, :]  # (B, S, 1, hd/2)
+        sin = jnp.asarray(sin_np)[position_ids][:, :, None, :]
+    else:
+        cos = jnp.asarray(cos_np[position_offset : position_offset + s])[None, :, None, :]
+        sin = jnp.asarray(sin_np[position_offset : position_offset + s])[None, :, None, :]
     x1, x2 = x[..., 0::2], x[..., 1::2]
     y1 = x1 * cos - x2 * sin
     y2 = x2 * cos + x1 * sin
@@ -223,14 +230,23 @@ def _dot(config: LlamaConfig, x, w):
     return x @ w
 
 
-def _attention(config: LlamaConfig, q, k, v, attention_fn=None, q_offset: int = 0):
+def _attention(config: LlamaConfig, q, k, v, attention_fn=None, q_offset: int = 0,
+               segment_ids=None):
     if attention_fn is not None:
+        if segment_ids is not None:
+            raise ValueError(
+                "segment_ids (packed sequences) cannot compose with a "
+                "mesh-injected attention_fn (CP/SP): document boundaries "
+                "would need resharding with the sequence — unpack the batch "
+                "or drop cp/sp for packed training"
+            )
         return attention_fn(q, k, v, causal=True)
     from ..ops.attention import dispatch_attention
 
     return dispatch_attention(
         config.attention_impl, q, k, v, causal=True, q_offset=q_offset,
         kv_block=config.attention_kv_block, block_q=config.attention_block_q,
+        segment_ids=segment_ids,
     )
 
 
@@ -241,6 +257,8 @@ def _layer(
     position_offset: int,
     attention_fn,
     collect_kv: bool = False,
+    segment_ids=None,
+    position_ids=None,
 ):
     """One transformer block on (B, S, D) activations. ``collect_kv=True``
     additionally returns the (post-RoPE) k/v for prefill cache building."""
@@ -253,10 +271,13 @@ def _layer(
     q = _dot(config, y, layer_params["attn"]["q_proj"]["kernel"].astype(cdt)).reshape(b, s, h, hd)
     k = _dot(config, y, layer_params["attn"]["k_proj"]["kernel"].astype(cdt)).reshape(b, s, kvh, hd)
     v = _dot(config, y, layer_params["attn"]["v_proj"]["kernel"].astype(cdt)).reshape(b, s, kvh, hd)
-    q = apply_rope(q, position_offset, config.rope_theta)
-    k = apply_rope(k, position_offset, config.rope_theta)
+    q = apply_rope(q, position_offset, config.rope_theta, position_ids)
+    k = apply_rope(k, position_offset, config.rope_theta, position_ids)
     kv_out = (k, v) if collect_kv else None
-    attn = _attention(config, q, k, v, attention_fn, q_offset=position_offset)
+    attn = _attention(
+        config, q, k, v, attention_fn, q_offset=position_offset,
+        segment_ids=segment_ids,
+    )
     attn = _dot(config, attn.reshape(b, s, h * hd), layer_params["attn"]["o_proj"]["kernel"].astype(cdt))
     attn = checkpoint_name(attn, "attn_block_out")
     x = constrain_activation(residual + attn)
@@ -297,8 +318,16 @@ def llama_apply(
     attention_fn: Optional[Callable] = None,
     layer_stack_fn: Optional[Callable] = None,
     return_aux: bool = False,
+    segment_ids: Optional[jax.Array] = None,
+    position_ids: Optional[jax.Array] = None,
 ):
     """Forward: (B, S) int tokens → (B, S, V) float32 logits.
+
+    ``segment_ids`` (B, S) int32: packed-sequence document labels — attention
+    never crosses a boundary (ops/flash_attention segment masking; llama_loss
+    forwards ``batch["segment_ids"]`` automatically). ``position_ids``
+    (B, S) int32: per-token RoPE positions (restart at packed-document
+    starts — utils/native.packed_position_ids).
 
     ``return_aux=True`` additionally returns {"aux_loss": scalar} (MoE
     load-balancing loss summed over layers). ``layer_stack_fn`` overrides how
@@ -311,7 +340,9 @@ def llama_apply(
     x = constrain_activation(table.astype(cdt)[input_ids])
 
     layer_fn = functools.partial(
-        _layer, config, position_offset=position_offset, attention_fn=attention_fn
+        _layer, config, position_offset=position_offset,
+        attention_fn=attention_fn, segment_ids=segment_ids,
+        position_ids=position_ids,
     )
     policy = _remat_policy(config.remat_policy)
     if config.remat_policy != "full":
@@ -430,13 +461,17 @@ def llama_ce_denominator(batch):
 
 def llama_loss(model_view, batch, ce_chunk_size: int = 4096):
     """Next-token cross entropy; ``batch = {"input_ids": (B,S)}`` with
-    optional ``"labels"`` (defaults to shifted input_ids) and
-    ``"loss_mask"``. MoE models fold the load-balancing aux loss in. With
-    ``config.use_chunked_ce`` the head matmul fuses into the CE reduction
-    (ops/losses.py) and full logits never materialize (``ce_chunk_size``
-    vocab slices; static)."""
+    optional ``"labels"`` (defaults to shifted input_ids), ``"loss_mask"``,
+    and ``"segment_ids"`` (packed-sequence document labels — forwarded to
+    the model so attention never crosses a document boundary). MoE models
+    fold the load-balancing aux loss in. With ``config.use_chunked_ce`` the
+    head matmul fuses into the CE reduction (ops/losses.py) and full logits
+    never materialize (``ce_chunk_size`` vocab slices; static)."""
     input_ids = batch["input_ids"]
-    out = model_view(input_ids)
+    packed_kwargs = {
+        kk: batch[kk] for kk in ("segment_ids", "position_ids") if kk in batch
+    }
+    out = model_view(input_ids, **packed_kwargs)
     labels = batch.get("labels")
     mask = batch.get("loss_mask")
     if isinstance(out, dict) and "hidden" in out:

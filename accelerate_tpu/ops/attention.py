@@ -63,6 +63,7 @@ def dot_product_attention(
     q_offset: int = 0,
     kv_offset: int = 0,
     softmax_dtype=jnp.float32,
+    segment_ids: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Reference attention, fully materialized scores. XLA fuses this well for
     moderate sequence lengths; use the Pallas flash kernel (ops/flash_attention)
@@ -78,6 +79,9 @@ def dot_product_attention(
         scores = scores + mask[None, None, :, :]
     if bias is not None:
         scores = scores + bias
+    if segment_ids is not None:
+        same = segment_ids[:, :, None] == segment_ids[:, None, :]  # (b, sq, sk)
+        scores = jnp.where(same[:, None], scores, NEG_INF)
     weights = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", weights.astype(v.dtype), v)
     return out
@@ -93,6 +97,7 @@ def dispatch_attention(
     q_offset: int = 0,
     kv_block: int = 512,
     block_q: int = 2048,
+    segment_ids: Optional[jax.Array] = None,
 ):
     """Select the attention implementation by name — the shared entry every
     causal-LM family (llama, gpt2, ...) routes through. ``impl``: "flash" |
@@ -107,12 +112,18 @@ def dispatch_attention(
     if impl == "flash" and q_offset == 0 and causal:
         from .flash_attention import flash_attention
 
-        return flash_attention(q, k, v, causal=True, block_q=block_q, block_k=kv_block)
+        return flash_attention(
+            q, k, v, causal=True, segment_ids=segment_ids,
+            block_q=block_q, block_k=kv_block,
+        )
     if impl in ("blockwise", "flash"):
         return blockwise_attention(
-            q, k, v, causal=causal, kv_block=kv_block, q_offset=q_offset
+            q, k, v, causal=causal, kv_block=kv_block, q_offset=q_offset,
+            segment_ids=segment_ids,
         )
-    return dot_product_attention(q, k, v, causal=causal, q_offset=q_offset)
+    return dot_product_attention(
+        q, k, v, causal=causal, q_offset=q_offset, segment_ids=segment_ids
+    )
 
 
 def _attend_block(q, k, v, bias):
@@ -157,7 +168,8 @@ def finalize_blocks(out, m, l):
 
 
 def blockwise_attention(
-    q, k, v, *, causal: bool = True, kv_block: int = 512, q_offset: int = 0
+    q, k, v, *, causal: bool = True, kv_block: int = 512, q_offset: int = 0,
+    segment_ids: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Memory-efficient attention: iterate KV blocks with online softmax —
     the same math the ring-attention CP path runs across chips
@@ -175,17 +187,33 @@ def blockwise_attention(
         v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
     k = k.reshape(b, num_blocks, kv_block, h, d)
     v = v.reshape(b, num_blocks, kv_block, h, d)
+    seg_blocks = None
+    if segment_ids is not None:
+        # padding gets segment -1 (matches no real token; the kv_pos bias
+        # already excludes it — this keeps the mask construction total)
+        segs = segment_ids.astype(jnp.int32)
+        if pad:
+            segs = jnp.pad(segs, ((0, 0), (0, pad)), constant_values=-1)
+        seg_blocks = segs.reshape(b, num_blocks, kv_block)
 
     def body(carry, blk):
         out, m, l = carry
-        k_blk, v_blk, idx = blk
+        if segment_ids is not None:
+            k_blk, v_blk, seg_blk, idx = blk
+        else:
+            k_blk, v_blk, idx = blk
+            seg_blk = None
         kv_start = idx * kv_block
         q_pos = lax.broadcasted_iota(jnp.int32, (sq, kv_block), 0) + q_offset
         kv_pos = lax.broadcasted_iota(jnp.int32, (sq, kv_block), 1) + kv_start
         bias = jnp.where(kv_pos < skv, 0.0, NEG_INF)
         if causal:
             bias = jnp.where(q_pos >= kv_pos, bias, NEG_INF)
-        o_b, m_b, l_b = _attend_block(q, k_blk, v_blk, bias[None, None])
+        bias = bias[None, None]
+        if seg_blk is not None:
+            same = segment_ids[:, :, None] == seg_blk[:, None, :]  # (b, sq, bk)
+            bias = jnp.where(same[:, None], bias, NEG_INF)
+        o_b, m_b, l_b = _attend_block(q, k_blk, v_blk, bias)
         return combine_blocks(out, m, l, o_b, m_b, l_b), None
 
     init = (
@@ -202,7 +230,8 @@ def blockwise_attention(
     # NaNs dq/dk whenever a positional bias touches the scores inside the
     # body (observed on v5e even with a numerically all-zero bias; the
     # fused transpose is at fault, not the math — a bias-free body is clean).
-    (out, m, l), _ = lax.scan(
-        jax.checkpoint(body), init, (k_t, v_t, jnp.arange(num_blocks))
-    )
+    xs = (k_t, v_t, jnp.arange(num_blocks))
+    if seg_blocks is not None:
+        xs = (k_t, v_t, jnp.moveaxis(seg_blocks, 1, 0), jnp.arange(num_blocks))
+    (out, m, l), _ = lax.scan(jax.checkpoint(body), init, xs)
     return finalize_blocks(out, m, l)

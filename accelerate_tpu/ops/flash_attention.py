@@ -5,6 +5,19 @@ online-softmax attention that never materializes the (S, S) score matrix in
 HBM. Layout (B, S, H, D) → kernels run per (batch·head) on (block_q, D) ×
 (block_k, D) tiles living in VMEM, with the MXU doing qk^T and pv.
 
+GQA is native: KV stays at (B·H_kv, S, D) in HBM and every q head of a
+group reads the SAME kv block via the BlockSpec index map — no
+``repeat_kv`` materialization (an n_rep× KV bandwidth/memory saving; the
+XLA fallbacks in ops/attention.py still repeat). The dk/dv kernel
+accumulates a kv head's gradient across its n_rep q heads inside VMEM by
+folding the q-head loop into the innermost grid dimension.
+
+Packed sequences are first-class: optional per-token ``segment_ids``
+(B, S) mask cross-document attention inside one row — the layout the C++
+padded/packed collate produces. Tokens attend only within their segment
+(∧ causal). The reference has no analogue (torch SDPA has no segment
+support; HF packs with cross-contamination or FlashAttention-2 varlen).
+
 Backward uses the standard recompute formulation (Dao et al.): the forward
 saves only out and the per-row logsumexp L; dq and dk/dv kernels recompute
 p = exp(qk - L) per tile. Set ``interpret=True`` (or run under
@@ -22,7 +35,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
 
-from .attention import NEG_INF, repeat_kv
+from .attention import NEG_INF
 
 __all__ = ["flash_attention"]
 
@@ -45,8 +58,25 @@ def _pick_block(s: int, preferred: int) -> int:
     return max(b, 1)
 
 
+def _mask_scores(s, i, j, q_seg, k_seg, causal, block_q, block_k):
+    """Apply causal and/or segment visibility to a (block_q, block_k) score
+    tile. ``q_seg``/``k_seg`` are (block,) int32 rows or None."""
+    if causal:
+        q_pos = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + i * block_q
+        k_pos = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + j * block_k
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    if q_seg is not None:
+        s = jnp.where(q_seg[:, None] == k_seg[None, :], s, NEG_INF)
+    return s
+
+
 # ---------------------------------------------------------------- forward
-def _fwd_kernel(q_ref, k_ref, v_ref, out_ref, lse_ref, acc_ref, m_ref, l_ref, *, causal, block_q, block_k, scale):
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest, causal, block_q, block_k, scale,
+                segmented):
+    if segmented:
+        qseg_ref, kseg_ref, out_ref, lse_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        out_ref, lse_ref, acc_ref, m_ref, l_ref = rest
     i = pl.program_id(1)  # q block
     j = pl.program_id(2)  # kv block
     nk = pl.num_programs(2)
@@ -69,10 +99,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, out_ref, lse_ref, acc_ref, m_ref, l_ref, *,
         v = v_ref[0]
 
         s = _dot_f32(q, k, transpose_b=True) * scale  # (bq, bk), f32 acc
-        if causal:
-            q_pos = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + i * block_q
-            k_pos = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + j * block_k
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        q_seg = qseg_ref[0, 0] if segmented else None
+        k_seg = kseg_ref[0, 0] if segmented else None
+        s = _mask_scores(s, i, j, q_seg, k_seg, causal, block_q, block_k)
 
         m_prev = m_ref[:, 0]
         l_prev = l_ref[:, 0]
@@ -91,7 +120,20 @@ def _fwd_kernel(q_ref, k_ref, v_ref, out_ref, lse_ref, acc_ref, m_ref, l_ref, *,
         lse_ref[0, 0] = m_ref[:, 0] + jnp.log(jnp.maximum(l, 1e-30))
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+def _kv_index(b, h, h_kv):
+    """Merged q index (batch·h + q_head) → merged kv index for its group."""
+    n_rep = h // h_kv
+    if n_rep == 1:
+        return b
+    return (b // h) * h_kv + (b % h) // n_rep
+
+
+def _seg_index(b, h):
+    """Merged q index → batch index (segments are per batch row, not head)."""
+    return b // h
+
+
+def _flash_fwd(q, k, v, segs, h, h_kv, causal, block_q, block_k, interpret):
     from jax.experimental.pallas import tpu as pltpu
 
     bh, s, d = q.shape
@@ -99,16 +141,29 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
     nq = s // block_q
     nk = s // block_k
     grid = (bh, nq, nk)
+    segmented = segs is not None
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d),
+                     lambda b, i, j: (_kv_index(b, h, h_kv), j, 0)),
+        pl.BlockSpec((1, block_k, d),
+                     lambda b, i, j: (_kv_index(b, h, h_kv), j, 0)),
+    ]
+    args = [q, k, v]
+    if segmented:
+        # (B, 1, S) int32; same lane-major layout trick as lse below
+        in_specs += [
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (_seg_index(b, h), 0, i)),
+            pl.BlockSpec((1, 1, block_k), lambda b, i, j: (_seg_index(b, h), 0, j)),
+        ]
+        args += [segs, segs]
     out, lse = pl.pallas_call(
         functools.partial(
-            _fwd_kernel, causal=causal, block_q=block_q, block_k=block_k, scale=scale
+            _fwd_kernel, causal=causal, block_q=block_q, block_k=block_k,
+            scale=scale, segmented=segmented,
         ),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             # lse rides a (bh, 1, s) layout: a (1, 1, block_q) block keeps the
@@ -127,12 +182,17 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
             pltpu.VMEM((block_q, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v)
+    )(*args)
     return out, lse
 
 
 # ---------------------------------------------------------------- backward
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc, *, causal, block_q, block_k, scale):
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+                   causal, block_q, block_k, scale, segmented):
+    if segmented:
+        qseg_ref, kseg_ref, dq_ref, dq_acc = rest
+    else:
+        dq_ref, dq_acc = rest
     i = pl.program_id(1)
     j = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -153,10 +213,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_a
         delta = delta_ref[0, 0]
 
         s = _dot_f32(q, k, transpose_b=True) * scale
-        if causal:
-            q_pos = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + i * block_q
-            k_pos = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + j * block_k
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        q_seg = qseg_ref[0, 0] if segmented else None
+        k_seg = kseg_ref[0, 0] if segmented else None
+        s = _mask_scores(s, i, j, q_seg, k_seg, causal, block_q, block_k)
         p = jnp.exp(s - lse[:, None])
         dp = _dot_f32(do, v, transpose_b=True)
         ds = p * (dp - delta[:, None])
@@ -167,12 +226,21 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_a
         dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc, *, causal, block_q, block_k, scale):
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+                    causal, block_q, block_k, scale, segmented, nq):
+    """Grid (B·H_kv, nk, nq·n_rep): the innermost dim walks every (q block,
+    q head-in-group) pair while the dk/dv output block stays put, so a kv
+    head's gradient accumulates across its whole GQA group in VMEM."""
+    if segmented:
+        qseg_ref, kseg_ref, dk_ref, dv_ref, dk_acc, dv_acc = rest
+    else:
+        dk_ref, dv_ref, dk_acc, dv_acc = rest
     j = pl.program_id(1)  # kv block
-    i = pl.program_id(2)  # q block
-    nq = pl.num_programs(2)
+    t = pl.program_id(2)  # (q head in group) · nq + (q block)
+    nt = pl.num_programs(2)
+    i = t % nq  # q row block — causal visibility depends on it, not the head
 
-    @pl.when(i == 0)
+    @pl.when(t == 0)
     def _init():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
@@ -189,10 +257,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
         delta = delta_ref[0, 0]
 
         s = _dot_f32(q, k, transpose_b=True) * scale  # (bq, bk)
-        if causal:
-            q_pos = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + i * block_q
-            k_pos = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + j * block_k
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        q_seg = qseg_ref[0, 0] if segmented else None
+        k_seg = kseg_ref[0, 0] if segmented else None
+        s = _mask_scores(s, i, j, q_seg, k_seg, causal, block_q, block_k)
         p = jnp.exp(s - lse[:, None])
         p_lo = p.astype(do.dtype)
         dv_acc[:] = dv_acc[:] + _dot_f32(p_lo.T, do)
@@ -200,87 +267,120 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
         ds = p * (dp - delta[:, None])
         dk_acc[:] = dk_acc[:] + _dot_f32(ds.astype(q.dtype).T, q) * scale
 
-    @pl.when(i == nq - 1)
+    @pl.when(t == nt - 1)
     def _finalize():
         dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd(q, k, v, out, lse, do, causal, block_q, block_k, interpret):
+def _flash_bwd(q, k, v, segs, out, lse, do, h, h_kv, causal, block_q, block_k,
+               interpret):
     from jax.experimental.pallas import tpu as pltpu
 
     bh, s, d = q.shape
+    bh_kv = k.shape[0]
+    n_rep = h // h_kv
     scale = 1.0 / math.sqrt(d)
+    segmented = segs is not None
     # (bh, 1, s): same lane-major layout as lse (see _flash_fwd out_specs)
     delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32), axis=-1)[:, None, :]
     nq = s // block_q
     nk = s // block_k
 
+    dq_in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (_kv_index(b, h, h_kv), j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (_kv_index(b, h, h_kv), j, 0)),
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+        pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+    ]
+    dq_args = [q, k, v, do, lse, delta]
+    if segmented:
+        dq_in_specs += [
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (_seg_index(b, h), 0, i)),
+            pl.BlockSpec((1, 1, block_k), lambda b, i, j: (_seg_index(b, h), 0, j)),
+        ]
+        dq_args += [segs, segs]
     dq = pl.pallas_call(
         functools.partial(
-            _bwd_dq_kernel, causal=causal, block_q=block_q, block_k=block_k, scale=scale
+            _bwd_dq_kernel, causal=causal, block_q=block_q, block_k=block_k,
+            scale=scale, segmented=segmented,
         ),
         grid=(bh, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
-            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
-        ],
+        in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(*dq_args)
 
+    # merged q index for (kv-merged index g, inner step t): the group's
+    # (t // nq)-th q head
+    def q_index(g, t):
+        if n_rep == 1:
+            return g
+        return (g // h_kv) * h + (g % h_kv) * n_rep + t // nq
+
+    dkv_in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda g, j, t: (q_index(g, t), t % nq, 0)),
+        pl.BlockSpec((1, block_k, d), lambda g, j, t: (g, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda g, j, t: (g, j, 0)),
+        pl.BlockSpec((1, block_q, d), lambda g, j, t: (q_index(g, t), t % nq, 0)),
+        pl.BlockSpec((1, 1, block_q), lambda g, j, t: (q_index(g, t), 0, t % nq)),
+        pl.BlockSpec((1, 1, block_q), lambda g, j, t: (q_index(g, t), 0, t % nq)),
+    ]
+    dkv_args = [q, k, v, do, lse, delta]
+    if segmented:
+        dkv_in_specs += [
+            pl.BlockSpec((1, 1, block_q),
+                         lambda g, j, t: (g // h_kv, 0, t % nq)),
+            pl.BlockSpec((1, 1, block_k), lambda g, j, t: (g // h_kv, 0, j)),
+        ]
+        dkv_args += [segs, segs]
     dk, dv = pl.pallas_call(
         functools.partial(
-            _bwd_dkv_kernel, causal=causal, block_q=block_q, block_k=block_k, scale=scale
+            _bwd_dkv_kernel, causal=causal, block_q=block_q, block_k=block_k,
+            scale=scale, segmented=segmented, nq=nq,
         ),
-        grid=(bh, nk, nq),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),
-            pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),
-        ],
+        grid=(bh_kv, nk, nq * n_rep),
+        in_specs=dkv_in_specs,
         out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda g, j, t: (g, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda g, j, t: (g, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, s, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, s, d), v.dtype),
+            jax.ShapeDtypeStruct((bh_kv, s, d), k.dtype),
+            jax.ShapeDtypeStruct((bh_kv, s, d), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(*dkv_args)
     return dq, dk, dv
 
 
 # ---------------------------------------------------------------- public op
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_core(q, k, v, causal, block_q, block_k, interpret):
-    out, _ = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash_core(q, k, v, segs, h, h_kv, causal, block_q, block_k, interpret):
+    out, _ = _flash_fwd(q, k, v, segs, h, h_kv, causal, block_q, block_k, interpret)
     return out
 
 
-def _flash_core_fwd(q, k, v, causal, block_q, block_k, interpret):
-    out, lse = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
-    return out, (q, k, v, out, lse)
+def _flash_core_fwd(q, k, v, segs, h, h_kv, causal, block_q, block_k, interpret):
+    out, lse = _flash_fwd(q, k, v, segs, h, h_kv, causal, block_q, block_k, interpret)
+    return out, (q, k, v, segs, out, lse)
 
 
-def _flash_core_bwd(causal, block_q, block_k, interpret, residuals, do):
-    q, k, v, out, lse = residuals
-    dq, dk, dv = _flash_bwd(q, k, v, out, lse, do, causal, block_q, block_k, interpret)
-    return dq, dk, dv
+def _flash_core_bwd(h, h_kv, causal, block_q, block_k, interpret, residuals, do):
+    q, k, v, segs, out, lse = residuals
+    dq, dk, dv = _flash_bwd(
+        q, k, v, segs, out, lse, do, h, h_kv, causal, block_q, block_k, interpret
+    )
+    dsegs = None if segs is None else jnp.zeros_like(segs)
+    return dq, dk, dv, dsegs
 
 
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
@@ -292,23 +392,38 @@ def flash_attention(
     v: jax.Array,
     *,
     causal: bool = True,
+    segment_ids: Optional[jax.Array] = None,
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
-    """(B, S, H, D) flash attention with GQA support."""
+    """(B, S, H, D) flash attention.
+
+    * GQA: pass k/v with fewer heads (B, S, H_kv, D), H divisible by H_kv —
+      kv blocks are shared across the group in the kernel, never repeated.
+    * Packed sequences: ``segment_ids`` (B, S) int32 document labels —
+      attention never crosses a segment boundary (the packed-SFT layout of
+      ``make_padded_collate``/csrc packing).
+    """
     b, s, h, d = q.shape
-    n_rep = h // k.shape[2]
-    k = repeat_kv(k, n_rep)
-    v = repeat_kv(v, n_rep)
+    h_kv = k.shape[2]
+    if h % h_kv != 0:
+        raise ValueError(f"num heads {h} not divisible by kv heads {h_kv}")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     block_q = _pick_block(s, block_q)
     block_k = _pick_block(s, block_k)
 
-    # (B, S, H, D) → (B·H, S, D)
     def merge(x):
-        return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+        n = x.shape[2]
+        return x.transpose(0, 2, 1, 3).reshape(b * n, s, d)
 
-    out = _flash_core(merge(q), merge(k), merge(v), causal, block_q, block_k, interpret)
+    segs = None
+    if segment_ids is not None:
+        # (B, 1, S): lane-major like lse so (1, 1, block) tiles are legal
+        segs = segment_ids.astype(jnp.int32)[:, None, :]
+    out = _flash_core(
+        merge(q), merge(k), merge(v), segs, h, h_kv, causal, block_q, block_k,
+        interpret,
+    )
     return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)

@@ -207,3 +207,33 @@ def pack_dataset(documents, seq_len: int, pad_id: int = 0, preserve_order: bool 
     packer = pack_contiguous if preserve_order else pack_ffd
     bin_ids, n_bins = packer(lengths, seq_len)
     return fill_packed(tokens, doc_starts, bin_ids, seq_len, n_bins, pad_id=pad_id)
+
+
+def packed_loss_mask(segment_ids: np.ndarray) -> np.ndarray:
+    """(N, S) segment ids → (N, S) f32 loss mask for next-token training on
+    packed rows: position i trains only when tokens i and i+1 belong to the
+    same (nonzero) document — boundary targets (the next document's first
+    token) and padding never contribute loss. Matches the loss_mask
+    convention of models/llama.py `_mask_of` (mask index i ↔ label
+    input[i+1])."""
+    seg = np.asarray(segment_ids, dtype=np.int32)
+    mask = np.zeros(seg.shape, dtype=np.float32)
+    mask[:, :-1] = ((seg[:, :-1] == seg[:, 1:]) & (seg[:, :-1] > 0)).astype(np.float32)
+    return mask
+
+
+def packed_position_ids(segment_ids: np.ndarray) -> np.ndarray:
+    """(N, S) segment ids → (N, S) int32 within-document positions (RoPE /
+    learned-position indices restart at every packed document; padding gets
+    0). Feed as ``batch["position_ids"]`` next to ``segment_ids``."""
+    seg = np.asarray(segment_ids, dtype=np.int32)
+    n, s = seg.shape
+    idx = np.arange(s, dtype=np.int32)[None, :].repeat(n, axis=0)
+    # each position's segment-start index: the running max of boundary
+    # positions (fully vectorized — this runs per dataset build)
+    change = np.ones((n, s), dtype=bool)
+    change[:, 1:] = seg[:, 1:] != seg[:, :-1]
+    start = np.maximum.accumulate(np.where(change, idx, 0), axis=1)
+    pos = idx - start
+    pos[seg == 0] = 0
+    return pos

@@ -165,3 +165,10 @@ def test_reference_config_training_example():
     assert result.returncode == 0, result.stderr[-2000:]
     assert "zero_stage=3 -> dp_shard" in result.stdout
     assert "final loss" in result.stdout
+
+
+@pytest.mark.slow
+def test_packed_sft_example():
+    result = _run("by_feature/packed_sft.py", "--steps", "2")
+    assert result.returncode == 0, result.stderr[-2000:]
+    assert "fill" in result.stdout and "packed training loss" in result.stdout

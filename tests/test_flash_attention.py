@@ -59,3 +59,99 @@ def test_flash_uneven_block_fallback():
     ref = dot_product_attention(q, k, v, causal=True)
     out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64, interpret=True)
     np.testing.assert_allclose(np.asarray(ref), np.asarray(out), atol=2e-5)
+
+
+def test_flash_gqa_grads_accumulate_over_group():
+    """Native-GQA backward: dk/dv for a kv head must sum over its whole
+    q-head group (the kernel folds the group loop into the grid — a wrong
+    index map silently drops heads)."""
+    q, k, v = _qkv(s=64, h=8, kvh=2, d=16)
+
+    def ref_loss(q, k, v):
+        return jnp.sum(dot_product_attention(q, k, v, causal=True) ** 2)
+
+    def flash_loss(q, k, v):
+        return jnp.sum(
+            flash_attention(q, k, v, causal=True, block_q=16, block_k=16,
+                            interpret=True) ** 2
+        )
+
+    ref_grads = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    grads = jax.grad(flash_loss, argnums=(0, 1, 2))(q, k, v)
+    for g, r in zip(grads, ref_grads):
+        assert g.shape == r.shape  # dk/dv stay (B, S, H_kv, D)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r), atol=2e-4)
+
+
+def _segment_reference(q, k, v, segment_ids, causal=True):
+    """Dense reference: scores masked where segments differ."""
+    from accelerate_tpu.ops.attention import NEG_INF, repeat_kv
+
+    b, s, h, d = q.shape
+    k = repeat_kv(k, h // k.shape[2])
+    v = repeat_kv(v, h // v.shape[2])
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / np.sqrt(d)
+    same = segment_ids[:, :, None] == segment_ids[:, None, :]  # (b, sq, sk)
+    scores = jnp.where(same[:, None], scores, NEG_INF)
+    if causal:
+        pos = np.arange(s)
+        scores = jnp.where((pos[:, None] >= pos[None, :])[None, None], scores, NEG_INF)
+    weights = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights.astype(v.dtype), v)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_segment_ids_forward(causal):
+    """Packed-sequence masking: attention never crosses a document boundary
+    inside a row (boundaries deliberately NOT block-aligned)."""
+    q, k, v = _qkv(s=96)
+    rng = np.random.default_rng(1)
+    # 3 ragged docs per row, boundaries at random offsets
+    segs = np.zeros((2, 96), np.int32)
+    for bi in range(2):
+        cuts = np.sort(rng.choice(np.arange(8, 88), size=2, replace=False))
+        segs[bi, cuts[0]:] = 1
+        segs[bi, cuts[1]:] = 2
+    segs = jnp.asarray(segs)
+    ref = _segment_reference(q, k, v, segs, causal=causal)
+    out = flash_attention(q, k, v, causal=causal, segment_ids=segs,
+                          block_q=32, block_k=32, interpret=True)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), atol=2e-5)
+
+
+def test_flash_segment_ids_grads():
+    q, k, v = _qkv(s=64, h=4, kvh=2, d=16)
+    segs = jnp.asarray(
+        np.repeat(np.array([[0, 1, 2, 3]]), 16, axis=1).reshape(1, 64).repeat(2, 0)
+    )
+    # non-uniform doc lengths in row 1
+    segs = segs.at[1, :10].set(0)
+
+    def ref_loss(q, k, v):
+        return jnp.sum(_segment_reference(q, k, v, segs, causal=True) ** 2)
+
+    def flash_loss(q, k, v):
+        return jnp.sum(
+            flash_attention(q, k, v, causal=True, segment_ids=segs,
+                            block_q=16, block_k=16, interpret=True) ** 2
+        )
+
+    ref_grads = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    grads = jax.grad(flash_loss, argnums=(0, 1, 2))(q, k, v)
+    for g, r in zip(grads, ref_grads):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r), atol=2e-4)
+
+
+def test_flash_segment_isolation():
+    """A token's output must be exactly what it would be if its document
+    were alone in the row — the no-cross-contamination guarantee packed
+    SFT depends on."""
+    q, k, v = _qkv(b=1, s=64)
+    segs = jnp.asarray(np.r_[np.zeros(24, np.int32), np.ones(40, np.int32)][None])
+    packed = flash_attention(q, k, v, causal=True, segment_ids=segs,
+                             block_q=16, block_k=16, interpret=True)
+    alone = flash_attention(q[:, :24], k[:, :24], v[:, :24], causal=True,
+                            block_q=8, block_k=8, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(packed[:, :24]), np.asarray(alone), atol=2e-5
+    )

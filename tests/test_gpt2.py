@@ -247,3 +247,31 @@ def test_gpt2_1f1b_training_matches_dp():
     )
     np.testing.assert_allclose(l_pp, l_ref, atol=1e-4)
     np.testing.assert_allclose(w_pp, w_ref, atol=1e-4)
+
+
+def test_gpt2_packed_segments_match_padded():
+    """Packed rows (segment-masked attention + restarted learned positions)
+    reproduce the per-document padded loss exactly — llama's packed-SFT
+    contract holds for gpt2's learned-position path too."""
+    from accelerate_tpu.utils import native
+
+    rng = np.random.default_rng(0)
+    cfg = GPT2Config.tiny(compute_dtype=jnp.float32)
+    model = create_gpt2(cfg, seed=0)
+    view = lambda ids, **kw: model.apply_fn(model.params, ids, **kw)
+
+    docs = [rng.integers(4, cfg.vocab_size, size=n).astype(np.int32)
+            for n in (7, 5, 9, 4, 6)]
+    tokens, segments = native.pack_dataset(docs, seq_len=16, pad_id=0)
+    packed = float(gpt2_loss(view, {
+        "input_ids": tokens,
+        "segment_ids": segments,
+        "position_ids": native.packed_position_ids(segments),
+        "loss_mask": native.packed_loss_mask(segments),
+    }))
+    padded_tokens, padded_mask = native.collate_padded(docs, seq_len=16)
+    padded = float(gpt2_loss(view, {
+        "input_ids": padded_tokens,
+        "loss_mask": native.packed_loss_mask((padded_mask > 0).astype(np.int32)),
+    }))
+    np.testing.assert_allclose(packed, padded, rtol=2e-5)
