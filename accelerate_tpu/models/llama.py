@@ -49,6 +49,10 @@ class LlamaConfig:
     max_position_embeddings: int = 4096
     rms_norm_eps: float = 1e-5
     rope_theta: float = 10000.0
+    # Mistral-style sliding-window attention: each query attends the last W
+    # keys only (None = full causal). The flash kernel grid-prunes
+    # out-of-window kv tiles, so long-seq compute is O(S·W) per row.
+    sliding_window: Optional[int] = None
     tie_word_embeddings: bool = False
     param_dtype: Any = jnp.float32
     compute_dtype: Any = jnp.bfloat16
@@ -98,6 +102,17 @@ class LlamaConfig:
             # top-2 unconditionally, so faithful inference must not drop;
             # lower this for capacity-bounded training at scale
             expert_capacity_factor=8.0,
+        ), **overrides})
+
+    @classmethod
+    def mistral_7b(cls, **overrides) -> "LlamaConfig":
+        """Mistral-7B-v0.1 shape (HF mistralai/Mistral-7B-v0.1): llama
+        architecture + GQA (8 kv heads) + 4096-token sliding window."""
+        return cls(**{**dict(
+            vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+            num_hidden_layers=32, num_attention_heads=32, num_key_value_heads=8,
+            max_position_embeddings=32768, rope_theta=10000.0,
+            sliding_window=4096,
         ), **overrides})
 
     @classmethod
@@ -240,13 +255,20 @@ def _attention(config: LlamaConfig, q, k, v, attention_fn=None, q_offset: int = 
                 "would need resharding with the sequence — unpack the batch "
                 "or drop cp/sp for packed training"
             )
+        if config.sliding_window is not None:
+            raise ValueError(
+                "sliding_window cannot compose with a mesh-injected "
+                "attention_fn (CP/SP ring/Ulysses attend full-causal): "
+                "results would silently differ from the model's window "
+                "semantics — drop cp/sp or set sliding_window=None"
+            )
         return attention_fn(q, k, v, causal=True)
     from ..ops.attention import dispatch_attention
 
     return dispatch_attention(
         config.attention_impl, q, k, v, causal=True, q_offset=q_offset,
         kv_block=config.attention_kv_block, block_q=config.attention_block_q,
-        segment_ids=segment_ids,
+        segment_ids=segment_ids, window=config.sliding_window,
     )
 
 
@@ -760,6 +782,8 @@ def _decode_layer(config: LlamaConfig, layer_params, x, cache_k, cache_v, pos):
     )
     k_pos = lax.broadcasted_iota(jnp.int32, scores.shape, 3)
     scores = jnp.where(k_pos <= pos, scores, -1e6)
+    if config.sliding_window is not None:
+        scores = jnp.where(pos - k_pos < config.sliding_window, scores, -1e6)
     weights = jax.nn.softmax(scores, axis=-1)
     attn = jnp.einsum("bhqk,bkhd->bqhd", weights.astype(cdt), vv.astype(cdt))
     attn = attn.reshape(b, s, h * hd) @ layer_params["attn"]["o_proj"]["kernel"].astype(cdt)

@@ -64,6 +64,7 @@ def dot_product_attention(
     kv_offset: int = 0,
     softmax_dtype=jnp.float32,
     segment_ids: Optional[jax.Array] = None,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Reference attention, fully materialized scores. XLA fuses this well for
     moderate sequence lengths; use the Pallas flash kernel (ops/flash_attention)
@@ -82,6 +83,10 @@ def dot_product_attention(
     if segment_ids is not None:
         same = segment_ids[:, :, None] == segment_ids[:, None, :]  # (b, sq, sk)
         scores = jnp.where(same[:, None], scores, NEG_INF)
+    if window is not None:
+        q_pos = jnp.arange(sq)[:, None] + q_offset
+        k_pos = jnp.arange(k.shape[1])[None, :] + kv_offset
+        scores = jnp.where((q_pos - k_pos < window)[None, None], scores, NEG_INF)
     weights = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", weights.astype(v.dtype), v)
     return out
@@ -98,6 +103,7 @@ def dispatch_attention(
     kv_block: int = 512,
     block_q: int = 2048,
     segment_ids: Optional[jax.Array] = None,
+    window: Optional[int] = None,
 ):
     """Select the attention implementation by name — the shared entry every
     causal-LM family (llama, gpt2, ...) routes through. ``impl``: "flash" |
@@ -113,16 +119,17 @@ def dispatch_attention(
         from .flash_attention import flash_attention
 
         return flash_attention(
-            q, k, v, causal=True, segment_ids=segment_ids,
+            q, k, v, causal=True, segment_ids=segment_ids, window=window,
             block_q=block_q, block_k=kv_block,
         )
     if impl in ("blockwise", "flash"):
         return blockwise_attention(
             q, k, v, causal=causal, kv_block=kv_block, q_offset=q_offset,
-            segment_ids=segment_ids,
+            segment_ids=segment_ids, window=window,
         )
     return dot_product_attention(
-        q, k, v, causal=causal, q_offset=q_offset, segment_ids=segment_ids
+        q, k, v, causal=causal, q_offset=q_offset, segment_ids=segment_ids,
+        window=window,
     )
 
 
@@ -169,7 +176,7 @@ def finalize_blocks(out, m, l):
 
 def blockwise_attention(
     q, k, v, *, causal: bool = True, kv_block: int = 512, q_offset: int = 0,
-    segment_ids: Optional[jax.Array] = None,
+    segment_ids: Optional[jax.Array] = None, window: Optional[int] = None,
 ) -> jax.Array:
     """Memory-efficient attention: iterate KV blocks with online softmax —
     the same math the ring-attention CP path runs across chips
@@ -209,6 +216,8 @@ def blockwise_attention(
         bias = jnp.where(kv_pos < skv, 0.0, NEG_INF)
         if causal:
             bias = jnp.where(q_pos >= kv_pos, bias, NEG_INF)
+        if window is not None:
+            bias = jnp.where(q_pos - kv_pos < window, bias, NEG_INF)
         bias = bias[None, None]
         if seg_blk is not None:
             same = segment_ids[:, :, None] == seg_blk[:, None, :]  # (b, sq, bk)

@@ -58,21 +58,44 @@ def _pick_block(s: int, preferred: int) -> int:
     return max(b, 1)
 
 
-def _mask_scores(s, i, j, q_seg, k_seg, causal, block_q, block_k):
-    """Apply causal and/or segment visibility to a (block_q, block_k) score
-    tile. ``q_seg``/``k_seg`` are (block,) int32 rows or None."""
-    if causal:
+def _mask_scores(s, i, j, q_seg, k_seg, causal, block_q, block_k, window):
+    """Apply causal / sliding-window / segment visibility to a
+    (block_q, block_k) score tile. ``q_seg``/``k_seg`` are (block,) int32
+    rows or None; ``window`` is the Mistral convention (q attends k iff
+    0 <= q_pos - k_pos < window) or None."""
+    if causal or window is not None:
         q_pos = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + i * block_q
         k_pos = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + j * block_k
-        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if causal:
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if window is not None:
+            s = jnp.where(q_pos - k_pos < window, s, NEG_INF)
     if q_seg is not None:
         s = jnp.where(q_seg[:, None] == k_seg[None, :], s, NEG_INF)
     return s
 
 
+def _block_visible(i, j, causal, block_q, block_k, window):
+    """Grid-level pruning: whether ANY (q, k) pair in the tile is visible.
+    Causal bound: the tile's lowest k_pos must not exceed its highest q_pos.
+    Window bound: the tile's highest k_pos must be within the window of the
+    tile's LOWEST q_pos — the bottom rows of the q block keep seeing a kv
+    tile after the top rows' windows have slid past it."""
+    vis = True
+    hi_q = i * block_q + block_q - 1
+    if causal:
+        vis = jnp.logical_and(vis, j * block_k <= hi_q) if not isinstance(vis, bool) else (j * block_k <= hi_q)
+    if window is not None:
+        lo_q = i * block_q
+        hi_k = j * block_k + block_k - 1
+        in_window = hi_k > lo_q - window  # some k in tile within some q's window
+        vis = jnp.logical_and(vis, in_window) if not isinstance(vis, bool) else (vis and in_window)
+    return vis
+
+
 # ---------------------------------------------------------------- forward
 def _fwd_kernel(q_ref, k_ref, v_ref, *rest, causal, block_q, block_k, scale,
-                segmented):
+                segmented, window):
     if segmented:
         qseg_ref, kseg_ref, out_ref, lse_ref, acc_ref, m_ref, l_ref = rest
     else:
@@ -87,10 +110,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, causal, block_q, block_k, scale,
         m_ref[:] = jnp.full_like(m_ref, NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    # causal grid pruning: skip blocks strictly above the diagonal (the MXU
-    # work is predicated out; block DMAs still occur — acceptable, compute
-    # dominates at these tile sizes)
-    visible = (j * block_k <= i * block_q + block_q - 1) if causal else True
+    # grid pruning: skip blocks above the causal diagonal and (with a
+    # sliding window) blocks entirely below every row's window — the
+    # long-sequence win: compute per row becomes O(S·window), not O(S²)
+    visible = _block_visible(i, j, causal, block_q, block_k, window)
 
     @pl.when(visible)
     def _compute():
@@ -101,7 +124,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, causal, block_q, block_k, scale,
         s = _dot_f32(q, k, transpose_b=True) * scale  # (bq, bk), f32 acc
         q_seg = qseg_ref[0, 0] if segmented else None
         k_seg = kseg_ref[0, 0] if segmented else None
-        s = _mask_scores(s, i, j, q_seg, k_seg, causal, block_q, block_k)
+        s = _mask_scores(s, i, j, q_seg, k_seg, causal, block_q, block_k, window)
 
         m_prev = m_ref[:, 0]
         l_prev = l_ref[:, 0]
@@ -133,7 +156,8 @@ def _seg_index(b, h):
     return b // h
 
 
-def _flash_fwd(q, k, v, segs, h, h_kv, causal, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, segs, h, h_kv, causal, block_q, block_k, interpret,
+               window=None):
     from jax.experimental.pallas import tpu as pltpu
 
     bh, s, d = q.shape
@@ -160,7 +184,7 @@ def _flash_fwd(q, k, v, segs, h, h_kv, causal, block_q, block_k, interpret):
     out, lse = pl.pallas_call(
         functools.partial(
             _fwd_kernel, causal=causal, block_q=block_q, block_k=block_k,
-            scale=scale, segmented=segmented,
+            scale=scale, segmented=segmented, window=window,
         ),
         grid=grid,
         in_specs=in_specs,
@@ -188,7 +212,7 @@ def _flash_fwd(q, k, v, segs, h, h_kv, causal, block_q, block_k, interpret):
 
 # ---------------------------------------------------------------- backward
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
-                   causal, block_q, block_k, scale, segmented):
+                   causal, block_q, block_k, scale, segmented, window):
     if segmented:
         qseg_ref, kseg_ref, dq_ref, dq_acc = rest
     else:
@@ -201,7 +225,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
     def _init():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    visible = (j * block_k <= i * block_q + block_q - 1) if causal else True
+    visible = _block_visible(i, j, causal, block_q, block_k, window)
 
     @pl.when(visible)
     def _compute():
@@ -215,7 +239,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
         s = _dot_f32(q, k, transpose_b=True) * scale
         q_seg = qseg_ref[0, 0] if segmented else None
         k_seg = kseg_ref[0, 0] if segmented else None
-        s = _mask_scores(s, i, j, q_seg, k_seg, causal, block_q, block_k)
+        s = _mask_scores(s, i, j, q_seg, k_seg, causal, block_q, block_k, window)
         p = jnp.exp(s - lse[:, None])
         dp = _dot_f32(do, v, transpose_b=True)
         ds = p * (dp - delta[:, None])
@@ -227,7 +251,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
-                    causal, block_q, block_k, scale, segmented, nq):
+                    causal, block_q, block_k, scale, segmented, nq, window):
     """Grid (B·H_kv, nk, nq·n_rep): the innermost dim walks every (q block,
     q head-in-group) pair while the dk/dv output block stays put, so a kv
     head's gradient accumulates across its whole GQA group in VMEM."""
@@ -245,7 +269,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    visible = (i * block_q + block_q - 1 >= j * block_k) if causal else True
+    visible = _block_visible(i, j, causal, block_q, block_k, window)
 
     @pl.when(visible)
     def _compute():
@@ -259,7 +283,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
         s = _dot_f32(q, k, transpose_b=True) * scale  # (bq, bk)
         q_seg = qseg_ref[0, 0] if segmented else None
         k_seg = kseg_ref[0, 0] if segmented else None
-        s = _mask_scores(s, i, j, q_seg, k_seg, causal, block_q, block_k)
+        s = _mask_scores(s, i, j, q_seg, k_seg, causal, block_q, block_k, window)
         p = jnp.exp(s - lse[:, None])
         p_lo = p.astype(do.dtype)
         dv_acc[:] = dv_acc[:] + _dot_f32(p_lo.T, do)
@@ -274,7 +298,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
 
 
 def _flash_bwd(q, k, v, segs, out, lse, do, h, h_kv, causal, block_q, block_k,
-               interpret):
+               interpret, window=None):
     from jax.experimental.pallas import tpu as pltpu
 
     bh, s, d = q.shape
@@ -305,7 +329,7 @@ def _flash_bwd(q, k, v, segs, out, lse, do, h, h_kv, causal, block_q, block_k,
     dq = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, causal=causal, block_q=block_q, block_k=block_k,
-            scale=scale, segmented=segmented,
+            scale=scale, segmented=segmented, window=window,
         ),
         grid=(bh, nq, nk),
         in_specs=dq_in_specs,
@@ -341,7 +365,7 @@ def _flash_bwd(q, k, v, segs, out, lse, do, h, h_kv, causal, block_q, block_k,
     dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, causal=causal, block_q=block_q, block_k=block_k,
-            scale=scale, segmented=segmented, nq=nq,
+            scale=scale, segmented=segmented, nq=nq, window=window,
         ),
         grid=(bh_kv, nk, nq * n_rep),
         in_specs=dkv_in_specs,
@@ -363,21 +387,27 @@ def _flash_bwd(q, k, v, segs, out, lse, do, h, h_kv, causal, block_q, block_k,
 
 
 # ---------------------------------------------------------------- public op
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
-def _flash_core(q, k, v, segs, h, h_kv, causal, block_q, block_k, interpret):
-    out, _ = _flash_fwd(q, k, v, segs, h, h_kv, causal, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
+def _flash_core(q, k, v, segs, h, h_kv, causal, block_q, block_k, interpret,
+                window):
+    out, _ = _flash_fwd(q, k, v, segs, h, h_kv, causal, block_q, block_k,
+                        interpret, window)
     return out
 
 
-def _flash_core_fwd(q, k, v, segs, h, h_kv, causal, block_q, block_k, interpret):
-    out, lse = _flash_fwd(q, k, v, segs, h, h_kv, causal, block_q, block_k, interpret)
+def _flash_core_fwd(q, k, v, segs, h, h_kv, causal, block_q, block_k, interpret,
+                    window):
+    out, lse = _flash_fwd(q, k, v, segs, h, h_kv, causal, block_q, block_k,
+                          interpret, window)
     return out, (q, k, v, segs, out, lse)
 
 
-def _flash_core_bwd(h, h_kv, causal, block_q, block_k, interpret, residuals, do):
+def _flash_core_bwd(h, h_kv, causal, block_q, block_k, interpret, window,
+                    residuals, do):
     q, k, v, segs, out, lse = residuals
     dq, dk, dv = _flash_bwd(
-        q, k, v, segs, out, lse, do, h, h_kv, causal, block_q, block_k, interpret
+        q, k, v, segs, out, lse, do, h, h_kv, causal, block_q, block_k,
+        interpret, window
     )
     dsegs = None if segs is None else jnp.zeros_like(segs)
     return dq, dk, dv, dsegs
@@ -393,6 +423,7 @@ def flash_attention(
     *,
     causal: bool = True,
     segment_ids: Optional[jax.Array] = None,
+    window: Optional[int] = None,
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
     interpret: Optional[bool] = None,
@@ -404,6 +435,9 @@ def flash_attention(
     * Packed sequences: ``segment_ids`` (B, S) int32 document labels —
       attention never crosses a segment boundary (the packed-SFT layout of
       ``make_padded_collate``/csrc packing).
+    * Sliding window (Mistral): ``window`` W limits each query to the last W
+      keys; out-of-window kv TILES are grid-pruned, so per-row compute is
+      O(S·W) instead of O(S²).
     """
     b, s, h, d = q.shape
     h_kv = k.shape[2]
@@ -424,6 +458,6 @@ def flash_attention(
         segs = segment_ids.astype(jnp.int32)[:, None, :]
     out = _flash_core(
         merge(q), merge(k), merge(v), segs, h, h_kv, causal, block_q, block_k,
-        interpret,
+        interpret, window,
     )
     return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)

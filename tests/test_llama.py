@@ -8,7 +8,9 @@ import optax
 from accelerate_tpu import Accelerator
 from accelerate_tpu.models.llama import (
     LlamaConfig,
+    convert_hf_state_dict,
     create_llama,
+    init_llama_params,
     llama_apply,
     llama_loss,
 )
@@ -106,3 +108,78 @@ def test_fused_step_llama():
             first = first if first is not None else loss
             last = loss
     assert last < first
+
+
+def test_sliding_window_receptive_field():
+    """With a 1-layer model and window W, logits at position t must be
+    independent of tokens more than W back (the Mistral guarantee the
+    attention masks implement)."""
+    cfg = LlamaConfig.tiny(num_hidden_layers=1, sliding_window=8,
+                           compute_dtype=jnp.float32)
+    params = init_llama_params(cfg, jax.random.key(0))
+    rng = np.random.default_rng(0)
+    a = rng.integers(4, cfg.vocab_size, size=(1, 32)).astype(np.int32)
+    b = a.copy()
+    b[0, 0] = (b[0, 0] + 1) % cfg.vocab_size  # perturb position 0
+    la = np.asarray(llama_apply(cfg, params, a))
+    lb = np.asarray(llama_apply(cfg, params, b))
+    # positions >= 8 can no longer see position 0
+    np.testing.assert_allclose(la[0, 8:], lb[0, 8:], atol=1e-5)
+    assert np.abs(la[0, :8] - lb[0, :8]).max() > 1e-4
+
+
+def test_sliding_window_decode_matches_full_forward():
+    """KV-cache decode applies the same window mask as the full forward."""
+    from accelerate_tpu.models.llama import llama_decode_step
+
+    cfg = LlamaConfig.tiny(sliding_window=6, compute_dtype=jnp.float32)
+    params = init_llama_params(cfg, jax.random.key(0))
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(4, cfg.vocab_size, size=(2, 16)).astype(np.int32))
+    full = np.asarray(llama_apply(cfg, params, ids))
+
+    h, kvh, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+    L = cfg.num_hidden_layers
+    cache = {
+        "k": jnp.zeros((L, 2, 16, kvh, hd), jnp.float32),
+        "v": jnp.zeros((L, 2, 16, kvh, hd), jnp.float32),
+    }
+    for t in range(16):
+        step_logits, cache = llama_decode_step(
+            cfg, params, cache, ids[:, t : t + 1], jnp.int32(t)
+        )
+        np.testing.assert_allclose(
+            np.asarray(step_logits), full[:, t], atol=1e-4, rtol=1e-4
+        )
+
+
+def test_hf_mistral_logits_parity():
+    """Mistral-7B family: llama arch + GQA + sliding window. A random HF
+    MistralForCausalLM converts via the SAME convert_hf_state_dict and
+    logits match with the window active (seq > window)."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    hf_cfg = transformers.MistralConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, sliding_window=8,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    hf_model = transformers.MistralForCausalLM(hf_cfg).eval()
+    ids = np.random.default_rng(0).integers(0, 128, size=(2, 24))
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(ids)).logits.numpy()
+
+    cfg = LlamaConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, sliding_window=8,
+        rms_norm_eps=hf_cfg.rms_norm_eps,  # MistralConfig defaults 1e-6
+        compute_dtype=jnp.float32, attention_impl="xla",
+    )
+    flat = {k: v.numpy() for k, v in hf_model.state_dict().items()}
+    params = convert_hf_state_dict(cfg, flat)
+    ours = np.asarray(llama_apply(cfg, params, ids.astype(np.int32)))
+    np.testing.assert_allclose(ours, hf_logits, atol=2e-4)
