@@ -32,12 +32,21 @@ def generate(
     temperature: float = 0.0,
     seed: int = 0,
     pad_to: Optional[int] = None,
+    *,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+    eos_token_id: Optional[int] = None,
+    pad_token_id: Optional[int] = None,
 ):
     """Greedy (temperature=0) or sampled generation for the causal-LM
-    families (llama/mixtral, gpt2 — dispatched on the model's config type).
+    families (llama/mixtral/mistral, gpt2 — dispatched on the model's config
+    type).
 
     Prefill runs the full forward once; decode is a single compiled scan with
-    a static-size KV cache. Returns (B, prompt+new) token ids.
+    a static-size KV cache. ``top_k``/``top_p`` (nucleus) filter the sampled
+    distribution; ``eos_token_id`` freezes a finished sequence (subsequent
+    positions emit ``pad_token_id``, defaulting to the EOS id — HF's
+    convention when pad is unset). Returns (B, prompt+new) token ids.
     """
     from .models.gpt2 import GPT2Config, gpt2_decode_step, gpt2_prefill
     from .models.llama import llama_decode_step, llama_prefill
@@ -52,6 +61,8 @@ def generate(
     total_len = prompt_len + max_new_tokens
     if pad_to is not None:
         total_len = max(total_len, pad_to)
+    if pad_token_id is None:
+        pad_token_id = eos_token_id if eos_token_id is not None else 0
 
     # prefill: ONE full forward fills the cache (O(S) matmul work vs O(S²)
     # for token-by-token decode over the prompt)
@@ -62,17 +73,42 @@ def generate(
     def sample(logits, key):
         if temperature <= 0.0:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(key, logits / temperature, axis=-1).astype(jnp.int32)
+        logits = logits / temperature
+        # top_k in (None, 0) means unfiltered (HF convention for 0)
+        if top_k is not None and 0 < top_k < logits.shape[-1]:
+            kth = lax.top_k(logits, top_k)[0][..., -1:]
+            logits = jnp.where(logits < kth, -jnp.inf, logits)
+        if top_p is not None and top_p < 1.0:
+            # nucleus: keep the smallest prefix of the sorted distribution
+            # with cumulative probability >= top_p (the top token always
+            # survives — the cumulative sum is exclusive, so element 0 is 0)
+            sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+            probs = jax.nn.softmax(sorted_logits, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1) - probs
+            cutoff_idx = jnp.maximum(
+                jnp.sum((cum < top_p).astype(jnp.int32), axis=-1) - 1, 0
+            )
+            cutoff = jnp.take_along_axis(
+                sorted_logits, cutoff_idx[..., None], axis=-1
+            )
+            logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+        return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+    done0 = jnp.zeros((b,), dtype=bool)
 
     def decode_body(carry, t):
-        cache, logits, key = carry
+        cache, logits, key, done = carry
         key, sub = jax.random.split(key)
-        token = sample(logits, sub)[:, None]
-        logits, cache = decode_fn(config, model.params, cache, token, t)
-        return (cache, logits, key), token[:, 0]
+        token = sample(logits, sub)
+        if eos_token_id is not None:
+            token = jnp.where(done, jnp.int32(pad_token_id), token)
+            done = done | (token == eos_token_id)
+        logits, cache = decode_fn(config, model.params, cache, token[:, None], t)
+        return (cache, logits, key, done), token
 
-    (_, _, _), new_tokens = lax.scan(
-        decode_body, (cache, logits, key), prompt_len + jnp.arange(max_new_tokens)
+    (_, _, _, _), new_tokens = lax.scan(
+        decode_body, (cache, logits, key, done0),
+        prompt_len + jnp.arange(max_new_tokens),
     )
     return jnp.concatenate([input_ids, new_tokens.T], axis=1)
 

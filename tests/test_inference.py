@@ -136,3 +136,78 @@ def test_moe_generate_real_capacity_matches_ample():
     out_real = generate(_create(cfg_real, seed=0), ids, max_new_tokens=6)
     out_full = generate(_create(cfg_full, seed=0), ids, max_new_tokens=6)
     np.testing.assert_array_equal(np.asarray(out_real), np.asarray(out_full))
+
+
+def test_generate_top_k_restricts_support():
+    """With top_k=1 sampling must equal greedy regardless of temperature."""
+    from accelerate_tpu.inference import generate
+    from accelerate_tpu.models.llama import LlamaConfig, create_llama
+
+    cfg = LlamaConfig.tiny(compute_dtype=jnp.float32)
+    model = create_llama(cfg, seed=0)
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(4, cfg.vocab_size, size=(2, 6)).astype(np.int32)
+    greedy = np.asarray(generate(model, prompt, max_new_tokens=6))
+    topk1 = np.asarray(generate(model, prompt, max_new_tokens=6,
+                                temperature=1.5, top_k=1, seed=3))
+    np.testing.assert_array_equal(greedy, topk1)
+
+
+def test_generate_top_p_one_is_unfiltered():
+    """top_p=1.0 must not change the sampled distribution (same seed ->
+    same tokens as plain temperature sampling)."""
+    from accelerate_tpu.inference import generate
+    from accelerate_tpu.models.llama import LlamaConfig, create_llama
+
+    cfg = LlamaConfig.tiny(compute_dtype=jnp.float32)
+    model = create_llama(cfg, seed=0)
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(4, cfg.vocab_size, size=(2, 6)).astype(np.int32)
+    a = np.asarray(generate(model, prompt, max_new_tokens=6, temperature=0.8, seed=5))
+    b = np.asarray(generate(model, prompt, max_new_tokens=6, temperature=0.8,
+                            top_p=1.0, seed=5))
+    np.testing.assert_array_equal(a, b)
+    # tight nucleus approaches greedy
+    tight = np.asarray(generate(model, prompt, max_new_tokens=6,
+                                temperature=0.8, top_p=1e-6, seed=5))
+    greedy = np.asarray(generate(model, prompt, max_new_tokens=6))
+    np.testing.assert_array_equal(tight, greedy)
+
+
+def test_generate_eos_freezes_sequence():
+    """After a sequence emits EOS, every later position is pad."""
+    from accelerate_tpu.inference import generate
+    from accelerate_tpu.models.llama import LlamaConfig, create_llama
+
+    cfg = LlamaConfig.tiny(compute_dtype=jnp.float32)
+    model = create_llama(cfg, seed=0)
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(4, cfg.vocab_size, size=(3, 5)).astype(np.int32)
+    # pick the model's own first greedy token as "EOS" so it fires at step 0
+    greedy = np.asarray(generate(model, prompt, max_new_tokens=1))
+    eos = int(greedy[0, 5])
+    out = np.asarray(generate(model, prompt, max_new_tokens=6,
+                              eos_token_id=eos, pad_token_id=1))
+    row = out[0, 5:]
+    fired = np.where(row == eos)[0]
+    assert fired.size > 0
+    assert (row[fired[0] + 1 :] == 1).all()
+
+
+def test_generate_top_k_zero_means_unfiltered_and_positional_compat():
+    from accelerate_tpu.inference import generate
+    from accelerate_tpu.models.llama import LlamaConfig, create_llama
+
+    cfg = LlamaConfig.tiny(compute_dtype=jnp.float32)
+    model = create_llama(cfg, seed=0)
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(4, cfg.vocab_size, size=(2, 6)).astype(np.int32)
+    plain = np.asarray(generate(model, prompt, max_new_tokens=4,
+                                temperature=0.8, seed=5))
+    k0 = np.asarray(generate(model, prompt, max_new_tokens=4,
+                             temperature=0.8, seed=5, top_k=0))
+    np.testing.assert_array_equal(plain, k0)  # HF convention: 0 = disabled
+    # the pre-sampling positional order (max_new_tokens, temperature, seed)
+    # still binds: sampling params are keyword-only
+    pos = np.asarray(generate(model, prompt, 4, 0.8, 5))
+    np.testing.assert_array_equal(plain, pos)
