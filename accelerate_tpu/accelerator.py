@@ -372,7 +372,8 @@ class Accelerator:
 
             cp_cfg = pcfg.cp_config or ContextParallelConfig()
             return make_ring_attention(
-                self.mesh, rotate_method=cp_cfg.rotate_method
+                self.mesh, rotate_method=cp_cfg.rotate_method,
+                kv_block=cp_cfg.kv_block,
             )
         if pcfg.sp_enabled:
             from .ops.ulysses import make_ulysses_attention

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 from jax import lax
 
 __all__ = [
+    "blockwise_attention_partials",
     "dot_product_attention",
     "blockwise_attention",
     "dispatch_attention",
@@ -174,19 +175,19 @@ def finalize_blocks(out, m, l):
     return out / jnp.maximum(denom, 1e-30).astype(out.dtype)
 
 
-def blockwise_attention(
+def blockwise_attention_partials(
     q, k, v, *, causal: bool = True, kv_block: int = 512, q_offset: int = 0,
-    segment_ids: Optional[jax.Array] = None, window: Optional[int] = None,
-) -> jax.Array:
-    """Memory-efficient attention: iterate KV blocks with online softmax —
-    the same math the ring-attention CP path runs across chips
-    (ops/ring_attention.py), here within one device."""
+    kv_offset: int = 0, segment_ids: Optional[jax.Array] = None,
+    window: Optional[int] = None,
+):
+    """Online-softmax accumulation over KV blocks, returning the UNNORMALIZED
+    partials (out, m, l) for combination with other shards — the shared core
+    of :func:`blockwise_attention` (one device) and each ring-attention step
+    (ops/ring_attention.py, where ``q_offset``/``kv_offset`` are the shard's
+    global positions). ``q`` must arrive PRE-SCALED by 1/sqrt(d) and kv
+    already head-repeated (see ``_attend_block``)."""
     b, sq, h, d = q.shape
-    n_rep = h // k.shape[2]
-    k = repeat_kv(k, n_rep)
-    v = repeat_kv(v, n_rep)
     skv = k.shape[1]
-    q = q * (1.0 / math.sqrt(d))  # pre-scale (see _attend_block)
     num_blocks = (skv + kv_block - 1) // kv_block
     pad = num_blocks * kv_block - skv
     if pad:
@@ -210,10 +211,10 @@ def blockwise_attention(
         else:
             k_blk, v_blk, idx = blk
             seg_blk = None
-        kv_start = idx * kv_block
+        kv_start = kv_offset + idx * kv_block
         q_pos = lax.broadcasted_iota(jnp.int32, (sq, kv_block), 0) + q_offset
         kv_pos = lax.broadcasted_iota(jnp.int32, (sq, kv_block), 1) + kv_start
-        bias = jnp.where(kv_pos < skv, 0.0, NEG_INF)
+        bias = jnp.where(kv_pos < kv_offset + skv, 0.0, NEG_INF)
         if causal:
             bias = jnp.where(q_pos >= kv_pos, bias, NEG_INF)
         if window is not None:
@@ -243,4 +244,23 @@ def blockwise_attention(
     if seg_blocks is not None:
         xs = (k_t, v_t, jnp.moveaxis(seg_blocks, 1, 0), jnp.arange(num_blocks))
     (out, m, l), _ = lax.scan(jax.checkpoint(body), init, xs)
+    return out, m, l
+
+
+def blockwise_attention(
+    q, k, v, *, causal: bool = True, kv_block: int = 512, q_offset: int = 0,
+    segment_ids: Optional[jax.Array] = None, window: Optional[int] = None,
+) -> jax.Array:
+    """Memory-efficient attention: iterate KV blocks with online softmax —
+    the same math the ring-attention CP path runs across chips
+    (ops/ring_attention.py), here within one device."""
+    b, sq, h, d = q.shape
+    n_rep = h // k.shape[2]
+    k = repeat_kv(k, n_rep)
+    v = repeat_kv(v, n_rep)
+    q = q * (1.0 / math.sqrt(d))  # pre-scale (see _attend_block)
+    out, m, l = blockwise_attention_partials(
+        q, k, v, causal=causal, kv_block=kv_block, q_offset=q_offset,
+        segment_ids=segment_ids, window=window,
+    )
     return finalize_blocks(out, m, l)

@@ -34,6 +34,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from .attention import (
     NEG_INF,
     _attend_block,
+    blockwise_attention_partials,
     combine_blocks,
     finalize_blocks,
     repeat_kv,
@@ -51,6 +52,28 @@ def _ring_bias(sq_local: int, skv_local: int, q_start, kv_start, causal: bool):
     return jnp.where(q_pos >= kv_pos, 0.0, NEG_INF)[None, None]
 
 
+def _attend_shard(q, k_shard, v_shard, q_start, kv_start, causal,
+                  kv_block=None):
+    """One ring step's attention of the local (pre-scaled) q against a
+    whole kv shard, returning online-softmax partials (out, m, l).
+
+    ``kv_block`` chunks the shard so the per-step score tile is
+    (b, h, sq, kv_block) instead of (b, h, sq, S/n) — the memory bound that
+    makes long-context shards viable. The chunked path IS
+    :func:`~accelerate_tpu.ops.attention.blockwise_attention_partials`
+    (same pad/scan/checkpoint machinery, incl. its TPU-miscompile
+    workaround), with this shard's global offsets."""
+    sq = q.shape[1]
+    skv = k_shard.shape[1]
+    if kv_block is None or kv_block >= skv:
+        bias = _ring_bias(sq, skv, q_start, kv_start, causal)
+        return _attend_block(q, k_shard, v_shard, bias)
+    return blockwise_attention_partials(
+        q, k_shard, v_shard, causal=causal, kv_block=kv_block,
+        q_offset=q_start, kv_offset=kv_start,
+    )
+
+
 def ring_attention_local(
     q: jax.Array,
     k: jax.Array,
@@ -59,6 +82,7 @@ def ring_attention_local(
     axis_name: str = "cp",
     causal: bool = True,
     rotate_method: str = "alltoall",
+    kv_block: Optional[int] = None,
 ) -> jax.Array:
     """Attention over sequence-sharded q/k/v — call INSIDE shard_map with
     ``axis_name`` bound. Shapes are local shards (B, S/n, H, D)."""
@@ -74,8 +98,7 @@ def ring_attention_local(
     if rotate_method == "allgather":
         k_all = lax.all_gather(k, axis_name, axis=1, tiled=True)
         v_all = lax.all_gather(v, axis_name, axis=1, tiled=True)
-        bias = _ring_bias(sq, k_all.shape[1], q_start, 0, causal)
-        out, m, l = _attend_block(q, k_all, v_all, bias)
+        out, m, l = _attend_shard(q, k_all, v_all, q_start, 0, causal, kv_block)
         return finalize_blocks(out, m, l)
 
     # true ring: rotate KV shards n times; shard s lives on rank
@@ -92,8 +115,9 @@ def ring_attention_local(
     for step in range(n):
         out, m, l, k_cur, v_cur = carry
         kv_rank = (idx - step) % n
-        bias = _ring_bias(sq, sq, q_start, kv_rank * sq, causal)
-        o2, m2, l2 = _attend_block(q, k_cur, v_cur, bias)
+        o2, m2, l2 = _attend_shard(
+            q, k_cur, v_cur, q_start, kv_rank * sq, causal, kv_block
+        )
         out, m, l = combine_blocks(out, m, l, o2, m2, l2)
         if step < n - 1:
             k_cur = lax.ppermute(k_cur, axis_name, perm)
@@ -130,6 +154,7 @@ def zigzag_ring_attention_local(
     axis_name: str = "cp",
     causal: bool = True,
     seq_len: int = None,
+    kv_block: Optional[int] = None,
 ) -> jax.Array:
     """Ring attention over zig-zag-permuted shards — call INSIDE shard_map.
 
@@ -180,8 +205,9 @@ def zigzag_ring_attention_local(
 
                 def attend(operand):
                     out, m, l = operand
-                    bias = _ring_bias(c, c, q_start, kv_start, causal)
-                    o2, m2, l2 = _attend_block(q_blk, k_blk, v_blk, bias)
+                    o2, m2, l2 = _attend_shard(
+                        q_blk, k_blk, v_blk, q_start, kv_start, causal, kv_block
+                    )
                     return combine_blocks(out, m, l, o2, m2, l2)
 
                 if causal:
@@ -206,6 +232,7 @@ def make_ring_attention(
     batch_axes: Sequence[str] = ("dp_replicate", "dp_shard"),
     head_axes: Sequence[str] = ("tp", "sp"),
     rotate_method: str = "alltoall",
+    kv_block: Optional[int] = 2048,
 ):
     """Build an attention fn over GLOBAL (B, S, H, D) arrays that runs ring
     attention across the cp axis (composing with dp batch sharding and tp
@@ -225,7 +252,8 @@ def make_ring_attention(
             kz = jnp.take(k, perm_j, axis=1)
             vz = jnp.take(v, perm_j, axis=1)
             body = functools.partial(
-                zigzag_ring_attention_local, axis_name=cp_axis, causal=causal
+                zigzag_ring_attention_local, axis_name=cp_axis, causal=causal,
+                kv_block=kv_block,
             )
             fn = jax.shard_map(
                 body,
@@ -241,6 +269,7 @@ def make_ring_attention(
             axis_name=cp_axis,
             causal=causal,
             rotate_method=rotate_method,
+            kv_block=kv_block,
         )
         fn = jax.shard_map(
             body,

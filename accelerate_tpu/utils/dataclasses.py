@@ -255,12 +255,18 @@ class ContextParallelConfig(KwargsHandler):
     rotate_method: str = "alltoall"
     use_pallas_kernel: bool = True
     causal: bool = True
+    # chunk each ring step's kv shard so the score tile is
+    # (b, h, sq_local, kv_block) instead of (b, h, sq_local, S/n) — the
+    # memory bound long-context shards need; None = whole shard at once
+    kv_block: Optional[int] = 2048
 
     def __post_init__(self):
         if self.rotate_method not in ("allgather", "alltoall", "zigzag"):
             raise ValueError(
                 f"rotate_method must be allgather|alltoall|zigzag, got {self.rotate_method}"
             )
+        if self.kv_block is not None and self.kv_block < 1:
+            raise ValueError(f"kv_block must be None or >= 1, got {self.kv_block}")
 
 
 @dataclass

@@ -202,3 +202,42 @@ def test_ulysses_gqa_kv_repeat_fallback():
     uly = make_ulysses_attention(mesh)
     out = jax.jit(lambda q, k, v: uly(q, k, v, causal=True))(q, k, v)
     np.testing.assert_allclose(np.asarray(ref), np.asarray(out), atol=1e-5)
+
+
+@pytest.mark.parametrize("rotate_method", ["alltoall", "allgather", "zigzag"])
+@pytest.mark.parametrize("kv_block", [8, 6])
+def test_ring_attention_chunked_kv_matches_reference(rotate_method, kv_block):
+    """Per-ring-step kv chunking (the long-context memory bound) must not
+    change the math — incl. kv_block=6, which does not divide the 16-row
+    shard and exercises the padding branch."""
+    cfg = ParallelismConfig(cp_size=4, dp_shard_size=2)
+    mesh = cfg.build_device_mesh()
+    q, k, v = _qkv(s=64)
+    ref = dot_product_attention(q, k, v, causal=True)
+    ring = make_ring_attention(mesh, rotate_method=rotate_method, kv_block=kv_block)
+    out = jax.jit(lambda q, k, v: ring(q, k, v, causal=True))(q, k, v)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), atol=1e-5)
+
+
+def test_ring_attention_chunked_grads_match_unchunked():
+    cfg = ParallelismConfig(cp_size=4, dp_shard_size=2)
+    mesh = cfg.build_device_mesh()
+    q, k, v = _qkv(s=64)
+
+    def loss(ring):
+        return lambda q, k, v: jnp.sum(ring(q, k, v, causal=True) ** 2)
+
+    chunked = make_ring_attention(mesh, kv_block=8)
+    whole = make_ring_attention(mesh, kv_block=None)
+    g_c = jax.jit(jax.grad(loss(chunked), argnums=(0, 1, 2)))(q, k, v)
+    g_w = jax.jit(jax.grad(loss(whole), argnums=(0, 1, 2)))(q, k, v)
+    for a, b in zip(g_c, g_w):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_cp_config_rejects_bad_kv_block():
+    from accelerate_tpu.utils.dataclasses import ContextParallelConfig
+
+    with pytest.raises(ValueError, match="kv_block"):
+        ContextParallelConfig(kv_block=0)
+    ContextParallelConfig(kv_block=None)  # disabled is fine
