@@ -324,7 +324,9 @@ class Accelerator:
         # CP/SP: inject the mesh-aware attention (the reference instead swaps
         # torch CP buffers / registers DeepSpeed Ulysses hooks —
         # accelerator.py:1658-1671, :2386-2437)
-        attention_fn = self.build_attention_fn()
+        attention_fn = self.build_attention_fn(
+            model_config=getattr(model, "config", None)
+        )
         if attention_fn is not None:
             if hasattr(model, "set_attention_fn"):
                 model.set_attention_fn(attention_fn)
@@ -362,9 +364,16 @@ class Accelerator:
             self._models.append(model)
         return model
 
-    def build_attention_fn(self):
+    def build_attention_fn(self, model_config=None):
         """The attention implementation this mesh calls for: ring attention
-        over cp, Ulysses over sp, or None (single-device attention)."""
+        over cp, Ulysses over sp, or None (single-device attention).
+
+        ``model_config``: when the model asks for the Pallas flash kernel
+        (``attention_impl="flash"``), Ulysses' LOCAL full-sequence attention
+        (post head-scatter, offset 0) runs it — the flash speedup composes
+        with SP. Ring steps keep the blockwise partials (they need
+        unnormalized stats with shard offsets).
+        """
         pcfg = self.parallelism_config
         if pcfg.cp_enabled:
             from .ops.ring_attention import make_ring_attention
@@ -378,7 +387,21 @@ class Accelerator:
         if pcfg.sp_enabled:
             from .ops.ulysses import make_ulysses_attention
 
-            return make_ulysses_attention(self.mesh)
+            inner = None
+            if getattr(model_config, "attention_impl", None) is not None:
+                from .ops.attention import dispatch_attention
+
+                # route the local attention through the shared dispatcher so
+                # the model's configured impl (flash/blockwise/xla) and its
+                # guards (non-causal fallback etc.) apply post head-scatter
+                inner = functools.partial(
+                    dispatch_attention,
+                    model_config.attention_impl,
+                    kv_block=getattr(model_config, "attention_kv_block", 512),
+                    block_q=getattr(model_config, "attention_block_q", 2048),
+                )
+
+            return make_ulysses_attention(self.mesh, inner=inner)
         return None
 
     def prepare_optimizer(self, optimizer, device_placement=None) -> AcceleratedOptimizer:

@@ -241,3 +241,31 @@ def test_cp_config_rejects_bad_kv_block():
     with pytest.raises(ValueError, match="kv_block"):
         ContextParallelConfig(kv_block=0)
     ContextParallelConfig(kv_block=None)  # disabled is fine
+
+
+def test_ulysses_flash_inner_matches_blockwise():
+    """SP with attention_impl='flash': Ulysses' local attention runs the
+    Pallas kernel (interpret on CPU) and must match the blockwise inner."""
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.models.llama import LlamaConfig, create_llama
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    for S in (AcceleratorState, GradientState, PartialState):
+        S._reset_state()
+    ids = np.stack([np.arange(32, dtype=np.int32) % 256] * 8)
+
+    outs = {}
+    for impl in ("blockwise", "flash"):
+        for S in (AcceleratorState, GradientState, PartialState):
+            S._reset_state()
+        cfg = LlamaConfig.tiny(
+            compute_dtype=jnp.float32, attention_impl=impl,
+            num_attention_heads=4, num_key_value_heads=4,
+            attention_kv_block=16, attention_block_q=16,
+        )
+        acc = Accelerator(parallelism_config=ParallelismConfig(
+            dp_shard_size=2, sp_size=4))
+        model = acc.prepare(create_llama(cfg, seed=0))
+        model.policy = None
+        outs[impl] = np.asarray(model(jnp.asarray(ids)))
+    np.testing.assert_allclose(outs["flash"], outs["blockwise"], atol=2e-4)
