@@ -250,8 +250,6 @@ def test_ulysses_flash_inner_matches_blockwise():
     from accelerate_tpu.models.llama import LlamaConfig, create_llama
     from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
 
-    for S in (AcceleratorState, GradientState, PartialState):
-        S._reset_state()
     ids = np.stack([np.arange(32, dtype=np.int32) % 256] * 8)
 
     outs = {}

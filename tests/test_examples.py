@@ -172,3 +172,18 @@ def test_packed_sft_example():
     result = _run("by_feature/packed_sft.py", "--steps", "2")
     assert result.returncode == 0, result.stderr[-2000:]
     assert "fill" in result.stdout and "packed training loss" in result.stdout
+
+
+@pytest.mark.slow
+def test_attention_bench_harness():
+    """The kernel microbench must run end-to-end on CPU (interpret-mode
+    flash) so the TPU window can just execute it."""
+    result = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "benchmarks", "attention_bench.py"),
+         "--seqs", "128", "--iters", "1", "--fwd_only",
+         "--out", "/dev/null"],
+        env=_ENV, capture_output=True, text=True, timeout=400,
+    )
+    assert result.returncode == 0, result.stderr[-2000:]
+    lines = [l for l in result.stdout.splitlines() if l.startswith("{")]
+    assert len(lines) == 3  # flash, blockwise, xla all produced a row
