@@ -53,6 +53,8 @@ class LlamaConfig:
     # keys only (None = full causal). The flash kernel grid-prunes
     # out-of-window kv tiles, so long-seq compute is O(S·W) per row.
     sliding_window: Optional[int] = None
+    # Qwen2-style biases on the q/k/v projections (o_proj stays bias-free)
+    attention_bias: bool = False
     tie_word_embeddings: bool = False
     param_dtype: Any = jnp.float32
     compute_dtype: Any = jnp.bfloat16
@@ -102,6 +104,27 @@ class LlamaConfig:
             # top-2 unconditionally, so faithful inference must not drop;
             # lower this for capacity-bounded training at scale
             expert_capacity_factor=8.0,
+        ), **overrides})
+
+    @classmethod
+    def llama3_8b(cls, **overrides) -> "LlamaConfig":
+        """Llama-3-8B shape (HF meta-llama/Meta-Llama-3-8B): GQA (8 kv
+        heads), 128k vocab, rope_theta=500000."""
+        return cls(**{**dict(
+            vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+            num_hidden_layers=32, num_attention_heads=32, num_key_value_heads=8,
+            max_position_embeddings=8192, rope_theta=500000.0,
+        ), **overrides})
+
+    @classmethod
+    def qwen2_7b(cls, **overrides) -> "LlamaConfig":
+        """Qwen2-7B shape (HF Qwen/Qwen2-7B): llama architecture + GQA (4 kv
+        heads) + q/k/v projection BIASES (attention_bias) + tied-free head."""
+        return cls(**{**dict(
+            vocab_size=152064, hidden_size=3584, intermediate_size=18944,
+            num_hidden_layers=28, num_attention_heads=28, num_key_value_heads=4,
+            max_position_embeddings=32768, rope_theta=1e6,
+            attention_bias=True, rms_norm_eps=1e-6,
         ), **overrides})
 
     @classmethod
@@ -164,13 +187,20 @@ def init_llama_params(config: LlamaConfig, key: jax.Array) -> dict:
             "down_proj": {"kernel": stack_init(keys[7], i, d)},
         }
 
+    def proj(k, in_dim, out_dim, bias):
+        entry = {"kernel": stack_init(k, in_dim, out_dim)}
+        if bias:
+            entry["bias"] = jnp.zeros((L, out_dim), dtype=dt)
+        return entry
+
+    ab = config.attention_bias
     params = {
         "embed_tokens": {"embedding": (jax.random.normal(keys[0], (v, d)) * 0.02).astype(dt)},
         "layers": {
             "attn": {
-                "q_proj": {"kernel": stack_init(keys[1], d, h * hd)},
-                "k_proj": {"kernel": stack_init(keys[2], d, kvh * hd)},
-                "v_proj": {"kernel": stack_init(keys[3], d, kvh * hd)},
+                "q_proj": proj(keys[1], d, h * hd, ab),
+                "k_proj": proj(keys[2], d, kvh * hd, ab),
+                "v_proj": proj(keys[3], d, kvh * hd, ab),
                 "o_proj": {"kernel": stack_init(keys[4], h * hd, d)},
             },
             "mlp": mlp,
@@ -290,9 +320,17 @@ def _layer(
 
     residual = x
     y = rms_norm(x, layer_params["input_norm"]["scale"], config.rms_norm_eps)
-    q = _dot(config, y, layer_params["attn"]["q_proj"]["kernel"].astype(cdt)).reshape(b, s, h, hd)
-    k = _dot(config, y, layer_params["attn"]["k_proj"]["kernel"].astype(cdt)).reshape(b, s, kvh, hd)
-    v = _dot(config, y, layer_params["attn"]["v_proj"]["kernel"].astype(cdt)).reshape(b, s, kvh, hd)
+
+    def _proj(name):
+        p = layer_params["attn"][name]
+        out = _dot(config, y, p["kernel"].astype(cdt))
+        if "bias" in p:  # Qwen2-style q/k/v biases (config.attention_bias)
+            out = out + p["bias"].astype(cdt)
+        return out
+
+    q = _proj("q_proj").reshape(b, s, h, hd)
+    k = _proj("k_proj").reshape(b, s, kvh, hd)
+    v = _proj("v_proj").reshape(b, s, kvh, hd)
     q = apply_rope(q, position_offset, config.rope_theta, position_ids)
     k = apply_rope(k, position_offset, config.rope_theta, position_ids)
     kv_out = (k, v) if collect_kv else None
@@ -679,6 +717,29 @@ def convert_hf_state_dict(config: LlamaConfig, flat: dict) -> dict:
         layer_map = _HF_LAYER_MAP
     for hf_suffix, (group, name) in layer_map.items():
         params["layers"][group][name] = {"kernel": stacked(hf_suffix, transpose=True)}
+    if not config.attention_bias and "model.layers.0.self_attn.q_proj.bias" in flat:
+        raise ValueError(
+            "checkpoint carries q/k/v projection biases (Qwen2-style) but "
+            "config.attention_bias=False — they would be silently dropped "
+            "and every logit would diverge from HF; set attention_bias=True "
+            "(see LlamaConfig.qwen2_7b)"
+        )
+    if config.attention_bias:
+        # Qwen2-style q/k/v biases; q/k biases live in the same rotate-half
+        # row layout as the kernels, so the same unpermute applies (as a
+        # 1-column matrix)
+        for name, heads in (("q_proj", config.num_attention_heads),
+                            ("k_proj", config.num_key_value_heads),
+                            ("v_proj", None)):
+            rows = []
+            for i in range(L):
+                bvec = np.asarray(flat[f"model.layers.{i}.self_attn.{name}.bias"])
+                if heads is not None:
+                    bvec = _rope_unpermute(bvec[:, None], heads, config.head_dim)[:, 0]
+                rows.append(bvec)
+            params["layers"]["attn"][name]["bias"] = jnp.asarray(
+                np.stack(rows), dtype=config.param_dtype
+            )
     if not config.tie_word_embeddings:
         if "lm_head.weight" in flat:
             params["lm_head"] = {
@@ -718,11 +779,17 @@ def export_hf_state_dict(config: LlamaConfig, params: dict) -> dict:
             rope_heads = config.num_attention_heads
         elif name == "k_proj":
             rope_heads = config.num_key_value_heads
+        bias = params["layers"][group][name].get("bias")
         for i in range(L):
             w = stacked[i].T  # → torch layout (out, in)
             if rope_heads is not None:
                 w = _rope_permute(w, rope_heads, config.head_dim)
             out[f"model.layers.{i}.{hf_suffix}"] = w
+            if bias is not None:
+                bvec = np.asarray(bias)[i]
+                if rope_heads is not None:
+                    bvec = _rope_permute(bvec[:, None], rope_heads, config.head_dim)[:, 0]
+                out[f"model.layers.{i}.{hf_suffix[:-len('.weight')]}.bias"] = bvec
     for i in range(L):
         out[f"model.layers.{i}.input_layernorm.weight"] = np.asarray(
             params["layers"]["input_norm"]["scale"]
@@ -767,9 +834,16 @@ def _decode_layer(config: LlamaConfig, layer_params, x, cache_k, cache_v, pos):
 
     residual = x
     y = rms_norm(x, layer_params["input_norm"]["scale"], config.rms_norm_eps)
-    q = (y @ layer_params["attn"]["q_proj"]["kernel"].astype(cdt)).reshape(b, s, h, hd)
-    k = (y @ layer_params["attn"]["k_proj"]["kernel"].astype(cdt)).reshape(b, s, kvh, hd)
-    v = (y @ layer_params["attn"]["v_proj"]["kernel"].astype(cdt)).reshape(b, s, kvh, hd)
+    def _dproj(name):
+        p = layer_params["attn"][name]
+        out = y @ p["kernel"].astype(cdt)
+        if "bias" in p:
+            out = out + p["bias"].astype(cdt)
+        return out
+
+    q = _dproj("q_proj").reshape(b, s, h, hd)
+    k = _dproj("k_proj").reshape(b, s, kvh, hd)
+    v = _dproj("v_proj").reshape(b, s, kvh, hd)
     q = apply_rope_at(q, pos, config.rope_theta)
     k = apply_rope_at(k, pos, config.rope_theta)
     cache_k = lax.dynamic_update_slice(cache_k, k.astype(cache_k.dtype), (0, pos, 0, 0))

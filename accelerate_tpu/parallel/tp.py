@@ -36,7 +36,7 @@ def tensor_parallel_rules(
         # two c_proj kernels (both are residual-path projections)
         (r"(o_proj|out_proj|wo|fc2|w2|down_proj|c_proj)/kernel", P(L, tp_axis, None)),
         # column-parallel biases ride the sharded output dim
-        (r"(c_attn_[qkv]|c_fc)/bias", P(L, tp_axis)),
+        (r"(q_proj|k_proj|v_proj|c_attn_[qkv]|c_fc)/bias", P(L, tp_axis)),
         # unstacked head/embedding tables
         (r"(embed_tokens|wte|word_embeddings)/(embedding|weight)", P(tp_axis, None)),
         (r"lm_head/kernel", P(None, tp_axis)),
