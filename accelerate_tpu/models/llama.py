@@ -55,6 +55,12 @@ class LlamaConfig:
     sliding_window: Optional[int] = None
     # Qwen2-style biases on the q/k/v projections (o_proj stays bias-free)
     attention_bias: bool = False
+    # RoPE scaling for beyond-pretraining context (HF rope_scaling dict):
+    #   {"rope_type": "linear", "factor": f}  — all frequencies / f
+    #   {"rope_type": "llama3", "factor": f, "low_freq_factor": ...,
+    #    "high_freq_factor": ..., "original_max_position_embeddings": ...}
+    #     — Llama-3.1 wavelength-dependent scaling
+    rope_scaling: Optional[dict] = None
     tie_word_embeddings: bool = False
     param_dtype: Any = jnp.float32
     compute_dtype: Any = jnp.bfloat16
@@ -83,6 +89,12 @@ class LlamaConfig:
     @property
     def head_dim(self) -> int:
         return self.hidden_size // self.num_attention_heads
+
+    def _rope_scaling_key(self):
+        """Hashable form for the host-side rope-table cache."""
+        if self.rope_scaling is None:
+            return None
+        return tuple(sorted(self.rope_scaling.items()))
 
     @classmethod
     def llama2_7b(cls, **overrides) -> "LlamaConfig":
@@ -115,6 +127,21 @@ class LlamaConfig:
             num_hidden_layers=32, num_attention_heads=32, num_key_value_heads=8,
             max_position_embeddings=8192, rope_theta=500000.0,
         ), **overrides})
+
+    @classmethod
+    def llama3_1_8b(cls, **overrides) -> "LlamaConfig":
+        """Llama-3.1-8B shape: llama3_8b + 128k context via llama3-type
+        rope scaling."""
+        return dataclasses.replace(
+            cls.llama3_8b(),
+            max_position_embeddings=131072,
+            rope_scaling={
+                "rope_type": "llama3", "factor": 8.0,
+                "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                "original_max_position_embeddings": 8192,
+            },
+            **overrides,
+        )
 
     @classmethod
     def qwen2_7b(cls, **overrides) -> "LlamaConfig":
@@ -221,24 +248,56 @@ def rms_norm(x, scale, eps):
     return (y * scale.astype(jnp.float32)).astype(x.dtype)
 
 
+def _rope_freqs(head_dim: int, theta: float, scaling=None) -> np.ndarray:
+    """Base inverse frequencies, optionally rope-scaled. ``scaling`` is the
+    hashable ``LlamaConfig._rope_scaling_key()`` tuple (or None)."""
+    freqs = 1.0 / (theta ** (np.arange(0, head_dim, 2) / head_dim))
+    if scaling is None:
+        return freqs
+    cfg = dict(scaling)
+    rope_type = cfg.get("rope_type", cfg.get("type"))
+    if rope_type is None:
+        raise ValueError(
+            "rope_scaling needs an explicit 'rope_type' ('linear' or "
+            "'llama3') — defaulting silently would apply the wrong geometry"
+        )
+    factor = float(cfg.get("factor", 1.0))
+    if rope_type == "linear":
+        # position/f is the same angle as freq/f (reference linear scaling)
+        return freqs / factor
+    if rope_type == "llama3":
+        # HF Llama-3.1: long wavelengths scale by 1/f, short ones keep the
+        # pretrained geometry, mid-band interpolates smoothly
+        low = float(cfg.get("low_freq_factor", 1.0))
+        high = float(cfg.get("high_freq_factor", 4.0))
+        orig = float(cfg.get("original_max_position_embeddings", 8192))
+        wavelen = 2 * np.pi / freqs
+        smooth = (orig / wavelen - low) / (high - low)
+        smooth = np.clip(smooth, 0.0, 1.0)
+        return (1 - smooth) * freqs / factor + smooth * freqs
+    raise ValueError(f"unsupported rope_scaling type {rope_type!r} "
+                     "(supported: linear, llama3)")
+
+
 @functools.lru_cache(maxsize=8)
-def _rope_tables(seq_len: int, head_dim: int, theta: float):
+def _rope_tables(seq_len: int, head_dim: int, theta: float, scaling=None):
     # host-side cache (numpy) — jnp conversion happens per-trace so no tracers
     # leak into the cache
     pos = np.arange(seq_len)
-    freqs = 1.0 / (theta ** (np.arange(0, head_dim, 2) / head_dim))
+    freqs = _rope_freqs(head_dim, theta, scaling)
     angles = np.outer(pos, freqs)  # (S, hd/2)
     return np.cos(angles).astype(np.float32), np.sin(angles).astype(np.float32)
 
 
 def apply_rope(x: jax.Array, position_offset: int, theta: float,
-               position_ids=None) -> jax.Array:
+               position_ids=None, scaling=None) -> jax.Array:
     """Rotary embedding on (B, S, H, D); ``position_offset`` supports CP/SP
     shards that start mid-sequence. ``position_ids`` (B, S) overrides with
     per-token positions (packed rows restart at each document —
-    utils/native.packed_position_ids)."""
+    utils/native.packed_position_ids). ``scaling``: rope-scaling key
+    (LlamaConfig._rope_scaling_key)."""
     b, s, h, d = x.shape
-    cos_np, sin_np = _rope_tables(s + position_offset, d, theta)
+    cos_np, sin_np = _rope_tables(s + position_offset, d, theta, scaling)
     if position_ids is not None:
         cos = jnp.asarray(cos_np)[position_ids][:, :, None, :]  # (B, S, 1, hd/2)
         sin = jnp.asarray(sin_np)[position_ids][:, :, None, :]
@@ -331,8 +390,9 @@ def _layer(
     q = _proj("q_proj").reshape(b, s, h, hd)
     k = _proj("k_proj").reshape(b, s, kvh, hd)
     v = _proj("v_proj").reshape(b, s, kvh, hd)
-    q = apply_rope(q, position_offset, config.rope_theta, position_ids)
-    k = apply_rope(k, position_offset, config.rope_theta, position_ids)
+    _sc = config._rope_scaling_key()
+    q = apply_rope(q, position_offset, config.rope_theta, position_ids, _sc)
+    k = apply_rope(k, position_offset, config.rope_theta, position_ids, _sc)
     kv_out = (k, v) if collect_kv else None
     attn = _attention(
         config, q, k, v, attention_fn, q_offset=position_offset,
@@ -844,8 +904,8 @@ def _decode_layer(config: LlamaConfig, layer_params, x, cache_k, cache_v, pos):
     q = _dproj("q_proj").reshape(b, s, h, hd)
     k = _dproj("k_proj").reshape(b, s, kvh, hd)
     v = _dproj("v_proj").reshape(b, s, kvh, hd)
-    q = apply_rope_at(q, pos, config.rope_theta)
-    k = apply_rope_at(k, pos, config.rope_theta)
+    q = apply_rope_at(q, pos, config.rope_theta, config._rope_scaling_key())
+    k = apply_rope_at(k, pos, config.rope_theta, config._rope_scaling_key())
     cache_k = lax.dynamic_update_slice(cache_k, k.astype(cache_k.dtype), (0, pos, 0, 0))
     cache_v = lax.dynamic_update_slice(cache_v, v.astype(cache_v.dtype), (0, pos, 0, 0))
     # attend over positions 0..pos (mask the tail)
@@ -893,12 +953,10 @@ def repeat_kv_cache(c, n_rep):
     return jnp.broadcast_to(c[:, :, :, None, :], (b, s, h, n_rep, d)).reshape(b, s, h * n_rep, d)
 
 
-def apply_rope_at(x, pos, theta):
+def apply_rope_at(x, pos, theta, scaling=None):
     """RoPE for a single traced position ``pos`` (decode step)."""
     b, s, h, d = x.shape
-    freqs = jnp.asarray(
-        1.0 / (theta ** (np.arange(0, d, 2, dtype=np.float32) / d)), dtype=jnp.float32
-    )
+    freqs = jnp.asarray(_rope_freqs(d, theta, scaling), dtype=jnp.float32)
     angles = pos.astype(jnp.float32) * freqs  # (d/2,)
     cos = jnp.cos(angles)[None, None, None, :]
     sin = jnp.sin(angles)[None, None, None, :]

@@ -283,3 +283,76 @@ def test_convert_rejects_dropped_biases():
     cfg_nb = LlamaConfig.tiny(attention_bias=False)
     with pytest.raises(ValueError, match="attention_bias"):
         convert_hf_state_dict(cfg_nb, flat)
+
+
+def test_rope_scaling_llama3_matches_hf():
+    """llama3-type rope scaling (Llama-3.1): converted HF checkpoint with
+    rope_scaling active must match logits at positions beyond the original
+    context geometry's comfort zone."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    scaling = {
+        "rope_type": "llama3", "factor": 4.0,
+        "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+        "original_max_position_embeddings": 16,
+    }
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rope_scaling=dict(scaling),
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    hf_model = transformers.LlamaForCausalLM(hf_cfg).eval()
+    ids = np.random.default_rng(0).integers(0, 128, size=(2, 48))
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(ids)).logits.numpy()
+
+    cfg = LlamaConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rope_scaling=dict(scaling),
+        rms_norm_eps=hf_cfg.rms_norm_eps,
+        compute_dtype=jnp.float32, attention_impl="xla",
+    )
+    flat = {k: v.numpy() for k, v in hf_model.state_dict().items()}
+    params = convert_hf_state_dict(cfg, flat)
+    ours = np.asarray(llama_apply(cfg, params, ids.astype(np.int32)))
+    np.testing.assert_allclose(ours, hf_logits, atol=2e-4)
+
+
+def test_rope_scaling_decode_matches_full():
+    from accelerate_tpu.models.llama import llama_decode_step
+
+    cfg = LlamaConfig.tiny(
+        compute_dtype=jnp.float32,
+        rope_scaling={"rope_type": "linear", "factor": 2.0},
+    )
+    params = init_llama_params(cfg, jax.random.key(0))
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(4, cfg.vocab_size, size=(2, 8)).astype(np.int32))
+    full = np.asarray(llama_apply(cfg, params, ids))
+    kvh, hd, L = cfg.num_key_value_heads, cfg.head_dim, cfg.num_hidden_layers
+    cache = {
+        "k": jnp.zeros((L, 2, 8, kvh, hd), jnp.float32),
+        "v": jnp.zeros((L, 2, 8, kvh, hd), jnp.float32),
+    }
+    for t in range(8):
+        step_logits, cache = llama_decode_step(
+            cfg, params, cache, ids[:, t : t + 1], jnp.int32(t)
+        )
+        np.testing.assert_allclose(np.asarray(step_logits), full[:, t],
+                                   atol=1e-4, rtol=1e-4)
+    # scaling actually changes the geometry vs unscaled
+    plain = np.asarray(llama_apply(LlamaConfig.tiny(compute_dtype=jnp.float32),
+                                   params, ids))
+    assert np.abs(plain - full).max() > 1e-3
+
+
+def test_rope_scaling_requires_explicit_type():
+    cfg = LlamaConfig.tiny(rope_scaling={"factor": 8.0})
+    ids = np.zeros((1, 8), np.int32)
+    params = init_llama_params(LlamaConfig.tiny(), jax.random.key(0))
+    with pytest.raises(ValueError, match="rope_type"):
+        llama_apply(cfg, params, ids)
