@@ -61,6 +61,13 @@ class LlamaConfig:
     #    "high_freq_factor": ..., "original_max_position_embeddings": ...}
     #     — Llama-3.1 wavelength-dependent scaling
     rope_scaling: Optional[dict] = None
+    # Gemma-family knobs: decoupled head_dim (None = hidden/heads), GeGLU
+    # MLP act, zero-centered (1+scale) RMSNorm weights, sqrt(d) embedding
+    # scaling
+    head_dim: Optional[int] = None
+    hidden_act: str = "silu"  # "silu" | "gelu_tanh"
+    rms_norm_offset: bool = False
+    scale_embeddings: bool = False
     tie_word_embeddings: bool = False
     param_dtype: Any = jnp.float32
     compute_dtype: Any = jnp.bfloat16
@@ -86,9 +93,19 @@ class LlamaConfig:
     use_chunked_ce: bool = False
     ce_chunk_size: int = 4096
 
-    @property
-    def head_dim(self) -> int:
-        return self.hidden_size // self.num_attention_heads
+    def __post_init__(self):
+        # resolved at CONSTRUCTION: when resizing an existing config via
+        # dataclasses.replace, pass head_dim=None explicitly (or use the
+        # preset factories, which construct fresh) — a stale resolved value
+        # cannot be distinguished from a deliberately decoupled one
+        if self.head_dim is None:
+            self.head_dim = self.hidden_size // self.num_attention_heads
+        if self.num_experts > 1 and self.hidden_act != "silu":
+            raise ValueError(
+                "hidden_act is silu-only on the MoE path (ops/moe.py expert "
+                f"FFNs); got {self.hidden_act!r} with num_experts="
+                f"{self.num_experts}"
+            )
 
     def _rope_scaling_key(self):
         """Hashable form for the host-side rope-table cache."""
@@ -132,8 +149,9 @@ class LlamaConfig:
     def llama3_1_8b(cls, **overrides) -> "LlamaConfig":
         """Llama-3.1-8B shape: llama3_8b + 128k context via llama3-type
         rope scaling."""
-        return dataclasses.replace(
-            cls.llama3_8b(),
+        # ride the llama3_8b factory (fresh construction) so overrides like
+        # hidden_size re-derive head_dim instead of inheriting a stale one
+        return cls.llama3_8b(
             max_position_embeddings=131072,
             rope_scaling={
                 "rope_type": "llama3", "factor": 8.0,
@@ -152,6 +170,19 @@ class LlamaConfig:
             num_hidden_layers=28, num_attention_heads=28, num_key_value_heads=4,
             max_position_embeddings=32768, rope_theta=1e6,
             attention_bias=True, rms_norm_eps=1e-6,
+        ), **overrides})
+
+    @classmethod
+    def gemma_7b(cls, **overrides) -> "LlamaConfig":
+        """Gemma-7B shape (HF google/gemma-7b): decoupled head_dim=256
+        (16 heads x 256 = 4096 != hidden 3072), GeGLU MLP, zero-centered
+        (1+w) RMSNorm, sqrt(d)-scaled embeddings, tied head."""
+        return cls(**{**dict(
+            vocab_size=256000, hidden_size=3072, intermediate_size=24576,
+            num_hidden_layers=28, num_attention_heads=16, num_key_value_heads=16,
+            head_dim=256, max_position_embeddings=8192, rms_norm_eps=1e-6,
+            hidden_act="gelu_tanh", rms_norm_offset=True,
+            scale_embeddings=True, tie_word_embeddings=True,
         ), **overrides})
 
     @classmethod
@@ -214,6 +245,10 @@ def init_llama_params(config: LlamaConfig, key: jax.Array) -> dict:
             "down_proj": {"kernel": stack_init(keys[7], i, d)},
         }
 
+    def norm_init(shape):
+        # offset convention stores zero-centered weights ((1+w) effective)
+        return (jnp.zeros if config.rms_norm_offset else jnp.ones)(shape, dtype=dt)
+
     def proj(k, in_dim, out_dim, bias):
         entry = {"kernel": stack_init(k, in_dim, out_dim)}
         if bias:
@@ -231,10 +266,10 @@ def init_llama_params(config: LlamaConfig, key: jax.Array) -> dict:
                 "o_proj": {"kernel": stack_init(keys[4], h * hd, d)},
             },
             "mlp": mlp,
-            "input_norm": {"scale": jnp.ones((L, d), dtype=dt)},
-            "post_attn_norm": {"scale": jnp.ones((L, d), dtype=dt)},
+            "input_norm": {"scale": norm_init((L, d))},
+            "post_attn_norm": {"scale": norm_init((L, d))},
         },
-        "final_norm": {"scale": jnp.ones((d,), dtype=dt)},
+        "final_norm": {"scale": norm_init((d,))},
     }
     if not config.tie_word_embeddings:
         params["lm_head"] = {"kernel": _init_dense(keys[0], d, v, dt)}
@@ -242,10 +277,24 @@ def init_llama_params(config: LlamaConfig, key: jax.Array) -> dict:
 
 
 # ------------------------------------------------------------------ forward
-def rms_norm(x, scale, eps):
+def _mlp_act(config, gate):
+    """SwiGLU's silu or Gemma's GeGLU tanh-gelu on the gate projection."""
+    if config.hidden_act == "gelu_tanh":
+        return jax.nn.gelu(gate, approximate=True)
+    if config.hidden_act != "silu":
+        raise ValueError(f"unsupported hidden_act {config.hidden_act!r}")
+    return jax.nn.silu(gate)
+
+
+def rms_norm(x, scale, eps, offset: bool = False):
+    """``offset=True``: Gemma convention — stored weights are zero-centered
+    and the effective scale is (1 + w)."""
     var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
     y = x.astype(jnp.float32) * jax.lax.rsqrt(var + eps)
-    return (y * scale.astype(jnp.float32)).astype(x.dtype)
+    w = scale.astype(jnp.float32)
+    if offset:
+        w = 1.0 + w
+    return (y * w).astype(x.dtype)
 
 
 def _rope_freqs(head_dim: int, theta: float, scaling=None) -> np.ndarray:
@@ -378,7 +427,7 @@ def _layer(
     cdt = config.compute_dtype
 
     residual = x
-    y = rms_norm(x, layer_params["input_norm"]["scale"], config.rms_norm_eps)
+    y = rms_norm(x, layer_params["input_norm"]["scale"], config.rms_norm_eps, config.rms_norm_offset)
 
     def _proj(name):
         p = layer_params["attn"][name]
@@ -403,7 +452,7 @@ def _layer(
     x = constrain_activation(residual + attn)
 
     residual = x
-    y = rms_norm(x, layer_params["post_attn_norm"]["scale"], config.rms_norm_eps)
+    y = rms_norm(x, layer_params["post_attn_norm"]["scale"], config.rms_norm_eps, config.rms_norm_offset)
     if config.num_experts > 1:
         from ..ops.moe import moe_ffn
 
@@ -420,7 +469,7 @@ def _layer(
     else:
         gate = _dot(config, y, layer_params["mlp"]["gate_proj"]["kernel"].astype(cdt))
         up = _dot(config, y, layer_params["mlp"]["up_proj"]["kernel"].astype(cdt))
-        y = constrain_activation(jax.nn.silu(gate) * up, "intermediate")
+        y = constrain_activation(_mlp_act(config, gate) * up, "intermediate")
         y = _dot(config, y, layer_params["mlp"]["down_proj"]["kernel"].astype(cdt))
         aux = jnp.float32(0.0)
     y = checkpoint_name(y, "mlp_block_out")
@@ -457,7 +506,10 @@ def llama_apply(
     # a gather from a sharded table is the partitioner's worst case (it
     # replicates involuntarily); same bytes moved, no pathological reshard
     table = replicate_over_fsdp(params["embed_tokens"]["embedding"], keep_tp=False)
-    x = constrain_activation(table.astype(cdt)[input_ids])
+    x = table.astype(cdt)[input_ids]
+    if config.scale_embeddings:  # Gemma: sqrt(d) in the embedding path
+        x = x * jnp.asarray(config.hidden_size**0.5, dtype=cdt)
+    x = constrain_activation(x)
 
     layer_fn = functools.partial(
         _layer, config, position_offset=position_offset,
@@ -487,7 +539,7 @@ def llama_apply(
             aux_total = aux_total + aux
         aux_total = aux_total * config.moe_aux_loss_coef
 
-    x = rms_norm(x, params["final_norm"]["scale"], config.rms_norm_eps)
+    x = rms_norm(x, params["final_norm"]["scale"], config.rms_norm_eps, config.rms_norm_offset)
     head = (
         params["embed_tokens"]["embedding"].T
         if config.tie_word_embeddings
@@ -640,9 +692,10 @@ def llama_pipeline_parts(config: LlamaConfig, attention_fn: Optional[Callable] =
         layer_fn = jax.checkpoint(layer_fn, policy=policy)
 
     def embed_fn(params, mb):
-        return constrain_activation(
-            params["embed_tokens"]["embedding"].astype(cdt)[mb["input_ids"]]
-        )
+        x = params["embed_tokens"]["embedding"].astype(cdt)[mb["input_ids"]]
+        if config.scale_embeddings:  # Gemma: sqrt(d) in the embedding path
+            x = x * jnp.asarray(config.hidden_size**0.5, dtype=cdt)
+        return constrain_activation(x)
 
     def stage_fn(stage_params, h):
         def body(h, lp):
@@ -657,7 +710,7 @@ def llama_pipeline_parts(config: LlamaConfig, attention_fn: Optional[Callable] =
         schedule: it divides by the GLOBAL valid-token count from
         :func:`llama_ce_denominator`, so per-microbatch mask imbalance keeps
         exactly llama_loss's sum/count semantics)."""
-        x = rms_norm(h, params["final_norm"]["scale"], config.rms_norm_eps)
+        x = rms_norm(h, params["final_norm"]["scale"], config.rms_norm_eps, config.rms_norm_offset)
         head = (
             params["embed_tokens"]["embedding"].T
             if config.tie_word_embeddings
@@ -893,7 +946,7 @@ def _decode_layer(config: LlamaConfig, layer_params, x, cache_k, cache_v, pos):
     cdt = config.compute_dtype
 
     residual = x
-    y = rms_norm(x, layer_params["input_norm"]["scale"], config.rms_norm_eps)
+    y = rms_norm(x, layer_params["input_norm"]["scale"], config.rms_norm_eps, config.rms_norm_offset)
     def _dproj(name):
         p = layer_params["attn"][name]
         out = y @ p["kernel"].astype(cdt)
@@ -924,7 +977,7 @@ def _decode_layer(config: LlamaConfig, layer_params, x, cache_k, cache_v, pos):
     x = residual + attn
 
     residual = x
-    y = rms_norm(x, layer_params["post_attn_norm"]["scale"], config.rms_norm_eps)
+    y = rms_norm(x, layer_params["post_attn_norm"]["scale"], config.rms_norm_eps, config.rms_norm_offset)
     if config.num_experts > 1:
         from ..ops.moe import moe_ffn
 
@@ -941,7 +994,7 @@ def _decode_layer(config: LlamaConfig, layer_params, x, cache_k, cache_v, pos):
     else:
         gate = y @ layer_params["mlp"]["gate_proj"]["kernel"].astype(cdt)
         up = y @ layer_params["mlp"]["up_proj"]["kernel"].astype(cdt)
-        y = jax.nn.silu(gate) * up
+        y = _mlp_act(config, gate) * up
         y = y @ layer_params["mlp"]["down_proj"]["kernel"].astype(cdt)
     return residual + y, cache_k, cache_v
 
@@ -973,6 +1026,8 @@ def llama_prefill(config: LlamaConfig, params, input_ids, max_len: int):
     cdt = config.compute_dtype
     b, s = input_ids.shape
     x = params["embed_tokens"]["embedding"].astype(cdt)[input_ids]
+    if config.scale_embeddings:
+        x = x * jnp.asarray(config.hidden_size**0.5, dtype=cdt)
     layer_fn = functools.partial(_layer, config, position_offset=0, attention_fn=None, collect_kv=True)
 
     def body(x, layer_params):
@@ -980,7 +1035,7 @@ def llama_prefill(config: LlamaConfig, params, input_ids, max_len: int):
         return x, (k, v)
 
     x, (ks, vs) = lax.scan(body, x, params["layers"])  # ks: (L, B, S, kvh, hd)
-    x = rms_norm(x, params["final_norm"]["scale"], config.rms_norm_eps)
+    x = rms_norm(x, params["final_norm"]["scale"], config.rms_norm_eps, config.rms_norm_offset)
     if config.tie_word_embeddings:
         logits = x @ params["embed_tokens"]["embedding"].astype(cdt).T
     else:
@@ -998,6 +1053,8 @@ def llama_decode_step(config: LlamaConfig, params, cache, token, pos):
     scalar). Returns (logits (B, V), new cache)."""
     cdt = config.compute_dtype
     x = params["embed_tokens"]["embedding"].astype(cdt)[token]
+    if config.scale_embeddings:
+        x = x * jnp.asarray(config.hidden_size**0.5, dtype=cdt)
 
     def body(carry, inputs):
         x = carry
@@ -1006,7 +1063,7 @@ def llama_decode_step(config: LlamaConfig, params, cache, token, pos):
         return x, (ck, cv)
 
     x, (new_k, new_v) = lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
-    x = rms_norm(x, params["final_norm"]["scale"], config.rms_norm_eps)
+    x = rms_norm(x, params["final_norm"]["scale"], config.rms_norm_eps, config.rms_norm_offset)
     if config.tie_word_embeddings:
         logits = x @ params["embed_tokens"]["embedding"].astype(cdt).T
     else:

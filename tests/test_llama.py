@@ -356,3 +356,77 @@ def test_rope_scaling_requires_explicit_type():
     params = init_llama_params(LlamaConfig.tiny(), jax.random.key(0))
     with pytest.raises(ValueError, match="rope_type"):
         llama_apply(cfg, params, ids)
+
+
+def test_hf_gemma_logits_parity():
+    """Gemma family: decoupled head_dim, GeGLU, zero-centered (1+w)
+    RMSNorm, sqrt(d)-scaled embeddings, tied head — all through the shared
+    converter, torch-verified."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    hf_cfg = transformers.GemmaConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16,  # decoupled: 4 x 16 = 64 != hidden 32
+        max_position_embeddings=64, attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    hf_model = transformers.GemmaForCausalLM(hf_cfg).eval()
+    ids = np.random.default_rng(0).integers(0, 128, size=(2, 16))
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(ids)).logits.numpy()
+
+    cfg = LlamaConfig.gemma_7b(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=64,
+        rms_norm_eps=hf_cfg.rms_norm_eps,
+        compute_dtype=jnp.float32, attention_impl="xla",
+    )
+    flat = {k: v.numpy() for k, v in hf_model.state_dict().items()}
+    params = convert_hf_state_dict(cfg, flat)
+    ours = np.asarray(llama_apply(cfg, params, ids.astype(np.int32)))
+    np.testing.assert_allclose(ours, hf_logits, atol=3e-4)
+
+
+def test_gemma_config_trains_and_decodes():
+    from accelerate_tpu.models.llama import llama_decode_step
+
+    cfg = LlamaConfig.gemma_7b(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=32, max_position_embeddings=64, compute_dtype=jnp.float32,
+    )
+    assert cfg.head_dim == 32 and cfg.rms_norm_offset
+    params = init_llama_params(cfg, jax.random.key(0))
+    # offset norms initialize zero-centered
+    assert float(jnp.abs(params["layers"]["input_norm"]["scale"]).max()) == 0.0
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(4, 256, size=(2, 8)).astype(np.int32))
+    full = np.asarray(llama_apply(cfg, params, ids))
+    assert np.isfinite(full).all()
+
+    kvh, hd, L = cfg.num_key_value_heads, cfg.head_dim, cfg.num_hidden_layers
+    cache = {"k": jnp.zeros((L, 2, 8, kvh, hd), jnp.float32),
+             "v": jnp.zeros((L, 2, 8, kvh, hd), jnp.float32)}
+    for t in range(8):
+        step_logits, cache = llama_decode_step(
+            cfg, params, cache, ids[:, t : t + 1], jnp.int32(t))
+        np.testing.assert_allclose(np.asarray(step_logits), full[:, t],
+                                   atol=1e-4, rtol=1e-4)
+
+    def loss(p):
+        return jnp.mean(llama_apply(cfg, p, ids).astype(jnp.float32) ** 2)
+
+    g = jax.grad(loss)(params)
+    assert np.isfinite(np.asarray(g["layers"]["mlp"]["gate_proj"]["kernel"])).all()
+
+
+def test_preset_overrides_rederive_head_dim():
+    """Resizing a preset through its factory must re-derive head_dim (a
+    stale inherited value silently breaks q/k/v shapes)."""
+    cfg = LlamaConfig.llama3_1_8b(hidden_size=64, num_attention_heads=4)
+    assert cfg.head_dim == 16
+    with pytest.raises(ValueError, match="silu-only"):
+        LlamaConfig.tiny(num_experts=4, hidden_act="gelu_tanh")
