@@ -85,6 +85,11 @@ class LlamaConfig:
     num_experts_per_tok: int = 2
     expert_capacity_factor: float = 1.25
     moe_aux_loss_coef: float = 0.01
+    # ST-MoE router z-loss (logit-magnitude regularizer); 0 = off; 1e-3 is
+    # the paper default. Lands in the total loss at exactly this weight
+    # (per-layer auxes are pre-scaled inside moe_ffn and summed, never
+    # re-multiplied)
+    router_z_loss_coef: float = 0.0
     # fp8 projections (ops/fp8.py): e4m3 fwd / e5m2 bwd current scaling;
     # set by Accelerator when mixed_precision="fp8"
     use_fp8: bool = False
@@ -150,16 +155,16 @@ class LlamaConfig:
         """Llama-3.1-8B shape: llama3_8b + 128k context via llama3-type
         rope scaling."""
         # ride the llama3_8b factory (fresh construction) so overrides like
-        # hidden_size re-derive head_dim instead of inheriting a stale one
-        return cls.llama3_8b(
+        # hidden_size re-derive head_dim; dict-merge so max_position/
+        # rope_scaling themselves stay overridable like every sibling preset
+        return cls.llama3_8b(**{**dict(
             max_position_embeddings=131072,
             rope_scaling={
                 "rope_type": "llama3", "factor": 8.0,
                 "low_freq_factor": 1.0, "high_freq_factor": 4.0,
                 "original_max_position_embeddings": 8192,
             },
-            **overrides,
-        )
+        ), **overrides})
 
     @classmethod
     def qwen2_7b(cls, **overrides) -> "LlamaConfig":
@@ -465,6 +470,8 @@ def _layer(
             num_selected=config.num_experts_per_tok,
             capacity_factor=config.expert_capacity_factor,
             compute_dtype=cdt,
+            aux_loss_coef=config.moe_aux_loss_coef,
+            router_z_loss_coef=config.router_z_loss_coef,
         )
     else:
         gate = _dot(config, y, layer_params["mlp"]["gate_proj"]["kernel"].astype(cdt))
@@ -522,14 +529,14 @@ def llama_apply(
 
     if layer_stack_fn is not None:
         x, aux_raw = layer_stack_fn(params["layers"], x, layer_fn)
-        aux_total = aux_raw * config.moe_aux_loss_coef
+        aux_total = aux_raw  # per-layer auxes are pre-scaled (moe_ffn)
     elif config.scan_layers:
         def scan_body(x, layer_params):
             x, aux = layer_fn(layer_params, x)
             return x, aux
 
         x, aux_per_layer = lax.scan(scan_body, x, params["layers"])
-        aux_total = jnp.sum(aux_per_layer) * config.moe_aux_loss_coef
+        aux_total = jnp.sum(aux_per_layer)  # pre-scaled per layer
     else:
         L = config.num_hidden_layers
         aux_total = jnp.float32(0.0)
@@ -537,7 +544,7 @@ def llama_apply(
             lp = jax.tree_util.tree_map(lambda p: p[li], params["layers"])
             x, aux = layer_fn(lp, x)
             aux_total = aux_total + aux
-        aux_total = aux_total * config.moe_aux_loss_coef
+        # aux_total already pre-scaled per layer
 
     x = rms_norm(x, params["final_norm"]["scale"], config.rms_norm_eps, config.rms_norm_offset)
     head = (

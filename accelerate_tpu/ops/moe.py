@@ -22,7 +22,7 @@ from typing import NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 
-__all__ = ["Routing", "route_topk", "moe_ffn", "load_balancing_loss"]
+__all__ = ["Routing", "route_topk", "moe_ffn", "load_balancing_loss", "router_z_loss"]
 
 
 class Routing(NamedTuple):
@@ -76,6 +76,15 @@ def route_topk(
     return Routing(dispatch_tensor, combine_tensor, aux, probs)
 
 
+def router_z_loss(router_logits: jax.Array) -> jax.Array:
+    """ST-MoE router z-loss: mean logsumexp(logits)² — keeps router logits
+    small so the f32 softmax stays well-conditioned in long bf16 runs
+    (Zoph et al. 2022, eq. 5). Scale with ``router_z_loss_coef`` (1e-3
+    is the paper default) and add to the load-balancing aux."""
+    z = jax.scipy.special.logsumexp(router_logits.astype(jnp.float32), axis=-1)
+    return jnp.mean(jnp.square(z))
+
+
 def load_balancing_loss(router_probs: jax.Array, dispatch_mask: jax.Array) -> jax.Array:
     """Switch-Transformer aux loss: E * Σ_e fraction_tokens_e · mean_prob_e —
     minimized by a uniform assignment."""
@@ -95,6 +104,8 @@ def moe_ffn(
     num_selected: int = 2,
     capacity_factor: float = 1.25,
     compute_dtype=jnp.bfloat16,
+    aux_loss_coef: float = 1.0,
+    router_z_loss_coef: float = 0.0,
 ) -> tuple[jax.Array, jax.Array]:
     """SwiGLU expert FFN with top-k routing.
 
@@ -114,6 +125,12 @@ def moe_ffn(
 
     router_logits = tokens.astype(jnp.float32) @ router_kernel.astype(jnp.float32)
     routing = route_topk(router_logits, num_selected, capacity)
+    # the returned aux is PRE-SCALED: coef * load-balance + coef_z * z-loss,
+    # each at face value — callers sum per-layer auxes into the total loss
+    # with no further multiply (so disabling one term never zeroes the other)
+    aux = aux_loss_coef * routing.aux_loss
+    if router_z_loss_coef:
+        aux = aux + router_z_loss_coef * router_z_loss(router_logits)
 
     # dispatch: (N,E,C) × (N,D) → (E,C,D)
     expert_in = jnp.einsum(
@@ -125,4 +142,4 @@ def moe_ffn(
     expert_out = jnp.einsum("eci,eid->ecd", act, w_down.astype(compute_dtype))
     # combine: (N,E,C) × (E,C,D) → (N,D)
     out = jnp.einsum("nec,ecd->nd", routing.combine.astype(compute_dtype), expert_out)
-    return out.reshape(b, s, d), routing.aux_loss
+    return out.reshape(b, s, d), aux

@@ -162,3 +162,60 @@ def test_ep_sharded_routing_matches_single_device():
     out, aux = jax.jit(fn)(*args)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
     np.testing.assert_allclose(float(aux), float(aux_ref), atol=1e-6)
+
+
+def test_router_z_loss():
+    """z-loss = mean logsumexp² penalizes logit magnitude; the config coef
+    lands in the total loss at exactly its face value."""
+    from accelerate_tpu.ops.moe import router_z_loss
+
+    logits = jnp.asarray(np.random.default_rng(0).normal(size=(32, 4)), jnp.float32)
+    z = float(router_z_loss(logits))
+    ref = float(np.mean(
+        np.log(np.sum(np.exp(np.asarray(logits, np.float64)), axis=-1)) ** 2
+    ))
+    np.testing.assert_allclose(z, ref, rtol=1e-5)
+    # bigger logits -> bigger penalty
+    assert float(router_z_loss(logits * 10)) > z
+
+    # exact pre-scaling contract at the op level: aux = c_lb*lb + c_z*z,
+    # each at face value, independent of one another
+    from accelerate_tpu.ops.moe import moe_ffn
+
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(2, 8, 16)), jnp.float32)
+    router = jnp.asarray(rng.normal(size=(16, 4)), jnp.float32)
+    wg = jnp.asarray(rng.normal(size=(4, 16, 32)) * 0.1, jnp.float32)
+    wu = jnp.asarray(rng.normal(size=(4, 16, 32)) * 0.1, jnp.float32)
+    wd = jnp.asarray(rng.normal(size=(4, 32, 16)) * 0.1, jnp.float32)
+
+    def aux_of(c_lb, c_z):
+        _, aux = moe_ffn(x, router, wg, wu, wd, num_selected=2,
+                         compute_dtype=jnp.float32,
+                         aux_loss_coef=c_lb, router_z_loss_coef=c_z)
+        return float(aux)
+
+    tok = x.reshape(-1, 16)
+    z_exact = float(router_z_loss(tok @ router))
+    lb_only = aux_of(1.0, 0.0)
+    np.testing.assert_allclose(aux_of(0.0, 1.0), z_exact, rtol=1e-5)
+    np.testing.assert_allclose(aux_of(0.01, 1e-3),
+                               0.01 * lb_only + 1e-3 * z_exact, rtol=1e-5)
+
+    # model level: z lands even with load balancing OFF (the edge case a
+    # divide/remultiply plumbing breaks), and linearly in its coef
+    from accelerate_tpu.models.llama import LlamaConfig, create_llama, llama_loss
+
+    batch = {"input_ids": np.random.default_rng(0).integers(
+        0, 256, size=(2, 16)).astype(np.int32)}
+    losses = {}
+    for coef in (0.0, 0.5, 1.0):
+        cfg = LlamaConfig.tiny(num_experts=4, compute_dtype=jnp.float32,
+                               moe_aux_loss_coef=0.0, router_z_loss_coef=coef)
+        model = create_llama(cfg, seed=0)
+        view = lambda ids, **kw: model.apply_fn(model.params, ids, **kw)
+        losses[coef] = float(llama_loss(view, batch))
+    assert losses[1.0] > losses[0.0]
+    np.testing.assert_allclose(
+        losses[1.0] - losses[0.0], 2 * (losses[0.5] - losses[0.0]), rtol=1e-4
+    )
