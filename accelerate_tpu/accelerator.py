@@ -176,6 +176,7 @@ class Accelerator:
         self._fused_steps: dict = {}
         self._save_state_pre_hooks: list = []
         self._load_state_pre_hooks: list = []
+        self._forced_sync = False
 
         self.mesh = self.state.get_device_mesh()
 
@@ -199,6 +200,163 @@ class Accelerator:
     @property
     def local_process_index(self) -> int:
         return self.state.local_process_index
+
+    # ------------------------------------------------- reference passthroughs
+    # (reference accelerator.py properties — same observable values; the
+    # engine-specific ones are documented exemptions in tests/test_api_parity)
+    @property
+    def multi_device(self) -> bool:
+        import jax
+
+        return len(jax.devices()) > 1
+
+    @property
+    def split_batches(self) -> bool:
+        return self.dataloader_config.split_batches
+
+    @property
+    def dispatch_batches(self):
+        return self.dataloader_config.dispatch_batches
+
+    @property
+    def even_batches(self) -> bool:
+        return self.dataloader_config.even_batches
+
+    @property
+    def use_seedable_sampler(self) -> bool:
+        return self.dataloader_config.use_seedable_sampler
+
+    @property
+    def non_blocking(self) -> bool:
+        return self.dataloader_config.non_blocking
+
+    @property
+    def use_stateful_dataloader(self) -> bool:
+        return self.dataloader_config.use_stateful_dataloader
+
+    @property
+    def project_dir(self):
+        return self.project_configuration.project_dir
+
+    @property
+    def logging_dir(self):
+        return self.project_configuration.logging_dir
+
+    @property
+    def save_iteration(self) -> int:
+        return self.project_configuration.iteration
+
+    @property
+    def is_fsdp2(self) -> bool:
+        """Reference: fsdp_version == 2. Here parameter sharding IS the
+        fsdp2-style per-tensor sharding whenever dp_shard is active."""
+        return self.parallelism_config.fsdp_enabled
+
+    @property
+    def is_composable_parallelism_enabled(self) -> bool:
+        """Every strategy composes on the one mesh — True whenever a mesh
+        exists (reference: fsdp2-only)."""
+        return self.mesh is not None
+
+    @property
+    def should_save_model(self) -> bool:
+        """Reference gates on engines that own saving (Megatron). Sharded
+        saves here involve every process, so always True."""
+        return True
+
+    @property
+    def optimizer_step_was_skipped(self) -> bool:
+        """Whether the last optimizer step was skipped (fp16 overflow /
+        accumulation gating) — reference accelerator.py property."""
+        return any(opt.step_was_skipped for opt in self._optimizers)
+
+    @property
+    def fp8_backend(self):
+        """"NATIVE" when fp8 is active (ops/fp8.py) — the reference reports
+        which of its three engine adapters is in use."""
+        return "NATIVE" if self.state.mixed_precision == "fp8" else None
+
+    @property
+    def deepspeed_plugin(self):
+        """Always None: there is no DeepSpeed engine — ZeRO semantics are
+        mesh shardings (docs/usage_guides/zero_on_tpu.md). Kept so
+        reference-shaped `if accelerator.deepspeed_plugin:` guards run."""
+        return None
+
+    def _mesh_axis_rank(self, *axis_names: str) -> int:
+        """This process's coordinate along a mesh axis (the reference's
+        per-rank accessors; under SPMD, the position of this process's
+        first addressable device)."""
+        if self.mesh is None:
+            return 0
+        import jax
+        import numpy as np
+
+        axes = [a for a in axis_names if a in self.mesh.axis_names]
+        if not axes or all(self.mesh.shape[a] == 1 for a in axes):
+            return 0
+        first = jax.local_devices()[0]
+        coords = np.argwhere(self.mesh.devices == first)
+        if coords.size == 0:  # device not in mesh (cpu fallback)
+            return 0
+        coord = dict(zip(self.mesh.axis_names, coords[0]))
+        rank = 0
+        for a in axes:
+            rank = rank * self.mesh.shape[a] + int(coord[a])
+        return rank
+
+    @property
+    def tensor_parallel_rank(self) -> int:
+        return self._mesh_axis_rank("tp")
+
+    @property
+    def pipeline_parallel_rank(self) -> int:
+        return self._mesh_axis_rank("pp")
+
+    @property
+    def context_parallel_rank(self) -> int:
+        return self._mesh_axis_rank("cp")
+
+    @property
+    def data_parallel_rank(self) -> int:
+        return self._mesh_axis_rank("dp_replicate", "dp_shard")
+
+    @property
+    def data_parallel_shard_rank(self) -> int:
+        return self._mesh_axis_rank("dp_shard")
+
+    def on_local_process(self, function=None, local_process_index: int = 0):
+        """Run only on the given local process (reference decorator)."""
+        return self.state._partial.on_local_process(
+            function, local_process_index=local_process_index
+        )
+
+    def trigger_sync_in_backward(self, model=None) -> None:
+        """Force the next backward to sync gradients even mid-accumulation
+        (reference accelerator.py trigger_sync_in_backward): takes effect
+        immediately AND survives the next ``accumulate()`` entry's cadence
+        recomputation."""
+        self._forced_sync = True
+        self.gradient_state._set_sync_gradients(True)
+
+    def save(self, obj, f, safe_serialization: bool = False):
+        """Save honoring ProjectConfiguration.save_on_each_node (reference
+        accelerator.py:save → utils save, which gates on main process /
+        main-local-process itself)."""
+        from .utils.other import save as _save
+
+        _save(
+            obj, f,
+            save_on_each_node=getattr(
+                self.project_configuration, "save_on_each_node", False
+            ),
+            safe_serialization=safe_serialization,
+        )
+
+    def verify_device_map(self, model) -> bool:
+        """Reference: detect big-model device_maps that break DDP wrapping.
+        No hook-based device maps exist here — always False."""
+        return False
 
     @property
     def device(self):
@@ -576,7 +734,12 @@ class Accelerator:
 
     def _do_sync(self) -> None:
         """Set sync_gradients for this step (reference accelerator.py:1229)."""
-        if self.gradient_state.sync_with_dataloader and self.gradient_state.end_of_dataloader:
+        if self._forced_sync:
+            # trigger_sync_in_backward: one forced sync, then back to cadence
+            self._forced_sync = False
+            self.step = 0
+            self.gradient_state._set_sync_gradients(True)
+        elif self.gradient_state.sync_with_dataloader and self.gradient_state.end_of_dataloader:
             self.step = 0
             self.gradient_state._set_sync_gradients(True)
         else:
