@@ -249,8 +249,9 @@ class Accelerator:
     @property
     def is_fsdp2(self) -> bool:
         """Reference: fsdp_version == 2. Here parameter sharding IS the
-        fsdp2-style per-tensor sharding whenever dp_shard is active."""
-        return self.parallelism_config.fsdp_enabled
+        fsdp2-style per-tensor sharding whenever dp_shard is active (one
+        definition — state.AcceleratorState.is_fsdp2)."""
+        return self.state.is_fsdp2
 
     @property
     def is_composable_parallelism_enabled(self) -> bool:

@@ -148,6 +148,17 @@ class PartialState:
             f"num_devices={self.num_devices}, platform={self.platform!r})"
         )
 
+    @property
+    def default_device(self):
+        """The first addressable device (reference state.py default_device
+        picks cuda/mps/cpu; here the backend's first device)."""
+        return self.device
+
+    def set_device(self) -> None:
+        """No-op by design (reference state.py:819 binds one process to one
+        accelerator): under SPMD a process addresses ALL its local devices
+        and placement is the mesh's job."""
+
     # --------------------------------------------------------- process control
     def wait_for_everyone(self) -> None:
         """Cross-process barrier (reference state.py:377-414; the xla branch
@@ -340,6 +351,37 @@ class AcceleratorState:
             self.mesh = self.parallelism_config.build_device_mesh(self._partial.platform)
         return self.mesh
 
+    @property
+    def is_fsdp2(self) -> bool:
+        """Reference: fsdp_version == 2; parameter sharding here IS
+        per-tensor (fsdp2-style) whenever dp_shard is active."""
+        pcfg = self._shared_state.get("parallelism_config")
+        return bool(pcfg is not None and pcfg.fsdp_enabled)
+
+    @property
+    def fork_launched(self) -> bool:
+        """Always False: processes come from the launcher, never fork
+        (reference tracks notebook fork launches)."""
+        return False
+
+    @property
+    def deepspeed_plugin(self):
+        """Always None — no DeepSpeed engine; ZeRO is mesh shardings
+        (docs/usage_guides/zero_on_tpu.md)."""
+        return None
+
+    def get_deepspeed_plugin(self, name: str):
+        raise ValueError(
+            "no DeepSpeed plugins exist here — ZeRO semantics are mesh "
+            "shardings (docs/usage_guides/zero_on_tpu.md)"
+        )
+
+    def select_deepspeed_plugin(self, name: str):
+        raise ValueError(
+            "no DeepSpeed plugins exist here — ZeRO semantics are mesh "
+            "shardings (docs/usage_guides/zero_on_tpu.md)"
+        )
+
     # Proxy the PartialState surface (reference state.py does the same via
     # __getattr__ against PartialState._shared_state).
     def __getattr__(self, name: str):
@@ -422,6 +464,18 @@ class GradientState:
 
     def _set_sync_gradients(self, value: bool) -> None:
         self.sync_gradients = value
+
+    @property
+    def is_xla_gradients_synced(self) -> bool:
+        """Always True: gradients are values of one compiled SPMD program —
+        there is no lazy-tensor mark_step whose completion the reference
+        must track (state.py is_xla_gradients_synced)."""
+        return True
+
+    @is_xla_gradients_synced.setter
+    def is_xla_gradients_synced(self, value) -> None:
+        """Accepted and ignored (reference code assigns this around backward/
+        step to track mark_step completion; there is nothing to track)."""
 
     def _add_dataloader(self, dataloader) -> None:
         self.active_dataloader = dataloader
