@@ -33,10 +33,10 @@ import jax.numpy as jnp
 from .data_loader import DataLoaderDispatcher, DataLoaderShard, prepare_data_loader, skip_first_batches
 from .logging import get_logger
 from .model import Model
-from .optimizer import AcceleratedOptimizer, DynamicScale, _tree_add
+from .optimizer import AcceleratedOptimizer, DynamicScale
 from .parallelism_config import ParallelismConfig
 from .scheduler import AcceleratedScheduler
-from .state import AcceleratorState, DistributedType, GradientState, PartialState
+from .state import AcceleratorState, DistributedType, GradientState
 from .utils.dataclasses import (
     DataLoaderConfiguration,
     DistributedDataParallelKwargs,

@@ -11,10 +11,7 @@ just the pp mesh axis.
 
 from __future__ import annotations
 
-import functools
 from typing import Optional
-
-import numpy as np
 
 import jax
 import jax.numpy as jnp

@@ -32,7 +32,6 @@ from jax import lax
 from jax.ad_checkpoint import checkpoint_name
 
 from ..model import Model
-from ..ops.attention import blockwise_attention, dot_product_attention
 from ..parallel.sharding import constrain_activation, replicate_over_fsdp
 
 __all__ = ["LlamaConfig", "init_llama_params", "llama_apply", "create_llama", "llama_loss"]

@@ -12,7 +12,6 @@ GQA is supported via n_kv_heads < n_heads (kv repeated on the fly).
 
 from __future__ import annotations
 
-import functools
 import math
 from typing import Optional
 

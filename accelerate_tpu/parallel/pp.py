@@ -21,7 +21,6 @@ replicated across pp.
 
 from __future__ import annotations
 
-import functools
 from typing import Callable
 
 import jax

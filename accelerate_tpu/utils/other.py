@@ -9,7 +9,6 @@ compile 5-9× faster than full compile; scan is the structural fix).
 
 from __future__ import annotations
 
-import os
 import socket
 from typing import Any
 

@@ -31,24 +31,28 @@ EXEMPT = {
 }
 
 
-def _reference_public_members():
-    if not os.path.isfile(REFERENCE_ACCELERATOR):
-        pytest.skip("reference checkout not available "
+def _reference_public_members(path=None, class_names=("Accelerator",)):
+    """Public methods/properties per class, parsed from a reference source
+    file (skips when the checkout is absent)."""
+    path = path or REFERENCE_ACCELERATOR
+    if not os.path.isfile(path):
+        pytest.skip(f"reference source not available: {path} "
                     "(set ACCELERATE_REFERENCE_SRC)")
-    tree = ast.parse(open(REFERENCE_ACCELERATOR).read())
-    names = set()
+    tree = ast.parse(open(path).read())
+    per_class = {}
     for node in ast.walk(tree):
-        if isinstance(node, ast.ClassDef) and node.name == "Accelerator":
-            for item in node.body:
-                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                    if not item.name.startswith("_"):
-                        names.add(item.name)
-    assert len(names) > 60, "reference parse looks wrong"
-    return names
+        if isinstance(node, ast.ClassDef) and node.name in class_names:
+            per_class[node.name] = {
+                i.name for i in node.body
+                if isinstance(i, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and not i.name.startswith("_")
+            }
+    return per_class
 
 
 def test_accelerator_surface_covers_reference():
-    ref = _reference_public_members()
+    ref = _reference_public_members()["Accelerator"]
+    assert len(ref) > 60, "reference parse looks wrong"
     missing = sorted(
         n for n in ref if not hasattr(Accelerator, n) and n not in EXEMPT
     )
@@ -131,8 +135,6 @@ def test_accelerator_save_helper(tmp_path):
 def test_state_classes_cover_reference():
     """PartialState / AcceleratorState / GradientState public surface, same
     AST enforcement as the Accelerator test (no exemptions needed)."""
-    if not os.path.isfile(REFERENCE_ACCELERATOR):
-        pytest.skip("reference checkout not available")
     ref_state = os.path.join(os.path.dirname(REFERENCE_ACCELERATOR), "state.py")
     import accelerate_tpu.state as S
 
@@ -142,24 +144,17 @@ def test_state_classes_cover_reference():
         "AcceleratorState": S.AcceleratorState(),
         "GradientState": S.GradientState(),
     }
-    tree = ast.parse(open(ref_state).read())
-    problems = []
-    found = {}
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ClassDef) and node.name in inst:
-            members = [
-                i.name for i in node.body
-                if isinstance(i, (ast.FunctionDef, ast.AsyncFunctionDef))
-                and not i.name.startswith("_")
-            ]
-            found[node.name] = len(members)
-            for name in members:
-                if not hasattr(inst[node.name], name):
-                    problems.append(f"{node.name}.{name}")
+    per_class = _reference_public_members(ref_state, tuple(inst))
     # guard against a vacuous pass if the reference restructures
-    assert set(found) == set(inst) and all(n > 8 for n in found.values()), (
-        f"reference state.py parse looks wrong: {found}"
-    )
+    assert set(per_class) == set(inst) and all(
+        len(m) > 8 for m in per_class.values()
+    ), f"reference state.py parse looks wrong: { {k: len(v) for k, v in per_class.items()} }"
+    problems = [
+        f"{cls}.{name}"
+        for cls, members in per_class.items()
+        for name in sorted(members)
+        if not hasattr(inst[cls], name)
+    ]
     assert not problems, problems
 
     # the reference ASSIGNS is_xla_gradients_synced around backward/step —
