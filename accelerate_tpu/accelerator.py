@@ -177,6 +177,7 @@ class Accelerator:
         self._save_state_pre_hooks: list = []
         self._load_state_pre_hooks: list = []
         self._forced_sync = False
+        self._in_accumulate = False
 
         self.mesh = self.state.get_device_mesh()
 
@@ -333,12 +334,15 @@ class Accelerator:
         )
 
     def trigger_sync_in_backward(self, model=None) -> None:
-        """Force the next backward to sync gradients even mid-accumulation
-        (reference accelerator.py trigger_sync_in_backward): takes effect
-        immediately AND survives the next ``accumulate()`` entry's cadence
-        recomputation."""
-        self._forced_sync = True
+        """Force gradient sync for the in-flight backward even
+        mid-accumulation (reference accelerator.py trigger_sync_in_backward)
+        WITHOUT changing the accumulation cadence. Inside ``accumulate()``
+        the immediate flag covers the current microbatch; outside, the
+        forced flag survives the next ``accumulate()`` entry's cadence
+        recomputation so exactly one upcoming microbatch syncs."""
         self.gradient_state._set_sync_gradients(True)
+        if not self._in_accumulate:
+            self._forced_sync = True
 
     def save(self, obj, f, safe_serialization: bool = False):
         """Save honoring ProjectConfiguration.save_on_each_node (reference
@@ -735,18 +739,19 @@ class Accelerator:
 
     def _do_sync(self) -> None:
         """Set sync_gradients for this step (reference accelerator.py:1229)."""
-        if self._forced_sync:
-            # trigger_sync_in_backward: one forced sync, then back to cadence
+        if self.gradient_state.sync_with_dataloader and self.gradient_state.end_of_dataloader:
+            self.step = 0
             self._forced_sync = False
-            self.step = 0
-            self.gradient_state._set_sync_gradients(True)
-        elif self.gradient_state.sync_with_dataloader and self.gradient_state.end_of_dataloader:
-            self.step = 0
             self.gradient_state._set_sync_gradients(True)
         else:
+            # A pending trigger_sync_in_backward forces THIS microbatch to
+            # sync but leaves the step counter alone — the accumulation
+            # cadence is unchanged, matching the reference's semantics of
+            # syncing only the flagged backward.
             self.step += 1
+            forced, self._forced_sync = self._forced_sync, False
             self.gradient_state._set_sync_gradients(
-                (self.step % self.gradient_state.num_steps) == 0
+                forced or (self.step % self.gradient_state.num_steps) == 0
             )
 
     @contextlib.contextmanager
@@ -754,7 +759,11 @@ class Accelerator:
         """Per-microbatch context toggling grad sync
         (reference accelerator.py:1255-1299)."""
         self._do_sync()
-        yield
+        self._in_accumulate = True
+        try:
+            yield
+        finally:
+            self._in_accumulate = False
 
     @contextlib.contextmanager
     def no_sync(self, model=None):

@@ -267,7 +267,18 @@ def load_accelerator_state(accelerator, input_dir: Optional[str] = None, **kwarg
     for i, model in enumerate(accelerator._models):
         suffix = "" if i == 0 else f"_{i}"
         path = os.path.join(input_dir, f"{MODEL_NAME}{suffix}")
-        model.params = load_pytree(path, target=model.params, shardings=model.shardings)
+        try:
+            model.params = load_pytree(path, target=model.params, shardings=model.shardings)
+        except ValueError:
+            # Orbax raises ValueError on a restore-item/on-disk tree
+            # structure mismatch — a legacy checkpoint layout. Retry a raw
+            # restore routed through load_state_dict, which applies the
+            # family's upgrade_state_fn (e.g. gpt2's fused-c_attn split).
+            # I/O and missing-file errors are NOT caught; a failure here
+            # auto-chains the original mismatch for diagnosis.
+            if getattr(model, "upgrade_state_fn", None) is None:
+                raise
+            model.load_state_dict(load_pytree(path))
     for i, opt in enumerate(accelerator._optimizers):
         suffix = "" if i == 0 else f"_{i}"
         path = os.path.join(input_dir, f"{OPTIMIZER_NAME}{suffix}")

@@ -45,7 +45,10 @@ class ClusterConfig:
     sp_size: int = 1
     tp_size: int = 1
     ep_size: int = 1
-    gradient_accumulation_steps: int = 1
+    # None = unset: only an explicitly configured value (including 1) is
+    # exported to the env, since the env var overrides the script's
+    # Accelerator(gradient_accumulation_steps=...) argument.
+    gradient_accumulation_steps: Optional[int] = None
     max_restarts: int = 0
     watchdog_timeout: float = 0.0
     debug: bool = False
@@ -56,10 +59,12 @@ class ClusterConfig:
     command_file: Optional[str] = None
 
     def to_env(self) -> dict[str, str]:
-        env = {
-            "ACCELERATE_MIXED_PRECISION": self.mixed_precision,
-            "ACCELERATE_GRADIENT_ACCUMULATION_STEPS": str(self.gradient_accumulation_steps),
-        }
+        env = {"ACCELERATE_MIXED_PRECISION": self.mixed_precision}
+        if self.gradient_accumulation_steps is not None:
+            # Matches the reference's `is not None` gate (utils/launch.py:567):
+            # an unconfigured default must not stomp the script's value, but
+            # an explicit 1 still disables a hardcoded constructor value.
+            env["ACCELERATE_GRADIENT_ACCUMULATION_STEPS"] = str(self.gradient_accumulation_steps)
         for axis in ("dp_replicate", "dp_shard", "pp", "cp", "sp", "tp", "ep"):
             size = getattr(self, f"{axis}_size")
             if size != 1:
@@ -113,7 +118,11 @@ def config_command(args, extra) -> int:
         cfg = ClusterConfig(
             mixed_precision=_ask("mixed precision (no/bf16/fp16/fp8)", "bf16"),
             num_processes=_ask("number of host processes", 1, int),
-            gradient_accumulation_steps=_ask("gradient accumulation steps", 1, int),
+            # Enter = unset: leaves accumulation to the training script
+            # (an explicit answer, including 1, overrides the script's value)
+            gradient_accumulation_steps=_ask(
+                "gradient accumulation steps (enter = script-controlled)", None, int
+            ),
         )
         if cfg.num_processes > 1:
             cfg.machine_rank = _ask("rank of this machine (0..N-1)", 0, int)

@@ -132,7 +132,12 @@ class Model:
         return jax.tree_util.tree_map(lambda p: np.asarray(p), self.params)
 
     def load_state_dict(self, state: Any) -> None:
-        """Load a host pytree, preserving current shardings."""
+        """Load a host pytree, preserving current shardings. Model families
+        may attach ``upgrade_state_fn`` to migrate legacy checkpoint layouts
+        (e.g. gpt2's pre-split fused ``c_attn``) before structure matching."""
+        upgrade = getattr(self, "upgrade_state_fn", None)
+        if upgrade is not None:
+            state = upgrade(state)
         if self.shardings is not None:
             self.params = jax.tree_util.tree_map(
                 lambda t, s: jax.device_put(np.asarray(t), s), state, self.shardings

@@ -38,6 +38,7 @@ __all__ = [
     "gpt2_loss",
     "convert_hf_state_dict",
     "export_hf_state_dict",
+    "upgrade_legacy_state",
 ]
 
 
@@ -267,6 +268,7 @@ def create_gpt2(config: GPT2Config, seed: int = 0) -> Model:
     model.set_attention_fn = set_attention_fn
     model.set_layer_stack_fn = set_layer_stack_fn
     model.canonical_loss = gpt2_loss
+    model.upgrade_state_fn = upgrade_legacy_state
     # 1F1B contract (parallel/pp_1f1b.py); lazy so a later set_attention_fn
     # (ring/Ulysses) is picked up
     model.pipeline_parts = lambda: gpt2_pipeline_parts(
@@ -397,6 +399,35 @@ def gpt2_decode_step(config: GPT2Config, params, cache, token, pos):
     x = layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"], config.layer_norm_eps)
     logits = x @ params["wte"]["embedding"].astype(cdt).T
     return logits[:, 0].astype(jnp.float32), {"k": new_k, "v": new_v}
+
+
+def upgrade_legacy_state(tree: dict) -> dict:
+    """Migrate a native checkpoint saved before the per-projection q/k/v
+    split (when ``layers.attn`` held one fused (L, d, 3d) ``c_attn``) to the
+    current layout. Trees already in the current layout pass through
+    unchanged, so this is safe to run on every load (wired as the model's
+    ``upgrade_state_fn``)."""
+    try:
+        attn = tree["layers"]["attn"]
+    except (KeyError, TypeError):
+        return tree
+    if "c_attn" not in attn:
+        return tree
+    fused = attn["c_attn"]
+    kernel = np.asarray(fused["kernel"])  # (L, d, 3d)
+    bias = np.asarray(fused["bias"])  # (L, 3d)
+    d = kernel.shape[-1] // 3
+    new_attn = {k: v for k, v in attn.items() if k != "c_attn"}
+    for idx, name in enumerate(("c_attn_q", "c_attn_k", "c_attn_v")):
+        new_attn[name] = {
+            "kernel": kernel[..., idx * d : (idx + 1) * d],
+            "bias": bias[..., idx * d : (idx + 1) * d],
+        }
+    new_layers = {k: v for k, v in tree["layers"].items() if k != "attn"}
+    new_layers["attn"] = new_attn
+    out = {k: v for k, v in tree.items() if k != "layers"}
+    out["layers"] = new_layers
+    return out
 
 
 # ------------------------------------------------------------ HF interop

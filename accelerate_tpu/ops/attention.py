@@ -84,9 +84,13 @@ def dot_product_attention(
         same = segment_ids[:, :, None] == segment_ids[:, None, :]  # (b, sq, sk)
         scores = jnp.where(same[:, None], scores, NEG_INF)
     if window is not None:
+        # Mistral convention 0 <= q_pos - k_pos < window: the lower bound
+        # applies even when causal=False, so windowed queries never see
+        # future keys (flash/blockwise enforce the same).
         q_pos = jnp.arange(sq)[:, None] + q_offset
         k_pos = jnp.arange(k.shape[1])[None, :] + kv_offset
-        scores = jnp.where((q_pos - k_pos < window)[None, None], scores, NEG_INF)
+        diff = q_pos - k_pos
+        scores = jnp.where(((diff >= 0) & (diff < window))[None, None], scores, NEG_INF)
     weights = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", weights.astype(v.dtype), v)
     return out
@@ -217,7 +221,9 @@ def blockwise_attention_partials(
         if causal:
             bias = jnp.where(q_pos >= kv_pos, bias, NEG_INF)
         if window is not None:
-            bias = jnp.where(q_pos - kv_pos < window, bias, NEG_INF)
+            # window implies the causal lower bound (see dot_product_attention)
+            diff = q_pos - kv_pos
+            bias = jnp.where((diff >= 0) & (diff < window), bias, NEG_INF)
         bias = bias[None, None]
         if seg_blk is not None:
             same = segment_ids[:, :, None] == seg_blk[:, None, :]  # (b, sq, bk)

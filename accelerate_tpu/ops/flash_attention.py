@@ -32,6 +32,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.experimental import pallas as pl
 
@@ -62,12 +63,12 @@ def _mask_scores(s, i, j, q_seg, k_seg, causal, block_q, block_k, window):
     """Apply causal / sliding-window / segment visibility to a
     (block_q, block_k) score tile. ``q_seg``/``k_seg`` are (block,) int32
     rows or None; ``window`` is the Mistral convention (q attends k iff
-    0 <= q_pos - k_pos < window) or None."""
+    0 <= q_pos - k_pos < window) — the lower bound applies even with
+    causal=False, so a windowed query never sees future keys."""
     if causal or window is not None:
         q_pos = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + i * block_q
         k_pos = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + j * block_k
-        if causal:
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         if window is not None:
             s = jnp.where(q_pos - k_pos < window, s, NEG_INF)
     if q_seg is not None:
@@ -83,7 +84,7 @@ def _block_visible(i, j, causal, block_q, block_k, window):
     tile after the top rows' windows have slid past it."""
     vis = True
     hi_q = i * block_q + block_q - 1
-    if causal:
+    if causal or window is not None:
         vis = jnp.logical_and(vis, j * block_k <= hi_q) if not isinstance(vis, bool) else (j * block_k <= hi_q)
     if window is not None:
         lo_q = i * block_q
@@ -409,7 +410,9 @@ def _flash_core_bwd(h, h_kv, causal, block_q, block_k, interpret, window,
         q, k, v, segs, out, lse, do, h, h_kv, causal, block_q, block_k,
         interpret, window
     )
-    dsegs = None if segs is None else jnp.zeros_like(segs)
+    # Integer primals take a float0 cotangent per JAX convention — an int32
+    # zeros array only works by accident under current versions.
+    dsegs = None if segs is None else np.zeros(segs.shape, dtype=jax.dtypes.float0)
     return dq, dk, dv, dsegs
 
 

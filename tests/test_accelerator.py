@@ -268,3 +268,31 @@ def test_small_params_replicated():
     model = RegressionModel()  # scalar params
     model = accelerator.prepare(model)
     assert model.shardings["a"].spec == ()  # replicated
+
+
+def test_trigger_sync_in_backward_keeps_cadence():
+    """trigger_sync_in_backward syncs exactly one extra microbatch without
+    resetting the accumulation cadence (reference semantics: only the
+    in-flight backward is flagged)."""
+    accelerator = make_accelerator(gradient_accumulation_steps=4)
+
+    # Inside accumulate(): the current microbatch syncs; the following
+    # entries return to the unchanged cadence (sync at multiples of 4).
+    flags = []
+    for i in range(8):
+        with accelerator.accumulate():
+            if i == 1:
+                accelerator.trigger_sync_in_backward()
+            flags.append(accelerator.sync_gradients)
+    assert flags == [False, True, False, True, False, False, False, True]
+
+    # Outside accumulate(): the flag survives the next entry's cadence
+    # recomputation, then cadence resumes where it left off.
+    GradientState._reset_state()
+    accelerator2 = make_accelerator(gradient_accumulation_steps=4)
+    accelerator2.trigger_sync_in_backward()
+    flags2 = []
+    for _ in range(8):
+        with accelerator2.accumulate():
+            flags2.append(accelerator2.sync_gradients)
+    assert flags2 == [True, False, False, True, False, False, False, True]

@@ -204,3 +204,52 @@ def test_cross_layout_restore(tmp_path):
     np.testing.assert_array_equal(ref, got)
     # restored into the NEW layout's sharding
     assert m2.params["layers"]["mlp"]["gate_proj"]["kernel"].sharding == spec_before
+
+
+def test_load_state_legacy_layout_fallback(tmp_path):
+    """load_state of a checkpoint in a legacy param layout (gpt2's pre-split
+    fused c_attn) hits the orbax structure-mismatch fallback and routes
+    through the model's upgrade_state_fn."""
+    import jax
+    import shutil
+
+    from accelerate_tpu.checkpointing import save_pytree
+    from accelerate_tpu.models.gpt2 import GPT2Config, create_gpt2
+
+    acc = _fresh(tmp_path)
+    model = create_gpt2(GPT2Config.tiny(), seed=0)
+    model = acc.prepare(model)
+    ckpt = acc.save_state(str(tmp_path / "ckpt"))
+
+    # Rewrite the model checkpoint in the legacy fused-c_attn layout.
+    params = jax.tree_util.tree_map(np.asarray, model.params)
+    attn = params["layers"]["attn"]
+    legacy = dict(params)
+    legacy["layers"] = dict(params["layers"])
+    legacy["layers"]["attn"] = {
+        "c_attn": {
+            "kernel": np.concatenate(
+                [attn["c_attn_q"]["kernel"], attn["c_attn_k"]["kernel"],
+                 attn["c_attn_v"]["kernel"]], axis=-1),
+            "bias": np.concatenate(
+                [attn["c_attn_q"]["bias"], attn["c_attn_k"]["bias"],
+                 attn["c_attn_v"]["bias"]], axis=-1),
+        },
+        "c_proj": attn["c_proj"],
+    }
+    model_dir = os.path.join(ckpt, "model")
+    shutil.rmtree(model_dir)
+    save_pytree(legacy, model_dir)
+
+    # Perturb in-memory params, then restore from the legacy checkpoint.
+    expected_sharding = model.params["layers"]["attn"]["c_attn_q"]["kernel"].sharding
+    model.params = jax.tree_util.tree_map(lambda p: p * 0, model.params)
+    acc.load_state(ckpt)
+    restored = jax.tree_util.tree_map(np.asarray, model.params)
+    np.testing.assert_allclose(
+        restored["layers"]["attn"]["c_attn_q"]["kernel"],
+        attn["c_attn_q"]["kernel"], atol=0,
+    )
+    # the fallback re-places params with the model's prepared shardings
+    leaf = model.params["layers"]["attn"]["c_attn_q"]["kernel"]
+    assert leaf.sharding == expected_sharding

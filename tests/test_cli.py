@@ -525,3 +525,18 @@ def test_migrate_config_reads_ds_config_file(tmp_path, capsys):
     cfg = ClusterConfig.load(str(out))
     # stage 1 = replication, not sharding
     assert cfg.dp_replicate_size == -1 and cfg.dp_shard_size == 1
+
+
+def test_default_accumulation_not_exported():
+    """Unconfigured gradient_accumulation_steps (None) must NOT be exported
+    by launch — the env var overrides the script's explicit
+    Accelerator(gradient_accumulation_steps=...) argument — but an explicit
+    value, INCLUDING 1, is exported (the reference gates this export on the
+    flag being given, utils/launch.py:567)."""
+    from accelerate_tpu.commands.config import ClusterConfig
+
+    assert "ACCELERATE_GRADIENT_ACCUMULATION_STEPS" not in ClusterConfig().to_env()
+    env = ClusterConfig(gradient_accumulation_steps=4).to_env()
+    assert env["ACCELERATE_GRADIENT_ACCUMULATION_STEPS"] == "4"
+    env1 = ClusterConfig(gradient_accumulation_steps=1).to_env()
+    assert env1["ACCELERATE_GRADIENT_ACCUMULATION_STEPS"] == "1"

@@ -213,3 +213,20 @@ def test_blockwise_and_xla_sliding_window_match():
     xla = dot_product_attention(q, k, v, causal=True, window=24)
     np.testing.assert_allclose(np.asarray(bw), np.asarray(ref), atol=2e-5)
     np.testing.assert_allclose(np.asarray(xla), np.asarray(ref), atol=2e-5)
+
+
+def test_window_implies_causal_lower_bound():
+    """The documented convention is 0 <= q_pos - k_pos < window: a windowed
+    query must never see future keys even with causal=False, in all three
+    implementations (flash / blockwise / xla)."""
+    from accelerate_tpu.ops.attention import blockwise_attention, dot_product_attention
+
+    q, k, v = _qkv(s=64)
+    ref = _windowed_reference(q, k, v, 24)  # helper masks 0 <= diff < window
+    flash = flash_attention(q, k, v, causal=False, window=24,
+                            block_q=16, block_k=16, interpret=True)
+    bw = blockwise_attention(q, k, v, causal=False, kv_block=16, window=24)
+    xla = dot_product_attention(q, k, v, causal=False, window=24)
+    np.testing.assert_allclose(np.asarray(flash), np.asarray(ref), atol=2e-5)
+    np.testing.assert_allclose(np.asarray(bw), np.asarray(ref), atol=2e-5)
+    np.testing.assert_allclose(np.asarray(xla), np.asarray(ref), atol=2e-5)

@@ -275,3 +275,39 @@ def test_gpt2_packed_segments_match_padded():
         "loss_mask": native.packed_loss_mask((padded_mask > 0).astype(np.int32)),
     }))
     np.testing.assert_allclose(packed, padded, rtol=2e-5)
+
+
+def test_legacy_fused_c_attn_checkpoint_loads():
+    """Native checkpoints saved before the per-projection q/k/v split carried
+    one fused (L, d, 3d) c_attn — upgrade_state_fn splits it on load and the
+    forward is unchanged."""
+    from accelerate_tpu.models.gpt2 import upgrade_legacy_state
+
+    config = GPT2Config.tiny()
+    model = create_gpt2(config, seed=0)
+    ref_logits = np.asarray(model(jnp.arange(8, dtype=jnp.int32)[None] % 7))
+
+    # Reconstruct the legacy layout from the current params.
+    params = jax.tree_util.tree_map(np.asarray, model.params)
+    attn = params["layers"]["attn"]
+    fused = {
+        "kernel": np.concatenate(
+            [attn["c_attn_q"]["kernel"], attn["c_attn_k"]["kernel"],
+             attn["c_attn_v"]["kernel"]], axis=-1),
+        "bias": np.concatenate(
+            [attn["c_attn_q"]["bias"], attn["c_attn_k"]["bias"],
+             attn["c_attn_v"]["bias"]], axis=-1),
+    }
+    legacy_attn = {"c_attn": fused, "c_proj": attn["c_proj"]}
+    legacy = dict(params)
+    legacy["layers"] = dict(params["layers"])
+    legacy["layers"]["attn"] = legacy_attn
+
+    fresh = create_gpt2(config, seed=1)  # different weights
+    fresh.load_state_dict(legacy)  # applies upgrade_state_fn
+    got = np.asarray(fresh(jnp.arange(8, dtype=jnp.int32)[None] % 7))
+    np.testing.assert_allclose(got, ref_logits, atol=1e-6)
+
+    # Current-layout trees pass through unchanged.
+    same = upgrade_legacy_state(params)
+    assert same["layers"]["attn"].keys() == params["layers"]["attn"].keys()
