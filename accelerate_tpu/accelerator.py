@@ -476,6 +476,10 @@ class Accelerator:
             ):
                 model.config.remat_policy = "minimal"
         fsdp_axes = pcfg.fsdp_dim_names
+        # record for use-time gather pinning (parallel/sharding.py
+        # _fsdp_use_hints): model code reconstructs storage specs in-trace
+        self.state._shared_state["fsdp_axes"] = tuple(fsdp_axes)
+        self.state._shared_state["fsdp_min_weight_size"] = min_weight_size
         shardings = infer_shardings(
             model.params, self.mesh, rules=rules, fsdp_axes=fsdp_axes,
             min_weight_size=min_weight_size,
@@ -532,10 +536,11 @@ class Accelerator:
         over cp, Ulysses over sp, or None (single-device attention).
 
         ``model_config``: when the model asks for the Pallas flash kernel
-        (``attention_impl="flash"``), Ulysses' LOCAL full-sequence attention
-        (post head-scatter, offset 0) runs it — the flash speedup composes
-        with SP. Ring steps keep the blockwise partials (they need
-        unnormalized stats with shard offsets).
+        (``attention_impl="flash"``), both paths honor it — Ulysses runs it
+        on the LOCAL full sequence post head-scatter, and ring attention
+        runs it per ring step with LSE merging across the ring
+        (ops/ring_attention.py; the allgather rotation alone keeps
+        blockwise partials, which need shard-offset stats).
         """
         pcfg = self.parallelism_config
         if pcfg.cp_enabled:
@@ -546,6 +551,9 @@ class Accelerator:
             return make_ring_attention(
                 self.mesh, rotate_method=cp_cfg.rotate_method,
                 kv_block=cp_cfg.kv_block,
+                attention_impl=getattr(model_config, "attention_impl", "blockwise")
+                or "blockwise",
+                block_q=getattr(model_config, "attention_block_q", 2048),
             )
         if pcfg.sp_enabled:
             from .ops.ulysses import make_ulysses_attention
@@ -932,9 +940,44 @@ class Accelerator:
                 "full model onto every device. Use flatten_params='auto' "
                 "(skips packing on sharded meshes) or False."
             )
-        use_flat = flatten_params is True or (
-            flatten_params == "auto" and pp_1f1b_cfg is None and params_unsharded
+        # Abstract (shape-only) prepare: params are ShapeDtypeStructs. The
+        # step cannot execute, but ``step.lower(*batch)`` AOT-lowers the real
+        # fused program for compile/memory/collective analysis of configs far
+        # too big to materialize on this host.
+        abstract_mode = any(
+            isinstance(p, jax.ShapeDtypeStruct)
+            for p in jax.tree_util.tree_leaves(model.params)
         )
+        use_flat = not abstract_mode and (
+            flatten_params is True
+            or (flatten_params == "auto" and pp_1f1b_cfg is None and params_unsharded)
+        )
+
+        # ZeRO grad layout: pin each gradient to its parameter's sharding the
+        # moment it is produced, so the partitioner reduces straight into the
+        # shard (reduce-scatter) instead of all-reducing the FULL gradient
+        # and slicing afterwards — 2x the ICI bytes on every step (observed
+        # in the partitioned HLO, runs/hlo_report.md).
+        grad_shardings = (
+            model.shardings
+            if (
+                pp_1f1b_cfg is None
+                and model.shardings is not None
+                and self.mesh is not None
+                and self.mesh.size > 1
+            )
+            else None
+        )
+
+        def _pin_grads(grads):
+            if grad_shardings is None:
+                return grads
+            try:
+                return jax.tree_util.tree_map(
+                    jax.lax.with_sharding_constraint, grads, grad_shardings
+                )
+            except Exception:
+                return grads
 
         def fused(params, opt_state, accum, count, scaler_state, *batch):
             def wrapped(p):
@@ -955,6 +998,7 @@ class Accelerator:
                 grads = jax.tree_util.tree_map(
                     lambda g: g.astype(grad_comm_dtype), grads
                 )
+            grads = _pin_grads(grads)
             accum = jax.tree_util.tree_map(jnp.add, accum, grads) if k > 1 else grads
             new_count = count + 1
             do_update = (new_count % k) == 0 if k > 1 else jnp.bool_(True)
@@ -1077,6 +1121,14 @@ class Accelerator:
                 jnp.zeros((size,), dtype=dt)
                 for size, dt in zip(accum_spec.buffer_sizes, accum_spec.buffer_dtypes)
             )
+        elif abstract_mode:
+            # shape-only accum, sharded like the params (its steady state)
+            accum_init = jax.tree_util.tree_map(
+                lambda p: jax.ShapeDtypeStruct(
+                    p.shape, accum_dtype_of(p), sharding=getattr(p, "sharding", None)
+                ),
+                model.params,
+            )
         else:
             accum_init = jax.tree_util.tree_map(
                 lambda p: jnp.zeros(p.shape, dtype=accum_dtype_of(p)), model.params
@@ -1124,6 +1176,31 @@ class Accelerator:
             self._touch_heartbeat()
             return loss
 
+        def lower(*batch):
+            """AOT-lower the fused step (``jax.jit(...).lower``) against the
+            current params/opt-state avals and abstract batch leaves — the
+            compile-analysis path (HLO text, memory_analysis, cost_analysis)
+            that works even for shape-only prepared models. Batch leaves may
+            be arrays or ShapeDtypeStructs."""
+            if use_flat:
+                in_params = tuple(
+                    jax.ShapeDtypeStruct((size,), dt)
+                    for size, dt in zip(param_spec.buffer_sizes, param_spec.buffer_dtypes)
+                )
+                in_opt = tuple(
+                    jax.ShapeDtypeStruct((size,), dt)
+                    for size, dt in zip(opt_spec.buffer_sizes, opt_spec.buffer_dtypes)
+                )
+            else:
+                in_params, in_opt = model.params, optimizer.opt_state
+            return compiled.lower(
+                in_params, in_opt, state["accum"], state["count"],
+                state["scaler"], *batch,
+            )
+
+        step.jitted = compiled
+        step.lower = lower
+        step.abstract = abstract_mode
         return step
 
     def eval_step(self, eval_fn: Callable, model: Optional[Model] = None) -> Callable:

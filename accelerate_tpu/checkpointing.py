@@ -257,6 +257,46 @@ def save_accelerator_state(
     return output_dir
 
 
+def _apply_upgrade_recursively(node, upgrade):
+    """Run a params-shaped ``upgrade_state_fn`` at every dict node of a raw
+    restored pytree: optimizer states nest params-shaped subtrees (adam
+    mu/nu) at arbitrary depth, and the upgrade passes non-matching dicts
+    through unchanged."""
+    if isinstance(node, dict):
+        node = upgrade(node)
+        return {k: _apply_upgrade_recursively(v, upgrade) for k, v in node.items()}
+    if isinstance(node, (list, tuple)):
+        vals = [_apply_upgrade_recursively(v, upgrade) for v in node]
+        return type(node)(vals) if not hasattr(node, "_fields") else type(node)(*vals)
+    return node
+
+
+def _restore_upgraded_opt_state(path, target, shardings, upgrade):
+    """Raw-restore a legacy-layout optimizer state, apply the model family's
+    layout upgrade to every nested params-shaped subtree, and rebuild into
+    the live ``target`` structure (orbax restores namedtuple states as
+    lists, so leaves are matched in flattened order — identical for both
+    container kinds) with the target's shardings."""
+    raw = _apply_upgrade_recursively(load_pytree(path), upgrade)
+    leaves = jax.tree_util.tree_leaves(raw)
+    treedef = jax.tree_util.tree_structure(target)
+    if len(leaves) != treedef.num_leaves:
+        raise ValueError(
+            f"legacy optimizer-state upgrade produced {len(leaves)} leaves "
+            f"but the live state has {treedef.num_leaves}"
+        )
+    restored = jax.tree_util.tree_unflatten(treedef, leaves)
+    return jax.tree_util.tree_map(
+        lambda t, s: (
+            jax.device_put(np.asarray(t), s)
+            if s is not None
+            else jax.numpy.asarray(t)
+        ),
+        restored,
+        shardings,
+    )
+
+
 def load_accelerator_state(accelerator, input_dir: Optional[str] = None, **kwargs) -> None:
     """Restore the training state (reference load_accelerator_state,
     checkpointing.py:183-320 + Accelerator.load_state accelerator.py:3750)."""
@@ -286,7 +326,19 @@ def load_accelerator_state(accelerator, input_dir: Optional[str] = None, **kwarg
             shardings = jax.tree_util.tree_map(
                 lambda t: t.sharding if isinstance(t, jax.Array) else None, opt.opt_state
             )
-            opt.opt_state = load_pytree(path, target=opt.opt_state, shardings=shardings)
+            try:
+                opt.opt_state = load_pytree(path, target=opt.opt_state, shardings=shardings)
+            except ValueError:
+                # Same legacy-layout story as the model above: adam mu/nu
+                # mirror the param tree, so a pre-split checkpoint's
+                # optimizer state needs the model's upgrade too.
+                model = accelerator._models[i] if i < len(accelerator._models) else None
+                upgrade = getattr(model, "upgrade_state_fn", None)
+                if upgrade is None:
+                    raise
+                opt.opt_state = _restore_upgraded_opt_state(
+                    path, opt.opt_state, shardings, upgrade
+                )
 
     for i, sched in enumerate(accelerator._schedulers):
         suffix = "" if i == 0 else f"_{i}"

@@ -121,8 +121,18 @@ def layer_norm(x, scale, bias, eps):
     return (y * scale.astype(jnp.float32) + bias.astype(jnp.float32)).astype(x.dtype)
 
 
-def _apply_dense(p, x, cdt):
-    return x @ p["kernel"].astype(cdt) + p["bias"].astype(cdt)
+def _apply_dense(p, x, cdt, tp_dim="skip"):
+    """Dense layer in compute dtype. ``tp_dim`` (0=row, 1=column, None=no
+    tp) additionally routes the casted kernel through ``gather_over_fsdp``
+    so fsdp-sharded weights all-gather in bf16, not their f32 master dtype
+    (see parallel/sharding.py); "skip" keeps the partitioner's default
+    placement (bert/t5 call sites that predate the hint)."""
+    w = p["kernel"].astype(cdt)
+    if tp_dim != "skip":
+        from ..parallel.sharding import gather_over_fsdp
+
+        w = gather_over_fsdp(w, tp_dim=tp_dim)
+    return x @ w + p["bias"].astype(cdt)
 
 
 def _bert_layer(config: BertConfig, lp, x, mask_bias):

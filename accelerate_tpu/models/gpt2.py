@@ -151,9 +151,9 @@ def _gpt2_layer(
     h, hd = config.num_attention_heads, config.head_dim
 
     y = layer_norm(x, lp["ln_1"]["scale"], lp["ln_1"]["bias"], config.layer_norm_eps)
-    q = _apply_dense(lp["attn"]["c_attn_q"], y, cdt).reshape(b, s, h, hd)
-    k = _apply_dense(lp["attn"]["c_attn_k"], y, cdt).reshape(b, s, h, hd)
-    v = _apply_dense(lp["attn"]["c_attn_v"], y, cdt).reshape(b, s, h, hd)
+    q = _apply_dense(lp["attn"]["c_attn_q"], y, cdt, tp_dim=1).reshape(b, s, h, hd)
+    k = _apply_dense(lp["attn"]["c_attn_k"], y, cdt, tp_dim=1).reshape(b, s, h, hd)
+    v = _apply_dense(lp["attn"]["c_attn_v"], y, cdt, tp_dim=1).reshape(b, s, h, hd)
     if attention_fn is not None:  # mesh-aware CP/SP attention from prepare()
         if segment_ids is not None:
             raise ValueError(
@@ -167,14 +167,14 @@ def _gpt2_layer(
             kv_block=config.attention_kv_block, block_q=config.attention_block_q,
             segment_ids=segment_ids,
         )
-    attn = _apply_dense(lp["attn"]["c_proj"], attn.reshape(b, s, d), cdt)
+    attn = _apply_dense(lp["attn"]["c_proj"], attn.reshape(b, s, d), cdt, tp_dim=0)
     attn = checkpoint_name(attn, "attn_block_out")  # saved under remat "minimal"
     x = constrain_activation(x + attn)
 
     y = layer_norm(x, lp["ln_2"]["scale"], lp["ln_2"]["bias"], config.layer_norm_eps)
     # gelu_new (tanh approximation) — matches HF GPT-2 exactly
-    y = jax.nn.gelu(_apply_dense(lp["mlp"]["c_fc"], y, cdt), approximate=True)
-    y = _apply_dense(lp["mlp"]["c_proj"], y, cdt)
+    y = jax.nn.gelu(_apply_dense(lp["mlp"]["c_fc"], y, cdt, tp_dim=1), approximate=True)
+    y = _apply_dense(lp["mlp"]["c_proj"], y, cdt, tp_dim=0)
     y = checkpoint_name(y, "mlp_block_out")
     out = constrain_activation(x + y)
     if collect_kv:
@@ -205,8 +205,9 @@ def gpt2_apply(
             f"sequence end {s + position_offset} exceeds "
             f"max_position_embeddings={config.max_position_embeddings}"
         )
-    table = replicate_over_fsdp(params["wte"]["embedding"], keep_tp=False)
-    x = table.astype(cdt)[input_ids]
+    # cast BEFORE the gather: the replication then moves bf16, not f32
+    table = replicate_over_fsdp(params["wte"]["embedding"].astype(cdt), keep_tp=False)
+    x = table[input_ids]
     wpe = params["wpe"]["embedding"].astype(cdt)
     if position_ids is not None:
         # packed rows: learned positions restart at each document
@@ -238,7 +239,7 @@ def gpt2_apply(
     head = params["wte"]["embedding"].T
     if config.use_chunked_ce:
         return {"hidden": x, "head_kernel": head}
-    logits = (x @ replicate_over_fsdp(head).astype(cdt)).astype(jnp.float32)
+    logits = (x @ replicate_over_fsdp(head.astype(cdt))).astype(jnp.float32)
     return constrain_activation(logits, "vocab")
 
 

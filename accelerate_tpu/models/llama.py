@@ -32,7 +32,11 @@ from jax import lax
 from jax.ad_checkpoint import checkpoint_name
 
 from ..model import Model
-from ..parallel.sharding import constrain_activation, replicate_over_fsdp
+from ..parallel.sharding import (
+    constrain_activation,
+    gather_over_fsdp,
+    replicate_over_fsdp,
+)
 
 __all__ = ["LlamaConfig", "init_llama_params", "llama_apply", "create_llama", "llama_loss"]
 
@@ -378,8 +382,11 @@ def _remat_policy(name: str):
     return None
 
 
-def _dot(config: LlamaConfig, x, w):
-    """Projection matmul, optionally via the fp8 path."""
+def _dot(config: LlamaConfig, x, w, tp_dim=None):
+    """Projection matmul, optionally via the fp8 path. ``w`` arrives already
+    cast to the compute dtype; ``gather_over_fsdp`` pins its use-time layout
+    (bf16 all-gather, tp axis kept on ``tp_dim``)."""
+    w = gather_over_fsdp(w, tp_dim=tp_dim)
     if config.use_fp8:
         from ..ops.fp8 import fp8_dot
 
@@ -435,7 +442,7 @@ def _layer(
 
     def _proj(name):
         p = layer_params["attn"][name]
-        out = _dot(config, y, p["kernel"].astype(cdt))
+        out = _dot(config, y, p["kernel"].astype(cdt), tp_dim=1)  # column
         if "bias" in p:  # Qwen2-style q/k/v biases (config.attention_bias)
             out = out + p["bias"].astype(cdt)
         return out
@@ -451,7 +458,8 @@ def _layer(
         config, q, k, v, attention_fn, q_offset=position_offset,
         segment_ids=segment_ids,
     )
-    attn = _dot(config, attn.reshape(b, s, h * hd), layer_params["attn"]["o_proj"]["kernel"].astype(cdt))
+    attn = _dot(config, attn.reshape(b, s, h * hd),
+                layer_params["attn"]["o_proj"]["kernel"].astype(cdt), tp_dim=0)
     attn = checkpoint_name(attn, "attn_block_out")
     x = constrain_activation(residual + attn)
 
@@ -473,10 +481,10 @@ def _layer(
             router_z_loss_coef=config.router_z_loss_coef,
         )
     else:
-        gate = _dot(config, y, layer_params["mlp"]["gate_proj"]["kernel"].astype(cdt))
-        up = _dot(config, y, layer_params["mlp"]["up_proj"]["kernel"].astype(cdt))
+        gate = _dot(config, y, layer_params["mlp"]["gate_proj"]["kernel"].astype(cdt), tp_dim=1)
+        up = _dot(config, y, layer_params["mlp"]["up_proj"]["kernel"].astype(cdt), tp_dim=1)
         y = constrain_activation(_mlp_act(config, gate) * up, "intermediate")
-        y = _dot(config, y, layer_params["mlp"]["down_proj"]["kernel"].astype(cdt))
+        y = _dot(config, y, layer_params["mlp"]["down_proj"]["kernel"].astype(cdt), tp_dim=0)
         aux = jnp.float32(0.0)
     y = checkpoint_name(y, "mlp_block_out")
     out = constrain_activation(residual + y)
@@ -511,8 +519,11 @@ def llama_apply(
     # explicit use-time all-gather of the (possibly fsdp/tp-sharded) table:
     # a gather from a sharded table is the partitioner's worst case (it
     # replicates involuntarily); same bytes moved, no pathological reshard
-    table = replicate_over_fsdp(params["embed_tokens"]["embedding"], keep_tp=False)
-    x = table.astype(cdt)[input_ids]
+    # cast BEFORE the gather: the replication then moves bf16, not f32
+    table = replicate_over_fsdp(
+        params["embed_tokens"]["embedding"].astype(cdt), keep_tp=False
+    )
+    x = table[input_ids]
     if config.scale_embeddings:  # Gemma: sqrt(d) in the embedding path
         x = x * jnp.asarray(config.hidden_size**0.5, dtype=cdt)
     x = constrain_activation(x)
@@ -561,7 +572,7 @@ def llama_apply(
         return out
     # use-time all-gather of the fsdp-sharded head; keeps logits (and their
     # cotangents) on the batch/seq layout — see replicate_over_fsdp
-    logits = (x @ replicate_over_fsdp(head).astype(cdt)).astype(jnp.float32)
+    logits = (x @ replicate_over_fsdp(head.astype(cdt))).astype(jnp.float32)
     logits = constrain_activation(logits, "vocab")
     if return_aux:
         return logits, {"aux_loss": aux_total}
@@ -616,8 +627,8 @@ def _ce_from_hidden(config, x, head, labels, mask, *, reduction="mean",
     # batch/seq-sharded, and the backward transpose hits the involuntary
     # full-rematerialization path (d_logits {batch,seq} -> {vocab} flip).
     # With a replicated head, d_head is a local partial + psum — clean.
-    head = replicate_over_fsdp(head)
-    logits = (x @ head.astype(config.compute_dtype)).astype(jnp.float32)
+    head = replicate_over_fsdp(head.astype(config.compute_dtype))
+    logits = (x @ head).astype(jnp.float32)
     logits = constrain_activation(logits, "vocab")
     return _dense_ce_from_logits(logits, labels, mask, reduction=reduction)
 
@@ -1077,8 +1088,17 @@ def llama_decode_step(config: LlamaConfig, params, cache, token, pos):
     return logits[:, 0].astype(jnp.float32), {"k": new_k, "v": new_v}
 
 
-def create_llama(config: LlamaConfig, seed: int = 0) -> Model:
-    params = init_llama_params(config, jax.random.key(seed))
+def create_llama(config: LlamaConfig, seed: int = 0, abstract: bool = False) -> Model:
+    """``abstract=True`` builds the model with shape-only params
+    (``jax.eval_shape``): prepare() then annotates shardings instead of
+    placing arrays, and only ``train_step(...).lower`` works — the
+    compile-analysis path for configs too big to materialize locally."""
+    if abstract:
+        params = jax.eval_shape(
+            functools.partial(init_llama_params, config), jax.random.key(seed)
+        )
+    else:
+        params = init_llama_params(config, jax.random.key(seed))
     return_aux = config.num_experts > 1
     overrides = {"attention_fn": None, "layer_stack_fn": None}
 

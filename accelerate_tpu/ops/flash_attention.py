@@ -38,7 +38,7 @@ from jax.experimental import pallas as pl
 
 from .attention import NEG_INF
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "flash_attention_with_lse"]
 
 DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_K = 512
@@ -162,9 +162,10 @@ def _flash_fwd(q, k, v, segs, h, h_kv, causal, block_q, block_k, interpret,
     from jax.experimental.pallas import tpu as pltpu
 
     bh, s, d = q.shape
+    skv = k.shape[1]
     scale = 1.0 / math.sqrt(d)
     nq = s // block_q
-    nk = s // block_k
+    nk = skv // block_k
     grid = (bh, nq, nk)
     segmented = segs is not None
     in_specs = [
@@ -299,18 +300,24 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
 
 
 def _flash_bwd(q, k, v, segs, out, lse, do, h, h_kv, causal, block_q, block_k,
-               interpret, window=None):
+               interpret, window=None, dlse=None):
     from jax.experimental.pallas import tpu as pltpu
 
     bh, s, d = q.shape
+    skv = k.shape[1]
     bh_kv = k.shape[0]
     n_rep = h // h_kv
     scale = 1.0 / math.sqrt(d)
     segmented = segs is not None
     # (bh, 1, s): same lane-major layout as lse (see _flash_fwd out_specs)
     delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32), axis=-1)[:, None, :]
+    if dlse is not None:
+        # lse cotangent (ring-attention LSE merge): d s_ij gains
+        # + dlse_i * p_ij, which folds into the kernels as delta -= dlse
+        # (ds = p * (dp - delta) everywhere below) — zero kernel changes.
+        delta = delta - dlse.astype(jnp.float32)
     nq = s // block_q
-    nk = s // block_k
+    nk = skv // block_k
 
     dq_in_specs = [
         pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -375,8 +382,8 @@ def _flash_bwd(q, k, v, segs, out, lse, do, h, h_kv, causal, block_q, block_k,
             pl.BlockSpec((1, block_k, d), lambda g, j, t: (g, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh_kv, s, d), k.dtype),
-            jax.ShapeDtypeStruct((bh_kv, s, d), v.dtype),
+            jax.ShapeDtypeStruct((bh_kv, skv, d), k.dtype),
+            jax.ShapeDtypeStruct((bh_kv, skv, d), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
@@ -417,6 +424,80 @@ def _flash_core_bwd(h, h_kv, causal, block_q, block_k, interpret, window,
 
 
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+
+
+# ------------------------------------------------- (out, lse) variant
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_core_lse(q, k, v, h, h_kv, causal, block_q, block_k, interpret):
+    """Like :func:`_flash_core` but also returns the per-row logsumexp —
+    the ring-attention building block (ops/ring_attention.py): per-step
+    normalized outputs merge across the ring via their LSEs, and the VJP
+    accepts an ``lse`` cotangent (the merge differentiates through it)."""
+    return _flash_fwd(q, k, v, None, h, h_kv, causal, block_q, block_k,
+                      interpret, None)
+
+
+def _flash_core_lse_fwd(q, k, v, h, h_kv, causal, block_q, block_k, interpret):
+    out, lse = _flash_fwd(q, k, v, None, h, h_kv, causal, block_q, block_k,
+                          interpret, None)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _flash_core_lse_bwd(h, h_kv, causal, block_q, block_k, interpret,
+                        residuals, cotangents):
+    q, k, v, out, lse = residuals
+    do, dlse = cotangents
+    dq, dk, dv = _flash_bwd(
+        q, k, v, None, out, lse, do, h, h_kv, causal, block_q, block_k,
+        interpret, None, dlse=dlse,
+    )
+    return dq, dk, dv
+
+
+_flash_core_lse.defvjp(_flash_core_lse_fwd, _flash_core_lse_bwd)
+
+
+def flash_attention_with_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+    interpret: Optional[bool] = None,
+):
+    """(B, Sq, H, D) x (B, Skv, H_kv, D) flash attention returning
+    ``(out (B, Sq, H, D), lse (B, H, Sq) f32)``.
+
+    The LSE output makes per-shard results mergeable (ring attention /
+    any online-softmax combination): ``(out, m=lse, l=1)`` feeds
+    :func:`~accelerate_tpu.ops.attention.combine_blocks` directly, and the
+    custom VJP differentiates through the merge (an ``lse`` cotangent
+    shifts ``delta`` in the shared backward kernels). Unlike
+    :func:`flash_attention`, q and kv sequence lengths may differ —
+    ``causal`` anchors both at position 0, so ring callers pass
+    ``causal=True`` only on the diagonal step."""
+    b, sq, hh, d = q.shape
+    h_kv = k.shape[2]
+    skv = k.shape[1]
+    if hh % h_kv != 0:
+        raise ValueError(f"num heads {hh} not divisible by kv heads {h_kv}")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    block_q = _pick_block(sq, block_q)
+    block_k = _pick_block(skv, block_k)
+
+    def merge(x):
+        n = x.shape[2]
+        return x.transpose(0, 2, 1, 3).reshape(b * n, x.shape[1], d)
+
+    out, lse = _flash_core_lse(
+        merge(q), merge(k), merge(v), hh, h_kv, causal, block_q, block_k,
+        interpret,
+    )
+    out = out.reshape(b, hh, sq, d).transpose(0, 2, 1, 3)
+    return out, lse.reshape(b, hh, sq)
 
 
 def flash_attention(

@@ -74,6 +74,22 @@ def _attend_shard(q, k_shard, v_shard, q_start, kv_start, causal,
     )
 
 
+def _flash_partials(q, k, v, causal, block_q, block_k):
+    """One ring step through the Pallas flash kernel: the normalized
+    (out, lse) pair re-enters the online-softmax merge as ``(out, m=lse,
+    l=1)`` — algebraically the LSE merge rule. The kernel's custom VJP
+    accepts the lse cotangent the merge produces (flash_attention.py
+    ``_flash_core_lse``), so the whole ring differentiates through it.
+    GQA stays native (kv never repeated) and the kernel applies 1/sqrt(d)
+    itself — callers pass RAW q and native kv heads."""
+    from .flash_attention import flash_attention_with_lse
+
+    out, lse = flash_attention_with_lse(
+        q, k, v, causal=causal, block_q=block_q, block_k=block_k
+    )
+    return out, lse, jnp.ones_like(lse)
+
+
 def ring_attention_local(
     q: jax.Array,
     k: jax.Array,
@@ -83,16 +99,28 @@ def ring_attention_local(
     causal: bool = True,
     rotate_method: str = "alltoall",
     kv_block: Optional[int] = None,
+    attention_impl: str = "blockwise",
+    block_q: int = 2048,
 ) -> jax.Array:
     """Attention over sequence-sharded q/k/v — call INSIDE shard_map with
-    ``axis_name`` bound. Shapes are local shards (B, S/n, H, D)."""
+    ``axis_name`` bound. Shapes are local shards (B, S/n, H, D).
+
+    ``attention_impl="flash"`` runs the Pallas kernel per ring step and
+    merges steps by LSE. No positional offsets reach the kernel: contiguous
+    shards make step 0 exactly the causal diagonal (local positions align),
+    and every later step's kv shard is either wholly past (full attention)
+    or wholly future (skipped via ``lax.cond``). The ``allgather`` rotation
+    keeps the blockwise path — its single local attention spans shards with
+    a true offset, which the kernel's 0-anchored mask cannot express."""
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     b, sq, h, d = q.shape
-    n_rep = h // k.shape[2]
-    k = repeat_kv(k, n_rep)
-    v = repeat_kv(v, n_rep)
-    q = q * (1.0 / math.sqrt(d))
+    use_flash = attention_impl == "flash" and rotate_method != "allgather"
+    if not use_flash:
+        n_rep = h // k.shape[2]
+        k = repeat_kv(k, n_rep)
+        v = repeat_kv(v, n_rep)
+        q = q * (1.0 / math.sqrt(d))  # kernel-less paths pre-scale
     q_start = idx * sq
 
     if rotate_method == "allgather":
@@ -109,16 +137,34 @@ def ring_attention_local(
     m = jnp.full((b, h, sq), NEG_INF, dtype=jnp.float32)
     l = jnp.zeros((b, h, sq), dtype=jnp.float32)
 
+    block_k = kv_block or 512
+
     # unrolled python loop: n is static; final rotation skipped so the ring
     # does exactly n-1 hops
     carry = (out, m, l, k, v)
     for step in range(n):
         out, m, l, k_cur, v_cur = carry
         kv_rank = (idx - step) % n
-        o2, m2, l2 = _attend_shard(
-            q, k_cur, v_cur, q_start, kv_rank * sq, causal, kv_block
-        )
-        out, m, l = combine_blocks(out, m, l, o2, m2, l2)
+        if use_flash:
+            def attend(operand, diag=(step == 0), kc=k_cur, vc=v_cur):
+                out, m, l = operand
+                o2, m2, l2 = _flash_partials(
+                    q, kc, vc, causal and diag, block_q, block_k
+                )
+                return combine_blocks(out, m, l, o2, m2, l2)
+
+            if step == 0 or not causal:
+                out, m, l = attend((out, m, l))
+            else:
+                # kv_rank is traced (axis_index): branch at run time
+                out, m, l = lax.cond(
+                    kv_rank < idx, attend, lambda op: op, (out, m, l)
+                )
+        else:
+            o2, m2, l2 = _attend_shard(
+                q, k_cur, v_cur, q_start, kv_rank * sq, causal, kv_block
+            )
+            out, m, l = combine_blocks(out, m, l, o2, m2, l2)
         if step < n - 1:
             k_cur = lax.ppermute(k_cur, axis_name, perm)
             v_cur = lax.ppermute(v_cur, axis_name, perm)
@@ -155,6 +201,8 @@ def zigzag_ring_attention_local(
     causal: bool = True,
     seq_len: int = None,
     kv_block: Optional[int] = None,
+    attention_impl: str = "blockwise",
+    block_q: int = 2048,
 ) -> jax.Array:
     """Ring attention over zig-zag-permuted shards — call INSIDE shard_map.
 
@@ -163,21 +211,29 @@ def zigzag_ring_attention_local(
     fully-masked pairs are skipped via ``lax.cond`` — with this layout the
     skip count is equal across ranks, halving causal wall-clock vs the
     contiguous ring.
+
+    ``attention_impl="flash"`` runs the Pallas kernel per chunk pair with
+    LSE merging. Chunk pairs need no kernel offsets: equal chunks are
+    causal-diagonal (and occur only at step 0, statically), ordered chunks
+    are fully visible, future chunks are skipped.
     """
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     b, sq, h, d = q.shape
     c = sq // 2  # chunk rows
-    n_rep = h // k.shape[2]
-    k = repeat_kv(k, n_rep)
-    v = repeat_kv(v, n_rep)
-    q = q * (1.0 / math.sqrt(d))
+    use_flash = attention_impl == "flash"
+    if not use_flash:
+        n_rep = h // k.shape[2]
+        k = repeat_kv(k, n_rep)
+        v = repeat_kv(v, n_rep)
+        q = q * (1.0 / math.sqrt(d))
 
     def my_chunks(rank):
         return rank, 2 * n - 1 - rank  # chunk ids held by `rank`
 
     q_chunks = (q[:, :c], q[:, c:])
     perm = [(i, (i + 1) % n) for i in range(n)]
+    block_k = kv_block or 512
 
     outs = []
     for qi in range(2):  # per local q chunk: own accumulators
@@ -202,20 +258,43 @@ def zigzag_ring_attention_local(
                 k_blk = (k_cur[:, :c], k_cur[:, c:])[ki]
                 v_blk = (v_cur[:, :c], v_cur[:, c:])[ki]
                 kv_start = kv_chunk_ids[ki] * c
+                # chunk relation: equal ids happen ONLY at step 0 (then for
+                # both local pairs), so the diagonal case is static
+                diagonal = step == 0 and qi == ki
 
-                def attend(operand):
-                    out, m, l = operand
-                    o2, m2, l2 = _attend_shard(
-                        q_blk, k_blk, v_blk, q_start, kv_start, causal, kv_block
-                    )
-                    return combine_blocks(out, m, l, o2, m2, l2)
-
-                if causal:
-                    # fully masked iff the kv chunk lies strictly in the future
-                    visible = kv_start <= q_start
-                    out, m, l = lax.cond(visible, attend, lambda op: op, (out, m, l))
+                if use_flash:
+                    def attend(operand, diag=diagonal, kb=k_blk, vb=v_blk,
+                               qb=q_blk):
+                        out, m, l = operand
+                        o2, m2, l2 = _flash_partials(
+                            qb, kb, vb, causal and diag, block_q, block_k
+                        )
+                        return combine_blocks(out, m, l, o2, m2, l2)
                 else:
+                    def attend(operand, qb=q_blk, kb=k_blk, vb=v_blk,
+                               qs=q_start, ks=kv_start):
+                        out, m, l = operand
+                        o2, m2, l2 = _attend_shard(
+                            qb, kb, vb, qs, ks, causal, kv_block
+                        )
+                        return combine_blocks(out, m, l, o2, m2, l2)
+
+                if not causal:
                     out, m, l = attend((out, m, l))
+                elif diagonal:
+                    out, m, l = attend((out, m, l))
+                elif step == 0 and qi != ki:
+                    # step-0 cross pairs are static too: (q chunk idx,
+                    # kv chunk 2n-1-idx) is future→skip; the transpose is
+                    # wholly past→full
+                    if qi == 1:  # q chunk 2n-1-idx vs kv chunk idx: past
+                        out, m, l = attend((out, m, l))
+                    # qi == 0: kv chunk 2n-1-idx is future — skip
+                else:
+                    # fully masked iff the kv chunk lies strictly in the
+                    # future (equal ids cannot occur past step 0)
+                    visible = kv_start < q_start if use_flash else kv_start <= q_start
+                    out, m, l = lax.cond(visible, attend, lambda op: op, (out, m, l))
             outs[qi] = (out, m, l)
         if step < n - 1:
             k_cur = lax.ppermute(k_cur, axis_name, perm)
@@ -233,10 +312,17 @@ def make_ring_attention(
     head_axes: Sequence[str] = ("tp", "sp"),
     rotate_method: str = "alltoall",
     kv_block: Optional[int] = 2048,
+    attention_impl: str = "blockwise",
+    block_q: int = 2048,
 ):
     """Build an attention fn over GLOBAL (B, S, H, D) arrays that runs ring
     attention across the cp axis (composing with dp batch sharding and tp
-    head sharding). Inject into a model as its ``attention_fn``."""
+    head sharding). Inject into a model as its ``attention_fn``.
+
+    ``attention_impl="flash"`` runs each ring step through the Pallas flash
+    kernel with LSE merging (``alltoall``/``zigzag`` rotations; the
+    ``allgather`` rotation keeps the blockwise path — see
+    :func:`ring_attention_local`)."""
     batch = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1) or None
     heads = tuple(a for a in head_axes if mesh.shape.get(a, 1) > 1) or None
     spec = P(batch, cp_axis, heads, None)
@@ -253,7 +339,8 @@ def make_ring_attention(
             vz = jnp.take(v, perm_j, axis=1)
             body = functools.partial(
                 zigzag_ring_attention_local, axis_name=cp_axis, causal=causal,
-                kv_block=kv_block,
+                kv_block=kv_block, attention_impl=attention_impl,
+                block_q=block_q,
             )
             fn = jax.shard_map(
                 body,
@@ -270,6 +357,8 @@ def make_ring_attention(
             causal=causal,
             rotate_method=rotate_method,
             kv_block=kv_block,
+            attention_impl=attention_impl,
+            block_q=block_q,
         )
         fn = jax.shard_map(
             body,

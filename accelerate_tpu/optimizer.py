@@ -222,6 +222,11 @@ class AcceleratedOptimizer:
 
         jax.tree_util.tree_map_with_path(collect, params)
         if mesh is None:  # unsharded params — plain placement is fine
+            if any(
+                isinstance(p, jax.ShapeDtypeStruct)
+                for p in jax.tree_util.tree_leaves(params)
+            ):
+                return jax.eval_shape(self.tx.init, params)
             return jax.jit(self.tx.init)(params)
 
         abstract = jax.eval_shape(self.tx.init, params)
@@ -241,6 +246,17 @@ class AcceleratedOptimizer:
             return replicated
 
         out_shardings = jax.tree_util.tree_map_with_path(out_sharding, abstract)
+        if any(
+            isinstance(p, jax.ShapeDtypeStruct)
+            for p in jax.tree_util.tree_leaves(params)
+        ):
+            # Abstract (shape-only) prepare: annotate the eval_shape'd state
+            # with the same shardings instead of materializing it.
+            return jax.tree_util.tree_map(
+                lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+                abstract,
+                out_shardings,
+            )
         return jax.jit(self.tx.init, out_shardings=out_shardings)(params)
 
     @property

@@ -139,10 +139,19 @@ def infer_shardings(
 
 def apply_shardings(params: Any, shardings: Any) -> Any:
     """Place (or re-place) every leaf according to its sharding — the one-time
-    "wrap" step of prepare() (vs the reference's module surgery)."""
-    return jax.tree_util.tree_map(
-        lambda p, s: jax.device_put(p, s), params, shardings
-    )
+    "wrap" step of prepare() (vs the reference's module surgery).
+
+    Abstract leaves (``jax.ShapeDtypeStruct``) are annotated instead of
+    placed: prepare() then works shape-only, so a 7B-class config can be
+    sharded, lowered, and compile-analyzed on a small host without ever
+    materializing the parameters (see Accelerator.train_step's ``.lower``)."""
+
+    def place(p, s):
+        if isinstance(p, jax.ShapeDtypeStruct):
+            return jax.ShapeDtypeStruct(p.shape, p.dtype, sharding=s)
+        return jax.device_put(p, s)
+
+    return jax.tree_util.tree_map(place, params, shardings)
 
 
 def shard_params(
@@ -207,13 +216,69 @@ def _axis_entry(mesh: Mesh, axes: Sequence[str], dim_size: int):
     return tuple(use) if len(use) > 1 else use[0]
 
 
-def replicate_over_fsdp(w, mesh: Optional[Mesh] = None, keep_tp: bool = True):
+def _fsdp_use_hints(mesh: Mesh):
+    """(active fsdp axes, min weight size) for use-time gather pinning,
+    read from the live AcceleratorState — prepare_model records the actual
+    config. Nothing recorded (bare shard_params / rules-only meshes) means
+    NO storage pin: pinning a weight that is not actually fsdp-sharded
+    would force a pointless reshard+gather round trip. The hints are a
+    process-global performance hint only (last prepare_model wins) — a
+    stale hint can cost layout efficiency but never correctness, since
+    sharding constraints never change values."""
+    from ..state import AcceleratorState
+
+    st = AcceleratorState._shared_state
+    axes = st.get("fsdp_axes") or ()
+    minw = st.get("fsdp_min_weight_size", 2**10)
+    return tuple(a for a in axes if mesh.shape.get(a, 1) > 1), minw
+
+
+import functools as _functools
+
+
+@_functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _gather_pin(w, storage_sh, use_sh):
+    """Storage→use-layout reshard with a reduce-scatter backward.
+
+    Forward: pin the (already compute-dtype) weight to its storage sharding
+    — the cast runs on-shard — then release to the use layout, so the
+    all-gather moves the compute dtype.
+
+    Backward: constrain the cotangent ONLY to the storage sharding. The
+    naive transpose would replay both constraints in reverse: the use-layout
+    (replicated) constraint forces the partial weight-grad to materialize
+    via a FULL all-reduce before the storage constraint slices it. Going
+    straight from partial to shard is exactly reduce-scatter — half the ICI
+    bytes per step on the FSDP grad path."""
+    return jax.lax.with_sharding_constraint(
+        jax.lax.with_sharding_constraint(w, storage_sh), use_sh
+    )
+
+
+def _gather_pin_fwd(w, storage_sh, use_sh):
+    return _gather_pin(w, storage_sh, use_sh), None
+
+
+def _gather_pin_bwd(storage_sh, use_sh, _, g):
+    return (jax.lax.with_sharding_constraint(g, storage_sh),)
+
+
+_gather_pin.defvjp(_gather_pin_fwd, _gather_pin_bwd)
+
+
+def gather_over_fsdp(w, tp_dim: Optional[int] = None, mesh: Optional[Mesh] = None):
     """Use-time all-gather of a 2D fsdp-sharded weight: replicated on every
-    axis except ``tp``, which stays on the last (output) dim when it divides
-    (Megatron column sharding); ``keep_tp=False`` replicates fully (e.g. an
-    embedding table consumed by a gather, where any remaining sharding sends
-    the partitioner down its involuntary-replication path anyway). The
-    explicit constraint keeps the weight's consumers on THEIR layout so the
+    axis except ``tp``, which stays on dim ``tp_dim`` when given and it
+    divides (Megatron column sharding: tp_dim=1; row: tp_dim=0; None
+    replicates fully).
+
+    Call this on the weight AFTER casting to the compute dtype. GSPMD runs
+    elementwise ops on their OUTPUT sharding, so a lone replication
+    constraint on the cast would gather the f32 master weight and convert
+    afterwards — 2x the ICI bytes. Two constraints fix the schedule: pin the
+    cast to the weight's STORAGE sharding (cast runs on-shard), then release
+    to the use-time layout (the all-gather moves bf16). The use-time
+    constraint also keeps the weight's consumers on THEIR layout so the
     backward computes a local partial + psum for the weight grad instead of
     resharding the activation gradient (involuntary full rematerialization)."""
     if mesh is None:
@@ -225,13 +290,32 @@ def replicate_over_fsdp(w, mesh: Optional[Mesh] = None, keep_tp: bool = True):
             return w
     except Exception:
         pass
-    tp = _axis_entry(mesh, _ACT_TP_AXIS, w.shape[-1]) if keep_tp else None
+    spec = [None, None]
+    if tp_dim is not None:
+        spec[tp_dim] = _axis_entry(mesh, _ACT_TP_AXIS, w.shape[tp_dim])
     try:
-        return jax.lax.with_sharding_constraint(
-            w, NamedSharding(mesh, P(None, tp))
-        )
+        fsdp_axes, minw = _fsdp_use_hints(mesh)
+        use_spec = P(*spec)
+        if fsdp_axes and int(np.prod(w.shape)) >= minw:
+            storage = _fsdp_spec_for(
+                w.shape, mesh, list(fsdp_axes),
+                use_spec if any(spec) else None,
+            )
+            if _spec_used_axes(storage) - _spec_used_axes(use_spec):
+                return _gather_pin(
+                    w,
+                    NamedSharding(mesh, storage),
+                    NamedSharding(mesh, use_spec),
+                )
+        return jax.lax.with_sharding_constraint(w, NamedSharding(mesh, use_spec))
     except Exception:
         return w
+
+
+def replicate_over_fsdp(w, mesh: Optional[Mesh] = None, keep_tp: bool = True):
+    """:func:`gather_over_fsdp` with the historical signature: ``keep_tp``
+    keeps ``tp`` on the last (output) dim — column sharding."""
+    return gather_over_fsdp(w, tp_dim=1 if keep_tp else None, mesh=mesh)
 
 
 def constrain_activation(x, kind: str = "residual", mesh: Optional[Mesh] = None):

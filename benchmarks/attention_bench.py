@@ -33,6 +33,10 @@ def main():
     parser.add_argument("--fwd_only", action="store_true")
     parser.add_argument("--impls", nargs="+",
                         default=["flash", "blockwise", "xla"])
+    parser.add_argument("--ring", type=int, default=0,
+                        help="additionally bench ring attention (CP) over an "
+                        "N-way cp mesh: ring+blockwise and ring+flash rows. "
+                        "Needs >= N devices (virtual CPU mesh or a pod).")
     parser.add_argument("--out", default="benchmarks/attention_results.jsonl")
     args = parser.parse_args()
 
@@ -46,6 +50,30 @@ def main():
     dtype = jnp.bfloat16 if on_tpu else jnp.float32
     kvh = args.kv_heads or args.heads
     rows = []
+
+    impls = list(args.impls)
+    ring_fns = {}
+    if args.ring > 1 and args.window is not None:
+        # ring attention has no sliding-window mode; rows would run full
+        # causal attention while the window-adjusted flops formula deflated
+        # their TFLOP/s — not comparable, so skip instead of misreport
+        print(json.dumps({"note": "--ring rows skipped: window unsupported"}))
+    elif args.ring > 1:
+        from accelerate_tpu.ops.ring_attention import make_ring_attention
+        from accelerate_tpu.parallelism_config import ParallelismConfig
+
+        n_dev = len(jax.devices())
+        if n_dev % args.ring:
+            raise SystemExit(f"--ring {args.ring} does not divide {n_dev} devices")
+        pcfg = ParallelismConfig(cp_size=args.ring,
+                                 dp_shard_size=n_dev // args.ring)
+        mesh = pcfg.build_device_mesh()
+        for name, impl in (("ring+blockwise", "blockwise"),
+                           ("ring+flash", "flash")):
+            ring_fns[name] = make_ring_attention(
+                mesh, attention_impl=impl, kv_block=512
+            )
+        impls += list(ring_fns)
 
     for seq in args.seqs:
         rng = np.random.default_rng(0)
@@ -61,9 +89,13 @@ def main():
             pair_frac = (w * seq - w * w / 2) / (seq * seq)
         flops_fwd = 4 * args.batch * args.heads * seq * seq * args.head_dim * pair_frac
 
-        for impl in args.impls:
-            fwd = jax.jit(lambda q, k, v, _i=impl: dispatch_attention(
-                _i, q, k, v, causal=True, window=args.window))
+        for impl in impls:
+            if impl in ring_fns:
+                fwd = jax.jit(lambda q, k, v, _f=ring_fns[impl]: _f(
+                    q, k, v, causal=True))
+            else:
+                fwd = jax.jit(lambda q, k, v, _i=impl: dispatch_attention(
+                    _i, q, k, v, causal=True, window=args.window))
 
             def loss(q, k, v, _f=fwd):
                 return jnp.sum(_f(q, k, v).astype(jnp.float32))

@@ -218,7 +218,7 @@ def test_load_state_legacy_layout_fallback(tmp_path):
 
     acc = _fresh(tmp_path)
     model = create_gpt2(GPT2Config.tiny(), seed=0)
-    model = acc.prepare(model)
+    model, opt = acc.prepare(model, optax.adamw(1e-3))
     ckpt = acc.save_state(str(tmp_path / "ckpt"))
 
     # Rewrite the model checkpoint in the legacy fused-c_attn layout.
@@ -241,6 +241,38 @@ def test_load_state_legacy_layout_fallback(tmp_path):
     shutil.rmtree(model_dir)
     save_pytree(legacy, model_dir)
 
+    # Rewrite the OPTIMIZER checkpoint in the legacy layout too: adam mu/nu
+    # mirror the param tree, so a real pre-split checkpoint has fused
+    # c_attn entries inside the optimizer state as well.
+    def fuse(tree):
+        if isinstance(tree, dict):
+            if "c_attn_q" in tree.get("layers", {}).get("attn", {}):
+                t = dict(tree)
+                a = t["layers"]["attn"]
+                t["layers"] = dict(t["layers"])
+                t["layers"]["attn"] = {
+                    "c_attn": {
+                        "kernel": np.concatenate(
+                            [a["c_attn_q"]["kernel"], a["c_attn_k"]["kernel"],
+                             a["c_attn_v"]["kernel"]], axis=-1),
+                        "bias": np.concatenate(
+                            [a["c_attn_q"]["bias"], a["c_attn_k"]["bias"],
+                             a["c_attn_v"]["bias"]], axis=-1),
+                    },
+                    "c_proj": a["c_proj"],
+                }
+                return t
+            return {k: fuse(v) for k, v in tree.items()}
+        if isinstance(tree, (list, tuple)):
+            vals = [fuse(v) for v in tree]
+            return type(tree)(vals) if not hasattr(tree, "_fields") else type(tree)(*vals)
+        return tree
+
+    opt_host = jax.tree_util.tree_map(np.asarray, opt.opt_state)
+    opt_dir = os.path.join(ckpt, "optimizer")
+    shutil.rmtree(opt_dir)
+    save_pytree(fuse(opt_host), opt_dir)
+
     # Perturb in-memory params, then restore from the legacy checkpoint.
     expected_sharding = model.params["layers"]["attn"]["c_attn_q"]["kernel"].sharding
     model.params = jax.tree_util.tree_map(lambda p: p * 0, model.params)
@@ -253,3 +285,12 @@ def test_load_state_legacy_layout_fallback(tmp_path):
     # the fallback re-places params with the model's prepared shardings
     leaf = model.params["layers"]["attn"]["c_attn_q"]["kernel"]
     assert leaf.sharding == expected_sharding
+
+    # the optimizer state came back through the same upgrade: every restored
+    # leaf equals the state that was saved (mu/nu fused and re-split)
+    restored_opt = jax.tree_util.tree_map(np.asarray, opt.opt_state)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(restored_opt),
+        jax.tree_util.tree_leaves(opt_host),
+    ):
+        np.testing.assert_array_equal(a, b)

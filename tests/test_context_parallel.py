@@ -267,3 +267,108 @@ def test_ulysses_flash_inner_matches_blockwise():
         model.policy = None
         outs[impl] = np.asarray(model(jnp.asarray(ids)))
     np.testing.assert_allclose(outs["flash"], outs["blockwise"], atol=2e-4)
+
+
+# ------------------------------------------------------- flash-in-ring
+@pytest.mark.parametrize("rotate_method", ["alltoall", "zigzag"])
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_flash_matches_reference(rotate_method, causal):
+    """Each ring step through the Pallas kernel (interpret mode on CPU) +
+    LSE merge == the dense reference (VERDICT r3 next-round #2)."""
+    cfg = ParallelismConfig(cp_size=4, dp_shard_size=2)
+    mesh = cfg.build_device_mesh()
+    q, k, v = _qkv(s=64)
+    ref = dot_product_attention(q, k, v, causal=causal)
+    ring = make_ring_attention(
+        mesh, rotate_method=rotate_method, attention_impl="flash",
+        kv_block=16, block_q=16,
+    )
+    out = jax.jit(lambda q, k, v: ring(q, k, v, causal=causal))(q, k, v)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), atol=2e-5)
+
+
+def test_ring_flash_equals_blockwise_exactly():
+    """ring+flash == ring+blockwise to float tolerance, fwd AND grads —
+    the same ring merge over different per-step engines."""
+    cfg = ParallelismConfig(cp_size=4, dp_shard_size=2)
+    mesh = cfg.build_device_mesh()
+    q, k, v = _qkv(s=64, h=8, kvh=2)  # GQA composes
+    ring_b = make_ring_attention(mesh, kv_block=16)
+    ring_f = make_ring_attention(
+        mesh, attention_impl="flash", kv_block=16, block_q=16
+    )
+
+    def loss(fn):
+        def f(q, k, v):
+            return jnp.sum(fn(q, k, v, causal=True) ** 2)
+        return f
+
+    out_b = jax.jit(lambda q, k, v: ring_b(q, k, v, causal=True))(q, k, v)
+    out_f = jax.jit(lambda q, k, v: ring_f(q, k, v, causal=True))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out_b), np.asarray(out_f), atol=1e-6)
+
+    gb = jax.jit(jax.grad(loss(ring_b), argnums=(0, 1, 2)))(q, k, v)
+    gf = jax.jit(jax.grad(loss(ring_f), argnums=(0, 1, 2)))(q, k, v)
+    for b_, f_ in zip(gb, gf):
+        assert np.all(np.isfinite(np.asarray(f_)))
+        np.testing.assert_allclose(np.asarray(b_), np.asarray(f_), atol=1e-5)
+
+
+def test_ring_flash_grads_match_dense():
+    """ring+flash grads == dense-attention grads (full chain: kernel VJP
+    with lse cotangents + merge + ppermute transpose)."""
+    cfg = ParallelismConfig(cp_size=4, dp_shard_size=2)
+    mesh = cfg.build_device_mesh()
+    q, k, v = _qkv(s=64)
+    ring = make_ring_attention(
+        mesh, attention_impl="flash", kv_block=16, block_q=16
+    )
+
+    def loss(q, k, v):
+        return jnp.sum(ring(q, k, v, causal=True) ** 2)
+
+    ref_grads = jax.grad(
+        lambda q, k, v: jnp.sum(dot_product_attention(q, k, v, causal=True) ** 2),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    grads = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+    for g, r in zip(grads, ref_grads):
+        assert np.all(np.isfinite(np.asarray(g)))
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r), atol=1e-4)
+
+
+@pytest.mark.slow
+def test_llama_cp_flash_training_matches_dp():
+    """CP training with attention_impl='flash' (ring runs the Pallas kernel
+    per step) matches the pure-FSDP trajectory, like the blockwise CP test."""
+    from accelerate_tpu.models.llama import LlamaConfig, create_llama, llama_loss
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    rng = np.random.default_rng(0)
+    data = {"input_ids": rng.integers(0, 256, size=(8, 64)).astype(np.int32)}
+
+    def run(pcfg, attention_impl):
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+        PartialState._reset_state()
+        acc = Accelerator(parallelism_config=pcfg)
+        cfg = LlamaConfig.tiny(compute_dtype=jnp.float32,
+                               attention_impl=attention_impl,
+                               attention_kv_block=16, attention_block_q=16)
+        model = create_llama(cfg, seed=0)
+        opt = optax.sgd(1e-2)
+        model, opt = acc.prepare(model, opt)
+        loader = acc.prepare_data_loader(data, batch_size=8, drop_last=True)
+        for batch in loader:
+            with acc.accumulate(model):
+                loss = acc.backward(llama_loss, batch)
+                opt.step()
+                opt.zero_grad()
+        return np.asarray(
+            jax.device_get(model.params["layers"]["attn"]["q_proj"]["kernel"])
+        ), float(loss)
+
+    w_dp, loss_dp = run(ParallelismConfig(dp_shard_size=8), "blockwise")
+    w_cp, loss_cp = run(ParallelismConfig(dp_shard_size=2, cp_size=4), "flash")
+    assert loss_cp == pytest.approx(loss_dp, abs=1e-4)
+    np.testing.assert_allclose(w_cp, w_dp, atol=1e-4)

@@ -1,0 +1,120 @@
+"""Abstract (shape-only) prepare + AOT train-step lowering + HLO analysis.
+
+The compile-analysis path behind runs/hlo_report.md: a model too big to
+materialize is prepared abstractly, its REAL fused train step is lowered and
+compiled through the full XLA pipeline, and the partitioned module is
+inspected for collective structure. The reference has no analogue (torch
+exposes no pre-execution partitioned program); the closest roles are its
+memory estimator (`accelerate estimate-memory`) and dry-run launches.
+"""
+
+import importlib.util
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.models.llama import LlamaConfig, create_llama, llama_loss
+from accelerate_tpu.parallelism_config import ParallelismConfig
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_hlo_report():
+    spec = importlib.util.spec_from_file_location(
+        "hlo_report", os.path.join(_ROOT, "benchmarks", "hlo_report.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _abstract_step(tmp_dump=None):
+    acc = Accelerator(parallelism_config=ParallelismConfig(dp_shard_size=8))
+    model = create_llama(LlamaConfig.tiny(num_hidden_layers=2), abstract=True)
+    model, opt = acc.prepare(model, optax.adamw(1e-3, mu_dtype=jnp.bfloat16))
+    model.policy = None
+    step = acc.train_step(llama_loss, max_grad_norm=1.0)
+    batch = {"input_ids": jax.ShapeDtypeStruct((8, 64), jnp.int32)}
+    return acc, model, opt, step, batch
+
+
+def test_abstract_prepare_materializes_nothing():
+    acc, model, opt, step, batch = _abstract_step()
+    leaves = jax.tree_util.tree_leaves(model.params)
+    assert leaves and all(isinstance(p, jax.ShapeDtypeStruct) for p in leaves)
+    # shardings were still computed and attached
+    assert any(
+        "dp_shard" in str(p.sharding.spec) for p in leaves if p.sharding is not None
+    )
+    opt_leaves = jax.tree_util.tree_leaves(opt.opt_state)
+    assert all(isinstance(p, jax.ShapeDtypeStruct) for p in opt_leaves)
+    assert step.abstract
+
+
+def test_abstract_lower_compiles_and_partitions(tmp_path):
+    _, model, opt, step, batch = _abstract_step()
+    lowered = step.lower(batch)
+    try:
+        compiled = lowered.compile(
+            {"xla_dump_to": str(tmp_path), "xla_dump_hlo_pass_re": "spmd.*"}
+        )
+    except Exception:
+        compiled = lowered.compile()
+    # memory analysis works without any materialized array
+    mem = compiled.memory_analysis()
+    assert getattr(mem, "argument_size_in_bytes", 1) > 0
+
+    import glob
+
+    spmd = sorted(glob.glob(str(tmp_path / "*after_spmd-partitioning*")))
+    assert spmd, "SPMD pass dump missing"
+    hlo = open(spmd[-1]).read()
+    mod = _load_hlo_report()
+    collectives, notes = mod.parse_collectives(hlo, 8)
+
+    # the weight all-gathers move the COMPUTE dtype (bf16), not the f32
+    # master dtype — the gather_over_fsdp two-constraint schedule
+    weight_ags = [
+        c for c in collectives if c["op"] == "all-gather" and c["bytes"] >= 2**13
+    ]
+    assert weight_ags, f"no weight all-gathers found: {collectives}"
+    assert all(c["dtype"] == "bf16" for c in weight_ags), weight_ags
+
+    # the FSDP weight-grad reduction goes straight from partial to shard
+    # (reduce-scatter form), not full all-reduce
+    rs_like = [
+        c for c in collectives
+        if c["op"] in ("reduce-scatter", "all-reduce[rs-pattern]")
+        and c["bytes"] >= 2**13
+    ]
+    assert rs_like, f"no reduce-scatter-form grad reductions: {collectives}"
+
+
+def test_gather_over_fsdp_outside_mesh_is_identity():
+    from accelerate_tpu.parallel.sharding import gather_over_fsdp
+
+    w = jnp.ones((8, 8), jnp.bfloat16)
+    out = gather_over_fsdp(w)  # no live mesh in this test -> passthrough
+    assert out is w or np.array_equal(np.asarray(out), np.asarray(w))
+
+
+def test_concrete_lower_matches_step():
+    """step.lower works on a CONCRETE prepared model too, and the step still
+    executes (the analysis hooks must not disturb the run path)."""
+    acc = Accelerator(parallelism_config=ParallelismConfig(dp_shard_size=8))
+    model = create_llama(LlamaConfig.tiny(num_hidden_layers=2))
+    model, opt = acc.prepare(model, optax.adamw(1e-3))
+    step = acc.train_step(llama_loss)
+    assert not step.abstract
+    batch = {"input_ids": np.zeros((8, 32), np.int32)}
+    lowered = step.lower(batch)
+    assert "all-gather" in lowered.compile().as_text()
+    loss = step(batch)
+    assert np.isfinite(float(loss))
