@@ -156,11 +156,11 @@ def _gpt2_layer(
     v = _apply_dense(lp["attn"]["c_attn_v"], y, cdt, tp_dim=1).reshape(b, s, h, hd)
     if attention_fn is not None:  # mesh-aware CP/SP attention from prepare()
         if segment_ids is not None:
-            raise ValueError(
-                "segment_ids cannot compose with a mesh-injected "
-                "attention_fn (CP/SP) — see models/llama.py _attention"
-            )
-        attn = attention_fn(q, k, v, causal=True)
+            # packed batches compose with CP/SP (labels shard with the
+            # sequence — see models/llama.py _attention)
+            attn = attention_fn(q, k, v, causal=True, segment_ids=segment_ids)
+        else:
+            attn = attention_fn(q, k, v, causal=True)
     else:
         attn = dispatch_attention(
             config.attention_impl, q, k, v, causal=True, q_offset=position_offset,

@@ -397,13 +397,6 @@ def _dot(config: LlamaConfig, x, w, tp_dim=None):
 def _attention(config: LlamaConfig, q, k, v, attention_fn=None, q_offset: int = 0,
                segment_ids=None):
     if attention_fn is not None:
-        if segment_ids is not None:
-            raise ValueError(
-                "segment_ids (packed sequences) cannot compose with a "
-                "mesh-injected attention_fn (CP/SP): document boundaries "
-                "would need resharding with the sequence — unpack the batch "
-                "or drop cp/sp for packed training"
-            )
         if config.sliding_window is not None:
             raise ValueError(
                 "sliding_window cannot compose with a mesh-injected "
@@ -411,6 +404,10 @@ def _attention(config: LlamaConfig, q, k, v, attention_fn=None, q_offset: int = 
                 "results would silently differ from the model's window "
                 "semantics — drop cp/sp or set sliding_window=None"
             )
+        if segment_ids is not None:
+            # packed sequences under CP/SP: document labels shard with the
+            # sequence (ring rotates kv labels; Ulysses all-gathers them)
+            return attention_fn(q, k, v, causal=True, segment_ids=segment_ids)
         return attention_fn(q, k, v, causal=True)
     from ..ops.attention import dispatch_attention
 

@@ -181,6 +181,7 @@ def finalize_blocks(out, m, l):
 def blockwise_attention_partials(
     q, k, v, *, causal: bool = True, kv_block: int = 512, q_offset: int = 0,
     kv_offset: int = 0, segment_ids: Optional[jax.Array] = None,
+    kv_segment_ids: Optional[jax.Array] = None,
     window: Optional[int] = None,
 ):
     """Online-softmax accumulation over KV blocks, returning the UNNORMALIZED
@@ -188,7 +189,11 @@ def blockwise_attention_partials(
     of :func:`blockwise_attention` (one device) and each ring-attention step
     (ops/ring_attention.py, where ``q_offset``/``kv_offset`` are the shard's
     global positions). ``q`` must arrive PRE-SCALED by 1/sqrt(d) and kv
-    already head-repeated (see ``_attend_block``)."""
+    already head-repeated (see ``_attend_block``).
+
+    ``segment_ids`` label the q rows; ``kv_segment_ids`` (default: the same
+    array) label the kv rows — ring attention passes its ROTATING kv shard's
+    labels here while q labels stay local."""
     b, sq, h, d = q.shape
     skv = k.shape[1]
     num_blocks = (skv + kv_block - 1) // kv_block
@@ -202,7 +207,9 @@ def blockwise_attention_partials(
     if segment_ids is not None:
         # padding gets segment -1 (matches no real token; the kv_pos bias
         # already excludes it — this keeps the mask construction total)
-        segs = segment_ids.astype(jnp.int32)
+        segs = (
+            kv_segment_ids if kv_segment_ids is not None else segment_ids
+        ).astype(jnp.int32)
         if pad:
             segs = jnp.pad(segs, ((0, 0), (0, pad)), constant_values=-1)
         seg_blocks = segs.reshape(b, num_blocks, kv_block)

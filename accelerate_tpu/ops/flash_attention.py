@@ -144,6 +144,23 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, causal, block_q, block_k, scale,
         lse_ref[0, 0] = m_ref[:, 0] + jnp.log(jnp.maximum(l, 1e-30))
 
 
+def _split_segs(segs):
+    """``segs`` is one (B, 1, S) labels array for both sides or a
+    (q_segs, kv_segs) pair — ring attention labels its rotating kv shard
+    independently of the local q shard."""
+    return segs if isinstance(segs, (tuple, list)) else (segs, segs)
+
+
+def _zero_dsegs(segs):
+    """float0 cotangent(s) for the integer segment-label primal(s) — the
+    JAX convention for nondifferentiable int inputs."""
+    if segs is None:
+        return None
+    return jax.tree_util.tree_map(
+        lambda s: np.zeros(s.shape, dtype=jax.dtypes.float0), segs
+    )
+
+
 def _kv_index(b, h, h_kv):
     """Merged q index (batch·h + q_head) → merged kv index for its group."""
     n_rep = h // h_kv
@@ -178,11 +195,12 @@ def _flash_fwd(q, k, v, segs, h, h_kv, causal, block_q, block_k, interpret,
     args = [q, k, v]
     if segmented:
         # (B, 1, S) int32; same lane-major layout trick as lse below
+        qsegs, ksegs = _split_segs(segs)
         in_specs += [
             pl.BlockSpec((1, 1, block_q), lambda b, i, j: (_seg_index(b, h), 0, i)),
             pl.BlockSpec((1, 1, block_k), lambda b, i, j: (_seg_index(b, h), 0, j)),
         ]
-        args += [segs, segs]
+        args += [qsegs, ksegs]
     out, lse = pl.pallas_call(
         functools.partial(
             _fwd_kernel, causal=causal, block_q=block_q, block_k=block_k,
@@ -327,13 +345,15 @@ def _flash_bwd(q, k, v, segs, out, lse, do, h, h_kv, causal, block_q, block_k,
         pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
         pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
     ]
+    if segmented:
+        qsegs, ksegs = _split_segs(segs)
     dq_args = [q, k, v, do, lse, delta]
     if segmented:
         dq_in_specs += [
             pl.BlockSpec((1, 1, block_q), lambda b, i, j: (_seg_index(b, h), 0, i)),
             pl.BlockSpec((1, 1, block_k), lambda b, i, j: (_seg_index(b, h), 0, j)),
         ]
-        dq_args += [segs, segs]
+        dq_args += [qsegs, ksegs]
     dq = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, causal=causal, block_q=block_q, block_k=block_k,
@@ -369,7 +389,7 @@ def _flash_bwd(q, k, v, segs, out, lse, do, h, h_kv, causal, block_q, block_k,
                          lambda g, j, t: (g // h_kv, 0, t % nq)),
             pl.BlockSpec((1, 1, block_k), lambda g, j, t: (g // h_kv, 0, j)),
         ]
-        dkv_args += [segs, segs]
+        dkv_args += [qsegs, ksegs]
     dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, causal=causal, block_q=block_q, block_k=block_k,
@@ -417,41 +437,41 @@ def _flash_core_bwd(h, h_kv, causal, block_q, block_k, interpret, window,
         q, k, v, segs, out, lse, do, h, h_kv, causal, block_q, block_k,
         interpret, window
     )
-    # Integer primals take a float0 cotangent per JAX convention — an int32
-    # zeros array only works by accident under current versions.
-    dsegs = None if segs is None else np.zeros(segs.shape, dtype=jax.dtypes.float0)
-    return dq, dk, dv, dsegs
+    return dq, dk, dv, _zero_dsegs(segs)
 
 
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 
 # ------------------------------------------------- (out, lse) variant
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash_core_lse(q, k, v, h, h_kv, causal, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash_core_lse(q, k, v, segs, h, h_kv, causal, block_q, block_k,
+                    interpret):
     """Like :func:`_flash_core` but also returns the per-row logsumexp —
     the ring-attention building block (ops/ring_attention.py): per-step
     normalized outputs merge across the ring via their LSEs, and the VJP
-    accepts an ``lse`` cotangent (the merge differentiates through it)."""
-    return _flash_fwd(q, k, v, None, h, h_kv, causal, block_q, block_k,
+    accepts an ``lse`` cotangent (the merge differentiates through it).
+    ``segs`` is None or a (q_segs, kv_segs) pair of (B, 1, S*) int32."""
+    return _flash_fwd(q, k, v, segs, h, h_kv, causal, block_q, block_k,
                       interpret, None)
 
 
-def _flash_core_lse_fwd(q, k, v, h, h_kv, causal, block_q, block_k, interpret):
-    out, lse = _flash_fwd(q, k, v, None, h, h_kv, causal, block_q, block_k,
+def _flash_core_lse_fwd(q, k, v, segs, h, h_kv, causal, block_q, block_k,
+                        interpret):
+    out, lse = _flash_fwd(q, k, v, segs, h, h_kv, causal, block_q, block_k,
                           interpret, None)
-    return (out, lse), (q, k, v, out, lse)
+    return (out, lse), (q, k, v, segs, out, lse)
 
 
 def _flash_core_lse_bwd(h, h_kv, causal, block_q, block_k, interpret,
                         residuals, cotangents):
-    q, k, v, out, lse = residuals
+    q, k, v, segs, out, lse = residuals
     do, dlse = cotangents
     dq, dk, dv = _flash_bwd(
-        q, k, v, None, out, lse, do, h, h_kv, causal, block_q, block_k,
+        q, k, v, segs, out, lse, do, h, h_kv, causal, block_q, block_k,
         interpret, None, dlse=dlse,
     )
-    return dq, dk, dv
+    return dq, dk, dv, _zero_dsegs(segs)
 
 
 _flash_core_lse.defvjp(_flash_core_lse_fwd, _flash_core_lse_bwd)
@@ -463,6 +483,8 @@ def flash_attention_with_lse(
     v: jax.Array,
     *,
     causal: bool = True,
+    segment_ids: Optional[jax.Array] = None,
+    kv_segment_ids: Optional[jax.Array] = None,
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
     interpret: Optional[bool] = None,
@@ -477,7 +499,12 @@ def flash_attention_with_lse(
     shifts ``delta`` in the shared backward kernels). Unlike
     :func:`flash_attention`, q and kv sequence lengths may differ —
     ``causal`` anchors both at position 0, so ring callers pass
-    ``causal=True`` only on the diagonal step."""
+    ``causal=True`` only on the diagonal step.
+
+    ``segment_ids`` (B, Sq) / ``kv_segment_ids`` (B, Skv) mask
+    cross-document attention for packed sequences; the two label arrays
+    are independent because a ring step's kv shard rotates while q stays
+    local. Passing only ``segment_ids`` labels both sides with it."""
     b, sq, hh, d = q.shape
     h_kv = k.shape[2]
     skv = k.shape[1]
@@ -492,8 +519,15 @@ def flash_attention_with_lse(
         n = x.shape[2]
         return x.transpose(0, 2, 1, 3).reshape(b * n, x.shape[1], d)
 
+    segs = None
+    if segment_ids is not None:
+        ks = kv_segment_ids if kv_segment_ids is not None else segment_ids
+        segs = (
+            segment_ids.astype(jnp.int32)[:, None, :],
+            ks.astype(jnp.int32)[:, None, :],
+        )
     out, lse = _flash_core_lse(
-        merge(q), merge(k), merge(v), hh, h_kv, causal, block_q, block_k,
+        merge(q), merge(k), merge(v), segs, hh, h_kv, causal, block_q, block_k,
         interpret,
     )
     out = out.reshape(b, hh, sq, d).transpose(0, 2, 1, 3)

@@ -53,7 +53,7 @@ def _ring_bias(sq_local: int, skv_local: int, q_start, kv_start, causal: bool):
 
 
 def _attend_shard(q, k_shard, v_shard, q_start, kv_start, causal,
-                  kv_block=None):
+                  kv_block=None, q_segs=None, kv_segs=None):
     """One ring step's attention of the local (pre-scaled) q against a
     whole kv shard, returning online-softmax partials (out, m, l).
 
@@ -62,30 +62,43 @@ def _attend_shard(q, k_shard, v_shard, q_start, kv_start, causal,
     makes long-context shards viable. The chunked path IS
     :func:`~accelerate_tpu.ops.attention.blockwise_attention_partials`
     (same pad/scan/checkpoint machinery, incl. its TPU-miscompile
-    workaround), with this shard's global offsets."""
+    workaround), with this shard's global offsets.
+
+    ``q_segs`` (b, sq) / ``kv_segs`` (b, skv): packed-document labels —
+    independent arrays because the kv shard rotates around the ring while
+    q stays local."""
     sq = q.shape[1]
     skv = k_shard.shape[1]
     if kv_block is None or kv_block >= skv:
         bias = _ring_bias(sq, skv, q_start, kv_start, causal)
+        if q_segs is not None:
+            same = (q_segs[:, :, None] == kv_segs[:, None, :])[:, None]
+            seg_bias = jnp.where(same, 0.0, NEG_INF)
+            bias = seg_bias if bias is None else bias + seg_bias
         return _attend_block(q, k_shard, v_shard, bias)
     return blockwise_attention_partials(
         q, k_shard, v_shard, causal=causal, kv_block=kv_block,
         q_offset=q_start, kv_offset=kv_start,
+        segment_ids=q_segs, kv_segment_ids=kv_segs,
     )
 
 
-def _flash_partials(q, k, v, causal, block_q, block_k):
+def _flash_partials(q, k, v, causal, block_q, block_k, q_segs=None,
+                    kv_segs=None):
     """One ring step through the Pallas flash kernel: the normalized
     (out, lse) pair re-enters the online-softmax merge as ``(out, m=lse,
     l=1)`` — algebraically the LSE merge rule. The kernel's custom VJP
     accepts the lse cotangent the merge produces (flash_attention.py
     ``_flash_core_lse``), so the whole ring differentiates through it.
     GQA stays native (kv never repeated) and the kernel applies 1/sqrt(d)
-    itself — callers pass RAW q and native kv heads."""
+    itself — callers pass RAW q and native kv heads. A fully seg-masked
+    step yields lse ~ NEG_INF, which the merge zeroes exactly (finite
+    NEG_INF underflows the rescale)."""
     from .flash_attention import flash_attention_with_lse
 
     out, lse = flash_attention_with_lse(
-        q, k, v, causal=causal, block_q=block_q, block_k=block_k
+        q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+        segment_ids=q_segs, kv_segment_ids=kv_segs,
     )
     return out, lse, jnp.ones_like(lse)
 
@@ -94,6 +107,7 @@ def ring_attention_local(
     q: jax.Array,
     k: jax.Array,
     v: jax.Array,
+    segment_ids: Optional[jax.Array] = None,
     *,
     axis_name: str = "cp",
     causal: bool = True,
@@ -111,7 +125,11 @@ def ring_attention_local(
     and every later step's kv shard is either wholly past (full attention)
     or wholly future (skipped via ``lax.cond``). The ``allgather`` rotation
     keeps the blockwise path — its single local attention spans shards with
-    a true offset, which the kernel's 0-anchored mask cannot express."""
+    a true offset, which the kernel's 0-anchored mask cannot express.
+
+    ``segment_ids`` (B, S/n): the LOCAL shard of packed-document labels;
+    the kv-side labels ride the ring with their kv shards (one extra tiny
+    int32 ppermute per hop)."""
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     b, sq, h, d = q.shape
@@ -122,11 +140,20 @@ def ring_attention_local(
         v = repeat_kv(v, n_rep)
         q = q * (1.0 / math.sqrt(d))  # kernel-less paths pre-scale
     q_start = idx * sq
+    q_segs = segment_ids
 
     if rotate_method == "allgather":
         k_all = lax.all_gather(k, axis_name, axis=1, tiled=True)
         v_all = lax.all_gather(v, axis_name, axis=1, tiled=True)
-        out, m, l = _attend_shard(q, k_all, v_all, q_start, 0, causal, kv_block)
+        segs_all = (
+            lax.all_gather(segment_ids, axis_name, axis=1, tiled=True)
+            if segment_ids is not None
+            else None
+        )
+        out, m, l = _attend_shard(
+            q, k_all, v_all, q_start, 0, causal, kv_block,
+            q_segs=q_segs, kv_segs=segs_all,
+        )
         return finalize_blocks(out, m, l)
 
     # true ring: rotate KV shards n times; shard s lives on rank
@@ -141,15 +168,18 @@ def ring_attention_local(
 
     # unrolled python loop: n is static; final rotation skipped so the ring
     # does exactly n-1 hops
+    kseg_cur = segment_ids
     carry = (out, m, l, k, v)
     for step in range(n):
         out, m, l, k_cur, v_cur = carry
         kv_rank = (idx - step) % n
         if use_flash:
-            def attend(operand, diag=(step == 0), kc=k_cur, vc=v_cur):
+            def attend(operand, diag=(step == 0), kc=k_cur, vc=v_cur,
+                       ks=kseg_cur):
                 out, m, l = operand
                 o2, m2, l2 = _flash_partials(
-                    q, kc, vc, causal and diag, block_q, block_k
+                    q, kc, vc, causal and diag, block_q, block_k,
+                    q_segs=q_segs, kv_segs=ks,
                 )
                 return combine_blocks(out, m, l, o2, m2, l2)
 
@@ -162,12 +192,15 @@ def ring_attention_local(
                 )
         else:
             o2, m2, l2 = _attend_shard(
-                q, k_cur, v_cur, q_start, kv_rank * sq, causal, kv_block
+                q, k_cur, v_cur, q_start, kv_rank * sq, causal, kv_block,
+                q_segs=q_segs, kv_segs=kseg_cur,
             )
             out, m, l = combine_blocks(out, m, l, o2, m2, l2)
         if step < n - 1:
             k_cur = lax.ppermute(k_cur, axis_name, perm)
             v_cur = lax.ppermute(v_cur, axis_name, perm)
+            if kseg_cur is not None:
+                kseg_cur = lax.ppermute(kseg_cur, axis_name, perm)
         carry = (out, m, l, k_cur, v_cur)
     out, m, l, _, _ = carry
     return finalize_blocks(out, m, l)
@@ -196,6 +229,7 @@ def zigzag_ring_attention_local(
     q: jax.Array,
     k: jax.Array,
     v: jax.Array,
+    segment_ids: Optional[jax.Array] = None,
     *,
     axis_name: str = "cp",
     causal: bool = True,
@@ -232,6 +266,11 @@ def zigzag_ring_attention_local(
         return rank, 2 * n - 1 - rank  # chunk ids held by `rank`
 
     q_chunks = (q[:, :c], q[:, c:])
+    qseg_chunks = (
+        (segment_ids[:, :c], segment_ids[:, c:])
+        if segment_ids is not None
+        else (None, None)
+    )
     perm = [(i, (i + 1) % n) for i in range(n)]
     block_k = kv_block or 512
 
@@ -246,6 +285,7 @@ def zigzag_ring_attention_local(
         )
 
     k_cur, v_cur = k, v
+    kseg_cur = segment_ids
     for step in range(n):
         kv_rank = (idx - step) % n
         kv_chunk_ids = my_chunks(kv_rank)
@@ -257,6 +297,11 @@ def zigzag_ring_attention_local(
             for ki in range(2):
                 k_blk = (k_cur[:, :c], k_cur[:, c:])[ki]
                 v_blk = (v_cur[:, :c], v_cur[:, c:])[ki]
+                kseg_blk = (
+                    (kseg_cur[:, :c], kseg_cur[:, c:])[ki]
+                    if kseg_cur is not None
+                    else None
+                )
                 kv_start = kv_chunk_ids[ki] * c
                 # chunk relation: equal ids happen ONLY at step 0 (then for
                 # both local pairs), so the diagonal case is static
@@ -264,18 +309,21 @@ def zigzag_ring_attention_local(
 
                 if use_flash:
                     def attend(operand, diag=diagonal, kb=k_blk, vb=v_blk,
-                               qb=q_blk):
+                               qb=q_blk, qsg=qseg_chunks[qi], ksg=kseg_blk):
                         out, m, l = operand
                         o2, m2, l2 = _flash_partials(
-                            qb, kb, vb, causal and diag, block_q, block_k
+                            qb, kb, vb, causal and diag, block_q, block_k,
+                            q_segs=qsg, kv_segs=ksg,
                         )
                         return combine_blocks(out, m, l, o2, m2, l2)
                 else:
                     def attend(operand, qb=q_blk, kb=k_blk, vb=v_blk,
-                               qs=q_start, ks=kv_start):
+                               qs=q_start, ks=kv_start,
+                               qsg=qseg_chunks[qi], ksg=kseg_blk):
                         out, m, l = operand
                         o2, m2, l2 = _attend_shard(
-                            qb, kb, vb, qs, ks, causal, kv_block
+                            qb, kb, vb, qs, ks, causal, kv_block,
+                            q_segs=qsg, kv_segs=ksg,
                         )
                         return combine_blocks(out, m, l, o2, m2, l2)
 
@@ -299,6 +347,8 @@ def zigzag_ring_attention_local(
         if step < n - 1:
             k_cur = lax.ppermute(k_cur, axis_name, perm)
             v_cur = lax.ppermute(v_cur, axis_name, perm)
+            if kseg_cur is not None:
+                kseg_cur = lax.ppermute(kseg_cur, axis_name, perm)
 
     finals = [finalize_blocks(*outs[qi]) for qi in range(2)]
     return jnp.concatenate(finals, axis=1)
@@ -328,7 +378,11 @@ def make_ring_attention(
     spec = P(batch, cp_axis, heads, None)
     n = mesh.shape[cp_axis]
 
-    def attention_fn(q, k, v, causal: bool = True):
+    seg_spec = P(batch, cp_axis)
+
+    def attention_fn(q, k, v, causal: bool = True, segment_ids=None):
+        if segment_ids is not None:
+            segment_ids = segment_ids.astype(jnp.int32)
         if rotate_method == "zigzag":
             seq_len = q.shape[1]
             perm, inv = _zigzag_perm(seq_len, n)
@@ -342,14 +396,19 @@ def make_ring_attention(
                 kv_block=kv_block, attention_impl=attention_impl,
                 block_q=block_q,
             )
+            in_specs = (spec, spec, spec)
+            args = (qz, kz, vz)
+            if segment_ids is not None:
+                in_specs += (seg_spec,)
+                args += (jnp.take(segment_ids, perm_j, axis=1),)
             fn = jax.shard_map(
                 body,
                 mesh=mesh,
-                in_specs=(spec, spec, spec),
+                in_specs=in_specs,
                 out_specs=spec,
                 check_vma=False,
             )
-            out = fn(qz, kz, vz)
+            out = fn(*args)
             return jnp.take(out, inv_j, axis=1)
         body = functools.partial(
             ring_attention_local,
@@ -360,13 +419,18 @@ def make_ring_attention(
             attention_impl=attention_impl,
             block_q=block_q,
         )
+        in_specs = (spec, spec, spec)
+        args = (q, k, v)
+        if segment_ids is not None:
+            in_specs += (seg_spec,)
+            args += (segment_ids,)
         fn = jax.shard_map(
             body,
             mesh=mesh,
-            in_specs=(spec, spec, spec),
+            in_specs=in_specs,
             out_specs=spec,
             check_vma=False,
         )
-        return fn(q, k, v)
+        return fn(*args)
 
     return attention_fn

@@ -30,13 +30,16 @@ def ulysses_attention_local(
     q: jax.Array,
     k: jax.Array,
     v: jax.Array,
+    segment_ids: Optional[jax.Array] = None,
     *,
     axis_name: str = "sp",
     causal: bool = True,
     inner: Optional[Callable] = None,
 ) -> jax.Array:
     """Call INSIDE shard_map. Local shapes (B, S/n, H, D); requires H (and KV
-    heads) divisible by the sp axis size."""
+    heads) divisible by the sp axis size. ``segment_ids`` (B, S/n) — packed
+    document labels, all-gathered to the full sequence alongside the head
+    scatter (attention runs over ALL positions locally)."""
     inner = inner or functools.partial(blockwise_attention, kv_block=512)
     n = lax.axis_size(axis_name)
     if q.shape[2] % n != 0:
@@ -71,12 +74,20 @@ def ulysses_attention_local(
         # (B, S, H/n, D) → (B, S/n, H, D)
         return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
+    seg_kw = {}
+    if segment_ids is not None:
+        segs_full = (
+            lax.all_gather(segment_ids, axis_name, axis=1, tiled=True)
+            if n > 1
+            else segment_ids
+        )
+        seg_kw = {"segment_ids": segs_full}
     if n == 1:
-        return inner(q, k, v, causal=causal)
+        return inner(q, k, v, causal=causal, **seg_kw)
     q_full = scatter_heads(q)
     k_full = scatter_heads(k)
     v_full = scatter_heads(v)
-    out = inner(q_full, k_full, v_full, causal=causal)
+    out = inner(q_full, k_full, v_full, causal=causal, **seg_kw)
     return gather_seq(out)
 
 
@@ -94,17 +105,22 @@ def make_ulysses_attention(
     heads = tuple(a for a in head_axes if mesh.shape.get(a, 1) > 1) or None
     spec = P(batch, sp_axis, heads, None)
 
-    def attention_fn(q, k, v, causal: bool = True):
+    def attention_fn(q, k, v, causal: bool = True, segment_ids=None):
         body = functools.partial(
             ulysses_attention_local, axis_name=sp_axis, causal=causal, inner=inner
         )
+        in_specs = (spec, spec, spec)
+        args = (q, k, v)
+        if segment_ids is not None:
+            in_specs += (P(batch, sp_axis),)
+            args += (segment_ids,)
         fn = jax.shard_map(
             body,
             mesh=mesh,
-            in_specs=(spec, spec, spec),
+            in_specs=in_specs,
             out_specs=spec,
             check_vma=False,
         )
-        return fn(q, k, v)
+        return fn(*args)
 
     return attention_fn

@@ -372,3 +372,128 @@ def test_llama_cp_flash_training_matches_dp():
     w_cp, loss_cp = run(ParallelismConfig(dp_shard_size=2, cp_size=4), "flash")
     assert loss_cp == pytest.approx(loss_dp, abs=1e-4)
     np.testing.assert_allclose(w_cp, w_dp, atol=1e-4)
+
+
+# ------------------------------------------------- packed (segment) CP/SP
+def _segs_qkv(b=2, s=64, h=4, d=16, seed=3):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.normal(size=(b, s, h, d)), dtype=jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b, s, h, d)), dtype=jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, s, h, d)), dtype=jnp.float32)
+    # ragged documents per row, boundaries not aligned to shards
+    segs = np.zeros((b, s), np.int32)
+    for row in range(b):
+        bounds = sorted(rng.choice(np.arange(4, s - 4), size=3, replace=False))
+        seg = 1
+        prev = 0
+        for bnd in list(bounds) + [s]:
+            segs[row, prev:bnd] = seg
+            seg += 1
+            prev = bnd
+    return q, k, v, jnp.asarray(segs)
+
+
+@pytest.mark.parametrize("impl", ["blockwise", "flash"])
+@pytest.mark.parametrize("rotate_method", ["alltoall", "zigzag", "allgather"])
+def test_ring_segments_match_reference(rotate_method, impl):
+    """Packed-document masking under ring attention: kv labels rotate with
+    their shards; both engines match the dense segment-masked reference
+    (VERDICT r3 next-round #3)."""
+    if impl == "flash" and rotate_method == "allgather":
+        pytest.skip("allgather rotation keeps the blockwise path")
+    cfg = ParallelismConfig(cp_size=4, dp_shard_size=2)
+    mesh = cfg.build_device_mesh()
+    q, k, v, segs = _segs_qkv()
+    ref = dot_product_attention(q, k, v, causal=True, segment_ids=segs)
+    ring = make_ring_attention(
+        mesh, rotate_method=rotate_method, attention_impl=impl,
+        kv_block=16, block_q=16,
+    )
+    out = jax.jit(
+        lambda q, k, v, s: ring(q, k, v, causal=True, segment_ids=s)
+    )(q, k, v, segs)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), atol=2e-5)
+
+
+def test_ring_segments_grads_match_reference():
+    cfg = ParallelismConfig(cp_size=4, dp_shard_size=2)
+    mesh = cfg.build_device_mesh()
+    q, k, v, segs = _segs_qkv()
+    ring = make_ring_attention(
+        mesh, attention_impl="flash", kv_block=16, block_q=16
+    )
+
+    def loss(q, k, v):
+        return jnp.sum(ring(q, k, v, causal=True, segment_ids=segs) ** 2)
+
+    ref_grads = jax.grad(
+        lambda q, k, v: jnp.sum(
+            dot_product_attention(q, k, v, causal=True, segment_ids=segs) ** 2
+        ),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    grads = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+    for g, r in zip(grads, ref_grads):
+        assert np.all(np.isfinite(np.asarray(g)))
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r), atol=1e-4)
+
+
+def test_ulysses_segments_match_reference():
+    cfg = ParallelismConfig(sp_size=4, dp_shard_size=2)
+    mesh = cfg.build_device_mesh()
+    q, k, v, segs = _segs_qkv()
+    ref = dot_product_attention(q, k, v, causal=True, segment_ids=segs)
+    ulysses = make_ulysses_attention(mesh)
+    out = jax.jit(
+        lambda q, k, v, s: ulysses(q, k, v, causal=True, segment_ids=s)
+    )(q, k, v, segs)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), atol=1e-5)
+
+
+@pytest.mark.parametrize("pcfg_kw", [
+    dict(dp_shard_size=2, cp_size=4),
+    dict(dp_shard_size=2, sp_size=4),
+])
+def test_packed_loss_matches_padded_under_cp_sp(pcfg_kw):
+    """The VERDICT done-criterion: packed loss == padded loss with the mesh
+    attention injected (cp_size=4 / sp_size=4 on the virtual mesh)."""
+    from accelerate_tpu.models.llama import LlamaConfig, create_llama, llama_loss
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+    from accelerate_tpu.utils import native
+
+    rng = np.random.default_rng(0)
+    cfg = LlamaConfig.tiny(compute_dtype=jnp.float32)
+    docs = [rng.integers(4, cfg.vocab_size, size=n).astype(np.int32)
+            for n in (7, 5, 9, 4, 6)]
+    seq_len = 16
+    tokens, segments = native.pack_dataset(docs, seq_len=seq_len, pad_id=0)
+    packed_batch = {
+        "input_ids": tokens,
+        "segment_ids": segments,
+        "position_ids": native.packed_position_ids(segments),
+        "loss_mask": native.packed_loss_mask(segments),
+    }
+    padded_tokens, padded_mask = native.collate_padded(docs, seq_len=seq_len)
+    padded_segs = (padded_mask > 0).astype(np.int32)
+    padded_batch = {
+        "input_ids": padded_tokens,
+        "loss_mask": native.packed_loss_mask(padded_segs),
+    }
+
+    # reference: single-mesh-free padded loss
+    model0 = create_llama(cfg, seed=0)
+    padded_loss = float(llama_loss(
+        lambda ids, **kw: model0.apply_fn(model0.params, ids, **kw),
+        padded_batch,
+    ))
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+    acc = Accelerator(parallelism_config=ParallelismConfig(**pcfg_kw))
+    model = create_llama(cfg, seed=0)
+    model = acc.prepare(model)
+    loss = float(jax.jit(
+        lambda p, b: llama_loss(model.bind(p), b)
+    )(model.params, packed_batch))
+    np.testing.assert_allclose(loss, padded_loss, rtol=2e-5)

@@ -311,3 +311,49 @@ def test_legacy_fused_c_attn_checkpoint_loads():
     # Current-layout trees pass through unchanged.
     same = upgrade_legacy_state(params)
     assert same["layers"]["attn"].keys() == params["layers"]["attn"].keys()
+
+
+def test_gpt2_packed_segments_match_padded_under_cp():
+    """gpt2 packed batches compose with CP: the mesh-injected ring attention
+    receives the segment labels (learned positions restart per document via
+    packed_position_ids), and packed loss == padded loss like the
+    mesh-free test above."""
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.parallelism_config import ParallelismConfig
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+    from accelerate_tpu.utils import native
+
+    rng = np.random.default_rng(0)
+    config = GPT2Config.tiny(compute_dtype=jnp.float32)
+    docs = [rng.integers(4, config.vocab_size, size=n).astype(np.int32)
+            for n in (7, 5, 9, 4, 6)]
+    seq_len = 16
+    tokens, segments = native.pack_dataset(docs, seq_len=seq_len, pad_id=0)
+    packed_batch = {
+        "input_ids": tokens,
+        "segment_ids": segments,
+        "position_ids": native.packed_position_ids(segments),
+        "loss_mask": native.packed_loss_mask(segments),
+    }
+    padded_tokens, padded_mask = native.collate_padded(docs, seq_len=seq_len)
+    padded_segs = (padded_mask > 0).astype(np.int32)
+
+    model0 = create_gpt2(config, seed=0)
+    padded_loss = float(gpt2_loss(
+        lambda ids, **kw: model0.apply_fn(model0.params, ids, **kw),
+        {"input_ids": padded_tokens,
+         "loss_mask": native.packed_loss_mask(padded_segs)},
+    ))
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+    acc = Accelerator(
+        parallelism_config=ParallelismConfig(dp_shard_size=2, cp_size=4)
+    )
+    model = create_gpt2(config, seed=0)
+    model = acc.prepare(model)
+    loss = float(jax.jit(
+        lambda p, b: gpt2_loss(model.bind(p), b)
+    )(model.params, packed_batch))
+    np.testing.assert_allclose(loss, padded_loss, rtol=2e-5)
