@@ -1,11 +1,12 @@
-"""In-process and debug launchers.
+"""In-process and multi-process launchers for notebooks and debugging.
 
 TPU-native analogue of the reference's ``launchers.py`` (notebook_launcher:43,
-debug_launcher:287). The reference forks one process per device; JAX drives
-all local devices from one process, so ``notebook_launcher`` simply runs the
-function (multi-host notebooks attach via coordinator env). ``debug_launcher``
-spawns REAL multi-process CPU JAX clusters (jax.distributed over localhost) —
-stronger than the reference's gloo FileStore fork: actual SPMD semantics.
+debug_launcher:287). One JAX process already drives every local TPU chip, so
+``notebook_launcher`` runs the function in-process by default; with
+``num_processes > 1`` it forks REAL workers joined into a ``jax.distributed``
+CPU cluster over localhost — actual multi-process SPMD semantics from a
+single notebook cell (the reference forks torch processes with an elastic
+rendezvous; same role). ``debug_launcher`` is the test-harness variant.
 """
 
 from __future__ import annotations
@@ -13,38 +14,26 @@ from __future__ import annotations
 import multiprocessing
 import os
 import socket
+import sys
 import traceback
-from typing import Callable, Tuple
+from typing import Callable, Optional, Tuple
 
 __all__ = ["notebook_launcher", "debug_launcher"]
 
+from .logging import get_logger
 
-def notebook_launcher(
-    function: Callable,
-    args: Tuple = (),
-    num_processes: int = None,
-    mixed_precision: str = "no",
-    use_port: str = "29500",
-    **kwargs,
-) -> None:
-    """Run a training function from a notebook (reference launchers.py:43-286).
+logger = get_logger(__name__)
 
-    One JAX process already addresses every local TPU chip, so no fork is
-    needed; ``num_processes`` is accepted for API parity and validated against
-    the visible device count."""
-    import jax
 
-    if num_processes is not None and num_processes > 1 and jax.process_count() == 1:
-        n_local = len(jax.local_devices())
-        if num_processes > n_local:
-            raise ValueError(
-                f"num_processes={num_processes} but this host sees {n_local} devices "
-                "and no multi-host coordinator is configured "
-                "(set ACCELERATE_COORDINATOR_ADDRESS/NUM_PROCESSES/PROCESS_ID)."
-            )
-    if mixed_precision != "no":
-        os.environ.setdefault("ACCELERATE_MIXED_PRECISION", mixed_precision)
-    function(*args)
+def _tpu_configured() -> bool:
+    """Whether this environment targets TPU hardware — decided WITHOUT
+    initializing jax (probing a dead relay hangs)."""
+    platforms = os.environ.get("JAX_PLATFORMS", "")
+    return (
+        any(p in platforms for p in ("tpu", "axon"))
+        or "PALLAS_AXON_POOL_IPS" in os.environ
+        or "TPU_NAME" in os.environ
+    )
 
 
 def _free_port() -> int:
@@ -53,12 +42,15 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _debug_worker(rank, num_processes, port, function, args, queue, local_devices=1):
+def _cluster_worker(rank, num_processes, port, function, args, queue,
+                    local_devices=1, extra_env=None):
     try:
         os.environ["JAX_PLATFORMS"] = "cpu"
         os.environ["ACCELERATE_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
         os.environ["ACCELERATE_NUM_PROCESSES"] = str(num_processes)
         os.environ["ACCELERATE_PROCESS_ID"] = str(rank)
+        for key, value in (extra_env or {}).items():
+            os.environ[key] = value
         import jax
 
         # the env var alone is NOT enough: a sitecustomize-registered TPU
@@ -67,7 +59,7 @@ def _debug_worker(rank, num_processes, port, function, args, queue, local_device
         jax.config.update("jax_platforms", "cpu")
         # deterministic cluster size regardless of the parent's XLA_FLAGS
         # (pytest forces an 8-device host; workers are 1 device each unless
-        # the test asks otherwise)
+        # the caller asks otherwise)
         jax.config.update("jax_num_cpu_devices", local_devices)
 
         jax.distributed.initialize(
@@ -81,14 +73,19 @@ def _debug_worker(rank, num_processes, port, function, args, queue, local_device
         queue.put((rank, traceback.format_exc()))
 
 
-def debug_launcher(function: Callable, args: Tuple = (), num_processes: int = 2, local_devices: int = 1) -> None:
-    """Run ``function`` under a real ``num_processes``-process CPU JAX cluster
-    (reference launchers.py:287 uses gloo FileStore; this is true SPMD)."""
+def _spawn_cluster(function, args, num_processes, local_devices, port,
+                   extra_env=None, timeout: Optional[float] = None):
+    """Fork ``num_processes`` fresh interpreters, join them into one
+    ``jax.distributed`` CPU cluster, run ``function(*args)`` on every rank,
+    and surface any worker traceback in the parent."""
     ctx = multiprocessing.get_context("spawn")
-    port = _free_port()
     queue = ctx.Queue()
     procs = [
-        ctx.Process(target=_debug_worker, args=(r, num_processes, port, function, args, queue, local_devices))
+        ctx.Process(
+            target=_cluster_worker,
+            args=(r, num_processes, port, function, args, queue,
+                  local_devices, extra_env),
+        )
         for r in range(num_processes)
     ]
     # children inherit the parent env at spawn: drop the TPU-relay trigger so
@@ -100,15 +97,127 @@ def debug_launcher(function: Callable, args: Tuple = (), num_processes: int = 2,
     finally:
         if relay is not None:
             os.environ["PALLAS_AXON_POOL_IPS"] = relay
-    timeout = float(os.environ.get("ACCELERATE_DEBUG_LAUNCHER_TIMEOUT", 600))
+    timeout = timeout or float(
+        os.environ.get("ACCELERATE_DEBUG_LAUNCHER_TIMEOUT", 600)
+    )
     errors = []
-    for _ in procs:
-        rank, err = queue.get(timeout=timeout)
-        if err is not None:
-            errors.append(f"--- rank {rank} ---\n{err}")
-    for p in procs:
-        p.join(timeout=60)
-        if p.is_alive():
-            p.terminate()
+    try:
+        for _ in procs:
+            try:
+                rank, err = queue.get(timeout=timeout)
+            except Exception:
+                # a worker died without reporting (OOM kill, segfault in
+                # native code): name the casualties instead of a bare
+                # queue.Empty, and let finally reap the survivors (blocked
+                # in a collective waiting for the dead rank)
+                dead = [
+                    f"rank {r} exitcode={p.exitcode}"
+                    for r, p in enumerate(procs)
+                    if not p.is_alive() and p.exitcode not in (0, None)
+                ]
+                raise RuntimeError(
+                    "launcher worker died without reporting "
+                    f"({', '.join(dead) or 'no exit codes yet'}); "
+                    f"no result within {timeout:.0f}s"
+                ) from None
+            if err is not None:
+                errors.append(f"--- rank {rank} ---\n{err}")
+    finally:
+        for p in procs:
+            p.join(timeout=60)
+            if p.is_alive():
+                p.terminate()
     if errors:
-        raise RuntimeError("debug_launcher worker failure:\n" + "\n".join(errors))
+        raise RuntimeError("launcher worker failure:\n" + "\n".join(errors))
+
+
+def notebook_launcher(
+    function: Callable,
+    args: Tuple = (),
+    num_processes: int = None,
+    mixed_precision: str = "no",
+    use_port: Optional[str] = None,
+    local_devices: int = 1,
+    **kwargs,
+) -> None:
+    """Run a training function from a notebook (reference launchers.py:43-286).
+
+    ``num_processes`` None/0/1 runs in-process: one JAX process already
+    addresses every local TPU chip (multi-host notebooks attach via the
+    coordinator env protocol). ``num_processes > 1`` forks that many REAL
+    worker processes joined into a ``jax.distributed`` CPU cluster over
+    localhost — each worker sees ``local_devices`` CPU devices, so a
+    notebook cell gets genuine multi-process semantics (collectives, process
+    indices, per-rank env) like the reference's fork path. ``use_port`` pins
+    the coordinator port (default: a free one)."""
+    fork = num_processes is not None and num_processes > 1
+    if fork and _tpu_configured():
+        # On a TPU host ONE process drives every chip: num_processes is
+        # satisfied by SPMD, and forking would silently retarget training
+        # onto CPU workers (JAX_PLATFORMS=cpu is forced in the worker).
+        # This branch also keeps forked children away from the TPU-relay
+        # sitecustomize hang the worker comment below warns about.
+        logger.warning(
+            "notebook_launcher: TPU environment detected — running "
+            "in-process (one JAX process drives all local chips; "
+            "num_processes=%s is provided by SPMD). Set JAX_PLATFORMS=cpu "
+            "to fork a real CPU jax.distributed cluster instead.",
+            num_processes,
+        )
+        import jax
+
+        if jax.process_count() == 1:
+            n_local = len(jax.local_devices())
+            if num_processes > n_local:
+                raise ValueError(
+                    f"num_processes={num_processes} but this host sees "
+                    f"{n_local} devices and no multi-host coordinator is "
+                    "configured (set ACCELERATE_COORDINATOR_ADDRESS/"
+                    "NUM_PROCESSES/PROCESS_ID)."
+                )
+        fork = False
+    if fork:
+        # The reference refuses to fork once the accelerator is initialized
+        # in the notebook kernel (its CUDA-already-initialized check,
+        # launchers.py:160-175); same here: a parent holding a non-CPU JAX
+        # backend cannot hand devices to forked workers.
+        jax_mod = sys.modules.get("jax")
+        if jax_mod is not None:
+            try:
+                backends = jax_mod._src.xla_bridge._backends  # noqa: SLF001
+            except AttributeError:
+                # private attr moved in a jax upgrade: make the drift
+                # visible rather than silently skipping the guard (the
+                # TPU-env check above still shields the dangerous case)
+                logger.warning(
+                    "notebook_launcher: cannot inspect jax backend state "
+                    "(jax._src.xla_bridge._backends missing) — skipping the "
+                    "already-initialized-accelerator check."
+                )
+                backends = {}
+            if any(name not in ("cpu", "interpreter") for name in backends):
+                raise RuntimeError(
+                    "notebook_launcher(num_processes>1) must be called before "
+                    "JAX initializes an accelerator backend in this kernel — "
+                    "restart the notebook kernel and launch first (the "
+                    "forked workers run a CPU jax.distributed cluster)."
+                )
+        extra_env = {}
+        if mixed_precision != "no":
+            extra_env["ACCELERATE_MIXED_PRECISION"] = mixed_precision
+        port = int(use_port) if use_port else _free_port()
+        _spawn_cluster(
+            function, args, num_processes, local_devices, port,
+            extra_env=extra_env,
+        )
+        return
+
+    if mixed_precision != "no":
+        os.environ.setdefault("ACCELERATE_MIXED_PRECISION", mixed_precision)
+    function(*args)
+
+
+def debug_launcher(function: Callable, args: Tuple = (), num_processes: int = 2, local_devices: int = 1) -> None:
+    """Run ``function`` under a real ``num_processes``-process CPU JAX cluster
+    (reference launchers.py:287 uses gloo FileStore; this is true SPMD)."""
+    _spawn_cluster(function, args, num_processes, local_devices, _free_port())

@@ -337,3 +337,71 @@ def test_trackio_tracker_contract(tmp_path, monkeypatch):
     assert ("config", {"lr": 0.1}) in calls
     assert ("log", {"loss": 1.5}) in calls
     assert ("finish",) in calls
+
+
+# ----------------------------------------------- REAL backend executions
+# tensorboard + tensorboardX ARE in this image: these tests run the real
+# SDKs end to end and read the event files BACK, asserting logged values —
+# the reference's tracking test depth (reference tests/test_tracking.py
+# TensorBoardTrackingTest) rather than a file-exists smoke.
+def _read_scalars(run_dir):
+    from tensorboard.backend.event_processing.event_accumulator import (
+        EventAccumulator,
+    )
+
+    acc = EventAccumulator(str(run_dir))
+    acc.Reload()
+    return {
+        tag: [(e.step, e.value) for e in acc.Scalars(tag)]
+        for tag in acc.Tags()["scalars"]
+    }
+
+
+def test_tensorboard_scalar_roundtrip(tmp_path):
+    acc = _fresh(tmp_path, log_with="tensorboard")
+    acc.init_trackers("tbrun", config={"lr": 0.1, "layers": 2})
+    acc.log({"loss": 0.5, "acc": 0.25}, step=0)
+    acc.log({"loss": 0.125}, step=7)
+    acc.end_training()
+
+    scalars = _read_scalars(tmp_path / "tbrun")
+    assert ("loss" in scalars) or ("loss/loss" in scalars), scalars
+    loss_tag = "loss" if "loss" in scalars else "loss/loss"
+    steps_vals = dict(scalars[loss_tag])
+    assert steps_vals[0] == pytest.approx(0.5)
+    assert steps_vals[7] == pytest.approx(0.125)
+
+
+def test_tensorboardx_fallback_real(tmp_path, monkeypatch):
+    """Force the tensorboardX import fallback and run the REAL tensorboardX
+    SummaryWriter — the second installed backend executed for real."""
+    import builtins
+
+    real_import = builtins.__import__
+
+    def no_torch_tb(name, *args, **kwargs):
+        if name == "torch.utils" or name.startswith("torch.utils.tensorboard"):
+            raise ImportError("forced for test")
+        return real_import(name, *args, **kwargs)
+
+    monkeypatch.setattr(builtins, "__import__", no_torch_tb)
+    from accelerate_tpu.tracking import TensorBoardTracker
+
+    tracker = TensorBoardTracker("tbxrun", logging_dir=str(tmp_path))
+    import tensorboardX
+
+    assert tracker._writer_cls is tensorboardX.SummaryWriter
+    monkeypatch.setattr(builtins, "__import__", real_import)
+
+    tracker.start()
+    tracker.store_init_configuration({"lr": 0.01, "note": "x"})
+    tracker.log({"loss": 1.5}, step=1)
+    tracker.log({"loss": 0.75}, step=2)
+    tracker.finish()
+
+    scalars = _read_scalars(tmp_path / "tbxrun")
+    loss_tags = [t for t in scalars if "loss" in t]
+    assert loss_tags, scalars
+    steps_vals = dict(scalars[loss_tags[0]])
+    assert steps_vals[1] == pytest.approx(1.5)
+    assert steps_vals[2] == pytest.approx(0.75)
