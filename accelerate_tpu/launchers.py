@@ -107,18 +107,22 @@ def _spawn_cluster(function, args, num_processes, local_devices, port,
                 rank, err = queue.get(timeout=timeout)
             except Exception:
                 # a worker died without reporting (OOM kill, segfault in
-                # native code): name the casualties instead of a bare
-                # queue.Empty, and let finally reap the survivors (blocked
-                # in a collective waiting for the dead rank)
+                # native code, sys.exit inside the function): name the
+                # casualties instead of a bare queue.Empty, carry any
+                # tracebacks ALREADY collected (often the root cause the
+                # survivors are deadlocked on), and let finally reap the
+                # survivors blocked in a collective waiting for the dead rank
                 dead = [
                     f"rank {r} exitcode={p.exitcode}"
                     for r, p in enumerate(procs)
-                    if not p.is_alive() and p.exitcode not in (0, None)
+                    if p.exitcode is not None
                 ]
+                detail = "\n".join(errors)
                 raise RuntimeError(
                     "launcher worker died without reporting "
-                    f"({', '.join(dead) or 'no exit codes yet'}); "
+                    f"({', '.join(dead) or 'all workers still alive'}); "
                     f"no result within {timeout:.0f}s"
+                    + (f"\nreported failures so far:\n{detail}" if detail else "")
                 ) from None
             if err is not None:
                 errors.append(f"--- rank {rank} ---\n{err}")

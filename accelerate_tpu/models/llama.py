@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import math
 from typing import Any, Callable, Optional
 
 import numpy as np
@@ -71,6 +72,16 @@ class LlamaConfig:
     hidden_act: str = "silu"  # "silu" | "gelu_tanh"
     rms_norm_offset: bool = False
     scale_embeddings: bool = False
+    # Gemma-2 knobs: tanh softcapping of attention scores / final logits,
+    # sandwich (pre+post) block norms, local/global attention alternating
+    # every other layer (even layers use sliding_window, odd layers full
+    # causal — HF layer_types convention), and a decoupled attention scale
+    # (1/sqrt(query_pre_attn_scalar) instead of 1/sqrt(head_dim))
+    attn_logit_softcap: Optional[float] = None
+    final_logit_softcap: Optional[float] = None
+    post_block_norms: bool = False
+    alternating_sliding_window: bool = False
+    query_pre_attn_scalar: Optional[float] = None
     tie_word_embeddings: bool = False
     param_dtype: Any = jnp.float32
     compute_dtype: Any = jnp.bfloat16
@@ -114,6 +125,18 @@ class LlamaConfig:
                 f"FFNs); got {self.hidden_act!r} with num_experts="
                 f"{self.num_experts}"
             )
+        if self.alternating_sliding_window:
+            if self.sliding_window is None:
+                raise ValueError(
+                    "alternating_sliding_window=True needs sliding_window set "
+                    "(the even layers' local window size)"
+                )
+            if self.num_hidden_layers % 2 != 0:
+                raise ValueError(
+                    "alternating_sliding_window needs an even layer count "
+                    "(layers scan as local/global pairs); got "
+                    f"{self.num_hidden_layers}"
+                )
 
     def _rope_scaling_key(self):
         """Hashable form for the host-side rope-table cache."""
@@ -191,6 +214,23 @@ class LlamaConfig:
             head_dim=256, max_position_embeddings=8192, rms_norm_eps=1e-6,
             hidden_act="gelu_tanh", rms_norm_offset=True,
             scale_embeddings=True, tie_word_embeddings=True,
+        ), **overrides})
+
+    @classmethod
+    def gemma2_9b(cls, **overrides) -> "LlamaConfig":
+        """Gemma-2-9B shape (HF google/gemma-2-9b): everything Gemma-1 has
+        plus attention/final logit softcapping (50/30), sandwich norms
+        around both blocks, 4096-token sliding window on every other layer,
+        and attention scaled by 1/sqrt(query_pre_attn_scalar=256)."""
+        return cls(**{**dict(
+            vocab_size=256000, hidden_size=3584, intermediate_size=14336,
+            num_hidden_layers=42, num_attention_heads=16, num_key_value_heads=8,
+            head_dim=256, max_position_embeddings=8192, rms_norm_eps=1e-6,
+            hidden_act="gelu_tanh", rms_norm_offset=True,
+            scale_embeddings=True, tie_word_embeddings=True,
+            sliding_window=4096, alternating_sliding_window=True,
+            attn_logit_softcap=50.0, final_logit_softcap=30.0,
+            post_block_norms=True, query_pre_attn_scalar=256.0,
         ), **overrides})
 
     @classmethod
@@ -279,12 +319,25 @@ def init_llama_params(config: LlamaConfig, key: jax.Array) -> dict:
         },
         "final_norm": {"scale": norm_init((d,))},
     }
+    if config.post_block_norms:
+        # Gemma-2 sandwich norms: block OUTPUTS are normalized before the
+        # residual add (attn_out_norm / mlp_out_norm), in addition to the
+        # pre-norms (input_norm / post_attn_norm = HF's
+        # pre_feedforward_layernorm in this layout)
+        params["layers"]["attn_out_norm"] = {"scale": norm_init((L, d))}
+        params["layers"]["mlp_out_norm"] = {"scale": norm_init((L, d))}
     if not config.tie_word_embeddings:
         params["lm_head"] = {"kernel": _init_dense(keys[0], d, v, dt)}
     return params
 
 
 # ------------------------------------------------------------------ forward
+def _tanh_softcap(x, cap):
+    from ..ops.attention import tanh_softcap
+
+    return tanh_softcap(x, cap)
+
+
 def _mlp_act(config, gate):
     """SwiGLU's silu or Gemma's GeGLU tanh-gelu on the gate projection."""
     if config.hidden_act == "gelu_tanh":
@@ -395,14 +448,22 @@ def _dot(config: LlamaConfig, x, w, tp_dim=None):
 
 
 def _attention(config: LlamaConfig, q, k, v, attention_fn=None, q_offset: int = 0,
-               segment_ids=None):
+               segment_ids=None, window="config"):
+    if window == "config":
+        window = config.sliding_window
     if attention_fn is not None:
-        if config.sliding_window is not None:
+        if window is not None:
             raise ValueError(
                 "sliding_window cannot compose with a mesh-injected "
                 "attention_fn (CP/SP ring/Ulysses attend full-causal): "
                 "results would silently differ from the model's window "
                 "semantics — drop cp/sp or set sliding_window=None"
+            )
+        if config.attn_logit_softcap is not None:
+            raise ValueError(
+                "attn_logit_softcap cannot compose with a mesh-injected "
+                "attention_fn (CP/SP) yet — the ring/Ulysses paths run "
+                "un-capped scores; drop cp/sp or disable softcapping"
             )
         if segment_ids is not None:
             # packed sequences under CP/SP: document labels shard with the
@@ -414,7 +475,8 @@ def _attention(config: LlamaConfig, q, k, v, attention_fn=None, q_offset: int = 
     return dispatch_attention(
         config.attention_impl, q, k, v, causal=True, q_offset=q_offset,
         kv_block=config.attention_kv_block, block_q=config.attention_block_q,
-        segment_ids=segment_ids, window=config.sliding_window,
+        segment_ids=segment_ids, window=window,
+        softcap=config.attn_logit_softcap,
     )
 
 
@@ -427,9 +489,12 @@ def _layer(
     collect_kv: bool = False,
     segment_ids=None,
     position_ids=None,
+    window="config",
 ):
     """One transformer block on (B, S, D) activations. ``collect_kv=True``
-    additionally returns the (post-RoPE) k/v for prefill cache building."""
+    additionally returns the (post-RoPE) k/v for prefill cache building.
+    ``window`` overrides ``config.sliding_window`` for this layer (Gemma-2
+    alternates local/global layers)."""
     h, kvh, hd = config.num_attention_heads, config.num_key_value_heads, config.head_dim
     b, s, d = x.shape
     cdt = config.compute_dtype
@@ -451,12 +516,22 @@ def _layer(
     q = apply_rope(q, position_offset, config.rope_theta, position_ids, _sc)
     k = apply_rope(k, position_offset, config.rope_theta, position_ids, _sc)
     kv_out = (k, v) if collect_kv else None
+    if config.query_pre_attn_scalar is not None:
+        # every attention impl scales by 1/sqrt(head_dim); pre-multiplying q
+        # by sqrt(hd / qpas) makes the effective scale 1/sqrt(qpas) without
+        # plumbing a scale through the kernels (Gemma-2)
+        q = q * jnp.asarray(
+            math.sqrt(hd / config.query_pre_attn_scalar), dtype=q.dtype
+        )
     attn = _attention(
         config, q, k, v, attention_fn, q_offset=position_offset,
-        segment_ids=segment_ids,
+        segment_ids=segment_ids, window=window,
     )
     attn = _dot(config, attn.reshape(b, s, h * hd),
                 layer_params["attn"]["o_proj"]["kernel"].astype(cdt), tp_dim=0)
+    if config.post_block_norms:  # Gemma-2 sandwich: normalize the block OUT
+        attn = rms_norm(attn, layer_params["attn_out_norm"]["scale"],
+                        config.rms_norm_eps, config.rms_norm_offset)
     attn = checkpoint_name(attn, "attn_block_out")
     x = constrain_activation(residual + attn)
 
@@ -483,11 +558,42 @@ def _layer(
         y = constrain_activation(_mlp_act(config, gate) * up, "intermediate")
         y = _dot(config, y, layer_params["mlp"]["down_proj"]["kernel"].astype(cdt), tp_dim=0)
         aux = jnp.float32(0.0)
+    if config.post_block_norms:
+        y = rms_norm(y, layer_params["mlp_out_norm"]["scale"],
+                     config.rms_norm_eps, config.rms_norm_offset)
     y = checkpoint_name(y, "mlp_block_out")
     out = constrain_activation(residual + y)
     if collect_kv:
         return out, aux, kv_out
     return out, aux
+
+
+def _alternating_fns(config: LlamaConfig, layer_kw: dict, remat: bool = True):
+    """(local_fn, global_fn) layer variants for Gemma-2's local/global
+    alternation — built ONCE so both windows stay static in their compiled
+    bodies (the flash kernel's window tile-pruning needs a static window)."""
+    local_fn = functools.partial(
+        _layer, config, window=config.sliding_window, **layer_kw
+    )
+    global_fn = functools.partial(_layer, config, window=None, **layer_kw)
+    if remat and config.remat_policy != "full":
+        policy = _remat_policy(config.remat_policy)
+        local_fn = jax.checkpoint(local_fn, policy=policy)
+        global_fn = jax.checkpoint(global_fn, policy=policy)
+    return local_fn, global_fn
+
+
+def _pair_layers(params_layers):
+    """Stacked (L, ...) leaves → (L/2, 2, ...) for the pair scan."""
+    return jax.tree_util.tree_map(
+        lambda p: p.reshape(p.shape[0] // 2, 2, *p.shape[1:]), params_layers
+    )
+
+
+def _pair_slices(pair_params):
+    lp0 = jax.tree_util.tree_map(lambda p: p[0], pair_params)
+    lp1 = jax.tree_util.tree_map(lambda p: p[1], pair_params)
+    return lp0, lp1
 
 
 def llama_apply(
@@ -525,18 +631,38 @@ def llama_apply(
         x = x * jnp.asarray(config.hidden_size**0.5, dtype=cdt)
     x = constrain_activation(x)
 
-    layer_fn = functools.partial(
-        _layer, config, position_offset=position_offset,
-        attention_fn=attention_fn, segment_ids=segment_ids,
-        position_ids=position_ids,
+    layer_kw = dict(
+        position_offset=position_offset, attention_fn=attention_fn,
+        segment_ids=segment_ids, position_ids=position_ids,
     )
+    layer_fn = functools.partial(_layer, config, **layer_kw)
     policy = _remat_policy(config.remat_policy)
     if config.remat_policy != "full":
         layer_fn = jax.checkpoint(layer_fn, policy=policy)
 
+    alternating = config.alternating_sliding_window
     if layer_stack_fn is not None:
+        if alternating:
+            raise ValueError(
+                "alternating_sliding_window (Gemma-2) cannot compose with a "
+                "pipelined layer stack yet — the pp stage scan assumes a "
+                "uniform layer body; run without pp"
+            )
         x, aux_raw = layer_stack_fn(params["layers"], x, layer_fn)
         aux_total = aux_raw  # per-layer auxes are pre-scaled (moe_ffn)
+    elif alternating and config.scan_layers:
+        # local/global layers alternate: scan over layer PAIRS (see
+        # _alternating_fns for why both windows must stay static)
+        local_fn, global_fn = _alternating_fns(config, layer_kw)
+
+        def pair_body(x, pair_params):
+            lp0, lp1 = _pair_slices(pair_params)
+            x, aux0 = local_fn(lp0, x)
+            x, aux1 = global_fn(lp1, x)
+            return x, aux0 + aux1
+
+        x, aux_per_pair = lax.scan(pair_body, x, _pair_layers(params["layers"]))
+        aux_total = jnp.sum(aux_per_pair)
     elif config.scan_layers:
         def scan_body(x, layer_params):
             x, aux = layer_fn(layer_params, x)
@@ -547,9 +673,15 @@ def llama_apply(
     else:
         L = config.num_hidden_layers
         aux_total = jnp.float32(0.0)
+        if alternating:
+            local_fn, global_fn = _alternating_fns(config, layer_kw)
         for li in range(L):
             lp = jax.tree_util.tree_map(lambda p: p[li], params["layers"])
-            x, aux = layer_fn(lp, x)
+            if alternating:
+                fn = local_fn if li % 2 == 0 else global_fn
+                x, aux = fn(lp, x)
+            else:
+                x, aux = layer_fn(lp, x)
             aux_total = aux_total + aux
         # aux_total already pre-scaled per layer
 
@@ -570,6 +702,7 @@ def llama_apply(
     # use-time all-gather of the fsdp-sharded head; keeps logits (and their
     # cotangents) on the batch/seq layout — see replicate_over_fsdp
     logits = (x @ replicate_over_fsdp(head.astype(cdt))).astype(jnp.float32)
+    logits = _tanh_softcap(logits, config.final_logit_softcap)  # Gemma-2
     logits = constrain_activation(logits, "vocab")
     if return_aux:
         return logits, {"aux_loss": aux_total}
@@ -617,6 +750,7 @@ def _ce_from_hidden(config, x, head, labels, mask, *, reduction="mean",
             x, head.astype(x.dtype), jnp.maximum(labels, 0),
             chunk_size=ce_chunk_size or config.ce_chunk_size,
             loss_mask=_mask_of(labels, mask), reduction=reduction,
+            logit_softcap=config.final_logit_softcap,
         )
     # all-gather the fsdp-sharded head for the logits matmul (the standard
     # FSDP use-time gather). Without this the partitioner keeps logits
@@ -626,6 +760,7 @@ def _ce_from_hidden(config, x, head, labels, mask, *, reduction="mean",
     # With a replicated head, d_head is a local partial + psum — clean.
     head = replicate_over_fsdp(head.astype(config.compute_dtype))
     logits = (x @ head).astype(jnp.float32)
+    logits = _tanh_softcap(logits, config.final_logit_softcap)  # Gemma-2
     logits = constrain_activation(logits, "vocab")
     return _dense_ce_from_logits(logits, labels, mask, reduction=reduction)
 
@@ -810,12 +945,26 @@ def convert_hf_state_dict(config: LlamaConfig, flat: dict) -> dict:
             "attn": {},
             "mlp": {},
             "input_norm": {"scale": stacked("input_layernorm.weight", transpose=False)},
-            "post_attn_norm": {
-                "scale": stacked("post_attention_layernorm.weight", transpose=False)
-            },
         },
         "final_norm": {"scale": jnp.asarray(get("model.norm.weight"), dtype=config.param_dtype)},
     }
+    if config.post_block_norms:
+        # Gemma-2 sandwich norms: HF's post_attention_layernorm normalizes
+        # the attention OUTPUT (our attn_out_norm) and pre_feedforward_
+        # layernorm is the pre-MLP norm (our post_attn_norm slot)
+        params["layers"]["attn_out_norm"] = {
+            "scale": stacked("post_attention_layernorm.weight", transpose=False)
+        }
+        params["layers"]["post_attn_norm"] = {
+            "scale": stacked("pre_feedforward_layernorm.weight", transpose=False)
+        }
+        params["layers"]["mlp_out_norm"] = {
+            "scale": stacked("post_feedforward_layernorm.weight", transpose=False)
+        }
+    else:
+        params["layers"]["post_attn_norm"] = {
+            "scale": stacked("post_attention_layernorm.weight", transpose=False)
+        }
     if config.num_experts > 1:
         # HF Mixtral layout: block_sparse_moe.gate (router, torch (E, D)) and
         # experts.{e}.{w1,w3,w2} (gate/up/down, torch (out, in)); ours stacks
@@ -921,9 +1070,20 @@ def export_hf_state_dict(config: LlamaConfig, params: dict) -> dict:
         out[f"model.layers.{i}.input_layernorm.weight"] = np.asarray(
             params["layers"]["input_norm"]["scale"]
         )[i]
-        out[f"model.layers.{i}.post_attention_layernorm.weight"] = np.asarray(
-            params["layers"]["post_attn_norm"]["scale"]
-        )[i]
+        if config.post_block_norms:  # Gemma-2 four-norm mapping (see import)
+            out[f"model.layers.{i}.post_attention_layernorm.weight"] = np.asarray(
+                params["layers"]["attn_out_norm"]["scale"]
+            )[i]
+            out[f"model.layers.{i}.pre_feedforward_layernorm.weight"] = np.asarray(
+                params["layers"]["post_attn_norm"]["scale"]
+            )[i]
+            out[f"model.layers.{i}.post_feedforward_layernorm.weight"] = np.asarray(
+                params["layers"]["mlp_out_norm"]["scale"]
+            )[i]
+        else:
+            out[f"model.layers.{i}.post_attention_layernorm.weight"] = np.asarray(
+                params["layers"]["post_attn_norm"]["scale"]
+            )[i]
     if "lm_head" in params:
         out["lm_head.weight"] = np.asarray(params["lm_head"]["kernel"]).T
     return out
@@ -953,8 +1113,12 @@ def init_kv_cache(config: LlamaConfig, batch_size: int, max_len: int, dtype=None
     return {"k": jnp.zeros(shape, dtype=dtype), "v": jnp.zeros(shape, dtype=dtype)}
 
 
-def _decode_layer(config: LlamaConfig, layer_params, x, cache_k, cache_v, pos):
-    """One block, one new position; returns updated (cache_k, cache_v)."""
+def _decode_layer(config: LlamaConfig, layer_params, x, cache_k, cache_v, pos,
+                  sliding=None):
+    """One block, one new position; returns updated (cache_k, cache_v).
+    ``sliding``: None = uniform config.sliding_window behavior; a traced
+    bool applies the window only when true (Gemma-2 alternating layers —
+    the flag rides the decode scan as a per-layer xs array)."""
     h, kvh, hd = config.num_attention_heads, config.num_key_value_heads, config.head_dim
     b, s, d = x.shape  # s == 1
     cdt = config.compute_dtype
@@ -978,16 +1142,24 @@ def _decode_layer(config: LlamaConfig, layer_params, x, cache_k, cache_v, pos):
     # attend over positions 0..pos (mask the tail)
     kk = repeat_kv_cache(cache_k, h // kvh)
     vv = repeat_kv_cache(cache_v, h // kvh)
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q * (1.0 / np.sqrt(hd)), kk.astype(cdt)).astype(
+    attn_scale = 1.0 / np.sqrt(config.query_pre_attn_scalar or hd)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q * attn_scale, kk.astype(cdt)).astype(
         jnp.float32
     )
+    scores = _tanh_softcap(scores, config.attn_logit_softcap)  # pre-mask
     k_pos = lax.broadcasted_iota(jnp.int32, scores.shape, 3)
     scores = jnp.where(k_pos <= pos, scores, -1e6)
     if config.sliding_window is not None:
-        scores = jnp.where(pos - k_pos < config.sliding_window, scores, -1e6)
+        in_window = pos - k_pos < config.sliding_window
+        if sliding is not None:  # per-layer alternating flag (traced)
+            in_window = jnp.logical_or(jnp.logical_not(sliding), in_window)
+        scores = jnp.where(in_window, scores, -1e6)
     weights = jax.nn.softmax(scores, axis=-1)
     attn = jnp.einsum("bhqk,bkhd->bqhd", weights.astype(cdt), vv.astype(cdt))
     attn = attn.reshape(b, s, h * hd) @ layer_params["attn"]["o_proj"]["kernel"].astype(cdt)
+    if config.post_block_norms:
+        attn = rms_norm(attn, layer_params["attn_out_norm"]["scale"],
+                        config.rms_norm_eps, config.rms_norm_offset)
     x = residual + attn
 
     residual = x
@@ -1010,6 +1182,9 @@ def _decode_layer(config: LlamaConfig, layer_params, x, cache_k, cache_v, pos):
         up = y @ layer_params["mlp"]["up_proj"]["kernel"].astype(cdt)
         y = _mlp_act(config, gate) * up
         y = y @ layer_params["mlp"]["down_proj"]["kernel"].astype(cdt)
+    if config.post_block_norms:
+        y = rms_norm(y, layer_params["mlp_out_norm"]["scale"],
+                     config.rms_norm_eps, config.rms_norm_offset)
     return residual + y, cache_k, cache_v
 
 
@@ -1042,18 +1217,34 @@ def llama_prefill(config: LlamaConfig, params, input_ids, max_len: int):
     x = params["embed_tokens"]["embedding"].astype(cdt)[input_ids]
     if config.scale_embeddings:
         x = x * jnp.asarray(config.hidden_size**0.5, dtype=cdt)
-    layer_fn = functools.partial(_layer, config, position_offset=0, attention_fn=None, collect_kv=True)
+    prefill_kw = dict(position_offset=0, attention_fn=None, collect_kv=True)
+    layer_fn = functools.partial(_layer, config, **prefill_kw)
 
-    def body(x, layer_params):
-        x, _aux, (k, v) = layer_fn(layer_params, x)
-        return x, (k, v)
+    if config.alternating_sliding_window:
+        local_fn, global_fn = _alternating_fns(config, prefill_kw, remat=False)
 
-    x, (ks, vs) = lax.scan(body, x, params["layers"])  # ks: (L, B, S, kvh, hd)
+        def pair_body(x, pair_params):
+            lp0, lp1 = _pair_slices(pair_params)
+            x, _a0, (k0, v0) = local_fn(lp0, x)
+            x, _a1, (k1, v1) = global_fn(lp1, x)
+            return x, (jnp.stack([k0, k1]), jnp.stack([v0, v1]))
+
+        # (L/2, 2, B, S, kvh, hd) -> (L, B, S, kvh, hd)
+        x, (ks, vs) = lax.scan(pair_body, x, _pair_layers(params["layers"]))
+        ks = ks.reshape(-1, *ks.shape[2:])
+        vs = vs.reshape(-1, *vs.shape[2:])
+    else:
+        def body(x, layer_params):
+            x, _aux, (k, v) = layer_fn(layer_params, x)
+            return x, (k, v)
+
+        x, (ks, vs) = lax.scan(body, x, params["layers"])  # ks: (L, B, S, kvh, hd)
     x = rms_norm(x, params["final_norm"]["scale"], config.rms_norm_eps, config.rms_norm_offset)
     if config.tie_word_embeddings:
         logits = x @ params["embed_tokens"]["embedding"].astype(cdt).T
     else:
         logits = x @ params["lm_head"]["kernel"].astype(cdt)
+    logits = _tanh_softcap(logits, config.final_logit_softcap)
     pad = max_len - s
     cache = {
         "k": jnp.pad(ks, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0))),
@@ -1070,18 +1261,35 @@ def llama_decode_step(config: LlamaConfig, params, cache, token, pos):
     if config.scale_embeddings:
         x = x * jnp.asarray(config.hidden_size**0.5, dtype=cdt)
 
-    def body(carry, inputs):
-        x = carry
-        layer_params, ck, cv = inputs
-        x, ck, cv = _decode_layer(config, layer_params, x, ck, cv, pos)
-        return x, (ck, cv)
+    if config.alternating_sliding_window:
+        L = config.num_hidden_layers
+        flags = (jnp.arange(L) % 2) == 0  # even layers local (HF layer_types)
 
-    x, (new_k, new_v) = lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
+        def body(carry, inputs):
+            x = carry
+            layer_params, ck, cv, sliding = inputs
+            x, ck, cv = _decode_layer(
+                config, layer_params, x, ck, cv, pos, sliding=sliding
+            )
+            return x, (ck, cv)
+
+        x, (new_k, new_v) = lax.scan(
+            body, x, (params["layers"], cache["k"], cache["v"], flags)
+        )
+    else:
+        def body(carry, inputs):
+            x = carry
+            layer_params, ck, cv = inputs
+            x, ck, cv = _decode_layer(config, layer_params, x, ck, cv, pos)
+            return x, (ck, cv)
+
+        x, (new_k, new_v) = lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
     x = rms_norm(x, params["final_norm"]["scale"], config.rms_norm_eps, config.rms_norm_offset)
     if config.tie_word_embeddings:
         logits = x @ params["embed_tokens"]["embedding"].astype(cdt).T
     else:
         logits = x @ params["lm_head"]["kernel"].astype(cdt)
+    logits = _tanh_softcap(logits, config.final_logit_softcap)
     return logits[:, 0].astype(jnp.float32), {"k": new_k, "v": new_v}
 
 

@@ -25,7 +25,17 @@ __all__ = [
     "blockwise_attention",
     "dispatch_attention",
     "repeat_kv",
+    "tanh_softcap",
 ]
+
+
+def tanh_softcap(x, cap):
+    """Gemma-2 logit capping: ``cap * tanh(x / cap)``, identity when ``cap``
+    is None — the ONE definition every scores/logits site shares (the Pallas
+    kernel bodies inline it: they also need the tanh for the backward)."""
+    if cap is None:
+        return x
+    return cap * jnp.tanh(x / cap)
 
 
 def repeat_kv(k: jax.Array, n_rep: int) -> jax.Array:
@@ -65,16 +75,19 @@ def dot_product_attention(
     softmax_dtype=jnp.float32,
     segment_ids: Optional[jax.Array] = None,
     window: Optional[int] = None,
+    softcap: Optional[float] = None,
 ) -> jax.Array:
     """Reference attention, fully materialized scores. XLA fuses this well for
     moderate sequence lengths; use the Pallas flash kernel (ops/flash_attention)
-    for long sequences on TPU."""
+    for long sequences on TPU. ``softcap``: Gemma-2 tanh score capping
+    (softcap * tanh(scores / softcap)), applied before any masking."""
     b, sq, h, d = q.shape
     n_rep = h // k.shape[2]
     k = repeat_kv(k, n_rep)
     v = repeat_kv(v, n_rep)
     scale = 1.0 / math.sqrt(d)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(softmax_dtype) * scale
+    scores = tanh_softcap(scores, softcap)
     if causal:
         mask = _causal_mask_bias(sq, k.shape[1], q_offset=q_offset - kv_offset, dtype=softmax_dtype)
         scores = scores + mask[None, None, :, :]
@@ -108,6 +121,7 @@ def dispatch_attention(
     block_q: int = 2048,
     segment_ids: Optional[jax.Array] = None,
     window: Optional[int] = None,
+    softcap: Optional[float] = None,
 ):
     """Select the attention implementation by name — the shared entry every
     causal-LM family (llama, gpt2, ...) routes through. ``impl``: "flash" |
@@ -124,20 +138,20 @@ def dispatch_attention(
 
         return flash_attention(
             q, k, v, causal=True, segment_ids=segment_ids, window=window,
-            block_q=block_q, block_k=kv_block,
+            softcap=softcap, block_q=block_q, block_k=kv_block,
         )
     if impl in ("blockwise", "flash"):
         return blockwise_attention(
             q, k, v, causal=causal, kv_block=kv_block, q_offset=q_offset,
-            segment_ids=segment_ids, window=window,
+            segment_ids=segment_ids, window=window, softcap=softcap,
         )
     return dot_product_attention(
         q, k, v, causal=causal, q_offset=q_offset, segment_ids=segment_ids,
-        window=window,
+        window=window, softcap=softcap,
     )
 
 
-def _attend_block(q, k, v, bias):
+def _attend_block(q, k, v, bias, softcap=None):
     """One block's contribution with running log-sum-exp stats.
 
     ``q`` must arrive PRE-SCALED by 1/sqrt(d) — scaling must happen outside
@@ -150,6 +164,7 @@ def _attend_block(q, k, v, bias):
     stay finite: a fully-masked block yields m=NEG_INF whose contribution is
     rescaled to exactly 0 when merged with any real block."""
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    scores = tanh_softcap(scores, softcap)
     if bias is not None:
         scores = scores + bias
     m = jnp.max(scores, axis=-1)  # (b,h,q), >= NEG_INF (finite)
@@ -183,6 +198,7 @@ def blockwise_attention_partials(
     kv_offset: int = 0, segment_ids: Optional[jax.Array] = None,
     kv_segment_ids: Optional[jax.Array] = None,
     window: Optional[int] = None,
+    softcap: Optional[float] = None,
 ):
     """Online-softmax accumulation over KV blocks, returning the UNNORMALIZED
     partials (out, m, l) for combination with other shards — the shared core
@@ -235,7 +251,7 @@ def blockwise_attention_partials(
         if seg_blk is not None:
             same = segment_ids[:, :, None] == seg_blk[:, None, :]  # (b, sq, bk)
             bias = jnp.where(same[:, None], bias, NEG_INF)
-        o_b, m_b, l_b = _attend_block(q, k_blk, v_blk, bias)
+        o_b, m_b, l_b = _attend_block(q, k_blk, v_blk, bias, softcap=softcap)
         return combine_blocks(out, m, l, o_b, m_b, l_b), None
 
     init = (
@@ -262,6 +278,7 @@ def blockwise_attention_partials(
 def blockwise_attention(
     q, k, v, *, causal: bool = True, kv_block: int = 512, q_offset: int = 0,
     segment_ids: Optional[jax.Array] = None, window: Optional[int] = None,
+    softcap: Optional[float] = None,
 ) -> jax.Array:
     """Memory-efficient attention: iterate KV blocks with online softmax —
     the same math the ring-attention CP path runs across chips
@@ -273,6 +290,6 @@ def blockwise_attention(
     q = q * (1.0 / math.sqrt(d))  # pre-scale (see _attend_block)
     out, m, l = blockwise_attention_partials(
         q, k, v, causal=causal, kv_block=kv_block, q_offset=q_offset,
-        segment_ids=segment_ids, window=window,
+        segment_ids=segment_ids, window=window, softcap=softcap,
     )
     return finalize_blocks(out, m, l)

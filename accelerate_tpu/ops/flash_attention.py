@@ -96,7 +96,7 @@ def _block_visible(i, j, causal, block_q, block_k, window):
 
 # ---------------------------------------------------------------- forward
 def _fwd_kernel(q_ref, k_ref, v_ref, *rest, causal, block_q, block_k, scale,
-                segmented, window):
+                segmented, window, softcap=None):
     if segmented:
         qseg_ref, kseg_ref, out_ref, lse_ref, acc_ref, m_ref, l_ref = rest
     else:
@@ -123,6 +123,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, causal, block_q, block_k, scale,
         v = v_ref[0]
 
         s = _dot_f32(q, k, transpose_b=True) * scale  # (bq, bk), f32 acc
+        if softcap is not None:  # Gemma-2 tanh capping, pre-mask
+            s = softcap * jnp.tanh(s / softcap)
         q_seg = qseg_ref[0, 0] if segmented else None
         k_seg = kseg_ref[0, 0] if segmented else None
         s = _mask_scores(s, i, j, q_seg, k_seg, causal, block_q, block_k, window)
@@ -175,7 +177,7 @@ def _seg_index(b, h):
 
 
 def _flash_fwd(q, k, v, segs, h, h_kv, causal, block_q, block_k, interpret,
-               window=None):
+               window=None, softcap=None):
     from jax.experimental.pallas import tpu as pltpu
 
     bh, s, d = q.shape
@@ -204,7 +206,7 @@ def _flash_fwd(q, k, v, segs, h, h_kv, causal, block_q, block_k, interpret,
     out, lse = pl.pallas_call(
         functools.partial(
             _fwd_kernel, causal=causal, block_q=block_q, block_k=block_k,
-            scale=scale, segmented=segmented, window=window,
+            scale=scale, segmented=segmented, window=window, softcap=softcap,
         ),
         grid=grid,
         in_specs=in_specs,
@@ -232,7 +234,8 @@ def _flash_fwd(q, k, v, segs, h, h_kv, causal, block_q, block_k, interpret,
 
 # ---------------------------------------------------------------- backward
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
-                   causal, block_q, block_k, scale, segmented, window):
+                   causal, block_q, block_k, scale, segmented, window,
+                   softcap=None):
     if segmented:
         qseg_ref, kseg_ref, dq_ref, dq_acc = rest
     else:
@@ -257,12 +260,17 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
         delta = delta_ref[0, 0]
 
         s = _dot_f32(q, k, transpose_b=True) * scale
+        if softcap is not None:
+            t = jnp.tanh(s / softcap)
+            s = softcap * t
         q_seg = qseg_ref[0, 0] if segmented else None
         k_seg = kseg_ref[0, 0] if segmented else None
         s = _mask_scores(s, i, j, q_seg, k_seg, causal, block_q, block_k, window)
         p = jnp.exp(s - lse[:, None])
         dp = _dot_f32(do, v, transpose_b=True)
         ds = p * (dp - delta[:, None])
+        if softcap is not None:  # d/ds_raw of softcap*tanh(s_raw/softcap)
+            ds = ds * (1.0 - t * t)
         dq_acc[:] = dq_acc[:] + _dot_f32(ds.astype(k.dtype), k) * scale
 
     @pl.when(j == nk - 1)
@@ -271,7 +279,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
-                    causal, block_q, block_k, scale, segmented, nq, window):
+                    causal, block_q, block_k, scale, segmented, nq, window,
+                    softcap=None):
     """Grid (B·H_kv, nk, nq·n_rep): the innermost dim walks every (q block,
     q head-in-group) pair while the dk/dv output block stays put, so a kv
     head's gradient accumulates across its whole GQA group in VMEM."""
@@ -301,6 +310,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
         delta = delta_ref[0, 0]
 
         s = _dot_f32(q, k, transpose_b=True) * scale  # (bq, bk)
+        if softcap is not None:
+            t = jnp.tanh(s / softcap)
+            s = softcap * t
         q_seg = qseg_ref[0, 0] if segmented else None
         k_seg = kseg_ref[0, 0] if segmented else None
         s = _mask_scores(s, i, j, q_seg, k_seg, causal, block_q, block_k, window)
@@ -309,6 +321,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
         dv_acc[:] = dv_acc[:] + _dot_f32(p_lo.T, do)
         dp = _dot_f32(do, v, transpose_b=True)
         ds = p * (dp - delta[:, None])
+        if softcap is not None:
+            ds = ds * (1.0 - t * t)
         dk_acc[:] = dk_acc[:] + _dot_f32(ds.astype(q.dtype).T, q) * scale
 
     @pl.when(t == nt - 1)
@@ -318,7 +332,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
 
 
 def _flash_bwd(q, k, v, segs, out, lse, do, h, h_kv, causal, block_q, block_k,
-               interpret, window=None, dlse=None):
+               interpret, window=None, dlse=None, softcap=None):
     from jax.experimental.pallas import tpu as pltpu
 
     bh, s, d = q.shape
@@ -357,7 +371,7 @@ def _flash_bwd(q, k, v, segs, out, lse, do, h, h_kv, causal, block_q, block_k,
     dq = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, causal=causal, block_q=block_q, block_k=block_k,
-            scale=scale, segmented=segmented, window=window,
+            scale=scale, segmented=segmented, window=window, softcap=softcap,
         ),
         grid=(bh, nq, nk),
         in_specs=dq_in_specs,
@@ -394,6 +408,7 @@ def _flash_bwd(q, k, v, segs, out, lse, do, h, h_kv, causal, block_q, block_k,
         functools.partial(
             _bwd_dkv_kernel, causal=causal, block_q=block_q, block_k=block_k,
             scale=scale, segmented=segmented, nq=nq, window=window,
+            softcap=softcap,
         ),
         grid=(bh_kv, nk, nq * n_rep),
         in_specs=dkv_in_specs,
@@ -415,27 +430,27 @@ def _flash_bwd(q, k, v, segs, out, lse, do, h, h_kv, causal, block_q, block_k,
 
 
 # ---------------------------------------------------------------- public op
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10, 11))
 def _flash_core(q, k, v, segs, h, h_kv, causal, block_q, block_k, interpret,
-                window):
+                window, softcap):
     out, _ = _flash_fwd(q, k, v, segs, h, h_kv, causal, block_q, block_k,
-                        interpret, window)
+                        interpret, window, softcap)
     return out
 
 
 def _flash_core_fwd(q, k, v, segs, h, h_kv, causal, block_q, block_k, interpret,
-                    window):
+                    window, softcap):
     out, lse = _flash_fwd(q, k, v, segs, h, h_kv, causal, block_q, block_k,
-                          interpret, window)
+                          interpret, window, softcap)
     return out, (q, k, v, segs, out, lse)
 
 
 def _flash_core_bwd(h, h_kv, causal, block_q, block_k, interpret, window,
-                    residuals, do):
+                    softcap, residuals, do):
     q, k, v, segs, out, lse = residuals
     dq, dk, dv = _flash_bwd(
         q, k, v, segs, out, lse, do, h, h_kv, causal, block_q, block_k,
-        interpret, window
+        interpret, window, softcap=softcap,
     )
     return dq, dk, dv, _zero_dsegs(segs)
 
@@ -542,6 +557,7 @@ def flash_attention(
     causal: bool = True,
     segment_ids: Optional[jax.Array] = None,
     window: Optional[int] = None,
+    softcap: Optional[float] = None,
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
     interpret: Optional[bool] = None,
@@ -576,6 +592,6 @@ def flash_attention(
         segs = segment_ids.astype(jnp.int32)[:, None, :]
     out = _flash_core(
         merge(q), merge(k), merge(v), segs, h, h_kv, causal, block_q, block_k,
-        interpret, window,
+        interpret, window, softcap,
     )
     return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)

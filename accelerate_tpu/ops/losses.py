@@ -29,6 +29,7 @@ def chunked_softmax_cross_entropy(
     loss_mask: Optional[jax.Array] = None,
     logit_dtype=jnp.float32,
     reduction: str = "mean",
+    logit_softcap: Optional[float] = None,
 ):
     """Mean CE of ``softmax(hidden @ head_kernel)`` against ``labels``.
 
@@ -63,12 +64,17 @@ def chunked_softmax_cross_entropy(
 
     def body(carry, inputs):
         from ..parallel.sharding import constrain_activation
+        from .attention import tanh_softcap
 
         m, l, label_logit = carry
         k_chunk, c_idx = inputs
         logits = jnp.einsum(
             "bsd,dc->bsc", hidden, k_chunk.astype(hidden.dtype)
         ).astype(logit_dtype)
+        # Gemma-2 final-logit capping, applied per chunk BEFORE the padding
+        # mask (tanh(-1e30) would resurrect padded columns to -softcap and
+        # corrupt the logsumexp)
+        logits = tanh_softcap(logits, logit_softcap)
         # anchor the per-chunk logits to the activation layout (vocab chunk
         # stays tp-sharded): without this the transpose (backward) program
         # reshards them involuntarily
