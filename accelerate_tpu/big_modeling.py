@@ -117,48 +117,52 @@ def load_checkpoint_in_model(
     mesh: Optional[Mesh] = None,
     strict: bool = True,
 ) -> None:
-    """Stream a safetensors checkpoint into (possibly sharded) params —
-    each host only materializes its own shards (reference
-    load_checkpoint_in_model utils/modeling.py:1805 moves tensors one by one
-    to devices; same spirit, zero per-layer hooks)."""
-    from .utils.serialization import load_sharded_safetensors, unflatten_dict
-
-    flat = load_sharded_safetensors(checkpoint)
-    tree = unflatten_dict(flat)
+    """Stream a safetensors checkpoint into (possibly sharded) params ONE
+    TENSOR AT A TIME: shard files are memory-mapped (SafetensorsReader) and
+    each tensor is copied out, cast, and device_put before the next is read
+    — the full checkpoint never materializes on the host (peak host
+    overhead = one tensor), matching the reference's per-tensor move loop
+    (load_checkpoint_in_model utils/modeling.py:1805) without its hooks.
+    Abstract (ShapeDtypeStruct) params work too: the loaded arrays simply
+    become the first real values."""
+    from .utils.serialization import SafetensorsReader
 
     flat_target, treedef = jax.tree_util.tree_flatten_with_path(model.params)
     from .parallel.sharding import path_of
 
-    new_leaves = []
+    shardings_flat = (
+        jax.tree_util.tree_flatten(model.shardings)[0]
+        if model.shardings is not None
+        else None
+    )
+    new_leaves = [leaf for _, leaf in flat_target]
     missing = []
-    for key_path, leaf in flat_target:
-        path = path_of(key_path).replace("/", ".")
-        if path in flat:
-            value = np.asarray(flat[path])
-        else:
-            nested = tree
-            found = True
-            for part in path.split("."):
-                if isinstance(nested, dict) and part in nested:
-                    nested = nested[part]
-                else:
-                    found = False
-                    break
-            if not found:
+    with SafetensorsReader(checkpoint) as reader:
+        # group reads by shard FILE: each shard is memory-mapped, and its
+        # touched pages stay in RSS until the handle is released — per-file
+        # processing keeps at most one shard resident at a time
+        by_file: dict[str, list] = {}
+        for idx, (key_path, leaf) in enumerate(flat_target):
+            path = path_of(key_path).replace("/", ".")
+            if path not in reader:
                 missing.append(path)
-                new_leaves.append(leaf)
                 continue
-            value = np.asarray(nested)
-        if value.shape != tuple(leaf.shape):
-            raise ValueError(f"Shape mismatch for {path}: ckpt {value.shape} vs model {leaf.shape}")
-        sharding = None
-        if model.shardings is not None:
-            sharding = jax.tree_util.tree_flatten(model.shardings)[0][len(new_leaves)]
-        new_leaves.append(
-            jax.device_put(value.astype(leaf.dtype), sharding)
-            if sharding is not None
-            else jnp.asarray(value, dtype=leaf.dtype)
-        )
+            by_file.setdefault(reader.file_of(path), []).append((idx, path, leaf))
+        for fname, entries in by_file.items():
+            for idx, path, leaf in entries:
+                value = reader.get(path)
+                if value.shape != tuple(leaf.shape):
+                    raise ValueError(
+                        f"Shape mismatch for {path}: ckpt {value.shape} vs model {leaf.shape}"
+                    )
+                sharding = shardings_flat[idx] if shardings_flat is not None else None
+                new_leaves[idx] = (
+                    jax.device_put(value.astype(leaf.dtype), sharding)
+                    if sharding is not None
+                    else jnp.asarray(value, dtype=leaf.dtype)
+                )
+                del value  # free the host copy before the next tensor
+            reader.release_file(fname)
     if missing and strict:
         raise KeyError(f"Missing keys in checkpoint: {missing[:10]}{'...' if len(missing)>10 else ''}")
     model.params = jax.tree_util.tree_unflatten(

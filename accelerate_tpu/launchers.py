@@ -101,10 +101,12 @@ def _spawn_cluster(function, args, num_processes, local_devices, port,
         os.environ.get("ACCELERATE_DEBUG_LAUNCHER_TIMEOUT", 600)
     )
     errors = []
+    reported: set = set()
     try:
         for _ in procs:
             try:
                 rank, err = queue.get(timeout=timeout)
+                reported.add(rank)
             except Exception:
                 # a worker died without reporting (OOM kill, segfault in
                 # native code, sys.exit inside the function): name the
@@ -115,7 +117,7 @@ def _spawn_cluster(function, args, num_processes, local_devices, port,
                 dead = [
                     f"rank {r} exitcode={p.exitcode}"
                     for r, p in enumerate(procs)
-                    if p.exitcode is not None
+                    if p.exitcode is not None and r not in reported
                 ]
                 detail = "\n".join(errors)
                 raise RuntimeError(

@@ -695,7 +695,8 @@ def llama_apply(
         # hand the pre-head hidden + head kernel to the fused CE path
         # (training-only mode: llama_loss consumes this; use the decode path
         # or use_chunked_ce=False for inference logits)
-        out = {"hidden": x, "head_kernel": head}
+        out = {"hidden": x, "head_kernel": head,
+               "logit_softcap": config.final_logit_softcap}
         if return_aux:
             out["aux_loss"] = aux_total
         return out
@@ -808,6 +809,9 @@ def llama_loss(model_view, batch, ce_chunk_size: int = 4096):
             jnp.maximum(labels, 0),
             chunk_size=ce_chunk_size,
             loss_mask=_mask_of(labels, mask),
+            # Gemma-2: the protocol dict carries the final-logit cap so the
+            # fused CE trains against the SAME capped logits inference serves
+            logit_softcap=out.get("logit_softcap"),
         )
         if "aux_loss" in out:
             loss = loss + out["aux_loss"]

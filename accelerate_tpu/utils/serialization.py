@@ -23,6 +23,7 @@ __all__ = [
     "parse_size",
     "save_sharded_safetensors",
     "load_sharded_safetensors",
+    "SafetensorsReader",
 ]
 
 _SIZE_UNITS = {"KB": 2**10, "MB": 2**20, "GB": 2**30, "TB": 2**40}
@@ -110,6 +111,88 @@ def save_sharded_safetensors(
     with open(os.path.join(save_directory, SAFE_WEIGHTS_INDEX_NAME), "w") as f:
         json.dump(index, f, indent=2)
     return written
+
+
+class SafetensorsReader:
+    """LAZY tensor-by-tensor access to a (possibly sharded) safetensors
+    checkpoint — the streamed-load primitive behind
+    ``load_checkpoint_in_model``. Unlike :func:`load_sharded_safetensors`
+    (which materializes the WHOLE flat dict on the host first — 2x the
+    model in host RAM during a load), this memory-maps each shard file and
+    copies out one tensor at a time, so peak host overhead is a single
+    tensor regardless of checkpoint size (the big-model load rehearsal,
+    reference big_model_inference role). Use as a context manager."""
+
+    def __init__(self, load_directory: str):
+        self._dir = load_directory
+        self._files: dict[str, str] = {}  # tensor name -> file path
+        self._handles: dict[str, Any] = {}
+        index_path = os.path.join(load_directory, SAFE_WEIGHTS_INDEX_NAME)
+        single = os.path.join(load_directory, SAFE_WEIGHTS_NAME)
+        if os.path.exists(index_path):
+            with open(index_path) as f:
+                index = json.load(f)
+            for name, fname in index["weight_map"].items():
+                self._files[name] = os.path.join(load_directory, fname)
+        elif os.path.exists(single):
+            for name in self._open(single).keys():
+                self._files[name] = single
+        else:
+            found = False
+            for fname in sorted(os.listdir(load_directory)):
+                if fname.endswith(".safetensors"):
+                    found = True
+                    path = os.path.join(load_directory, fname)
+                    for name in self._open(path).keys():
+                        self._files[name] = path
+            if not found:
+                raise FileNotFoundError(
+                    f"No safetensors files under {load_directory}"
+                )
+
+    def _open(self, path: str):
+        handle = self._handles.get(path)
+        if handle is None:
+            from safetensors import safe_open
+
+            handle = safe_open(path, framework="numpy")
+            self._handles[path] = handle
+        return handle
+
+    def keys(self):
+        return self._files.keys()
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._files
+
+    def file_of(self, name: str) -> str:
+        """Which shard file holds ``name`` — callers group reads per file
+        and :meth:`release_file` between groups so at most ONE shard's mmap
+        is resident (touched mmap pages count toward RSS until unmapped)."""
+        return self._files[name]
+
+    def release_file(self, path: str) -> None:
+        handle = self._handles.pop(path, None)
+        if handle is not None:
+            closer = getattr(handle, "close", None)
+            if closer is not None:
+                closer()
+
+    def get(self, name: str) -> np.ndarray:
+        return self._open(self._files[name]).get_tensor(name)
+
+    def close(self) -> None:
+        for handle in self._handles.values():
+            closer = getattr(handle, "close", None)
+            if closer is not None:
+                closer()
+        self._handles.clear()
+
+    def __enter__(self) -> "SafetensorsReader":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
 
 def load_sharded_safetensors(load_directory: str) -> dict[str, np.ndarray]:

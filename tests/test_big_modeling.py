@@ -135,3 +135,50 @@ def test_cpu_offload_forward():
     assert isinstance(model.params["fc1"]["w"], np.ndarray)
     out = model(np.ones((2, 64), dtype=np.float32))
     assert out.shape == (2, 8)
+
+
+def test_load_checkpoint_streams_tensor_by_tensor(tmp_path, monkeypatch):
+    """load_checkpoint_in_model must go through the LAZY SafetensorsReader
+    (per-tensor mmap reads, per-shard release) — never the whole-flat-dict
+    loader, whose host peak is 2x the model (big-model rehearsal,
+    benchmarks/inference_bench.py --big-load-gb)."""
+    import accelerate_tpu.utils.serialization as ser
+    from accelerate_tpu.big_modeling import load_checkpoint_and_dispatch
+    from accelerate_tpu.models.llama import LlamaConfig, create_llama
+    from accelerate_tpu.parallelism_config import ParallelismConfig
+
+    cfg = LlamaConfig.tiny()
+    src = create_llama(cfg, seed=3)
+    host = jax.tree_util.tree_map(np.asarray, src.params)
+    # tiny shards so the checkpoint is multi-file like the real thing
+    ser.save_sharded_safetensors(host, str(tmp_path), max_shard_size="64KB")
+    import os as _os
+
+    assert sum(f.endswith(".safetensors") for f in _os.listdir(tmp_path)) > 1
+
+    released = []
+    orig_release = ser.SafetensorsReader.release_file
+    monkeypatch.setattr(
+        ser.SafetensorsReader, "release_file",
+        lambda self, p: (released.append(p), orig_release(self, p))[1],
+    )
+
+    def banned(*a, **k):
+        raise AssertionError("eager load_sharded_safetensors must not be used")
+
+    monkeypatch.setattr(ser, "load_sharded_safetensors", banned)
+
+    mesh = ParallelismConfig(dp_shard_size=8).build_device_mesh()
+    # ABSTRACT model: the streamed load materializes straight into shards
+    model = create_llama(cfg, abstract=True)
+    model = load_checkpoint_and_dispatch(model, str(tmp_path), mesh=mesh)
+
+    assert len(set(released)) > 1  # every shard mmap released after its group
+    got = jax.tree_util.tree_map(np.asarray, model.params)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(got), jax.tree_util.tree_leaves(host)
+    ):
+        np.testing.assert_array_equal(a, b)
+    # placed with real shardings
+    leaf = model.params["layers"]["mlp"]["gate_proj"]["kernel"]
+    assert "dp_shard" in str(leaf.sharding.spec)

@@ -524,3 +524,31 @@ def test_gemma2_trains_and_decodes():
     g = jax.grad(loss)(params)
     for leaf in jax.tree_util.tree_leaves(g):
         assert np.isfinite(np.asarray(leaf)).all()
+
+
+def test_gemma2_chunked_ce_matches_dense():
+    """The fused chunked CE must train against the SAME softcapped logits
+    the dense path and inference serve (the protocol dict carries the cap)."""
+    from accelerate_tpu.models.llama import llama_loss
+
+    base = dict(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=64, sliding_window=8,
+        query_pre_attn_scalar=16.0, compute_dtype=jnp.float32,
+    )
+    cfg_dense = LlamaConfig.gemma2_9b(**base)
+    cfg_chunk = LlamaConfig.gemma2_9b(**base, use_chunked_ce=True, ce_chunk_size=64)
+    params = init_llama_params(cfg_dense, jax.random.key(0))
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(4, 256, size=(2, 16)).astype(np.int32)
+    )
+    batch = {"input_ids": ids}
+    dense = float(llama_loss(
+        lambda i, **kw: llama_apply(cfg_dense, params, i, **kw), batch
+    ))
+    chunked = float(llama_loss(
+        lambda i, **kw: llama_apply(cfg_chunk, params, i, **kw), batch,
+        ce_chunk_size=64,
+    ))
+    np.testing.assert_allclose(chunked, dense, rtol=1e-5)
