@@ -84,6 +84,40 @@ def _supervise(cmd, env, max_restarts: int, monitor_interval: float,
                 f"[launch] worker exited rc={rc}; restart {attempt}/{max_restarts}",
                 file=sys.stderr,
             )
+            # Whole-job restart alignment: on a multi-host job one rank's
+            # crash leaves the OTHERS failing or hung at different times —
+            # error-exits within seconds, hung workers only when their
+            # watchdog fires, up to watchdog_timeout later. Relaunching
+            # per-host on its OWN death time splits the restarts by that
+            # spread: early rejoiners attach to the half-dead old cluster
+            # (split-brain) or give up before the new coordinator exists,
+            # burning the restart budget. The heartbeat file is a per-host
+            # clock that ticks with the GLOBAL step cadence, so
+            # "last beat + watchdog horizon + margin" is (to within a step)
+            # the same ABSOLUTE instant on every host — each supervisor
+            # sleeps until that deadline and the whole job relaunches
+            # together, with every old worker provably dead (any hung one
+            # was killed at last beat + watchdog).
+            multi_host = int(env.get("ACCELERATE_NUM_PROCESSES", "1") or 1) > 1
+            if "ACCELERATE_RESTART_DELAY" in os.environ:
+                delay = float(os.environ["ACCELERATE_RESTART_DELAY"])
+            elif multi_host and hb_file and watchdog_timeout > 0:
+                deadline = (
+                    os.path.getmtime(hb_file)
+                    + watchdog_timeout
+                    + 2 * monitor_interval
+                    + 2
+                )
+                delay = max(0.0, deadline - time.time())
+            else:
+                delay = 0.0
+            if delay:
+                print(
+                    f"[launch] waiting {delay:.0f}s for the whole job to "
+                    "come down before relaunching",
+                    file=sys.stderr,
+                )
+                time.sleep(delay)
     finally:
         if hb_file:
             try:

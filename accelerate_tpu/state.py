@@ -60,8 +60,20 @@ def _maybe_init_jax_distributed() -> None:
     """
     import jax
 
-    if jax.process_count() > 1:
-        return  # already initialized
+    # Probe "already initialized" WITHOUT a backend query: jax.process_count()
+    # initializes the XLA backend as a side effect, after which
+    # jax.distributed.initialize refuses to run — the launcher env protocol
+    # (this function's whole reason to exist) would always crash. Found by
+    # the 4-process supervisor test; the debug_launcher path masked it by
+    # initializing distributed itself before PartialState.
+    try:
+        initialized = jax.distributed.is_initialized()
+    except AttributeError:  # older jax: peek the client directly
+        from jax._src import distributed as _dist
+
+        initialized = _dist.global_state.client is not None
+    if initialized:
+        return
     coord = os.environ.get("ACCELERATE_COORDINATOR_ADDRESS")
     nproc = os.environ.get("ACCELERATE_NUM_PROCESSES")
     if coord and nproc and int(nproc) > 1:

@@ -31,6 +31,11 @@ def main() -> None:
     ap.add_argument("--save_every", type=int, default=2)
     ap.add_argument("--crash_at", type=int, default=-1,
                     help="die (rc 13) at the END of this step — first attempt only")
+    ap.add_argument("--crash_rank", type=int, default=-1,
+                    help="only this process index crashes (-1 = every rank); "
+                    "the multi-host recovery contract: survivors hang on the "
+                    "dead rank's collectives, their watchdogs fire, and ALL "
+                    "supervisors restart together")
     args = ap.parse_args()
 
     accelerator = Accelerator(project_dir=args.project_dir)
@@ -59,17 +64,29 @@ def main() -> None:
             optimizer.zero_grad()
         if (step + 1) % args.save_every == 0:
             accelerator.save_state()
-        if step == args.crash_at and restart == 0:
-            print(f"crashing at step {step}")
+        if (
+            step == args.crash_at
+            and restart == 0
+            and args.crash_rank in (-1, accelerator.process_index)
+        ):
+            print(f"crashing at step {step} (rank {accelerator.process_index})")
             os._exit(13)
 
-    flat = np.concatenate(
-        [
-            np.asarray(jax.device_get(leaf)).ravel()
-            for leaf in jax.tree_util.tree_leaves(model.params)
-        ]
-    )
-    np.save(args.out, flat)
+    # per-rank LOCAL shard bytes: works for multi-process sharded params
+    # (a global device_get is not addressable from one rank) and reduces to
+    # the old whole-array dump in single-process runs
+    pieces = []
+    for leaf in jax.tree_util.tree_leaves(model.params):
+        shards = getattr(leaf, "addressable_shards", None)
+        if shards is not None:
+            pieces.extend(np.asarray(sh.data).ravel() for sh in shards)
+        else:
+            pieces.append(np.asarray(jax.device_get(leaf)).ravel())
+    flat = np.concatenate(pieces)
+    out = args.out
+    if accelerator.num_processes > 1:
+        out = f"{args.out}.rank{accelerator.process_index}"
+    np.save(out, flat)
     print(f"done: final_loss={float(loss):.6f}")
 
 

@@ -75,3 +75,77 @@ def test_crash_without_restarts_fails(tmp_path):
         cmd, env=_env(tmp_path), capture_output=True, text=True, timeout=900
     )
     assert proc.returncode == 13
+
+
+def _launch_cluster(tmp_path, name, n, crash_rank=None, crash_at=None,
+                    max_restarts=0, watchdog=60.0):
+    """Start n per-host supervisors (one launch invocation per process_id)
+    forming one jax.distributed CPU cluster; returns per-rank .npy paths."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    out = str(tmp_path / f"{name}.npy")
+    procs = []
+    for rank in range(n):
+        cmd = [
+            sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli",
+            "launch",
+            "--num_processes", str(n),
+            "--coordinator_address", f"127.0.0.1:{port}",
+            "--process_id", str(rank),
+            "--max_restarts", str(max_restarts),
+            "--watchdog_timeout", str(watchdog),
+            "--monitor_interval", "1",
+            SCRIPT,
+            "--project_dir", str(tmp_path / name),
+            "--out", out,
+        ]
+        if crash_rank is not None:
+            cmd += ["--crash_rank", str(crash_rank), "--crash_at", str(crash_at)]
+        env = _env(tmp_path)
+        # each worker is a 1-device host in the 4-process cluster
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+        # persistent compile cache shared across ranks AND attempts: four
+        # 1-core workers compiling simultaneously would outlast any sane
+        # watchdog on every attempt; with the cache only the first run pays
+        env["JAX_COMPILATION_CACHE_DIR"] = str(tmp_path / "jaxcache")
+        env["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "1"
+        procs.append(subprocess.Popen(
+            cmd, env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True,
+        ))
+    outs = []
+    for rank, proc in enumerate(procs):
+        stdout, stderr = proc.communicate(timeout=900)
+        assert proc.returncode == 0, (
+            f"rank {rank} rc={proc.returncode}\n{stdout}\n{stderr}"
+        )
+        outs.append((f"{out}.rank{rank}.npy", stdout, stderr))
+    return outs
+
+
+@pytest.mark.slow
+def test_four_process_supervisors_restart_together(tmp_path):
+    """The multi-host recovery claim at commands/launch.py:17-27 (VERDICT r3
+    next-round #9): rank 2 of a 4-process cluster crashes mid-run; the
+    survivors hang on its collectives until their watchdogs fire, every
+    supervisor restarts its worker, jax.distributed re-forms at the same
+    process count, and training resumes from the shared checkpoint to a
+    state bit-identical to an uninterrupted 4-process run."""
+    ref = _launch_cluster(tmp_path, "ref4", n=4)
+    crash = _launch_cluster(
+        tmp_path, "crash4", n=4, crash_rank=2, crash_at=2, max_restarts=1,
+    )
+    restarted = 0
+    for rank, (_path, stdout, stderr) in enumerate(crash):
+        if "restart 1/1" in stderr:
+            restarted += 1
+        if rank == 2:
+            assert "crashing at step 2" in stdout
+    # ALL FOUR supervisors restarted — the crashed rank via its exit code,
+    # the survivors via the heartbeat watchdog
+    assert restarted == 4, [c[2][-400:] for c in crash]
+    for (ref_path, _, _), (crash_path, _, _) in zip(ref, crash):
+        np.testing.assert_array_equal(np.load(ref_path), np.load(crash_path))

@@ -144,3 +144,44 @@ def test_collectives_inside_shard_map():
     assert float(np.asarray(s)[0] if np.asarray(s).ndim else s) == 28.0
     np.testing.assert_array_equal(np.asarray(g), x)
     np.testing.assert_array_equal(np.asarray(shifted), np.roll(x, 1))
+
+
+def test_recursively_apply_preserves_container_types():
+    """The tree_util rewrite keeps the reference's container semantics:
+    namedtuples, OrderedDicts, mixed nesting, non-tensor passthrough, and
+    error_on_other_type (reference utils/operations.py:85-133)."""
+    import collections
+
+    import jax.numpy as jnp
+    import pytest as _pytest
+
+    from accelerate_tpu.ops.operations import recursively_apply
+
+    Point = collections.namedtuple("Point", ["x", "y"])
+    data = {
+        "a": [jnp.ones((2,)), (jnp.zeros((1,)), "keep-me")],
+        "b": collections.OrderedDict(c=Point(jnp.full((2,), 2.0), None)),
+    }
+    out = recursively_apply(lambda t: t + 1, data)
+    assert isinstance(out["b"], collections.OrderedDict)
+    assert isinstance(out["b"]["c"], Point)
+    assert out["a"][1][1] == "keep-me"
+    assert out["b"]["c"].y is None
+    np.testing.assert_array_equal(np.asarray(out["a"][0]), np.full((2,), 2.0))
+    np.testing.assert_array_equal(np.asarray(out["b"]["c"].x), np.full((2,), 3.0))
+
+    with _pytest.raises(TypeError, match="Unsupported type"):
+        recursively_apply(lambda t: t, {"x": "not-a-tensor"}, error_on_other_type=True)
+
+    # contract beyond jax's pytree registry (why this is NOT tree_map):
+    # insertion order is preserved and UNREGISTERED Mapping subclasses
+    # (HF BatchEncoding-style) traverse instead of becoming opaque leaves
+    ordered = recursively_apply(lambda t: t + 1, {"z": jnp.ones(()), "a": jnp.ones(())})
+    assert list(ordered.keys()) == ["z", "a"]
+
+    class Batch(dict):
+        pass
+
+    out2 = recursively_apply(lambda t: t + 1, Batch(x=jnp.zeros((2,))))
+    assert isinstance(out2, Batch)
+    np.testing.assert_array_equal(np.asarray(out2["x"]), np.ones((2,)))
