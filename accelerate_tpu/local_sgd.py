@@ -25,8 +25,12 @@ per-shard region)::
             local_sgd.step()
 
 On every sync point (and on ``__exit__``) ``model.params`` holds the
-averaged parameters. Composes with dp/dp_shard meshes; model-parallel axes
-(tp/pp) are not supported inside the local region yet.
+averaged parameters. Composes with dp/dp_shard meshes AND with tensor
+parallelism inside the local region (the realistic HSDP+TP pod layout):
+the stack dim averages over the data axes while each stack slice keeps its
+``tp`` sharding on the parameter dims — the shard_map is manual over the
+data axes only, so GSPMD still partitions the inner compute over ``tp``.
+Pipeline parallelism is not supported inside the local region.
 """
 
 from __future__ import annotations
@@ -78,20 +82,50 @@ class LocalSGD:
         self._fallback_opt = None
 
     # ------------------------------------------------------------- lifecycle
+    def _stacked_sharding(self, leaf_sharding):
+        """Placement for one stacked (ndp, ...) leaf: dim 0 over the data
+        axes; the parameter dims KEEP their non-data sharding (tp under
+        HSDP+TP — each stack slice is a tp-sharded replica; dp/fsdp entries
+        are dropped because the slice is the shard's full copy)."""
+        entries = []
+        spec = getattr(leaf_sharding, "spec", None)
+        if spec is not None:
+            drop = set(self.axes)
+            for entry in spec:
+                names = (entry,) if isinstance(entry, (str, type(None))) else tuple(entry)
+                kept = tuple(n for n in names if n is not None and n not in drop)
+                entries.append(
+                    kept if len(kept) > 1 else (kept[0] if kept else None)
+                )
+        return NamedSharding(self.mesh, P(self.axes, *entries))
+
     def __enter__(self):
         if not self.enabled or self.ndp <= 1:
             return self
         mesh, axes = self.mesh, self.axes
         stacked = NamedSharding(mesh, P(axes))
+        leaf_shardings = self.model.shardings
+        if leaf_shardings is None:
+            leaf_shardings = jax.tree_util.tree_map(
+                lambda _: None, self.model.params
+            )
+        stack_shardings = jax.tree_util.tree_map(
+            self._stacked_sharding,
+            leaf_shardings,
+            is_leaf=lambda x: x is None or hasattr(x, "spec"),
+        )
         self._stack = jax.tree_util.tree_map(
-            lambda p: jax.device_put(
-                jnp.broadcast_to(p[None], (self.ndp, *p.shape)), stacked
+            lambda p, s: jax.device_put(
+                jnp.broadcast_to(p[None], (self.ndp, *p.shape)), s
             ),
             self.model.params,
+            stack_shardings,
         )
         # vmap(init) has no data dependence on the params, so explicit
         # out_shardings keep the per-shard opt state on its shard (the same
-        # hazard AcceleratedOptimizer._init_opt_state documents)
+        # hazard AcceleratedOptimizer._init_opt_state documents). Opt-state
+        # leaves ride P(axes) (tp-replicated within a shard) — mu/nu could
+        # inherit tp specs by path matching, a memory optimization only.
         abstract = jax.eval_shape(jax.vmap(self.tx.init), self._stack)
         self._opt_stack = jax.jit(
             jax.vmap(self.tx.init),
@@ -119,6 +153,9 @@ class LocalSGD:
             )
 
         def stepped(p_stack, o_stack, batch):
+            # manual over the DATA axes only: tp (and any other model axis)
+            # stays auto, so GSPMD partitions the inner forward/backward
+            # over it exactly as in normal training
             return jax.shard_map(
                 inner,
                 mesh=mesh,
@@ -145,7 +182,14 @@ class LocalSGD:
             )
             return mean, new_stack
 
-        self._sync = jax.jit(sync, donate_argnums=(0,))
+        # the averaged params go back to the model's OWN layout (tp/fsdp
+        # shardings) so post-LocalSGD training and checkpointing see the
+        # placement prepare() established; the refreshed stack keeps the
+        # same placement it was created with
+        self._sync = jax.jit(
+            sync, donate_argnums=(0,),
+            out_shardings=(leaf_shardings, stack_shardings),
+        )
         return self
 
     # ------------------------------------------------------------ train loop

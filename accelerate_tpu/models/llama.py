@@ -751,7 +751,9 @@ def _ce_from_hidden(config, x, head, labels, mask, *, reduction="mean",
             x, head.astype(x.dtype), jnp.maximum(labels, 0),
             chunk_size=ce_chunk_size or config.ce_chunk_size,
             loss_mask=_mask_of(labels, mask), reduction=reduction,
-            logit_softcap=config.final_logit_softcap,
+            # getattr: this CE tail is shared with families whose configs
+            # predate the Gemma-2 field (gpt2's 1F1B head)
+            logit_softcap=getattr(config, "final_logit_softcap", None),
         )
     # all-gather the fsdp-sharded head for the logits matmul (the standard
     # FSDP use-time gather). Without this the partitioner keeps logits
@@ -761,7 +763,7 @@ def _ce_from_hidden(config, x, head, labels, mask, *, reduction="mean",
     # With a replicated head, d_head is a local partial + psum — clean.
     head = replicate_over_fsdp(head.astype(config.compute_dtype))
     logits = (x @ head).astype(jnp.float32)
-    logits = _tanh_softcap(logits, config.final_logit_softcap)  # Gemma-2
+    logits = _tanh_softcap(logits, getattr(config, "final_logit_softcap", None))
     logits = constrain_activation(logits, "vocab")
     return _dense_ce_from_logits(logits, labels, mask, reduction=reduction)
 

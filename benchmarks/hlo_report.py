@@ -62,6 +62,7 @@ POLICY_RECOMPUTE = {"full": 0.0, "dots": 0.15, "minimal": 0.40, "nothing": 1.0}
 
 SIZES = {
     # (hidden, inter, layers, heads, kv_heads, vocab)
+    "70b": (8192, 28672, 80, 64, 8, 32000),
     "7b": (4096, 11008, 32, 32, 32, 32000),
     "1b": (2048, 5632, 16, 32, 32, 32000),
     "tiny": (256, 688, 4, 8, 8, 2048),
@@ -69,7 +70,7 @@ SIZES = {
 
 
 def build_step(size: str, devices: int, per_chip_batch: int, seq: int,
-               remat: str, accum_dtype: str):
+               remat: str, accum_dtype: str, tp: int = 1):
     import jax
     import jax.numpy as jnp
     import optax
@@ -96,7 +97,9 @@ def build_step(size: str, devices: int, per_chip_batch: int, seq: int,
     GradientState._reset_state()
     PartialState._reset_state()
     accelerator = Accelerator(
-        parallelism_config=ParallelismConfig(dp_shard_size=devices)
+        parallelism_config=ParallelismConfig(
+            dp_shard_size=devices // tp, tp_size=tp
+        )
     )
     model = create_llama(config, abstract=True)
     mu_dtype = jnp.bfloat16  # bench.py's BENCH_MU_BF16 default
@@ -300,6 +303,9 @@ def main():
     ap.add_argument("--per-chip-batch", type=int, default=2)
     ap.add_argument("--seq", type=int, default=4096)
     ap.add_argument("--remat", default="minimal")
+    ap.add_argument("--tp", type=int, default=1,
+                    help="tensor-parallel degree (composes with fsdp over "
+                    "the remaining devices)")
     ap.add_argument("--chip", default="v5p", choices=sorted(CHIPS))
     ap.add_argument("--out", default="runs/hlo_report")
     ap.add_argument("--fail-below-mfu", type=float, default=None,
@@ -318,7 +324,7 @@ def main():
     t0 = time.time()
     config, model, step, batch = build_step(
         args.size, args.devices, args.per_chip_batch, args.seq, args.remat,
-        "bf16",
+        "bf16", tp=args.tp,
     )
     lowered = step.lower(batch)
     t_lower = time.time() - t0
@@ -417,7 +423,14 @@ def main():
         model=dict(size=args.size, params_b=round(n_params / 1e9, 3),
                    seq=args.seq, per_chip_batch=args.per_chip_batch,
                    remat=args.remat, attention="blockwise (flash on TPU)"),
-        mesh=dict(devices=n, layout="fsdp(dp_shard)"),
+        mesh=dict(
+            devices=n,
+            layout=(
+                f"fsdp({n // args.tp}) x tp({args.tp})"
+                if args.tp > 1
+                else "fsdp(dp_shard)"
+            ),
+        ),
         chip=dict(kind=args.chip, **{k: v for k, v in chip.items()}),
         compile_s=round(t_compile, 1),
         collectives=sorted(collectives, key=lambda r: -r["bytes"] * r["count"]),

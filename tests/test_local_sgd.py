@@ -71,3 +71,66 @@ def test_local_sgd_disabled_falls_back_to_global():
         ls.step()
     assert np.isfinite(float(loss))
     assert float(model.params["b"]) != 0.0
+
+
+@pytest.mark.slow
+def test_local_sgd_hsdp_tp_parity():
+    """VERDICT r3 next-round #10: LocalSGD under the realistic pod layout —
+    HSDP (dp_replicate x dp_shard) with TP inside the local region. The tp
+    axis stays sharded on the parameter dims of every stack slice, and with
+    sync every step the trajectory equals dense HSDP+TP training at the
+    same effective batch (SGD linearity: mean of per-shard updates == the
+    update from the mean gradient)."""
+    from accelerate_tpu.models.llama import LlamaConfig, create_llama, llama_loss
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    def reset():
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+        PartialState._reset_state()
+
+    pcfg = dict(dp_replicate_size=2, dp_shard_size=2, tp_size=2)
+    cfg = LlamaConfig.tiny(compute_dtype=jnp.float32)
+    rng = np.random.default_rng(0)
+    batches = [
+        {"input_ids": rng.integers(4, cfg.vocab_size, size=(8, 16)).astype(np.int32)}
+        for _ in range(2)
+    ]
+    lr = 1e-2
+
+    # --- LocalSGD with sync every step
+    reset()
+    acc = Accelerator(parallelism_config=ParallelismConfig(**pcfg))
+    model = acc.prepare(create_llama(cfg, seed=0))
+    tp_specs = []
+    with LocalSGD(acc, model, optax.sgd(lr), llama_loss, local_sgd_steps=1) as ls:
+        for b in batches:
+            ls.train_step(b)
+            # each stack slice keeps its tp sharding on the param dims
+            tp_specs.append(
+                str(ls.shard_params["layers"]["attn"]["q_proj"]["kernel"].sharding.spec)
+            )
+            ls.step()
+    w_local = np.asarray(
+        jax.device_get(model.params["layers"]["attn"]["q_proj"]["kernel"])
+    )
+    assert all("tp" in s for s in tp_specs), tp_specs
+    # and the averaged params land back on the model's prepared layout
+    assert (
+        model.params["layers"]["attn"]["q_proj"]["kernel"].sharding
+        == model.shardings["layers"]["attn"]["q_proj"]["kernel"]
+    )
+
+    # --- dense HSDP+TP reference
+    reset()
+    acc2 = Accelerator(parallelism_config=ParallelismConfig(**pcfg))
+    model2, opt2 = acc2.prepare(create_llama(cfg, seed=0), optax.sgd(lr))
+    for b in batches:
+        with acc2.accumulate(model2):
+            acc2.backward(llama_loss, b)
+            opt2.step()
+            opt2.zero_grad()
+    w_dense = np.asarray(
+        jax.device_get(model2.params["layers"]["attn"]["q_proj"]["kernel"])
+    )
+    np.testing.assert_allclose(w_local, w_dense, atol=2e-5)
