@@ -154,6 +154,7 @@ def _gpt2_layer(
     q = _apply_dense(lp["attn"]["c_attn_q"], y, cdt, tp_dim=1).reshape(b, s, h, hd)
     k = _apply_dense(lp["attn"]["c_attn_k"], y, cdt, tp_dim=1).reshape(b, s, h, hd)
     v = _apply_dense(lp["attn"]["c_attn_v"], y, cdt, tp_dim=1).reshape(b, s, h, hd)
+    q, k, v = (constrain_activation(t, "heads") for t in (q, k, v))
     if attention_fn is not None:  # mesh-aware CP/SP attention from prepare()
         if segment_ids is not None:
             # packed batches compose with CP/SP (labels shard with the

@@ -515,6 +515,11 @@ def _layer(
     _sc = config._rope_scaling_key()
     q = apply_rope(q, position_offset, config.rope_theta, position_ids, _sc)
     k = apply_rope(k, position_offset, config.rope_theta, position_ids, _sc)
+    # Megatron-SP transition: full sequence, heads over tp (see
+    # constrain_activation kind="heads")
+    q = constrain_activation(q, "heads")
+    k = constrain_activation(k, "heads")
+    v = constrain_activation(v, "heads")
     kv_out = (k, v) if collect_kv else None
     if config.query_pre_attn_scalar is not None:
         # every attention impl scales by 1/sqrt(head_dim); pre-multiplying q

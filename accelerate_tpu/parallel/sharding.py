@@ -326,6 +326,14 @@ def constrain_activation(x, kind: str = "residual", mesh: Optional[Mesh] = None)
     ``tp`` (gate/up MLP activations, Megatron column-parallel outputs);
     "vocab" likewise for logits. No-op when no mesh is live, inside fully
     manual shard_map regions, or when no named axis applies.
+
+    Megatron sequence parallelism comes from the "residual" spec: between
+    blocks the SEQUENCE dim is sharded over ``tp`` too (composing with
+    cp/sp), so the partitioner turns each row-parallel matmul's output
+    all-reduce into reduce-scatter + the next block's all-gather (half the
+    TP bytes) and — the big one — saved-for-backward residuals shrink by
+    the tp degree (the 70B tp8 HBM blowup in runs/hlo_report_index.md).
+    Norms/elementwise between blocks run seq-sharded for free.
     """
     if mesh is None:
         mesh = current_mesh()
@@ -340,18 +348,43 @@ def constrain_activation(x, kind: str = "residual", mesh: Optional[Mesh] = None)
     except Exception:
         pass
     batch = _axis_entry(mesh, _ACT_BATCH_AXES, x.shape[0])
-    seq = _axis_entry(mesh, _ACT_SEQ_AXES, x.shape[1]) if x.ndim >= 3 else None
-    feat = (
-        _axis_entry(mesh, _ACT_TP_AXIS, x.shape[-1])
-        if kind in ("intermediate", "vocab")
-        else None
-    )
-    if batch is None and seq is None and feat is None:
-        return x
-    if x.ndim == 2:  # (B, F) — e.g. single-token decode logits
-        entries = [batch, feat]
+    if kind == "heads" and x.ndim >= 4:
+        # (B, S, H, D) entering attention: FULL sequence, heads over tp —
+        # the Megatron-SP transition point. Without this anchor the
+        # partitioner leaves q/k/v seq-sharded and re-gathers the sequence
+        # INSIDE the kv-block scan (observed: one 512 MB all-gather per kv
+        # block per layer in the 70B tp8 module — 2 TB/step). cp/sp keep
+        # their sequence shard (the ring/Ulysses shard_map owns that
+        # layout); only tp's share of the sequence is gathered here.
+        heads = _axis_entry(mesh, _ACT_TP_AXIS, x.shape[-2])
+        seq = _axis_entry(mesh, _ACT_SEQ_AXES, x.shape[1])
+        if batch is None and heads is None and seq is None:
+            return x
+        entries = [batch, seq] + [None] * (x.ndim - 4) + [heads, None]
     else:
-        entries = [batch, seq] + [None] * (x.ndim - 3) + [feat]
+        seq = None
+        if x.ndim >= 3:
+            if kind == "residual":
+                # Megatron-SP: tp joins the sequence axes ONLY where the
+                # feature dim is replicated (one axis cannot appear on two
+                # dims); fall back to cp/sp alone when the combined product
+                # does not divide the sequence — dropping the pre-existing
+                # cp/sp shard would be a memory/ICI REGRESSION, not just a
+                # missed optimization
+                seq = _axis_entry(mesh, _ACT_SEQ_AXES + _ACT_TP_AXIS, x.shape[1])
+            if seq is None:
+                seq = _axis_entry(mesh, _ACT_SEQ_AXES, x.shape[1])
+        feat = (
+            _axis_entry(mesh, _ACT_TP_AXIS, x.shape[-1])
+            if kind in ("intermediate", "vocab")
+            else None
+        )
+        if batch is None and seq is None and feat is None:
+            return x
+        if x.ndim == 2:  # (B, F) — e.g. single-token decode logits
+            entries = [batch, feat]
+        else:
+            entries = [batch, seq] + [None] * (x.ndim - 3) + [feat]
     try:
         return jax.lax.with_sharding_constraint(
             x, NamedSharding(mesh, P(*entries))

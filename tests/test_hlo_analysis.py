@@ -45,6 +45,19 @@ def _abstract_step(tmp_dump=None):
     return acc, model, opt, step, batch
 
 
+def _compile_with_spmd_dump(lowered, tmp_path):
+    """Compile with the SPMD-pass dump and return the post-partitioning HLO
+    text (fails loudly if the dump option is unsupported)."""
+    import glob
+
+    compiled = lowered.compile(
+        {"xla_dump_to": str(tmp_path), "xla_dump_hlo_pass_re": "spmd.*"}
+    )
+    spmd = sorted(glob.glob(str(tmp_path / "*after_spmd-partitioning*")))
+    assert spmd, "SPMD pass dump missing (compiler_options not honored?)"
+    return compiled, open(spmd[-1]).read()
+
+
 def test_abstract_prepare_materializes_nothing():
     acc, model, opt, step, batch = _abstract_step()
     leaves = jax.tree_util.tree_leaves(model.params)
@@ -60,22 +73,11 @@ def test_abstract_prepare_materializes_nothing():
 
 def test_abstract_lower_compiles_and_partitions(tmp_path):
     _, model, opt, step, batch = _abstract_step()
-    lowered = step.lower(batch)
-    try:
-        compiled = lowered.compile(
-            {"xla_dump_to": str(tmp_path), "xla_dump_hlo_pass_re": "spmd.*"}
-        )
-    except Exception:
-        compiled = lowered.compile()
+    compiled, hlo = _compile_with_spmd_dump(step.lower(batch), tmp_path)
     # memory analysis works without any materialized array
     mem = compiled.memory_analysis()
     assert getattr(mem, "argument_size_in_bytes", 1) > 0
 
-    import glob
-
-    spmd = sorted(glob.glob(str(tmp_path / "*after_spmd-partitioning*")))
-    assert spmd, "SPMD pass dump missing"
-    hlo = open(spmd[-1]).read()
     mod = _load_hlo_report()
     collectives, notes = mod.parse_collectives(hlo, 8)
 
@@ -118,3 +120,31 @@ def test_concrete_lower_matches_step():
     assert "all-gather" in lowered.compile().as_text()
     loss = step(batch)
     assert np.isfinite(float(loss))
+
+
+def test_megatron_sp_pattern_under_tp(tmp_path):
+    """With tp active, residual activations are sequence-sharded between
+    blocks (Megatron-SP): the partitioned module reduce-scatters the
+    row-parallel outputs over the tp group instead of full all-reducing,
+    and the q/k/v heads anchor keeps the sequence gather OUT of the
+    attention kv-block scan (the 2 TB/step failure mode recorded in
+    runs/hlo_report_index.md)."""
+    mod = _load_hlo_report()
+    config, model, step, batch = mod.build_step(
+        "tiny", 8, 2, 128, "minimal", "bf16", tp=2
+    )
+    _compiled, hlo = _compile_with_spmd_dump(step.lower(batch), tmp_path)
+    collectives, _ = mod.parse_collectives(hlo, 8)
+
+    tp_rs = [
+        c for c in collectives
+        if c["group"] == 2
+        and c["op"] in ("reduce-scatter", "all-reduce[rs-pattern]")
+        and c["bytes"] >= 2**12
+    ]
+    assert tp_rs, f"no reduce-scatter-form tp collectives: {collectives}"
+    # no collective runs more than ~8x per layer per direction: an in-scan
+    # sequence re-gather would multiply by the kv-block trip count too
+    L = config.num_hidden_layers
+    worst = max(c["count"] for c in collectives)
+    assert worst <= 16 * L, collectives
