@@ -12,12 +12,14 @@ GQA is supported via n_kv_heads < n_heads (kv repeated on the fly).
 
 from __future__ import annotations
 
+import functools
 import math
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.sharding import PartitionSpec as P
 
 __all__ = [
     "blockwise_attention_partials",
@@ -109,6 +111,68 @@ def dot_product_attention(
     return out
 
 
+def _shard_map_over_batch_heads(fn, q, k):
+    """Mesh-native wrapper for the Pallas flash kernel: a bare pallas_call
+    cannot be auto-partitioned by GSPMD — on a multi-device mesh the
+    partitioner would involuntarily REPLICATE q/k/v (gathering the whole
+    batch onto every chip) before the kernel. When a mesh with active
+    batch/tp axes is live (and we are not already inside a manual shard_map
+    region like the ring), run the kernel under a shard_map manual over
+    those axes: batch rows over the data axes, heads over tp — each chip's
+    kernel invocation sees only its local (B/dp, S, H/tp, D) block, which is
+    exactly the flash grid's batch*head outer dimension. Causal/window/
+    segment masking are per-(batch, head) so the split changes nothing.
+
+    Returns a callable ``wrapped(q, k, v, segment_ids)`` or None when the
+    plain call is the right thing (no mesh, axes inactive, non-divisible
+    heads, or already manual)."""
+    from ..parallel.sharding import (
+        _ACT_BATCH_AXES,
+        _ACT_TP_AXIS,
+        _axis_entry,
+        _in_manual_region,
+        current_mesh,
+    )
+
+    mesh = current_mesh()
+    if mesh is None:
+        return None
+    if _in_manual_region():
+        return None  # ring/Ulysses internals own the layout already
+    batch = _axis_entry(mesh, _ACT_BATCH_AXES, q.shape[0])
+    heads = _axis_entry(mesh, _ACT_TP_AXIS, q.shape[2])
+    if heads is not None and _axis_entry(mesh, _ACT_TP_AXIS, k.shape[2]) is None:
+        heads = None  # GQA kv heads must split the same way
+    if batch is None and heads is None:
+        return None
+
+    qkv_spec = P(batch, None, heads, None)
+    seg_spec = P(batch, None)
+
+    def wrapped(q, k, v, segs):
+        in_specs = [qkv_spec, qkv_spec, qkv_spec]
+        args = [q, k, v]
+        if segs is not None:
+            in_specs.append(seg_spec)
+            args.append(segs)
+
+            def body(q, k, v, segs):
+                return fn(q, k, v, segment_ids=segs)
+        else:
+            def body(q, k, v):
+                return fn(q, k, v)
+
+        return jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=tuple(in_specs),
+            out_specs=qkv_spec,
+            check_vma=False,
+        )(*args)
+
+    return wrapped
+
+
 def dispatch_attention(
     impl: str,
     q,
@@ -136,10 +200,16 @@ def dispatch_attention(
     if impl == "flash" and q_offset == 0 and causal:
         from .flash_attention import flash_attention
 
-        return flash_attention(
-            q, k, v, causal=True, segment_ids=segment_ids, window=window,
+        fn = functools.partial(
+            flash_attention, causal=True, window=window,
             softcap=softcap, block_q=block_q, block_k=kv_block,
         )
+        wrapped = _shard_map_over_batch_heads(fn, q, k)
+        if wrapped is not None:
+            return wrapped(q, k, v, segment_ids)
+        if segment_ids is not None:
+            return fn(q, k, v, segment_ids=segment_ids)
+        return fn(q, k, v)
     if impl in ("blockwise", "flash"):
         return blockwise_attention(
             q, k, v, causal=causal, kv_block=kv_block, q_offset=q_offset,

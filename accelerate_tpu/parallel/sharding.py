@@ -216,6 +216,16 @@ def _axis_entry(mesh: Mesh, axes: Sequence[str], dim_size: int):
     return tuple(use) if len(use) > 1 else use[0]
 
 
+def _in_manual_region() -> bool:
+    """Inside a shard_map manual region (ring/Ulysses/pp internals), layout
+    hints must stand down: constraining again is at best a no-op and on some
+    backends a compiler crash. One probe shared by every hint site."""
+    try:
+        return bool(jax.sharding.get_abstract_mesh().manual_axes)
+    except Exception:
+        return False
+
+
 def _fsdp_use_hints(mesh: Mesh):
     """(active fsdp axes, min weight size) for use-time gather pinning,
     read from the live AcceleratorState — prepare_model records the actual
@@ -285,11 +295,8 @@ def gather_over_fsdp(w, tp_dim: Optional[int] = None, mesh: Optional[Mesh] = Non
         mesh = current_mesh()
     if mesh is None or getattr(w, "ndim", 0) != 2:
         return w
-    try:
-        if jax.sharding.get_abstract_mesh().manual_axes:
-            return w
-    except Exception:
-        pass
+    if _in_manual_region():
+        return w
     spec = [None, None]
     if tp_dim is not None:
         spec[tp_dim] = _axis_entry(mesh, _ACT_TP_AXIS, w.shape[tp_dim])
@@ -339,14 +346,8 @@ def constrain_activation(x, kind: str = "residual", mesh: Optional[Mesh] = None)
         mesh = current_mesh()
     if mesh is None or getattr(x, "ndim", 0) < 2:
         return x
-    try:
-        if jax.sharding.get_abstract_mesh().manual_axes:
-            # inside a shard_map manual region (pp/cp/sp internals) the named
-            # layout is already explicit — constraining again is at best a
-            # no-op and on some backends a compiler crash
-            return x
-    except Exception:
-        pass
+    if _in_manual_region():
+        return x
     batch = _axis_entry(mesh, _ACT_BATCH_AXES, x.shape[0])
     if kind == "heads" and x.ndim >= 4:
         # (B, S, H, D) entering attention: FULL sequence, heads over tp —
