@@ -543,6 +543,11 @@ class Accelerator:
         blockwise partials, which need shard-offset stats).
         """
         pcfg = self.parallelism_config
+        # uniform sliding windows ride the ring/Ulysses fns; Gemma-2's
+        # per-layer alternation cannot (the model rejects loudly)
+        window = getattr(model_config, "sliding_window", None)
+        if getattr(model_config, "alternating_sliding_window", False):
+            window = None
         if pcfg.cp_enabled:
             from .ops.ring_attention import make_ring_attention
             from .utils.dataclasses import ContextParallelConfig
@@ -554,6 +559,7 @@ class Accelerator:
                 attention_impl=getattr(model_config, "attention_impl", "blockwise")
                 or "blockwise",
                 block_q=getattr(model_config, "attention_block_q", 2048),
+                window=window,
             )
         if pcfg.sp_enabled:
             from .ops.ulysses import make_ulysses_attention
@@ -572,7 +578,7 @@ class Accelerator:
                     block_q=getattr(model_config, "attention_block_q", 2048),
                 )
 
-            return make_ulysses_attention(self.mesh, inner=inner)
+            return make_ulysses_attention(self.mesh, inner=inner, window=window)
         return None
 
     def prepare_optimizer(self, optimizer, device_placement=None) -> AcceleratedOptimizer:

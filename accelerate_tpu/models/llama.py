@@ -452,12 +452,16 @@ def _attention(config: LlamaConfig, q, k, v, attention_fn=None, q_offset: int = 
     if window == "config":
         window = config.sliding_window
     if attention_fn is not None:
-        if window is not None:
+        if window != getattr(attention_fn, "window", None):
+            # a window-aware ring/Ulysses fn carries its build-time window
+            # as .window (ops/ring_attention.py); anything else would
+            # silently attend full-causal
             raise ValueError(
-                "sliding_window cannot compose with a mesh-injected "
-                "attention_fn (CP/SP ring/Ulysses attend full-causal): "
-                "results would silently differ from the model's window "
-                "semantics — drop cp/sp or set sliding_window=None"
+                "sliding_window cannot compose with this mesh-injected "
+                f"attention_fn (built for window={getattr(attention_fn, 'window', None)}, "
+                f"layer wants {window}): Gemma-2's ALTERNATING windows are "
+                "unsupported under cp/sp; uniform windows work when the "
+                "Accelerator builds the attention fn from the model config"
             )
         if config.attn_logit_softcap is not None:
             raise ValueError(

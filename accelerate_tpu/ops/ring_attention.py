@@ -53,7 +53,7 @@ def _ring_bias(sq_local: int, skv_local: int, q_start, kv_start, causal: bool):
 
 
 def _attend_shard(q, k_shard, v_shard, q_start, kv_start, causal,
-                  kv_block=None, q_segs=None, kv_segs=None):
+                  kv_block=None, q_segs=None, kv_segs=None, window=None):
     """One ring step's attention of the local (pre-scaled) q against a
     whole kv shard, returning online-softmax partials (out, m, l).
 
@@ -69,18 +69,19 @@ def _attend_shard(q, k_shard, v_shard, q_start, kv_start, causal,
     q stays local."""
     sq = q.shape[1]
     skv = k_shard.shape[1]
-    if kv_block is None or kv_block >= skv:
-        bias = _ring_bias(sq, skv, q_start, kv_start, causal)
-        if q_segs is not None:
-            same = (q_segs[:, :, None] == kv_segs[:, None, :])[:, None]
-            seg_bias = jnp.where(same, 0.0, NEG_INF)
-            bias = seg_bias if bias is None else bias + seg_bias
-        return _attend_block(q, k_shard, v_shard, bias)
-    return blockwise_attention_partials(
-        q, k_shard, v_shard, causal=causal, kv_block=kv_block,
-        q_offset=q_start, kv_offset=kv_start,
-        segment_ids=q_segs, kv_segment_ids=kv_segs,
-    )
+    if window is not None or not (kv_block is None or kv_block >= skv):
+        # the chunked path owns window masking (global offsets built in)
+        return blockwise_attention_partials(
+            q, k_shard, v_shard, causal=causal, kv_block=kv_block or skv,
+            q_offset=q_start, kv_offset=kv_start,
+            segment_ids=q_segs, kv_segment_ids=kv_segs, window=window,
+        )
+    bias = _ring_bias(sq, skv, q_start, kv_start, causal)
+    if q_segs is not None:
+        same = (q_segs[:, :, None] == kv_segs[:, None, :])[:, None]
+        seg_bias = jnp.where(same, 0.0, NEG_INF)
+        bias = seg_bias if bias is None else bias + seg_bias
+    return _attend_block(q, k_shard, v_shard, bias)
 
 
 def _flash_partials(q, k, v, causal, block_q, block_k, q_segs=None,
@@ -115,9 +116,15 @@ def ring_attention_local(
     kv_block: Optional[int] = None,
     attention_impl: str = "blockwise",
     block_q: int = 2048,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Attention over sequence-sharded q/k/v — call INSIDE shard_map with
     ``axis_name`` bound. Shapes are local shards (B, S/n, H, D).
+
+    ``window``: Mistral sliding window over GLOBAL positions — each ring
+    step masks with its shard's true offsets (the blockwise path computes
+    every step: the flash kernel cannot express shifted windows, so
+    windowed rings run blockwise partials regardless of attention_impl).
 
     ``attention_impl="flash"`` runs the Pallas kernel per ring step and
     merges steps by LSE. No positional offsets reach the kernel: contiguous
@@ -133,7 +140,11 @@ def ring_attention_local(
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     b, sq, h, d = q.shape
-    use_flash = attention_impl == "flash" and rotate_method != "allgather"
+    use_flash = (
+        attention_impl == "flash"
+        and rotate_method != "allgather"
+        and window is None
+    )
     if not use_flash:
         n_rep = h // k.shape[2]
         k = repeat_kv(k, n_rep)
@@ -152,7 +163,7 @@ def ring_attention_local(
         )
         out, m, l = _attend_shard(
             q, k_all, v_all, q_start, 0, causal, kv_block,
-            q_segs=q_segs, kv_segs=segs_all,
+            q_segs=q_segs, kv_segs=segs_all, window=window,
         )
         return finalize_blocks(out, m, l)
 
@@ -191,11 +202,30 @@ def ring_attention_local(
                     kv_rank < idx, attend, lambda op: op, (out, m, l)
                 )
         else:
-            o2, m2, l2 = _attend_shard(
-                q, k_cur, v_cur, q_start, kv_rank * sq, causal, kv_block,
-                q_segs=q_segs, kv_segs=kseg_cur,
-            )
-            out, m, l = combine_blocks(out, m, l, o2, m2, l2)
+            def attend_bw(operand, kc=k_cur, vc=v_cur, ks=kseg_cur,
+                          kv_start=kv_rank * sq):
+                out, m, l = operand
+                o2, m2, l2 = _attend_shard(
+                    q, kc, vc, q_start, kv_start, causal, kv_block,
+                    q_segs=q_segs, kv_segs=ks, window=window,
+                )
+                return combine_blocks(out, m, l, o2, m2, l2)
+
+            if window is not None:
+                # sliding-window step skip — the O(S*W) payoff CP exists
+                # for at long context: shards wholly in the future OR wholly
+                # outside every query's window contribute nothing (mirrors
+                # the flash kernel's _block_visible grid pruning)
+                kv_start = kv_rank * sq
+                visible = jnp.logical_and(
+                    kv_start <= q_start + sq - 1,          # not all-future
+                    q_start - (kv_start + sq - 1) < window,  # not all-stale
+                )
+                out, m, l = lax.cond(
+                    visible, attend_bw, lambda op: op, (out, m, l)
+                )
+            else:
+                out, m, l = attend_bw((out, m, l))
         if step < n - 1:
             k_cur = lax.ppermute(k_cur, axis_name, perm)
             v_cur = lax.ppermute(v_cur, axis_name, perm)
@@ -237,6 +267,7 @@ def zigzag_ring_attention_local(
     kv_block: Optional[int] = None,
     attention_impl: str = "blockwise",
     block_q: int = 2048,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Ring attention over zig-zag-permuted shards — call INSIDE shard_map.
 
@@ -255,7 +286,7 @@ def zigzag_ring_attention_local(
     idx = lax.axis_index(axis_name)
     b, sq, h, d = q.shape
     c = sq // 2  # chunk rows
-    use_flash = attention_impl == "flash"
+    use_flash = attention_impl == "flash" and window is None
     if not use_flash:
         n_rep = h // k.shape[2]
         k = repeat_kv(k, n_rep)
@@ -323,25 +354,38 @@ def zigzag_ring_attention_local(
                         out, m, l = operand
                         o2, m2, l2 = _attend_shard(
                             qb, kb, vb, qs, ks, causal, kv_block,
-                            q_segs=qsg, kv_segs=ksg,
+                            q_segs=qsg, kv_segs=ksg, window=window,
                         )
                         return combine_blocks(out, m, l, o2, m2, l2)
 
-                if not causal:
+                def _win_visible(qs=q_start, ks=kv_start):
+                    # some (q, k) pair satisfies 0 <= q - k < window
+                    return jnp.logical_and(
+                        ks <= qs + c - 1, qs - (ks + c - 1) < window
+                    )
+
+                if (not causal) and window is None:
                     out, m, l = attend((out, m, l))
+                elif not causal:  # windowed non-causal: window bounds only
+                    out, m, l = lax.cond(
+                        _win_visible(), attend, lambda op: op, (out, m, l)
+                    )
                 elif diagonal:
                     out, m, l = attend((out, m, l))
-                elif step == 0 and qi != ki:
-                    # step-0 cross pairs are static too: (q chunk idx,
-                    # kv chunk 2n-1-idx) is future→skip; the transpose is
-                    # wholly past→full
+                elif step == 0 and qi != ki and window is None:
+                    # step-0 cross pairs are static: (q chunk idx, kv chunk
+                    # 2n-1-idx) is future→skip; the transpose is wholly
+                    # past→full
                     if qi == 1:  # q chunk 2n-1-idx vs kv chunk idx: past
                         out, m, l = attend((out, m, l))
                     # qi == 0: kv chunk 2n-1-idx is future — skip
                 else:
                     # fully masked iff the kv chunk lies strictly in the
-                    # future (equal ids cannot occur past step 0)
+                    # future (equal ids cannot occur past step 0) or — with
+                    # a sliding window — wholly outside every query's window
                     visible = kv_start < q_start if use_flash else kv_start <= q_start
+                    if window is not None:
+                        visible = jnp.logical_and(visible, _win_visible())
                     out, m, l = lax.cond(visible, attend, lambda op: op, (out, m, l))
             outs[qi] = (out, m, l)
         if step < n - 1:
@@ -364,6 +408,7 @@ def make_ring_attention(
     kv_block: Optional[int] = 2048,
     attention_impl: str = "blockwise",
     block_q: int = 2048,
+    window: Optional[int] = None,
 ):
     """Build an attention fn over GLOBAL (B, S, H, D) arrays that runs ring
     attention across the cp axis (composing with dp batch sharding and tp
@@ -394,7 +439,7 @@ def make_ring_attention(
             body = functools.partial(
                 zigzag_ring_attention_local, axis_name=cp_axis, causal=causal,
                 kv_block=kv_block, attention_impl=attention_impl,
-                block_q=block_q,
+                block_q=block_q, window=window,
             )
             in_specs = (spec, spec, spec)
             args = (qz, kz, vz)
@@ -418,6 +463,7 @@ def make_ring_attention(
             kv_block=kv_block,
             attention_impl=attention_impl,
             block_q=block_q,
+            window=window,
         )
         in_specs = (spec, spec, spec)
         args = (q, k, v)
@@ -433,4 +479,6 @@ def make_ring_attention(
         )
         return fn(*args)
 
+    # models check this marker to allow their sliding_window under CP
+    attention_fn.window = window
     return attention_fn

@@ -98,6 +98,7 @@ def make_ulysses_attention(
     batch_axes: Sequence[str] = ("dp_replicate", "dp_shard"),
     head_axes: Sequence[str] = ("tp",),
     inner: Optional[Callable] = None,
+    window: Optional[int] = None,
 ):
     """Attention fn over GLOBAL (B, S, H, D) arrays running Ulysses SP over
     the sp axis (composes with dp batch and tp head sharding)."""
@@ -105,9 +106,19 @@ def make_ulysses_attention(
     heads = tuple(a for a in head_axes if mesh.shape.get(a, 1) > 1) or None
     spec = P(batch, sp_axis, heads, None)
 
+    base_inner = inner
+    if window is not None:
+        # Ulysses attends the FULL sequence locally post head-scatter, so a
+        # uniform window is just the inner attention's window
+        base_inner = functools.partial(
+            inner or functools.partial(blockwise_attention, kv_block=512),
+            window=window,
+        )
+
     def attention_fn(q, k, v, causal: bool = True, segment_ids=None):
         body = functools.partial(
-            ulysses_attention_local, axis_name=sp_axis, causal=causal, inner=inner
+            ulysses_attention_local, axis_name=sp_axis, causal=causal,
+            inner=base_inner,
         )
         in_specs = (spec, spec, spec)
         args = (q, k, v)
@@ -123,4 +134,5 @@ def make_ulysses_attention(
         )
         return fn(*args)
 
+    attention_fn.window = window  # models check this to allow sliding_window
     return attention_fn

@@ -365,6 +365,28 @@ def _measured_mfu(device, config, seq_len, measured) -> float:
     return (measured["tok_s_chip"] * flops_per_token) / detect_peak_flops(device)
 
 
+def _compile_report_summary():
+    """The committed relay-independent perf evidence (benchmarks/
+    hlo_report.py): attach its headline prediction to CPU-smoke fallback
+    emissions so the round's bench artifact points at the real analysis
+    instead of a meaningless 1-core number standing alone."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "runs", "hlo_report.json")
+    try:
+        with open(path) as f:
+            report = json.load(f)
+        roof = report["roofline"]
+        return {
+            "predicted_mfu": roof["predicted_mfu"],
+            "predicted_tok_s_chip": roof["predicted_tok_s_chip"],
+            "config": f"{report['model']['size']} on "
+                      f"{report['mesh']['devices']}x {report['chip']['kind']}",
+            "see": "runs/hlo_report_index.md",
+        }
+    except Exception:
+        return None
+
+
 def _emit(device, config, seq_len, measured, notes=""):
     global _EMITTED_RESULT
     mfu = _measured_mfu(device, config, seq_len, measured)
@@ -388,6 +410,12 @@ def _emit(device, config, seq_len, measured, notes=""):
     }
     if notes:
         result["error"] = notes
+        if device.platform != "tpu":
+            # CPU smoke fallback: point at the committed compile-time
+            # analysis — the measured value above is a 1-core smoke number
+            report = _compile_report_summary()
+            if report is not None:
+                result["detail"]["compile_report"] = report
     _EMITTED_RESULT = True
     print(json.dumps(result), flush=True)
 
