@@ -365,13 +365,18 @@ def constrain_activation(x, kind: str = "residual", mesh: Optional[Mesh] = None)
     else:
         seq = None
         if x.ndim >= 3:
-            if kind == "residual":
+            if kind == "residual" and mesh.shape.get("pp", 1) == 1:
                 # Megatron-SP: tp joins the sequence axes ONLY where the
                 # feature dim is replicated (one axis cannot appear on two
                 # dims); fall back to cp/sp alone when the combined product
                 # does not divide the sequence — dropping the pre-existing
                 # cp/sp shard would be a memory/ICI REGRESSION, not just a
-                # missed optimization
+                # missed optimization. Disabled under pp meshes: the
+                # seq-over-tp residual crossing the pipeline stage boundary
+                # emits data-independent resharding permutes that race
+                # XLA:CPU's thunk rendezvous (the known deadlock class) and
+                # would be wasted ICI on TPU; SPxPP needs the stage layout
+                # itself to carry the seq shard (future work).
                 seq = _axis_entry(mesh, _ACT_SEQ_AXES + _ACT_TP_AXIS, x.shape[1])
             if seq is None:
                 seq = _axis_entry(mesh, _ACT_SEQ_AXES, x.shape[1])
