@@ -52,6 +52,41 @@ logger = get_logger(__name__)
 __all__ = ["Accelerator"]
 
 
+def check_wide_pp_limit(mesh_size: int, pp_size: int) -> None:
+    """Refuse pipeline meshes whose non-pp subgroup exceeds 4 devices.
+
+    XLA's SPMD partitioner CHECK-crashes (spmd_partitioner_util partition-
+    group arithmetic) partitioning the pipeline shard_map (manual over pp,
+    auto over the rest) whenever the auto subgroup exceeds 4 devices —
+    reproduced under pp=2 for dp8, ddp2×fsdp4, and dp4×tp2 (every schedule:
+    GPipe, 1F1B, interleaved; fused and eager), while pp4×dp4 and every
+    auto<=4 composition partitions fine. The crashing CHECK lives in the
+    platform-independent partitioner (spmd_partitioner_util.cc — unlike the
+    CPU-only AllReducePromotion/rendezvous classes), but it has only ever
+    been REPRODUCED on the CPU backend: hard-error there, warn on real TPU
+    where the compiler stack differs and no evidence exists either way.
+    ACCELERATE_FORCE_WIDE_PP=1 silences both once upstream is fixed."""
+    from .utils.environment import parse_flag_from_env
+
+    auto_size = mesh_size // max(pp_size, 1)
+    if auto_size > 4 and not parse_flag_from_env("ACCELERATE_FORCE_WIDE_PP"):
+        import jax
+
+        msg = (
+            f"pipeline parallelism with a {auto_size}-device non-pp "
+            "subgroup hits an XLA SPMD-partitioner crash (partition-group "
+            "CHECK) on current XLA:CPU. Keep dp*tp*cp*sp*ep <= 4 per "
+            "pipeline (e.g. raise pp_size), or set "
+            "ACCELERATE_FORCE_WIDE_PP=1 to try anyway."
+        )
+        if jax.default_backend() == "cpu":
+            raise ValueError(msg)
+        logger.warning(
+            "%s (continuing: the crash is unreproduced on the %s backend)",
+            msg, jax.default_backend(),
+        )
+
+
 def _is_optax_tx(obj) -> bool:
     return (
         hasattr(obj, "init")
@@ -506,6 +541,7 @@ class Accelerator:
             from .parallel.pp import make_pipeline_layer_stack
             from .utils.dataclasses import PipelineParallelConfig
 
+            check_wide_pp_limit(self.mesh.size, self.mesh.shape.get("pp", 1))
             pp_cfg = pcfg.pp_config or PipelineParallelConfig()
             stack_fn = make_pipeline_layer_stack(self.mesh, pp_cfg.num_microbatches)
             if hasattr(model, "set_layer_stack_fn"):

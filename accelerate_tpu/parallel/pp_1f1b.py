@@ -62,29 +62,42 @@ def _index_mb(microbatches, f):
 
 
 def shard_microbatches(mesh, batch, m, batch_axes, seq_axes):
-    """Reshape a flat (B, ...) batch pytree to (m, B/m, ...) microbatches and
-    pin the sharding: microbatch dim unsharded, row dim on the data axes
-    (the flat batch was dp-sharded on dim 0; reshape alone would leave GSPMD
-    free to shard the m dim). Shared by the 1F1B and interleaved schedules."""
+    """Reshape a flat (B, ...) batch pytree to (m, B/m, ...) microbatches with
+    a pinned data layout. Shared by the 1F1B and interleaved schedules.
+
+    The pin goes on the FLAT batch (rows over the dp axes, sequence over
+    cp/sp), and the reshape after it propagates that layout (GSPMD splits the
+    sharded row dim into the microbatch dim). Constraining the microbatched
+    (m, B/m, ...) array instead — the obvious formulation — produces a
+    sharding whose device tiling combines tiled dp + a manual pp subgroup +
+    a replicated tp subgroup once this array enters the schedule's
+    partial-manual shard_map; XLA's SPMD partitioner CHECK-crashes on that
+    pattern (spmd_partitioner_util.cc partition-group arithmetic) whenever
+    the mesh has BOTH tp>1 and pp>1. Platform-independent partitioner code,
+    so real TPUs crash identically — found by the 3D tp×pp×fsdp driver gate.
+    """
     leaves = jax.tree_util.tree_leaves(batch)
     b = leaves[0].shape[0]
     if b % m != 0:
         raise ValueError(f"batch {b} not divisible by num_microbatches {m}")
-    micro = jax.tree_util.tree_map(
-        lambda a: a.reshape(m, b // m, *a.shape[1:]), batch
-    )
     b_axes = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1)
     s_axes = tuple(a for a in seq_axes if mesh.shape.get(a, 1) > 1)
-    return jax.tree_util.tree_map(
+    # pinned unconditionally: with no data/seq axes the P(None, ...) pin is
+    # an explicit "replicated" that keeps GSPMD from electing to shard the
+    # microbatch dim over tp/other axes after the reshape below
+    batch = jax.tree_util.tree_map(
         lambda a: jax.lax.with_sharding_constraint(
             a,
             NamedSharding(
                 mesh,
-                P(None, b_axes or None,
-                  *([s_axes] if (s_axes and a.ndim > 2) else [])),
+                P(b_axes or None,
+                  *([s_axes] if (s_axes and a.ndim > 1) else [])),
             ),
         ),
-        micro,
+        batch,
+    )
+    return jax.tree_util.tree_map(
+        lambda a: a.reshape(m, b // m, *a.shape[1:]), batch
     )
 
 

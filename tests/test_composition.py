@@ -58,6 +58,41 @@ def test_3d_tp_pp_fsdp_training():
     np.testing.assert_allclose(w_3d, w_ref, atol=1e-4)
 
 
+@pytest.mark.slow
+def test_3d_fused_1f1b_tp_parity():
+    """Fused ``train_step`` (1F1B schedule) under tp×pp×fsdp vs plain-FSDP
+    fused step. Regression for the SPMD-partitioner CHECK crash: pinning the
+    microbatched (m, B/m, ...) array's sharding produced a tiled-dp + manual-
+    pp + replicated-tp pattern the partitioner aborts on (fixed by pinning
+    the flat batch pre-reshape, parallel/pp_1f1b.py shard_microbatches)."""
+    rng = np.random.default_rng(0)
+    data = {"input_ids": rng.integers(0, 256, size=(8, 32)).astype(np.int32)}
+
+    def run(pcfg):
+        _reset()
+        acc = Accelerator(parallelism_config=pcfg)
+        cfg = LlamaConfig.tiny(num_hidden_layers=4, compute_dtype=jnp.float32)
+        model = create_llama(cfg, seed=0)
+        model, opt = acc.prepare(model, optax.sgd(1e-2))
+        step = acc.train_step(llama_loss, model=model, optimizer=opt)
+        loader = acc.prepare_data_loader(data, batch_size=8, drop_last=True)
+        for batch in loader:
+            loss = step(batch)
+        return float(loss), np.asarray(
+            jax.device_get(model.params["layers"]["mlp"]["gate_proj"]["kernel"])
+        )
+
+    loss_ref, w_ref = run(ParallelismConfig(dp_shard_size=8))
+    loss_3d, w_3d = run(
+        ParallelismConfig(
+            tp_size=2, pp_size=2, dp_shard_size=2,
+            pp_config=PipelineParallelConfig(num_microbatches=2),
+        )
+    )
+    assert loss_3d == pytest.approx(loss_ref, abs=1e-4)
+    np.testing.assert_allclose(w_3d, w_ref, atol=1e-4)
+
+
 def test_4d_with_cp():
     """tp×cp×fsdp×ddp all at once — beyond what the reference can compose."""
     _reset()

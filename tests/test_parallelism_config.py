@@ -75,3 +75,26 @@ def test_build_mesh():
     assert mesh.shape["tp"] == 2
     assert mesh.shape["dp_replicate"] == 1
     assert mesh.devices.size == 8
+
+
+def test_wide_pp_guard(monkeypatch):
+    """Pipeline meshes whose non-pp subgroup exceeds 4 devices hit an XLA
+    SPMD-partitioner CHECK crash (reproduced for dp8/ddp2xfsdp4/dp4xtp2
+    under pp=2, every schedule); prepare refuses with guidance instead of
+    letting XLA SIGABRT. ACCELERATE_FORCE_WIDE_PP=1 overrides."""
+    import pytest
+
+    from accelerate_tpu.accelerator import check_wide_pp_limit
+
+    monkeypatch.delenv("ACCELERATE_FORCE_WIDE_PP", raising=False)
+    # auto <= 4: fine
+    check_wide_pp_limit(8, 2)
+    check_wide_pp_limit(16, 4)
+    # auto > 4: refused with the override named
+    with pytest.raises(ValueError, match="ACCELERATE_FORCE_WIDE_PP"):
+        check_wide_pp_limit(16, 2)
+    with pytest.raises(ValueError, match="non-pp"):
+        check_wide_pp_limit(32, 4)
+    # the escape hatch
+    monkeypatch.setenv("ACCELERATE_FORCE_WIDE_PP", "1")
+    check_wide_pp_limit(16, 2)
