@@ -381,6 +381,11 @@ def _compile_report_summary():
             "predicted_tok_s_chip": roof["predicted_tok_s_chip"],
             "config": f"{report['model']['size']} on "
                       f"{report['mesh']['devices']}x {report['chip']['kind']}",
+            # predictor calibrated against the one hardware datum (r1's
+            # 10.3%-MFU v5e run): the roofline over-predicted that untuned
+            # small-matmul config 5.45x, so the prediction is a ceiling
+            # with a /5.45 worst-case floor — see the calibration section
+            "calibration": "ceiling; measured floor = /5.45 (r1 datum)",
             "see": "runs/hlo_report_index.md",
         }
     except Exception:

@@ -65,6 +65,10 @@ SIZES = {
     "70b": (8192, 28672, 80, 64, 8, 32000),
     "7b": (4096, 11008, 32, 32, 32, 32000),
     "1b": (2048, 5632, 16, 32, 32, 32000),
+    # the round-1 measured config (bench.py @ c6493e4): the ONE hardware
+    # datum (v5e 1 chip, seq 2048, bs 8, remat "nothing" -> 11.1k tok/s,
+    # 10.3% MFU) — used to calibrate this predictor
+    "0.3b": (1024, 2816, 16, 16, 16, 32000),
     "tiny": (256, 688, 4, 8, 8, 2048),
 }
 
