@@ -512,7 +512,11 @@ class Accelerator:
                 model.config.remat_policy = "minimal"
         fsdp_axes = pcfg.fsdp_dim_names
         # record for use-time gather pinning (parallel/sharding.py
-        # _fsdp_use_hints): model code reconstructs storage specs in-trace
+        # _fsdp_use_hints): model code reconstructs storage specs in-trace.
+        # The per-model copy is authoritative inside this model's apply
+        # (scoped by Model._mp_apply); the shared-state copy covers paths
+        # that bypass apply (pipeline stage fns).
+        model._fsdp_hints = (tuple(fsdp_axes), min_weight_size)
         self.state._shared_state["fsdp_axes"] = tuple(fsdp_axes)
         self.state._shared_state["fsdp_min_weight_size"] = min_weight_size
         shardings = infer_shardings(

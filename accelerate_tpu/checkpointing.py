@@ -331,8 +331,12 @@ def load_accelerator_state(accelerator, input_dir: Optional[str] = None, **kwarg
             except ValueError:
                 # Same legacy-layout story as the model above: adam mu/nu
                 # mirror the param tree, so a pre-split checkpoint's
-                # optimizer state needs the model's upgrade too.
-                model = accelerator._models[i] if i < len(accelerator._models) else None
+                # optimizer state needs the model's upgrade too. The upgrade
+                # comes from the model this optimizer was prepared against
+                # (AcceleratedOptimizer.init stores the link) — positional
+                # _models[i] would mispair under multi-model registration
+                # orders that are not 1:1.
+                model = getattr(opt, "model", None)
                 upgrade = getattr(model, "upgrade_state_fn", None)
                 if upgrade is None:
                     raise

@@ -27,13 +27,39 @@ logger = get_logger(__name__)
 
 def _tpu_configured() -> bool:
     """Whether this environment targets TPU hardware — decided WITHOUT
-    initializing jax (probing a dead relay hangs)."""
+    initializing jax (probing a dead relay hangs).
+
+    Env vars cover relay/pod setups; the /dev/accel* / /dev/vfio device
+    probes cover a bare TPU-VM host where jax auto-discovers the chips with
+    no TPU env vars set at all — without them ``notebook_launcher(
+    num_processes>1)`` would fork a CPU cluster and silently retarget
+    training off the TPU. A pip-installed libtpu is deliberately NOT a
+    signal: it proves software installation, not hardware (jax[tpu]-style
+    images ship it on CPU-only hosts)."""
     platforms = os.environ.get("JAX_PLATFORMS", "")
-    return (
+    if platforms and "tpu" not in platforms and "axon" not in platforms:
+        # an explicit JAX_PLATFORMS that excludes TPU (e.g. "cpu") wins over
+        # hardware presence — it is the documented way to force the fork path
+        return False
+    if (
         any(p in platforms for p in ("tpu", "axon"))
         or "PALLAS_AXON_POOL_IPS" in os.environ
         or "TPU_NAME" in os.environ
-    )
+    ):
+        return True
+    import glob
+
+    # v2-v4 expose numbered /dev/accelN nodes (the [0-9] avoids the generic
+    # /dev/accel/ subsystem dir non-TPU NPUs create). v5e+ attach through
+    # numbered vfio group nodes — but those also exist on GPU-passthrough
+    # hypervisors, so vfio only counts when libtpu is importable too.
+    if glob.glob("/dev/accel[0-9]*"):
+        return True
+    if glob.glob("/dev/vfio/[0-9]*"):
+        import importlib.util
+
+        return importlib.util.find_spec("libtpu") is not None
+    return False
 
 
 def _free_port() -> int:

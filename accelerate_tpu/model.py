@@ -99,12 +99,17 @@ class Model:
     def _mp_apply(self, params, *args, **kwargs):
         """Mixed-precision forward: params→compute dtype, outputs→fp32 — the
         analogue of the reference's autocast wrap + ConvertOutputsToFp32
-        (accelerator.py:1818-1829)."""
-        if self.policy is not None:
-            params = self.policy.cast_to_compute(params)
-            out = self.apply_fn(params, *args, **kwargs)
-            return self.policy.cast_to_output(out)
-        return self.apply_fn(params, *args, **kwargs)
+        (accelerator.py:1818-1829). Scopes this model's fsdp gather-pin
+        hints so multi-model setups with different fsdp configs pin
+        use-time gathers to their OWN storage spec."""
+        from .parallel.sharding import model_fsdp_hints
+
+        with model_fsdp_hints(getattr(self, "_fsdp_hints", None)):
+            if self.policy is not None:
+                params = self.policy.cast_to_compute(params)
+                out = self.apply_fn(params, *args, **kwargs)
+                return self.policy.cast_to_output(out)
+            return self.apply_fn(params, *args, **kwargs)
 
     def bind(self, params) -> Callable:
         """Functional view for use inside traced step functions."""

@@ -82,7 +82,13 @@ def dot_product_attention(
     """Reference attention, fully materialized scores. XLA fuses this well for
     moderate sequence lengths; use the Pallas flash kernel (ops/flash_attention)
     for long sequences on TPU. ``softcap``: Gemma-2 tanh score capping
-    (softcap * tanh(scores / softcap)), applied before any masking."""
+    (softcap * tanh(scores / softcap)), applied before any masking.
+
+    ``window`` uses the Mistral convention ``0 <= q_pos - k_pos < window``
+    for every engine (dense/blockwise/flash/ring/Ulysses): the lower bound
+    applies EVEN WITH ``causal=False``, so a windowed query never attends
+    to future keys. There is no symmetric/two-sided window mode; pass a
+    ``bias`` for bidirectional locality patterns."""
     b, sq, h, d = q.shape
     n_rep = h // k.shape[2]
     k = repeat_kv(k, n_rep)

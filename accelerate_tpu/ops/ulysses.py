@@ -110,6 +110,41 @@ def make_ulysses_attention(
     if window is not None:
         # Ulysses attends the FULL sequence locally post head-scatter, so a
         # uniform window is just the inner attention's window
+        if inner is not None:
+            import inspect
+
+            if (
+                isinstance(inner, functools.partial)
+                and "window" in inner.keywords
+            ):
+                raise TypeError(
+                    "make_ulysses_attention(window=...) would re-bind "
+                    "`window` already bound in the partial inner — pass the "
+                    "window through ONE of the two, not both"
+                )
+            try:
+                sig = inspect.signature(inner)
+            except (ValueError, TypeError):
+                # non-introspectable callable (C extension): assume it
+                # accepts `window` rather than rejecting a valid inner
+                sig = None
+            accepts_window = sig is None or any(
+                (
+                    p.name == "window"
+                    and p.kind in (
+                        inspect.Parameter.POSITIONAL_OR_KEYWORD,
+                        inspect.Parameter.KEYWORD_ONLY,
+                    )
+                )
+                or p.kind is inspect.Parameter.VAR_KEYWORD
+                for p in sig.parameters.values()
+            )
+            if not accepts_window:
+                raise TypeError(
+                    "make_ulysses_attention(window=...) with a custom inner "
+                    "requires the inner attention to accept a `window` "
+                    f"keyword; {getattr(inner, '__name__', inner)!r} does not"
+                )
         base_inner = functools.partial(
             inner or functools.partial(blockwise_attention, kv_block=512),
             window=window,

@@ -15,6 +15,8 @@ when the parameter is big enough, else replicate.
 
 from __future__ import annotations
 
+import contextlib as _contextlib
+import contextvars as _contextvars
 import re
 from typing import Any, Optional, Sequence
 
@@ -226,20 +228,51 @@ def _in_manual_region() -> bool:
         return False
 
 
+# (fsdp_axes, min_weight_size) scoped to the model whose apply is running —
+# set by Model._mp_apply so multi-model setups with different fsdp configs
+# do not cross-pin (ADVICE r4: process-global "last prepare wins" hints).
+_MODEL_FSDP_HINTS: _contextvars.ContextVar = _contextvars.ContextVar(
+    "model_fsdp_hints", default=None
+)
+
+
+@_contextlib.contextmanager
+def model_fsdp_hints(hints):
+    """Scope per-model (fsdp_axes, min_weight_size) gather-pin hints for the
+    duration of a model apply/trace. ``hints=None`` is a no-op passthrough."""
+    if hints is None:
+        yield
+        return
+    token = _MODEL_FSDP_HINTS.set(tuple(hints))
+    try:
+        yield
+    finally:
+        _MODEL_FSDP_HINTS.reset(token)
+
+
 def _fsdp_use_hints(mesh: Mesh):
-    """(active fsdp axes, min weight size) for use-time gather pinning,
-    read from the live AcceleratorState — prepare_model records the actual
-    config. Nothing recorded (bare shard_params / rules-only meshes) means
-    NO storage pin: pinning a weight that is not actually fsdp-sharded
-    would force a pointless reshard+gather round trip. The hints are a
-    process-global performance hint only (last prepare_model wins) — a
-    stale hint can cost layout efficiency but never correctness, since
-    sharding constraints never change values."""
+    """(active fsdp axes, min weight size) for use-time gather pinning.
+
+    Resolution order: the per-model hints scoped by :func:`model_fsdp_hints`
+    (Model._mp_apply enters it with the config THIS model was prepared
+    under — so two models prepared with different fsdp configs each pin
+    gathers to their own storage spec), then the live AcceleratorState
+    (prepare_model records the last config — covers stage fns and other
+    paths that bypass Model apply). Nothing recorded (bare shard_params /
+    rules-only meshes) means NO storage pin: pinning a weight that is not
+    actually fsdp-sharded would force a pointless reshard+gather round
+    trip. Hints are a performance hint only — a stale hint can cost layout
+    efficiency but never correctness, since sharding constraints never
+    change values."""
     from ..state import AcceleratorState
 
-    st = AcceleratorState._shared_state
-    axes = st.get("fsdp_axes") or ()
-    minw = st.get("fsdp_min_weight_size", 2**10)
+    scoped = _MODEL_FSDP_HINTS.get()
+    if scoped is not None:
+        axes, minw = scoped
+    else:
+        st = AcceleratorState._shared_state
+        axes = st.get("fsdp_axes") or ()
+        minw = st.get("fsdp_min_weight_size", 2**10)
     return tuple(a for a in axes if mesh.shape.get(a, 1) > 1), minw
 
 

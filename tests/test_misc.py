@@ -345,3 +345,77 @@ def test_fsdp_plugin_activation_checkpointing():
     model = create_llama(cfg)
     model = acc.prepare(model)
     assert model.config.remat_policy == "minimal"
+
+
+def test_tpu_configured_probe(monkeypatch):
+    """ADVICE r4: _tpu_configured must detect a bare TPU-VM host (TPU device
+    nodes present, no TPU env vars) and must honor an explicit non-TPU
+    JAX_PLATFORMS as the fork opt-out."""
+    import glob
+
+    from accelerate_tpu import launchers
+
+    for var in ("JAX_PLATFORMS", "PALLAS_AXON_POOL_IPS", "TPU_NAME"):
+        monkeypatch.delenv(var, raising=False)
+    # bare TPU-VM host: device nodes present, no env vars
+    monkeypatch.setattr(
+        glob, "glob", lambda pat: ["/dev/accel0"] if "accel" in pat else []
+    )
+    assert launchers._tpu_configured() is True
+    # explicit cpu platforms wins over hardware presence
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    assert launchers._tpu_configured() is False
+    monkeypatch.setenv("JAX_PLATFORMS", "tpu")
+    assert launchers._tpu_configured() is True
+    # CPU-only host with libtpu pip-installed: NOT TPU-configured
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    monkeypatch.setattr(glob, "glob", lambda pat: [])
+    assert launchers._tpu_configured() is False
+
+
+def test_model_scoped_fsdp_hints():
+    """ADVICE r4: gather pins read the hints of the model whose apply is
+    running, not whichever model was prepared last."""
+    import jax
+    from jax.sharding import Mesh
+
+    from accelerate_tpu.parallel import sharding as sh
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(8), ("dp_shard",))
+    from accelerate_tpu.state import AcceleratorState
+
+    AcceleratorState._shared_state["fsdp_axes"] = ("dp_shard",)
+    AcceleratorState._shared_state["fsdp_min_weight_size"] = 2**10
+    try:
+        # global fallback
+        assert sh._fsdp_use_hints(mesh) == (("dp_shard",), 2**10)
+        # scoped hints win while the model apply is in flight
+        with sh.model_fsdp_hints(((), 2**20)):
+            assert sh._fsdp_use_hints(mesh) == ((), 2**20)
+        # and restore on exit
+        assert sh._fsdp_use_hints(mesh) == (("dp_shard",), 2**10)
+    finally:
+        AcceleratorState._shared_state.pop("fsdp_axes", None)
+        AcceleratorState._shared_state.pop("fsdp_min_weight_size", None)
+
+
+def test_ulysses_custom_inner_window_signature():
+    """ADVICE r4: a custom inner that cannot accept `window` fails with a
+    clear TypeError at construction, not a confusing one at trace time."""
+    import jax
+    from jax.sharding import Mesh
+
+    from accelerate_tpu.ops.ulysses import make_ulysses_attention
+
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(4), ("sp",))
+
+    def windowless_inner(q, k, v, causal=True, segment_ids=None):
+        return q
+
+    with pytest.raises(TypeError, match="window"):
+        make_ulysses_attention(mesh, inner=windowless_inner, window=64)
+
+    def windowed_inner(q, k, v, causal=True, segment_ids=None, window=None):
+        return q
+
+    make_ulysses_attention(mesh, inner=windowed_inner, window=64)
