@@ -344,6 +344,49 @@ def interleave_permutation(num_layers: int, n: int, v: int) -> np.ndarray:
     return np.asarray(perm, np.int64)
 
 
+def make_layout_converters(num_layers: int, n: int, v: int):
+    """(to_interleaved, to_canonical) pytree converters for the pre-permuted
+    interleaved layout.
+
+    Work on ANY pytree whose ``layers`` subtrees stack the layer dim first —
+    the params tree, gradient trees, and adam-style optimizer state (mu/nu
+    mirror the param tree). A leaf is permuted iff its tree path contains a
+    ``layers`` key and its leading dim equals ``num_layers``; everything
+    else (io params, scalars, counts) passes through. Each permuted leaf is
+    constrained back to ITS OWN input sharding, so the conversion is a pure
+    cross-device row exchange over pp that preserves tp/fsdp layouts — paid
+    once at layout adoption, not per step."""
+    perm = interleave_permutation(num_layers, n, v)
+    inv_perm = np.argsort(perm)
+
+    def _convert(tree, idx):
+        # eager on purpose: runs once per layout adoption (first step /
+        # params read), and eager leaves expose their concrete sharding so
+        # the row exchange can land back on each leaf's own layout
+        def leaf(key_path, a):
+            in_layers = any(
+                getattr(k, "key", getattr(k, "name", None)) == "layers"
+                for k in key_path
+            )
+            if not (
+                in_layers
+                and getattr(a, "ndim", 0) >= 1
+                and a.shape[0] == num_layers
+            ):
+                return a
+            out = jnp.take(a, idx, axis=0)
+            sh = getattr(a, "sharding", None)
+            if sh is not None and getattr(sh, "mesh", None) is not None:
+                out = jax.device_put(out, sh)
+            return out
+
+        return jax.tree_util.tree_map_with_path(leaf, tree)
+
+    to_interleaved = lambda t: _convert(t, perm)  # noqa: E731
+    to_canonical = lambda t: _convert(t, inv_perm)  # noqa: E731
+    return to_interleaved, to_canonical
+
+
 def make_interleaved_1f1b_value_and_grad(
     mesh: Mesh,
     num_microbatches: int,
@@ -351,10 +394,19 @@ def make_interleaved_1f1b_value_and_grad(
     pp_axis: str = "pp",
     batch_axes=("dp_replicate", "dp_shard"),
     seq_axes=("cp", "sp"),
+    pre_permuted: bool = False,
 ) -> Callable:
     """Interleaved-1F1B counterpart of
     :func:`parallel.pp_1f1b.make_1f1b_value_and_grad` — same vag signature
-    and loss/grad semantics, ``v``-way virtual stages per device."""
+    and loss/grad semantics, ``v``-way virtual stages per device.
+
+    ``pre_permuted=True``: the caller keeps ``stage_params`` (and therefore
+    grads, accumulators, optimizer state) in device-major interleaved row
+    order across steps, so the per-step canonical→interleaved param
+    all-to-all and its inverse on the grads disappear from the compiled
+    program (Accelerator.train_step adopts the layout via the Model's
+    packed-params mechanism and un-permutes lazily when ``model.params`` is
+    read at checkpoint/eval/HF-interop boundaries)."""
     n = mesh.shape[pp_axis]
     v = num_virtual_stages
     m = num_microbatches
@@ -371,20 +423,24 @@ def make_interleaved_1f1b_value_and_grad(
                 f"{num_layers} layers not divisible by pp*virtual ({n}*{v})"
             )
         lc = num_layers // (n * v)
-        perm = interleave_permutation(num_layers, n, v)
-        inv_perm = np.argsort(perm)
 
         spec_stage = jax.tree_util.tree_map(lambda _: P(pp_axis), stage_params)
         stage_sharding = jax.tree_util.tree_map(
             lambda _: NamedSharding(mesh, P(pp_axis)), stage_params
         )
-        # canonical -> interleaved rows (cross-device: one param all-to-all)
-        stage_il = jax.tree_util.tree_map(
-            lambda a, sh: jax.lax.with_sharding_constraint(
-                jnp.take(a, perm, axis=0), sh
-            ),
-            stage_params, stage_sharding,
-        )
+        if pre_permuted:
+            stage_il = stage_params
+        else:
+            perm = interleave_permutation(num_layers, n, v)
+            inv_perm = np.argsort(perm)
+            # canonical -> interleaved rows (cross-device: one param
+            # all-to-all each way per step — the pre_permuted path removes it)
+            stage_il = jax.tree_util.tree_map(
+                lambda a, sh: jax.lax.with_sharding_constraint(
+                    jnp.take(a, perm, axis=0), sh
+                ),
+                stage_params, stage_sharding,
+            )
 
         micro = shard_microbatches(mesh, batch, m, batch_axes, seq_axes)
         tables = jnp.asarray(tables_np)  # (n, T, 16), sharded P(pp) below
@@ -532,6 +588,8 @@ def make_interleaved_1f1b_value_and_grad(
             tables, stage_il, io_params, micro,
             jnp.asarray(loss_denom, jnp.float32),
         )
+        if pre_permuted:
+            return loss, g_stage_il, g_io
         # interleaved -> canonical grad rows (the inverse all-to-all)
         g_stage = jax.tree_util.tree_map(
             lambda a, sh: jax.lax.with_sharding_constraint(

@@ -283,3 +283,44 @@ def test_interleaved_1f1b_masked_labels_match_dp():
     )
     np.testing.assert_allclose(l_pp, l_ref, atol=1e-5)
     np.testing.assert_allclose(w_pp, w_ref, atol=1e-5)
+
+
+@pytest.mark.slow
+def test_interleaved_prepermuted_adam_state_roundtrip():
+    """Pre-permuted interleaved layout with ADAM: mu/nu live in interleaved
+    row order across steps (make_layout_converters permutes opt-state
+    subtrees too) and reads canonicalize — trajectory AND first-moment
+    parity against dp-only."""
+    rng = np.random.default_rng(0)
+    data = {"input_ids": rng.integers(0, 256, size=(8, 32)).astype(np.int32)}
+    cfg = LlamaConfig.tiny(num_hidden_layers=8, compute_dtype=jnp.float32)
+
+    def run(pcfg, steps=3):
+        _reset()
+        acc = Accelerator(parallelism_config=pcfg)
+        model, opt = acc.prepare(create_llama(cfg, seed=0), optax.adamw(1e-3))
+        step = acc.train_step(llama_loss, max_grad_norm=None)
+        loader = acc.prepare_data_loader(data, batch_size=8, drop_last=True)
+        losses = []
+        for _ in range(steps):
+            for batch in loader:
+                losses.append(float(step(batch)))
+        w = np.asarray(
+            jax.device_get(model.params["layers"]["attn"]["q_proj"]["kernel"])
+        )
+        mu = np.asarray(jax.device_get(jax.tree_util.tree_leaves(
+            [s for s in opt.opt_state if hasattr(s, "mu")][0]
+            .mu["layers"]["attn"]["q_proj"]
+        )[0]))
+        return w, losses, mu
+
+    w_ref, l_ref, mu_ref = run(ParallelismConfig(dp_shard_size=8))
+    w_pp, l_pp, mu_pp = run(ParallelismConfig(
+        pp_size=2, dp_shard_size=4,
+        pp_config=PipelineParallelConfig(
+            num_microbatches=4, schedule="1f1b", num_virtual_stages=2
+        ),
+    ))
+    np.testing.assert_allclose(l_pp, l_ref, atol=1e-4)
+    np.testing.assert_allclose(w_pp, w_ref, atol=1e-4)
+    np.testing.assert_allclose(mu_pp, mu_ref, atol=1e-4)
