@@ -299,8 +299,273 @@ def ici_bytes_per_chip(collectives) -> float:
     return total
 
 
+def compile_and_extract_spmd(lowered, prefix="hlo_report_", want_dump=True):
+    """Compile with the SPMD-pass dump and return (compiled, hlo_text) —
+    the post-partitioning module when the dump is available, else the
+    final optimized text (CPU-legalized; dtype/RS info degraded). Shared by
+    the train and decode reports so dump/selection fixes apply once."""
+    import glob as _glob
+    import tempfile
+
+    if not want_dump:
+        return lowered.compile(), None
+    dump_dir = tempfile.mkdtemp(prefix=prefix)
+    try:
+        compiled = lowered.compile(
+            {"xla_dump_to": dump_dir, "xla_dump_hlo_pass_re": "spmd.*"}
+        )
+    except Exception:  # older jax: no compiler options
+        compiled = lowered.compile()
+    spmd = sorted(
+        _glob.glob(os.path.join(dump_dir, "*after_spmd-partitioning*"))
+    )
+    if spmd:
+        with open(spmd[-1]) as f:
+            return compiled, f.read()
+    return compiled, None
+
+
+def build_decode(size: str, devices: int, batch: int, context: int, tp: int):
+    """AOT-lowerable prefill + single-token decode programs for the
+    generation path (inference.py generate: one compiled prefill, then a
+    scanned decode step) on an abstract (shape-only) model sharded over the
+    mesh. ``batch`` is the GLOBAL batch (the caller scales per-chip-batch by
+    the dp width, matching train mode). Returns (config, model,
+    lowered_prefill, lowered_decode)."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.models.llama import (
+        LlamaConfig,
+        create_llama,
+        llama_decode_step,
+        llama_prefill,
+    )
+    from accelerate_tpu.parallelism_config import ParallelismConfig
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    hidden, inter, layers, heads, kv, vocab = SIZES[size]
+    config = LlamaConfig(
+        vocab_size=vocab,
+        hidden_size=hidden,
+        intermediate_size=inter,
+        num_hidden_layers=layers,
+        num_attention_heads=heads,
+        num_key_value_heads=kv,
+        max_position_embeddings=context,
+        # inference weights live in the compute dtype (the serving load
+        # path casts once); the roofline reads bf16 bytes per token
+        param_dtype=jnp.bfloat16,
+    )
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+    accelerator = Accelerator(
+        parallelism_config=ParallelismConfig(
+            dp_shard_size=devices // tp, tp_size=tp
+        )
+    )
+    model = create_llama(config, abstract=True)
+    model = accelerator.prepare_model(model)
+    model.policy = None
+
+    hd = config.head_dim
+    prompt = jax.ShapeDtypeStruct((batch, context // 2), jnp.int32)
+    cache = {
+        "k": jax.ShapeDtypeStruct(
+            (layers, batch, context, kv, hd), config.compute_dtype
+        ),
+        "v": jax.ShapeDtypeStruct(
+            (layers, batch, context, kv, hd), config.compute_dtype
+        ),
+    }
+    token = jax.ShapeDtypeStruct((batch, 1), jnp.int32)
+
+    prefill = jax.jit(
+        functools.partial(llama_prefill, config), static_argnums=(2,)
+    ).lower(model.params, prompt, context)
+    decode = jax.jit(functools.partial(llama_decode_step, config)).lower(
+        model.params, cache, token, jnp.int32(0)
+    )
+    return config, model, prefill, decode
+
+
+def run_decode(args):
+    """Decode-path report: HBM-bandwidth-bound roofline for per-token
+    latency + collective inventory of the partitioned decode step. The
+    reference's published counterpart is the big_model_inference table
+    (BASELINE.md: GPT-J-6B 0.05 s/token on 2 GPUs)."""
+    import jax
+
+    t0 = time.time()
+    dp_shards = args.devices // args.tp
+    global_b = args.per_chip_batch * dp_shards
+    config, model, prefill, decode = build_decode(
+        args.size, args.devices, global_b, args.seq, args.tp
+    )
+    # prefill is compiled for memory/shape validation only (its collectives
+    # mirror the train forward's); the decode step gets the full dump+parse
+    _prefill_compiled, _ = compile_and_extract_spmd(prefill, want_dump=False)
+    decode_compiled, hlo = compile_and_extract_spmd(decode, "hlo_decode_")
+    if hlo is None:
+        hlo = decode_compiled.as_text()
+    colls, notes = parse_collectives(hlo, args.devices)
+    results = {"decode": dict(collectives=colls, notes=notes,
+                              compiled=decode_compiled)}
+    t_compile = time.time() - t0
+
+    chip = CHIPS[args.chip]
+    n = args.devices
+    b = global_b
+    L = config.num_hidden_layers
+    hd = config.head_dim
+    kvh = config.num_key_value_heads
+
+    import math as _math
+
+    param_bytes = sum(
+        int(_math.prod(p.shape)) * p.dtype.itemsize
+        for p in jax.tree_util.tree_leaves(model.params)
+    )
+    # per decode token, per chip: every (sharded) weight is read once, and
+    # the (full-context) KV cache is read once + this token written
+    kv_bytes = 2 * L * b * args.seq * kvh * hd * 2  # bf16 k+v
+    hbm_per_token = (param_bytes + kv_bytes) / n
+    # matmul FLOPs: 2*P per token per sequence, batch b rows
+    n_params = model.num_parameters
+    flops_per_token = 2 * n_params * b / n
+    ici_decode = ici_bytes_per_chip(results["decode"]["collectives"])
+
+    t_hbm = hbm_per_token / (chip["hbm_bw"] * HBM_EFF)
+    t_compute = flops_per_token / (chip["peak_bf16"] * MATMUL_EFF)
+    t_ici = ici_decode / (chip["ici_bw"] * ICI_EFF)
+    latency = max(t_hbm, t_compute, t_ici)
+    bound = {t_hbm: "hbm", t_compute: "compute", t_ici: "ici"}[latency]
+
+    # prefill: compute-bound forward over prompt_len tokens
+    prompt_len = args.seq // 2
+    from accelerate_tpu.models.llama import llama_flops_per_token
+
+    prefill_flops = (
+        llama_flops_per_token(config, prompt_len) / 3.0  # fwd share of 6ND
+        * prompt_len * b / n
+    )
+    t_prefill = max(
+        prefill_flops / (chip["peak_bf16"] * MATMUL_EFF),
+        (param_bytes / n) / (chip["hbm_bw"] * HBM_EFF),
+    )
+
+    mem = results["decode"]["compiled"].memory_analysis()
+    hbm_live = int(getattr(mem, "argument_size_in_bytes", 0)) + int(
+        getattr(mem, "temp_size_in_bytes", 0)
+    )
+
+    # reference anchor: GPT-J-6B fp16, 0.05 s/token on 2 GPUs (BASELINE.md)
+    ref_s_tok = 0.05
+    result = dict(
+        mode="decode",
+        model=dict(size=args.size, params_b=round(n_params / 1e9, 3),
+                   context=args.seq, prompt=prompt_len, global_batch=b,
+                   per_chip_batch=args.per_chip_batch,
+                   weights_dtype="bf16"),
+        mesh=dict(devices=n, tp=args.tp),
+        chip=dict(kind=args.chip, **chip),
+        compile_s=round(t_compile, 1),
+        decode_collectives=results["decode"]["collectives"],
+        collective_notes=results["decode"]["notes"],
+        hbm_bytes_per_token_per_chip=int(hbm_per_token),
+        roofline=dict(
+            t_hbm_s=t_hbm, t_compute_s=t_compute, t_ici_s=t_ici,
+            bound=bound,
+            predicted_s_per_token=latency,
+            predicted_tok_s=round(b / latency, 1),
+            predicted_prefill_s=t_prefill,
+            assumptions=dict(matmul_eff=MATMUL_EFF, ici_eff=ICI_EFF,
+                             hbm_eff=HBM_EFF),
+            calibration="ceiling; train-side calibration bounds apply "
+                        "(runs/hlo_report_index.md)",
+        ),
+        memory=dict(hbm_live_estimate=hbm_live,
+                    hbm_capacity=int(chip["hbm_bytes"]),
+                    fits=hbm_live < chip["hbm_bytes"]),
+        vs_reference=dict(
+            reference="GPT-J-6B fp16 0.05 s/token on 2 GPUs "
+                      "(BASELINE.md big_model_inference)",
+            ref_s_per_token=ref_s_tok,
+            speedup_vs_ref=round(ref_s_tok / latency, 1),
+        ),
+    )
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    with open(args.out + ".json", "w") as f:
+        json.dump(result, f, indent=1)
+    _write_decode_md(args.out + ".md", result)
+    print(json.dumps(dict(
+        predicted_s_per_token=round(latency, 6),
+        predicted_tok_s=result["roofline"]["predicted_tok_s"],
+        bound=bound, prefill_s=round(t_prefill, 4),
+        fits_hbm=result["memory"]["fits"],
+        speedup_vs_ref=result["vs_reference"]["speedup_vs_ref"],
+    )))
+
+
+def _write_decode_md(path, r):
+    roof = r["roofline"]
+    lines = [
+        "# Decode-path compile report",
+        "",
+        f"Model: llama-{r['model']['size']} ({r['model']['params_b']} B params, "
+        f"bf16 weights), context {r['model']['context']}, prompt "
+        f"{r['model']['prompt']}, global batch {r['model']['global_batch']}.",
+        f"Mesh: {r['mesh']['devices']} chip(s), tp={r['mesh']['tp']}; "
+        f"target {r['chip']['kind']}.",
+        "",
+        "Both generation programs (full-forward prefill; single-token decode"
+        " step — inference.py runs it under one compiled scan) are"
+        " AOT-lowered shape-only and compiled through the XLA pipeline;"
+        " decode collectives come from the post-SPMD-partitioning module.",
+        "",
+        "## Per-token roofline",
+        "",
+        "| component | value |",
+        "|---|---|",
+        f"| HBM bytes/token/chip | {r['hbm_bytes_per_token_per_chip']/1e9:.3f} GB |",
+        f"| t_hbm | {roof['t_hbm_s']*1e3:.2f} ms |",
+        f"| t_compute | {roof['t_compute_s']*1e3:.2f} ms |",
+        f"| t_ici | {roof['t_ici_s']*1e3:.2f} ms |",
+        f"| bound | {roof['bound']} |",
+        f"| **predicted latency** | **{roof['predicted_s_per_token']*1e3:.2f} ms/token** |",
+        f"| predicted throughput | {roof['predicted_tok_s']} tok/s |",
+        f"| predicted prefill | {roof['predicted_prefill_s']*1e3:.1f} ms |",
+        f"| fits HBM | {r['memory']['fits']} |",
+        "",
+        f"Reference anchor: {r['vs_reference']['reference']} — predicted "
+        f"**{r['vs_reference']['speedup_vs_ref']}x** faster per token. "
+        f"({roof['calibration']})",
+        "",
+        "## Decode-step collectives",
+        "",
+        "| op | dtype | bytes | group | count |",
+        "|---|---|---|---|---|",
+    ]
+    for c in r["decode_collectives"]:
+        lines.append(
+            f"| {c['op']} | {c['dtype']} | {c['bytes']:,} | {c['group']} "
+            f"| {c['count']} |"
+        )
+    for note in r["collective_notes"]:
+        lines.append(f"- note: {note}")
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+
+
 def main():
     ap = argparse.ArgumentParser()
+    ap.add_argument("--mode", default="train", choices=("train", "decode"),
+                    help="train = fused train_step report; decode = "
+                    "generation (prefill + per-token) report")
     ap.add_argument("--size", default="7b", choices=sorted(SIZES))
     ap.add_argument("--devices", type=int, default=16,
                     help="mesh size (v5p-32 slice = 16 chips)")
@@ -325,6 +590,10 @@ def main():
             f"need XLA_FLAGS=--xla_force_host_platform_device_count={args.devices}"
         )
 
+    if args.mode == "decode":
+        run_decode(args)
+        return
+
     t0 = time.time()
     config, model, step, batch = build_step(
         args.size, args.devices, args.per_chip_batch, args.seq, args.remat,
@@ -335,32 +604,16 @@ def main():
     print(f"lowered in {t_lower:.1f}s; compiling (SPMD partition + optimize)...",
           flush=True)
     t0 = time.time()
-    import tempfile
-
-    dump_dir = tempfile.mkdtemp(prefix="hlo_report_")
-    try:
-        compiled = lowered.compile(
-            {"xla_dump_to": dump_dir, "xla_dump_hlo_pass_re": "spmd.*"}
-        )
-    except Exception:  # older jax: no compiler options — optimized HLO only
-        compiled = lowered.compile()
-    t_compile = time.time() - t0
-    print(f"compiled in {t_compile:.1f}s", flush=True)
-
     # Collectives are read from the module RIGHT AFTER SPMD partitioning:
     # the final CPU module legalizes them away from what TPU runs
     # (FloatNormalization promotes bf16 collectives to f32,
     # ReduceScatterDecomposer rewrites reduce-scatter as all-reduce+slice).
-    import glob as _glob
+    compiled, hlo = compile_and_extract_spmd(lowered)
+    t_compile = time.time() - t0
+    print(f"compiled in {t_compile:.1f}s", flush=True)
 
-    spmd_files = sorted(
-        _glob.glob(os.path.join(dump_dir, "*after_spmd-partitioning*"))
-    )
     hlo_src = "post-spmd-partitioning"
-    if spmd_files:
-        with open(spmd_files[-1]) as f:
-            hlo = f.read()
-    else:
+    if hlo is None:
         hlo = compiled.as_text()
         hlo_src = "final-optimized (CPU-legalized; dtype/RS info degraded)"
     collectives, notes = parse_collectives(hlo, args.devices)
