@@ -470,13 +470,21 @@ class Accelerator:
             model = Model(model[0], model[1])
         if model.policy is None and self.state.mixed_precision != "no":
             model.policy = self.policy
-        if self.state.mixed_precision == "fp8" and hasattr(
-            getattr(model, "config", None), "use_fp8"
-        ):
-            # fp8 projections in-model (ops/fp8.py); the bf16 policy still
-            # covers non-matmul math (reference picks AO→TE→MSAMP here,
-            # accelerator.py:487-503 — one native path instead)
-            model.config.use_fp8 = True
+        if self.state.mixed_precision == "fp8":
+            if hasattr(getattr(model, "config", None), "use_fp8"):
+                # fp8 projections in-model (ops/fp8.py); the bf16 policy
+                # still covers non-matmul math (reference picks AO→TE→MSAMP
+                # here, accelerator.py:487-503 — one native path instead)
+                model.config.use_fp8 = True
+            else:
+                # arbitrary user models: rewrite Linear-shaped dots in the
+                # traced program to the fp8 path — the prepare-level
+                # analogue of reference convert_model (utils/ao.py,
+                # utils/transformer_engine.py), which swaps nn.Linear
+                # modules for Float8Linear/te.Linear
+                from .ops.fp8 import fp8_rewrite
+
+                model.apply_fn = fp8_rewrite(model.apply_fn)
 
         from .parallel.sharding import infer_shardings, apply_shardings
         from .parallel.tp import tensor_parallel_rules
@@ -1056,7 +1064,28 @@ class Accelerator:
             except Exception:
                 return grads
 
-        def fused(params, opt_state, accum, count, scaler_state, *batch):
+        # PowerSGD comm hook: low-rank-compressed gradient reduction over
+        # the dp_replicate (DCN) axis — reference POWER_SGD hook family
+        # (utils/dataclasses.py:136-242). ops/powersgd.py holds the math.
+        psgd_rank = None
+        if self.ddp_handler is not None and self.ddp_handler.comm_hook == "powersgd":
+            world = (self.mesh.shape.get("dp_replicate", 1)
+                     if self.mesh is not None else 1)
+            if world < 2:
+                raise ValueError(
+                    "comm_hook='powersgd' compresses the dp_replicate "
+                    "gradient reduction — the mesh has no dp_replicate axis "
+                    f"(size {world}); use dp_replicate_size >= 2"
+                )
+            if self.parallelism_config.pp_enabled:
+                raise ValueError(
+                    "comm_hook='powersgd' does not compose with pipeline "
+                    "parallelism (the schedules own the backward); drop pp "
+                    "or the hook"
+                )
+            psgd_rank = self.ddp_handler.powersgd_rank
+
+        def fused(params, opt_state, accum, count, scaler_state, psgd_state, *batch):
             def wrapped(p):
                 out = loss_fn(model.bind(p), *batch)
                 loss, aux = out if isinstance(out, tuple) else (out, None)
@@ -1067,6 +1096,28 @@ class Accelerator:
                 scale = scaler_state["scale"] if use_scaler else jnp.float32(1.0)
                 loss, grads = _pipeline_grads(params, scale, batch)
                 _aux = None
+            elif psgd_rank is not None:
+                from .ops.powersgd import make_powersgd_grad_fn
+
+                def local_grad(p, *b):
+                    def wrapped_local(pl):
+                        out = loss_fn(model.bind(pl), *b)
+                        loss, aux = out if isinstance(out, tuple) else (out, None)
+                        scale = (scaler_state["scale"] if use_scaler
+                                 else jnp.float32(1.0))
+                        return loss * scale / k, (loss, aux)
+
+                    (_, (loss, aux)), grads = jax.value_and_grad(
+                        wrapped_local, has_aux=True
+                    )(p)
+                    return loss, aux, grads
+
+                psgd_fn = make_powersgd_grad_fn(
+                    self.mesh, local_grad, params, psgd_rank
+                )
+                loss, _aux, grads, psgd_state = psgd_fn(
+                    params, psgd_state, *batch
+                )
             else:
                 (_, (loss, _aux)), grads = jax.value_and_grad(wrapped, has_aux=True)(params)
             if grad_comm_dtype is not None:
@@ -1134,7 +1185,8 @@ class Accelerator:
                 params, opt_state, accum, scaler_state = apply_branch(
                     (params, opt_state, accum, scaler_state)
                 )
-            return params, opt_state, accum, new_count % (k if k > 1 else 1), scaler_state, loss
+            return (params, opt_state, accum, new_count % (k if k > 1 else 1),
+                    scaler_state, psgd_state, loss)
 
         if use_flat:
             from .utils.flatbuf import build_pack_spec, pack_tree, unpack_tree
@@ -1146,12 +1198,12 @@ class Accelerator:
                 dtype_of=(lambda p: grad_comm_dtype) if grad_comm_dtype is not None else None,
             )
 
-            def core(pp, po, pa, count, scaler_state, *batch):
+            def core(pp, po, pa, count, scaler_state, psgd_state, *batch):
                 params = unpack_tree(param_spec, pp)
                 opt_state = unpack_tree(opt_spec, po)
                 accum = unpack_tree(accum_spec, pa)
-                params, opt_state, accum, count, scaler_state, loss = fused(
-                    params, opt_state, accum, count, scaler_state, *batch
+                params, opt_state, accum, count, scaler_state, psgd_state, loss = fused(
+                    params, opt_state, accum, count, scaler_state, psgd_state, *batch
                 )
                 return (
                     pack_tree(param_spec, params),
@@ -1159,6 +1211,7 @@ class Accelerator:
                     pack_tree(accum_spec, accum),
                     count,
                     scaler_state,
+                    psgd_state,
                     loss,
                 )
 
@@ -1171,23 +1224,25 @@ class Accelerator:
 
         if multi_step:
 
-            def multi(params, opt_state, accum, count, scaler_state, *batches):
+            def multi(params, opt_state, accum, count, scaler_state, psgd_state, *batches):
                 def body(carry, batch):
-                    params, opt_state, accum, count, scaler_state = carry
-                    params, opt_state, accum, count, scaler_state, loss = core(
-                        params, opt_state, accum, count, scaler_state, *batch
+                    params, opt_state, accum, count, scaler_state, psgd_state = carry
+                    params, opt_state, accum, count, scaler_state, psgd_state, loss = core(
+                        params, opt_state, accum, count, scaler_state, psgd_state, *batch
                     )
-                    return (params, opt_state, accum, count, scaler_state), loss
+                    return (params, opt_state, accum, count, scaler_state, psgd_state), loss
 
-                (params, opt_state, accum, count, scaler_state), losses = jax.lax.scan(
-                    body, (params, opt_state, accum, count, scaler_state), batches
+                (params, opt_state, accum, count, scaler_state, psgd_state), losses = jax.lax.scan(
+                    body, (params, opt_state, accum, count, scaler_state, psgd_state), batches
                 )
-                return params, opt_state, accum, count, scaler_state, losses
+                return params, opt_state, accum, count, scaler_state, psgd_state, losses
 
             target = multi
         else:
             target = core
-        donate_args = (0, 1, 2) if donate else ()
+        # arg 5 is the powersgd state (error feedback is param-sized); an
+        # empty dict when the hook is off, so donating it is always safe
+        donate_args = (0, 1, 2, 5) if donate else ()
         compiled = jax.jit(target, donate_argnums=donate_args)
 
         accum_dtype_of = (
@@ -1210,10 +1265,26 @@ class Accelerator:
             accum_init = jax.tree_util.tree_map(
                 lambda p: jnp.zeros(p.shape, dtype=accum_dtype_of(p)), model.params
             )
+        if psgd_rank is not None:
+            from .ops.powersgd import init_powersgd_state
+
+            world = self.mesh.shape["dp_replicate"]
+            if abstract_mode:
+                psgd_init = jax.eval_shape(
+                    lambda p: init_powersgd_state(p, psgd_rank, world),
+                    model.params,
+                )
+            else:
+                psgd_init = init_powersgd_state(
+                    model.params, psgd_rank, world, mesh=self.mesh
+                )
+        else:
+            psgd_init = {}
         state = {
             "accum": accum_init,
             "count": jnp.int32(0),
             "scaler": self.scaler.state if use_scaler else {"scale": jnp.float32(1.0), "good_steps": jnp.int32(0)},
+            "psgd": psgd_init,
         }
 
         def step(*batch):
@@ -1246,12 +1317,13 @@ class Accelerator:
                 in_params, in_opt = pp, po
             else:
                 in_params, in_opt = model.params, optimizer.opt_state
-            params, opt_state, accum, count, scaler_state, loss = compiled(
+            params, opt_state, accum, count, scaler_state, psgd_state, loss = compiled(
                 in_params,
                 in_opt,
                 state["accum"],
                 state["count"],
                 state["scaler"],
+                state["psgd"],
                 *batch,
             )
             if use_flat:
@@ -1266,6 +1338,7 @@ class Accelerator:
                 model.params = params
                 optimizer.opt_state = opt_state
             state["accum"], state["count"], state["scaler"] = accum, count, scaler_state
+            state["psgd"] = psgd_state
             if use_scaler:
                 self.scaler.state = scaler_state
             optimizer._step_count += 1
@@ -1291,7 +1364,7 @@ class Accelerator:
                 in_params, in_opt = model.params, optimizer.opt_state
             return compiled.lower(
                 in_params, in_opt, state["accum"], state["count"],
-                state["scaler"], *batch,
+                state["scaler"], state["psgd"], *batch,
             )
 
         step.jitted = compiled

@@ -17,10 +17,12 @@ from __future__ import annotations
 import functools
 from typing import Optional
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 
-__all__ = ["fp8_dot", "quantize_e4m3", "quantize_e5m2", "Fp8Config"]
+__all__ = ["fp8_dot", "fp8_rewrite", "quantize_e4m3", "quantize_e5m2", "Fp8Config"]
 
 E4M3_MAX = 448.0
 E5M2_MAX = 57344.0
@@ -93,3 +95,196 @@ class Fp8Config:
         if self.use_fp8_dots and w.shape[0] >= self.min_dim and w.shape[-1] >= self.min_dim:
             return fp8_dot(x, w)
         return x @ w
+
+
+# --------------------------------------------------------------- fp8_rewrite
+def _transpose_for_matmul(lhs, rhs, dimension_numbers):
+    """Normalize a no-batch single-contraction dot_general to (..., K) @ (K, N)
+    form. Returns (x, w, out_perm_inverse_shape_fn) or None if unsupported."""
+    (lc, rc), (lb, rb) = dimension_numbers
+    if lb or rb or len(lc) != 1 or len(rc) != 1:
+        return None
+    lck, rck = lc[0], rc[0]
+    # lhs: move contracting dim last
+    l_perm = [d for d in range(lhs.ndim) if d != lck] + [lck]
+    # rhs: move contracting dim first
+    r_perm = [rck] + [d for d in range(rhs.ndim) if d != rck]
+    x = jnp.transpose(lhs, l_perm)
+    w = jnp.transpose(rhs, r_perm)
+    if w.ndim != 2:
+        # fold trailing rhs dims into one N column block
+        n = int(np.prod(w.shape[1:]))
+        w2 = w.reshape(w.shape[0], n)
+        return x, w2, w.shape[1:]
+    return x, w, (w.shape[1],)
+
+
+def _fp8_dot_general(lhs, rhs, dimension_numbers, min_dim: int):
+    norm = _transpose_for_matmul(lhs, rhs, dimension_numbers)
+    if norm is None:
+        return None
+    x, w, out_tail = norm
+    if x.shape[-1] < min_dim or int(np.prod(out_tail)) < min_dim:
+        return None
+    if x.dtype not in (jnp.bfloat16, jnp.float32, jnp.float16):
+        return None
+    out = fp8_dot(x, w)
+    return out.reshape(*x.shape[:-1], *out_tail)
+
+
+_REWRITE_HOPS = {"pjit", "jit", "custom_vjp_call", "custom_jvp_call"}
+
+
+def fp8_rewrite(fn, min_dim: int = 256):
+    """Rewrite qualifying matmuls in ANY jax function to the fp8 path.
+
+    The prepare-level analogue of the reference's ``convert_model``
+    (utils/ao.py convert_to_float8_training / utils/transformer_engine.py
+    convert_model, which swap nn.Linear for Float8Linear/te.Linear): traces
+    ``fn`` to a jaxpr and re-evaluates it with every no-batch,
+    single-contraction ``dot_general`` over float operands (K and N both
+    >= ``min_dim`` — Linear-shaped, so attention einsums with batch dims
+    stay bf16, exactly like Float8Linear) replaced by :func:`fp8_dot`,
+    whose custom VJP quantizes gradients to e5m2. Recurses through
+    pjit/remat/scan/while/cond sub-jaxprs; unknown higher-order primitives
+    are left unrewritten (their dots stay bf16 — a no-op, never an error).
+
+    Because the rewrite happens at trace time, it composes with jit, grad,
+    and the fused train_step (the custom VJP carries the backward)."""
+    import jax
+
+    def _eval(jaxpr, consts, *args):
+        env = {}
+
+        def read(v):
+            return v.val if hasattr(v, "val") else env[v]
+
+        def write(v, val):
+            env[v] = val
+
+        for v, c in zip(jaxpr.constvars, consts):
+            write(v, c)
+        for v, a in zip(jaxpr.invars, args):
+            write(v, a)
+        for eqn in jaxpr.eqns:
+            invals = [read(v) for v in eqn.invars]
+            prim = eqn.primitive.name
+            out = None
+            if prim == "dot_general":
+                out = _fp8_dot_general(
+                    invals[0], invals[1], eqn.params["dimension_numbers"],
+                    min_dim,
+                )
+                if out is not None:
+                    out = [out.astype(eqn.outvars[0].aval.dtype)]
+            elif prim == "scan":
+                closed = eqn.params["jaxpr"]
+                nc = eqn.params["num_consts"]
+                ncar = eqn.params["num_carry"]
+                body_consts = invals[:nc]
+                init = invals[nc:nc + ncar]
+                xs = invals[nc + ncar:]
+
+                def body(carry, x):
+                    res = _eval(
+                        closed.jaxpr, closed.consts,
+                        *body_consts, *carry, *x,
+                    )
+                    return tuple(res[:ncar]), tuple(res[ncar:])
+
+                carry, ys = jax.lax.scan(
+                    body, tuple(init), tuple(xs),
+                    length=eqn.params.get("length"),
+                    reverse=eqn.params.get("reverse", False),
+                    unroll=eqn.params.get("unroll", 1),
+                )
+                out = list(carry) + list(ys)
+            elif prim == "while":
+                cj = eqn.params["cond_jaxpr"]
+                bj = eqn.params["body_jaxpr"]
+                cn = eqn.params["cond_nconsts"]
+                bn = eqn.params["body_nconsts"]
+                c_consts = invals[:cn]
+                b_consts = invals[cn:cn + bn]
+                init = invals[cn + bn:]
+
+                def cond_f(state):
+                    return _eval(cj.jaxpr, cj.consts, *c_consts, *state)[0]
+
+                def body_f(state):
+                    return tuple(
+                        _eval(bj.jaxpr, bj.consts, *b_consts, *state)
+                    )
+
+                out = list(jax.lax.while_loop(cond_f, body_f, tuple(init)))
+            elif prim == "cond":
+                branches = eqn.params["branches"]
+                pred, *ops = invals
+
+                def mk(br):
+                    return lambda *a: tuple(_eval(br.jaxpr, br.consts, *a))
+
+                out = list(jax.lax.switch(
+                    pred, [mk(br) for br in branches], *ops
+                ))
+            elif prim == "remat2":
+                # rewrite the body AND re-wrap in jax.checkpoint: inlining
+                # via _eval alone would silently strip the rematerialization
+                # policy and blow up backward-pass memory
+                body = eqn.params["jaxpr"]
+
+                def remat_body(*a, _body=body):
+                    return tuple(_eval(_body, (), *a))
+
+                out = list(jax.checkpoint(
+                    remat_body,
+                    policy=eqn.params.get("policy"),
+                    prevent_cse=eqn.params.get("prevent_cse", True),
+                )(*invals))
+            elif prim in _REWRITE_HOPS and "jaxpr" in eqn.params:
+                closed = eqn.params["jaxpr"]
+                inner = closed.jaxpr if hasattr(closed, "jaxpr") else closed
+                iconsts = getattr(closed, "consts", ())
+                if prim in ("custom_vjp_call", "custom_jvp_call"):
+                    # the paired fwd/bwd rules reference the ORIGINAL body;
+                    # rewriting only the primal would desynchronize them
+                    out = eqn.primitive.bind(*invals, **eqn.params)
+                    out = out if isinstance(out, (list, tuple)) else [out]
+                else:
+                    out = list(_eval(inner, iconsts, *invals))
+            if out is None:
+                out = eqn.primitive.bind(*invals, **eqn.params)
+                if not eqn.primitive.multiple_results:
+                    out = [out]
+            for v, val in zip(eqn.outvars, out):
+                write(v, val)
+        return [read(v) for v in jaxpr.outvars]
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        # non-array leaves (python bools/ints/strings steering control flow,
+        # e.g. apply_fn(p, x, train=False)) stay STATIC: tracing them would
+        # turn `if train:` into a TracerBoolConversionError the moment fp8
+        # is enabled on a model that worked under bf16
+        leaves, treedef_in = jax.tree_util.tree_flatten((args, kwargs))
+        dyn_idx = [
+            i for i, leaf in enumerate(leaves)
+            if isinstance(leaf, (jax.Array, np.ndarray))
+        ]
+
+        def from_dynamic(dyn):
+            full = list(leaves)
+            for i, v in zip(dyn_idx, dyn):
+                full[i] = v
+            a, kw = jax.tree_util.tree_unflatten(treedef_in, full)
+            return fn(*a, **kw)
+
+        dyn = [leaves[i] for i in dyn_idx]
+        closed, shape = jax.make_jaxpr(from_dynamic, return_shape=True)(dyn)
+        out_flat = _eval(
+            closed.jaxpr, closed.consts, *jax.tree_util.tree_leaves(dyn)
+        )
+        treedef = jax.tree_util.tree_structure(shape)
+        return jax.tree_util.tree_unflatten(treedef, out_flat)
+
+    return wrapped

@@ -579,6 +579,12 @@ def main():
     ap.add_argument("--out", default="runs/hlo_report")
     ap.add_argument("--fail-below-mfu", type=float, default=None,
                     help="exit 1 if predicted MFU is below this")
+    ap.add_argument("--fp8-speedup", type=float, default=None,
+                    help="emit an fp8 variant row assuming matmuls run this "
+                    "much faster than bf16 (2.0 on fp8-MXU parts; v5e/v5p "
+                    "have no fp8 MXU so the honest value there is 1.0). "
+                    "Reference measured +25%% end-to-end on H100 "
+                    "(BASELINE.md FSDP2+ao row)")
     args = ap.parse_args()
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -676,6 +682,31 @@ def main():
         max(t_compute, t_ici, t_hbm)
     ]
 
+    fp8_variant = None
+    if args.fp8_speedup:
+        # fp8_rewrite / in-model fp8 dots quantize every Linear-shaped
+        # matmul; attention + elementwise stay bf16 and the roofline lumps
+        # them into t_compute, so scaling ALL of t_compute is an upper
+        # bound on the win (the reference's measured end-to-end +25% on
+        # H100 sits well inside it)
+        t_c8 = t_compute / args.fp8_speedup
+        st8 = max(t_c8, t_ici, t_hbm)
+        fp8_variant = dict(
+            assumed_matmul_speedup=args.fp8_speedup,
+            step_time_s=st8,
+            predicted_tok_s_chip=round(tokens_per_chip / st8, 1),
+            # normalized by the ASSUMED fp8 peak (bf16 peak x speedup) so the
+            # number stays a physical utilization fraction <= 1
+            predicted_mfu_of_fp8_peak=round(
+                useful_flops_chip
+                / (st8 * chip["peak_bf16"] * args.fp8_speedup),
+                4,
+            ),
+            speedup_vs_bf16=round(step_time / st8, 3),
+            caveat="upper bound: scales ALL compute incl. attention; "
+                   "requires an fp8-MXU part (not v5e/v5p)",
+        )
+
     result = dict(
         model=dict(size=args.size, params_b=round(n_params / 1e9, 3),
                    seq=args.seq, per_chip_batch=args.per_chip_batch,
@@ -712,18 +743,24 @@ def main():
                              hbm_eff=HBM_EFF),
         ),
     )
+    if fp8_variant is not None:
+        result["fp8_variant"] = fp8_variant
 
     os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
     with open(args.out + ".json", "w") as f:
         json.dump(result, f, indent=1)
     _write_md(args.out + ".md", result)
-    print(json.dumps(dict(
+    summary = dict(
         predicted_mfu=result["roofline"]["predicted_mfu"],
         predicted_tok_s_chip=result["roofline"]["predicted_tok_s_chip"],
         bound=bound, ici_gb=round(ici_bytes / 1e9, 2),
         recompute_fraction=result["flops"]["recompute_fraction"],
         fits_hbm=result["memory"]["fits"],
-    )))
+    )
+    if fp8_variant is not None:
+        summary["fp8_tok_s_chip"] = fp8_variant["predicted_tok_s_chip"]
+        summary["fp8_speedup_vs_bf16"] = fp8_variant["speedup_vs_bf16"]
+    print(json.dumps(summary))
     if args.fail_below_mfu and mfu_pred < args.fail_below_mfu:
         print(f"FAIL: predicted MFU {mfu_pred:.3f} < {args.fail_below_mfu}")
         sys.exit(1)
