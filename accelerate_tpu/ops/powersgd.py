@@ -1,0 +1,187 @@
+"""PowerSGD low-rank gradient compression for the data-replicate axis.
+
+TPU-native realization of the reference's
+``DDPCommunicationHookType.POWER_SGD`` (reference utils/dataclasses.py
+:136-242 + torch's ``powerSGD_hook``): in torch, a DDP bucket hook replaces
+each gradient all-reduce with reductions of rank-r factors. There is no
+bucket hook to attach under GSPMD — the partitioner inserts gradient
+reductions itself — so the native formulation makes the reduction explicit:
+the loss/grad computation runs inside a ``shard_map`` that is manual over
+``dp_replicate`` ONLY (fsdp/tp/... stay automatic inside), each replica
+computes its LOCAL gradient, and the only cross-replica traffic is
+``psum`` of the (m, r) and (n, r) factors — the DCN bytes drop from
+``m*n`` to ``r*(m+n)`` per matrix.
+
+Algorithm (Vogels et al., NeurIPS 2019 — single subspace iteration with
+error feedback, the variant torch ships):
+
+    M    = G_local + error         (error feedback folds residual back in)
+    P    = M @ Q                   ; P = psum(P) / world
+    P    = orthonormalize(P)       (thin QR)
+    Q'   = M^T @ P                 ; Q' = psum(Q') / world
+    Ghat = P @ Q'^T                (identical on every replica)
+    error' = M - Ghat              (stays local, per replica)
+
+``Q`` persists across steps (warm start). Leaves that are not 2D, or too
+small for ``r (m+n) < m n`` to pay, reduce densely (``psum``), exactly like
+torch's ``min_compression_rate`` gate. The compression is lossy; error
+feedback makes the *accumulated* update unbiased, which is what preserves
+convergence in practice (and in tests/test_powersgd.py's parity check).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = [
+    "powersgd_compressible",
+    "init_powersgd_state",
+    "powersgd_state_specs",
+    "make_powersgd_grad_fn",
+]
+
+# zero-size placeholder for non-compressible slots: keeps the state a
+# uniform pytree (None leaves vanish from jax pytrees, which would break
+# shard_map spec matching)
+_EMPTY = (0,)
+
+
+def powersgd_compressible(leaf, rank: int) -> bool:
+    """2D, floating, and big enough that rank-r factors beat dense bytes."""
+    shape = getattr(leaf, "shape", ())
+    if len(shape) != 2:
+        return False
+    if not jnp.issubdtype(getattr(leaf, "dtype", jnp.float32), jnp.floating):
+        return False
+    m, n = shape
+    return rank * (m + n) < m * n
+
+
+def init_powersgd_state(params, rank: int, world: int, seed: int = 0,
+                        mesh: Mesh = None, axis: str = "dp_replicate"):
+    """State dict: ``err`` — per-replica error feedback, global shape
+    (world, m, n) SHARDED over the replicate axis at creation (a dense
+    allocation would put world x fp32 copies of every 2D param on one
+    device — for 7B-class models that is an OOM before the first step);
+    ``q`` — warm-started (n, r) right factors, replicated (identical
+    post-psum). Zero-size placeholders fill non-compressible slots."""
+    from jax.sharding import NamedSharding
+
+    key = jax.random.key(seed)
+    err_sh = (
+        NamedSharding(mesh, P(axis)) if mesh is not None else None
+    )
+
+    def _sharded_zeros(shape):
+        if err_sh is None:
+            return jnp.zeros(shape, jnp.float32)
+        return jax.jit(
+            lambda: jnp.zeros(shape, jnp.float32), out_shardings=err_sh
+        )()
+
+    leaves, _ = jax.tree_util.tree_flatten(params)
+    errs, qs = [], []
+    for i, leaf in enumerate(leaves):
+        if powersgd_compressible(leaf, rank):
+            sub = jax.random.fold_in(key, i)
+            m, n = leaf.shape
+            qs.append(jax.random.normal(sub, (n, rank), dtype=jnp.float32))
+            errs.append(_sharded_zeros((world, m, n)))
+        else:
+            qs.append(jnp.zeros(_EMPTY, jnp.float32))
+            errs.append(jnp.zeros(_EMPTY, jnp.float32))
+    return {"err": tuple(errs), "q": tuple(qs)}
+
+
+def powersgd_state_specs(state, axis: str = "dp_replicate"):
+    """in/out specs for the state: err sharded over the replicate axis,
+    q (and placeholders) replicated."""
+    err_specs = tuple(
+        P() if e.shape == _EMPTY else P(axis) for e in state["err"]
+    )
+    q_specs = tuple(P() for _ in state["q"])
+    return {"err": err_specs, "q": q_specs}
+
+
+def _compress_leaf(g, err, q, axis: str, world: int):
+    """One PowerSGD round for a single 2D gradient. Runs inside the
+    dp_replicate-manual region; fsdp/tp shardings on ``g`` stay automatic."""
+    m32 = g.astype(jnp.float32) + err
+    p = m32 @ q
+    p = jax.lax.psum(p, axis) / world
+    # thin QR orthonormalization; r is small so this is negligible compute
+    p, _ = jnp.linalg.qr(p)
+    q_new = m32.T @ p
+    q_new = jax.lax.psum(q_new, axis) / world
+    ghat = p @ q_new.T
+    return ghat.astype(g.dtype), (m32 - ghat), q_new
+
+
+def make_powersgd_grad_fn(
+    mesh: Mesh,
+    local_grad_fn,
+    params_example,
+    rank: int,
+    axis: str = "dp_replicate",
+):
+    """Wrap ``local_grad_fn(params, *batch) -> (loss_local, aux, grads)``
+    (per-replica loss mean + UNreduced grads) into
+    ``fn(params, psgd_state, *batch) -> (loss, aux, ghat, new_state)``.
+
+    The shard_map is manual over ``axis`` only; batch leaves split their
+    leading dim across replicas (they are already row-sharded by the data
+    loader — the in_spec just names the manual share). The same XLA
+    partitioner limitation as pipelines applies: very wide automatic
+    subgroups inside a partial-manual region can hit the upstream
+    partition-group CHECK (see accelerator.check_wide_pp_limit).
+    """
+    world = mesh.shape[axis]
+    if world < 2:
+        raise ValueError(f"powersgd needs {axis} > 1 in the mesh")
+    treedef = jax.tree_util.tree_structure(params_example)
+
+    def inner(params, psgd_state, *batch):
+        loss_local, aux, grads = local_grad_fn(params, *batch)
+        loss = jax.lax.psum(loss_local, axis) / world
+
+        g_leaves = jax.tree_util.tree_leaves(grads)
+        out_g, out_e, out_q = [], [], []
+        for g, e, q in zip(g_leaves, psgd_state["err"], psgd_state["q"]):
+            if q.shape == _EMPTY:
+                out_g.append(jax.lax.psum(g, axis) / world)
+                out_e.append(e)
+                out_q.append(q)
+            else:
+                # err arrives as this replica's (1, m, n) block
+                ghat, e_new, q_new = _compress_leaf(g, e[0], q, axis, world)
+                out_g.append(ghat)
+                out_e.append(e_new[None])
+                out_q.append(q_new)
+        return (
+            loss,
+            aux,
+            jax.tree_util.tree_unflatten(treedef, out_g),
+            {"err": tuple(out_e), "q": tuple(out_q)},
+        )
+
+    def fn(params, psgd_state, *batch):
+        state_spec = powersgd_state_specs(psgd_state, axis)
+        # partial-manual shard_map: specs name ONLY the manual axis; the
+        # batch rows' dp_shard (and any cp/sp) sharding stays automatic
+        batch_spec = jax.tree_util.tree_map(lambda _: P(axis), batch)
+        mapped = jax.shard_map(
+            inner,
+            mesh=mesh,
+            in_specs=(P(), state_spec, *batch_spec),
+            out_specs=(P(), P(), P(), state_spec),
+            axis_names={axis},
+            check_vma=False,
+        )
+        return mapped(params, psgd_state, *batch)
+
+    return fn

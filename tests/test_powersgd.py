@@ -1,0 +1,189 @@
+"""PowerSGD comm-hook tests (reference DDPCommunicationHookType.POWER_SGD —
+utils/dataclasses.py:136-242; ours is ops/powersgd.py over dp_replicate)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.parallelism_config import ParallelismConfig
+from accelerate_tpu.utils.dataclasses import DistributedDataParallelKwargs
+
+
+def _reset():
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+
+
+def test_compress_exact_for_low_rank():
+    """One PowerSGD round reconstructs a rank<=r matrix EXACTLY (P spans
+    col(M) a.s. for a random warm start, and P Pᵀ M = M)."""
+    from accelerate_tpu.ops.powersgd import _compress_leaf
+
+    rng = np.random.default_rng(0)
+    r = 3
+    u = rng.normal(size=(64, r))
+    v = rng.normal(size=(r, 48))
+    m = jnp.asarray(u @ v, jnp.float32)
+    q0 = jnp.asarray(rng.normal(size=(48, r)), jnp.float32)
+
+    mesh = jax.sharding.Mesh(
+        np.array(jax.devices()[:2]).reshape(2), ("dp_replicate",)
+    )
+
+    def run(g, e, q):
+        return _compress_leaf(g, e, q, "dp_replicate", 2)
+
+    ghat, err, _q = jax.jit(
+        jax.shard_map(
+            run, mesh=mesh,
+            in_specs=(jax.sharding.PartitionSpec(),) * 3,
+            out_specs=(jax.sharding.PartitionSpec(),) * 3,
+            axis_names={"dp_replicate"}, check_vma=False,
+        )
+    )(m, jnp.zeros_like(m), q0)
+    np.testing.assert_allclose(np.asarray(ghat), np.asarray(m), atol=1e-3)
+    assert float(jnp.max(jnp.abs(err))) < 1e-3
+
+
+def test_compressible_gate():
+    from accelerate_tpu.ops.powersgd import powersgd_compressible
+
+    assert powersgd_compressible(jnp.zeros((256, 256)), 4)
+    assert not powersgd_compressible(jnp.zeros((256,)), 4)          # 1D
+    assert not powersgd_compressible(jnp.zeros((4, 4)), 4)          # too small
+    assert not powersgd_compressible(jnp.zeros((8, 8), jnp.int32), 4)
+
+
+def test_powersgd_trains_and_tracks_dense():
+    """Convergence parity on the regression fixture: the compressed run
+    decreases loss and lands near the dense run after several steps (lossy
+    per step; error feedback keeps the trajectory tracking)."""
+    from accelerate_tpu.models.llama import LlamaConfig, create_llama, llama_loss
+
+    rng = np.random.default_rng(0)
+    data = {"input_ids": rng.integers(0, 256, size=(16, 32)).astype(np.int32)}
+    cfg = LlamaConfig.tiny(num_hidden_layers=2, compute_dtype=jnp.float32)
+
+    def run(handlers):
+        _reset()
+        acc = Accelerator(
+            parallelism_config=ParallelismConfig(
+                dp_replicate_size=2, dp_shard_size=4
+            ),
+            kwargs_handlers=handlers,
+        )
+        model, opt = acc.prepare(create_llama(cfg, seed=0), optax.sgd(5e-2))
+        step = acc.train_step(llama_loss, model=model, optimizer=opt)
+        loader = acc.prepare_data_loader(data, batch_size=16, drop_last=True)
+        losses = []
+        for _ in range(8):
+            for batch in loader:
+                losses.append(float(step(batch)))
+        return losses
+
+    dense = run([])
+    psgd = run([DistributedDataParallelKwargs(comm_hook="powersgd",
+                                              powersgd_rank=8)])
+    assert all(np.isfinite(psgd))
+    assert psgd[-1] < psgd[0] * 0.8, psgd
+    # same fixture, same seed: final losses in the same neighborhood
+    assert abs(psgd[-1] - dense[-1]) < 0.25 * abs(dense[0] - dense[-1]), (
+        psgd, dense,
+    )
+
+
+def test_powersgd_cuts_replicate_bytes():
+    """Replicate-axis (DCN) traffic: with a single large weight matrix the
+    dense program all-reduces the full gradient across replicas, while the
+    powersgd program's replicate-crossing reductions move only rank-r
+    factors — an order of magnitude fewer bytes. Classified by parsing
+    replica_groups: on the (dp_replicate=2, dp_shard=4) mesh, groups whose
+    members differ by 4 cross the replicate axis."""
+    import re
+
+    rng = np.random.default_rng(0)
+    w0 = jnp.asarray(rng.normal(size=(1024, 1024)), jnp.float32) * 0.02
+    x = jnp.asarray(rng.normal(size=(16, 1024)), jnp.float32)
+    y = jnp.asarray(rng.normal(size=(16, 1024)), jnp.float32)
+
+    def crossing_bytes(handlers):
+        from accelerate_tpu.model import Model
+
+        _reset()
+        acc = Accelerator(
+            parallelism_config=ParallelismConfig(
+                dp_replicate_size=2, dp_shard_size=4
+            ),
+            kwargs_handlers=handlers,
+        )
+        model = Model(lambda p, xx: xx @ p["w"], {"w": w0})
+        model, opt = acc.prepare(model, optax.sgd(1e-2))
+
+        def loss_fn(m, batch):
+            return jnp.mean((m(batch["x"]) - batch["y"]) ** 2)
+
+        step = acc.train_step(loss_fn, model=model, optimizer=opt)
+        # shard the batch rows like the data loader would — an uncommitted
+        # batch lets GSPMD replicate it and skip the gradient reduction
+        row_sh = jax.sharding.NamedSharding(
+            acc.mesh, jax.sharding.PartitionSpec(("dp_replicate", "dp_shard"))
+        )
+        batch = {"x": jax.device_put(x, row_sh), "y": jax.device_put(y, row_sh)}
+        hlo = step.lower(batch).compile().as_text()
+        total = 0
+        for line in hlo.splitlines():
+            m = re.search(r"(all-reduce|reduce-scatter)(?:-start)?\(", line)
+            if not m:
+                continue
+            groups = re.search(r"replica_groups=\{(\{[^}]*\}(?:,\{[^}]*\})*)\}", line)
+            if groups:
+                first = [int(v) for v in
+                         groups.group(1).split("}")[0].strip("{").split(",")]
+            else:
+                it = re.search(r"replica_groups=\[(\d+),(\d+)\]<=\[(\d+)\]", line)
+                if it:  # iota [n,g]<=[8]: consecutive ids per group
+                    first = list(range(int(it.group(2))))
+                else:
+                    first = list(range(8))
+            crosses = any(abs(a - b) >= 4 for a in first for b in first)
+            if not crosses:
+                continue
+            shapes = re.findall(r"f32\[([\d,]*)\]", line.split("=")[0] + "=" +
+                                line.split("=", 1)[1].split("(")[0])
+            for dims in shapes:
+                n = 1
+                for d in (dims.split(",") if dims else []):
+                    n *= int(d)
+                total += n * 4
+        return total
+
+    dense = crossing_bytes([])
+    psgd = crossing_bytes(
+        [DistributedDataParallelKwargs(comm_hook="powersgd", powersgd_rank=4)]
+    )
+    # dense must move the (fsdp-scattered) gradient across replicas at least
+    # once — the (1024,1024) f32 grad / 4 shards = 1 MB; powersgd only the
+    # rank-4 factors (+ small QR traffic)
+    assert dense >= 1024 * 1024, dense
+    assert psgd * 4 < dense, (psgd, dense)
+
+
+def test_powersgd_requires_replicate_axis():
+    from accelerate_tpu.models.llama import LlamaConfig, create_llama, llama_loss
+
+    _reset()
+    acc = Accelerator(
+        parallelism_config=ParallelismConfig(dp_shard_size=8),
+        kwargs_handlers=[DistributedDataParallelKwargs(comm_hook="powersgd")],
+    )
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    model, opt = acc.prepare(create_llama(cfg, seed=0), optax.sgd(1e-2))
+    with pytest.raises(ValueError, match="dp_replicate"):
+        acc.train_step(llama_loss, model=model, optimizer=opt)
