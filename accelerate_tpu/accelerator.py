@@ -1269,15 +1269,11 @@ class Accelerator:
             from .ops.powersgd import init_powersgd_state
 
             world = self.mesh.shape["dp_replicate"]
-            if abstract_mode:
-                psgd_init = jax.eval_shape(
-                    lambda p: init_powersgd_state(p, psgd_rank, world),
-                    model.params,
-                )
-            else:
-                psgd_init = init_powersgd_state(
-                    model.params, psgd_rank, world, mesh=self.mesh
-                )
+            # handles abstract (ShapeDtypeStruct) params too, attaching the
+            # err shardings so step.lower/memory_analysis see the real layout
+            psgd_init = init_powersgd_state(
+                model.params, psgd_rank, world, mesh=self.mesh
+            )
         else:
             psgd_init = {}
         state = {

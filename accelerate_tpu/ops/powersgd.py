@@ -63,38 +63,60 @@ def powersgd_compressible(leaf, rank: int) -> bool:
 
 
 def init_powersgd_state(params, rank: int, world: int, seed: int = 0,
-                        mesh: Mesh = None, axis: str = "dp_replicate"):
+                        mesh: Mesh = None, axis: str = "dp_replicate",
+                        shard_axes=("dp_shard",)):
     """State dict: ``err`` — per-replica error feedback, global shape
-    (world, m, n) SHARDED over the replicate axis at creation (a dense
-    allocation would put world x fp32 copies of every 2D param on one
-    device — for 7B-class models that is an OOM before the first step);
-    ``q`` — warm-started (n, r) right factors, replicated (identical
-    post-psum). Zero-size placeholders fill non-compressible slots."""
+    (world, m, n) SHARDED over the replicate axis AND (when divisible) the
+    fsdp axes on the row dim at creation — a dense or replicate-only
+    allocation would put full fp32 copies of every 2D param on each shard
+    device, an OOM at 7B scale; ``q`` — warm-started (n, r) right factors,
+    replicated (identical post-psum). Zero-size placeholders fill
+    non-compressible slots. Abstract (ShapeDtypeStruct) params produce
+    sharding-annotated ShapeDtypeStructs (the AOT/lower path)."""
     from jax.sharding import NamedSharding
 
     key = jax.random.key(seed)
-    err_sh = (
-        NamedSharding(mesh, P(axis)) if mesh is not None else None
+    s_axes = tuple(
+        a for a in shard_axes if mesh is not None and mesh.shape.get(a, 1) > 1
     )
+    shard_n = 1
+    for a in s_axes:
+        shard_n *= mesh.shape[a]
 
-    def _sharded_zeros(shape):
-        if err_sh is None:
+    def _err_sharding(m):
+        if mesh is None:
+            return None
+        row = (s_axes if (s_axes and m % shard_n == 0) else None)
+        return NamedSharding(mesh, P(axis, row))
+
+    def _zeros(shape, sh, abstract):
+        if abstract:
+            return jax.ShapeDtypeStruct(shape, jnp.float32, sharding=sh)
+        if sh is None:
             return jnp.zeros(shape, jnp.float32)
-        return jax.jit(
-            lambda: jnp.zeros(shape, jnp.float32), out_shardings=err_sh
-        )()
+        return jax.jit(lambda: jnp.zeros(shape, jnp.float32), out_shardings=sh)()
 
     leaves, _ = jax.tree_util.tree_flatten(params)
     errs, qs = [], []
     for i, leaf in enumerate(leaves):
+        abstract = isinstance(leaf, jax.ShapeDtypeStruct)
         if powersgd_compressible(leaf, rank):
             sub = jax.random.fold_in(key, i)
             m, n = leaf.shape
-            qs.append(jax.random.normal(sub, (n, rank), dtype=jnp.float32))
-            errs.append(_sharded_zeros((world, m, n)))
+            if abstract:
+                qs.append(jax.ShapeDtypeStruct((n, rank), jnp.float32))
+            else:
+                qs.append(jax.random.normal(sub, (n, rank), dtype=jnp.float32))
+            errs.append(_zeros((world, m, n), _err_sharding(m), abstract))
         else:
-            qs.append(jnp.zeros(_EMPTY, jnp.float32))
-            errs.append(jnp.zeros(_EMPTY, jnp.float32))
+            qs.append(
+                jax.ShapeDtypeStruct(_EMPTY, jnp.float32) if abstract
+                else jnp.zeros(_EMPTY, jnp.float32)
+            )
+            errs.append(
+                jax.ShapeDtypeStruct(_EMPTY, jnp.float32) if abstract
+                else jnp.zeros(_EMPTY, jnp.float32)
+            )
     return {"err": tuple(errs), "q": tuple(qs)}
 
 
@@ -150,6 +172,17 @@ def make_powersgd_grad_fn(
         loss = jax.lax.psum(loss_local, axis) / world
 
         g_leaves = jax.tree_util.tree_leaves(grads)
+        # fp16 overflow steps (expected under a dynamic scaler) must not
+        # poison the persistent state: inf grads would write NaN into err/q
+        # FOREVER (inf - inf), while apply_branch's finite-guard only
+        # protects params/opt_state. Keep the old state on non-finite steps
+        # — the scaler backs off and retries.
+        finite = jnp.bool_(True)
+        for g in g_leaves:
+            finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(g)))
+        # any-replica overflow is a global skip (matches the dense path,
+        # where the reduced gradient would be non-finite everywhere)
+        finite = jax.lax.pmin(finite.astype(jnp.int32), axis) > 0
         out_g, out_e, out_q = [], [], []
         for g, e, q in zip(g_leaves, psgd_state["err"], psgd_state["q"]):
             if q.shape == _EMPTY:
@@ -160,8 +193,8 @@ def make_powersgd_grad_fn(
                 # err arrives as this replica's (1, m, n) block
                 ghat, e_new, q_new = _compress_leaf(g, e[0], q, axis, world)
                 out_g.append(ghat)
-                out_e.append(e_new[None])
-                out_q.append(q_new)
+                out_e.append(jnp.where(finite, e_new[None], e))
+                out_q.append(jnp.where(finite, q_new, q))
         return (
             loss,
             aux,
@@ -172,8 +205,20 @@ def make_powersgd_grad_fn(
     def fn(params, psgd_state, *batch):
         state_spec = powersgd_state_specs(psgd_state, axis)
         # partial-manual shard_map: specs name ONLY the manual axis; the
-        # batch rows' dp_shard (and any cp/sp) sharding stays automatic
-        batch_spec = jax.tree_util.tree_map(lambda _: P(axis), batch)
+        # batch rows' dp_shard (and any cp/sp) sharding stays automatic.
+        # 0-d leaves (scalar batch extras) replicate instead of splitting.
+        def _leaf_spec(leaf):
+            ndim = getattr(leaf, "ndim", 0)
+            if ndim < 1:
+                return P()
+            if leaf.shape[0] % world != 0:
+                raise ValueError(
+                    f"powersgd: batch leading dim {leaf.shape[0]} not "
+                    f"divisible by dp_replicate={world}"
+                )
+            return P(axis)
+
+        batch_spec = jax.tree_util.tree_map(_leaf_spec, batch)
         mapped = jax.shard_map(
             inner,
             mesh=mesh,
@@ -182,6 +227,10 @@ def make_powersgd_grad_fn(
             axis_names={axis},
             check_vma=False,
         )
-        return mapped(params, psgd_state, *batch)
+        # partial-manual shard_map only resolves auto-axis (fsdp) shardings
+        # on the err state under jit; eager application rejects the
+        # out_specs ("refers to 'dp_shard'"). Inside train_step's fused jit
+        # this inlines; standalone callers get a correct jitted call.
+        return jax.jit(mapped)(params, psgd_state, *batch)
 
     return fn

@@ -187,3 +187,45 @@ def test_powersgd_requires_replicate_axis():
     model, opt = acc.prepare(create_llama(cfg, seed=0), optax.sgd(1e-2))
     with pytest.raises(ValueError, match="dp_replicate"):
         acc.train_step(llama_loss, model=model, optimizer=opt)
+
+
+def test_powersgd_state_survives_overflow_and_scalar_batch():
+    """Non-finite grads (fp16 overflow steps) must not poison the persistent
+    err/q state, and 0-d batch leaves replicate instead of crashing the
+    shard_map spec."""
+    from accelerate_tpu.ops.powersgd import (
+        init_powersgd_state,
+        make_powersgd_grad_fn,
+    )
+
+    mesh = jax.sharding.Mesh(
+        np.array(jax.devices()[:8]).reshape(2, 4), ("dp_replicate", "dp_shard")
+    )
+    rng = np.random.default_rng(0)
+    params = {"w": jnp.asarray(rng.normal(size=(256, 256)), jnp.float32)}
+    x = jnp.asarray(rng.normal(size=(8, 256)), jnp.float32)
+
+    def local_grad(p, xx, scale):
+        # scale=inf poisons the gradient like an fp16 overflow would
+        g = {"w": xx.T @ xx * scale}
+        return jnp.float32(0.5), None, g
+
+    fn = make_powersgd_grad_fn(mesh, local_grad, params, rank=4)
+    state0 = init_powersgd_state(params, 4, 2, mesh=mesh)
+    # scalar batch leaf (scale) exercises the 0-d spec path
+    loss, _aux, ghat, state1 = fn(params, state0, x, jnp.float32(1.0))
+    assert np.isfinite(float(loss))
+    assert np.isfinite(np.asarray(state1["err"][0])).all()
+
+    _l, _a, ghat_bad, state2 = fn(params, state1, x, jnp.float32(np.inf))
+    # state unchanged on the overflow step; the bad ghat is the
+    # apply-branch finite-guard's problem (it skips the update)
+    np.testing.assert_array_equal(
+        np.asarray(state2["err"][0]), np.asarray(state1["err"][0])
+    )
+    np.testing.assert_array_equal(
+        np.asarray(state2["q"][0]), np.asarray(state1["q"][0])
+    )
+
+    _l, _a, ghat3, state3 = fn(params, state2, x, jnp.float32(1.0))
+    assert np.isfinite(np.asarray(ghat3["w"])).all()
