@@ -20,17 +20,32 @@ import jax.numpy as jnp
 
 from ..model import Model
 
-__all__ = ["QuantizationConfig", "quantize_params", "dequantize_leaf", "quantize_model", "load_and_quantize_model"]
+__all__ = ["QuantizationConfig", "quantize_params", "dequantize_leaf", "quantize_model", "load_and_quantize_model", "NF4Leaf", "nf4_quantize_leaf", "NF4_CODEBOOK"]
 
 
 @dataclasses.dataclass
 class QuantizationConfig:
-    """(reference BnbQuantizationConfig)."""
+    """(reference BnbQuantizationConfig, utils/dataclasses.py:3057+).
+
+    4-bit supports the linear symmetric codebook and ``nf4`` (NormalFloat
+    quantile codebook with per-block absmax, QLoRA), with optional double
+    quantization of the absmax scales — the full bitsandbytes 4-bit
+    surface."""
 
     load_in_8bit: bool = False
     load_in_4bit: bool = False
     min_weight_size: int = 2**12  # leave small params in full precision
     skip_patterns: tuple = ("norm", "bias", "scale", "embed")
+    bnb_4bit_quant_type: str = "linear"  # "linear" | "nf4"
+    bnb_4bit_use_double_quant: bool = False
+    bnb_4bit_block_size: int = 64
+
+    def __post_init__(self):
+        if self.bnb_4bit_quant_type not in ("linear", "nf4"):
+            raise ValueError(
+                f"bnb_4bit_quant_type must be linear|nf4, got "
+                f"{self.bnb_4bit_quant_type!r}"
+            )
 
     @property
     def bits(self) -> int:
@@ -80,6 +95,12 @@ def quantize_params(params: Any, config: QuantizationConfig) -> Any:
             and size >= config.min_weight_size
             and not any(p in path for p in config.skip_patterns)
         ):
+            if config.load_in_4bit and config.bnb_4bit_quant_type == "nf4":
+                return nf4_quantize_leaf(
+                    leaf,
+                    block=config.bnb_4bit_block_size,
+                    double_quant=config.bnb_4bit_use_double_quant,
+                )
             q, scales = _quantize_array(jax.device_get(leaf), config.bits)
             return QuantizedLeaf(jnp.asarray(q), jnp.asarray(scales), dtype)
         return leaf
@@ -88,7 +109,9 @@ def quantize_params(params: Any, config: QuantizationConfig) -> Any:
 
 
 def dequantize_leaf(leaf):
-    return leaf.dequantize() if isinstance(leaf, QuantizedLeaf) else leaf
+    if isinstance(leaf, (QuantizedLeaf, NF4Leaf)):
+        return leaf.dequantize()
+    return leaf
 
 
 def quantize_model(model: Model, config: Optional[QuantizationConfig] = None) -> Model:
@@ -100,7 +123,8 @@ def quantize_model(model: Model, config: Optional[QuantizationConfig] = None) ->
 
     def quantized_apply(params, *args, **kwargs):
         full = jax.tree_util.tree_map(
-            dequantize_leaf, params, is_leaf=lambda x: isinstance(x, QuantizedLeaf)
+            dequantize_leaf, params,
+            is_leaf=lambda x: isinstance(x, (QuantizedLeaf, NF4Leaf)),
         )
         return base_apply(full, *args, **kwargs)
 
@@ -121,3 +145,105 @@ def load_and_quantize_model(
 
     load_checkpoint_in_model(model, checkpoint, mesh=mesh, strict=False)
     return quantize_model(model, quantization_config)
+
+
+# --------------------------------------------------------------------- NF4
+# The 4-bit NormalFloat codebook (QLoRA, Dettmers et al. 2023 — the values
+# bitsandbytes ships): quantiles of N(0,1) normalized to [-1, 1], so
+# normally-distributed weights use all 16 levels evenly. The reference
+# exposes it through BnbQuantizationConfig(bnb_4bit_quant_type="nf4",
+# bnb_4bit_use_double_quant=...) — utils/dataclasses.py:3057+, utils/bnb.py.
+NF4_CODEBOOK = np.array(
+    [
+        -1.0, -0.6961928009986877, -0.5250730514526367, -0.39491748809814453,
+        -0.28444138169288635, -0.18477343022823334, -0.09105003625154495, 0.0,
+        0.07958029955625534, 0.16093020141124725, 0.24611230194568634,
+        0.33791524171829224, 0.44070982933044434, 0.5626170039176941,
+        0.7229568362236023, 1.0,
+    ],
+    dtype=np.float32,
+)
+
+
+class NF4Leaf:
+    """NF4-quantized tensor: two 4-bit codebook indices packed per uint8,
+    per-block (``block``-element) absmax scales, optionally double-quantized
+    (int8 residual + per-group scale + scalar mean offset). A pytree node."""
+
+    def __init__(self, packed, absmax, dq, shape, orig_dtype, block):
+        self.packed = packed          # uint8[ceil(n/2)]
+        self.absmax = absmax          # f32[nblocks] or int8[nblocks] (dq)
+        self.dq = dq                  # None | (group_scales f32[g], offset f32)
+        self.shape = tuple(shape)
+        self.orig_dtype = orig_dtype
+        self.block = block
+
+    def dequantize(self):
+        n = int(np.prod(self.shape))
+        hi = jnp.right_shift(self.packed, 4).astype(jnp.int32)
+        lo = jnp.bitwise_and(self.packed, 0xF).astype(jnp.int32)
+        idx = jnp.stack([hi, lo], axis=-1).reshape(-1)[:n]
+        vals = jnp.asarray(NF4_CODEBOOK)[idx]
+        if self.dq is not None:
+            group_scales, offset = self.dq
+            g = jnp.repeat(
+                group_scales, _DQ_GROUP, total_repeat_length=self.absmax.shape[0]
+            )
+            absmax = self.absmax.astype(jnp.float32) * g + offset
+        else:
+            absmax = self.absmax
+        scale = jnp.repeat(absmax, self.block, total_repeat_length=n)
+        return (vals * scale).reshape(self.shape).astype(self.orig_dtype)
+
+
+jax.tree_util.register_pytree_node(
+    NF4Leaf,
+    lambda l: (
+        (l.packed, l.absmax, l.dq),
+        (l.shape, l.orig_dtype, l.block),
+    ),
+    lambda aux, ch: NF4Leaf(ch[0], ch[1], ch[2], aux[0], aux[1], aux[2]),
+)
+
+_DQ_GROUP = 256  # absmax values per second-level quantization group
+
+
+def _nf4_quantize_array(arr, block: int, double_quant: bool):
+    x = np.asarray(arr, dtype=np.float32).reshape(-1)
+    n = x.size
+    pad = (-n) % block
+    xb = np.pad(x, (0, pad)).reshape(-1, block)
+    absmax = np.maximum(np.abs(xb).max(axis=1), 1e-12).astype(np.float32)
+    normed = xb / absmax[:, None]
+    # nearest codebook level by midpoint bucketing
+    mids = (NF4_CODEBOOK[1:] + NF4_CODEBOOK[:-1]) / 2
+    idx = np.searchsorted(mids, normed).astype(np.uint8)  # (nblocks, block)
+    flat = idx.reshape(-1)[: n + pad]
+    if flat.size % 2:
+        flat = np.pad(flat, (0, 1))
+    packed = (flat[0::2] << 4) | flat[1::2]
+
+    dq = None
+    if double_quant:
+        # 8-bit absmax: subtract the mean, then symmetric int8 per group of
+        # _DQ_GROUP blocks (the bitsandbytes double-quantization recipe)
+        offset = np.float32(absmax.mean())
+        resid = absmax - offset
+        gpad = (-resid.size) % _DQ_GROUP
+        rg = np.pad(resid, (0, gpad)).reshape(-1, _DQ_GROUP)
+        gscale = np.maximum(np.abs(rg).max(axis=1), 1e-12) / 127.0
+        q8 = np.clip(np.round(rg / gscale[:, None]), -127, 127).astype(np.int8)
+        absmax_store = q8.reshape(-1)[: absmax.size]
+        dq = (jnp.asarray(gscale.astype(np.float32)), jnp.asarray(offset))
+        return packed, absmax_store, dq
+    return packed, absmax, dq
+
+
+def nf4_quantize_leaf(leaf, block: int = 64, double_quant: bool = False):
+    packed, absmax, dq = _nf4_quantize_array(
+        jax.device_get(leaf), block, double_quant
+    )
+    return NF4Leaf(
+        jnp.asarray(packed), jnp.asarray(absmax), dq,
+        leaf.shape, leaf.dtype, block,
+    )
