@@ -1,0 +1,34 @@
+#!/bin/bash
+# Cloud TPU queued-resources submission (bare TPU VMs, no k8s) — the
+# closest TPU analogue of the reference's examples/multigpu_remote_launcher.py
+# (remote machines + accelerate launch with machine_rank per node).
+#
+# `accelerate-tpu launch --pod` then fans the SAME command out to every
+# worker over `gcloud compute tpus tpu-vm ssh --worker=all`, forwarding the
+# restart supervisor settings to each host (commands/launch.py).
+set -euo pipefail
+
+PROJECT=my-project
+ZONE=us-east5-a
+NAME=accelerate-train
+ACCELERATOR=v5p-32
+RUNTIME=v2-alpha-tpuv5
+
+# 1) request capacity (queued resource waits for it)
+gcloud compute tpus queued-resources create "$NAME" \
+  --project "$PROJECT" --zone "$ZONE" \
+  --node-id "$NAME" \
+  --accelerator-type "$ACCELERATOR" \
+  --runtime-version "$RUNTIME"
+
+# 2) wait until ACTIVE
+gcloud compute tpus queued-resources describe "$NAME" \
+  --project "$PROJECT" --zone "$ZONE" --format='value(state.state)'
+
+# 3) install + launch on every worker (idempotent; rerun on restarts)
+gcloud compute tpus tpu-vm ssh "$NAME" --worker=all \
+  --project "$PROJECT" --zone "$ZONE" \
+  --command "pip install -q accelerate-tpu && \
+    accelerate-tpu launch --pod $NAME \
+      --dp_shard_size -1 --max_restarts 3 \
+      examples/llama_finetune.py --preset 1b --steps 1000"

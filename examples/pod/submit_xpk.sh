@@ -1,0 +1,19 @@
+#!/bin/bash
+# XPK submission — Google's xpk wrapper creates the GKE JobSet of
+# submit_gke.yaml from one command line. TPU analogue of the reference's
+# examples/slurm/submit_multigpu.sh.
+set -euo pipefail
+
+CLUSTER=my-cluster          # xpk cluster name
+PROJECT=my-project
+ZONE=us-east5-a
+TPU_TYPE=v5p-32             # slice type (4 hosts x 4 chips)
+
+python -m xpk.main workload create \
+  --cluster "$CLUSTER" --project "$PROJECT" --zone "$ZONE" \
+  --workload accelerate-tpu-train \
+  --tpu-type "$TPU_TYPE" \
+  --command "accelerate-tpu launch \
+      --dp_shard_size -1 \
+      --max_restarts 3 \
+      examples/llama_finetune.py --preset 1b --steps 1000"

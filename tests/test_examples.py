@@ -187,3 +187,28 @@ def test_attention_bench_harness():
     assert result.returncode == 0, result.stderr[-2000:]
     lines = [l for l in result.stdout.splitlines() if l.startswith("{")]
     assert len(lines) == 3  # flash, blockwise, xla all produced a row
+
+
+def test_pod_submission_templates():
+    """examples/pod/ (the reference examples/slurm analogue): YAML parses,
+    scripts are bash with the launch CLI wired in."""
+    import os
+
+    root = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                        "examples", "pod")
+    files = set(os.listdir(root))
+    assert {"README.md", "submit_gke.yaml", "submit_xpk.sh", "submit_qr.sh"} <= files
+    try:
+        import yaml
+
+        spec = yaml.safe_load(open(os.path.join(root, "submit_gke.yaml")))
+        assert spec["kind"] == "JobSet"
+        args = spec["spec"]["replicatedJobs"][0]["template"]["spec"]["template"][
+            "spec"]["containers"][0]["args"][0]
+        assert "accelerate-tpu launch" in args
+    except ImportError:
+        pass
+    for sh in ("submit_xpk.sh", "submit_qr.sh"):
+        body = open(os.path.join(root, sh)).read()
+        assert body.startswith("#!/bin/bash")
+        assert "accelerate-tpu launch" in body
