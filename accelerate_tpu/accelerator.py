@@ -596,6 +596,10 @@ class Accelerator:
         window = getattr(model_config, "sliding_window", None)
         if getattr(model_config, "alternating_sliding_window", False):
             window = None
+        # Gemma-2 tanh score capping runs inside every ring step / the
+        # Ulysses inner (capping precedes the softmax the LSE merge
+        # describes, so the merge math is unchanged)
+        softcap = getattr(model_config, "attn_logit_softcap", None)
         if pcfg.cp_enabled:
             from .ops.ring_attention import make_ring_attention
             from .utils.dataclasses import ContextParallelConfig
@@ -608,6 +612,7 @@ class Accelerator:
                 or "blockwise",
                 block_q=getattr(model_config, "attention_block_q", 2048),
                 window=window,
+                softcap=softcap,
             )
         if pcfg.sp_enabled:
             from .ops.ulysses import make_ulysses_attention
@@ -626,7 +631,9 @@ class Accelerator:
                     block_q=getattr(model_config, "attention_block_q", 2048),
                 )
 
-            return make_ulysses_attention(self.mesh, inner=inner, window=window)
+            return make_ulysses_attention(
+                self.mesh, inner=inner, window=window, softcap=softcap
+            )
         return None
 
     def prepare_optimizer(self, optimizer, device_placement=None) -> AcceleratedOptimizer:

@@ -463,11 +463,16 @@ def _attention(config: LlamaConfig, q, k, v, attention_fn=None, q_offset: int = 
                 "unsupported under cp/sp; uniform windows work when the "
                 "Accelerator builds the attention fn from the model config"
             )
-        if config.attn_logit_softcap is not None:
+        if config.attn_logit_softcap != getattr(attention_fn, "softcap", None):
+            # ring/Ulysses fns carry their build-time cap as .softcap
+            # (ops/ring_attention.py, ops/ulysses.py) — a mismatch would
+            # silently attend with the wrong (or no) capping
             raise ValueError(
-                "attn_logit_softcap cannot compose with a mesh-injected "
-                "attention_fn (CP/SP) yet — the ring/Ulysses paths run "
-                "un-capped scores; drop cp/sp or disable softcapping"
+                "attn_logit_softcap mismatch with the mesh-injected "
+                f"attention_fn (built for softcap="
+                f"{getattr(attention_fn, 'softcap', None)}, layer wants "
+                f"{config.attn_logit_softcap}): the Accelerator builds "
+                "capped CP/SP attention from the model config automatically"
             )
         if segment_ids is not None:
             # packed sequences under CP/SP: document labels shard with the

@@ -459,32 +459,35 @@ _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 
 # ------------------------------------------------- (out, lse) variant
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
 def _flash_core_lse(q, k, v, segs, h, h_kv, causal, block_q, block_k,
-                    interpret):
+                    interpret, softcap):
     """Like :func:`_flash_core` but also returns the per-row logsumexp —
     the ring-attention building block (ops/ring_attention.py): per-step
     normalized outputs merge across the ring via their LSEs, and the VJP
     accepts an ``lse`` cotangent (the merge differentiates through it).
-    ``segs`` is None or a (q_segs, kv_segs) pair of (B, 1, S*) int32."""
+    ``segs`` is None or a (q_segs, kv_segs) pair of (B, 1, S*) int32.
+    ``softcap`` caps scores in-kernel (Gemma-2), pre-mask, exactly like
+    the non-LSE core — the LSE merge math is unchanged (capping precedes
+    the softmax the stats describe)."""
     return _flash_fwd(q, k, v, segs, h, h_kv, causal, block_q, block_k,
-                      interpret, None)
+                      interpret, None, softcap)
 
 
 def _flash_core_lse_fwd(q, k, v, segs, h, h_kv, causal, block_q, block_k,
-                        interpret):
+                        interpret, softcap):
     out, lse = _flash_fwd(q, k, v, segs, h, h_kv, causal, block_q, block_k,
-                          interpret, None)
+                          interpret, None, softcap)
     return (out, lse), (q, k, v, segs, out, lse)
 
 
-def _flash_core_lse_bwd(h, h_kv, causal, block_q, block_k, interpret,
+def _flash_core_lse_bwd(h, h_kv, causal, block_q, block_k, interpret, softcap,
                         residuals, cotangents):
     q, k, v, segs, out, lse = residuals
     do, dlse = cotangents
     dq, dk, dv = _flash_bwd(
         q, k, v, segs, out, lse, do, h, h_kv, causal, block_q, block_k,
-        interpret, None, dlse=dlse,
+        interpret, None, dlse=dlse, softcap=softcap,
     )
     return dq, dk, dv, _zero_dsegs(segs)
 
@@ -503,6 +506,7 @@ def flash_attention_with_lse(
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
     interpret: Optional[bool] = None,
+    softcap: Optional[float] = None,
 ):
     """(B, Sq, H, D) x (B, Skv, H_kv, D) flash attention returning
     ``(out (B, Sq, H, D), lse (B, H, Sq) f32)``.
@@ -543,7 +547,7 @@ def flash_attention_with_lse(
         )
     out, lse = _flash_core_lse(
         merge(q), merge(k), merge(v), segs, hh, h_kv, causal, block_q, block_k,
-        interpret,
+        interpret, softcap,
     )
     out = out.reshape(b, hh, sq, d).transpose(0, 2, 1, 3)
     return out, lse.reshape(b, hh, sq)

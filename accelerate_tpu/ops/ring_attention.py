@@ -53,7 +53,8 @@ def _ring_bias(sq_local: int, skv_local: int, q_start, kv_start, causal: bool):
 
 
 def _attend_shard(q, k_shard, v_shard, q_start, kv_start, causal,
-                  kv_block=None, q_segs=None, kv_segs=None, window=None):
+                  kv_block=None, q_segs=None, kv_segs=None, window=None,
+                  softcap=None):
     """One ring step's attention of the local (pre-scaled) q against a
     whole kv shard, returning online-softmax partials (out, m, l).
 
@@ -75,17 +76,18 @@ def _attend_shard(q, k_shard, v_shard, q_start, kv_start, causal,
             q, k_shard, v_shard, causal=causal, kv_block=kv_block or skv,
             q_offset=q_start, kv_offset=kv_start,
             segment_ids=q_segs, kv_segment_ids=kv_segs, window=window,
+            softcap=softcap,
         )
     bias = _ring_bias(sq, skv, q_start, kv_start, causal)
     if q_segs is not None:
         same = (q_segs[:, :, None] == kv_segs[:, None, :])[:, None]
         seg_bias = jnp.where(same, 0.0, NEG_INF)
         bias = seg_bias if bias is None else bias + seg_bias
-    return _attend_block(q, k_shard, v_shard, bias)
+    return _attend_block(q, k_shard, v_shard, bias, softcap=softcap)
 
 
 def _flash_partials(q, k, v, causal, block_q, block_k, q_segs=None,
-                    kv_segs=None):
+                    kv_segs=None, softcap=None):
     """One ring step through the Pallas flash kernel: the normalized
     (out, lse) pair re-enters the online-softmax merge as ``(out, m=lse,
     l=1)`` — algebraically the LSE merge rule. The kernel's custom VJP
@@ -99,7 +101,7 @@ def _flash_partials(q, k, v, causal, block_q, block_k, q_segs=None,
 
     out, lse = flash_attention_with_lse(
         q, k, v, causal=causal, block_q=block_q, block_k=block_k,
-        segment_ids=q_segs, kv_segment_ids=kv_segs,
+        segment_ids=q_segs, kv_segment_ids=kv_segs, softcap=softcap,
     )
     return out, lse, jnp.ones_like(lse)
 
@@ -117,6 +119,7 @@ def ring_attention_local(
     attention_impl: str = "blockwise",
     block_q: int = 2048,
     window: Optional[int] = None,
+    softcap: Optional[float] = None,
 ) -> jax.Array:
     """Attention over sequence-sharded q/k/v — call INSIDE shard_map with
     ``axis_name`` bound. Shapes are local shards (B, S/n, H, D).
@@ -163,7 +166,7 @@ def ring_attention_local(
         )
         out, m, l = _attend_shard(
             q, k_all, v_all, q_start, 0, causal, kv_block,
-            q_segs=q_segs, kv_segs=segs_all, window=window,
+            q_segs=q_segs, kv_segs=segs_all, window=window, softcap=softcap,
         )
         return finalize_blocks(out, m, l)
 
@@ -190,7 +193,7 @@ def ring_attention_local(
                 out, m, l = operand
                 o2, m2, l2 = _flash_partials(
                     q, kc, vc, causal and diag, block_q, block_k,
-                    q_segs=q_segs, kv_segs=ks,
+                    q_segs=q_segs, kv_segs=ks, softcap=softcap,
                 )
                 return combine_blocks(out, m, l, o2, m2, l2)
 
@@ -207,7 +210,7 @@ def ring_attention_local(
                 out, m, l = operand
                 o2, m2, l2 = _attend_shard(
                     q, kc, vc, q_start, kv_start, causal, kv_block,
-                    q_segs=q_segs, kv_segs=ks, window=window,
+                    q_segs=q_segs, kv_segs=ks, window=window, softcap=softcap,
                 )
                 return combine_blocks(out, m, l, o2, m2, l2)
 
@@ -268,6 +271,7 @@ def zigzag_ring_attention_local(
     attention_impl: str = "blockwise",
     block_q: int = 2048,
     window: Optional[int] = None,
+    softcap: Optional[float] = None,
 ) -> jax.Array:
     """Ring attention over zig-zag-permuted shards — call INSIDE shard_map.
 
@@ -344,7 +348,7 @@ def zigzag_ring_attention_local(
                         out, m, l = operand
                         o2, m2, l2 = _flash_partials(
                             qb, kb, vb, causal and diag, block_q, block_k,
-                            q_segs=qsg, kv_segs=ksg,
+                            q_segs=qsg, kv_segs=ksg, softcap=softcap,
                         )
                         return combine_blocks(out, m, l, o2, m2, l2)
                 else:
@@ -355,6 +359,7 @@ def zigzag_ring_attention_local(
                         o2, m2, l2 = _attend_shard(
                             qb, kb, vb, qs, ks, causal, kv_block,
                             q_segs=qsg, kv_segs=ksg, window=window,
+                            softcap=softcap,
                         )
                         return combine_blocks(out, m, l, o2, m2, l2)
 
@@ -409,6 +414,7 @@ def make_ring_attention(
     attention_impl: str = "blockwise",
     block_q: int = 2048,
     window: Optional[int] = None,
+    softcap: Optional[float] = None,
 ):
     """Build an attention fn over GLOBAL (B, S, H, D) arrays that runs ring
     attention across the cp axis (composing with dp batch sharding and tp
@@ -439,7 +445,7 @@ def make_ring_attention(
             body = functools.partial(
                 zigzag_ring_attention_local, axis_name=cp_axis, causal=causal,
                 kv_block=kv_block, attention_impl=attention_impl,
-                block_q=block_q, window=window,
+                block_q=block_q, window=window, softcap=softcap,
             )
             in_specs = (spec, spec, spec)
             args = (qz, kz, vz)
@@ -464,6 +470,7 @@ def make_ring_attention(
             attention_impl=attention_impl,
             block_q=block_q,
             window=window,
+            softcap=softcap,
         )
         in_specs = (spec, spec, spec)
         args = (q, k, v)
@@ -479,6 +486,8 @@ def make_ring_attention(
         )
         return fn(*args)
 
-    # models check this marker to allow their sliding_window under CP
+    # models check these markers to allow their sliding_window /
+    # attn_logit_softcap under CP
     attention_fn.window = window
+    attention_fn.softcap = softcap
     return attention_fn

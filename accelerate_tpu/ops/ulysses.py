@@ -99,55 +99,70 @@ def make_ulysses_attention(
     head_axes: Sequence[str] = ("tp",),
     inner: Optional[Callable] = None,
     window: Optional[int] = None,
+    softcap: Optional[float] = None,
 ):
     """Attention fn over GLOBAL (B, S, H, D) arrays running Ulysses SP over
-    the sp axis (composes with dp batch and tp head sharding)."""
+    the sp axis (composes with dp batch and tp head sharding). ``window``
+    and ``softcap`` bind onto the inner attention (Ulysses attends the full
+    sequence locally post head-scatter, so both are just the inner's
+    kwargs)."""
     batch = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1) or None
     heads = tuple(a for a in head_axes if mesh.shape.get(a, 1) > 1) or None
     spec = P(batch, sp_axis, heads, None)
 
-    base_inner = inner
-    if window is not None:
-        # Ulysses attends the FULL sequence locally post head-scatter, so a
-        # uniform window is just the inner attention's window
-        if inner is not None:
-            import inspect
+    def _check_inner_kwarg(fn, name):
+        """Misuse checks for binding ``name`` onto the inner attention:
+        reject a partial that already binds ``name`` (the outer bind would
+        silently win at call time), and validate the callable accepts the
+        keyword so failure happens HERE, not as an opaque trace-time
+        TypeError inside shard_map."""
+        import inspect
 
-            if (
-                isinstance(inner, functools.partial)
-                and "window" in inner.keywords
-            ):
-                raise TypeError(
-                    "make_ulysses_attention(window=...) would re-bind "
-                    "`window` already bound in the partial inner — pass the "
-                    "window through ONE of the two, not both"
-                )
-            try:
-                sig = inspect.signature(inner)
-            except (ValueError, TypeError):
-                # non-introspectable callable (C extension): assume it
-                # accepts `window` rather than rejecting a valid inner
-                sig = None
-            accepts_window = sig is None or any(
-                (
-                    p.name == "window"
-                    and p.kind in (
-                        inspect.Parameter.POSITIONAL_OR_KEYWORD,
-                        inspect.Parameter.KEYWORD_ONLY,
-                    )
-                )
-                or p.kind is inspect.Parameter.VAR_KEYWORD
-                for p in sig.parameters.values()
+        if isinstance(fn, functools.partial) and name in fn.keywords:
+            raise TypeError(
+                f"make_ulysses_attention({name}=...) would re-bind `{name}` "
+                "already bound in the partial inner — pass it through ONE "
+                "of the two, not both"
             )
-            if not accepts_window:
-                raise TypeError(
-                    "make_ulysses_attention(window=...) with a custom inner "
-                    "requires the inner attention to accept a `window` "
-                    f"keyword; {getattr(inner, '__name__', inner)!r} does not"
+        try:
+            sig = inspect.signature(fn)
+        except (ValueError, TypeError):
+            # non-introspectable callable (C extension): assume it accepts
+            # the keyword rather than rejecting a valid inner
+            sig = None
+        accepts = sig is None or any(
+            (
+                p.name == name
+                and p.kind in (
+                    inspect.Parameter.POSITIONAL_OR_KEYWORD,
+                    inspect.Parameter.KEYWORD_ONLY,
                 )
+            )
+            or p.kind is inspect.Parameter.VAR_KEYWORD
+            for p in sig.parameters.values()
+        )
+        if not accepts:
+            raise TypeError(
+                f"make_ulysses_attention({name}=...) with a custom inner "
+                f"requires the inner attention to accept a `{name}` "
+                f"keyword; {getattr(fn, '__name__', fn)!r} does not"
+            )
+
+    # validate both binds against the ORIGINAL inner (wrapping first would
+    # hide its bound keywords from the re-bind guard), then wrap once.
+    # Ulysses attends the FULL sequence locally post head-scatter, so a
+    # uniform window and the Gemma-2 softcap are just the inner's kwargs.
+    bind_kwargs = {}
+    for name, value in (("window", window), ("softcap", softcap)):
+        if value is not None:
+            if inner is not None:
+                _check_inner_kwarg(inner, name)
+            bind_kwargs[name] = value
+    base_inner = inner
+    if bind_kwargs:
         base_inner = functools.partial(
             inner or functools.partial(blockwise_attention, kv_block=512),
-            window=window,
+            **bind_kwargs,
         )
 
     def attention_fn(q, k, v, causal: bool = True, segment_ids=None):
@@ -170,4 +185,5 @@ def make_ulysses_attention(
         return fn(*args)
 
     attention_fn.window = window  # models check this to allow sliding_window
+    attention_fn.softcap = softcap  # ditto for attn_logit_softcap
     return attention_fn

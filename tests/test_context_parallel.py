@@ -578,3 +578,97 @@ def test_mistral_window_cp_training_matches_dp():
     w_cp, loss_cp = run(ParallelismConfig(dp_shard_size=2, cp_size=4))
     assert loss_cp == pytest.approx(loss_dp, abs=1e-4)
     np.testing.assert_allclose(w_cp, w_dp, atol=1e-4)
+
+
+# ------------------------------------------------- softcap under CP/SP
+@pytest.mark.parametrize("rotate_method", ["alltoall", "zigzag", "allgather"])
+def test_ring_softcap_matches_reference(rotate_method):
+    """Gemma-2 tanh score capping under ring attention: the cap applies
+    inside every ring step's scores (capping precedes the softmax the LSE
+    merge describes), matching the dense capped reference."""
+    cfg = ParallelismConfig(cp_size=4, dp_shard_size=2)
+    mesh = cfg.build_device_mesh()
+    q, k, v = _qkv(s=64)
+    ref = dot_product_attention(q, k, v, causal=True, softcap=30.0)
+    ring = make_ring_attention(
+        mesh, rotate_method=rotate_method, kv_block=16, softcap=30.0,
+    )
+    out = jax.jit(lambda q, k, v: ring(q, k, v, causal=True))(q, k, v)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), atol=1e-5)
+
+
+@pytest.mark.parametrize("rotate_method", ["alltoall", "zigzag"])
+def test_ring_flash_softcap_matches_reference(rotate_method):
+    """flash-in-ring with in-kernel softcapping equals the dense capped
+    reference for values AND gradients (the LSE variant now threads the
+    cap into both kernels)."""
+    cfg = ParallelismConfig(cp_size=4, dp_shard_size=2)
+    mesh = cfg.build_device_mesh()
+    q, k, v = _qkv(s=64)
+    ring = make_ring_attention(
+        mesh, rotate_method=rotate_method, attention_impl="flash",
+        softcap=30.0,
+    )
+    ref = dot_product_attention(q, k, v, causal=True, softcap=30.0)
+    out = jax.jit(lambda q, k, v: ring(q, k, v, causal=True))(q, k, v)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), atol=2e-5)
+
+    g = jax.jit(jax.grad(
+        lambda q, k, v: jnp.sum(ring(q, k, v, causal=True) ** 2),
+        argnums=(0, 1, 2),
+    ))(q, k, v)
+    gr = jax.grad(
+        lambda q, k, v: jnp.sum(
+            dot_product_attention(q, k, v, causal=True, softcap=30.0) ** 2
+        ),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_ulysses_softcap_matches_reference():
+    cfg = ParallelismConfig(sp_size=4, dp_shard_size=2)
+    mesh = cfg.build_device_mesh()
+    q, k, v = _qkv(s=64)
+    ref = dot_product_attention(q, k, v, causal=True, softcap=30.0)
+    ulysses = make_ulysses_attention(mesh, softcap=30.0)
+    out = jax.jit(lambda q, k, v: ulysses(q, k, v, causal=True))(q, k, v)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), atol=1e-5)
+
+
+@pytest.mark.slow
+def test_softcap_cp_training_matches_dp():
+    """A softcapped (Gemma-2-style uniform-attention) model trains under CP
+    with the same trajectory as pure FSDP — the composition that used to be
+    rejected loudly."""
+    from accelerate_tpu.models.llama import LlamaConfig, create_llama, llama_loss
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    rng = np.random.default_rng(0)
+    data = {"input_ids": rng.integers(0, 256, size=(8, 64)).astype(np.int32)}
+
+    def run(pcfg):
+        for S in [AcceleratorState, GradientState, PartialState]:
+            S._reset_state()
+        acc = Accelerator(parallelism_config=pcfg)
+        cfg = LlamaConfig.tiny(
+            num_hidden_layers=2, compute_dtype=jnp.float32,
+            attn_logit_softcap=30.0,
+        )
+        model, opt = acc.prepare(create_llama(cfg, seed=0), optax.sgd(1e-2))
+        step = acc.train_step(llama_loss, model=model, optimizer=opt)
+        loader = acc.prepare_data_loader(data, batch_size=8, drop_last=True)
+        for batch in loader:
+            loss = step(batch)
+        return float(loss), np.asarray(
+            jax.device_get(model.params["layers"]["mlp"]["gate_proj"]["kernel"])
+        )
+
+    loss_ref, w_ref = run(ParallelismConfig(dp_shard_size=8))
+    loss_cp, w_cp = run(ParallelismConfig(dp_shard_size=2, cp_size=4))
+    loss_sp, w_sp = run(ParallelismConfig(dp_shard_size=2, sp_size=4))
+    assert loss_cp == pytest.approx(loss_ref, abs=1e-4)
+    assert loss_sp == pytest.approx(loss_ref, abs=1e-4)
+    np.testing.assert_allclose(w_cp, w_ref, atol=1e-4)
+    np.testing.assert_allclose(w_sp, w_ref, atol=1e-4)
