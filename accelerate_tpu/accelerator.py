@@ -591,8 +591,11 @@ class Accelerator:
         blockwise partials, which need shard-offset stats).
         """
         pcfg = self.parallelism_config
-        # uniform sliding windows ride the ring/Ulysses fns; Gemma-2's
-        # per-layer alternation cannot (the model rejects loudly)
+        # uniform sliding windows ride the ring/Ulysses fns. Gemma-2's
+        # per-layer alternation builds WINDOWLESS on purpose: the fns accept
+        # a per-call static window override (.supports_window_override), and
+        # each local/global layer passes its own window — two traced
+        # branches against one injected fn.
         window = getattr(model_config, "sliding_window", None)
         if getattr(model_config, "alternating_sliding_window", False):
             window = None

@@ -452,17 +452,22 @@ def _attention(config: LlamaConfig, q, k, v, attention_fn=None, q_offset: int = 
     if window == "config":
         window = config.sliding_window
     if attention_fn is not None:
+        extra_kw = {}
         if window != getattr(attention_fn, "window", None):
             # a window-aware ring/Ulysses fn carries its build-time window
-            # as .window (ops/ring_attention.py); anything else would
-            # silently attend full-causal
-            raise ValueError(
-                "sliding_window cannot compose with this mesh-injected "
-                f"attention_fn (built for window={getattr(attention_fn, 'window', None)}, "
-                f"layer wants {window}): Gemma-2's ALTERNATING windows are "
-                "unsupported under cp/sp; uniform windows work when the "
-                "Accelerator builds the attention fn from the model config"
-            )
+            # as .window; fns built by this framework additionally accept a
+            # per-call STATIC window override (Gemma-2's local/global
+            # alternation — each distinct window traces its own branch)
+            if getattr(attention_fn, "supports_window_override", False):
+                extra_kw["window"] = window
+            else:
+                raise ValueError(
+                    "sliding_window cannot compose with this mesh-injected "
+                    f"attention_fn (built for window="
+                    f"{getattr(attention_fn, 'window', None)}, layer wants "
+                    f"{window}) and the fn accepts no per-call window "
+                    "override; the Accelerator-built CP/SP attention fns do"
+                )
         if config.attn_logit_softcap != getattr(attention_fn, "softcap", None):
             # ring/Ulysses fns carry their build-time cap as .softcap
             # (ops/ring_attention.py, ops/ulysses.py) — a mismatch would
@@ -477,8 +482,10 @@ def _attention(config: LlamaConfig, q, k, v, attention_fn=None, q_offset: int = 
         if segment_ids is not None:
             # packed sequences under CP/SP: document labels shard with the
             # sequence (ring rotates kv labels; Ulysses all-gathers them)
-            return attention_fn(q, k, v, causal=True, segment_ids=segment_ids)
-        return attention_fn(q, k, v, causal=True)
+            return attention_fn(
+                q, k, v, causal=True, segment_ids=segment_ids, **extra_kw
+            )
+        return attention_fn(q, k, v, causal=True, **extra_kw)
     from ..ops.attention import dispatch_attention
 
     return dispatch_attention(

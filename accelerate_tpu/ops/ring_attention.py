@@ -430,8 +430,15 @@ def make_ring_attention(
     n = mesh.shape[cp_axis]
 
     seg_spec = P(batch, cp_axis)
+    build_window = window
+    _UNSET = object()
 
-    def attention_fn(q, k, v, causal: bool = True, segment_ids=None):
+    def attention_fn(q, k, v, causal: bool = True, segment_ids=None,
+                     window=_UNSET):
+        # per-call STATIC window override (Gemma-2 alternates local/global
+        # layers against ONE injected fn; each distinct python-int window
+        # traces its own branch — two for the alternation)
+        window = build_window if window is _UNSET else window
         if segment_ids is not None:
             segment_ids = segment_ids.astype(jnp.int32)
         if rotate_method == "zigzag":
@@ -487,7 +494,9 @@ def make_ring_attention(
         return fn(*args)
 
     # models check these markers to allow their sliding_window /
-    # attn_logit_softcap under CP
-    attention_fn.window = window
+    # attn_logit_softcap under CP; window_override marks that per-call
+    # static windows are accepted (the alternating-layer path)
+    attention_fn.window = build_window
     attention_fn.softcap = softcap
+    attention_fn.supports_window_override = True
     return attention_fn

@@ -148,27 +148,41 @@ def make_ulysses_attention(
                 f"keyword; {getattr(fn, '__name__', fn)!r} does not"
             )
 
-    # validate both binds against the ORIGINAL inner (wrapping first would
-    # hide its bound keywords from the re-bind guard), then wrap once.
-    # Ulysses attends the FULL sequence locally post head-scatter, so a
-    # uniform window and the Gemma-2 softcap are just the inner's kwargs.
-    bind_kwargs = {}
-    for name, value in (("window", window), ("softcap", softcap)):
-        if value is not None:
-            if inner is not None:
-                _check_inner_kwarg(inner, name)
-            bind_kwargs[name] = value
-    base_inner = inner
-    if bind_kwargs:
-        base_inner = functools.partial(
-            inner or functools.partial(blockwise_attention, kv_block=512),
-            **bind_kwargs,
-        )
+    # validate binds against the ORIGINAL inner (wrapping first would hide
+    # its bound keywords from the re-bind guard). Ulysses attends the FULL
+    # sequence locally post head-scatter, so a uniform window and the
+    # Gemma-2 softcap are just the inner's kwargs; softcap binds at build,
+    # the window binds per call (Gemma-2 alternates local/global layers
+    # against one injected fn — each static window traces its own branch).
+    if softcap is not None and inner is not None:
+        _check_inner_kwarg(inner, "softcap")
+    # probe window acceptance up front even when the BUILD window is None:
+    # supports_window_override below must only be advertised when a
+    # per-call override can actually bind (otherwise the model's clear
+    # composition ValueError is replaced by a confusing trace-time error)
+    window_ok = True
+    if inner is not None:
+        try:
+            _check_inner_kwarg(inner, "window")
+        except TypeError:
+            if window is not None:
+                raise
+            window_ok = False
+    base_inner = inner or functools.partial(blockwise_attention, kv_block=512)
+    if softcap is not None:
+        base_inner = functools.partial(base_inner, softcap=softcap)
+    build_window = window
+    _UNSET = object()
 
-    def attention_fn(q, k, v, causal: bool = True, segment_ids=None):
+    def attention_fn(q, k, v, causal: bool = True, segment_ids=None,
+                     window=_UNSET):
+        win = build_window if window is _UNSET else window
+        call_inner = base_inner
+        if win is not None:
+            call_inner = functools.partial(base_inner, window=win)
         body = functools.partial(
             ulysses_attention_local, axis_name=sp_axis, causal=causal,
-            inner=base_inner,
+            inner=call_inner,
         )
         in_specs = (spec, spec, spec)
         args = (q, k, v)
@@ -184,6 +198,7 @@ def make_ulysses_attention(
         )
         return fn(*args)
 
-    attention_fn.window = window  # models check this to allow sliding_window
+    attention_fn.window = build_window  # models check this (sliding_window)
     attention_fn.softcap = softcap  # ditto for attn_logit_softcap
+    attention_fn.supports_window_override = window_ok
     return attention_fn
