@@ -123,13 +123,41 @@ class LocalSGD:
         )
         # vmap(init) has no data dependence on the params, so explicit
         # out_shardings keep the per-shard opt state on its shard (the same
-        # hazard AcceleratedOptimizer._init_opt_state documents). Opt-state
-        # leaves ride P(axes) (tp-replicated within a shard) — mu/nu could
-        # inherit tp specs by path matching, a memory optimization only.
+        # hazard AcceleratedOptimizer._init_opt_state documents). Adam-style
+        # moment leaves (mu/nu) mirror the param tree, so they inherit each
+        # param's stacked sharding by path suffix — under HSDP+TP the
+        # moments stay tp-sharded instead of tp-replicated (1/tp the
+        # opt-state HBM); unmatched leaves (counts, scalars) ride P(axes).
+        from .parallel.sharding import path_of
+
+        param_entries = {}
+
+        def record(key_path, p, sh):
+            # stacked shapes: the shape guard keeps factored-optimizer
+            # stats (adafactor v_row/v_col, reduced rank at the SAME path
+            # suffix) off full-rank param shardings — the same contract as
+            # AcceleratedOptimizer._init_opt_state's matcher
+            param_entries[path_of(key_path)] = ((self.ndp, *p.shape), sh)
+
+        jax.tree_util.tree_map_with_path(record, self.model.params, stack_shardings)
+
+        def opt_leaf_sharding(key_path, aval):
+            path = path_of(key_path)
+            for ppath, (shape, sh) in param_entries.items():
+                # component-boundary suffix match (see optimizer.py:235)
+                if (
+                    (path == ppath or path.endswith("/" + ppath))
+                    and tuple(aval.shape) == shape
+                ):
+                    return sh
+            return stacked
+
         abstract = jax.eval_shape(jax.vmap(self.tx.init), self._stack)
         self._opt_stack = jax.jit(
             jax.vmap(self.tx.init),
-            out_shardings=jax.tree_util.tree_map(lambda _: stacked, abstract),
+            out_shardings=jax.tree_util.tree_map_with_path(
+                opt_leaf_sharding, abstract
+            ),
         )(self._stack)
 
         tx, loss_fn, model = self.tx, self.loss_fn, self.model
