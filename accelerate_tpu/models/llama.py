@@ -604,6 +604,19 @@ def _alternating_fns(config: LlamaConfig, layer_kw: dict, remat: bool = True):
     return local_fn, global_fn
 
 
+def _make_pair_fn(local_fn, global_fn, keep_aux: bool = True):
+    """One local+global pair body — the single source for every
+    alternating-scan site (stack/pipeline/stage/prefill)."""
+
+    def pair_fn(pair_params, h):
+        lp0, lp1 = _pair_slices(pair_params)
+        h, a0 = local_fn(lp0, h)
+        h, a1 = global_fn(lp1, h)
+        return h, (a0 + a1 if keep_aux else None)
+
+    return pair_fn
+
+
 def _pair_layers(params_layers):
     """Stacked (L, ...) leaves → (L/2, 2, ...) for the pair scan."""
     return jax.tree_util.tree_map(
@@ -664,23 +677,25 @@ def llama_apply(
     alternating = config.alternating_sliding_window
     if layer_stack_fn is not None:
         if alternating:
-            raise ValueError(
-                "alternating_sliding_window (Gemma-2) cannot compose with a "
-                "pipelined layer stack yet — the pp stage scan assumes a "
-                "uniform layer body; run without pp"
+            # the pipeline scans layer PAIRS as its stack unit, so every
+            # stage holds whole local/global pairs and both windows stay
+            # static inside the compiled stage body (_alternating_fns)
+            local_fn, global_fn = _alternating_fns(config, layer_kw)
+            pair_fn = _make_pair_fn(local_fn, global_fn)
+            x, aux_raw = layer_stack_fn(
+                _pair_layers(params["layers"]), x, pair_fn
             )
-        x, aux_raw = layer_stack_fn(params["layers"], x, layer_fn)
+        else:
+            x, aux_raw = layer_stack_fn(params["layers"], x, layer_fn)
         aux_total = aux_raw  # per-layer auxes are pre-scaled (moe_ffn)
     elif alternating and config.scan_layers:
         # local/global layers alternate: scan over layer PAIRS (see
         # _alternating_fns for why both windows must stay static)
         local_fn, global_fn = _alternating_fns(config, layer_kw)
+        pair_fn = _make_pair_fn(local_fn, global_fn)
 
         def pair_body(x, pair_params):
-            lp0, lp1 = _pair_slices(pair_params)
-            x, aux0 = local_fn(lp0, x)
-            x, aux1 = global_fn(lp1, x)
-            return x, aux0 + aux1
+            return pair_fn(pair_params, x)
 
         x, aux_per_pair = lax.scan(pair_body, x, _pair_layers(params["layers"]))
         aux_total = jnp.sum(aux_per_pair)
@@ -866,6 +881,15 @@ def llama_pipeline_parts(config: LlamaConfig, attention_fn: Optional[Callable] =
     policy = _remat_policy(config.remat_policy)
     if config.remat_policy != "full":
         layer_fn = jax.checkpoint(layer_fn, policy=policy)
+    alt_fns = None
+    if config.alternating_sliding_window:
+        # stage slices start on even global layer indices whenever the
+        # rows-per-stage count is even (enforced below), so pairing within
+        # the slice preserves the global local/global alternation
+        alt_fns = _alternating_fns(
+            config,
+            dict(position_offset=0, attention_fn=attention_fn),
+        )
 
     def embed_fn(params, mb):
         x = params["embed_tokens"]["embedding"].astype(cdt)[mb["input_ids"]]
@@ -874,6 +898,22 @@ def llama_pipeline_parts(config: LlamaConfig, attention_fn: Optional[Callable] =
         return constrain_activation(x)
 
     def stage_fn(stage_params, h):
+        if alt_fns is not None:
+            rows = jax.tree_util.tree_leaves(stage_params)[0].shape[0]
+            if rows % 2:
+                raise ValueError(
+                    "alternating_sliding_window under pp needs an even "
+                    f"layer count per stage/chunk; got {rows} — choose "
+                    "pp (and virtual stages) so layers/(pp*v) is even"
+                )
+            pair_fn = _make_pair_fn(*alt_fns, keep_aux=False)
+
+            def pair_body(h, pair_params):
+                return pair_fn(pair_params, h)
+
+            h, _ = lax.scan(pair_body, h, _pair_layers(stage_params))
+            return h
+
         def body(h, lp):
             h, _aux = layer_fn(lp, h)
             return h, None

@@ -45,6 +45,14 @@ def make_pipeline_layer_stack(
         m = num_microbatches
         if b % m != 0:
             raise ValueError(f"batch {b} not divisible by num_microbatches {m}")
+        units = jax.tree_util.tree_leaves(layers_params)[0].shape[0]
+        if units % n_stages != 0:
+            raise ValueError(
+                f"pipeline stack has {units} scan units (layers, or layer "
+                f"PAIRS for alternating-window models) not divisible by "
+                f"pp={n_stages} — every stage needs an even share; adjust "
+                "num_hidden_layers or pp_size"
+            )
         mb = b // m
         x_mb = x.reshape(m, mb, *x.shape[1:])
 
