@@ -15,7 +15,7 @@ test:
 	$(PY) -m pytest tests/ -q
 
 test_all:
-	$(PY_SLOW) -m pytest tests/test_state.py tests/test_operations.py tests/test_parallelism_config.py tests/test_accelerator.py tests/test_checkpointing.py tests/test_tracking.py tests/test_data_loader.py tests/test_data_shard_info.py tests/test_misc.py tests/test_cli.py tests/test_big_modeling.py tests/test_losses.py tests/test_flatbuf.py tests/test_local_sgd.py tests/test_api_parity.py tests/test_hlo_analysis.py -q
+	$(PY_SLOW) -m pytest tests/test_state.py tests/test_operations.py tests/test_parallelism_config.py tests/test_accelerator.py tests/test_checkpointing.py tests/test_tracking.py tests/test_data_loader.py tests/test_data_shard_info.py tests/test_misc.py tests/test_cli.py tests/test_big_modeling.py tests/test_losses.py tests/test_flatbuf.py tests/test_local_sgd.py tests/test_api_parity.py tests/test_hlo_analysis.py tests/test_tracking_fakes.py tests/test_powersgd.py -q
 	$(PY_SLOW) -m pytest tests/test_llama.py tests/test_gpt2.py tests/test_bert.py tests/test_t5.py tests/test_resnet.py tests/test_attention.py tests/test_flash_attention.py tests/test_fp8_quantization.py tests/test_native_packing.py tests/test_interop.py -q
 	$(PY_SLOW) -m pytest tests/test_context_parallel.py tests/test_pipeline.py tests/test_moe.py tests/test_composition.py tests/test_inference.py -q
 	$(PY_SLOW) -m pytest tests/test_multiprocess.py tests/test_examples.py tests/test_fault_tolerance.py -q

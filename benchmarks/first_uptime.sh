@@ -1,0 +1,34 @@
+#!/bin/bash
+# First-uptime TPU sweep — run the MOMENT the axon relay answers.
+# (The round-5 watch: timeout -k 10 240 python -c "import jax; jax.devices()"
+# in a loop; this script re-probes first so it is safe to fire blind.)
+#
+# Priority order per VERDICT r4 #1: (a) bench.py training sweep with its
+# built-in flash-validation gate (expect ~0.66 MFU predicted ceiling /
+# 0.12 calibrated floor on the 1B v5e config — runs/hlo_report_index.md);
+# (b) real-lowering validation of every Pallas kernel entry point
+# (attention_bench covers flash fwd/bwd, GQA, window, softcap; ring rows
+# cover flash-in-ring + with_lse); (c) decode latency (inference_bench)
+# against runs/hlo_decode_*.md predictions.
+#
+# ONE TPU process at a time (single-tenant chip); every step appends to
+# benchmarks/RESULTS.md by hand afterwards with the printed JSON.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== probe =="
+if ! timeout -k 10 120 python -c "import jax; d=jax.devices(); assert d[0].platform != 'cpu', d; print(d)"; then
+  echo "relay still down; aborting sweep" >&2
+  exit 1
+fi
+
+echo "== (a) training bench =="
+timeout -k 30 1800 python bench.py || echo "bench.py failed rc=$?"
+
+echo "== (b) kernel validation: attention bench =="
+timeout -k 30 1800 python benchmarks/attention_bench.py || echo "attention_bench failed rc=$?"
+
+echo "== (c) decode latency =="
+timeout -k 30 1800 python benchmarks/inference_bench.py || echo "inference_bench failed rc=$?"
+
+echo "== done — paste the JSON lines into benchmarks/RESULTS.md =="
