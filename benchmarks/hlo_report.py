@@ -74,7 +74,8 @@ SIZES = {
 
 
 def build_step(size: str, devices: int, per_chip_batch: int, seq: int,
-               remat: str, accum_dtype: str, tp: int = 1):
+               remat: str, accum_dtype: str, tp: int = 1, pp: int = 1,
+               pp_microbatches: int = 0):
     import jax
     import jax.numpy as jnp
     import optax
@@ -100,11 +101,17 @@ def build_step(size: str, devices: int, per_chip_batch: int, seq: int,
     AcceleratorState._reset_state()
     GradientState._reset_state()
     PartialState._reset_state()
-    accelerator = Accelerator(
-        parallelism_config=ParallelismConfig(
-            dp_shard_size=devices // tp, tp_size=tp
+    pcfg_kw = dict(dp_shard_size=devices // (tp * pp), tp_size=tp)
+    if pp > 1:
+        from accelerate_tpu.utils.dataclasses import PipelineParallelConfig
+
+        pcfg_kw.update(
+            pp_size=pp,
+            pp_config=PipelineParallelConfig(
+                num_microbatches=pp_microbatches or 2 * pp
+            ),
         )
-    )
+    accelerator = Accelerator(parallelism_config=ParallelismConfig(**pcfg_kw))
     model = create_llama(config, abstract=True)
     mu_dtype = jnp.bfloat16  # bench.py's BENCH_MU_BF16 default
     model, _opt = accelerator.prepare(
@@ -575,6 +582,12 @@ def main():
     ap.add_argument("--tp", type=int, default=1,
                     help="tensor-parallel degree (composes with fsdp over "
                     "the remaining devices)")
+    ap.add_argument("--pp", type=int, default=1,
+                    help="pipeline-parallel degree (1F1B fused schedule; "
+                    "non-pp subgroup must stay <= 4 — the wide-pp XLA "
+                    "limit)")
+    ap.add_argument("--pp-microbatches", type=int, default=0,
+                    help="1F1B microbatches (default 2*pp)")
     ap.add_argument("--chip", default="v5p", choices=sorted(CHIPS))
     ap.add_argument("--out", default="runs/hlo_report")
     ap.add_argument("--fail-below-mfu", type=float, default=None,
@@ -603,7 +616,8 @@ def main():
     t0 = time.time()
     config, model, step, batch = build_step(
         args.size, args.devices, args.per_chip_batch, args.seq, args.remat,
-        "bf16", tp=args.tp,
+        "bf16", tp=args.tp, pp=args.pp,
+        pp_microbatches=args.pp_microbatches,
     )
     lowered = step.lower(batch)
     t_lower = time.time() - t0
@@ -675,6 +689,13 @@ def main():
     t_ici = ici_bytes / (chip["ici_bw"] * ICI_EFF)
     t_hbm = hbm_traffic / (chip["hbm_bw"] * HBM_EFF)
     step_time = max(t_compute, t_ici, t_hbm)
+    # pipeline bubble: 1F1B idles each stage (n-1)/(m+n-1) of the step —
+    # the roofline's busy time stretches by (m+n-1)/m
+    bubble_factor = 1.0
+    if args.pp > 1:
+        m_mb = args.pp_microbatches or 2 * args.pp
+        bubble_factor = (m_mb + args.pp - 1) / m_mb
+        step_time *= bubble_factor
     mfu_pred = useful_flops_chip / (step_time * chip["peak_bf16"])
     tok_s_chip = tokens_per_chip / step_time
 
@@ -713,10 +734,10 @@ def main():
                    remat=args.remat, attention="blockwise (flash on TPU)"),
         mesh=dict(
             devices=n,
-            layout=(
-                f"fsdp({n // args.tp}) x tp({args.tp})"
-                if args.tp > 1
-                else "fsdp(dp_shard)"
+            layout=" x ".join(
+                [f"fsdp({n // (args.tp * args.pp)})"]
+                + ([f"tp({args.tp})"] if args.tp > 1 else [])
+                + ([f"pp({args.pp})"] if args.pp > 1 else [])
             ),
         ),
         chip=dict(kind=args.chip, **{k: v for k, v in chip.items()}),
@@ -737,6 +758,7 @@ def main():
         roofline=dict(
             t_compute_s=t_compute, t_ici_s=t_ici, t_hbm_s=t_hbm,
             bound=bound, step_time_s=step_time,
+            pp_bubble_factor=round(bubble_factor, 4),
             predicted_tok_s_chip=round(tok_s_chip, 1),
             predicted_mfu=round(mfu_pred, 4),
             assumptions=dict(matmul_eff=MATMUL_EFF, ici_eff=ICI_EFF,
