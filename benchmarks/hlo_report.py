@@ -105,11 +105,10 @@ def build_step(size: str, devices: int, per_chip_batch: int, seq: int,
     if pp > 1:
         from accelerate_tpu.utils.dataclasses import PipelineParallelConfig
 
+        assert pp_microbatches > 0, "caller resolves the microbatch default"
         pcfg_kw.update(
             pp_size=pp,
-            pp_config=PipelineParallelConfig(
-                num_microbatches=pp_microbatches or 2 * pp
-            ),
+            pp_config=PipelineParallelConfig(num_microbatches=pp_microbatches),
         )
     accelerator = Accelerator(parallelism_config=ParallelismConfig(**pcfg_kw))
     model = create_llama(config, abstract=True)
@@ -614,10 +613,15 @@ def main():
         return
 
     t0 = time.time()
+    if args.devices % (args.tp * args.pp):
+        raise SystemExit(
+            f"--devices {args.devices} not divisible by tp*pp = "
+            f"{args.tp * args.pp}"
+        )
+    m_mb = (args.pp_microbatches or 2 * args.pp) if args.pp > 1 else 0
     config, model, step, batch = build_step(
         args.size, args.devices, args.per_chip_batch, args.seq, args.remat,
-        "bf16", tp=args.tp, pp=args.pp,
-        pp_microbatches=args.pp_microbatches,
+        "bf16", tp=args.tp, pp=args.pp, pp_microbatches=m_mb,
     )
     lowered = step.lower(batch)
     t_lower = time.time() - t0
@@ -682,8 +686,9 @@ def main():
     # per chip: read+write params f32, mu bf16, nu f32, grads f32 (sharded 1/n)
     hbm_traffic = (2 * (param_bytes + param_bytes // 2 + param_bytes) + 2 * param_bytes) / n
     # compute path reads the bf16-cast full weights once per fwd and ~twice
-    # per bwd (remat included via recompute fraction below)
-    hbm_traffic += 3 * (param_bytes // 2)
+    # per bwd (remat included via recompute fraction below); under pp each
+    # chip only touches its stage's share of the stack
+    hbm_traffic += 3 * (param_bytes // 2) // max(args.pp, 1)
 
     t_compute = actual_flops_chip / (chip["peak_bf16"] * MATMUL_EFF)
     t_ici = ici_bytes / (chip["ici_bw"] * ICI_EFF)
@@ -693,7 +698,6 @@ def main():
     # the roofline's busy time stretches by (m+n-1)/m
     bubble_factor = 1.0
     if args.pp > 1:
-        m_mb = args.pp_microbatches or 2 * args.pp
         bubble_factor = (m_mb + args.pp - 1) / m_mb
         step_time *= bubble_factor
     mfu_pred = useful_flops_chip / (step_time * chip["peak_bf16"])
@@ -711,7 +715,7 @@ def main():
         # bound on the win (the reference's measured end-to-end +25% on
         # H100 sits well inside it)
         t_c8 = t_compute / args.fp8_speedup
-        st8 = max(t_c8, t_ici, t_hbm)
+        st8 = max(t_c8, t_ici, t_hbm) * bubble_factor
         fp8_variant = dict(
             assumed_matmul_speedup=args.fp8_speedup,
             step_time_s=st8,
@@ -737,8 +741,9 @@ def main():
             layout=" x ".join(
                 [f"fsdp({n // (args.tp * args.pp)})"]
                 + ([f"tp({args.tp})"] if args.tp > 1 else [])
-                + ([f"pp({args.pp})"] if args.pp > 1 else [])
+                + ([f"pp({args.pp}, m={m_mb})"] if args.pp > 1 else [])
             ),
+            pp_microbatches=m_mb,
         ),
         chip=dict(kind=args.chip, **{k: v for k, v in chip.items()}),
         compile_s=round(t_compile, 1),
