@@ -394,3 +394,51 @@ def test_alternating_window_pp_odd_stage_rejected():
     step = acc.train_step(llama_loss, model=model, optimizer=opt)
     with pytest.raises(ValueError, match="scan units"):
         step(batch)
+
+
+@pytest.mark.slow
+def test_interleaved_prepermuted_checkpoint_resume():
+    """save_state mid-training under the pre-permuted interleaved layout:
+    the lazy canonicalization must hand the checkpoint canonical rows, and
+    a fresh process restoring it must continue BIT-IDENTICALLY (layout
+    re-adoption on the first post-restore step)."""
+    import tempfile
+
+    rng = np.random.default_rng(0)
+    data = {"input_ids": rng.integers(0, 256, size=(8, 32)).astype(np.int32)}
+    cfg = LlamaConfig.tiny(num_hidden_layers=8, compute_dtype=jnp.float32)
+    pcfg = lambda: ParallelismConfig(  # noqa: E731
+        pp_size=2, dp_shard_size=4,
+        pp_config=PipelineParallelConfig(
+            num_microbatches=4, schedule="1f1b", num_virtual_stages=2
+        ),
+    )
+
+    def fresh():
+        _reset()
+        acc = Accelerator(parallelism_config=pcfg())
+        model, opt = acc.prepare(create_llama(cfg, seed=0), optax.adamw(1e-3))
+        step = acc.train_step(llama_loss, model=model, optimizer=opt)
+        loader = acc.prepare_data_loader(data, batch_size=8, drop_last=True)
+        return acc, model, opt, step, loader
+
+    with tempfile.TemporaryDirectory() as tmp:
+        ckpt = tmp + "/ckpt"
+        acc, model, opt, step, loader = fresh()
+        for _ in range(2):
+            for batch in loader:
+                step(batch)
+        acc.save_state(ckpt)
+        cont = []
+        for _ in range(2):
+            for batch in loader:
+                cont.append(float(step(batch)))
+
+        acc2, model2, opt2, step2, loader2 = fresh()
+        acc2.load_state(ckpt)
+        resumed = []
+        for _ in range(2):
+            for batch in loader2:
+                resumed.append(float(step2(batch)))
+
+    np.testing.assert_array_equal(np.asarray(resumed), np.asarray(cont))
