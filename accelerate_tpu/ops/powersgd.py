@@ -22,8 +22,12 @@ error feedback, the variant torch ships):
     Ghat = P @ Q'^T                (identical on every replica)
     error' = M - Ghat              (stays local, per replica)
 
-``Q`` persists across steps (warm start). Leaves that are not 2D, or too
-small for ``r (m+n) < m n`` to pay, reduce densely (``psum``), exactly like
+``Q`` persists across steps (warm start) WITHIN a training process; the
+error/Q state lives in the compiled step's carry, not in ``save_state``
+checkpoints — a restart re-warm-starts both (one transient quality blip,
+never divergence; torch's hook state behaves the same unless explicitly
+checkpointed). Leaves that are not 2D, or too small for
+``r (m+n) < m n`` to pay, reduce densely (``psum``), exactly like
 torch's ``min_compression_rate`` gate. The compression is lossy; error
 feedback makes the *accumulated* update unbiased, which is what preserves
 convergence in practice (and in tests/test_powersgd.py's parity check).

@@ -442,3 +442,36 @@ def test_interleaved_prepermuted_checkpoint_resume():
                 resumed.append(float(step2(batch)))
 
     np.testing.assert_array_equal(np.asarray(resumed), np.asarray(cont))
+
+
+@pytest.mark.slow
+def test_interleaved_tp_training_matches_dp():
+    """Interleaved (v=2) 1F1B x tensor parallelism through the fused step —
+    the virtual-stage sibling of the 3D fused-1F1B x tp composition that
+    crashed the SPMD partitioner before the flat-batch microbatch pin."""
+    rng = np.random.default_rng(0)
+    data = {"input_ids": rng.integers(0, 256, size=(8, 32)).astype(np.int32)}
+    cfg = LlamaConfig.tiny(num_hidden_layers=8, compute_dtype=jnp.float32)
+
+    def run(pcfg):
+        _reset()
+        acc = Accelerator(parallelism_config=pcfg)
+        model, opt = acc.prepare(create_llama(cfg, seed=0), optax.sgd(1e-2))
+        step = acc.train_step(llama_loss, model=model, optimizer=opt)
+        loader = acc.prepare_data_loader(data, batch_size=8, drop_last=True)
+        for _ in range(2):
+            for batch in loader:
+                loss = step(batch)
+        return float(loss), np.asarray(
+            jax.device_get(model.params["layers"]["attn"]["q_proj"]["kernel"])
+        )
+
+    l_ref, w_ref = run(ParallelismConfig(dp_shard_size=8))
+    l_il, w_il = run(ParallelismConfig(
+        tp_size=2, pp_size=2, dp_shard_size=2,
+        pp_config=PipelineParallelConfig(
+            num_microbatches=2, num_virtual_stages=2
+        ),
+    ))
+    np.testing.assert_allclose(l_il, l_ref, atol=1e-4)
+    np.testing.assert_allclose(w_il, w_ref, atol=1e-4)
