@@ -1120,6 +1120,16 @@ class Accelerator:
                     (_, (loss, aux)), grads = jax.value_and_grad(
                         wrapped_local, has_aux=True
                     )(p)
+                    if use_scaler:
+                        # unscale BEFORE compression: the persistent
+                        # error-feedback/Q state must live in scale-free
+                        # units or every scaler growth/backoff mis-weights
+                        # the carried residual (the scale's underflow
+                        # protection matters during the backward only)
+                        inv = 1.0 / scaler_state["scale"]
+                        grads = jax.tree_util.tree_map(
+                            lambda g: g * inv, grads
+                        )
                     return loss, aux, grads
 
                 psgd_fn = make_powersgd_grad_fn(
@@ -1128,6 +1138,12 @@ class Accelerator:
                 loss, _aux, grads, psgd_state = psgd_fn(
                     params, psgd_state, *batch
                 )
+                if use_scaler:
+                    # re-apply the scale so the shared accumulate/
+                    # finite-check/unscale path downstream is unchanged
+                    grads = jax.tree_util.tree_map(
+                        lambda g: g * scaler_state["scale"], grads
+                    )
             else:
                 (_, (loss, _aux)), grads = jax.value_and_grad(wrapped, has_aux=True)(params)
             if grad_comm_dtype is not None:

@@ -260,6 +260,15 @@ def fp8_rewrite(fn, min_dim: int = 256):
                 write(v, val)
         return [read(v) for v in jaxpr.outvars]
 
+    # rewritten-program cache: keyed on the call's tree structure, the
+    # dynamic leaves' avals, and the static leaves' values. Without it an
+    # EAGER call (a user debugging with model(params, x), an eval loop off
+    # the jitted path) would re-trace the model and interpret its jaxpr
+    # primitive-by-primitive in Python EVERY call; with it the rewritten
+    # evaluation compiles once per signature (and inlines when the caller
+    # is already inside jit, e.g. the fused train_step).
+    cache: dict = {}
+
     @functools.wraps(fn)
     def wrapped(*args, **kwargs):
         # non-array leaves (python bools/ints/strings steering control flow,
@@ -271,20 +280,42 @@ def fp8_rewrite(fn, min_dim: int = 256):
             i for i, leaf in enumerate(leaves)
             if isinstance(leaf, (jax.Array, np.ndarray))
         ]
-
-        def from_dynamic(dyn):
-            full = list(leaves)
-            for i, v in zip(dyn_idx, dyn):
-                full[i] = v
-            a, kw = jax.tree_util.tree_unflatten(treedef_in, full)
-            return fn(*a, **kw)
-
         dyn = [leaves[i] for i in dyn_idx]
-        closed, shape = jax.make_jaxpr(from_dynamic, return_shape=True)(dyn)
-        out_flat = _eval(
-            closed.jaxpr, closed.consts, *jax.tree_util.tree_leaves(dyn)
-        )
-        treedef = jax.tree_util.tree_structure(shape)
-        return jax.tree_util.tree_unflatten(treedef, out_flat)
+        static = [leaves[i] for i in range(len(leaves)) if i not in set(dyn_idx)]
+        try:
+            key = (
+                treedef_in,
+                tuple(
+                    (getattr(l, "shape", None), str(getattr(l, "dtype", None)))
+                    for l in dyn
+                ),
+                tuple(static),
+            )
+        except TypeError:  # unhashable static leaf: skip caching
+            key = None
+        run = cache.get(key) if key is not None else None
+        if run is None:
+
+            def from_dynamic(dyn):
+                full = list(leaves)
+                for i, v in zip(dyn_idx, dyn):
+                    full[i] = v
+                a, kw = jax.tree_util.tree_unflatten(treedef_in, full)
+                return fn(*a, **kw)
+
+            closed, shape = jax.make_jaxpr(from_dynamic, return_shape=True)(dyn)
+            treedef_out = jax.tree_util.tree_structure(shape)
+
+            def run(dyn, _closed=closed, _treedef=treedef_out):
+                out_flat = _eval(
+                    _closed.jaxpr, _closed.consts,
+                    *jax.tree_util.tree_leaves(dyn),
+                )
+                return jax.tree_util.tree_unflatten(_treedef, out_flat)
+
+            run = jax.jit(run)
+            if key is not None:
+                cache[key] = run
+        return run(dyn)
 
     return wrapped

@@ -351,3 +351,33 @@ def test_nf4_model_forward_close():
         len(set(a) & set(b)) / 8 for a, b in zip(ref_top, out_top)
     ])
     assert overlap >= 0.5, overlap
+
+
+def test_fp8_rewrite_caches_eager_calls():
+    """Eager (non-jitted) calls must not re-trace the model every time: the
+    rewritten program caches per (structure, avals, statics) signature."""
+    from accelerate_tpu.ops.fp8 import fp8_rewrite
+
+    traces = {"n": 0}
+
+    def mlp(p, x, train=False):
+        traces["n"] += 1
+        h = jnp.tanh(x @ p["w1"])
+        if train:
+            h = h * 0.9
+        return h @ p["w2"]
+
+    rng = np.random.default_rng(0)
+    params = {
+        "w1": jnp.asarray(rng.normal(size=(512, 512)), jnp.float32) * 0.02,
+        "w2": jnp.asarray(rng.normal(size=(512, 512)), jnp.float32) * 0.02,
+    }
+    x = jnp.asarray(rng.normal(size=(4, 512)), jnp.float32)
+    fn8 = fp8_rewrite(mlp)
+    fn8(params, x)
+    n_after_first = traces["n"]
+    fn8(params, x)
+    fn8(params, x)
+    assert traces["n"] == n_after_first  # no re-trace on repeat signature
+    fn8(params, x, train=True)  # distinct static signature traces once
+    assert traces["n"] == n_after_first + 1
