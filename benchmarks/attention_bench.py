@@ -14,6 +14,11 @@ benchmarks/attention_results.jsonl.
 
 from __future__ import annotations
 
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))  # runnable as `python benchmarks/x.py`
+
 import argparse
 import json
 import time
@@ -102,9 +107,12 @@ def main():
 
             grad = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
             try:
-                np.asarray(fwd(q, k, v))  # compile + correctness smoke
+                np.asarray(fwd(q, k, v)[0, 0, 0, 0])  # compile + 1-elem smoke
                 if not args.fwd_only:
-                    jax.block_until_ready(grad(q, k, v))
+                    # 1-elem fetch, not block_until_ready: through the axon
+                    # relay block_until_ready returns before execution
+                    # completes, and full-tensor fetches crawl (~20 MB/s)
+                    np.asarray(grad(q, k, v)[0][0, 0, 0, 0])
             except Exception as exc:  # noqa: BLE001 — record, don't die
                 row = {"impl": impl, "seq": seq, "error": str(exc)[:200]}
                 rows.append(row)
@@ -114,7 +122,7 @@ def main():
             t0 = time.perf_counter()
             for _ in range(args.iters):
                 out = fwd(q, k, v)
-            np.asarray(out)
+            np.asarray(out[0, 0, 0, 0])  # 1-elem fetch forces the in-order stream
             fwd_s = (time.perf_counter() - t0) / args.iters
 
             row = {
@@ -128,7 +136,7 @@ def main():
                 t0 = time.perf_counter()
                 for _ in range(args.iters):
                     g = grad(q, k, v)
-                jax.block_until_ready(g)
+                np.asarray(g[0][0, 0, 0, 0])  # 1-elem fetch forces the in-order stream
                 bwd_s = (time.perf_counter() - t0) / args.iters
                 row["fwdbwd_ms"] = round(bwd_s * 1e3, 3)
                 # bwd ~2x fwd flops (dq + dkv) on top of the fwd recompute

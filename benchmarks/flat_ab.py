@@ -7,6 +7,11 @@ regression probe for the per-buffer-overhead fix (utils/flatbuf.py).
 
 from __future__ import annotations
 
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))  # runnable as `python benchmarks/x.py`
+
 import time
 
 import numpy as np

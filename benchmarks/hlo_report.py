@@ -28,6 +28,11 @@ Usage (compile of the 7B config takes a few minutes on one core):
 
 from __future__ import annotations
 
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))  # runnable as `python benchmarks/x.py`
+
 import argparse
 import json
 import math

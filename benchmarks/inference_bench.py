@@ -11,6 +11,11 @@ Prints one JSON line.
 
 from __future__ import annotations
 
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))  # runnable as `python benchmarks/x.py`
+
 import argparse
 import json
 import os
@@ -95,7 +100,8 @@ def big_load_rehearsal(target_gb: float, shard_gb: float = 1.0):
     model = create_llama(config, abstract=True)  # nothing materialized
     t0 = time.perf_counter()
     model = load_checkpoint_and_dispatch(model, ckpt_dir, mesh=mesh)
-    jax.block_until_ready(jax.tree_util.tree_leaves(model.params)[0])
+    _leaf = jax.tree_util.tree_leaves(model.params)[0]
+    np.asarray(_leaf[(0,) * _leaf.ndim])  # 1-elem fetch forces the stream; relay's block_until_ready does not
     load_s = time.perf_counter() - t0
     rss_after = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
 
@@ -165,7 +171,8 @@ def main():
     t0 = time.perf_counter()
     model = create_llama(config, seed=0)
     model = dispatch_model(model, mesh=mesh, rules=tensor_parallel_rules() if n_dev > 1 else None)
-    jax.block_until_ready(jax.tree_util.tree_leaves(model.params)[0])
+    _leaf = jax.tree_util.tree_leaves(model.params)[0]
+    np.asarray(_leaf[(0,) * _leaf.ndim])  # 1-elem fetch forces the stream; relay's block_until_ready does not
     load_s = time.perf_counter() - t0
 
     rng = np.random.default_rng(0)

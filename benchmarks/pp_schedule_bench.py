@@ -15,6 +15,11 @@ Prints one JSON line per (schedule, num_microbatches) config.
 
 from __future__ import annotations
 
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))  # runnable as `python benchmarks/x.py`
+
 import json
 import time
 

@@ -1,0 +1,90 @@
+"""Chip-health phase for the relay-window sweep (`window_sweep.sh`).
+
+Reuses ``bench._chip_health`` (RTT, sustained matmul rate, free-HBM
+staircase — ONE implementation, so the health phase and the chip_health
+block bench.py attaches to its JSON can never disagree) and adds two
+probes bench doesn't need: elementwise bandwidth and the embedding
+scatter-add gradient that window 1 measured at a pathological 4 s.
+
+Window-1 findings (2026-07-31) this encodes:
+- `block_until_ready` is a no-op through the axon relay — only a data
+  fetch forces completion, so every timing here is fetch-forced.
+- The chip is time-shared: pure-matmul programs hit 91-97% of peak while
+  train steps in the same window ran 6x slower than round 1 with huge
+  variance, and ~2 GB allocations RESOURCE_EXHAUSTED-ed on a 16 GB chip.
+  The health row makes each window's numbers interpretable.
+- Host<->device bandwidth through the tunnel is tiny (~20 MB/s):
+  generate test data ON DEVICE and fetch single elements.
+
+Prints partial JSON lines as probes land (a mid-window relay death keeps
+what finished), then one final line with everything.
+"""
+
+from __future__ import annotations
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))  # for `import bench`
+
+import json
+import time
+
+import numpy as np
+
+from bench import _chip_health
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    t0 = time.perf_counter()
+    device = jax.devices()[0]
+    health = {
+        "phase": "health",
+        "ts": time.strftime("%F %T"),
+        "device": str(getattr(device, "device_kind", device.platform)),
+        "devices_s": round(time.perf_counter() - t0, 1),
+    }
+    health.update(_chip_health())
+    print(json.dumps({"partial": health}), flush=True)
+
+    # elementwise HBM bandwidth: 256 MiB bf16 (>> VMEM, so it can't sit in
+    # on-chip memory across iterations), 8 passes, data via iota on device
+    ne = 128 * 1024 * 1024
+
+    @jax.jit
+    def ew(t):
+        x0 = jax.lax.iota(jnp.bfloat16, ne) + t
+
+        def body(h, _):
+            return h * jnp.bfloat16(1.0001), None
+
+        h, _ = jax.lax.scan(body, x0, None, length=8)
+        # full reduction, NOT h[0]: a scalar slice lets XLA dead-code-
+        # eliminate the array and the "bandwidth" becomes scalar math
+        return jnp.float32(jnp.sum(h.astype(jnp.float32)))
+
+    np.asarray(ew(jnp.bfloat16(0.5)))
+    gibs = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        np.asarray(ew(jnp.bfloat16(1.5)))
+        gibs.append(round(2 * 8 * ne * 2 / (time.perf_counter() - t0) / 2**30, 1))
+    health["elemwise_gibs"] = gibs
+    print(json.dumps({"partial": health}), flush=True)
+
+    # embedding-gradient scatter-add (window 1: 4 s — pathological)
+    emb = jax.random.normal(jax.random.PRNGKey(2), (32000, 1024), jnp.float32)
+    ids = jax.random.randint(jax.random.PRNGKey(3), (8, 2048), 0, 32000)
+    gs = jax.jit(jax.grad(lambda e, i: jnp.sum(jnp.take(e, i, axis=0)), argnums=0))
+    np.asarray(gs(emb, ids)[0, 0])
+    t0 = time.perf_counter()
+    np.asarray(gs(emb, ids)[0, 0])
+    health["take_grad_ms"] = round((time.perf_counter() - t0) * 1e3)
+    print(json.dumps(health), flush=True)
+
+
+if __name__ == "__main__":
+    main()

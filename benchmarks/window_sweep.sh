@@ -1,0 +1,44 @@
+#!/bin/bash
+# Relay-window sweep: fired automatically by the uptime watch the moment
+# the axon relay answers. Phases are priority-ordered (VERDICT r4 #1) and
+# individually watchdogged so a mid-window relay death still leaves every
+# earlier phase's data on disk. All output appends to one timestamped log
+# under runs/; each phase prints JSON lines.
+#
+# Window-1 lesson: relay windows last ~35-50 min and degrade progressively
+# — front-load what matters, never trust block_until_ready, keep host<->
+# device transfers tiny.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+STAMP=$(date '+%Y%m%d_%H%M%S')
+LOG="runs/window_sweep_${STAMP}.log"
+echo "== window sweep ${STAMP} ==" | tee -a "$LOG"
+
+phase() {
+  local name=$1 tmo=$2; shift 2
+  echo "== phase ${name} ($(date '+%T')) ==" | tee -a "$LOG"
+  timeout -k 30 "$tmo" "$@" >> "$LOG" 2>&1
+  echo "== phase ${name} rc=$? ($(date '+%T')) ==" | tee -a "$LOG"
+}
+
+# 0. health (~2 min): window quality context for every later number
+phase health 300 python -u benchmarks/window_phases.py
+
+# 1. training throughput — the round's headline artifact (internal
+#    sweep + flash relative-validation gate + chip-health detail).
+#    Outer watchdog must exceed bench.py's internal chain (TPU child +
+#    CPU fallback child) or a hung relay destroys the salvaged JSON held
+#    in the parent's memory.
+export BENCH_TPU_TIMEOUT=1800 BENCH_CPU_TIMEOUT=300
+phase bench 2500 python -u bench.py
+
+# 2. Pallas kernel real-lowering evidence: flash vs blockwise vs xla,
+#    then the GQA + sliding-window variants the kernel optimizes
+phase attn 900 python -u benchmarks/attention_bench.py --seqs 2048 4096 --iters 3
+phase attn_gqa_win 600 python -u benchmarks/attention_bench.py \
+  --seqs 4096 --heads 8 --kv_heads 2 --window 1024 --iters 3
+
+# 3. decode latency vs the reference's published per-token table
+phase decode 900 python -u benchmarks/inference_bench.py
+
+echo "== sweep done ($(date '+%T')) ==" | tee -a "$LOG"
