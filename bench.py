@@ -162,6 +162,28 @@ def _measure(config, starting_batch, steps, seq_len):
     return result
 
 
+def relative_leaf_gate(cand_leaves, base_leaves, ref_leaves, labels, ratio=2.0):
+    """Per-leaf relative numerics gate shared by the bench flash gate and
+    ``benchmarks/kernel_validation.py`` (ONE implementation so the two can
+    never drift): the candidate (bf16 kernel) must track the f32 reference
+    within ``ratio``x of the bf16 baseline's own error, with a small
+    absolute floor for near-zero baselines. Returns (ok, per-leaf dict)."""
+    ok = True
+    details = {}
+    for label, f, b, r in zip(labels, cand_leaves, base_leaves, ref_leaves):
+        err_cand = float(np.abs(f - r).max())
+        err_base = float(np.abs(b - r).max())
+        floor = 1e-3 * max(1.0, float(np.abs(r).max()))
+        passed = err_cand <= max(ratio * err_base, floor)
+        details[label] = {
+            "err_flash": round(err_cand, 6),
+            "err_blockwise": round(err_base, 6),
+            "pass": passed,
+        }
+        ok = ok and passed
+    return ok, details
+
+
 def _flash_is_valid_on_device() -> bool:
     """Quick on-device fwd+bwd check of the Pallas flash kernel against the
     blockwise reference — the kernel was only interpret-mode tested before
@@ -232,17 +254,12 @@ def _flash_is_valid_on_device() -> bool:
                 )
             )(qf, kf, vf)
         )
-        for name, f, b, r in zip(("out", "dq", "dk", "dv"), flash_all, base_all, ref_all):
-            err_flash = float(np.abs(f - r).max())
-            err_base = float(np.abs(b - r).max())
-            floor = 1e-3 * max(1.0, float(np.abs(r).max()))
-            if err_flash > max(2.0 * err_base, floor):
-                sys.stderr.write(
-                    f"bench: flash validation failed on {name}: "
-                    f"err_flash={err_flash:.4g} vs err_blockwise={err_base:.4g}\n"
-                )
-                return False
-        return True
+        ok, details = relative_leaf_gate(
+            flash_all, base_all, ref_all, ("out", "dq", "dk", "dv")
+        )
+        if not ok:
+            sys.stderr.write(f"bench: flash validation failed: {details}\n")
+        return ok
     except Exception as exc:  # noqa: BLE001 — a broken kernel must not kill bench
         sys.stderr.write(f"bench: flash validation failed: {exc}\n")
         return False
@@ -285,11 +302,20 @@ def _chip_health():
 
         np.asarray(mm(a, b))
         rates = []
+        rates_corr = []
+        rtt_s = health.get("rtt_ms", 0.0) / 1e3
         for _ in range(3):
             t0 = time.perf_counter()
             np.asarray(mm(a, b))
-            rates.append(2 * n**3 * 32 / (time.perf_counter() - t0) / 1e12)
+            dt = time.perf_counter() - t0
+            rates.append(2 * n**3 * 32 / dt / 1e12)
+            # RTT-corrected: on the tunneled relay the ~70 ms fetch
+            # round-trip dominates a ~25 ms program; the corrected rate is
+            # the one comparable across windows (window 1: 47 raw / 191
+            # corrected on a healthy chip)
+            rates_corr.append(2 * n**3 * 32 / max(dt - rtt_s, 1e-4) / 1e12)
         health["matmul_tflops"] = [round(r, 1) for r in rates]
+        health["matmul_tflops_rtt_corrected"] = [round(r, 1) for r in rates_corr]
 
         # free-HBM staircase: largest power-of-two GiB allocation that
         # succeeds (other tenants' residency shows up here); jnp.zeros is

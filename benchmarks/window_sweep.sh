@@ -32,8 +32,10 @@ phase health 300 python -u benchmarks/window_phases.py
 export BENCH_TPU_TIMEOUT=1800 BENCH_CPU_TIMEOUT=300
 phase bench 2500 python -u bench.py
 
-# 2. Pallas kernel real-lowering evidence: flash vs blockwise vs xla,
-#    then the GQA + sliding-window variants the kernel optimizes
+# 2. Pallas kernel real-lowering evidence: every entry-point variant
+#    (base/GQA/window/softcap/segments/noncausal/with_lse/ring-shape)
+#    gated against an f32 reference, then timing rows
+phase kernels 1200 python -u benchmarks/kernel_validation.py
 phase attn 900 python -u benchmarks/attention_bench.py --seqs 2048 4096 --iters 3
 phase attn_gqa_win 600 python -u benchmarks/attention_bench.py \
   --seqs 4096 --heads 8 --kv_heads 2 --window 1024 --iters 3
