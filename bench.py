@@ -91,9 +91,13 @@ def detect_peak_flops(device) -> float:
     return PEAK_FLOPS["v5e"] if device.platform == "tpu" else PEAK_FLOPS["cpu"]
 
 
-def _measure(config, starting_batch, steps, seq_len):
+def _measure(config, starting_batch, steps, seq_len, repeats=1):
     """Build a fresh accelerator+model for ``config``, run one fused
-    multi-step program twice (warmup + timed), return the measurement."""
+    multi-step program warmup + ``repeats`` timed calls, return the
+    measurement with the MINIMUM step time. On a time-shared chip
+    (window-1 evidence: 2x run-to-run variance on identical programs)
+    the min is the closest observable to the uncontended rate; on a
+    quiet chip repeats agree and min changes nothing."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -136,10 +140,15 @@ def _measure(config, starting_batch, steps, seq_len):
         device_batches = jax.device_put(batches)
         losses = step_fn(device_batches)
         _ = np.asarray(losses)  # warmup + force real execution (relay is async)
-        t0 = time.perf_counter()
-        losses = step_fn(device_batches)
-        last = float(np.asarray(losses)[-1])  # fetch forces completion
-        dt = time.perf_counter() - t0
+        best = None
+        for _rep in range(max(repeats, 1)):
+            t0 = time.perf_counter()
+            losses = step_fn(device_batches)
+            last = float(np.asarray(losses)[-1])  # fetch forces completion
+            dt = time.perf_counter() - t0
+            if best is None or dt < best[0]:
+                best = (dt, last)
+        dt, last = best
         return batch_size, dt, last
 
     batch_size, dt, loss = run()
@@ -367,14 +376,24 @@ def main(note=None):
     sweep_note = None
     if on_tpu:
         global _CHIP_HEALTH
+        degraded = False
         if os.environ.get("BENCH_HEALTH", "1") == "1":
             _CHIP_HEALTH = _chip_health()
             sys.stderr.write(f"bench: chip health: {_CHIP_HEALTH}\n")
+            rates = _CHIP_HEALTH.get("matmul_tflops_rtt_corrected") or []
+            degraded = bool(rates) and max(rates) < 80.0
         starting_batch = int(os.environ.get("BENCH_BATCH", 8))
         # 32 fused steps per program call: the tunneled relay's dispatch
         # latency is large (steps=4 measured ~half the steps=16 rate), so
-        # amortize harder for the final number
-        steps = int(os.environ.get("BENCH_STEPS", 32))
+        # amortize harder for the final number. On a degraded (contended)
+        # window a 32-step program runs for minutes and eats the watchdog —
+        # drop to 8 and let min-of-repeats recover precision instead.
+        steps = int(os.environ.get("BENCH_STEPS", 8 if degraded else 32))
+        if degraded:
+            sys.stderr.write(
+                "bench: degraded window (matmul < 80 TFLOP/s corrected); "
+                "steps=8\n"
+            )
         default = (os.environ.get("BENCH_REMAT", "minimal"),
                    os.environ.get("BENCH_ATTN", "blockwise"))
         # validate flash FIRST: nothing flash-configured may run (even an
@@ -453,7 +472,8 @@ def main(note=None):
         best = None
         for _, cfg, m in probed[:2]:
             try:
-                full = _measure(cfg, m["batch_size"], steps=steps, seq_len=seq_len)
+                full = _measure(cfg, m["batch_size"], steps=steps, seq_len=seq_len,
+                                repeats=int(os.environ.get("BENCH_REPEATS", 3)))
             except Exception as exc:  # noqa: BLE001
                 sys.stderr.write(f"bench: full-steps re-measure failed: {exc}\n")
                 continue
@@ -468,6 +488,9 @@ def main(note=None):
         if best is None:
             raise RuntimeError("full-steps re-measure failed for every finalist")
         config, measured = best
+        if degraded:
+            extra = "DEGRADED/contended window — treat as a floor, not the chip's rate"
+            sweep_note = f"{sweep_note}; {extra}" if sweep_note else extra
     else:  # CPU smoke mode
         config = LlamaConfig.tiny(max_position_embeddings=seq_len)
         measured = _measure(config, starting_batch=8, steps=2, seq_len=seq_len)
