@@ -381,7 +381,14 @@ def main(note=None):
             _CHIP_HEALTH = _chip_health()
             sys.stderr.write(f"bench: chip health: {_CHIP_HEALTH}\n")
             rates = _CHIP_HEALTH.get("matmul_tflops_rtt_corrected") or []
-            degraded = bool(rates) and max(rates) < 80.0
+            # fail CLOSED: a health probe that errors out (e.g.
+            # RESOURCE_EXHAUSTED mid-probe) is itself evidence of the
+            # contended window the mitigation exists for
+            degraded = (not rates) or max(rates) < 80.0
+        win_note = (
+            "DEGRADED/contended window — treat as a floor, not the chip's rate"
+            if degraded else None
+        )
         starting_batch = int(os.environ.get("BENCH_BATCH", 8))
         # 32 fused steps per program call: the tunneled relay's dispatch
         # latency is large (steps=4 measured ~half the steps=16 rate), so
@@ -437,7 +444,8 @@ def main(note=None):
                 # salvages the LAST printed result, so keep re-emitting the
                 # best-so-far — better a real measured number than a CPU
                 # smoke fallback (the final full-steps emit still wins)
-                _emit(device, cfg, seq_len, dict(m), "preliminary sweep result")
+                _emit(device, cfg, seq_len, dict(m),
+                      "; ".join(x for x in (win_note, "preliminary sweep result") if x))
                 best_probe = _mfu(cfg, m)
             probed.append((_mfu(cfg, m), cfg, m))
         if not probed:
@@ -463,7 +471,8 @@ def main(note=None):
                     f"mfu={_mfu(cfg, m):.3f}\n"
                 )
                 if _mfu(cfg, m) > best_probe:
-                    _emit(device, cfg, seq_len, dict(m), "preliminary sweep result")
+                    _emit(device, cfg, seq_len, dict(m),
+                          "; ".join(x for x in (win_note, "preliminary sweep result") if x))
                     best_probe = _mfu(cfg, m)
                 probed.append((_mfu(cfg, m), cfg, m))
         # the 4-step probes carry a fixed per-call dispatch cost that biases
@@ -472,8 +481,13 @@ def main(note=None):
         best = None
         for _, cfg, m in probed[:2]:
             try:
-                full = _measure(cfg, m["batch_size"], steps=steps, seq_len=seq_len,
-                                repeats=int(os.environ.get("BENCH_REPEATS", 3)))
+                # min-of-repeats is the contention mitigation; on a quiet
+                # chip repeats agree, so spend the watchdog budget only
+                # when the window needs it
+                full = _measure(
+                    cfg, m["batch_size"], steps=steps, seq_len=seq_len,
+                    repeats=int(os.environ.get("BENCH_REPEATS",
+                                               3 if degraded else 1)))
             except Exception as exc:  # noqa: BLE001
                 sys.stderr.write(f"bench: full-steps re-measure failed: {exc}\n")
                 continue
@@ -488,9 +502,8 @@ def main(note=None):
         if best is None:
             raise RuntimeError("full-steps re-measure failed for every finalist")
         config, measured = best
-        if degraded:
-            extra = "DEGRADED/contended window — treat as a floor, not the chip's rate"
-            sweep_note = f"{sweep_note}; {extra}" if sweep_note else extra
+        if win_note:
+            sweep_note = f"{sweep_note}; {win_note}" if sweep_note else win_note
     else:  # CPU smoke mode
         config = LlamaConfig.tiny(max_position_embeddings=seq_len)
         measured = _measure(config, starting_batch=8, steps=2, seq_len=seq_len)
