@@ -148,19 +148,28 @@ def main():
 
     on_tpu = jax.devices()[0].platform == "tpu"
     if on_tpu:
-        config = LlamaConfig(
-            vocab_size=32000,
-            hidden_size=int(os.environ.get("IBENCH_HIDDEN", 2048)),
-            intermediate_size=int(os.environ.get("IBENCH_INTER", 5504)),
-            num_hidden_layers=int(os.environ.get("IBENCH_LAYERS", 24)),
-            num_attention_heads=16,
-            num_key_value_heads=16,
-            max_position_embeddings=2048,
-            param_dtype=jnp.bfloat16,
-        )
+        # size ladder: 1.3B-class first, backing off if the (possibly
+        # contended — window-1 saw other tenants holding most of the
+        # 16 GB) chip can't fit it. A measured small-model row beats a
+        # RESOURCE_EXHAUSTED and says so in the JSON.
+        candidates = [
+            dict(hidden_size=int(os.environ.get("IBENCH_HIDDEN", 2048)),
+                 intermediate_size=int(os.environ.get("IBENCH_INTER", 5504)),
+                 num_hidden_layers=int(os.environ.get("IBENCH_LAYERS", 24))),
+            dict(hidden_size=1024, intermediate_size=2816, num_hidden_layers=16),
+            dict(hidden_size=512, intermediate_size=1408, num_hidden_layers=8),
+        ]
+        configs = [
+            LlamaConfig(
+                vocab_size=32000, num_attention_heads=16,
+                num_key_value_heads=16, max_position_embeddings=2048,
+                param_dtype=jnp.bfloat16, **c,
+            )
+            for c in candidates
+        ]
         prompt_len, new_tokens = 128, 64
     else:
-        config = LlamaConfig.tiny(param_dtype=jnp.bfloat16)
+        configs = [LlamaConfig.tiny(param_dtype=jnp.bfloat16)]
         prompt_len, new_tokens = 16, 8
 
     n_dev = len(jax.devices())
@@ -168,25 +177,43 @@ def main():
     mesh = pcfg.build_device_mesh()
     from accelerate_tpu.parallel.tp import tensor_parallel_rules
 
-    t0 = time.perf_counter()
-    model = create_llama(config, seed=0)
-    model = dispatch_model(model, mesh=mesh, rules=tensor_parallel_rules() if n_dev > 1 else None)
-    _leaf = jax.tree_util.tree_leaves(model.params)[0]
-    np.asarray(_leaf[(0,) * _leaf.ndim])  # 1-elem fetch forces the stream; relay's block_until_ready does not
-    load_s = time.perf_counter() - t0
+    backoff_note = None
+    for i, config in enumerate(configs):
+        try:
+            t0 = time.perf_counter()
+            model = create_llama(config, seed=0)
+            model = dispatch_model(
+                model, mesh=mesh,
+                rules=tensor_parallel_rules() if n_dev > 1 else None,
+            )
+            _leaf = jax.tree_util.tree_leaves(model.params)[0]
+            np.asarray(_leaf[(0,) * _leaf.ndim])  # 1-elem fetch forces the stream
+            load_s = time.perf_counter() - t0
 
-    rng = np.random.default_rng(0)
-    ids = rng.integers(0, config.vocab_size, size=(1, prompt_len)).astype(np.int32)
+            rng = np.random.default_rng(0)
+            ids = rng.integers(
+                0, config.vocab_size, size=(1, prompt_len)
+            ).astype(np.int32)
 
-    # compile + warm
-    out = generate(model, ids, max_new_tokens=new_tokens)
-    _ = np.asarray(out)
+            # compile + warm
+            out = generate(model, ids, max_new_tokens=new_tokens)
+            _ = np.asarray(out)
 
-    t0 = time.perf_counter()
-    out = generate(model, ids, max_new_tokens=new_tokens)
-    _ = np.asarray(out)  # force completion through the relay
-    total_s = time.perf_counter() - t0
-    per_token_s = total_s / new_tokens
+            t0 = time.perf_counter()
+            out = generate(model, ids, max_new_tokens=new_tokens)
+            _ = np.asarray(out)  # force completion through the relay
+            total_s = time.perf_counter() - t0
+            per_token_s = total_s / new_tokens
+            break
+        except Exception as exc:  # noqa: BLE001 — back off and retry smaller
+            if i + 1 >= len(configs):
+                raise
+            backoff_note = (
+                f"h={config.hidden_size} failed "
+                f"({type(exc).__name__}: {str(exc)[:120]}); backing off"
+            )
+            print(json.dumps({"note": backoff_note}), flush=True)
+            jax.clear_caches()
 
     result = {
         "metric": "llama_decode_latency_per_token",
@@ -200,6 +227,7 @@ def main():
             "new_tokens": new_tokens,
             "n_devices": n_dev,
             "generate_total_s": round(total_s, 3),
+            **({"backoff": backoff_note} if backoff_note else {}),
         },
     }
     print(json.dumps(result))

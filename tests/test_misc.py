@@ -419,3 +419,31 @@ def test_ulysses_custom_inner_window_signature():
         return q
 
     make_ulysses_attention(mesh, inner=windowed_inner, window=64)
+
+
+def test_relative_leaf_gate():
+    """bench.relative_leaf_gate — the shared numerics gate for the bench
+    flash check and benchmarks/kernel_validation.py. Window-1 motivation:
+    a bf16-round-off dv (one ulp over an absolute atol) must PASS while a
+    real lowering bug (O(1) error) must FAIL."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(os.path.dirname(__file__), "..", "bench.py")
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    ref = [np.linspace(-1, 1, 64).reshape(8, 8)]
+    base = [ref[0] + 0.03]  # bf16 baseline round-off
+    ok, details = bench.relative_leaf_gate([ref[0] + 0.05], base, ref, ("dv",))
+    assert ok and details["dv"]["pass"]  # 1.7x baseline error: bf16 noise
+
+    ok, details = bench.relative_leaf_gate([ref[0] + 1.0], base, ref, ("dv",))
+    assert not ok  # O(1) error: a real lowering bug must fail
+
+    # near-zero baseline error: the absolute floor keeps exact-match
+    # kernels passing
+    ok, _ = bench.relative_leaf_gate([ref[0]], [ref[0]], ref, ("out",))
+    assert ok
