@@ -19,8 +19,10 @@ from accelerate_tpu import Accelerator
 from accelerate_tpu.models.bert import BertConfig, bert_classification_loss, create_bert
 
 
-def get_dataset(cfg, n=512, seq_len=64, seed=0):
+def get_dataset(cfg, n=512, seq_len=64, seed=0, synthetic=False):
     try:
+        if synthetic:
+            raise RuntimeError("synthetic requested")
         from datasets import load_dataset
         from transformers import AutoTokenizer
 
@@ -68,7 +70,9 @@ def main():
 
     cfg = BertConfig.tiny() if args.tiny else BertConfig.base()
     model = create_bert(cfg, seed=0)
-    data = get_dataset(cfg, seq_len=64)
+    # --tiny is the smoke path: never dial the hub (minutes of retries on
+    # an egress-less host before the fallback kicks in)
+    data = get_dataset(cfg, seq_len=64, synthetic=args.tiny)
 
     steps_per_epoch = len(data["labels"]) // args.batch_size
     schedule = optax.linear_schedule(args.lr, 0.0, steps_per_epoch * args.epochs)
