@@ -32,6 +32,14 @@ phase health 300 python -u benchmarks/window_phases.py
 export BENCH_TPU_TIMEOUT=1800 BENCH_CPU_TIMEOUT=300
 phase bench 2500 python -u bench.py
 
+# 1b. the round-1 calibration config, pinned exactly (no sweep): a
+#     healthy-window measurement here is the second predicted-vs-measured
+#     point for the roofline (runs/hlo_report_r1_calib.md: 60.5k ceiling,
+#     r1 measured 11.1k)
+phase bench_r1_calib 1100 env BENCH_SWEEP=0 BENCH_REMAT=nothing \
+  BENCH_ATTN=xla BENCH_STEPS=8 BENCH_REPEATS=3 BENCH_TPU_TIMEOUT=900 \
+  BENCH_CPU_TIMEOUT=120 python -u bench.py
+
 # 2. Pallas kernel real-lowering evidence: every entry-point variant
 #    (base/GQA/window/softcap/segments/noncausal/with_lse/ring-shape)
 #    gated against an f32 reference, then timing rows
