@@ -3,9 +3,11 @@ examples/by_feature/ddp_comm_hook.py, DDPCommunicationHookType): under SPMD
 the analogue of a DDP comm hook is the gradient reduction dtype —
 ``DistributedDataParallelKwargs(comm_hook="bf16")`` makes gradients
 all-reduce/accumulate in bfloat16 (half the wire bytes), matching the
-reference's bf16 compression hook semantics. PowerSGD is intentionally
-omitted (docs/PARITY.md explains why low-rank compression loses under
-XLA's fused reduce-scatter)."""
+reference's bf16 compression hook semantics. ``--comm_hook powersgd``
+demonstrates the low-rank member of the family (reference
+powerSGD_hook): rank-r factor psums over the ``dp_replicate`` (DCN)
+axis with per-replica error feedback (ops/powersgd.py) — it therefore
+builds a 2-way-replicated mesh."""
 
 from __future__ import annotations
 
@@ -16,18 +18,28 @@ import optax
 
 from accelerate_tpu import Accelerator
 from accelerate_tpu.models.bert import BertConfig, bert_classification_loss, create_bert
+from accelerate_tpu.parallelism_config import ParallelismConfig
 from accelerate_tpu.utils.dataclasses import DistributedDataParallelKwargs
 
 
 def main():
     parser = argparse.ArgumentParser()
-    parser.add_argument("--comm_hook", default="bf16", choices=["no", "fp16", "bf16"])
+    parser.add_argument("--comm_hook", default="bf16",
+                        choices=["no", "fp16", "bf16", "powersgd"])
+    parser.add_argument("--powersgd_rank", type=int, default=4)
     args = parser.parse_args()
 
     handlers = []
-    if args.comm_hook != "no":
+    pcfg = None
+    if args.comm_hook == "powersgd":
+        handlers.append(DistributedDataParallelKwargs(
+            comm_hook="powersgd", powersgd_rank=args.powersgd_rank))
+        # PowerSGD compresses the cross-replica reduction, so the mesh
+        # needs a dp_replicate axis (the slow/DCN one); shard the rest
+        pcfg = ParallelismConfig(dp_replicate_size=2, dp_shard_size=-1)
+    elif args.comm_hook != "no":
         handlers.append(DistributedDataParallelKwargs(comm_hook=args.comm_hook))
-    accelerator = Accelerator(kwargs_handlers=handlers)
+    accelerator = Accelerator(kwargs_handlers=handlers, parallelism_config=pcfg)
     cfg = BertConfig.tiny()
     rng = np.random.default_rng(0)
     data = {
@@ -43,7 +55,9 @@ def main():
         optimizer.zero_grad()
     accelerator.print(
         f"comm_hook={args.comm_hook} final loss={float(loss):.4f} "
-        "(gradients reduced in the compressed dtype)"
+        + ("(rank-%d factors crossed the replica axis)" % args.powersgd_rank
+           if args.comm_hook == "powersgd"
+           else "(gradients reduced in the compressed dtype)")
     )
 
 

@@ -116,6 +116,13 @@ def test_ddp_comm_hook_example():
 
 
 @pytest.mark.slow
+def test_ddp_comm_hook_powersgd_example():
+    result = _run("by_feature/ddp_comm_hook.py", "--comm_hook", "powersgd")
+    assert result.returncode == 0, result.stderr[-2000:]
+    assert "comm_hook=powersgd" in result.stdout
+
+
+@pytest.mark.slow
 def test_pipeline_parallelism_example():
     result = _run(
         "by_feature/pipeline_parallelism.py",
