@@ -1211,6 +1211,12 @@ class Accelerator:
                 params, opt_state, accum, scaler_state = apply_branch(
                     (params, opt_state, accum, scaler_state)
                 )
+            # pin the accum OUTPUT to the grad shardings: the zeroed accum is
+            # a fresh broadcast whose sharding the partitioner picks freely;
+            # left unpinned it can come back replicated, so call N+1's input
+            # sharding differs from call N's and the whole fused program
+            # compiles a second signature (test_train_step_compiles_once_sharded)
+            accum = _pin_grads(accum)
             return (params, opt_state, accum, new_count % (k if k > 1 else 1),
                     scaler_state, psgd_state, loss)
 
@@ -1308,6 +1314,35 @@ class Accelerator:
             "scaler": self.scaler.state if use_scaler else {"scale": jnp.float32(1.0), "good_steps": jnp.int32(0)},
             "psgd": psgd_init,
         }
+        if not abstract_mode:
+            # Commit the initial state NOW with the shardings the compiled
+            # call's outputs will carry. Freshly created arrays (jnp.zeros /
+            # jnp.int32) carry SingleDeviceShardings with no mesh in their
+            # aval, while every output of the compiled call is NamedSharded
+            # over the prepare-time mesh — pjit keys its cache on exactly
+            # that, so without this, call 0 and call 1 compile TWO copies of
+            # the full fused program (a whole extra multi-second XLA compile
+            # inside the first *timed* step, on CPU and the TPU relay alike;
+            # found via benchmarks/overhead_ab.py, pinned by
+            # tests/test_accelerator.py::test_train_step_compiles_once).
+            if self.mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                replicated = NamedSharding(self.mesh, PartitionSpec())
+                state["count"] = jax.device_put(state["count"], replicated)
+                state["scaler"] = jax.device_put(state["scaler"], replicated)
+                # accum lives sharded like the params/grads (its steady
+                # state); replicating it on a >1 mesh would both miss the
+                # cache AND waste memory, so fall back to jit's own
+                # placement when no param shardings exist to mirror
+                accum_sh = grad_shardings if grad_shardings is not None else model.shardings
+                if use_flat or self.mesh.size == 1:
+                    state["accum"] = jax.device_put(state["accum"], replicated)
+                elif accum_sh is not None:
+                    state["accum"] = jax.device_put(state["accum"], accum_sh)
+                # psgd state is committed by init_powersgd_state (mesh-aware)
+            else:
+                state = jax.device_put(state)
 
         def step(*batch):
             if use_flat:

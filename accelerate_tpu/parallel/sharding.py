@@ -75,6 +75,19 @@ def _spec_used_axes(spec: P) -> set:
     return used
 
 
+def _norm_spec(spec: P) -> P:
+    """Strip trailing Nones: ``P(None, 'x', None)`` and ``P(None, 'x')``
+    shard identically, but pjit's executable cache keys on the spec as
+    written — a prepare-time sharding with a trailing None vs the same
+    sharding as a jit output (jax normalizes those) would recompile the
+    whole fused train step on its second call
+    (tests/test_accelerator.py::test_train_step_compiles_once_sharded)."""
+    entries = list(spec)
+    while entries and entries[-1] is None:
+        entries.pop()
+    return P(*entries)
+
+
 def _fsdp_spec_for(shape, mesh, fsdp_axes, base_spec: Optional[P] = None) -> P:
     """Shard the largest not-yet-sharded dim divisible by the fsdp-axes size.
 
@@ -96,7 +109,7 @@ def _fsdp_spec_for(shape, mesh, fsdp_axes, base_spec: Optional[P] = None) -> P:
     _, dim = max(candidates)
     axes_entry = fsdp_axes[0] if len(fsdp_axes) == 1 else tuple(fsdp_axes)
     entries[dim] = axes_entry
-    return P(*entries)
+    return _norm_spec(P(*entries))
 
 
 def infer_shardings(
@@ -133,7 +146,7 @@ def infer_shardings(
             if fsdp_compose_with_rules and not (_spec_used_axes(base_spec) & set(fsdp_axes)):
                 return NamedSharding(mesh, _fsdp_spec_for(shape, mesh, fsdp_axes, base_spec))
         if base_spec is not None:
-            return NamedSharding(mesh, base_spec)
+            return NamedSharding(mesh, _norm_spec(base_spec))
         return NamedSharding(mesh, P())
 
     return jax.tree_util.tree_map_with_path(leaf_sharding, params)

@@ -7,7 +7,11 @@ reference's bf16 compression hook semantics. ``--comm_hook powersgd``
 demonstrates the low-rank member of the family (reference
 powerSGD_hook): rank-r factor psums over the ``dp_replicate`` (DCN)
 axis with per-replica error feedback (ops/powersgd.py) — it therefore
-builds a 2-way-replicated mesh."""
+builds a 2-way-replicated mesh and needs >= 2 devices, e.g.::
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \\
+        python examples/by_feature/ddp_comm_hook.py --comm_hook powersgd
+"""
 
 from __future__ import annotations
 

@@ -296,3 +296,67 @@ def test_trigger_sync_in_backward_keeps_cadence():
         with accelerator2.accumulate():
             flags2.append(accelerator2.sync_gradients)
     assert flags2 == [True, False, False, True, False, False, False, True]
+
+
+def test_train_step_compiles_once():
+    """The fused step must hit ONE jit signature across calls: freshly
+    created initial state (accum/count/scaler) carries no mesh in its
+    avals while the compiled call's outputs are NamedSharded over the
+    prepare-time mesh, and pjit keys its cache on exactly that — the
+    regression was a whole second compile of the full fused program
+    inside the first timed step (multi-second on CPU, tens of relay
+    seconds on TPU). train_step commits the state up front."""
+    from accelerate_tpu.state import AcceleratorState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+    acc = make_accelerator()
+    model = RegressionModel()
+    opt = optax.sgd(LR)
+    data = make_regression_data(64)
+    loader = acc.prepare_data_loader(data, batch_size=16, drop_last=True)
+    model, opt = acc.prepare(model, opt)
+    for flatten in ("auto", False):
+        step = acc.train_step(
+            regression_loss, model=model, optimizer=opt, flatten_params=flatten
+        )
+        for batch in loader:
+            step(batch)
+        assert step.jitted._cache_size() == 1, (
+            f"flatten_params={flatten}: fused step compiled "
+            f"{step.jitted._cache_size()} signatures; expected 1"
+        )
+
+
+def test_train_step_compiles_once_sharded():
+    """Same invariant with genuinely PARTITIONED params (FSDP tiny llama —
+    RegressionModel's scalar params would be fully replicated and take the
+    same flat/replicated branch as the unsharded test): the initial accum
+    must adopt the grad shardings up front."""
+    from accelerate_tpu.models.llama import LlamaConfig, create_llama, llama_loss
+    from accelerate_tpu.state import AcceleratorState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+    acc = make_accelerator(
+        parallelism_config=ParallelismConfig(dp_shard_size=len(jax.devices()))
+    )
+    model = create_llama(LlamaConfig.tiny(), seed=0)
+    model, opt = acc.prepare(model, optax.sgd(LR))
+    # the partitioned-accum branch must actually be in play
+    assert model.shardings is not None and not all(
+        getattr(s, "is_fully_replicated", False)
+        for s in jax.tree_util.tree_leaves(model.shardings)
+    )
+    step = acc.train_step(llama_loss, model=model, optimizer=opt)
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": jnp.asarray(
+        rng.integers(0, 256, size=(8, 16)), jnp.int32)}
+    for _ in range(3):
+        step(batch)
+    assert step.jitted._cache_size() == 1, (
+        f"fused step compiled {step.jitted._cache_size()} signatures on the "
+        "sharded mesh; expected 1"
+    )
