@@ -11,6 +11,7 @@ just the pp mesh axis.
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from typing import Optional
 
 import jax
@@ -20,6 +21,10 @@ from jax import lax
 from .model import Model
 
 __all__ = ["generate", "prepare_inference"]
+
+# compiled generate() programs kept per Model (serving loops with varying
+# prompt lengths compile per length; this caps host-side executable count)
+_GENERATE_CACHE_MAX = 16
 
 
 def generate(
@@ -61,53 +66,93 @@ def generate(
     if pad_token_id is None:
         pad_token_id = eos_token_id if eos_token_id is not None else 0
 
-    # prefill: ONE full forward fills the cache (O(S) matmul work vs O(S²)
-    # for token-by-token decode over the prompt)
-    logits, cache = prefill_fn(config, model.params, input_ids, total_len)
-
-    key = jax.random.key(seed)
-
-    def sample(logits, key):
-        if temperature <= 0.0:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        logits = logits / temperature
-        # top_k in (None, 0) means unfiltered (HF convention for 0)
-        if top_k is not None and 0 < top_k < logits.shape[-1]:
-            kth = lax.top_k(logits, top_k)[0][..., -1:]
-            logits = jnp.where(logits < kth, -jnp.inf, logits)
-        if top_p is not None and top_p < 1.0:
-            # nucleus: keep the smallest prefix of the sorted distribution
-            # with cumulative probability >= top_p (the top token always
-            # survives — the cumulative sum is exclusive, so element 0 is 0)
-            sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
-            probs = jax.nn.softmax(sorted_logits, axis=-1)
-            cum = jnp.cumsum(probs, axis=-1) - probs
-            cutoff_idx = jnp.maximum(
-                jnp.sum((cum < top_p).astype(jnp.int32), axis=-1) - 1, 0
-            )
-            cutoff = jnp.take_along_axis(
-                sorted_logits, cutoff_idx[..., None], axis=-1
-            )
-            logits = jnp.where(logits < cutoff, -jnp.inf, logits)
-        return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
-
-    done0 = jnp.zeros((b,), dtype=bool)
-
-    def decode_body(carry, t):
-        cache, logits, key, done = carry
-        key, sub = jax.random.split(key)
-        token = sample(logits, sub)
-        if eos_token_id is not None:
-            token = jnp.where(done, jnp.int32(pad_token_id), token)
-            done = done | (token == eos_token_id)
-        logits, cache = decode_fn(config, model.params, cache, token[:, None], t)
-        return (cache, logits, key, done), token
-
-    (_, _, _, _), new_tokens = lax.scan(
-        decode_body, (cache, logits, key, done0),
-        prompt_len + jnp.arange(max_new_tokens),
+    # ONE jitted end-to-end program (prefill + decode scan), cached on the
+    # model. Building it eagerly per call would re-trace everything every
+    # time — decode_body is a fresh closure, so even lax.scan's internal
+    # cache misses and each generate() paid a full recompile (3.4 s/call
+    # for the tiny model on CPU; a relay-side compile per timed call on TPU
+    # — the train-step double-compile bug's sibling). The key holds only
+    # STRUCTURAL choices (shapes + which sampling branches exist);
+    # temperature/top_p/token ids are traced operands, so a serving loop
+    # varying them per request reuses one program. Varying prompt lengths
+    # still compile per length (static shapes) — pass ``pad_to`` to bucket
+    # them; an LRU bound caps the compiled-program count either way.
+    temp_on = temperature > 0.0
+    top_k_width = (
+        top_k if (temp_on and top_k is not None and 0 < top_k < config.vocab_size)
+        else None
+    )  # structural: sets the lax.top_k width
+    top_p_on = temp_on and top_p is not None and top_p < 1.0
+    eos_on = eos_token_id is not None
+    cache_key = (
+        type(config).__name__, b, prompt_len, total_len, max_new_tokens,
+        temp_on, top_k_width, top_p_on, eos_on,
     )
-    return jnp.concatenate([input_ids, new_tokens.T], axis=1)
+    jit_cache = getattr(model, "_generate_cache", None)
+    if jit_cache is None:
+        jit_cache = model._generate_cache = OrderedDict()
+    run = jit_cache.get(cache_key)
+    if run is not None:
+        jit_cache.move_to_end(cache_key)
+    else:
+
+        def sample(logits, key, temp, p_threshold):
+            if not temp_on:
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            logits = logits / temp
+            # top_k in (None, 0) means unfiltered (HF convention for 0)
+            if top_k_width is not None:
+                kth = lax.top_k(logits, top_k_width)[0][..., -1:]
+                logits = jnp.where(logits < kth, -jnp.inf, logits)
+            if top_p_on:
+                # nucleus: keep the smallest prefix of the sorted
+                # distribution with cumulative probability >= top_p (the top
+                # token always survives — the cumulative sum is exclusive,
+                # so element 0 is 0)
+                sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+                probs = jax.nn.softmax(sorted_logits, axis=-1)
+                cum = jnp.cumsum(probs, axis=-1) - probs
+                cutoff_idx = jnp.maximum(
+                    jnp.sum((cum < p_threshold).astype(jnp.int32), axis=-1) - 1, 0
+                )
+                cutoff = jnp.take_along_axis(
+                    sorted_logits, cutoff_idx[..., None], axis=-1
+                )
+                logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+            return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+        def _run(params, input_ids, key, temp, p_threshold, eos_id, pad_id):
+            # prefill: ONE full forward fills the cache (O(S) matmul work
+            # vs O(S²) for token-by-token decode over the prompt)
+            logits, cache = prefill_fn(config, params, input_ids, total_len)
+            done0 = jnp.zeros((b,), dtype=bool)
+
+            def decode_body(carry, t):
+                cache, logits, key, done = carry
+                key, sub = jax.random.split(key)
+                token = sample(logits, sub, temp, p_threshold)
+                if eos_on:
+                    token = jnp.where(done, pad_id, token)
+                    done = done | (token == eos_id)
+                logits, cache = decode_fn(config, params, cache, token[:, None], t)
+                return (cache, logits, key, done), token
+
+            (_, _, _, _), new_tokens = lax.scan(
+                decode_body, (cache, logits, key, done0),
+                prompt_len + jnp.arange(max_new_tokens),
+            )
+            return jnp.concatenate([input_ids, new_tokens.T], axis=1)
+
+        run = jit_cache[cache_key] = jax.jit(_run)
+        while len(jit_cache) > _GENERATE_CACHE_MAX:
+            jit_cache.popitem(last=False)
+    return run(
+        model.params, input_ids, jax.random.key(seed),
+        jnp.float32(temperature if temp_on else 1.0),
+        jnp.float32(top_p if top_p_on else 1.0),
+        jnp.int32(eos_token_id if eos_on else -1),
+        jnp.int32(pad_token_id),
+    )
 
 
 def prepare_inference(model: Model, mesh=None, rules=None) -> Model:

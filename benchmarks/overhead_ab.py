@@ -22,17 +22,15 @@ Prints one JSON line per framework plus a ratio line.
 
 from __future__ import annotations
 
-import os as _os
+import os
 import sys as _sys
 
-_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))  # runnable as `python benchmarks/x.py`
+_sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))  # runnable as `python benchmarks/x.py`
 
 import json
 import time
 
 import numpy as np
-
-import os
 
 HIDDEN = int(os.environ.get("AB_HIDDEN", "256"))
 BATCH = int(os.environ.get("AB_BATCH", "32"))

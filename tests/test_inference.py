@@ -211,3 +211,31 @@ def test_generate_top_k_zero_means_unfiltered_and_positional_compat():
     # still binds: sampling params are keyword-only
     pos = np.asarray(generate(model, prompt, 4, 0.8, 5))
     np.testing.assert_array_equal(plain, pos)
+
+
+def test_generate_caches_compiled_program():
+    """generate() must reuse ONE compiled program across calls — including
+    calls varying temperature/top_p/seed (traced operands, not cache keys).
+    The regression was a full re-trace+recompile per call (runs/overhead_ab.md)."""
+    import jax.numpy as jnp
+
+    from accelerate_tpu.inference import generate
+    from accelerate_tpu.models.llama import LlamaConfig, create_llama
+
+    cfg = LlamaConfig.tiny(param_dtype=jnp.bfloat16)
+    model = create_llama(cfg, seed=0)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, size=(1, 16)).astype(np.int32)
+
+    out1 = generate(model, ids, max_new_tokens=8, temperature=0.7,
+                    top_p=0.9, eos_token_id=5)
+    out2 = generate(model, ids, max_new_tokens=8, temperature=1.3,
+                    top_p=0.8, eos_token_id=5, seed=3)
+    assert out1.shape == out2.shape == (1, 24)
+    assert len(model._generate_cache) == 1
+
+    # structural change (greedy: no sampling branches) compiles a second
+    # program; repeating it stays at two
+    generate(model, ids, max_new_tokens=8)
+    generate(model, ids, max_new_tokens=8, seed=7)
+    assert len(model._generate_cache) == 2
