@@ -21,8 +21,9 @@ phase() {
   echo "== phase ${name} rc=$? ($(date '+%T')) ==" | tee -a "$LOG"
 }
 
-# 0. health (~2 min): window quality context for every later number
-phase health 300 python -u benchmarks/window_phases.py
+# 0. health (~2 min on a healthy chip): window quality context for every
+#    later number; 480 s so a degraded window still yields partial rows
+phase health 480 python -u benchmarks/window_phases.py
 
 # 1. training throughput — the round's headline artifact (internal
 #    sweep + flash relative-validation gate + chip-health detail).
