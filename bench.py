@@ -539,11 +539,12 @@ def _compile_report_summary():
             "predicted_tok_s_chip": roof["predicted_tok_s_chip"],
             "config": f"{report['model']['size']} on "
                       f"{report['mesh']['devices']}x {report['chip']['kind']}",
-            # predictor calibrated against the one hardware datum (r1's
-            # 10.3%-MFU v5e run): the roofline over-predicted that untuned
-            # small-matmul config 5.45x, so the prediction is a ceiling
-            # with a /5.45 worst-case floor — see the calibration section
-            "calibration": "ceiling; measured floor = /5.45 (r1 datum)",
+            # predictor calibration: the r1 hardware datum demonstrably
+            # contained a full in-window recompile (true MFU 0.18-0.68,
+            # bracketing the prediction); /5.45 is kept as a deliberately
+            # conservative floor — see the index's calibration sections
+            "calibration": ("ceiling; conservative floor = /5.45 (r1 datum, "
+                            "known compile-contaminated — see index)"),
             "see": "runs/hlo_report_index.md",
         }
     except Exception:
