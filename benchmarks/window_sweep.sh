@@ -52,4 +52,9 @@ phase attn_gqa_win 600 python -u benchmarks/attention_bench.py \
 # 3. decode latency vs the reference's published per-token table
 phase decode 900 python -u benchmarks/inference_bench.py
 
+# 4. tail phase (only if the window survives): flat-buffer A/B — the
+#    historical "~1 s/step" claim was measured pre-compile-fix and needs
+#    a clean re-measure on the relay
+phase flat_ab 900 python -u benchmarks/flat_ab.py
+
 echo "== sweep done ($(date '+%T')) ==" | tee -a "$LOG"
