@@ -360,3 +360,28 @@ def test_train_step_compiles_once_sharded():
         f"fused step compiled {step.jitted._cache_size()} signatures on the "
         "sharded mesh; expected 1"
     )
+
+
+def test_eager_loop_compiles_once():
+    """The eager backward/step loop must also hold one jit signature per
+    function across calls (same invariant as the fused step; the grad fn
+    is cached by (loss_fn, model, num_steps) identity)."""
+    from accelerate_tpu.state import AcceleratorState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+    acc = make_accelerator()
+    model = RegressionModel()
+    opt = optax.sgd(LR)
+    data = make_regression_data(64)
+    loader = acc.prepare_data_loader(data, batch_size=16, drop_last=True)
+    model, opt = acc.prepare(model, opt)
+    for batch in loader:
+        acc.backward(regression_loss, batch)
+        opt.step()
+        opt.zero_grad()
+    assert len(acc._grad_fns) == 1
+    (grad_fn,) = acc._grad_fns.values()
+    assert grad_fn._cache_size() == 1
+    assert opt._update_fn._cache_size() == 1
