@@ -64,14 +64,16 @@ def main():
     def mk(b=B, s=S, h=H, d=D):
         return jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.bfloat16)
 
-    def run_case(name, flash_fn, ref_fn, labels, sq=S, skv=S, h_kv=None):
+    D_BIG = 128  # llama-class head_dim; different VMEM tiling than 64
+
+    def run_case(name, flash_fn, ref_fn, labels, sq=S, skv=S, h_kv=None, d=D):
         """Shared scaffold: jit (fwd + grads) for candidate and reference,
         fetch, gate, print one JSON row, count failures."""
         nonlocal failures
         t0 = time.time()
-        q = mk(s=sq)
-        k = mk(s=skv, h=h_kv or H)
-        v = mk(s=skv, h=h_kv or H)
+        q = mk(s=sq, d=d)
+        k = mk(s=skv, h=h_kv or H, d=d)
+        v = mk(s=skv, h=h_kv or H, d=d)
         qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
 
         def loss_of(fn):
@@ -112,13 +114,16 @@ def main():
 
     GRADS = ("out", "dq", "dk", "dv")
 
-    def simple(name, h_kv=None, **kwargs):
+    def simple(name, h_kv=None, sq=S, d=D, **kwargs):
         run_case(
             name,
             lambda q, k, v: flash_attention(q, k, v, **BLOCKS, **kwargs),
             lambda q, k, v: blockwise_attention(q, k, v, **kwargs),
             GRADS,
             h_kv=h_kv,
+            sq=sq,
+            skv=sq,
+            d=d,
         )
 
     simple("base_causal", causal=True)
@@ -130,6 +135,11 @@ def main():
         np.repeat(np.arange(4), S // 4)[None, :].repeat(B, 0), jnp.int32
     )
     simple("segment_ids", causal=True, segment_ids=segs)
+    # shape-robustness: non-block-aligned sequence (pad/mask path) and the
+    # llama-class head_dim (different VMEM tiling) — classic real-lowering
+    # breakers that interpret mode cannot vouch for
+    simple("seq_1792_unaligned", sq=1792, causal=True)
+    simple("head_dim_128", d=D_BIG, causal=True)
 
     # with_lse: out AND lse, plus the lse-cotangent backward (ring merge path)
     def block_with_lse(causal):
