@@ -29,7 +29,12 @@ def main():
 
     n_dev = len(jax.devices())
     tp = args.tp or n_dev
-    pcfg = ParallelismConfig(tp_size=tp) if tp > 1 else ParallelismConfig()
+    # the mesh must cover every device: tp over the requested group, the
+    # remainder as (replicated-weight) data shards
+    pcfg = (
+        ParallelismConfig(tp_size=tp, dp_shard_size=-1)
+        if tp > 1 else ParallelismConfig()
+    )
     mesh = pcfg.build_device_mesh()
 
     cfg = LlamaConfig.tiny() if args.preset == "tiny" else LlamaConfig.llama2_7b()
