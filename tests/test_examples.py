@@ -219,3 +219,10 @@ def test_pod_submission_templates():
         body = open(os.path.join(root, sh)).read()
         assert body.startswith("#!/bin/bash")
         assert "accelerate-tpu launch" in body
+
+
+@pytest.mark.slow
+def test_big_model_inference_example():
+    result = _run("big_model_inference.py", "--preset", "tiny", "--tp", "2")
+    assert result.returncode == 0, result.stderr[-2000:]
+    assert "ms/token" in result.stdout
