@@ -347,6 +347,18 @@ def _chip_health():
 def main(note=None):
     import jax
 
+    # persistent compilation cache: bench runs as parent->child subprocesses
+    # and relay windows repeat the same programs — without this every child
+    # pays every compile again (20-40 s each through the relay). Harmless
+    # when unsupported; min-compile-time filter keeps tiny programs out.
+    try:
+        cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                                   "/tmp/accelerate_tpu_jax_cache")
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+    except Exception:  # noqa: BLE001 — cache is an optimization only
+        pass
+
     if os.environ.get("BENCH_FORCE_CPU") == "1":
         # env JAX_PLATFORMS is NOT enough: a sitecustomize-registered TPU
         # plugin can override platform selection via jax config at interpreter

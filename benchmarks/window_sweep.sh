@@ -10,6 +10,9 @@
 # device transfers tiny.
 set -uo pipefail
 cd "$(dirname "$0")/.."
+# persistent compile cache shared by every phase (and with bench.py's
+# default): repeat windows and sibling processes skip identical compiles
+export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-/tmp/accelerate_tpu_jax_cache}"
 STAMP=$(date '+%Y%m%d_%H%M%S')
 LOG="runs/window_sweep_${STAMP}.log"
 echo "== window sweep ${STAMP} ==" | tee -a "$LOG"
