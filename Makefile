@@ -9,10 +9,17 @@
 PY := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu python
 PY_SLOW := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu RUN_SLOW=1 python
 
-.PHONY: test test_all test_core test_data test_parallel test_models test_cli test_big_modeling bench
+.PHONY: test test_all test_core test_data test_parallel test_models test_cli test_big_modeling test-fault bench
 
 test:
 	$(PY) -m pytest tests/ -q
+
+# durable-checkpointing suite (docs/fault_tolerance.md): atomic commit,
+# kill-mid-save rollback via ACCELERATE_TPU_FAULT_INJECT, preemption,
+# health watchdog, supervisor backoff/crash-loop — fast, on 8 virtual
+# CPU devices (XLA_FLAGS comes from tests/conftest.py)
+test-fault:
+	$(PY) -m pytest tests/test_durability.py tests/test_checkpointing.py -q
 
 test_all:
 	$(PY_SLOW) -m pytest tests/test_state.py tests/test_operations.py tests/test_parallelism_config.py tests/test_accelerator.py tests/test_checkpointing.py tests/test_tracking.py tests/test_data_loader.py tests/test_data_shard_info.py tests/test_misc.py tests/test_cli.py tests/test_big_modeling.py tests/test_losses.py tests/test_flatbuf.py tests/test_local_sgd.py tests/test_api_parity.py tests/test_hlo_analysis.py tests/test_tracking_fakes.py tests/test_powersgd.py -q

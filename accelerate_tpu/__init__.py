@@ -47,6 +47,19 @@ _LAZY = {
     "LocalSGD": ("local_sgd", "LocalSGD"),
     "GeneralTracker": ("tracking", "GeneralTracker"),
     "find_executable_batch_size": ("utils.memory", "find_executable_batch_size"),
+    "wait_for_async_saves": ("checkpointing", "wait_for_async_saves"),
+    "list_checkpoints": ("checkpointing", "list_checkpoints"),
+    "verify_checkpoint": ("checkpointing", "verify_checkpoint"),
+    "is_checkpoint_committed": ("checkpointing", "is_checkpoint_committed"),
+    "CheckpointError": ("utils.fault", "CheckpointError"),
+    "CheckpointNotFoundError": ("utils.fault", "CheckpointNotFoundError"),
+    "CheckpointUncommittedError": ("utils.fault", "CheckpointUncommittedError"),
+    "CheckpointCorruptError": ("utils.fault", "CheckpointCorruptError"),
+    "CheckpointComponentMissingError": ("utils.fault", "CheckpointComponentMissingError"),
+    "TrainingHealthError": ("utils.fault", "TrainingHealthError"),
+    "TrainingHealthConfig": ("utils.dataclasses", "TrainingHealthConfig"),
+    "install_preemption_handler": ("utils.fault", "install_preemption_handler"),
+    "preemption_requested": ("utils.fault", "preemption_requested"),
 }
 
 

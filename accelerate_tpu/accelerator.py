@@ -45,7 +45,9 @@ from .utils.dataclasses import (
     KwargsHandler,
     MixedPrecisionPolicy,
     ProjectConfiguration,
+    TrainingHealthConfig,
 )
+from .utils.fault import TrainingHealthError
 
 logger = get_logger(__name__)
 
@@ -140,6 +142,7 @@ class Accelerator:
         device_placement: bool = True,
         step_scheduler_with_optimizer: bool = True,
         kwargs_handlers: Optional[Sequence[KwargsHandler]] = None,
+        health_config: Optional[TrainingHealthConfig] = None,
     ):
         if project_config is not None:
             self.project_configuration = project_config
@@ -163,6 +166,8 @@ class Accelerator:
                 dataloader_config = handler
             elif isinstance(handler, GradientAccumulationPlugin) and gradient_accumulation_plugin is None:
                 gradient_accumulation_plugin = handler
+            elif isinstance(handler, TrainingHealthConfig) and health_config is None:
+                health_config = handler
 
         self.dataloader_config = dataloader_config or DataLoaderConfiguration()
         if fsdp_plugin is None and os.environ.get("ACCELERATE_USE_FSDP", "") == "true":
@@ -214,7 +219,20 @@ class Accelerator:
         self._forced_sync = False
         self._in_accumulate = False
 
+        # training health watchdog (docs/fault_tolerance.md)
+        self.health_config = health_config or TrainingHealthConfig()
+        self._bad_step_count = 0
+        self._last_committed_checkpoint: Optional[str] = None
+
         self.mesh = self.state.get_device_mesh()
+
+        # Preemption-aware saves: under `accelerate launch --handle_preemption`
+        # the supervisor sets this flag so every worker checkpoints on
+        # SIGTERM/SIGINT and exits cleanly (utils/fault.py).
+        from .utils.environment import parse_flag_from_env
+
+        if parse_flag_from_env("ACCELERATE_HANDLE_PREEMPTION"):
+            self.install_preemption_handler()
 
     # ------------------------------------------------------------- properties
     @property
@@ -769,14 +787,16 @@ class Accelerator:
         pc = self.project_configuration
         if input_dir is None and pc.automatic_checkpoint_naming and pc.project_dir:
             # a fresh process restarts iteration at 0 — fast-forward past the
-            # checkpoints already on disk so the next save doesn't overwrite
-            from .utils.constants import CHECKPOINT_DIR_PREFIX
+            # checkpoints already on disk so the next save doesn't overwrite.
+            # checkpoint_index-based listing skips `.tmp` staging leftovers
+            # from an interrupted save (a bare int() over listdir would crash
+            # on "checkpoint_2.tmp").
+            from .checkpointing import checkpoint_index, list_checkpoints
 
             base = os.path.join(pc.project_dir, "checkpoints")
             indices = [
-                int(d.rsplit("_", 1)[-1])
-                for d in os.listdir(base)
-                if d.startswith(CHECKPOINT_DIR_PREFIX)
+                checkpoint_index(os.path.basename(p))
+                for p in list_checkpoints(base)
             ]
             if indices:
                 pc.iteration = max(indices) + 1
@@ -1552,18 +1572,107 @@ class Accelerator:
             hook(self._models, None, output_dir)
         self._touch_heartbeat()  # a long orbax write is progress, not a hang
         result = save_accelerator_state(self, output_dir, **save_kwargs)
+        if not save_kwargs.get("async_save"):
+            self._last_committed_checkpoint = result
         self._touch_heartbeat()
         return result
 
     def load_state(self, input_dir: Optional[str] = None, **load_kwargs) -> None:
-        from .checkpointing import _resolve_dir, load_accelerator_state
+        from .checkpointing import _resolve_dir, load_accelerator_state, wait_for_async_saves
 
+        # join (and commit) any in-flight async save first, so latest-committed
+        # resolution below can see it
+        wait_for_async_saves()
         input_dir = _resolve_dir(self, input_dir, for_save=False)
         for hook in self._load_state_pre_hooks:
             hook(self._models, input_dir)
         self._touch_heartbeat()
         load_accelerator_state(self, input_dir, **load_kwargs)
         self._touch_heartbeat()
+
+    def wait_for_async_saves(self) -> None:
+        """Join in-flight async checkpoint writes and run their deferred
+        atomic commits (module-level :func:`checkpointing.wait_for_async_saves`)."""
+        from .checkpointing import wait_for_async_saves
+
+        wait_for_async_saves()
+
+    def install_preemption_handler(self, **kwargs) -> bool:
+        """Checkpoint-then-exit on SIGTERM/SIGINT (TPU preemption /
+        maintenance eviction). See :func:`utils.fault.install_preemption_handler`;
+        auto-enabled under ``accelerate-tpu launch --handle_preemption``."""
+        from .utils.fault import install_preemption_handler
+
+        return install_preemption_handler(self, **kwargs)
+
+    # ------------------------------------------------------- health watchdog
+    def check_step_health(self, loss=None, grads=None) -> bool:
+        """Training health watchdog: validate this step's ``loss`` (and, with
+        ``health_config.check_grads``, the gradient pytree) for NaN/Inf and
+        apply the configured policy. Returns True when the step is healthy
+        (callers should then ``optimizer.step()`` as usual) and False when
+        the step must be discarded:
+
+        * ``"raise"`` — raise :class:`TrainingHealthError` immediately;
+        * ``"skip"`` — zero the accumulated grads and continue;
+        * ``"restore"`` — reload the newest committed checkpoint, then
+          continue.
+
+        ``max_bad_steps`` consecutive unhealthy steps raise regardless of
+        policy. Note this is a host-side sync point (it reads the loss
+        value), so call it at a cadence you can afford — every step on CPU
+        tests, every N steps under a fused train_step at scale."""
+        cfg = self.health_config
+        healthy = True
+        if loss is not None:
+            healthy = bool(np.all(np.isfinite(np.asarray(jax.device_get(loss)))))
+        if healthy and grads is None and cfg.check_grads:
+            for opt in self._optimizers:
+                if opt._accum_grads is not None:
+                    grads = opt._accum_grads
+                    break
+        if healthy and grads is not None and cfg.check_grads:
+            for leaf in jax.tree_util.tree_leaves(grads):
+                if hasattr(leaf, "dtype") and jnp.issubdtype(
+                    jnp.asarray(leaf).dtype, jnp.floating
+                ):
+                    if not bool(np.all(np.isfinite(np.asarray(jax.device_get(leaf))))):
+                        healthy = False
+                        break
+        if healthy:
+            self._bad_step_count = 0
+            return True
+
+        self._bad_step_count += 1
+        if cfg.nonfinite_policy == "raise":
+            raise TrainingHealthError(
+                f"non-finite loss/gradients at step {self.step} "
+                f"(nonfinite_policy='raise')"
+            )
+        if self._bad_step_count >= cfg.max_bad_steps:
+            raise TrainingHealthError(
+                f"{self._bad_step_count} consecutive non-finite steps — "
+                f"exceeded max_bad_steps={cfg.max_bad_steps} under "
+                f"nonfinite_policy={cfg.nonfinite_policy!r}"
+            )
+        if cfg.nonfinite_policy == "skip":
+            logger.warning(
+                f"non-finite loss/gradients at step {self.step}; skipping "
+                f"step ({self._bad_step_count}/{cfg.max_bad_steps} consecutive)"
+            )
+            for opt in self._optimizers:
+                opt.zero_grad()
+            return False
+        # "restore"
+        logger.warning(
+            f"non-finite loss/gradients at step {self.step}; restoring last "
+            f"committed checkpoint ({self._bad_step_count}/{cfg.max_bad_steps} "
+            f"consecutive)"
+        )
+        for opt in self._optimizers:
+            opt.zero_grad()
+        self.load_state(self._last_committed_checkpoint)
+        return False
 
     def save_model(self, model: Model, save_directory: str, max_shard_size: str = "10GB", safe_serialization: bool = True):
         from .checkpointing import save_model_checkpoint
@@ -1627,6 +1736,11 @@ class Accelerator:
             tracker.log(values, step=step, **log_kwargs.get(tracker.name, {}))
 
     def end_training(self):
+        # a checkpoint still writing on background threads must reach its
+        # atomic commit before the process is allowed to wind down
+        from .checkpointing import wait_for_async_saves
+
+        wait_for_async_saves()
         for tracker in self.trackers:
             tracker.finish()
 

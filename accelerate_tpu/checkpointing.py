@@ -17,6 +17,16 @@ Layout of a checkpoint directory (reference file naming, checkpointing.py:63-182
     random_states_{rank}.pkl   host RNG (python/numpy/torch)
     custom_checkpoint_{i}/     registered objects (orbax if pytree of arrays,
                                pickle otherwise)
+    COMMITTED         atomic-commit manifest (per-file sizes + crc32)
+
+Durability (docs/fault_tolerance.md): every save is staged into
+``<dir>.tmp``, all hosts barrier, and the main process writes the
+``COMMITTED`` manifest and renames the staging dir into place — so a crash
+or preemption at ANY point mid-save leaves the previous committed
+checkpoint untouched and loadable, and ``load_accelerator_state`` resolves
+only committed checkpoints (rolling back past interrupted saves with a
+warning). Retention GC runs AFTER the new checkpoint is durable and only
+ever deletes committed checkpoints.
 """
 
 from __future__ import annotations
@@ -25,7 +35,10 @@ import json
 import os
 import pickle
 import random
+import re
 import shutil
+import time
+import zlib
 from typing import Optional
 
 import numpy as np
@@ -35,13 +48,24 @@ import jax
 from .logging import get_logger
 from .state import PartialState
 from .utils.constants import (
+    CHECKPOINT_COMMITTED_MARKER,
     CHECKPOINT_DIR_PREFIX,
+    CHECKPOINT_OLD_SUFFIX,
+    CHECKPOINT_STAGING_SUFFIX,
     CUSTOM_STATE_PATTERN,
     MODEL_NAME,
     OPTIMIZER_NAME,
     RNG_STATE_NAME,
     SAMPLER_NAME,
     SCHEDULER_NAME,
+)
+from .utils.fault import (
+    CheckpointComponentMissingError,
+    CheckpointCorruptError,
+    CheckpointError,
+    CheckpointNotFoundError,
+    CheckpointUncommittedError,
+    fault_point,
 )
 from .utils.imports import is_torch_available
 
@@ -54,11 +78,21 @@ __all__ = [
     "load_model_checkpoint",
     "save_pytree",
     "load_pytree",
+    "wait_for_async_saves",
+    "list_checkpoints",
+    "is_checkpoint_committed",
+    "verify_checkpoint",
 ]
+
+_CKPT_NAME_RE = re.compile(rf"^{CHECKPOINT_DIR_PREFIX}_(\d+)$")
 
 
 # ------------------------------------------------------------------ orbax io
 _ASYNC_CKPTRS: list = []
+# (staging_dir, final_dir, accelerator) for async saves whose atomic commit
+# is deferred until the background writes are joined.
+_PENDING_COMMITS: list = []
+_ATEXIT_REGISTERED = False
 
 
 def save_pytree(tree, path: str, async_save: bool = False) -> None:
@@ -77,6 +111,15 @@ def save_pytree(tree, path: str, async_save: bool = False) -> None:
         shutil.rmtree(path)
     state.wait_for_everyone()
     if async_save:
+        global _ATEXIT_REGISTERED
+        if not _ATEXIT_REGISTERED:
+            # join in-flight writes (and run their deferred commits) even if
+            # the process exits without another save/load — an uncommitted
+            # .tmp dir is discarded by the loader, losing the whole save
+            import atexit
+
+            atexit.register(_join_async_saves_quietly)
+            _ATEXIT_REGISTERED = True
         ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
         ckptr.save(path, args=ocp.args.StandardSave(tree))
         _ASYNC_CKPTRS.append(ckptr)
@@ -87,11 +130,43 @@ def save_pytree(tree, path: str, async_save: bool = False) -> None:
 
 
 def wait_for_async_saves() -> None:
-    """Block until all in-flight async checkpoint writes are durable."""
+    """Block until all in-flight async checkpoint writes are durable, then
+    run their deferred atomic commits.
+
+    The checkpointer list is drained unconditionally (one failed join no
+    longer strands the rest of the list for the life of the process — each
+    entry is joined and closed exactly once, errors re-raised after the
+    drain), so resources are bounded by the single in-flight save rather
+    than accumulating one ``AsyncCheckpointer`` per save forever."""
+    first_error: Optional[BaseException] = None
     while _ASYNC_CKPTRS:
         ckptr = _ASYNC_CKPTRS.pop()
-        ckptr.wait_until_finished()
-        ckptr.close()
+        try:
+            ckptr.wait_until_finished()
+        except BaseException as exc:  # noqa: BLE001 — re-raised below
+            if first_error is None:
+                first_error = exc
+        finally:
+            try:
+                ckptr.close()
+            except Exception:
+                pass
+    if first_error is not None:
+        # the staged data is suspect: drop the deferred commits so a broken
+        # save can never be renamed into a "committed" checkpoint
+        _PENDING_COMMITS.clear()
+        raise first_error
+    while _PENDING_COMMITS:
+        staging, final, accelerator = _PENDING_COMMITS.pop(0)
+        _commit_staged(staging, final, accelerator)
+        logger.info(f"Saved state to {final}")
+
+
+def _join_async_saves_quietly() -> None:
+    try:
+        wait_for_async_saves()
+    except Exception as exc:  # atexit: nothing to do but report
+        logger.error(f"async checkpoint save failed during interpreter exit: {exc}")
 
 
 def load_pytree(path: str, target=None, shardings=None):
@@ -118,6 +193,207 @@ def load_pytree(path: str, target=None, shardings=None):
             )
             return ckptr.restore(path, abstract)
         return ckptr.restore(path)
+
+
+# ------------------------------------------------------ commit protocol
+def _file_crc32(path: str) -> str:
+    crc = 0
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            crc = zlib.crc32(chunk, crc)
+    return f"{crc & 0xFFFFFFFF:08x}"
+
+
+def _build_manifest(ckpt_dir: str) -> dict:
+    """Per-file sizes + crc32 checksums for everything under ``ckpt_dir``
+    (excluding the marker itself)."""
+    files = {}
+    for root, _dirs, names in os.walk(ckpt_dir):
+        for name in names:
+            full = os.path.join(root, name)
+            rel = os.path.relpath(full, ckpt_dir)
+            if rel == CHECKPOINT_COMMITTED_MARKER:
+                continue
+            files[rel] = {
+                "size": os.path.getsize(full),
+                "crc32": _file_crc32(full),
+            }
+    return files
+
+
+def checkpoint_index(name: str) -> Optional[int]:
+    """The N of a ``checkpoint_N`` directory name; None for anything else
+    (staging ``.tmp`` dirs, ``.old`` parking dirs, user files)."""
+    m = _CKPT_NAME_RE.match(name)
+    return int(m.group(1)) if m else None
+
+
+def list_checkpoints(base: str, committed_only: bool = False) -> list:
+    """``checkpoint_N`` directories under ``base``, sorted by N ascending.
+    Staging (``.tmp``) and parking (``.old``) dirs never match."""
+    if not os.path.isdir(base):
+        return []
+    entries = []
+    for name in os.listdir(base):
+        idx = checkpoint_index(name)
+        if idx is None:
+            continue
+        path = os.path.join(base, name)
+        if not os.path.isdir(path):
+            continue
+        if committed_only and not is_checkpoint_committed(path):
+            continue
+        entries.append((idx, path))
+    entries.sort()
+    return [path for _idx, path in entries]
+
+
+def is_checkpoint_committed(ckpt_dir: str) -> bool:
+    try:
+        read_commit_manifest(ckpt_dir)
+    except CheckpointError:
+        return False
+    return True
+
+
+def read_commit_manifest(ckpt_dir: str) -> dict:
+    """The parsed ``COMMITTED`` manifest, raising the precise taxonomy error
+    when the checkpoint is absent / uncommitted / unreadable."""
+    if not os.path.isdir(ckpt_dir):
+        raise CheckpointNotFoundError(f"checkpoint directory {ckpt_dir} does not exist")
+    marker = os.path.join(ckpt_dir, CHECKPOINT_COMMITTED_MARKER)
+    if not os.path.isfile(marker):
+        raise CheckpointUncommittedError(
+            f"{ckpt_dir} has no {CHECKPOINT_COMMITTED_MARKER} manifest — the "
+            "save that produced it was interrupted before the atomic commit "
+            "(or it predates the durability layer). Load a committed "
+            "checkpoint instead, or pass verify='off' to load it anyway."
+        )
+    try:
+        with open(marker) as f:
+            return json.load(f)
+    except (json.JSONDecodeError, OSError) as exc:
+        raise CheckpointCorruptError(
+            f"{CHECKPOINT_COMMITTED_MARKER} manifest in {ckpt_dir} is "
+            f"unreadable: {exc}"
+        ) from exc
+
+
+def verify_checkpoint(ckpt_dir: str, level: str = "marker") -> None:
+    """Validate a checkpoint at one of four levels:
+
+    * ``"off"`` — the directory merely exists;
+    * ``"marker"`` (default) — a parseable ``COMMITTED`` manifest is present:
+      the save reached its atomic commit;
+    * ``"size"`` — additionally every manifest-listed file exists with the
+      recorded size (catches truncation, the common partial-write failure);
+    * ``"checksum"`` — additionally every file's crc32 matches (full
+      integrity scan; cost scales with checkpoint bytes).
+
+    Raises :class:`CheckpointNotFoundError` / :class:`CheckpointUncommittedError`
+    / :class:`CheckpointCorruptError` accordingly.
+    """
+    if level not in ("off", "marker", "size", "checksum"):
+        raise ValueError(
+            f"unknown verify level {level!r} (expected off|marker|size|checksum)"
+        )
+    if level == "off":
+        if not os.path.isdir(ckpt_dir):
+            raise CheckpointNotFoundError(
+                f"checkpoint directory {ckpt_dir} does not exist"
+            )
+        return
+    manifest = read_commit_manifest(ckpt_dir)
+    if level == "marker":
+        return
+    problems = []
+    for rel, meta in manifest.get("files", {}).items():
+        full = os.path.join(ckpt_dir, rel)
+        if not os.path.isfile(full):
+            problems.append(f"{rel}: missing")
+            continue
+        size = os.path.getsize(full)
+        if size != meta.get("size"):
+            problems.append(f"{rel}: size {size} != recorded {meta.get('size')}")
+            continue
+        if level == "checksum" and _file_crc32(full) != meta.get("crc32"):
+            problems.append(f"{rel}: crc32 mismatch")
+    if problems:
+        raise CheckpointCorruptError(
+            f"checkpoint {ckpt_dir} fails {level} verification: "
+            + "; ".join(problems[:10])
+            + ("" if len(problems) <= 10 else f" (+{len(problems) - 10} more)")
+        )
+
+
+def _verify_level(override: Optional[str]) -> str:
+    if override is not None:
+        return override
+    return os.environ.get("ACCELERATE_CHECKPOINT_VERIFY", "marker")
+
+
+def _commit_staged(staging: str, final: str, accelerator) -> None:
+    """Atomic commit: barrier all hosts, write the COMMITTED manifest into
+    the staging dir, rename it into place on the main process, then run
+    retention GC. A same-name overwrite parks the previous checkpoint at
+    ``<final>.old`` until the rename lands — the previous committed state is
+    only ever deleted after the new one is durable."""
+    state = PartialState()
+    state.wait_for_everyone()  # every host's staged writes are on disk
+    fault_point("before_commit")
+    if state.is_main_process:
+        manifest = {
+            "format": 1,
+            "files": _build_manifest(staging),
+            "step": getattr(accelerator, "step", 0),
+            "iteration": getattr(
+                accelerator.project_configuration, "iteration", 0
+            ),
+            "num_processes": state.num_processes,
+            "time": time.time(),
+        }
+        marker = os.path.join(staging, CHECKPOINT_COMMITTED_MARKER)
+        with open(marker + ".part", "w") as f:
+            json.dump(manifest, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(marker + ".part", marker)
+        fault_point("before_rename")
+        old = final + CHECKPOINT_OLD_SUFFIX
+        if os.path.exists(final):
+            os.rename(final, old)
+        os.rename(staging, final)
+        shutil.rmtree(old, ignore_errors=True)
+    state.wait_for_everyone()  # no host reads `final` before it exists
+    fault_point("before_gc")
+    _gc_checkpoints(accelerator)
+
+
+def _gc_checkpoints(accelerator) -> None:
+    """Retention policy: keep the newest ``total_limit`` committed
+    checkpoints, exempting every ``checkpoint_keep_every``-th index. Runs
+    AFTER commit, only on the main process, and only ever deletes COMMITTED
+    checkpoints — an interrupted save can never cost the last good state."""
+    state = PartialState()
+    pc = accelerator.project_configuration
+    if not state.is_main_process:
+        return
+    if not (pc.automatic_checkpoint_naming and pc.total_limit is not None):
+        return
+    if pc.project_dir is None:
+        return
+    base = os.path.join(pc.project_dir, "checkpoints")
+    keep_every = getattr(pc, "checkpoint_keep_every", None)
+    candidates = []
+    for path in list_checkpoints(base, committed_only=True):
+        idx = checkpoint_index(os.path.basename(path))
+        if keep_every and idx is not None and idx % keep_every == 0:
+            continue  # pinned by the keep-every-K policy
+        candidates.append(path)
+    while len(candidates) > pc.total_limit:
+        victim = candidates.pop(0)
+        logger.info(f"retention GC: removing committed checkpoint {victim}")
+        shutil.rmtree(victim, ignore_errors=True)
 
 
 # --------------------------------------------------------------- rng states
@@ -168,14 +444,36 @@ def _resolve_dir(accelerator, output_dir: Optional[str], for_save: bool) -> str:
         if for_save and pc.automatic_checkpoint_naming:
             return os.path.join(base, f"{CHECKPOINT_DIR_PREFIX}_{pc.iteration}")
         if not for_save:
-            # latest checkpoint
-            if not os.path.isdir(base):
-                raise FileNotFoundError(f"No checkpoints under {base}")
-            subdirs = [d for d in os.listdir(base) if d.startswith(CHECKPOINT_DIR_PREFIX)]
-            subdirs.sort(key=lambda d: int(d.rsplit("_", 1)[-1]))
-            return os.path.join(base, subdirs[-1])
+            return _latest_committed(base)
         return base
     return output_dir
+
+
+def _latest_committed(base: str) -> str:
+    """The newest committed ``checkpoint_N`` under ``base``; uncommitted
+    newer dirs (interrupted saves) are skipped with a rollback warning.
+    Falls back to the newest plain dir when NO checkpoint carries a marker
+    (a tree written entirely by the pre-durability layout)."""
+    if not os.path.isdir(base):
+        raise CheckpointNotFoundError(f"No checkpoints under {base}")
+    entries = list_checkpoints(base)
+    if not entries:
+        raise CheckpointNotFoundError(f"No checkpoints under {base}")
+    committed = [p for p in entries if is_checkpoint_committed(p)]
+    if committed:
+        chosen = committed[-1]
+        for newer in entries[entries.index(chosen) + 1 :]:
+            logger.warning(
+                f"ignoring uncommitted checkpoint {newer} (interrupted save: "
+                f"no {CHECKPOINT_COMMITTED_MARKER} manifest); rolling back to "
+                f"last committed checkpoint {chosen}"
+            )
+        return chosen
+    logger.warning(
+        f"no checkpoint under {base} carries a {CHECKPOINT_COMMITTED_MARKER} "
+        "manifest (pre-durability layout?); loading the newest one unverified"
+    )
+    return entries[-1]
 
 
 def save_accelerator_state(
@@ -185,74 +483,92 @@ def save_accelerator_state(
     async_save: bool = False,
 ) -> str:
     """Save the complete training state (reference save_accelerator_state,
-    checkpointing.py:63-182 + Accelerator.save_state accelerator.py:3584)."""
+    checkpointing.py:63-182 + Accelerator.save_state accelerator.py:3584)
+    under the atomic-commit protocol: everything is written into
+    ``<output_dir>.tmp`` and only renamed into place once all hosts finish
+    and the ``COMMITTED`` manifest is durable. With ``async_save=True`` the
+    commit is deferred to :func:`wait_for_async_saves` (which the next
+    save/load — and interpreter exit — calls automatically)."""
+    from .utils import fault as _fault
+
     state = PartialState()
     pc = accelerator.project_configuration
-    wait_for_async_saves()  # join any previous in-flight save first
-    output_dir = _resolve_dir(accelerator, output_dir, for_save=True)
+    wait_for_async_saves()  # join + commit any previous in-flight save first
+    output_dir = os.path.abspath(_resolve_dir(accelerator, output_dir, for_save=True))
+    staging = output_dir + CHECKPOINT_STAGING_SUFFIX
 
-    if pc.automatic_checkpoint_naming and state.is_main_process:
-        # total_limit GC (reference accelerator.py:3622-3647)
-        base = os.path.dirname(output_dir)
-        if os.path.isdir(base) and pc.total_limit is not None:
-            ckpts = sorted(
-                (d for d in os.listdir(base) if d.startswith(CHECKPOINT_DIR_PREFIX)),
-                key=lambda d: int(d.rsplit("_", 1)[-1]),
-            )
-            while len(ckpts) + 1 > pc.total_limit:
-                shutil.rmtree(os.path.join(base, ckpts.pop(0)), ignore_errors=True)
-    os.makedirs(output_dir, exist_ok=True)
+    _fault.mark_save_started()
+    if state.is_main_process:
+        # stale staging/parking dirs from a previous crashed save
+        for leftover in (staging, output_dir + CHECKPOINT_OLD_SUFFIX):
+            if os.path.exists(leftover):
+                shutil.rmtree(leftover, ignore_errors=True)
+    state.wait_for_everyone()
+    os.makedirs(staging, exist_ok=True)
 
     for i, model in enumerate(accelerator._models):
         suffix = "" if i == 0 else f"_{i}"
         save_pytree(
-            model.params, os.path.join(output_dir, f"{MODEL_NAME}{suffix}"), async_save=async_save
+            model.params, os.path.join(staging, f"{MODEL_NAME}{suffix}"), async_save=async_save
         )
+    fault_point("after_model_save")
     for i, opt in enumerate(accelerator._optimizers):
         suffix = "" if i == 0 else f"_{i}"
         if opt.opt_state is not None:
             save_pytree(
                 opt.opt_state,
-                os.path.join(output_dir, f"{OPTIMIZER_NAME}{suffix}"),
+                os.path.join(staging, f"{OPTIMIZER_NAME}{suffix}"),
                 async_save=async_save,
             )
+    fault_point("after_optimizer_save")
 
     if state.is_main_process:
         for i, sched in enumerate(accelerator._schedulers):
             suffix = "" if i == 0 else f"_{i}"
-            with open(os.path.join(output_dir, f"{SCHEDULER_NAME}{suffix}.json"), "w") as f:
+            with open(os.path.join(staging, f"{SCHEDULER_NAME}{suffix}.json"), "w") as f:
                 json.dump(sched.state_dict(), f)
         samplers = []
         for dl in accelerator._dataloaders:
             samplers.append(dl.state_dict() if hasattr(dl, "state_dict") else {})
-        with open(os.path.join(output_dir, f"{SAMPLER_NAME}.json"), "w") as f:
+        with open(os.path.join(staging, f"{SAMPLER_NAME}.json"), "w") as f:
             # stateful datasets may put numpy scalars/arrays in their state —
             # coerce so one such leaf can't crash the whole save
             json.dump(
                 _json_safe({"dataloaders": samplers, "step": accelerator.step}), f
             )
         if accelerator.scaler is not None:
-            with open(os.path.join(output_dir, "scaler.json"), "w") as f:
+            with open(os.path.join(staging, "scaler.json"), "w") as f:
                 json.dump(accelerator.scaler.state_dict(), f)
         opt_meta = [
             {"step_count": o._step_count} for o in accelerator._optimizers
         ]
-        with open(os.path.join(output_dir, "optimizer_meta.json"), "w") as f:
+        with open(os.path.join(staging, "optimizer_meta.json"), "w") as f:
             json.dump(opt_meta, f)
 
     # per-rank host RNG (reference checkpointing.py:154-179)
-    with open(os.path.join(output_dir, f"{RNG_STATE_NAME}_{state.process_index}.pkl"), "wb") as f:
+    with open(os.path.join(staging, f"{RNG_STATE_NAME}_{state.process_index}.pkl"), "wb") as f:
         pickle.dump(_collect_rng_state(), f)
 
     # registered custom objects (reference checkpointing.py:323)
     for i, obj in enumerate(accelerator._custom_objects):
         sd = obj.state_dict()
-        with open(os.path.join(output_dir, CUSTOM_STATE_PATTERN.format(i) + ".pkl"), "wb") as f:
+        with open(os.path.join(staging, CUSTOM_STATE_PATTERN.format(i) + ".pkl"), "wb") as f:
             pickle.dump(jax.tree_util.tree_map(lambda t: np.asarray(t) if isinstance(t, jax.Array) else t, sd), f)
 
     if pc.automatic_checkpoint_naming:
         pc.iteration += 1
-    state.wait_for_everyone()
+
+    if async_save:
+        _PENDING_COMMITS.append((staging, output_dir, accelerator))
+        _fault.mark_save_finished(accelerator, path=output_dir)
+        logger.info(
+            f"staged async state at {staging}; commit deferred to "
+            "wait_for_async_saves()"
+        )
+        return output_dir
+
+    _commit_staged(staging, output_dir, accelerator)
+    _fault.mark_save_finished(accelerator, path=output_dir)
     logger.info(f"Saved state to {output_dir}")
     return output_dir
 
@@ -297,16 +613,54 @@ def _restore_upgraded_opt_state(path, target, shardings, upgrade):
     )
 
 
-def load_accelerator_state(accelerator, input_dir: Optional[str] = None, **kwargs) -> None:
+def load_accelerator_state(
+    accelerator,
+    input_dir: Optional[str] = None,
+    verify: Optional[str] = None,
+    **kwargs,
+) -> None:
     """Restore the training state (reference load_accelerator_state,
-    checkpointing.py:183-320 + Accelerator.load_state accelerator.py:3750)."""
+    checkpointing.py:183-320 + Accelerator.load_state accelerator.py:3750).
+
+    With no ``input_dir`` the newest COMMITTED ``checkpoint_N`` under the
+    project dir is chosen — interrupted saves are rolled back past with a
+    warning. An explicit ``input_dir`` is validated at the ``verify`` level
+    (default from ``ACCELERATE_CHECKPOINT_VERIFY``, else ``"marker"``; see
+    :func:`verify_checkpoint`) and failures raise the precise taxonomy
+    error: :class:`CheckpointNotFoundError` (never saved),
+    :class:`CheckpointUncommittedError` (interrupted save),
+    :class:`CheckpointCorruptError` (manifest mismatch), or
+    :class:`CheckpointComponentMissingError` (live state has no counterpart
+    in the checkpoint)."""
     state = PartialState()
     wait_for_async_saves()  # ensure no half-written checkpoint is read
     input_dir = _resolve_dir(accelerator, input_dir, for_save=False)
+    if not os.path.isdir(input_dir):
+        # a same-name overwrite that died between its two renames parks the
+        # previous committed checkpoint at <dir>.old — recover it
+        parked = input_dir + CHECKPOINT_OLD_SUFFIX
+        if os.path.isdir(parked) and is_checkpoint_committed(parked):
+            logger.warning(
+                f"{input_dir} missing but committed {parked} found (save "
+                "interrupted mid-rename); recovering it"
+            )
+            if state.is_main_process:
+                os.rename(parked, input_dir)
+            state.wait_for_everyone()
+        else:
+            raise CheckpointNotFoundError(
+                f"checkpoint directory {input_dir} does not exist"
+            )
+    verify_checkpoint(input_dir, level=_verify_level(verify))
 
     for i, model in enumerate(accelerator._models):
         suffix = "" if i == 0 else f"_{i}"
         path = os.path.join(input_dir, f"{MODEL_NAME}{suffix}")
+        if not os.path.isdir(path):
+            raise CheckpointComponentMissingError(
+                f"checkpoint {input_dir} has no '{MODEL_NAME}{suffix}' "
+                f"component for prepared model {i}"
+            )
         try:
             model.params = load_pytree(path, target=model.params, shardings=model.shardings)
         except ValueError:
@@ -322,7 +676,14 @@ def load_accelerator_state(accelerator, input_dir: Optional[str] = None, **kwarg
     for i, opt in enumerate(accelerator._optimizers):
         suffix = "" if i == 0 else f"_{i}"
         path = os.path.join(input_dir, f"{OPTIMIZER_NAME}{suffix}")
-        if os.path.isdir(path) and opt.opt_state is not None:
+        if not os.path.isdir(path):
+            if opt.opt_state is not None:
+                logger.warning(
+                    f"checkpoint {input_dir} has no '{OPTIMIZER_NAME}{suffix}' "
+                    f"component; optimizer {i} keeps its live state"
+                )
+            continue
+        if opt.opt_state is not None:
             shardings = jax.tree_util.tree_map(
                 lambda t: t.sharding if isinstance(t, jax.Array) else None, opt.opt_state
             )
@@ -384,6 +745,7 @@ def load_accelerator_state(accelerator, input_dir: Optional[str] = None, **kwarg
         if os.path.exists(p):
             with open(p, "rb") as f:
                 obj.load_state_dict(pickle.load(f))
+    accelerator._last_committed_checkpoint = input_dir
     logger.info(f"Loaded state from {input_dir}")
 
 

@@ -30,35 +30,85 @@ from __future__ import annotations
 
 import os
 import shlex
+import signal
 import subprocess
 import sys
 import tempfile
+import threading
 import time
 
 from .config import ClusterConfig, default_config_file
 
+# utils.fault is import-light by design so the launcher can use it
+from ..utils.fault import PREEMPTION_EXIT_CODE
+
+# exponential-backoff cap between crash-loop restarts
+_MAX_BACKOFF = 60.0
+
 
 def _supervise(cmd, env, max_restarts: int, monitor_interval: float,
-               watchdog_timeout: float) -> int:
+               watchdog_timeout: float, min_uptime: float = 10.0,
+               crash_loop_limit: int = 3) -> int:
     """Run ``cmd`` under a restart supervisor; returns the final exit code.
 
     The child is polled every ``monitor_interval`` seconds. With
     ``watchdog_timeout > 0`` a heartbeat file is exported as
     ``ACCELERATE_HEARTBEAT_FILE``; if the child stops touching it for longer
     than the timeout (hung collective, dead relay) it is killed and counted
-    as a failure."""
+    as a failure.
+
+    Signals: SIGTERM/SIGINT sent to the supervisor (TPU preemption targets
+    the whole process tree's leader) are forwarded to the worker so it can
+    run its preemption handler (emergency checkpoint); the worker then
+    exiting 0 or :data:`PREEMPTION_EXIT_CODE` counts as a clean shutdown
+    (supervisor returns 0, no restart).
+
+    Crash-loop breaker: a worker that dies within ``min_uptime`` seconds of
+    launch is a *fast failure* (bad config, import error, poisoned
+    checkpoint) — after ``crash_loop_limit`` CONSECUTIVE fast failures the
+    supervisor aborts even with restart budget left, instead of hammering
+    the job forever. Consecutive fast failures also back off exponentially
+    (``ACCELERATE_RESTART_BACKOFF`` base seconds, default 1.0, doubling per
+    fast failure, capped at 60s); a worker that survived past ``min_uptime``
+    resets both the counter and the backoff."""
     hb_file = None
     if watchdog_timeout > 0:
         fd, hb_file = tempfile.mkstemp(prefix="accelerate_hb_")
         os.close(fd)
         env["ACCELERATE_HEARTBEAT_FILE"] = hb_file
     attempt = 0
+    fast_fails = 0
+    backoff_base = float(os.environ.get("ACCELERATE_RESTART_BACKOFF", "1.0"))
+    child: dict = {"proc": None, "terminating": False}
+    prev_handlers = {}
+
+    def _forward(signum, frame):
+        child["terminating"] = True
+        proc = child["proc"]
+        if proc is not None and proc.poll() is None:
+            print(
+                f"[launch] forwarding signal {signum} to worker for a "
+                "preemption checkpoint",
+                file=sys.stderr,
+            )
+            try:
+                proc.send_signal(signum)
+            except OSError:
+                pass
+
+    # handler installation is main-thread-only in Python; in a test harness
+    # driving _supervise from a worker thread the forwarding is simply off
+    if threading.current_thread() is threading.main_thread():
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            prev_handlers[sig] = signal.signal(sig, _forward)
     try:
         while True:
             env["ACCELERATE_RESTART_COUNT"] = str(attempt)
             if hb_file:
                 os.utime(hb_file, None)
+            started = time.time()
             proc = subprocess.Popen(cmd, env=env)
+            child["proc"] = proc
             rc = None
             while rc is None:
                 try:
@@ -75,8 +125,32 @@ def _supervise(cmd, env, max_restarts: int, monitor_interval: float,
                         proc.kill()
                         proc.wait()
                         rc = 1
+            uptime = time.time() - started
+            if child["terminating"]:
+                # forwarded preemption: the worker checkpointing and exiting
+                # 143 (or 0) is the PLANNED outcome, not a crash
+                if rc in (0, PREEMPTION_EXIT_CODE, -signal.SIGTERM, -signal.SIGINT):
+                    print(
+                        "[launch] worker shut down cleanly after preemption "
+                        "signal",
+                        file=sys.stderr,
+                    )
+                    return 0
+                return rc
             if rc == 0:
                 return 0
+            if uptime < min_uptime:
+                fast_fails += 1
+            else:
+                fast_fails = 0
+            if fast_fails >= crash_loop_limit:
+                print(
+                    f"[launch] crash loop: worker died within {min_uptime}s "
+                    f"of launch {fast_fails} times in a row; aborting "
+                    f"(rc={rc})",
+                    file=sys.stderr,
+                )
+                return rc
             if attempt >= max_restarts:
                 return rc
             attempt += 1
@@ -99,6 +173,11 @@ def _supervise(cmd, env, max_restarts: int, monitor_interval: float,
             # together, with every old worker provably dead (any hung one
             # was killed at last beat + watchdog).
             multi_host = int(env.get("ACCELERATE_NUM_PROCESSES", "1") or 1) > 1
+            backoff = (
+                min(backoff_base * (2 ** (fast_fails - 1)), _MAX_BACKOFF)
+                if fast_fails > 0
+                else 0.0
+            )
             if "ACCELERATE_RESTART_DELAY" in os.environ:
                 delay = float(os.environ["ACCELERATE_RESTART_DELAY"])
             elif multi_host and hb_file and watchdog_timeout > 0:
@@ -108,17 +187,25 @@ def _supervise(cmd, env, max_restarts: int, monitor_interval: float,
                     + 2 * monitor_interval
                     + 2
                 )
-                delay = max(0.0, deadline - time.time())
+                # both constraints hold: the whole job must be down AND a
+                # fast-failing worker must not be hammered back up instantly
+                delay = max(0.0, deadline - time.time(), backoff)
             else:
-                delay = 0.0
+                delay = backoff
             if delay:
                 print(
-                    f"[launch] waiting {delay:.0f}s for the whole job to "
-                    "come down before relaunching",
+                    f"[launch] waiting {delay:.1f}s before relaunching"
+                    + (f" (backoff after {fast_fails} fast failures)" if backoff and backoff >= delay else
+                       " for the whole job to come down"),
                     file=sys.stderr,
                 )
                 time.sleep(delay)
     finally:
+        for sig, handler in prev_handlers.items():
+            try:
+                signal.signal(sig, handler)
+            except (OSError, ValueError):
+                pass
         if hb_file:
             try:
                 os.unlink(hb_file)
@@ -164,6 +251,10 @@ def launch_command(args, script_args) -> int:
     env.update(cfg.to_env())
     if args.process_id is not None:
         env["ACCELERATE_PROCESS_ID"] = str(args.process_id)
+    if args.handle_preemption:
+        # every worker's Accelerator installs the SIGTERM/SIGINT
+        # checkpoint-then-exit handler (utils/fault.py)
+        env["ACCELERATE_HANDLE_PREEMPTION"] = "1"
 
     if not args.training_script:
         print("error: no training script given", file=sys.stderr)
@@ -182,6 +273,10 @@ def launch_command(args, script_args) -> int:
             supervisor_flags += ["--monitor_interval", str(args.monitor_interval)]
             if pod_watchdog:
                 supervisor_flags += ["--watchdog_timeout", str(pod_watchdog)]
+            supervisor_flags += ["--min_uptime", str(args.min_uptime)]
+            supervisor_flags += ["--crash_loop_limit", str(args.crash_loop_limit)]
+        if args.handle_preemption:
+            supervisor_flags += ["--handle_preemption"]
         inner = " ".join(
             [f"{k}={shlex.quote(v)}" for k, v in cfg.to_env().items()]
             + ["python", "-m", "accelerate_tpu.commands.accelerate_cli", "launch"]
@@ -204,9 +299,12 @@ def launch_command(args, script_args) -> int:
             print(f"  {k}={v}")
         return 0
     max_restarts, watchdog = _supervision_settings(args, cfg)
-    if max_restarts > 0:
-        return _supervise(cmd, env, max_restarts, args.monitor_interval, watchdog)
-    return subprocess.call(cmd, env=env)
+    # even with zero restarts the child runs under _supervise so preemption
+    # signals are forwarded for a checkpoint-then-exit shutdown
+    return _supervise(
+        cmd, env, max_restarts, args.monitor_interval, watchdog,
+        min_uptime=args.min_uptime, crash_loop_limit=args.crash_loop_limit,
+    )
 
 
 def add_parser(subparsers) -> None:
@@ -230,6 +328,17 @@ def add_parser(subparsers) -> None:
                         "checkpoint save/load — set this comfortably above the first-"
                         "step XLA compile time or the watchdog will kill a healthy "
                         "worker mid-compile")
+    p.add_argument("--min_uptime", type=float, default=10.0,
+                   help="a worker dying within this many seconds of launch counts as a "
+                        "fast failure for the crash-loop breaker")
+    p.add_argument("--crash_loop_limit", type=int, default=3,
+                   help="abort after this many consecutive fast failures even with "
+                        "restart budget left (exponential backoff applies in between; "
+                        "base seconds via ACCELERATE_RESTART_BACKOFF, default 1.0)")
+    p.add_argument("--handle_preemption", action="store_true",
+                   help="workers checkpoint and exit cleanly on SIGTERM/SIGINT "
+                        "(TPU preemption); the supervisor forwards the signal and "
+                        "treats the shutdown as planned")
     p.add_argument("--debug", action="store_true", help="enable collective shape verification")
     p.add_argument("--dry_run", action="store_true", help="print the command and env, don't run")
     p.add_argument("training_script", nargs="?")

@@ -153,6 +153,14 @@ class PartialState:
     def is_last_process(self) -> bool:
         return self.process_index == self.num_processes - 1
 
+    @property
+    def preemption_requested(self) -> bool:
+        """Whether a handled SIGTERM/SIGINT has arrived in this process
+        (set by ``utils.fault``'s preemption handler). Training loops can
+        poll this to break out at a step boundary instead of relying on the
+        handler's emergency save."""
+        return self._shared_state.get("preemption_requested", False)
+
     def __repr__(self) -> str:
         return (
             f"PartialState(distributed_type={self.distributed_type.value}, "

@@ -1,0 +1,92 @@
+"""Durability drive script: die at a named point inside save_state, then
+prove the previous committed checkpoint survived bit-identically.
+
+Two phases, each a separate process (tests/test_durability.py):
+
+* ``--phase train`` — one training step, a committed ``save_state``
+  (checkpoint_0), dump the exact post-step params to ``--ref_out``; then arm
+  ``ACCELERATE_TPU_FAULT_INJECT=<--fault>`` *in this process only*, take a
+  second step and save again — the save dies (SIGKILL by default) at the
+  injected point, leaving whatever partial staging state the crash timing
+  produced.
+* ``--phase verify`` — fresh process: ``resume_from_latest()`` must roll
+  back to checkpoint_0 and restore params bit-identical to ``--ref_out``
+  (the parent compares the two .npy files).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import numpy as np
+
+import jax
+import optax
+
+
+def _flat_params(model) -> np.ndarray:
+    leaves = [
+        np.asarray(jax.device_get(leaf)).ravel()
+        for leaf in jax.tree_util.tree_leaves(model.params)
+    ]
+    return np.concatenate(leaves)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--project_dir", required=True)
+    ap.add_argument("--phase", choices=["train", "verify"], required=True)
+    ap.add_argument("--ref_out", required=True,
+                    help="train: where to dump post-step-1 params; "
+                         "verify: where to dump the restored params")
+    ap.add_argument("--fault", default="before_commit",
+                    help="fault spec armed before the SECOND save "
+                         "(point[:action], see utils/fault.py)")
+    args = ap.parse_args()
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.test_utils.training import (
+        RegressionModel,
+        make_regression_data,
+        regression_loss,
+    )
+
+    accelerator = Accelerator(project_dir=args.project_dir)
+    accelerator.project_configuration.automatic_checkpoint_naming = True
+
+    model = RegressionModel()
+    optimizer = optax.adam(0.1)
+    data = make_regression_data(32)
+    loader = accelerator.prepare_data_loader(data, batch_size=16, drop_last=True)
+    model, optimizer = accelerator.prepare(model, optimizer)
+
+    if args.phase == "verify":
+        resumed = accelerator.resume_from_latest()
+        print(f"resumed={resumed}", flush=True)
+        np.save(args.ref_out, _flat_params(model))
+        return
+
+    batches = list(loader)
+    # step 1 → committed checkpoint_0 → reference params
+    with accelerator.accumulate(model):
+        accelerator.backward(regression_loss, batches[0])
+        optimizer.step()
+        optimizer.zero_grad()
+    accelerator.save_state()
+    np.save(args.ref_out, _flat_params(model))
+    print("committed checkpoint_0", flush=True)
+
+    # step 2 → save dies at the armed fault point; checkpoint_0 must survive
+    with accelerator.accumulate(model):
+        accelerator.backward(regression_loss, batches[1])
+        optimizer.step()
+        optimizer.zero_grad()
+    os.environ["ACCELERATE_TPU_FAULT_INJECT"] = args.fault
+    accelerator.save_state()
+    # only reachable when the armed action doesn't kill the process
+    print("save unexpectedly survived", flush=True)
+
+
+if __name__ == "__main__":
+    main()

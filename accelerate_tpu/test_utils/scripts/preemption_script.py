@@ -1,0 +1,66 @@
+"""Preemption drive script: train slowly until SIGTERM arrives, prove the
+handler writes a committed emergency checkpoint and exits cleanly.
+
+Run under ``accelerate-tpu launch --handle_preemption`` (the launcher
+forwards its own SIGTERM to the worker): the Accelerator auto-installs the
+checkpoint-then-exit handler, the test SIGTERMs the launcher once
+``--ready_file`` appears, and expects
+
+* "emergency checkpoint committed at ..." on stdout,
+* launcher exit code 0 (clean preemption shutdown, not a crash),
+* a committed ``checkpoint_0`` on disk that a fresh process can load.
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import numpy as np
+
+import optax
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--project_dir", required=True)
+    ap.add_argument("--ready_file", required=True,
+                    help="touched after the first step — the signal the test "
+                         "waits for before sending SIGTERM")
+    ap.add_argument("--max_steps", type=int, default=600)
+    args = ap.parse_args()
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.test_utils.training import (
+        RegressionModel,
+        make_regression_data,
+        regression_loss,
+    )
+
+    accelerator = Accelerator(project_dir=args.project_dir)
+    accelerator.project_configuration.automatic_checkpoint_naming = True
+
+    model = RegressionModel()
+    optimizer = optax.adam(0.1)
+    data = make_regression_data(32)
+    loader = accelerator.prepare_data_loader(data, batch_size=16, drop_last=True)
+    model, optimizer = accelerator.prepare(model, optimizer)
+    batch = next(iter(loader))
+
+    for step in range(args.max_steps):
+        with accelerator.accumulate(model):
+            accelerator.backward(regression_loss, batch)
+            optimizer.step()
+            optimizer.zero_grad()
+        if step == 0:
+            with open(args.ready_file, "w") as f:
+                f.write("ready")
+            print("training started", flush=True)
+        # slow cadence so the test's SIGTERM lands between steps, where the
+        # handler runs immediately (not deferred behind an in-flight save)
+        time.sleep(0.05)
+    print("finished without preemption", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,4 +1,17 @@
 from .constants import MESH_AXIS_ORDER, JOINT_AXES
+from .fault import (
+    PREEMPTION_EXIT_CODE,
+    CheckpointComponentMissingError,
+    CheckpointCorruptError,
+    CheckpointError,
+    CheckpointNotFoundError,
+    CheckpointUncommittedError,
+    FaultInjected,
+    TrainingHealthError,
+    fault_point,
+    install_preemption_handler,
+    preemption_requested,
+)
 from .environment import (
     clear_environment,
     parse_choice_from_env,

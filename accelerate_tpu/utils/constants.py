@@ -19,6 +19,13 @@ SAFE_WEIGHTS_INDEX_NAME = "model.safetensors.index.json"
 SAFE_WEIGHTS_PATTERN_NAME = "model{suffix}.safetensors"
 
 CHECKPOINT_DIR_PREFIX = "checkpoint"
+# Atomic-commit protocol (checkpointing.py, docs/fault_tolerance.md): saves
+# stage into `<dir>.tmp`, write the COMMITTED manifest (per-file sizes +
+# crc32), then rename to `<dir>`; a same-name overwrite parks the previous
+# checkpoint at `<dir>.old` until the rename lands.
+CHECKPOINT_COMMITTED_MARKER = "COMMITTED"
+CHECKPOINT_STAGING_SUFFIX = ".tmp"
+CHECKPOINT_OLD_SUFFIX = ".old"
 
 # Env-var protocol prefix (reference uses ACCELERATE_*; we keep the same
 # prefix so existing accelerate launch configs can map over).

@@ -215,6 +215,10 @@ class ProjectConfiguration(KwargsHandler):
     total_limit: Optional[int] = None
     iteration: int = 0
     save_on_each_node: bool = False
+    # Retention pin: every checkpoint whose index is a multiple of this is
+    # exempt from total_limit GC (keep-every-K milestones for post-hoc evals
+    # while total_limit bounds the rolling recency window).
+    checkpoint_keep_every: Optional[int] = None
 
     def set_directories(self, project_dir: Optional[str] = None):
         self.project_dir = project_dir
@@ -224,6 +228,35 @@ class ProjectConfiguration(KwargsHandler):
     def __post_init__(self):
         if self.logging_dir is None:
             self.logging_dir = self.project_dir
+        if self.checkpoint_keep_every is not None and self.checkpoint_keep_every <= 0:
+            raise ValueError("checkpoint_keep_every must be a positive integer")
+
+
+@dataclass
+class TrainingHealthConfig(KwargsHandler):
+    """Policy for ``Accelerator.check_step_health`` — what to do when a step
+    produces a non-finite loss (or gradients, with ``check_grads=True``):
+
+    * ``"raise"`` (default) — fail fast with :class:`TrainingHealthError`;
+    * ``"skip"`` — drop the step (zero the accumulated grads) and continue;
+    * ``"restore"`` — reload the last committed checkpoint and continue.
+
+    ``max_bad_steps`` bounds how many *consecutive* unhealthy steps the
+    skip/restore policies tolerate before raising anyway — a persistent
+    divergence should stop the job, not loop forever restoring."""
+
+    nonfinite_policy: str = "raise"  # "raise" | "skip" | "restore"
+    check_grads: bool = False
+    max_bad_steps: int = 10
+
+    def __post_init__(self):
+        if self.nonfinite_policy not in ("raise", "skip", "restore"):
+            raise ValueError(
+                f"nonfinite_policy must be raise|skip|restore, got "
+                f"{self.nonfinite_policy!r}"
+            )
+        if self.max_bad_steps <= 0:
+            raise ValueError("max_bad_steps must be a positive integer")
 
 
 @dataclass

@@ -1,0 +1,252 @@
+"""Fault-tolerance primitives: error taxonomy, fault injection, preemption.
+
+This module is the home of the durability layer's cross-cutting pieces
+(SURVEY §5 "Checkpoint / resume"; docs/fault_tolerance.md):
+
+* a precise **checkpoint error taxonomy** so callers can tell "no checkpoint
+  yet" (first launch) from "a save was interrupted" (roll back) from "the
+  bytes on disk are damaged" (refuse to load silently corrupted state);
+* **fault injection** points (``ACCELERATE_TPU_FAULT_INJECT``) used by the
+  test suite to kill/except a process at named moments inside the checkpoint
+  lifecycle, proving the atomic-commit protocol leaves the previous committed
+  checkpoint loadable no matter where a save dies;
+* a **preemption handler** for TPU maintenance-event eviction: SIGTERM/SIGINT
+  trigger one synchronous emergency ``save_state`` (joining any in-flight
+  async checkpointers first) and a clean exit with
+  :data:`PREEMPTION_EXIT_CODE`, which the launch supervisor treats as a
+  deliberate shutdown rather than a crash.
+
+Kept deliberately import-light (no jax at module scope) so the launcher and
+tests can use it without touching the accelerator runtime.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import threading
+from typing import Optional
+
+__all__ = [
+    "CheckpointError",
+    "CheckpointNotFoundError",
+    "CheckpointUncommittedError",
+    "CheckpointCorruptError",
+    "CheckpointComponentMissingError",
+    "TrainingHealthError",
+    "FaultInjected",
+    "fault_point",
+    "install_preemption_handler",
+    "preemption_requested",
+    "PREEMPTION_EXIT_CODE",
+]
+
+# 128 + SIGTERM: the conventional "terminated on request" code. The launch
+# supervisor treats a child exiting with this code after a forwarded signal
+# as a clean preemption shutdown (no restart, supervisor exits 0).
+PREEMPTION_EXIT_CODE = 143
+
+FAULT_INJECT_ENV = "ACCELERATE_TPU_FAULT_INJECT"
+
+
+# ------------------------------------------------------------ error taxonomy
+class CheckpointError(RuntimeError):
+    """Base class for checkpoint load/save failures."""
+
+
+class CheckpointNotFoundError(CheckpointError, FileNotFoundError):
+    """The checkpoint directory does not exist at all (nothing was ever
+    saved there). Subclasses FileNotFoundError so pre-taxonomy callers
+    (``Accelerator.resume_from_latest``) keep working."""
+
+
+class CheckpointUncommittedError(CheckpointError):
+    """The directory exists but carries no ``COMMITTED`` manifest — a save
+    was interrupted before the atomic commit. The data cannot be trusted;
+    load the newest *committed* checkpoint instead."""
+
+
+class CheckpointCorruptError(CheckpointError):
+    """The ``COMMITTED`` manifest is present but the bytes on disk disagree
+    with it (missing file, size drift, checksum mismatch)."""
+
+
+class CheckpointComponentMissingError(CheckpointError):
+    """A component the live training state requires (model_1, optimizer, …)
+    has no counterpart in the checkpoint directory."""
+
+
+class TrainingHealthError(RuntimeError):
+    """Raised by the training health watchdog when the configured NaN/Inf
+    policy is exhausted (or is ``"raise"``)."""
+
+
+class FaultInjected(RuntimeError):
+    """Raised by :func:`fault_point` for ``point:raise`` injection specs."""
+
+
+# ------------------------------------------------------------ fault injection
+def fault_point(name: str) -> None:
+    """Fault-injection hook: if ``ACCELERATE_TPU_FAULT_INJECT`` names this
+    point, die here. The spec is a comma-separated list of ``point[:action]``
+    entries; actions are
+
+    * ``kill`` (default) — SIGKILL this process, exactly like a host loss or
+      OOM-killer mid-save; nothing (atexit, finally, orbax commit threads)
+      gets to run;
+    * ``exit`` — ``os._exit(17)``;
+    * ``raise`` — raise :class:`FaultInjected` (in-process error paths).
+
+    Checkpointing calls this at the named moments of the save lifecycle
+    (``after_model_save``, ``after_optimizer_save``, ``before_commit``,
+    ``before_rename``, ``before_gc``). The env var is read at call time so a
+    test script can arm a point between two saves.
+    """
+    spec = os.environ.get(FAULT_INJECT_ENV)
+    if not spec:
+        return
+    for item in spec.split(","):
+        point, _, action = item.strip().partition(":")
+        if point != name:
+            continue
+        action = action or "kill"
+        if action == "kill":
+            os.kill(os.getpid(), signal.SIGKILL)
+        elif action == "exit":
+            os._exit(17)
+        elif action == "raise":
+            raise FaultInjected(name)
+        else:
+            raise ValueError(
+                f"unknown fault action {action!r} for point {name!r} "
+                f"(expected kill|exit|raise)"
+            )
+
+
+# ---------------------------------------------------------------- preemption
+_PREEMPTION = {
+    "requested": False,  # a handled signal arrived
+    "in_save": False,  # a save_state is in flight; defer the emergency save
+    "in_handler": False,  # the signal handler's own emergency save is running
+    "installed": False,
+}
+
+
+def preemption_requested() -> bool:
+    """Whether a handled SIGTERM/SIGINT has arrived in this process."""
+    return _PREEMPTION["requested"]
+
+
+def _record_preemption(signum: int) -> None:
+    _PREEMPTION["requested"] = True
+    # Mirror into PartialState's shared dict so any component holding a
+    # state handle (dataloaders, trackers) can consult it without importing
+    # this module.
+    try:
+        from ..state import PartialState
+
+        PartialState._shared_state["preemption_requested"] = True
+    except Exception:
+        pass
+
+
+def install_preemption_handler(
+    accelerator,
+    signals: tuple = (signal.SIGTERM, signal.SIGINT),
+    exit_code: int = PREEMPTION_EXIT_CODE,
+) -> bool:
+    """Install a SIGTERM/SIGINT handler that checkpoints before dying.
+
+    On the first handled signal: join in-flight async checkpoint writes,
+    run one synchronous committed ``save_state``, finish trackers, and exit
+    with ``exit_code``. A signal arriving *while a save_state is already in
+    flight* only sets the deferred flag — the active save finishes its
+    atomic commit and the exit happens right after (re-entering orbax from
+    a handler mid-write would corrupt the very state we are trying to
+    preserve). A second signal during the emergency save is likewise
+    absorbed.
+
+    Python only allows handler installation from the main thread; from any
+    other thread this is a no-op returning False.
+    """
+    if threading.current_thread() is not threading.main_thread():
+        return False
+
+    def _handler(signum, frame):
+        _record_preemption(signum)
+        if _PREEMPTION["in_save"] or _PREEMPTION["in_handler"]:
+            return  # the in-flight save's epilogue performs the exit
+        _PREEMPTION["in_handler"] = True
+        try:
+            _emergency_save(accelerator, signum)
+        finally:
+            _PREEMPTION["in_handler"] = False
+        sys.exit(exit_code)
+
+    for sig in signals:
+        signal.signal(sig, _handler)
+    _PREEMPTION["installed"] = True
+    return True
+
+
+def _emergency_save(accelerator, signum: int) -> None:
+    from ..checkpointing import wait_for_async_saves
+    from ..logging import get_logger
+
+    logger = get_logger(__name__)
+    logger.warning(
+        "received signal %d — writing emergency checkpoint before exit",
+        signum,
+    )
+    wait_for_async_saves()  # join + commit anything already in flight
+    try:
+        path = accelerator.save_state()
+        logger.warning("emergency checkpoint committed at %s", path)
+        print(f"emergency checkpoint committed at {path}", flush=True)
+    finally:
+        try:
+            accelerator.end_training()
+        except Exception:
+            pass
+
+
+def mark_save_started() -> None:
+    """Checkpointing bracket: a save_state is entering its critical section
+    — a signal arriving now is DEFERRED (recursively checkpointing from a
+    handler mid-orbax-write would corrupt the very state being saved)."""
+    _PREEMPTION["in_save"] = True
+
+
+def mark_save_finished(
+    accelerator=None, path: Optional[str] = None, exit_code: Optional[int] = None
+) -> None:
+    """Checkpointing bracket: the save committed (or, for an async save,
+    staged). If a preemption signal was deferred behind this save, the
+    just-committed checkpoint doubles as the emergency checkpoint: flush any
+    deferred async commit, report it, and exit. The handler's OWN emergency
+    save skips this — the handler performs its exit itself."""
+    _PREEMPTION["in_save"] = False
+    if not (_PREEMPTION["requested"] and _PREEMPTION["installed"]):
+        return
+    if _PREEMPTION["in_handler"]:
+        return
+    from ..logging import get_logger
+
+    get_logger(__name__).warning(
+        "preemption signal arrived during save_state; the committed "
+        "checkpoint doubles as the emergency checkpoint — exiting"
+    )
+    try:
+        from ..checkpointing import wait_for_async_saves
+
+        wait_for_async_saves()  # an async save's deferred commit must land
+        if path is not None:
+            print(f"emergency checkpoint committed at {path}", flush=True)
+    finally:
+        if accelerator is not None:
+            try:
+                accelerator.end_training()
+            except Exception:
+                pass
+    sys.exit(exit_code if exit_code is not None else PREEMPTION_EXIT_CODE)

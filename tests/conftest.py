@@ -19,7 +19,12 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # jax < 0.5 has no jax_num_cpu_devices option; the XLA_FLAGS override
+    # above provides the 8 virtual devices on those versions.
+    pass
 
 import pytest  # noqa: E402
 
@@ -42,6 +47,22 @@ def pytest_collection_modifyitems(config, items):
     for item in items:
         if "slow" in item.keywords:
             item.add_marker(skip)
+
+
+@pytest.fixture
+def fault_inject():
+    """Arm the ``ACCELERATE_TPU_FAULT_INJECT`` hook for one test and always
+    disarm it afterwards (a leaked spec would kill unrelated tests' saves).
+    Yields a setter: ``fault_inject("before_commit:raise")``."""
+    from accelerate_tpu.utils.fault import FAULT_INJECT_ENV
+
+    def _arm(spec: str) -> None:
+        os.environ[FAULT_INJECT_ENV] = spec
+
+    try:
+        yield _arm
+    finally:
+        os.environ.pop(FAULT_INJECT_ENV, None)
 
 
 @pytest.fixture(autouse=True)

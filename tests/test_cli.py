@@ -162,7 +162,7 @@ def test_launch_uses_config_supervision(tmp_path, monkeypatch):
     cfg.save(path)
     captured = {}
 
-    def fake_supervise(cmd, env, max_restarts, monitor, watchdog):
+    def fake_supervise(cmd, env, max_restarts, monitor, watchdog, **kwargs):
         captured.update(max_restarts=max_restarts, watchdog=watchdog)
         return 0
 

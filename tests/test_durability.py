@@ -1,0 +1,523 @@
+"""Durable checkpointing: atomic commit, integrity rollback, preemption,
+health watchdog, supervisor crash-loop breaker (docs/fault_tolerance.md).
+
+All FAST (non-slow) tests. The kill-mid-save and preemption tests drive
+real subprocesses — a RegressionModel compiles in seconds on the 8-device
+CPU platform — while the taxonomy / retention / watchdog / supervisor
+tests run in-process.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.parallelism_config import ParallelismConfig
+from accelerate_tpu.test_utils.training import (
+    RegressionModel,
+    make_regression_data,
+    regression_loss,
+)
+from accelerate_tpu.utils.fault import (
+    CheckpointComponentMissingError,
+    CheckpointCorruptError,
+    CheckpointNotFoundError,
+    CheckpointUncommittedError,
+    FaultInjected,
+    TrainingHealthError,
+    fault_point,
+)
+
+SCRIPTS = os.path.join(
+    os.path.dirname(__file__), "..", "accelerate_tpu", "test_utils", "scripts"
+)
+FAULT_SCRIPT = os.path.join(SCRIPTS, "fault_save_script.py")
+PREEMPT_SCRIPT = os.path.join(SCRIPTS, "preemption_script.py")
+
+
+def _subprocess_env(tmp_path=None):
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # never dial the TPU relay
+    env.pop("ACCELERATE_TPU_FAULT_INJECT", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["PYTHONPATH"] = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+    if tmp_path is not None:
+        # never pick up a user config file in the launcher
+        env["ACCELERATE_TPU_CONFIG_DIR"] = str(tmp_path / "cfg")
+    return env
+
+
+def _fresh(tmp_path, **kwargs):
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+    return Accelerator(
+        parallelism_config=ParallelismConfig(dp_shard_size=8),
+        project_dir=str(tmp_path),
+        **kwargs,
+    )
+
+
+def _prepared(acc):
+    model = RegressionModel()
+    optimizer = optax.adam(0.1)
+    data = make_regression_data(32)
+    loader = acc.prepare_data_loader(data, batch_size=16, drop_last=True)
+    model, optimizer = acc.prepare(model, optimizer)
+    return model, optimizer, loader
+
+
+def _one_step(acc, model, optimizer, batch):
+    with acc.accumulate(model):
+        acc.backward(regression_loss, batch)
+        optimizer.step()
+        optimizer.zero_grad()
+
+
+# --------------------------------------------------------- kill mid-save
+@pytest.mark.parametrize("fault", ["after_model_save", "before_commit", "before_rename"])
+def test_sigkill_mid_save_rolls_back_bit_identical(tmp_path, fault):
+    """The acceptance criterion: SIGKILL at any point during save_state
+    leaves the previous committed checkpoint loadable, and a restart
+    restores it bit-identically."""
+    project = str(tmp_path / "proj")
+    ref = str(tmp_path / "ref.npy")
+    got = str(tmp_path / "got.npy")
+    env = _subprocess_env()
+
+    train = subprocess.run(
+        [sys.executable, FAULT_SCRIPT, "--phase", "train",
+         "--project_dir", project, "--ref_out", ref, "--fault", fault],
+        env=env, capture_output=True, text=True, timeout=300,
+    )
+    # the armed fault SIGKILLed the process mid-second-save
+    assert train.returncode == -signal.SIGKILL, (
+        f"rc={train.returncode}\n{train.stdout}\n{train.stderr}"
+    )
+    assert "committed checkpoint_0" in train.stdout
+
+    verify = subprocess.run(
+        [sys.executable, FAULT_SCRIPT, "--phase", "verify",
+         "--project_dir", project, "--ref_out", got],
+        env=env, capture_output=True, text=True, timeout=300,
+    )
+    assert verify.returncode == 0, (
+        f"rc={verify.returncode}\n{verify.stdout}\n{verify.stderr}"
+    )
+    assert "resumed=True" in verify.stdout
+    np.testing.assert_array_equal(np.load(ref), np.load(got))
+
+
+# ---------------------------------------------------------- fault_point
+def test_fault_point_actions(fault_inject):
+    fault_point("unarmed")  # no spec → no-op
+    fault_inject("mypoint:raise")
+    fault_point("other")  # armed, different point → no-op
+    with pytest.raises(FaultInjected):
+        fault_point("mypoint")
+    fault_inject("a:raise,b:raise")
+    with pytest.raises(FaultInjected):
+        fault_point("b")
+    fault_inject("mypoint:bogus")
+    with pytest.raises(ValueError):
+        fault_point("mypoint")
+
+
+# ------------------------------------------------------ commit + verify
+def test_save_writes_committed_manifest(tmp_path):
+    from accelerate_tpu.checkpointing import read_commit_manifest, verify_checkpoint
+
+    acc = _fresh(tmp_path)
+    model, optimizer, loader = _prepared(acc)
+    _one_step(acc, model, optimizer, next(iter(loader)))
+    ckpt = acc.save_state(str(tmp_path / "ckpt"))
+
+    manifest = read_commit_manifest(ckpt)
+    assert manifest["format"] == 1
+    files = manifest["files"]
+    assert any(rel.startswith("model") for rel in files)
+    assert "sampler.json" in files
+    for rel, meta in files.items():
+        assert meta["size"] == os.path.getsize(os.path.join(ckpt, rel))
+    # no leftover staging/parking dirs after a clean commit
+    assert not os.path.exists(ckpt + ".tmp")
+    assert not os.path.exists(ckpt + ".old")
+    for level in ("off", "marker", "size", "checksum"):
+        verify_checkpoint(ckpt, level=level)
+
+
+def test_verify_detects_truncation_and_bitflips(tmp_path):
+    acc = _fresh(tmp_path)
+    model, optimizer, loader = _prepared(acc)
+    _one_step(acc, model, optimizer, next(iter(loader)))
+    ckpt = acc.save_state(str(tmp_path / "ckpt"))
+    from accelerate_tpu.checkpointing import read_commit_manifest, verify_checkpoint
+
+    victim_rel = "sampler.json"
+    victim = os.path.join(ckpt, victim_rel)
+    original = open(victim, "rb").read()
+
+    # same-size bit flip: only the checksum level sees it
+    open(victim, "wb").write(b"X" * len(original))
+    verify_checkpoint(ckpt, level="size")
+    with pytest.raises(CheckpointCorruptError, match="crc32"):
+        verify_checkpoint(ckpt, level="checksum")
+    with pytest.raises(CheckpointCorruptError):
+        acc.load_state(ckpt, verify="checksum")
+
+    # truncation: the size level sees it
+    open(victim, "wb").write(original[: max(0, len(original) - 3)])
+    with pytest.raises(CheckpointCorruptError, match="size"):
+        verify_checkpoint(ckpt, level="size")
+
+    # deletion of a manifest-listed file
+    open(victim, "wb").write(original)
+    verify_checkpoint(ckpt, level="checksum")
+    os.unlink(victim)
+    with pytest.raises(CheckpointCorruptError, match="missing"):
+        verify_checkpoint(ckpt, level="size")
+    # the manifest itself still parses
+    read_commit_manifest(ckpt)
+
+
+def test_error_taxonomy(tmp_path):
+    """Precise load errors: never-saved vs interrupted-save vs corrupt
+    manifest vs missing component."""
+    acc = _fresh(tmp_path)
+    model, optimizer, loader = _prepared(acc)
+    _one_step(acc, model, optimizer, next(iter(loader)))
+
+    # (1) dir does not exist
+    with pytest.raises(CheckpointNotFoundError):
+        acc.load_state(str(tmp_path / "never_saved"))
+
+    ckpt = acc.save_state(str(tmp_path / "ckpt"))
+    marker = os.path.join(ckpt, "COMMITTED")
+
+    # (2) partial/uncommitted: marker absent
+    os.rename(marker, marker + ".hidden")
+    with pytest.raises(CheckpointUncommittedError):
+        acc.load_state(ckpt)
+    # escape hatch for pre-durability trees
+    acc.load_state(ckpt, verify="off")
+    os.rename(marker + ".hidden", marker)
+
+    # (3) corrupt manifest
+    with open(marker, "w") as f:
+        f.write("{not json")
+    with pytest.raises(CheckpointCorruptError):
+        acc.load_state(ckpt)
+
+    # (4) component missing: restore the manifest, remove the model dir
+    import shutil
+
+    shutil.rmtree(os.path.join(ckpt, "model"))
+    files = {"sampler.json": {"size": 1, "crc32": "0"}}
+    with open(marker, "w") as f:
+        json.dump({"format": 1, "files": files}, f)
+    with pytest.raises(CheckpointComponentMissingError):
+        acc.load_state(ckpt)
+
+
+def test_resolve_rolls_back_past_uncommitted(tmp_path):
+    """Auto-resolution skips a newer interrupted save and loads the newest
+    COMMITTED checkpoint; `.tmp` staging leftovers never break the listing."""
+    pc_dir = tmp_path / "proj"
+    acc = _fresh(pc_dir)
+    acc.project_configuration.automatic_checkpoint_naming = True
+    model, optimizer, loader = _prepared(acc)
+    batch = next(iter(loader))
+    _one_step(acc, model, optimizer, batch)
+    acc.save_state()  # checkpoint_0
+    _one_step(acc, model, optimizer, batch)
+    acc.save_state()  # checkpoint_1
+    base = os.path.join(str(pc_dir), "checkpoints")
+
+    # fake an interrupted newer save: a bare dir and a staging leftover
+    os.makedirs(os.path.join(base, "checkpoint_2"))
+    os.makedirs(os.path.join(base, "checkpoint_3.tmp"))
+
+    acc.load_state()  # must pick checkpoint_1, not the uncommitted _2
+    assert acc._last_committed_checkpoint.endswith("checkpoint_1")
+
+    # resume_from_latest's iteration fast-forward must also survive the
+    # staging leftover (a bare int() over listdir would crash on "3.tmp")
+    acc2 = _fresh(pc_dir)
+    acc2.project_configuration.automatic_checkpoint_naming = True
+    model2, optimizer2, loader2 = _prepared(acc2)
+    assert acc2.resume_from_latest() is True
+    assert acc2.project_configuration.iteration == 3  # past committed+bare dirs
+
+
+def test_old_parking_dir_recovery(tmp_path):
+    """A same-name overwrite killed between its two renames leaves only
+    `<dir>.old` — load_state recovers it."""
+    acc = _fresh(tmp_path)
+    model, optimizer, loader = _prepared(acc)
+    _one_step(acc, model, optimizer, next(iter(loader)))
+    ckpt = acc.save_state(str(tmp_path / "ckpt"))
+    a_saved = float(model.params["a"])
+    os.rename(ckpt, ckpt + ".old")  # simulate dying after rename #1
+
+    model.params = {"a": jnp.float32(-7.0), "b": jnp.float32(-7.0)}
+    acc.load_state(ckpt)
+    assert float(model.params["a"]) == pytest.approx(a_saved)
+    assert os.path.isdir(ckpt) and not os.path.exists(ckpt + ".old")
+
+
+# ------------------------------------------------------------ retention
+def test_retention_gc_committed_only_and_keep_every(tmp_path):
+    from accelerate_tpu.utils.dataclasses import ProjectConfiguration
+
+    pc = ProjectConfiguration(
+        project_dir=str(tmp_path),
+        automatic_checkpoint_naming=True,
+        total_limit=2,
+        checkpoint_keep_every=3,
+    )
+    acc = _fresh(tmp_path, project_config=pc)
+    model, optimizer, loader = _prepared(acc)
+    batch = next(iter(loader))
+    base = os.path.join(str(tmp_path), "checkpoints")
+
+    # an uncommitted dir (interrupted save) must never be GC'd or counted
+    os.makedirs(os.path.join(base, "checkpoint_100"))
+
+    for _ in range(5):  # checkpoint_0 .. checkpoint_4
+        _one_step(acc, model, optimizer, batch)
+        acc.save_state()
+
+    names = sorted(
+        d for d in os.listdir(base) if os.path.isdir(os.path.join(base, d))
+    )
+    # 0 and 3 pinned by keep_every=3; 2 and 4 are the total_limit=2 newest
+    # non-pinned; 1 GC'd; the uncommitted 100 untouched
+    assert names == [
+        "checkpoint_0", "checkpoint_100", "checkpoint_2", "checkpoint_3",
+        "checkpoint_4",
+    ]
+
+
+def test_keep_every_validation():
+    from accelerate_tpu.utils.dataclasses import ProjectConfiguration
+
+    with pytest.raises(ValueError):
+        ProjectConfiguration(checkpoint_keep_every=0)
+
+
+# ------------------------------------------------------- async commits
+def test_async_save_commits_on_join_and_drains_checkpointers(tmp_path):
+    import accelerate_tpu.checkpointing as ckpt_mod
+
+    acc = _fresh(tmp_path)
+    model, optimizer, loader = _prepared(acc)
+    _one_step(acc, model, optimizer, next(iter(loader)))
+    out = str(tmp_path / "async_ckpt")
+    acc.save_state(out, async_save=True)
+    acc.wait_for_async_saves()
+    # the leak fix: nothing accumulates across saves
+    assert ckpt_mod._ASYNC_CKPTRS == []
+    assert ckpt_mod._PENDING_COMMITS == []
+    assert os.path.isfile(os.path.join(out, "COMMITTED"))
+    assert not os.path.exists(out + ".tmp")
+    acc.load_state(out, verify="checksum")
+
+
+# -------------------------------------------------------- health watchdog
+def test_health_raise_policy(tmp_path):
+    acc = _fresh(tmp_path)
+    model, optimizer, loader = _prepared(acc)
+    _one_step(acc, model, optimizer, next(iter(loader)))
+    assert acc.check_step_health(loss=jnp.float32(0.5)) is True
+    with pytest.raises(TrainingHealthError):
+        acc.check_step_health(loss=jnp.float32(float("nan")))
+
+
+def test_health_skip_policy_and_max_bad_steps(tmp_path):
+    from accelerate_tpu.utils.dataclasses import TrainingHealthConfig
+
+    acc = _fresh(
+        tmp_path,
+        health_config=TrainingHealthConfig(nonfinite_policy="skip", max_bad_steps=3),
+    )
+    model, optimizer, loader = _prepared(acc)
+    _one_step(acc, model, optimizer, next(iter(loader)))
+    bad = jnp.float32(float("inf"))
+    assert acc.check_step_health(loss=bad) is False
+    assert acc.check_step_health(loss=bad) is False
+    # a healthy step resets the consecutive counter
+    assert acc.check_step_health(loss=jnp.float32(1.0)) is True
+    assert acc.check_step_health(loss=bad) is False
+    assert acc.check_step_health(loss=bad) is False
+    with pytest.raises(TrainingHealthError, match="max_bad_steps"):
+        acc.check_step_health(loss=bad)
+
+
+def test_health_restore_policy_reloads_last_committed(tmp_path):
+    from accelerate_tpu.utils.dataclasses import TrainingHealthConfig
+
+    acc = _fresh(
+        tmp_path,
+        health_config=TrainingHealthConfig(nonfinite_policy="restore"),
+    )
+    model, optimizer, loader = _prepared(acc)
+    _one_step(acc, model, optimizer, next(iter(loader)))
+    acc.save_state(str(tmp_path / "good"))
+    a_good = float(model.params["a"])
+
+    model.params = {"a": jnp.float32(999.0), "b": jnp.float32(999.0)}
+    assert acc.check_step_health(loss=jnp.float32(float("nan"))) is False
+    assert float(model.params["a"]) == pytest.approx(a_good)
+
+
+def test_health_checks_grad_tree(tmp_path):
+    from accelerate_tpu.utils.dataclasses import TrainingHealthConfig
+
+    acc = _fresh(
+        tmp_path,
+        health_config=TrainingHealthConfig(check_grads=True),
+    )
+    model, optimizer, loader = _prepared(acc)
+    _one_step(acc, model, optimizer, next(iter(loader)))
+    good = {"a": jnp.float32(0.1), "b": jnp.float32(0.2)}
+    assert acc.check_step_health(loss=jnp.float32(0.5), grads=good) is True
+    bad = {"a": jnp.float32(0.1), "b": jnp.float32(float("nan"))}
+    with pytest.raises(TrainingHealthError):
+        acc.check_step_health(loss=jnp.float32(0.5), grads=bad)
+
+
+def test_health_config_validation():
+    from accelerate_tpu.utils.dataclasses import TrainingHealthConfig
+
+    with pytest.raises(ValueError):
+        TrainingHealthConfig(nonfinite_policy="explode")
+    with pytest.raises(ValueError):
+        TrainingHealthConfig(max_bad_steps=0)
+
+
+# ----------------------------------------------------------- supervisor
+def _fast_fail_cmd(rc=7):
+    # -S skips site/sitecustomize (which imports jax): each supervised child
+    # starts in milliseconds, keeping these unit tests fast
+    return [sys.executable, "-S", "-c", f"import sys; sys.exit({rc})"]
+
+
+def test_supervisor_crash_loop_breaker(monkeypatch, capsys):
+    """A worker dying instantly every time must NOT burn the whole restart
+    budget: the breaker aborts after crash_loop_limit consecutive fast
+    failures, with exponential backoff between them."""
+    from accelerate_tpu.commands.launch import _supervise
+
+    monkeypatch.setenv("ACCELERATE_RESTART_BACKOFF", "0.01")
+    monkeypatch.delenv("ACCELERATE_RESTART_DELAY", raising=False)
+    start = time.time()
+    rc = _supervise(
+        _fast_fail_cmd(), dict(os.environ), max_restarts=50,
+        monitor_interval=0.05, watchdog_timeout=0.0,
+        min_uptime=30.0, crash_loop_limit=3,
+    )
+    elapsed = time.time() - start
+    assert rc == 7
+    err = capsys.readouterr().err
+    assert "crash loop" in err
+    # 3 fast failures = initial + exactly 2 restarts, not 50
+    assert err.count("restart") == 2
+    assert elapsed < 30  # backoff was the tiny test base, not the 1s default
+
+
+def test_supervisor_honors_restart_budget_before_loop_limit(monkeypatch, capsys):
+    from accelerate_tpu.commands.launch import _supervise
+
+    monkeypatch.setenv("ACCELERATE_RESTART_BACKOFF", "0.01")
+    monkeypatch.delenv("ACCELERATE_RESTART_DELAY", raising=False)
+    rc = _supervise(
+        _fast_fail_cmd(rc=13), dict(os.environ), max_restarts=1,
+        monitor_interval=0.05, watchdog_timeout=0.0,
+        min_uptime=30.0, crash_loop_limit=10,
+    )
+    assert rc == 13
+    assert capsys.readouterr().err.count("restart 1/1") == 1
+
+
+def test_supervisor_clean_exit_no_restart(capsys):
+    from accelerate_tpu.commands.launch import _supervise
+
+    rc = _supervise(
+        [sys.executable, "-S", "-c", "pass"], dict(os.environ), max_restarts=5,
+        monitor_interval=0.05, watchdog_timeout=0.0,
+    )
+    assert rc == 0
+    assert "restart" not in capsys.readouterr().err
+
+
+def test_supervisor_backoff_grows(monkeypatch, capsys):
+    """Consecutive fast failures double the delay (base via
+    ACCELERATE_RESTART_BACKOFF)."""
+    from accelerate_tpu.commands.launch import _supervise
+
+    monkeypatch.setenv("ACCELERATE_RESTART_BACKOFF", "0.2")
+    monkeypatch.delenv("ACCELERATE_RESTART_DELAY", raising=False)
+    start = time.time()
+    rc = _supervise(
+        _fast_fail_cmd(), dict(os.environ), max_restarts=50,
+        monitor_interval=0.05, watchdog_timeout=0.0,
+        min_uptime=30.0, crash_loop_limit=3,
+    )
+    elapsed = time.time() - start
+    assert rc == 7
+    # two backoff sleeps: 0.2s (after 1st fast fail) + 0.4s (after 2nd)
+    assert elapsed >= 0.6
+
+
+# ----------------------------------------------------------- preemption
+def test_sigterm_produces_committed_emergency_checkpoint(tmp_path):
+    """The acceptance criterion: SIGTERM during training produces a
+    committed emergency checkpoint and a clean (rc 0) supervisor exit."""
+    project = str(tmp_path / "proj")
+    ready = str(tmp_path / "ready")
+    cmd = [
+        sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli",
+        "launch", "--handle_preemption",
+        PREEMPT_SCRIPT,
+        "--project_dir", project, "--ready_file", ready,
+    ]
+    proc = subprocess.Popen(
+        cmd, env=_subprocess_env(tmp_path), stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        deadline = time.time() + 240
+        while not os.path.exists(ready):
+            assert proc.poll() is None, (
+                f"launcher died early rc={proc.returncode}\n"
+                f"{proc.communicate()[0]}\n{proc.communicate()[1]}"
+            )
+            assert time.time() < deadline, "worker never reached step 1"
+            time.sleep(0.2)
+        proc.send_signal(signal.SIGTERM)
+        stdout, stderr = proc.communicate(timeout=240)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+    assert proc.returncode == 0, f"rc={proc.returncode}\n{stdout}\n{stderr}"
+    assert "emergency checkpoint committed at" in stdout
+    assert "preemption" in stderr  # supervisor logged the forwarded signal
+
+    from accelerate_tpu.checkpointing import is_checkpoint_committed, list_checkpoints
+
+    ckpts = list_checkpoints(os.path.join(project, "checkpoints"), committed_only=True)
+    assert ckpts, "no committed emergency checkpoint on disk"
+    assert is_checkpoint_committed(ckpts[-1])
