@@ -9,7 +9,7 @@
 PY := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu python
 PY_SLOW := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu RUN_SLOW=1 python
 
-.PHONY: test test_all test_core test_data test_parallel test_models test_cli test_big_modeling test-fault bench
+.PHONY: test test_all test_core test_data test_parallel test_models test_cli test_big_modeling test-fault bench bench-telemetry
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -47,3 +47,8 @@ test_big_modeling:
 
 bench:
 	python bench.py
+
+# CPU A/B regression gate: fused health + async logging must stay within
+# 5% of telemetry-off steps/s (docs/fault_tolerance.md)
+bench-telemetry:
+	$(PY) benchmarks/telemetry_bench.py --gate

@@ -60,6 +60,10 @@ _LAZY = {
     "TrainingHealthConfig": ("utils.dataclasses", "TrainingHealthConfig"),
     "install_preemption_handler": ("utils.fault", "install_preemption_handler"),
     "preemption_requested": ("utils.fault", "preemption_requested"),
+    "health_summary": ("telemetry", "health_summary"),
+    "StepHealth": ("telemetry", "StepHealth"),
+    "DeferredReadbackRing": ("telemetry", "DeferredReadbackRing"),
+    "AsyncTrackerFlusher": ("telemetry", "AsyncTrackerFlusher"),
 }
 
 

@@ -143,6 +143,7 @@ class Accelerator:
         step_scheduler_with_optimizer: bool = True,
         kwargs_handlers: Optional[Sequence[KwargsHandler]] = None,
         health_config: Optional[TrainingHealthConfig] = None,
+        async_logging: bool = False,
     ):
         if project_config is not None:
             self.project_configuration = project_config
@@ -219,10 +220,19 @@ class Accelerator:
         self._forced_sync = False
         self._in_accumulate = False
 
-        # training health watchdog (docs/fault_tolerance.md)
+        # training health watchdog + non-blocking telemetry
+        # (docs/fault_tolerance.md): the health ring and tracker flusher
+        # are created lazily; all readbacks funnel through telemetry._fetch
         self.health_config = health_config or TrainingHealthConfig()
         self._bad_step_count = 0
         self._last_committed_checkpoint: Optional[str] = None
+        self._health_ring = None
+        self._health_seq = 0
+        self.last_health = None
+        from .utils.environment import parse_flag_from_env as _flag
+
+        self.async_logging = async_logging or _flag("ACCELERATE_ASYNC_LOGGING")
+        self._tracker_flusher = None
 
         self.mesh = self.state.get_device_mesh()
 
@@ -1606,47 +1616,96 @@ class Accelerator:
         return install_preemption_handler(self, **kwargs)
 
     # ------------------------------------------------------- health watchdog
-    def check_step_health(self, loss=None, grads=None) -> bool:
+    def check_step_health(self, loss=None, grads=None, grad_norm=None) -> bool:
         """Training health watchdog: validate this step's ``loss`` (and, with
         ``health_config.check_grads``, the gradient pytree) for NaN/Inf and
         apply the configured policy. Returns True when the step is healthy
         (callers should then ``optimizer.step()`` as usual) and False when
         the step must be discarded:
 
-        * ``"raise"`` — raise :class:`TrainingHealthError` immediately;
+        * ``"raise"`` — raise :class:`TrainingHealthError`;
         * ``"skip"`` — zero the accumulated grads and continue;
         * ``"restore"`` — reload the newest committed checkpoint, then
           continue.
 
         ``max_bad_steps`` consecutive unhealthy steps raise regardless of
-        policy. Note this is a host-side sync point (it reads the loss
-        value), so call it at a cadence you can afford — every step on CPU
-        tests, every N steps under a fused train_step at scale."""
+        policy. The finiteness of the loss and *all* float grad leaves is
+        tree-reduced on device by one fused ``telemetry.health_summary``
+        program, so the host reads back exactly ONE tiny scalar array per
+        call — never one transfer per gradient leaf. ``grad_norm`` (or the
+        norm the optimizer's ``clip_grad_norm_`` already computed) rides
+        along in the same transfer and lands in ``self.last_health``.
+
+        With ``health_config.sync=True`` (default) the verdict for this
+        step is applied before returning — a per-call host sync point.
+        With ``sync=False`` the summary is enqueued on a deferred-readback
+        ring and the verdict applied (and returned) is the one from
+        ``readback_depth`` steps ago, keeping the dispatch pipeline full;
+        call :meth:`health_drain` (``end_training`` does) to flush the
+        tail. See docs/fault_tolerance.md for the latency/exactness
+        trade-off."""
+        from . import telemetry
+
         cfg = self.health_config
-        healthy = True
-        if loss is not None:
-            healthy = bool(np.all(np.isfinite(np.asarray(jax.device_get(loss)))))
-        if healthy and grads is None and cfg.check_grads:
-            for opt in self._optimizers:
-                if opt._accum_grads is not None:
-                    grads = opt._accum_grads
-                    break
-        if healthy and grads is not None and cfg.check_grads:
-            for leaf in jax.tree_util.tree_leaves(grads):
-                if hasattr(leaf, "dtype") and jnp.issubdtype(
-                    jnp.asarray(leaf).dtype, jnp.floating
-                ):
-                    if not bool(np.all(np.isfinite(np.asarray(jax.device_get(leaf))))):
-                        healthy = False
+        if cfg.check_grads:
+            if grads is None:
+                for opt in self._optimizers:
+                    if opt._accum_grads is not None:
+                        grads = opt._accum_grads
                         break
-        if healthy:
+            if grad_norm is None:
+                # reuse the clipping reduction instead of re-reducing
+                for opt in self._optimizers:
+                    if opt._last_grad_norm is not None:
+                        grad_norm = opt._last_grad_norm
+                        break
+        else:
+            grads = None
+        summary = telemetry.health_summary(loss, grads, grad_norm)
+        step = self._health_seq
+        self._health_seq += 1
+        if cfg.sync:
+            return self._apply_health_verdict(telemetry.read_summary(summary, step))
+        if self._health_ring is None:
+            self._health_ring = telemetry.DeferredReadbackRing(cfg.readback_depth)
+        ok = True
+        for s, matured in self._health_ring.push((step, summary)):
+            ok = self._apply_health_verdict(telemetry.read_summary(matured, s)) and ok
+        return ok
+
+    def health_drain(self) -> bool:
+        """Read back and apply every verdict still pending on the deferred
+        ring (``health_config.sync=False``), restoring exact per-step
+        semantics at a boundary — end of epoch, before a checkpoint you
+        must trust, or in tests. Returns True iff every drained step was
+        healthy. No-op (True) in sync mode."""
+        from . import telemetry
+
+        ok = True
+        ring = self._health_ring
+        if ring is None:
+            return True
+        while len(ring):
+            # popleft one at a time: a restore verdict clears the ring
+            # (the newer in-flight summaries predate the reload — stale)
+            step, summary = ring.popleft()
+            ok = self._apply_health_verdict(telemetry.read_summary(summary, step)) and ok
+        return ok
+
+    def _apply_health_verdict(self, health) -> bool:
+        """Apply the configured nonfinite policy to one realized
+        :class:`telemetry.StepHealth` verdict (PR-1 semantics, shared by
+        the sync path, the ring, and :meth:`health_drain`)."""
+        cfg = self.health_config
+        self.last_health = health
+        if health.healthy:
             self._bad_step_count = 0
             return True
 
         self._bad_step_count += 1
         if cfg.nonfinite_policy == "raise":
             raise TrainingHealthError(
-                f"non-finite loss/gradients at step {self.step} "
+                f"non-finite loss/gradients at health step {health.step} "
                 f"(nonfinite_policy='raise')"
             )
         if self._bad_step_count >= cfg.max_bad_steps:
@@ -1657,20 +1716,23 @@ class Accelerator:
             )
         if cfg.nonfinite_policy == "skip":
             logger.warning(
-                f"non-finite loss/gradients at step {self.step}; skipping "
-                f"step ({self._bad_step_count}/{cfg.max_bad_steps} consecutive)"
+                f"non-finite loss/gradients at health step {health.step}; "
+                f"skipping step ({self._bad_step_count}/{cfg.max_bad_steps} "
+                f"consecutive)"
             )
             for opt in self._optimizers:
                 opt.zero_grad()
             return False
         # "restore"
         logger.warning(
-            f"non-finite loss/gradients at step {self.step}; restoring last "
-            f"committed checkpoint ({self._bad_step_count}/{cfg.max_bad_steps} "
-            f"consecutive)"
+            f"non-finite loss/gradients at health step {health.step}; restoring "
+            f"last committed checkpoint ({self._bad_step_count}/"
+            f"{cfg.max_bad_steps} consecutive)"
         )
         for opt in self._optimizers:
             opt.zero_grad()
+        if self._health_ring is not None:
+            self._health_ring.clear()
         self.load_state(self._last_committed_checkpoint)
         return False
 
@@ -1708,6 +1770,9 @@ class Accelerator:
     def init_trackers(self, project_name: str, config: Optional[dict] = None, init_kwargs: Optional[dict] = None):
         from .tracking import filter_trackers
 
+        if self._tracker_flusher is not None:
+            flusher, self._tracker_flusher = self._tracker_flusher, None
+            flusher.close()
         init_kwargs = init_kwargs or {}
         self.trackers = []
         for tracker_cls in filter_trackers(self.log_with, self.project_configuration.logging_dir):
@@ -1721,6 +1786,10 @@ class Accelerator:
             if config is not None:
                 tracker.store_init_configuration(config)
             self.trackers.append(tracker)
+        if self.async_logging and self.is_main_process:
+            from . import telemetry
+
+            self._tracker_flusher = telemetry.AsyncTrackerFlusher(self.trackers)
 
     def get_tracker(self, name: str, unwrap: bool = False):
         for tracker in self.trackers:
@@ -1729,11 +1798,25 @@ class Accelerator:
         raise ValueError(f"Tracker {name} not initialized")
 
     def log(self, values: dict, step: Optional[int] = None, log_kwargs: Optional[dict] = None):
+        """Log ``values`` to every initialized tracker. Values may be device
+        ``jax.Array`` scalars; with ``async_logging`` they are enqueued as-is
+        (no readback — the hot path never blocks) and materialized by the
+        background flusher, which also batches file writes. Without async
+        logging, values pass straight to each tracker synchronously."""
         if not self.is_main_process:
             return
         log_kwargs = log_kwargs or {}
+        if self._tracker_flusher is not None:
+            self._tracker_flusher.submit(values, step, log_kwargs)
+            return
         for tracker in self.trackers:
             tracker.log(values, step=step, **log_kwargs.get(tracker.name, {}))
+
+    def flush_trackers(self):
+        """Block until every ``log()`` call so far is durably written
+        (no-op without ``async_logging``); re-raise deferred tracker errors."""
+        if self._tracker_flusher is not None:
+            self._tracker_flusher.flush()
 
     def end_training(self):
         # a checkpoint still writing on background threads must reach its
@@ -1741,8 +1824,18 @@ class Accelerator:
         from .checkpointing import wait_for_async_saves
 
         wait_for_async_saves()
-        for tracker in self.trackers:
-            tracker.finish()
+        try:
+            # pending deferred health verdicts are applied before shutdown —
+            # a tail-step NaN still raises/skips/restores per policy
+            self.health_drain()
+        finally:
+            try:
+                if self._tracker_flusher is not None:
+                    flusher, self._tracker_flusher = self._tracker_flusher, None
+                    flusher.close()
+            finally:
+                for tracker in self.trackers:
+                    tracker.finish()
 
     # ------------------------------------------------------------------ misc
     @contextlib.contextmanager

@@ -11,6 +11,7 @@ just the pp mesh axis.
 
 from __future__ import annotations
 
+import threading
 from collections import OrderedDict
 from typing import Optional
 
@@ -25,6 +26,25 @@ __all__ = ["generate", "prepare_inference"]
 # compiled generate() programs kept per Model (serving loops with varying
 # prompt lengths compile per length; this caps host-side executable count)
 _GENERATE_CACHE_MAX = 16
+
+# guards the lazy attach of a model's LRU + lock (double-checked below);
+# the per-model lock then guards that model's OrderedDict — concurrent
+# serving threads mutating it unlocked can corrupt the dict
+_CACHE_ATTACH_LOCK = threading.Lock()
+
+
+def _model_generate_cache(model: Model):
+    cache = getattr(model, "_generate_cache", None)
+    lock = getattr(model, "_generate_cache_lock", None)
+    if cache is None or lock is None:
+        with _CACHE_ATTACH_LOCK:
+            cache = getattr(model, "_generate_cache", None)
+            lock = getattr(model, "_generate_cache_lock", None)
+            if lock is None:
+                lock = model._generate_cache_lock = threading.Lock()
+            if cache is None:
+                cache = model._generate_cache = OrderedDict()
+    return cache, lock
 
 
 def generate(
@@ -88,13 +108,12 @@ def generate(
         type(config).__name__, b, prompt_len, total_len, max_new_tokens,
         temp_on, top_k_width, top_p_on, eos_on,
     )
-    jit_cache = getattr(model, "_generate_cache", None)
-    if jit_cache is None:
-        jit_cache = model._generate_cache = OrderedDict()
-    run = jit_cache.get(cache_key)
-    if run is not None:
-        jit_cache.move_to_end(cache_key)
-    else:
+    jit_cache, cache_lock = _model_generate_cache(model)
+    with cache_lock:
+        run = jit_cache.get(cache_key)
+        if run is not None:
+            jit_cache.move_to_end(cache_key)
+    if run is None:
 
         def sample(logits, key, temp, p_threshold):
             if not temp_on:
@@ -143,9 +162,14 @@ def generate(
             )
             return jnp.concatenate([input_ids, new_tokens.T], axis=1)
 
-        run = jit_cache[cache_key] = jax.jit(_run)
-        while len(jit_cache) > _GENERATE_CACHE_MAX:
-            jit_cache.popitem(last=False)
+        # jit() itself is cheap (tracing happens at first call) and two
+        # threads racing here just build equivalent wrappers — last insert
+        # wins; only the dict mutation needs the lock
+        run = jax.jit(_run)
+        with cache_lock:
+            jit_cache[cache_key] = run
+            while len(jit_cache) > _GENERATE_CACHE_MAX:
+                jit_cache.popitem(last=False)
     return run(
         model.params, input_ids, jax.random.key(seed),
         jnp.float32(temperature if temp_on else 1.0),

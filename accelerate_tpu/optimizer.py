@@ -146,6 +146,9 @@ class AcceleratedOptimizer:
         self.model = None  # linked by Accelerator.prepare
         self._accum_grads = None
         self._accum_count = 0
+        # device scalar from the last clip_grad_norm_ — the health watchdog
+        # reuses it instead of re-reducing the grad tree (telemetry.py)
+        self._last_grad_norm = None
         self.step_was_skipped = False
         self._step_count = 0
         self._update_fn = None
@@ -288,6 +291,7 @@ class AcceleratedOptimizer:
             self._accum_grads = self.scaler.unscale(self._accum_grads)
             self._unscaled = True
         self._accum_grads, norm = _clip_by_global_norm(self._accum_grads, max_norm)
+        self._last_grad_norm = norm
         return norm
 
     def clip_grad_value_(self, clip_value: float):
@@ -327,6 +331,7 @@ class AcceleratedOptimizer:
         self.model.params = new_params
         self._accum_grads = None
         self._accum_count = 0
+        self._last_grad_norm = None
         self.step_was_skipped = False
         self._step_count += 1
 
@@ -336,6 +341,7 @@ class AcceleratedOptimizer:
         if self.gradient_state.sync_gradients:
             self._accum_grads = None
             self._accum_count = 0
+            self._last_grad_norm = None
             self._unscaled = False
 
     # ------------------------------------------------------------- state dict

@@ -1,0 +1,284 @@
+"""Non-blocking step telemetry: fused on-device health reductions, a
+bounded deferred-readback ring, and an async tracker flusher.
+
+The training loop's safety/observability hooks (``check_step_health``,
+``Accelerator.log``) used to be host sync points: every call flushed the
+async dispatch pipeline with a ``device_get`` — and with ``check_grads``
+one blocking transfer *per gradient leaf*. That undoes the dispatch-
+overhead wins the fused ``train_step`` exists for (runs/overhead_ab.md:
+~22 µs/step amortized dispatch vs ~ms-scale forced readbacks). Keeping
+the host ahead of the device is the whole game; this module makes every
+per-step host interaction cost ~zero steady-state step time:
+
+* :func:`health_summary` — ONE jitted on-device reduction of the loss's
+  and the whole grad-pytree's finiteness (plus the global grad norm,
+  reusing the optimizer's clipping reduction when already computed) into
+  a single tiny ``f32[3]`` array: one device→host transfer instead of N.
+* :class:`DeferredReadbackRing` — a bounded ring (depth K): each step
+  enqueues its device scalars and only the value from K steps ago is
+  read back, so the host never blocks on the step it just dispatched and
+  the pipeline stays full. Verdicts arrive with K-step latency.
+* :class:`AsyncTrackerFlusher` — a background thread that materializes
+  ``jax.Array`` metric values and writes tracker batches off the hot
+  path; JSONL/TensorBoard writes are batched per wakeup.
+
+Every telemetry readback in the package funnels through :func:`_fetch`
+so tests can count device→host transfers by shimming one function.
+"""
+
+from __future__ import annotations
+
+import collections
+import queue
+import threading
+from typing import Any, NamedTuple, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .logging import get_logger
+
+logger = get_logger(__name__)
+
+__all__ = [
+    "health_summary",
+    "read_summary",
+    "StepHealth",
+    "DeferredReadbackRing",
+    "AsyncTrackerFlusher",
+]
+
+# sentinel for "no grad norm in this summary" — real norms are >= 0, and a
+# NaN norm is data (it means the grads are non-finite), so -1 is unambiguous
+_NORM_UNSET = -1.0
+
+
+def _fetch(value):
+    """THE telemetry device→host transfer point. All health-verdict and
+    metric readbacks go through here — one shim to count transfers in
+    tests, one place that documents where the host may block."""
+    return np.asarray(jax.device_get(value))
+
+
+@jax.jit
+def _summarize(loss, grads, grad_norm):
+    """Tree-reduce (loss, grads) finiteness + global grad norm into ONE
+    f32[3] array: [loss_finite, grads_finite, grad_norm]. Runs as a single
+    compiled program (cached per pytree structure), so the step's health
+    costs one tiny kernel and one scalar transfer — never a per-leaf loop.
+    """
+    if loss is None:
+        loss_ok = jnp.bool_(True)
+    else:
+        loss_ok = jnp.all(jnp.isfinite(jnp.asarray(loss, jnp.float32)))
+    float_leaves = [
+        g
+        for g in jax.tree_util.tree_leaves(grads)
+        if jnp.issubdtype(jnp.asarray(g).dtype, jnp.floating)
+    ]
+    grads_ok = jnp.bool_(True)
+    for g in float_leaves:
+        grads_ok = jnp.logical_and(grads_ok, jnp.all(jnp.isfinite(g)))
+    if grad_norm is not None:
+        norm = jnp.asarray(grad_norm, jnp.float32).reshape(())
+    elif float_leaves:
+        # same reduction as the optimizer's clip_by_global_norm — computed
+        # here only when no caller already has it
+        norm = jnp.sqrt(
+            sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in float_leaves)
+        )
+    else:
+        norm = jnp.float32(_NORM_UNSET)
+    return jnp.stack([loss_ok.astype(jnp.float32), grads_ok.astype(jnp.float32), norm])
+
+
+def health_summary(loss=None, grads=None, grad_norm=None) -> jax.Array:
+    """Fused on-device health reduction (see :func:`_summarize`). Returns
+    a device ``f32[3]`` — NOT a host value: dispatching this is non-
+    blocking; pair with :func:`read_summary` (or the ring) to realize it."""
+    return _summarize(loss, grads, grad_norm)
+
+
+class StepHealth(NamedTuple):
+    """Host-side verdict for one step's telemetry summary."""
+
+    step: int
+    loss_finite: bool
+    grads_finite: bool
+    grad_norm: Optional[float]
+
+    @property
+    def healthy(self) -> bool:
+        return self.loss_finite and self.grads_finite
+
+
+def read_summary(summary, step: int) -> StepHealth:
+    """Realize a :func:`health_summary` device array on the host (the one
+    blocking point) and decode it."""
+    vals = _fetch(summary)
+    norm = float(vals[2])
+    return StepHealth(
+        step=step,
+        loss_finite=bool(vals[0] != 0.0),
+        grads_finite=bool(vals[1] != 0.0),
+        grad_norm=None if norm == _NORM_UNSET else norm,
+    )
+
+
+class DeferredReadbackRing:
+    """Bounded FIFO of in-flight device values.
+
+    ``push(entry)`` enqueues this step's (still device-resident) scalars
+    and returns the entries that have matured — those pushed ``depth``
+    steps ago, which have almost certainly finished executing, so reading
+    them back does not stall the dispatch pipeline. ``drain()``/
+    ``popleft()`` empty the ring at epoch boundaries / shutdown."""
+
+    def __init__(self, depth: int):
+        if depth < 1:
+            raise ValueError(f"ring depth must be >= 1, got {depth}")
+        self.depth = depth
+        self._entries: collections.deque = collections.deque()
+
+    def push(self, entry) -> list:
+        self._entries.append(entry)
+        matured = []
+        while len(self._entries) > self.depth:
+            matured.append(self._entries.popleft())
+        return matured
+
+    def popleft(self):
+        return self._entries.popleft()
+
+    def drain(self) -> list:
+        out = list(self._entries)
+        self._entries.clear()
+        return out
+
+    def clear(self) -> None:
+        self._entries.clear()
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+
+def materialize_metrics(values: dict) -> dict:
+    """Convert ``jax.Array`` metric values to host scalars/arrays (one
+    :func:`_fetch` per device value). Python/numpy values pass through
+    untouched so custom trackers see exactly what the user logged."""
+    out = {}
+    for key, val in values.items():
+        if isinstance(val, jax.Array):
+            host = _fetch(val)
+            out[key] = host.item() if host.size == 1 else host
+        else:
+            out[key] = val
+    return out
+
+
+_STOP = object()
+
+
+class AsyncTrackerFlusher:
+    """Background tracker writer: the hot path only enqueues (values may
+    contain device ``jax.Array`` scalars — no readback, no block); a
+    daemon thread materializes them and hands per-tracker BATCHES to
+    ``tracker.log_batch`` (one file write/flush per wakeup, not per step).
+
+    A tracker exception never kills the training loop: it is recorded,
+    remaining trackers still receive the batch, and the first error is
+    re-raised from :meth:`flush`/:meth:`close` — so ``end_training``
+    surfaces it after all pending writes were attempted."""
+
+    # after the first record arrives, linger this long collecting more
+    # before materializing/writing: turns per-step wakeups (each one GIL +
+    # XLA-client contention with the dispatching thread) into one batch
+    # write per interval. Bounded: a flush()/close() still drains promptly
+    # because the linger only runs while nothing is joining the queue.
+    COALESCE_S = 0.05
+
+    def __init__(self, trackers, name: str = "tracker-flush"):
+        self.trackers = trackers
+        self._queue: queue.Queue = queue.Queue()
+        self._errors: list[BaseException] = []
+        self._closed = False
+        self._draining = threading.Event()  # set while flush()/close() wait
+        self._thread = threading.Thread(target=self._loop, name=name, daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------- hot path
+    def submit(self, values: dict, step=None, log_kwargs: Optional[dict] = None):
+        if self._closed:
+            raise RuntimeError("AsyncTrackerFlusher is closed")
+        self._queue.put((values, step, log_kwargs or {}))
+
+    # ------------------------------------------------------------ background
+    def _loop(self):
+        while True:
+            item = self._queue.get()
+            if item is not _STOP and not self._draining.is_set():
+                self._draining.wait(self.COALESCE_S)
+            batch = [item]
+            while True:  # opportunistic batching: drain whatever is queued
+                try:
+                    batch.append(self._queue.get_nowait())
+                except queue.Empty:
+                    break
+            stop = any(entry is _STOP for entry in batch)
+            entries = [e for e in batch if e is not _STOP]
+            if entries:
+                self._write(entries)
+            for _ in batch:
+                self._queue.task_done()
+            if stop:
+                return
+
+    def _write(self, entries):
+        materialized = []
+        for values, step, log_kwargs in entries:
+            try:
+                materialized.append((materialize_metrics(values), step, log_kwargs))
+            except Exception as exc:  # noqa: BLE001 — never kill the thread
+                self._record(exc)
+        for tracker in self.trackers:
+            per_tracker = [
+                (values, step, kw.get(tracker.name, {}))
+                for values, step, kw in materialized
+            ]
+            try:
+                tracker.log_batch(per_tracker)
+            except Exception as exc:  # noqa: BLE001
+                self._record(exc)
+
+    def _record(self, exc: BaseException) -> None:
+        if not self._errors:
+            self._errors.append(exc)
+        logger.warning(f"async tracker flush failed: {type(exc).__name__}: {exc}")
+
+    # -------------------------------------------------------------- control
+    def _raise_pending(self):
+        if self._errors:
+            raise self._errors.pop(0)
+
+    def flush(self) -> None:
+        """Block until every submitted record has been written (or failed);
+        re-raise the first deferred tracker error."""
+        self._draining.set()
+        try:
+            self._queue.join()
+        finally:
+            self._draining.clear()
+        self._raise_pending()
+
+    def close(self) -> None:
+        """Flush everything, stop the thread, surface deferred errors.
+        Idempotent."""
+        if not self._closed:
+            self._closed = True
+            self._draining.set()
+            self._queue.put(_STOP)
+            self._queue.join()
+            self._thread.join(timeout=30)
+        self._raise_pending()

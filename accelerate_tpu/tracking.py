@@ -77,6 +77,14 @@ class GeneralTracker:
     def log(self, values: dict, step: Optional[int] = None, **kwargs):
         raise NotImplementedError
 
+    def log_batch(self, entries):
+        """Write several queued records at once. ``entries`` is a list of
+        ``(values, step, kwargs)`` tuples (values already materialized to
+        host types by the async flusher). Backends override this to batch
+        file writes / flushes; the default just replays ``log`` per record."""
+        for values, step, kwargs in entries:
+            self.log(values, step=step, **kwargs)
+
     def log_images(self, values: dict, step: Optional[int] = None, **kwargs):
         pass
 
@@ -113,11 +121,24 @@ class JSONLTracker(GeneralTracker):
 
     @on_main_process
     def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        self.log_batch([(values, step, kwargs)])
+
+    @on_main_process
+    def log_batch(self, entries):
+        # one write + one flush for the whole batch — the async flusher can
+        # hand us dozens of steps per wakeup without dozens of syscalls
+        if not entries:
+            return
         if self._fh is None:
             self.start()
-        rec = {"_step": step, "_time": time.time()}
-        rec.update({k: (float(v) if hasattr(v, "__float__") else v) for k, v in values.items()})
-        self._fh.write(json.dumps(rec, default=str) + "\n")
+        lines = []
+        for values, step, _kwargs in entries:
+            rec = {"_step": step, "_time": time.time()}
+            rec.update(
+                {k: (float(v) if hasattr(v, "__float__") else v) for k, v in values.items()}
+            )
+            lines.append(json.dumps(rec, default=str))
+        self._fh.write("\n".join(lines) + "\n")
         self._fh.flush()
 
     @on_main_process
@@ -163,13 +184,22 @@ class TensorBoardTracker(GeneralTracker):
 
     @on_main_process
     def log(self, values: dict, step: Optional[int] = None, **kwargs):
-        for k, v in values.items():
-            if isinstance(v, str):
-                self.writer.add_text(k, v, global_step=step)
-            elif isinstance(v, dict):
-                self.writer.add_scalars(k, v, global_step=step)
-            else:
-                self.writer.add_scalar(k, float(v), global_step=step, **kwargs)
+        self.log_batch([(values, step, kwargs)])
+
+    @on_main_process
+    def log_batch(self, entries):
+        # all scalars for the batch land in the event file behind a single
+        # flush, instead of one flush per step
+        if not entries:
+            return
+        for values, step, kwargs in entries:
+            for k, v in values.items():
+                if isinstance(v, str):
+                    self.writer.add_text(k, v, global_step=step)
+                elif isinstance(v, dict):
+                    self.writer.add_scalars(k, v, global_step=step)
+                else:
+                    self.writer.add_scalar(k, float(v), global_step=step, **kwargs)
         self.writer.flush()
 
     @on_main_process

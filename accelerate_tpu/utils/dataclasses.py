@@ -243,11 +243,28 @@ class TrainingHealthConfig(KwargsHandler):
 
     ``max_bad_steps`` bounds how many *consecutive* unhealthy steps the
     skip/restore policies tolerate before raising anyway — a persistent
-    divergence should stop the job, not loop forever restoring."""
+    divergence should stop the job, not loop forever restoring.
+
+    ``sync`` picks between per-step exactness and a full dispatch
+    pipeline (docs/fault_tolerance.md "Telemetry cost"):
+
+    * ``sync=True`` (default) — the verdict for step S is read back and
+      applied inside step S's ``check_step_health`` call. Exact, but a
+      host sync point per call (still only ONE fused scalar transfer —
+      the finiteness of the loss and every grad leaf is tree-reduced on
+      device by ``telemetry.health_summary``).
+    * ``sync=False`` — deferred-readback ring: each call enqueues this
+      step's device scalars and only blocks on the value from
+      ``readback_depth`` steps ago, so the host never flushes the
+      dispatch pipeline it just filled. Policies apply with
+      ``readback_depth``-step latency; ``Accelerator.health_drain()``
+      flushes pending verdicts exactly (called by ``end_training``)."""
 
     nonfinite_policy: str = "raise"  # "raise" | "skip" | "restore"
     check_grads: bool = False
     max_bad_steps: int = 10
+    sync: bool = True
+    readback_depth: int = 2
 
     def __post_init__(self):
         if self.nonfinite_policy not in ("raise", "skip", "restore"):
@@ -257,6 +274,8 @@ class TrainingHealthConfig(KwargsHandler):
             )
         if self.max_bad_steps <= 0:
             raise ValueError("max_bad_steps must be a positive integer")
+        if self.readback_depth < 1:
+            raise ValueError("readback_depth must be a positive integer")
 
 
 @dataclass

@@ -16,6 +16,31 @@ _TRUE = {"1", "true", "yes", "on", "y", "t"}
 _FALSE = {"0", "false", "no", "off", "n", "f", ""}
 
 
+def default_compile_cache_dir() -> str:
+    """Per-user default for the persistent JAX compile cache.
+
+    A world-shared path like ``/tmp/accelerate_tpu_jax_cache`` is a
+    poisoned-cache risk on multi-user hosts: cache entries are deserialized
+    compiled executables, so anyone who can write the directory can plant
+    code that the next user's process runs. ``JAX_COMPILATION_CACHE_DIR``
+    still wins when set; otherwise XDG/`~/.cache`, with a uid-salted tmpdir
+    as the last resort (e.g. HOME unset in a stripped container)."""
+    env = os.environ.get("JAX_COMPILATION_CACHE_DIR")
+    if env:
+        return env
+    base = os.environ.get("XDG_CACHE_HOME", "")
+    if not base:
+        home = os.path.expanduser("~")
+        if home and home != "~":
+            base = os.path.join(home, ".cache")
+    if not base:
+        import tempfile
+
+        uid = os.getuid() if hasattr(os, "getuid") else "user"
+        base = os.path.join(tempfile.gettempdir(), f"accelerate_tpu-{uid}")
+    return os.path.join(base, "accelerate_tpu", "jax")
+
+
 def str_to_bool(value: str) -> int:
     """Convert a string to 1/0 (raises on unrecognized), mirroring
     reference utils/environment.py:59-74."""

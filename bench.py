@@ -177,6 +177,16 @@ def relative_leaf_gate(cand_leaves, base_leaves, ref_leaves, labels, ratio=2.0):
     never drift): the candidate (bf16 kernel) must track the f32 reference
     within ``ratio``x of the bf16 baseline's own error, with a small
     absolute floor for near-zero baselines. Returns (ok, per-leaf dict)."""
+    # a kernel variant silently dropping a grad leaf must FAIL the gate,
+    # not shorten the zip and vacuously pass on the leaves that remain
+    counts = {
+        "labels": len(labels),
+        "cand": len(cand_leaves),
+        "base": len(base_leaves),
+        "ref": len(ref_leaves),
+    }
+    if len(set(counts.values())) != 1:
+        raise ValueError(f"relative_leaf_gate: leaf-count mismatch {counts}")
     ok = True
     details = {}
     for label, f, b, r in zip(labels, cand_leaves, base_leaves, ref_leaves):
@@ -352,9 +362,11 @@ def main(note=None):
     # pays every compile again (20-40 s each through the relay). Harmless
     # when unsupported; min-compile-time filter keeps tiny programs out.
     try:
-        cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR",
-                                   "/tmp/accelerate_tpu_jax_cache")
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # per-user path (not world-shared /tmp): cache entries deserialize
+        # into compiled executables — see default_compile_cache_dir
+        from accelerate_tpu.utils.environment import default_compile_cache_dir
+
+        jax.config.update("jax_compilation_cache_dir", default_compile_cache_dir())
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
     except Exception:  # noqa: BLE001 — cache is an optimization only
         pass
@@ -598,6 +610,13 @@ def _emit(device, config, seq_len, measured, notes=""):
 
 
 if __name__ == "__main__":
+    if "--telemetry-gate" in sys.argv:
+        # regression gate: async telemetry (fused health + async log) must
+        # stay within 5% of telemetry-off steps/s on the CPU A/B
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from benchmarks.telemetry_bench import main as telemetry_main
+
+        sys.exit(telemetry_main(gate=True))
     if "--child" in sys.argv:
         # the actual measurement; parent enforces the wall-clock watchdog
         try:

@@ -11,8 +11,10 @@
 set -uo pipefail
 cd "$(dirname "$0")/.."
 # persistent compile cache shared by every phase (and with bench.py's
-# default): repeat windows and sibling processes skip identical compiles
-export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-/tmp/accelerate_tpu_jax_cache}"
+# default): repeat windows and sibling processes skip identical compiles.
+# Per-user path, not world-shared /tmp (poisoned-cache risk — see
+# accelerate_tpu.utils.environment.default_compile_cache_dir)
+export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-${XDG_CACHE_HOME:-$HOME/.cache}/accelerate_tpu/jax}"
 STAMP=$(date '+%Y%m%d_%H%M%S')
 LOG="runs/window_sweep_${STAMP}.log"
 echo "== window sweep ${STAMP} ==" | tee -a "$LOG"
@@ -43,6 +45,10 @@ phase bench 2500 python -u bench.py
 phase bench_r1_calib 1100 env BENCH_SWEEP=0 BENCH_REMAT=nothing \
   BENCH_ATTN=xla BENCH_STEPS=8 BENCH_REPEATS=3 BENCH_TPU_TIMEOUT=900 \
   BENCH_CPU_TIMEOUT=120 python -u bench.py
+
+# 1c. telemetry overhead gate (CPU A/B — relay not required but cheap):
+#     async health+logging must stay within 5% of telemetry-off
+phase telemetry 600 python -u benchmarks/telemetry_bench.py --gate
 
 # 2. Pallas kernel real-lowering evidence: every entry-point variant
 #    (base/GQA/window/softcap/segments/noncausal/with_lse/ring-shape)

@@ -1,0 +1,364 @@
+"""Non-blocking telemetry: fused health summary (ONE transfer per step),
+deferred-readback ring semantics (verdicts exactly K steps late), sync-mode
+PR-1 parity, drain-on-end_training, async tracker flushing off the hot
+path, and flush ordering under tracker exceptions.
+
+Transfer counting works because every telemetry readback in the package
+funnels through ``telemetry._fetch`` — shimming that one function counts
+device->host transfers and records which thread performed them.
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu import telemetry
+from accelerate_tpu.parallelism_config import ParallelismConfig
+from accelerate_tpu.test_utils.training import (
+    RegressionModel,
+    make_regression_data,
+    regression_loss,
+)
+from accelerate_tpu.tracking import GeneralTracker, register_tracker_class
+from accelerate_tpu.utils.dataclasses import TrainingHealthConfig
+from accelerate_tpu.utils.fault import TrainingHealthError
+
+NAN = jnp.float32(float("nan"))
+OK = jnp.float32(0.5)
+
+
+def _fresh(tmp_path, **kwargs):
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+    return Accelerator(
+        parallelism_config=ParallelismConfig(dp_shard_size=8),
+        project_dir=str(tmp_path),
+        **kwargs,
+    )
+
+
+def _prepared(acc):
+    model = RegressionModel()
+    optimizer = optax.adam(0.1)
+    data = make_regression_data(32)
+    loader = acc.prepare_data_loader(data, batch_size=16, drop_last=True)
+    model, optimizer = acc.prepare(model, optimizer)
+    return model, optimizer, loader
+
+
+def _one_step(acc, model, optimizer, batch):
+    with acc.accumulate(model):
+        acc.backward(regression_loss, batch)
+        optimizer.step()
+        optimizer.zero_grad()
+
+
+class _FetchCounter:
+    """Shim for telemetry._fetch: counts transfers + records the thread."""
+
+    def __init__(self, monkeypatch):
+        self.calls = []
+        real = telemetry._fetch
+
+        def counting(value):
+            self.calls.append(threading.current_thread())
+            return real(value)
+
+        monkeypatch.setattr(telemetry, "_fetch", counting)
+
+    @property
+    def count(self):
+        return len(self.calls)
+
+    @property
+    def main_thread_count(self):
+        return sum(1 for t in self.calls if t is threading.main_thread())
+
+
+# ------------------------------------------------------------ fused summary
+def test_health_summary_fuses_loss_and_grads():
+    grads = {"a": jnp.float32(1.0), "b": jnp.ones((3,)), "i": jnp.int32(7)}
+    h = telemetry.read_summary(telemetry.health_summary(OK, grads), step=0)
+    assert h.healthy and h.loss_finite and h.grads_finite
+    assert h.grad_norm == pytest.approx(2.0)  # sqrt(1 + 3*1), int leaf skipped
+
+    bad = {"a": jnp.float32(1.0), "b": jnp.array([1.0, float("nan"), 1.0])}
+    h = telemetry.read_summary(telemetry.health_summary(OK, bad), step=1)
+    assert h.loss_finite and not h.grads_finite and not h.healthy
+
+    h = telemetry.read_summary(telemetry.health_summary(NAN, grads), step=2)
+    assert not h.loss_finite and h.grads_finite and not h.healthy
+
+
+def test_health_summary_reuses_supplied_grad_norm():
+    h = telemetry.read_summary(
+        telemetry.health_summary(OK, {"a": jnp.float32(3.0)}, grad_norm=jnp.float32(9.0)),
+        step=0,
+    )
+    assert h.grad_norm == pytest.approx(9.0)
+
+
+def test_health_summary_no_grads_has_no_norm():
+    h = telemetry.read_summary(telemetry.health_summary(OK), step=0)
+    assert h.healthy and h.grad_norm is None
+
+
+def test_sync_health_single_transfer_multi_leaf_grads(tmp_path, monkeypatch):
+    """The acceptance criterion: one host transfer per health check, even
+    with check_grads over a multi-leaf grad tree (PR 1 did one per leaf)."""
+    acc = _fresh(tmp_path, health_config=TrainingHealthConfig(check_grads=True))
+    model, optimizer, loader = _prepared(acc)
+    _one_step(acc, model, optimizer, next(iter(loader)))
+    counter = _FetchCounter(monkeypatch)
+    grads = {f"g{i}": jnp.ones((4,)) for i in range(8)}
+    assert acc.check_step_health(loss=OK, grads=grads) is True
+    assert counter.count == 1
+
+
+# ------------------------------------------------------- ring verdict latency
+def test_ring_rejects_bad_depth():
+    with pytest.raises(ValueError):
+        telemetry.DeferredReadbackRing(0)
+    with pytest.raises(ValueError):
+        TrainingHealthConfig(readback_depth=0)
+
+
+def test_ring_maturity_order():
+    ring = telemetry.DeferredReadbackRing(2)
+    assert ring.push("a") == []
+    assert ring.push("b") == []
+    assert ring.push("c") == ["a"]
+    assert ring.push("d") == ["b"]
+    assert len(ring) == 2
+    assert ring.drain() == ["c", "d"]
+    assert len(ring) == 0
+
+
+def test_deferred_verdict_arrives_exactly_k_steps_late(tmp_path):
+    """NaN at call S is acted on at call S+K (skip policy, depth 2)."""
+    acc = _fresh(
+        tmp_path,
+        health_config=TrainingHealthConfig(
+            nonfinite_policy="skip", sync=False, readback_depth=2, max_bad_steps=10
+        ),
+    )
+    model, optimizer, loader = _prepared(acc)
+    _one_step(acc, model, optimizer, next(iter(loader)))
+    losses = [OK, OK, NAN, OK, OK]  # NaN injected at call index 2
+    verdicts = [acc.check_step_health(loss=l) for l in losses]
+    # calls 0,1 fill the ring (True); call 2 sees step 0, call 3 sees step 1,
+    # call 4 sees step 2 — the NaN — exactly K=2 calls after injection
+    assert verdicts == [True, True, True, True, False]
+    assert acc.last_health.step == 2 and not acc.last_health.healthy
+
+
+def test_sync_mode_is_immediate_pr1_parity(tmp_path):
+    acc = _fresh(tmp_path)  # default: sync=True, raise policy
+    model, optimizer, loader = _prepared(acc)
+    _one_step(acc, model, optimizer, next(iter(loader)))
+    assert acc.check_step_health(loss=OK) is True
+    with pytest.raises(TrainingHealthError):
+        acc.check_step_health(loss=NAN)
+
+
+def test_restore_policy_fires_k_steps_late_and_restores(tmp_path):
+    acc = _fresh(
+        tmp_path,
+        health_config=TrainingHealthConfig(
+            nonfinite_policy="restore", sync=False, readback_depth=2
+        ),
+    )
+    model, optimizer, loader = _prepared(acc)
+    _one_step(acc, model, optimizer, next(iter(loader)))
+    acc.save_state(str(tmp_path / "good"))
+    a_good = float(model.params["a"])
+
+    model.params = {"a": jnp.float32(999.0), "b": jnp.float32(999.0)}
+    assert acc.check_step_health(loss=NAN) is True  # enqueued, not yet seen
+    assert acc.check_step_health(loss=OK) is True
+    assert float(model.params["a"]) == 999.0  # not restored yet
+    assert acc.check_step_health(loss=OK) is False  # NaN verdict lands here
+    assert float(model.params["a"]) == pytest.approx(a_good)
+    # the restore cleared pre-reload in-flight entries as stale
+    assert len(acc._health_ring) == 0
+
+
+def test_health_drain_applies_pending_verdicts(tmp_path):
+    acc = _fresh(
+        tmp_path,
+        health_config=TrainingHealthConfig(
+            nonfinite_policy="skip", sync=False, readback_depth=4
+        ),
+    )
+    model, optimizer, loader = _prepared(acc)
+    _one_step(acc, model, optimizer, next(iter(loader)))
+    assert acc.check_step_health(loss=OK) is True
+    assert acc.check_step_health(loss=NAN) is True  # still in the ring
+    assert acc.health_drain() is False  # drain realizes the NaN verdict
+    assert acc.health_drain() is True  # idempotent once empty
+
+
+def test_end_training_drains_ring_and_raises(tmp_path):
+    acc = _fresh(
+        tmp_path,
+        health_config=TrainingHealthConfig(sync=False, readback_depth=4),
+    )
+    model, optimizer, loader = _prepared(acc)
+    _one_step(acc, model, optimizer, next(iter(loader)))
+    acc.check_step_health(loss=NAN)  # pending in the ring, raise policy
+    with pytest.raises(TrainingHealthError):
+        acc.end_training()
+
+
+def test_grad_norm_reused_from_clipping(tmp_path):
+    """clip_grad_norm_'s already-computed reduction rides the summary."""
+    acc = _fresh(tmp_path, health_config=TrainingHealthConfig(check_grads=True))
+    model, optimizer, loader = _prepared(acc)
+    batch = next(iter(loader))
+    with acc.accumulate(model):
+        acc.backward(regression_loss, batch)
+        norm = float(np.asarray(acc.clip_grad_norm_(max_norm=10.0)))
+        assert acc.check_step_health(loss=OK) is True
+        assert acc.last_health.grad_norm == pytest.approx(norm, rel=1e-5)
+        optimizer.step()
+        optimizer.zero_grad()
+    # consumed by step(): the stale norm must not leak into the next step
+    assert optimizer._last_grad_norm is None
+
+
+# ------------------------------------------------------------- async logging
+def test_async_log_no_hot_path_transfer(tmp_path, monkeypatch):
+    """log() with device jax.Array values must never read back on the main
+    thread — all materialization happens on the flusher thread."""
+    counter = _FetchCounter(monkeypatch)
+    acc = _fresh(tmp_path, log_with="jsonl", async_logging=True)
+    acc.init_trackers("async_run")
+    for i in range(5):
+        acc.log({"loss": jnp.float32(i) / 10}, step=i)
+    acc.end_training()
+    assert counter.count == 5
+    assert counter.main_thread_count == 0
+
+    lines = [json.loads(l) for l in open(tmp_path / "async_run" / "metrics.jsonl")]
+    assert [l["_step"] for l in lines] == list(range(5))
+    assert lines[3]["loss"] == pytest.approx(0.3)
+
+
+def test_sync_log_unchanged_without_async(tmp_path):
+    """Default (no async_logging): values pass through to trackers as-is,
+    synchronously — PR 1 behavior, custom trackers see exact objects."""
+    logged = []
+
+    class EagerTracker(GeneralTracker):
+        name = "eager"
+        requires_logging_directory = False
+
+        @property
+        def tracker(self):
+            return logged
+
+        def log(self, values, step=None, **kwargs):
+            logged.append((step, values))
+
+    register_tracker_class("eager", EagerTracker)
+    acc = _fresh(tmp_path, log_with="eager")
+    acc.init_trackers("run")
+    acc.log({"x": 1}, step=5)
+    assert logged == [(5, {"x": 1})]  # immediate, int preserved
+
+
+def test_flusher_defers_errors_other_trackers_still_written(tmp_path):
+    records = []
+    finished = []
+
+    class GoodTracker(GeneralTracker):
+        name = "good"
+        requires_logging_directory = False
+
+        @property
+        def tracker(self):
+            return records
+
+        def log(self, values, step=None, **kwargs):
+            records.append((step, values))
+
+        def finish(self):
+            finished.append("good")
+
+    class BadTracker(GeneralTracker):
+        name = "bad"
+        requires_logging_directory = False
+
+        @property
+        def tracker(self):
+            return None
+
+        def log(self, values, step=None, **kwargs):
+            raise RuntimeError("backend down")
+
+        def finish(self):
+            finished.append("bad")
+
+    register_tracker_class("good", GoodTracker)
+    register_tracker_class("bad", BadTracker)
+    acc = _fresh(tmp_path, log_with=["bad", "good"], async_logging=True)
+    acc.init_trackers("run")
+    for i in range(3):
+        acc.log({"v": i}, step=i)  # must not raise on the hot path
+    with pytest.raises(RuntimeError, match="backend down"):
+        acc.end_training()
+    # the failing tracker never blocked the healthy one, and both finished
+    assert [s for s, _ in records] == [0, 1, 2]
+    assert sorted(finished) == ["bad", "good"]
+
+
+def test_flusher_flush_blocks_until_written():
+    writes = []
+
+    class SlowTracker(GeneralTracker):
+        name = "slow"
+        requires_logging_directory = False
+
+        def __init__(self):  # bypass GeneralTracker signature for direct use
+            pass
+
+        @property
+        def tracker(self):
+            return writes
+
+        def log(self, values, step=None, **kwargs):
+            writes.append(step)
+
+    flusher = telemetry.AsyncTrackerFlusher([SlowTracker()])
+    try:
+        for i in range(20):
+            flusher.submit({"x": i}, step=i)
+        flusher.flush()
+        assert writes == list(range(20))
+    finally:
+        flusher.close()
+    with pytest.raises(RuntimeError):
+        flusher.submit({"x": 99}, step=99)
+    flusher.close()  # idempotent
+
+
+def test_jsonl_log_batch_single_write(tmp_path):
+    from accelerate_tpu.tracking import JSONLTracker
+
+    t = JSONLTracker("runb", logging_dir=str(tmp_path))
+    t.start()
+    t.log_batch([({"a": 1.0}, 0, {}), ({"a": 2.0}, 1, {})])
+    t.log_batch([])  # no-op, must not write a blank line
+    t.finish()
+    lines = [json.loads(l) for l in open(tmp_path / "runb" / "metrics.jsonl")]
+    assert len(lines) == 2
+    assert lines[1]["a"] == 2.0 and lines[1]["_step"] == 1
