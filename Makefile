@@ -9,7 +9,7 @@
 PY := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu python
 PY_SLOW := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu RUN_SLOW=1 python
 
-.PHONY: test test_all test_core test_data test_parallel test_models test_cli test_big_modeling test-fault bench bench-telemetry
+.PHONY: test test_all test_core test_data test_parallel test_models test_cli test_big_modeling test-fault test-serving bench bench-telemetry bench-serving
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -19,7 +19,13 @@ test:
 # health watchdog, supervisor backoff/crash-loop — fast, on 8 virtual
 # CPU devices (XLA_FLAGS comes from tests/conftest.py)
 test-fault:
-	$(PY) -m pytest tests/test_durability.py tests/test_checkpointing.py -q
+	$(PY) -m pytest tests/test_durability.py tests/test_checkpointing.py tests/test_serving.py -q
+
+# resilient-serving suite (docs/serving.md): dynamic batching, deadline
+# shedding, backpressure, retry/backoff, circuit breaker, SIGTERM drain,
+# fault-injected batch death (exactly-once replies)
+test-serving:
+	$(PY) -m pytest tests/test_serving.py -q
 
 test_all:
 	$(PY_SLOW) -m pytest tests/test_state.py tests/test_operations.py tests/test_parallelism_config.py tests/test_accelerator.py tests/test_checkpointing.py tests/test_tracking.py tests/test_data_loader.py tests/test_data_shard_info.py tests/test_misc.py tests/test_cli.py tests/test_big_modeling.py tests/test_losses.py tests/test_flatbuf.py tests/test_local_sgd.py tests/test_api_parity.py tests/test_hlo_analysis.py tests/test_tracking_fakes.py tests/test_powersgd.py -q
@@ -52,3 +58,10 @@ bench:
 # 5% of telemetry-off steps/s (docs/fault_tolerance.md)
 bench-telemetry:
 	$(PY) benchmarks/telemetry_bench.py --gate
+
+# serving resilience gate: load ramp at 1x/2x/4x capacity, breaker
+# open/close under injected faults, recovery throughput >= 95% of
+# baseline, SIGTERM drain exits 143 with zero dropped in-flight
+# (docs/serving.md)
+bench-serving:
+	$(PY) benchmarks/serving_bench.py --gate

@@ -44,6 +44,19 @@ _LAZY = {
     "cpu_offload": ("big_modeling", "cpu_offload"),
     "generate": ("inference", "generate"),
     "prepare_inference": ("inference", "prepare_inference"),
+    "generate_cache_stats": ("inference", "generate_cache_stats"),
+    "InferenceServer": ("serving", "InferenceServer"),
+    "ServingResult": ("serving", "ServingResult"),
+    "ServingMetrics": ("serving", "ServingMetrics"),
+    "install_drain_handler": ("serving", "install_drain_handler"),
+    "ServingConfig": ("utils.dataclasses", "ServingConfig"),
+    "ServingError": ("utils.fault", "ServingError"),
+    "ServerOverloaded": ("utils.fault", "ServerOverloaded"),
+    "RequestDeadlineExceeded": ("utils.fault", "RequestDeadlineExceeded"),
+    "CircuitOpenError": ("utils.fault", "CircuitOpenError"),
+    "ServerDrainingError": ("utils.fault", "ServerDrainingError"),
+    "BatchExecutionError": ("utils.fault", "BatchExecutionError"),
+    "BarrierTimeoutError": ("utils.fault", "BarrierTimeoutError"),
     "LocalSGD": ("local_sgd", "LocalSGD"),
     "GeneralTracker": ("tracking", "GeneralTracker"),
     "find_executable_batch_size": ("utils.memory", "find_executable_batch_size"),
@@ -64,6 +77,7 @@ _LAZY = {
     "StepHealth": ("telemetry", "StepHealth"),
     "DeferredReadbackRing": ("telemetry", "DeferredReadbackRing"),
     "AsyncTrackerFlusher": ("telemetry", "AsyncTrackerFlusher"),
+    "LatencyReservoir": ("telemetry", "LatencyReservoir"),
 }
 
 

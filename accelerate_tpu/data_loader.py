@@ -26,6 +26,7 @@ import copy
 import math
 import queue
 import threading
+import time
 from typing import Any, Callable, Iterable, Iterator, Optional, Sequence
 
 import numpy as np
@@ -385,7 +386,13 @@ class IterableDatasetShard:
 class _DevicePrefetcher:
     """Background thread staging host batches onto the mesh while the previous
     step computes — the ``MpDeviceLoaderWrapper`` role (data_loader.py:670-721).
-    Depth 2 double-buffers without pinning excess HBM."""
+    Depth 2 double-buffers without pinning excess HBM.
+
+    A consumer that abandons iteration early (break / exception) must call
+    :meth:`close`: without it the daemon worker stays blocked in ``q.put``
+    forever, holding already-staged device batches pinned in HBM (and the
+    underlying host iterator open). The owning loader's iterator cleanup and
+    re-iteration both call it."""
 
     _SENTINEL = object()
 
@@ -394,17 +401,32 @@ class _DevicePrefetcher:
         self.put_fn = put_fn
         self.q: queue.Queue = queue.Queue(maxsize=depth)
         self.error: Optional[BaseException] = None
+        self._stop = threading.Event()
         self.thread = threading.Thread(target=self._worker, daemon=True)
         self.thread.start()
+
+    def _put(self, item) -> bool:
+        """Bounded put that yields to a close() signal instead of blocking
+        forever on a full queue with no consumer. Returns False on stop."""
+        while not self._stop.is_set():
+            try:
+                self.q.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def _worker(self):
         try:
             for item in self.iterator:
-                self.q.put(self.put_fn(item))
+                if self._stop.is_set():
+                    return
+                if not self._put(self.put_fn(item)):
+                    return
         except BaseException as e:  # noqa: BLE001 - reraised on main thread
             self.error = e
         finally:
-            self.q.put(self._SENTINEL)
+            self._put(self._SENTINEL)
 
     def __iter__(self):
         return self
@@ -416,6 +438,32 @@ class _DevicePrefetcher:
                 raise self.error
             raise StopIteration
         return item
+
+    @property
+    def closed(self) -> bool:
+        return self._stop.is_set() and not self.thread.is_alive()
+
+    def close(self, timeout: float = 5.0) -> bool:
+        """Signal the worker, drain staged batches (releasing their HBM),
+        and join. Idempotent; safe from any thread. Returns True when the
+        worker exited within ``timeout``."""
+        self._stop.set()
+        deadline = time.monotonic() + timeout
+        while self.thread.is_alive() and time.monotonic() < deadline:
+            # drain so a put-blocked worker can observe the stop flag
+            try:
+                while True:
+                    self.q.get_nowait()
+            except queue.Empty:
+                pass
+            self.thread.join(timeout=0.05)
+        # final drain: nothing staged may stay pinned behind the queue
+        try:
+            while True:
+                self.q.get_nowait()
+        except queue.Empty:
+            pass
+        return not self.thread.is_alive()
 
 
 # ------------------------------------------------------------------- loaders
@@ -544,14 +592,33 @@ class _BaseAcceleratedLoader:
 
         return snapshotting()
 
+    def _close_prefetcher(self) -> None:
+        """Shut down any live prefetch worker (abandoned iteration would
+        otherwise leak the thread + its HBM-pinned staged batches)."""
+        prefetcher = getattr(self, "_active_prefetcher", None)
+        if prefetcher is not None:
+            self._active_prefetcher = None
+            prefetcher.close()
+
+    def __del__(self):
+        try:
+            self._close_prefetcher()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
+
     def _iter_with_gradient_state(self, raw_iter):
         self.end_of_dataloader = False
+        # re-iteration abandons any previous epoch's half-consumed iterator;
+        # reap its prefetch worker before starting a new one
+        self._close_prefetcher()
         self.gradient_state._add_dataloader(self)
         if self.rng_types is not None:
             synchronize_rng_states(self.rng_types, self.synchronized_generator)
+        prefetcher = None
         try:
             if self.device_prefetch:
-                raw_iter = _DevicePrefetcher(raw_iter, self._place)
+                prefetcher = _DevicePrefetcher(raw_iter, self._place)
+                self._active_prefetcher = raw_iter = prefetcher
                 place = lambda b: b
             else:
                 place = self._place
@@ -579,6 +646,14 @@ class _BaseAcceleratedLoader:
                 # this point must NOT replay-skip into the next epoch
                 self._position = 0
         finally:
+            # runs on normal exhaustion AND on GeneratorExit when the
+            # consumer breaks/raises — the leak path close() exists for.
+            # Close OUR prefetcher, not _active_prefetcher: a re-iteration
+            # may already own a newer one this stale generator must not kill.
+            if prefetcher is not None:
+                prefetcher.close()
+                if getattr(self, "_active_prefetcher", None) is prefetcher:
+                    self._active_prefetcher = None
             self.gradient_state._remove_dataloader(self)
             self.iteration += 1
 

@@ -11,6 +11,7 @@ just the pp mesh axis.
 
 from __future__ import annotations
 
+import os
 import threading
 from collections import OrderedDict
 from typing import Optional
@@ -21,11 +22,14 @@ from jax import lax
 
 from .model import Model
 
-__all__ = ["generate", "prepare_inference"]
+__all__ = ["generate", "prepare_inference", "generate_cache_stats"]
 
 # compiled generate() programs kept per Model (serving loops with varying
-# prompt lengths compile per length; this caps host-side executable count)
-_GENERATE_CACHE_MAX = 16
+# prompt lengths compile per length; this caps host-side executable count).
+# ACCELERATE_GENERATE_CACHE_MAX tunes it for serving deployments whose
+# bucket grid (batch pow-2s × prompt lengths × total-len multiples) is
+# wider than the default.
+_GENERATE_CACHE_MAX = int(os.environ.get("ACCELERATE_GENERATE_CACHE_MAX", "16"))
 
 # guards the lazy attach of a model's LRU + lock (double-checked below);
 # the per-model lock then guards that model's OrderedDict — concurrent
@@ -177,6 +181,23 @@ def generate(
         jnp.int32(eos_token_id if eos_on else -1),
         jnp.int32(pad_token_id),
     )
+
+
+def generate_cache_stats(model: Model) -> dict:
+    """Observability for the per-model compiled-program LRU: how many
+    executables are live and which structural keys they hold. The serving
+    bench reports this to prove dynamic batching's bucket padding keeps the
+    executable count bounded under varied traffic."""
+    cache = getattr(model, "_generate_cache", None)
+    lock = getattr(model, "_generate_cache_lock", None)
+    if cache is None:
+        return {"size": 0, "max": _GENERATE_CACHE_MAX, "keys": []}
+    if lock is not None:
+        with lock:
+            keys = list(cache.keys())
+    else:
+        keys = list(cache.keys())
+    return {"size": len(keys), "max": _GENERATE_CACHE_MAX, "keys": keys}
 
 
 def prepare_inference(model: Model, mesh=None, rules=None) -> Model:

@@ -159,6 +159,18 @@ def _spawn_cluster(function, args, num_processes, local_devices, port,
             p.join(timeout=60)
             if p.is_alive():
                 p.terminate()
+        # terminate() is SIGTERM: a worker wedged in native code (XLA
+        # compile, collective) can survive it. Escalate: bounded re-join,
+        # then SIGKILL, then a final join so no zombie outlives the launcher.
+        for p in procs:
+            if p.is_alive():
+                p.join(timeout=10)
+            if p.is_alive():
+                logger.warning(
+                    "launcher worker pid=%s survived terminate(); killing", p.pid
+                )
+                p.kill()
+                p.join(timeout=10)
     if errors:
         raise RuntimeError("launcher worker failure:\n" + "\n".join(errors))
 

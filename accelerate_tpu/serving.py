@@ -1,0 +1,771 @@
+"""Resilient serving: deadline-aware dynamic batching with backpressure,
+retry/backoff, circuit breaking, graceful degradation, and graceful drain.
+
+The inference path used to be a bare compiled :func:`~accelerate_tpu
+.inference.generate` call — fine for a notebook, not for the ROADMAP's
+"heavy traffic from millions of users". The reference harness delegates
+serving-shaped robustness to external engines (SURVEY §3.5); a TPU-native
+framework must supply it itself, in the same single-controller style the
+rest of the package uses: ONE Python worker thread owns dispatch, requests
+are plain host-side objects, and the device only ever sees bucket-padded
+batches that hit the per-model compiled-program LRU.
+
+Robustness is the headline, not throughput (docs/serving.md):
+
+* **Backpressure** — a bounded admission queue; full means a typed
+  :class:`~accelerate_tpu.utils.fault.ServerOverloaded` NOW, not unbounded
+  memory later.
+* **Deadlines** — enforced at dequeue (a request that cannot finish in
+  time is shed instead of wasting a batch slot — the estimate is an EWMA
+  of recent batch times) and again at completion.
+* **Retry** — transiently failed batches retry with exponential backoff +
+  jitter; the retry budget is per batch, never per server.
+* **Circuit breaker** — consecutive failed attempts (e.g. repeated
+  RESOURCE_EXHAUSTED compiles) open the breaker: submissions fail fast
+  with :class:`~accelerate_tpu.utils.fault.CircuitOpenError` while
+  half-open probe batches test recovery.
+* **Graceful degradation** — under sustained queue pressure per-request
+  token budgets are clamped *before* anything is shed: cheaper batches
+  drain a backlog faster than rejections do.
+* **Graceful drain** — SIGTERM (via :func:`install_drain_handler` or the
+  training-side preemption handler) stops admission, finishes in-flight
+  batches, and rejects queued-but-unbatched requests with a retriable
+  :class:`~accelerate_tpu.utils.fault.ServerDrainingError`.
+
+Every lifecycle moment has a named :func:`~accelerate_tpu.utils.fault
+.fault_point` (``serving_submit``, ``serving_before_batch``,
+``serving_after_batch``, ``serving_before_reply``) so the test suite can
+prove each failure mode, and queue depth / latency percentiles / shed-
+timeout-retry-breaker counters flow through ``GeneralTracker.log_batch``.
+"""
+
+from __future__ import annotations
+
+import collections
+import random
+import signal
+import sys
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional, Sequence
+
+import numpy as np
+
+from .logging import get_logger
+from .telemetry import LatencyReservoir
+from .utils.dataclasses import ServingConfig
+from .utils.fault import (
+    PREEMPTION_EXIT_CODE,
+    BatchExecutionError,
+    CircuitOpenError,
+    RequestDeadlineExceeded,
+    ServerDrainingError,
+    ServerOverloaded,
+    fault_point,
+    preemption_requested,
+)
+
+logger = get_logger(__name__)
+
+__all__ = [
+    "InferenceServer",
+    "ServingResult",
+    "ServingMetrics",
+    "install_drain_handler",
+]
+
+
+# ------------------------------------------------------------------- requests
+@dataclass
+class _Request:
+    """One admitted generation request (internal; callers hold the Future)."""
+
+    input_ids: np.ndarray  # (prompt_len,) int32
+    max_new_tokens: int
+    deadline: Optional[float]  # absolute, server clock domain
+    temperature: float
+    top_k: Optional[int]
+    top_p: Optional[float]
+    eos_token_id: Optional[int]
+    pad_token_id: Optional[int]
+    seed: int
+    submitted_at: float
+    future: Future = field(default_factory=Future)
+    # token budget after the degradation ladder clamped it (set at dequeue)
+    effective_max_new_tokens: int = 0
+    degraded: bool = False
+
+    def group_key(self) -> tuple:
+        """Requests sharing this key can ride one ``generate()`` batch: the
+        sampling params are batch-uniform traced operands and the shapes
+        (prompt length, token budget) are the compile key. ``seed`` is
+        deliberately excluded — rows of one categorical draw are independent
+        given the batch key, and keying on it would kill batching for
+        sampled traffic."""
+        return (
+            self.input_ids.shape[-1],
+            self.effective_max_new_tokens,
+            self.temperature,
+            self.top_k,
+            self.top_p,
+            self.eos_token_id,
+            self.pad_token_id,
+        )
+
+
+@dataclass
+class ServingResult:
+    """What a completed request's Future resolves to."""
+
+    tokens: np.ndarray  # (prompt_len + new,) int32 — this request's row
+    latency_s: float
+    batch_size: int  # real occupancy (before row padding)
+    degraded: bool  # token budget was clamped by the pressure ladder
+
+
+# -------------------------------------------------------------------- metrics
+class ServingMetrics:
+    """Thread-safe serving counters + latency reservoirs.
+
+    Counters are monotonic; :meth:`snapshot` flattens everything into one
+    ``serving/...`` dict suitable for ``GeneralTracker.log_batch`` — queue
+    depth and breaker state are sampled at snapshot time."""
+
+    _COUNTERS = (
+        "submitted",
+        "completed",
+        "rejected_queue_full",
+        "rejected_breaker",
+        "rejected_draining",
+        "shed_deadline",
+        "completed_late",
+        "retries",
+        "batch_failures",
+        "batches",
+        "breaker_opens",
+        "degraded",
+    )
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts = {name: 0 for name in self._COUNTERS}
+        self.latency = LatencyReservoir()  # seconds, accepted+completed only
+        self.queue_wait = LatencyReservoir()  # seconds spent queued
+        self._gauges: dict[str, float] = {"queue_depth": 0, "breaker_state": 0}
+
+    def bump(self, name: str, by: int = 1) -> None:
+        with self._lock:
+            self._counts[name] += by
+
+    def gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = value
+
+    def __getitem__(self, name: str) -> int:
+        with self._lock:
+            return self._counts[name]
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = {f"serving/{k}": v for k, v in self._counts.items()}
+            out.update({f"serving/{k}": v for k, v in self._gauges.items()})
+        out.update(self.latency.snapshot(prefix="serving/latency_"))
+        out.update(self.queue_wait.snapshot(prefix="serving/queue_wait_"))
+        return out
+
+
+# ------------------------------------------------------------ circuit breaker
+class _CircuitBreaker:
+    """Classic three-state breaker over consecutive batch-attempt failures.
+
+    CLOSED → (``threshold`` consecutive failures) → OPEN → (``reset_s``
+    elapses) → HALF_OPEN (one probe batch) → CLOSED on success, OPEN on
+    failure. State transitions happen on the worker thread; ``submit``
+    only reads."""
+
+    CLOSED, OPEN, HALF_OPEN = 0, 1, 2
+
+    def __init__(self, threshold: int, reset_s: float, clock: Callable[[], float]):
+        self.threshold = threshold
+        self.reset_s = reset_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = self.CLOSED
+        self._failures = 0
+        self._opened_at = 0.0
+        self.opens = 0
+
+    def state(self) -> int:
+        """Current state; an OPEN breaker whose reset window has elapsed
+        reports (and becomes) HALF_OPEN."""
+        with self._lock:
+            if (
+                self._state == self.OPEN
+                and self._clock() - self._opened_at >= self.reset_s
+            ):
+                self._state = self.HALF_OPEN
+            return self._state
+
+    @property
+    def rejects_admission(self) -> bool:
+        return self.state() == self.OPEN
+
+    def seconds_until_probe(self) -> float:
+        with self._lock:
+            if self._state != self.OPEN:
+                return 0.0
+            return max(0.0, self.reset_s - (self._clock() - self._opened_at))
+
+    def record_failure(self) -> bool:
+        """Count one failed batch attempt; returns True when this failure
+        opened (or re-opened) the breaker."""
+        with self._lock:
+            self._failures += 1
+            was_open = self._state == self.OPEN
+            if self._state == self.HALF_OPEN or self._failures >= self.threshold:
+                self._state = self.OPEN
+                self._opened_at = self._clock()
+                if not was_open:
+                    self.opens += 1
+                    return True
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._failures = 0
+            self._state = self.CLOSED
+
+
+# --------------------------------------------------------------------- server
+class InferenceServer:
+    """Turn concurrent ``submit()`` calls into dynamically batched,
+    bucket-padded :func:`~accelerate_tpu.inference.generate` executions.
+
+    One daemon worker thread owns the whole dispatch lifecycle (dequeue →
+    shed → batch → execute → reply), so the device stream stays single-
+    controller even under many submitting threads. Construction starts the
+    worker; use as a context manager (or call :meth:`close`) to drain.
+
+    Parameters
+    ----------
+    model:
+        A prepared :class:`~accelerate_tpu.model.Model` (optionally sharded
+        via :func:`~accelerate_tpu.inference.prepare_inference`).
+    config:
+        :class:`~accelerate_tpu.utils.dataclasses.ServingConfig`.
+    generate_fn:
+        Override the batch executor — signature of
+        :func:`accelerate_tpu.inference.generate`, must return a
+        ``(batch, prompt+new)`` array. Tests inject failures/latency here;
+        ``None`` uses the real compiled path (and its per-model LRU).
+    trackers:
+        ``GeneralTracker`` instances receiving ``metrics.snapshot()``
+        batches every ``config.metrics_interval_s`` (and once at drain).
+    clock:
+        Monotonic time source (injectable for deterministic tests).
+    """
+
+    def __init__(
+        self,
+        model,
+        config: Optional[ServingConfig] = None,
+        *,
+        generate_fn: Optional[Callable[..., Any]] = None,
+        trackers: Sequence = (),
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.model = model
+        self.config = config or ServingConfig()
+        self.trackers = list(trackers)
+        self._clock = clock
+        self._generate_fn = generate_fn or self._default_generate
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._queue: collections.deque[_Request] = collections.deque()
+        self._draining = False
+        self._closed = False
+        self._drained = threading.Event()
+        self.metrics = ServingMetrics()
+        self._breaker = _CircuitBreaker(
+            self.config.breaker_threshold, self.config.breaker_reset_s, clock
+        )
+        self._batch_time_ewma = 0.0
+        self._last_metrics_flush = clock()
+        self._rng = random.Random(0)  # backoff jitter only
+        self._worker = threading.Thread(
+            target=self._serve_loop, name="inference-server", daemon=True
+        )
+        self._worker.start()
+
+    # ------------------------------------------------------------- admission
+    def submit(
+        self,
+        input_ids,
+        *,
+        max_new_tokens: Optional[int] = None,
+        deadline_s: Optional[float] = None,
+        temperature: float = 0.0,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
+        eos_token_id: Optional[int] = None,
+        pad_token_id: Optional[int] = None,
+        seed: int = 0,
+    ) -> Future:
+        """Admit one request; returns a Future resolving to
+        :class:`ServingResult` (or raising the typed serving error that
+        ended it). Raises synchronously — *before* any queue mutation —
+        when admission itself is refused:
+
+        * :class:`ServerDrainingError` — draining/closed (retriable
+          elsewhere);
+        * :class:`CircuitOpenError` — breaker open, fail fast;
+        * :class:`ServerOverloaded` — bounded queue full (backpressure).
+
+        ``deadline_s`` is relative seconds from now (``None`` →
+        ``config.default_deadline_s``).
+        """
+        fault_point("serving_submit")
+        if self._closed or self._draining or preemption_requested():
+            self.metrics.bump("rejected_draining")
+            raise ServerDrainingError(
+                "server is draining — resubmit to another replica"
+            )
+        if self._breaker.rejects_admission:
+            self.metrics.bump("rejected_breaker")
+            raise CircuitOpenError(
+                "circuit breaker open after repeated batch failures; retry "
+                f"in {self._breaker.seconds_until_probe():.2f}s"
+            )
+        ids = np.asarray(input_ids, dtype=np.int32)
+        if ids.ndim == 2:
+            if ids.shape[0] != 1:
+                raise ValueError(
+                    "submit() takes ONE request; for many rows call submit "
+                    f"per row (got shape {ids.shape})"
+                )
+            ids = ids[0]
+        if ids.ndim != 1 or ids.shape[0] == 0:
+            raise ValueError(f"input_ids must be a non-empty 1-D prompt, got {ids.shape}")
+        now = self._clock()
+        if deadline_s is None:
+            deadline_s = self.config.default_deadline_s
+        req = _Request(
+            input_ids=ids,
+            max_new_tokens=max_new_tokens or self.config.default_max_new_tokens,
+            deadline=(now + deadline_s) if deadline_s is not None else None,
+            temperature=temperature,
+            top_k=top_k,
+            top_p=top_p,
+            eos_token_id=eos_token_id,
+            pad_token_id=pad_token_id,
+            seed=seed,
+            submitted_at=now,
+        )
+        with self._wake:
+            if self._draining or self._closed:
+                self.metrics.bump("rejected_draining")
+                raise ServerDrainingError(
+                    "server is draining — resubmit to another replica"
+                )
+            if len(self._queue) >= self.config.max_queue:
+                self.metrics.bump("rejected_queue_full")
+                raise ServerOverloaded(
+                    f"admission queue full ({self.config.max_queue}); apply "
+                    "backpressure and resubmit after backoff"
+                )
+            self._queue.append(req)
+            self.metrics.bump("submitted")
+            self.metrics.gauge("queue_depth", len(self._queue))
+            self._wake.notify()
+        return req.future
+
+    def generate(self, input_ids, *, timeout: Optional[float] = None, **kwargs):
+        """Blocking convenience wrapper: ``submit(...).result().tokens``."""
+        return self.submit(input_ids, **kwargs).result(timeout=timeout).tokens
+
+    # ------------------------------------------------------------- lifecycle
+    @property
+    def draining(self) -> bool:
+        return self._draining or self._closed
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Stop admission, finish the in-flight batch, reject everything
+        still queued with a retriable :class:`ServerDrainingError`. Returns
+        True when the worker exited within ``timeout`` (default
+        ``config.drain_timeout_s``)."""
+        with self._wake:
+            self._draining = True
+            self._wake.notify_all()
+        timeout = self.config.drain_timeout_s if timeout is None else timeout
+        done = self._drained.wait(timeout)
+        if not done:
+            logger.warning(
+                "serving drain did not finish within %.1fs (in-flight batch "
+                "still executing)", timeout,
+            )
+        return done
+
+    def close(self, drain: bool = True, timeout: Optional[float] = None) -> bool:
+        """Drain (unless ``drain=False`` — then queued requests are still
+        rejected, we just don't wait for the in-flight batch) and stop the
+        worker. Idempotent."""
+        done = self.drain(timeout if drain else 0.0)
+        self._closed = True
+        if self.trackers:
+            self._flush_metrics(force=True)
+        return done
+
+    def __enter__(self) -> "InferenceServer":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close()
+
+    # ----------------------------------------------------------- worker loop
+    def _serve_loop(self) -> None:
+        try:
+            while True:
+                with self._wake:
+                    while not self._queue and not self._draining:
+                        if preemption_requested():
+                            self._draining = True
+                            break
+                        self._maybe_flush_metrics_locked()
+                        self._wake.wait(timeout=0.05)
+                    if self._draining or preemption_requested():
+                        self._draining = True
+                        break
+                st = self._breaker.state()
+                if st == _CircuitBreaker.OPEN:
+                    # fail fast is submit()'s job; here just shed requests
+                    # whose deadline will pass before the next probe
+                    self._shed_expired()
+                    time.sleep(min(0.01, max(self._breaker.seconds_until_probe(), 0.001)))
+                    continue
+                batch = self._collect_batch(
+                    probe=(st == _CircuitBreaker.HALF_OPEN)
+                )
+                if batch:
+                    self._execute(batch)
+                self._flush_metrics()
+        except BaseException:  # noqa: BLE001 — a dead worker must not hang clients
+            logger.exception("serving worker died; failing queued requests")
+            raise
+        finally:
+            self._reject_queued()
+            self._drained.set()
+            self._flush_metrics(force=True)
+
+    def _estimated_batch_s(self) -> float:
+        return self._batch_time_ewma
+
+    def _degrade_level(self, depth: int) -> int:
+        frac = depth / self.config.max_queue
+        if frac >= self.config.degrade_hard_fraction:
+            return 2
+        if frac >= self.config.degrade_queue_fraction:
+            return 1
+        return 0
+
+    def _clamp_budget(self, req: _Request, level: int) -> None:
+        budget = req.max_new_tokens
+        if level == 1:
+            budget = min(budget, self.config.degraded_max_new_tokens)
+        elif level == 2:
+            budget = min(budget, max(1, self.config.degraded_max_new_tokens // 2))
+        req.degraded = budget < req.max_new_tokens
+        req.effective_max_new_tokens = budget
+
+    def _shed(self, req: _Request, now: float) -> None:
+        self.metrics.bump("shed_deadline")
+        req.future.set_exception(
+            RequestDeadlineExceeded(
+                f"deadline passed {now - req.deadline:.3f}s ago at dequeue "
+                f"(estimated batch time {self._estimated_batch_s():.3f}s) — "
+                "shed instead of wasting a batch slot"
+            )
+        )
+
+    def _shed_expired(self) -> None:
+        """Drop queued requests that can no longer make their deadline
+        (used while the breaker is open so clients fail fast)."""
+        now = self._clock()
+        with self._lock:
+            keep: collections.deque[_Request] = collections.deque()
+            while self._queue:
+                req = self._queue.popleft()
+                if req.deadline is not None and now + self._estimated_batch_s() > req.deadline:
+                    self._shed(req, now)
+                else:
+                    keep.append(req)
+            self._queue = keep
+            self.metrics.gauge("queue_depth", len(self._queue))
+
+    def _collect_batch(self, probe: bool = False) -> list[_Request]:
+        """Head-of-line dynamic batching: shed expired heads, take the first
+        live request, then coalesce compatible requests for up to the
+        batching window. ``probe`` (half-open breaker) caps the batch at one
+        request — risk the minimum while testing recovery."""
+        cfg = self.config
+        max_size = 1 if probe else cfg.max_batch_size
+        with self._wake:
+            first: Optional[_Request] = None
+            while self._queue:
+                now = self._clock()
+                req = self._queue.popleft()
+                level = self._degrade_level(len(self._queue) + 1)
+                if req.deadline is not None and now + self._estimated_batch_s() > req.deadline:
+                    self._shed(req, now)
+                    continue
+                self._clamp_budget(req, level)
+                first = req
+                break
+            if first is None:
+                self.metrics.gauge("queue_depth", len(self._queue))
+                return []
+            batch = [first]
+            key = first.group_key()
+            window_end = self._clock() + cfg.batch_window_s
+            while len(batch) < max_size and not self._draining:
+                if self._queue:
+                    now = self._clock()
+                    head = self._queue[0]
+                    if head.deadline is not None and now + self._estimated_batch_s() > head.deadline:
+                        self._shed(self._queue.popleft(), now)
+                        continue
+                    self._clamp_budget(head, self._degrade_level(len(self._queue)))
+                    if head.group_key() != key:
+                        break  # incompatible head stays for the next batch
+                    batch.append(self._queue.popleft())
+                    continue
+                remaining = window_end - self._clock()
+                if remaining <= 0:
+                    break
+                self._wake.wait(timeout=remaining)
+            self.metrics.gauge("queue_depth", len(self._queue))
+        self.metrics.bump("degraded", sum(1 for r in batch if r.degraded))
+        return batch
+
+    # -------------------------------------------------------- batch execution
+    def _bucket_rows(self, n: int) -> int:
+        if not self.config.batch_bucket:
+            return n
+        b = 1
+        while b < n:
+            b *= 2
+        return min(b, max(self.config.max_batch_size, n))
+
+    def _default_generate(self, model, ids, **kwargs):
+        from .inference import generate
+
+        return generate(model, ids, **kwargs)
+
+    def _run_batch(self, batch: list[_Request]) -> np.ndarray:
+        cfg = self.config
+        first = batch[0]
+        rows = np.stack([r.input_ids for r in batch])
+        target = self._bucket_rows(len(batch))
+        if target > len(batch):  # pad rows so the LRU sees pow-2 batch shapes
+            pad = np.repeat(rows[:1], target - len(batch), axis=0)
+            rows = np.concatenate([rows, pad], axis=0)
+        total = rows.shape[1] + first.effective_max_new_tokens
+        pad_to = -(-total // cfg.pad_total_multiple) * cfg.pad_total_multiple
+        out = self._generate_fn(
+            self.model,
+            rows,
+            max_new_tokens=first.effective_max_new_tokens,
+            temperature=first.temperature,
+            seed=first.seed,
+            pad_to=pad_to,
+            top_k=first.top_k,
+            top_p=first.top_p,
+            eos_token_id=first.eos_token_id,
+            pad_token_id=first.pad_token_id,
+        )
+        # realize on host here — a transfer error is a batch failure, not a
+        # mystery the client trips over later
+        return np.asarray(out)[: len(batch)]
+
+    def _execute(self, batch: list[_Request]) -> None:
+        cfg = self.config
+        attempt = 0
+        while True:
+            try:
+                fault_point("serving_before_batch")
+                t0 = self._clock()
+                out = self._run_batch(batch)
+                dt = self._clock() - t0
+                fault_point("serving_after_batch")
+            except BaseException as exc:  # noqa: BLE001 — classified below
+                if isinstance(exc, (KeyboardInterrupt, SystemExit)):
+                    raise
+                attempt += 1
+                self.metrics.bump("batch_failures")
+                opened = self._breaker.record_failure()
+                if opened:
+                    self.metrics.bump("breaker_opens")
+                    logger.warning(
+                        "circuit breaker OPEN after %d consecutive batch "
+                        "failures (last: %s)",
+                        cfg.breaker_threshold, exc,
+                    )
+                if attempt > cfg.max_retries or self._draining:
+                    err = BatchExecutionError(
+                        f"batch failed permanently after {attempt} attempt(s): "
+                        f"{type(exc).__name__}: {exc}"
+                    )
+                    err.__cause__ = exc
+                    for req in batch:
+                        if not req.future.done():
+                            req.future.set_exception(err)
+                    return
+                self.metrics.bump("retries")
+                backoff = min(
+                    cfg.retry_backoff_s * (2 ** (attempt - 1)),
+                    cfg.retry_backoff_max_s,
+                )
+                backoff *= 1.0 + cfg.retry_jitter * self._rng.random()
+                logger.warning(
+                    "batch attempt %d/%d failed (%s: %s); retrying in %.3fs",
+                    attempt, cfg.max_retries + 1, type(exc).__name__, exc, backoff,
+                )
+                # interruptible sleep: a drain request must not wait out the
+                # whole backoff ladder
+                with self._wake:
+                    self._wake.wait(timeout=backoff)
+                continue
+            break
+        # success epilogue
+        self._breaker.record_success()
+        self.metrics.bump("batches")
+        self._batch_time_ewma = (
+            dt if self._batch_time_ewma == 0.0
+            else 0.8 * self._batch_time_ewma + 0.2 * dt
+        )
+        fault_point("serving_before_reply")
+        now = self._clock()
+        for i, req in enumerate(batch):
+            if req.future.done():  # already shed/cancelled — never double-reply
+                continue
+            if req.deadline is not None and now > req.deadline:
+                self.metrics.bump("completed_late")
+                req.future.set_exception(
+                    RequestDeadlineExceeded(
+                        f"batch completed {now - req.deadline:.3f}s past the "
+                        "deadline"
+                    )
+                )
+                continue
+            self.metrics.bump("completed")
+            latency = now - req.submitted_at
+            self.metrics.latency.add(latency)
+            self.metrics.queue_wait.add(max(0.0, latency - dt))
+            req.future.set_result(
+                ServingResult(
+                    tokens=out[i],
+                    latency_s=latency,
+                    batch_size=len(batch),
+                    degraded=req.degraded,
+                )
+            )
+
+    def _reject_queued(self) -> None:
+        with self._lock:
+            pending = list(self._queue)
+            self._queue.clear()
+            self.metrics.gauge("queue_depth", 0)
+        for req in pending:
+            if not req.future.done():
+                self.metrics.bump("rejected_draining")
+                req.future.set_exception(
+                    ServerDrainingError(
+                        "server drained before this request was batched — "
+                        "resubmit to another replica"
+                    )
+                )
+
+    # --------------------------------------------------------------- metrics
+    def _maybe_flush_metrics_locked(self) -> None:
+        # called with self._lock held (idle wait) — snapshot outside is fine,
+        # the counters have their own locks
+        if self.config.metrics_interval_s is None or not self.trackers:
+            return
+        if self._clock() - self._last_metrics_flush >= self.config.metrics_interval_s:
+            self._last_metrics_flush = self._clock()
+            self._emit_snapshot()
+
+    def _flush_metrics(self, force: bool = False) -> None:
+        if not self.trackers:
+            return
+        interval = self.config.metrics_interval_s
+        if force or (
+            interval is not None
+            and self._clock() - self._last_metrics_flush >= interval
+        ):
+            self._last_metrics_flush = self._clock()
+            self._emit_snapshot()
+
+    def _emit_snapshot(self) -> None:
+        self.metrics.gauge("breaker_state", self._breaker.state())
+        entries = [(self.metrics.snapshot(), None, {})]
+        for tracker in self.trackers:
+            try:
+                tracker.log_batch(entries)
+            except Exception as exc:  # noqa: BLE001 — metrics never kill serving
+                logger.warning(
+                    "serving metrics flush failed: %s: %s", type(exc).__name__, exc
+                )
+
+    def log_metrics(self, step: Optional[int] = None, trackers: Optional[Sequence] = None):
+        """Push one metrics snapshot through ``GeneralTracker.log_batch``
+        (explicit sibling of the periodic ``metrics_interval_s`` flow).
+        Returns the snapshot dict."""
+        self.metrics.gauge("breaker_state", self._breaker.state())
+        snapshot = self.metrics.snapshot()
+        for tracker in trackers if trackers is not None else self.trackers:
+            tracker.log_batch([(snapshot, step, {})])
+        return snapshot
+
+
+# ----------------------------------------------------------------- drain hook
+def install_drain_handler(
+    server: InferenceServer,
+    signals: tuple = (signal.SIGTERM, signal.SIGINT),
+    exit_code: int = PREEMPTION_EXIT_CODE,
+) -> bool:
+    """SIGTERM → graceful drain → ``sys.exit(143)`` — the serving twin of
+    :func:`~accelerate_tpu.utils.fault.install_preemption_handler` (which
+    handles the *training* side: emergency checkpoint). Admission stops,
+    the in-flight batch finishes and replies, queued requests get a
+    retriable :class:`~accelerate_tpu.utils.fault.ServerDrainingError`.
+
+    Only installable from the main thread (Python restriction); returns
+    False elsewhere. A second signal during the drain is absorbed."""
+    if threading.current_thread() is not threading.main_thread():
+        return False
+    state = {"draining": False}
+
+    def _handler(signum, frame):
+        if state["draining"]:
+            return
+        state["draining"] = True
+        logger.warning(
+            "received signal %d — draining inference server before exit", signum
+        )
+        try:
+            from .utils.fault import _record_preemption
+
+            _record_preemption(signum)
+            server.close(drain=True)
+        finally:
+            sys.exit(exit_code)
+
+    for sig in signals:
+        signal.signal(sig, _handler)
+    return True

@@ -84,6 +84,44 @@ def _maybe_init_jax_distributed() -> None:
         )
 
 
+def _run_with_barrier_timeout(sync_fn: Callable[[], Any], tag: str, timeout: Optional[float]) -> None:
+    """Run a blocking barrier with an optional upper bound.
+
+    The underlying collective blocks in native code and cannot be
+    cancelled; on timeout the barrier thread is abandoned (daemonized) and
+    a typed :class:`~accelerate_tpu.utils.fault.BarrierTimeoutError` is
+    raised — the caller is expected to exit, which is exactly what the
+    launch supervisor wants: a precise failure naming the barrier site
+    instead of a stale-heartbeat kill minutes later. ``timeout`` of
+    ``None``/``0`` runs the barrier inline with original semantics."""
+    if not timeout or timeout <= 0:
+        sync_fn()
+        return
+    done = threading.Event()
+    errors: list[BaseException] = []
+
+    def _run():
+        try:
+            sync_fn()
+        except BaseException as e:  # noqa: BLE001 — reraised on caller thread
+            errors.append(e)
+        finally:
+            done.set()
+
+    t = threading.Thread(target=_run, name=f"barrier:{tag}", daemon=True)
+    t.start()
+    if not done.wait(timeout):
+        from .utils.fault import BarrierTimeoutError
+
+        raise BarrierTimeoutError(
+            f"barrier {tag!r} did not complete within {timeout:g}s — a peer "
+            "process is likely dead or wedged (set ACCELERATE_BARRIER_TIMEOUT"
+            "=0 to restore unbounded waits)"
+        )
+    if errors:
+        raise errors[0]
+
+
 class PartialState:
     """Borg singleton exposing process/device/rank info and process-control
     helpers (reference state.py:123-867)."""
@@ -180,15 +218,31 @@ class PartialState:
         and placement is the mesh's job."""
 
     # --------------------------------------------------------- process control
-    def wait_for_everyone(self) -> None:
+    def wait_for_everyone(
+        self,
+        tag: str = "accelerate_tpu.wait_for_everyone",
+        timeout: Optional[float] = None,
+    ) -> None:
         """Cross-process barrier (reference state.py:377-414; the xla branch
         uses ``xm.rendezvous``). Implemented as a named sync over all global
-        devices; a no-op single-process."""
+        devices; a no-op single-process.
+
+        A dead peer host makes this hang forever. ``timeout`` (seconds; or
+        the ``ACCELERATE_BARRIER_TIMEOUT`` env var — unset/0 preserves the
+        blocking semantics) bounds the wait and raises a typed
+        :class:`~accelerate_tpu.utils.fault.BarrierTimeoutError` naming the
+        barrier site ``tag``, so the launch supervisor gets a precise error
+        instead of a stale-heartbeat kill."""
         if self.num_processes <= 1:
             return
         from jax.experimental import multihost_utils
 
-        multihost_utils.sync_global_devices("accelerate_tpu.wait_for_everyone")
+        if timeout is None:
+            raw = os.environ.get("ACCELERATE_BARRIER_TIMEOUT", "")
+            timeout = float(raw) if raw else None
+        _run_with_barrier_timeout(
+            lambda: multihost_utils.sync_global_devices(tag), tag, timeout
+        )
 
     @contextmanager
     def split_between_processes(self, inputs, apply_padding: bool = False):

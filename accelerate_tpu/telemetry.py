@@ -48,6 +48,7 @@ __all__ = [
     "StepHealth",
     "DeferredReadbackRing",
     "AsyncTrackerFlusher",
+    "LatencyReservoir",
 ]
 
 # sentinel for "no grad norm in this summary" — real norms are >= 0, and a
@@ -162,6 +163,55 @@ class DeferredReadbackRing:
 
     def __len__(self) -> int:
         return len(self._entries)
+
+
+class LatencyReservoir:
+    """Bounded sliding-window percentile estimator for request latencies
+    (and any other per-event scalar): keeps the last ``size`` samples in a
+    ring, computes p50/p99 over the window on demand. Thread-safe — the
+    serving worker records while metric snapshots read. Memory is O(size)
+    no matter how many requests flow through."""
+
+    def __init__(self, size: int = 2048):
+        if size < 1:
+            raise ValueError(f"reservoir size must be >= 1, got {size}")
+        self._samples: collections.deque = collections.deque(maxlen=size)
+        self._lock = threading.Lock()
+        self._count = 0
+
+    def add(self, value: float) -> None:
+        with self._lock:
+            self._samples.append(float(value))
+            self._count += 1
+
+    @property
+    def count(self) -> int:
+        """Total samples ever recorded (not just the retained window)."""
+        return self._count
+
+    def percentile(self, p: float) -> Optional[float]:
+        with self._lock:
+            if not self._samples:
+                return None
+            data = sorted(self._samples)
+        idx = min(len(data) - 1, max(0, int(round(p / 100.0 * (len(data) - 1)))))
+        return data[idx]
+
+    def snapshot(self, prefix: str = "") -> dict:
+        """p50/p99/max over the window + lifetime count, flat dict keyed
+        ``<prefix>p50`` etc. — ready for ``GeneralTracker.log_batch``."""
+        with self._lock:
+            data = sorted(self._samples)
+            count = self._count
+        if not data:
+            return {f"{prefix}count": count}
+        pick = lambda p: data[min(len(data) - 1, int(round(p / 100.0 * (len(data) - 1))))]
+        return {
+            f"{prefix}count": count,
+            f"{prefix}p50": pick(50),
+            f"{prefix}p99": pick(99),
+            f"{prefix}max": data[-1],
+        }
 
 
 def materialize_metrics(values: dict) -> dict:

@@ -279,6 +279,123 @@ class TrainingHealthConfig(KwargsHandler):
 
 
 @dataclass
+class ServingConfig(KwargsHandler):
+    """Policy knobs for :class:`accelerate_tpu.serving.InferenceServer`
+    (docs/serving.md). Robustness-first defaults: bounded everything.
+
+    Admission / batching:
+
+    * ``max_queue`` — bounded admission queue; a full queue rejects with
+      :class:`~accelerate_tpu.utils.fault.ServerOverloaded` (backpressure,
+      never unbounded memory).
+    * ``max_batch_size`` / ``batch_window_s`` — dynamic batching: the worker
+      takes the head request and coalesces compatible requests (same prompt
+      length / token budget / sampling shape) for up to ``batch_window_s``.
+    * ``batch_bucket`` — round the executed batch up to the next power of
+      two (rows padded) so the compiled-program LRU sees O(log
+      max_batch_size) batch shapes, not one per occupancy.
+    * ``pad_total_multiple`` — bucket ``prompt+new`` total length up to this
+      multiple (the ``pad_to`` knob of :func:`~accelerate_tpu.inference
+      .generate`), bounding per-length recompiles.
+
+    Deadlines: ``default_deadline_s`` applies when ``submit`` passes none
+    (``None`` = no deadline). Enforced at dequeue (a request that cannot
+    finish in time is shed instead of wasting a batch slot) and again at
+    completion.
+
+    Retry / circuit breaker: failed batches retry up to ``max_retries``
+    with exponential backoff (``retry_backoff_s`` base, doubled per
+    attempt, capped at ``retry_backoff_max_s``, ±``retry_jitter``
+    fractional jitter). ``breaker_threshold`` consecutive failed attempts
+    open the breaker: submissions fail fast with
+    :class:`~accelerate_tpu.utils.fault.CircuitOpenError` until
+    ``breaker_reset_s`` passes, then ONE half-open probe batch decides
+    between closing and re-opening.
+
+    Degradation ladder (before shedding): above ``degrade_queue_fraction``
+    queue occupancy, per-request token budgets are clamped to
+    ``degraded_max_new_tokens``; above ``degrade_hard_fraction`` they are
+    clamped to half that. Cheaper batches drain the queue faster than
+    rejecting ever could.
+
+    Drain: ``drain_timeout_s`` bounds how long ``close(drain=True)`` (and
+    the SIGTERM handler) waits for in-flight batches.
+
+    ``metrics_interval_s`` — when set (and trackers are attached), the
+    worker pushes a metrics snapshot through ``GeneralTracker.log_batch``
+    at this cadence.
+    """
+
+    max_queue: int = 256
+    max_batch_size: int = 8
+    batch_window_s: float = 0.002
+    batch_bucket: bool = True
+    pad_total_multiple: int = 64
+    default_max_new_tokens: int = 32
+    default_deadline_s: Optional[float] = None
+    max_retries: int = 2
+    retry_backoff_s: float = 0.05
+    retry_backoff_max_s: float = 2.0
+    retry_jitter: float = 0.25
+    breaker_threshold: int = 5
+    breaker_reset_s: float = 5.0
+    degrade_queue_fraction: float = 0.5
+    degrade_hard_fraction: float = 0.8
+    degraded_max_new_tokens: int = 16
+    drain_timeout_s: float = 30.0
+    metrics_interval_s: Optional[float] = None
+
+    def __post_init__(self):
+        if self.max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {self.max_queue}")
+        if self.max_batch_size < 1:
+            raise ValueError(
+                f"max_batch_size must be >= 1, got {self.max_batch_size}"
+            )
+        if self.batch_window_s < 0 or self.batch_window_s > 10:
+            raise ValueError(
+                f"batch_window_s must be in [0, 10], got {self.batch_window_s}"
+            )
+        if self.pad_total_multiple < 1:
+            raise ValueError(
+                f"pad_total_multiple must be >= 1, got {self.pad_total_multiple}"
+            )
+        if self.max_retries < 0:
+            raise ValueError(f"max_retries must be >= 0, got {self.max_retries}")
+        if self.retry_backoff_s < 0 or self.retry_backoff_max_s < self.retry_backoff_s:
+            raise ValueError(
+                "retry backoff must satisfy 0 <= retry_backoff_s <= "
+                f"retry_backoff_max_s, got {self.retry_backoff_s}/"
+                f"{self.retry_backoff_max_s}"
+            )
+        if not 0 <= self.retry_jitter <= 1:
+            raise ValueError(f"retry_jitter must be in [0, 1], got {self.retry_jitter}")
+        if self.breaker_threshold < 1:
+            raise ValueError(
+                f"breaker_threshold must be >= 1, got {self.breaker_threshold}"
+            )
+        if self.breaker_reset_s <= 0:
+            raise ValueError(
+                f"breaker_reset_s must be > 0, got {self.breaker_reset_s}"
+            )
+        if not 0 < self.degrade_queue_fraction <= 1:
+            raise ValueError(
+                "degrade_queue_fraction must be in (0, 1], got "
+                f"{self.degrade_queue_fraction}"
+            )
+        if not self.degrade_queue_fraction <= self.degrade_hard_fraction <= 1:
+            raise ValueError(
+                "degrade_hard_fraction must be in [degrade_queue_fraction, 1], "
+                f"got {self.degrade_hard_fraction}"
+            )
+        if self.degraded_max_new_tokens < 1:
+            raise ValueError(
+                "degraded_max_new_tokens must be >= 1, got "
+                f"{self.degraded_max_new_tokens}"
+            )
+
+
+@dataclass
 class FSDPPlugin(KwargsHandler):
     """FSDP strategy knobs mapped to GSPMD equivalents
     (reference FullyShardedDataParallelPlugin, utils/dataclasses.py:1586-2191).

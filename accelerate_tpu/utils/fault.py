@@ -35,6 +35,13 @@ __all__ = [
     "CheckpointCorruptError",
     "CheckpointComponentMissingError",
     "TrainingHealthError",
+    "BarrierTimeoutError",
+    "ServingError",
+    "ServerOverloaded",
+    "RequestDeadlineExceeded",
+    "CircuitOpenError",
+    "ServerDrainingError",
+    "BatchExecutionError",
     "FaultInjected",
     "fault_point",
     "install_preemption_handler",
@@ -82,6 +89,62 @@ class TrainingHealthError(RuntimeError):
     policy is exhausted (or is ``"raise"``)."""
 
 
+class BarrierTimeoutError(RuntimeError):
+    """``PartialState.wait_for_everyone`` (with ``ACCELERATE_BARRIER_TIMEOUT``
+    set) gave up waiting on a cross-host barrier — a peer host is dead or
+    wedged. Carries the barrier site name so the launch supervisor's logs
+    point at the exact rendezvous instead of a stale-heartbeat kill."""
+
+
+# ----------------------------------------------------- serving error taxonomy
+class ServingError(RuntimeError):
+    """Base class for :class:`accelerate_tpu.serving.InferenceServer`
+    failures. ``retriable`` tells a client whether backing off and
+    resubmitting can succeed (load/lifecycle conditions) or the request
+    itself is a lost cause (deadline passed, batch permanently failed)."""
+
+    retriable: bool = False
+
+
+class ServerOverloaded(ServingError):
+    """The bounded admission queue is full — backpressure, not an outage.
+    Resubmit after backoff."""
+
+    retriable = True
+
+
+class RequestDeadlineExceeded(ServingError):
+    """The request's deadline passed — either shed at dequeue (it could not
+    finish in time, so it never wasted a batch slot) or its batch completed
+    too late. The work is stale; do not retry with the same deadline."""
+
+    retriable = False
+
+
+class CircuitOpenError(ServingError):
+    """The server's circuit breaker is open after consecutive batch
+    failures: failing fast instead of queueing work onto a broken backend.
+    Resubmit after the breaker's reset window."""
+
+    retriable = True
+
+
+class ServerDrainingError(ServingError):
+    """The server is draining (SIGTERM / ``close()``): admission is stopped
+    and queued-but-unbatched requests are rejected. Resubmit to another
+    replica."""
+
+    retriable = True
+
+
+class BatchExecutionError(ServingError):
+    """The batch this request rode in failed permanently (retry budget
+    exhausted, or a non-transient error). ``__cause__`` carries the last
+    underlying exception."""
+
+    retriable = False
+
+
 class FaultInjected(RuntimeError):
     """Raised by :func:`fault_point` for ``point:raise`` injection specs."""
 
@@ -100,8 +163,10 @@ def fault_point(name: str) -> None:
 
     Checkpointing calls this at the named moments of the save lifecycle
     (``after_model_save``, ``after_optimizer_save``, ``before_commit``,
-    ``before_rename``, ``before_gc``). The env var is read at call time so a
-    test script can arm a point between two saves.
+    ``before_rename``, ``before_gc``); the serving loop at the named moments
+    of a batch's lifecycle (``serving_submit``, ``serving_before_batch``,
+    ``serving_after_batch``, ``serving_before_reply``). The env var is read
+    at call time so a test script can arm a point between two saves.
     """
     spec = os.environ.get(FAULT_INJECT_ENV)
     if not spec:

@@ -617,6 +617,13 @@ if __name__ == "__main__":
         from benchmarks.telemetry_bench import main as telemetry_main
 
         sys.exit(telemetry_main(gate=True))
+    if "--serving-gate" in sys.argv:
+        # resilience gate: load ramp at 1x/2x/4x capacity + fault/recovery +
+        # SIGTERM drain (docs/serving.md acceptance criteria)
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from benchmarks.serving_bench import main as serving_main
+
+        sys.exit(serving_main(gate=True))
     if "--child" in sys.argv:
         # the actual measurement; parent enforces the wall-clock watchdog
         try:

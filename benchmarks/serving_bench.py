@@ -1,0 +1,299 @@
+"""Serving resilience bench: load ramp at 1x/2x/4x capacity + recovery.
+
+Drives an :class:`~accelerate_tpu.serving.InferenceServer` with a synthetic
+constant-service-time engine (capacity = max_batch / service_s, so the
+overload multiples are exact) through five phases:
+
+- ``baseline``  — offered load at 1x capacity
+- ``over_2x``   — 2x capacity: queue fills, deadline shedding engages
+- ``over_4x``   — 4x capacity: bounded queue + typed rejections under stress
+- ``fault``     — every batch fails: retries exhaust, the breaker opens
+- ``recovery``  — faults cleared: breaker closes, throughput must return to
+  >= ``SB_GATE_RECOVERY`` (default 95%) of baseline
+
+plus a SIGTERM probe (``--sigterm-child`` sub-mode): the bench re-spawns
+itself under load, sends SIGTERM mid-batch, and asserts exit code 143 with
+every in-flight future resolved (result or typed rejection — none dropped).
+
+Prints one JSON line per phase plus a gate line. ``--gate`` (also reached
+via ``bench.py --serving-gate`` / ``make bench-serving``) turns the
+acceptance criteria into a nonzero exit: bounded queue, only typed shed
+errors, accepted p99 within deadline, recovery throughput, SIGTERM drain.
+"""
+
+from __future__ import annotations
+
+import os
+import sys as _sys
+
+_sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))  # runnable as `python benchmarks/x.py`
+
+import json
+import signal
+import subprocess
+import time
+
+import numpy as np
+
+SERVICE_S = float(os.environ.get("SB_SERVICE_S", "0.04"))
+MAX_BATCH = int(os.environ.get("SB_MAX_BATCH", "8"))
+PHASE_S = float(os.environ.get("SB_PHASE_S", "1.5"))
+DEADLINE_S = float(os.environ.get("SB_DEADLINE_S", "0.25"))
+GATE_RECOVERY = float(os.environ.get("SB_GATE_RECOVERY", "0.95"))
+PROMPT = np.arange(1, 9, dtype=np.int32)
+
+
+class _SyntheticEngine:
+    """generate_fn with a fixed per-batch service time — capacity is exactly
+    ``max_batch / service_s`` rps, so the ramp multiples mean what they say.
+    ``fail=True`` turns every batch into an immediate device fault."""
+
+    def __init__(self, service_s: float):
+        self.service_s = service_s
+        self.fail = False
+        self.batches = 0
+
+    def __call__(self, model, ids, max_new_tokens=4, **kw):
+        if self.fail:
+            raise RuntimeError("injected device fault")
+        time.sleep(self.service_s)
+        self.batches += 1
+        new = np.repeat(ids[:, :1], max_new_tokens, axis=1)
+        return np.concatenate([ids, new], axis=1)
+
+
+def _p(latencies, q):
+    if not latencies:
+        return None
+    s = sorted(latencies)
+    return s[min(len(s) - 1, int(q * len(s)))]
+
+
+def _run_phase(srv, name, rate_rps, duration_s):
+    from accelerate_tpu.utils.fault import (
+        RequestDeadlineExceeded,
+        ServingError,
+    )
+
+    futures = []
+    admission = {"queue_full": 0, "breaker": 0, "draining": 0}
+    untyped = 0
+    max_depth = 0
+    start = time.perf_counter()
+    i = 0
+    while True:
+        now = time.perf_counter()
+        if now - start >= duration_s:
+            break
+        next_t = start + i / rate_rps
+        if next_t > now:
+            time.sleep(min(next_t - now, 0.01))
+            continue
+        i += 1
+        try:
+            futures.append(
+                srv.submit(PROMPT, max_new_tokens=4, deadline_s=DEADLINE_S)
+            )
+        except ServingError as exc:
+            kind = type(exc).__name__
+            key = {
+                "ServerOverloaded": "queue_full",
+                "CircuitOpenError": "breaker",
+                "ServerDrainingError": "draining",
+            }.get(kind)
+            if key is None or not hasattr(exc, "retriable"):
+                untyped += 1
+            else:
+                admission[key] += 1
+        except Exception:  # noqa: BLE001 — gate counts anything untyped
+            untyped += 1
+        max_depth = max(max_depth, srv.queue_depth())
+
+    latencies, completed, shed, failed = [], 0, 0, 0
+    for f in futures:
+        try:
+            res = f.result(timeout=30)
+            completed += 1
+            latencies.append(res.latency_s)
+        except RequestDeadlineExceeded:
+            shed += 1
+        except ServingError:
+            failed += 1
+        except Exception:  # noqa: BLE001
+            untyped += 1
+    elapsed = time.perf_counter() - start
+    offered = i + sum(admission.values())
+    row = {
+        "phase": name,
+        "offered_rps": round(offered / elapsed, 1),
+        "completed_rps": round(completed / elapsed, 1),
+        "shed_rate": round(
+            (shed + failed + sum(admission.values())) / max(offered, 1), 3
+        ),
+        "p50_s": round(_p(latencies, 0.50), 4) if latencies else None,
+        "p99_s": round(_p(latencies, 0.99), 4) if latencies else None,
+        "deadline_s": DEADLINE_S,
+        "rejected": admission,
+        "batch_failed": failed,
+        "max_queue_depth": max_depth,
+        "untyped_errors": untyped,
+    }
+    print(json.dumps(row), flush=True)
+    return row
+
+
+def _sigterm_child() -> int:
+    import atexit
+
+    from accelerate_tpu.serving import InferenceServer, install_drain_handler
+    from accelerate_tpu.utils.dataclasses import ServingConfig
+
+    eng = _SyntheticEngine(0.05)
+    cfg = ServingConfig(max_batch_size=2, batch_window_s=0.0, max_queue=64)
+    srv = InferenceServer(object(), cfg, generate_fn=eng)
+    install_drain_handler(srv)
+    futs = [srv.submit(PROMPT, max_new_tokens=4) for _ in range(6)]
+
+    def _report():
+        done = sum(1 for f in futs if f.done())
+        ok = sum(1 for f in futs if f.done() and f.exception() is None)
+        print(
+            json.dumps(
+                {"result": "sigterm_child", "submitted": len(futs),
+                 "done": done, "ok": ok}
+            ),
+            flush=True,
+        )
+
+    atexit.register(_report)
+    print("READY", flush=True)
+    while True:  # the drain handler sys.exit(143)s out of this
+        time.sleep(0.1)
+
+
+def _sigterm_probe() -> dict:
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # child must never dial the relay
+    env["JAX_PLATFORMS"] = "cpu"
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [_sys.executable, os.path.abspath(__file__), "--sigterm-child"],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        env=env,
+    )
+    try:
+        deadline = time.monotonic() + 60
+        ready = False
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            if line.strip() == "READY":
+                ready = True
+                break
+        if not ready:
+            proc.kill()
+            return {"phase": "sigterm", "pass": False, "error": "child never READY"}
+        time.sleep(0.05)  # land the signal mid-batch
+        proc.send_signal(signal.SIGTERM)
+        out, err = proc.communicate(timeout=60)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        return {"phase": "sigterm", "pass": False, "error": "child hung in drain"}
+    report = None
+    for line in out.splitlines():
+        try:
+            parsed = json.loads(line)
+        except ValueError:
+            continue
+        if parsed.get("result") == "sigterm_child":
+            report = parsed
+    row = {
+        "phase": "sigterm",
+        "returncode": proc.returncode,
+        "report": report,
+        "pass": (
+            proc.returncode == 143
+            and report is not None
+            and report["done"] == report["submitted"]  # zero dropped in-flight
+            and report["ok"] >= 1
+        ),
+    }
+    if not row["pass"]:
+        row["stderr_tail"] = err[-500:]
+    print(json.dumps(row), flush=True)
+    return row
+
+
+def main(gate: bool = False) -> int:
+    from accelerate_tpu.serving import InferenceServer
+    from accelerate_tpu.utils.dataclasses import ServingConfig
+
+    eng = _SyntheticEngine(SERVICE_S)
+    cfg = ServingConfig(
+        max_queue=256,
+        max_batch_size=MAX_BATCH,
+        batch_window_s=0.001,
+        default_max_new_tokens=4,
+        max_retries=2,
+        retry_backoff_s=0.02,
+        retry_backoff_max_s=0.1,
+        breaker_threshold=5,
+        breaker_reset_s=0.3,
+        drain_timeout_s=10.0,
+    )
+    capacity = MAX_BATCH / SERVICE_S
+    rows = {}
+    with InferenceServer(object(), cfg, generate_fn=eng) as srv:
+        rows["baseline"] = _run_phase(srv, "baseline", capacity, PHASE_S)
+        rows["over_2x"] = _run_phase(srv, "over_2x", 2 * capacity, PHASE_S)
+        rows["over_4x"] = _run_phase(srv, "over_4x", 4 * capacity, PHASE_S)
+        eng.fail = True
+        rows["fault"] = _run_phase(srv, "fault", 0.5 * capacity, 0.4)
+        eng.fail = False
+        time.sleep(cfg.breaker_reset_s + 0.2)  # let the breaker reach HALF_OPEN
+        rows["recovery"] = _run_phase(srv, "recovery", capacity, PHASE_S)
+        breaker_open_at_end = srv._breaker.rejects_admission  # noqa: SLF001
+        breaker_opened = srv.metrics["breaker_opens"] >= 1
+    rows["sigterm"] = _sigterm_probe()
+
+    recovery_ratio = rows["recovery"]["completed_rps"] / max(
+        rows["baseline"]["completed_rps"], 1e-9
+    )
+    checks = {
+        "typed_errors_only": all(r.get("untyped_errors", 0) == 0 for r in rows.values()),
+        "queue_bounded": all(
+            r.get("max_queue_depth", 0) <= cfg.max_queue for r in rows.values()
+        ),
+        "alive_at_4x": rows["over_4x"]["completed_rps"] > 0,
+        "accepted_p99_within_deadline": all(
+            rows[p]["p99_s"] is None or rows[p]["p99_s"] <= DEADLINE_S
+            for p in ("baseline", "over_2x", "over_4x", "recovery")
+        ),
+        "breaker_opened_under_faults": breaker_opened,
+        "breaker_closed_after_recovery": not breaker_open_at_end,
+        "recovery_throughput": recovery_ratio >= GATE_RECOVERY,
+        "sigterm_drain": rows["sigterm"]["pass"],
+    }
+    ok = all(checks.values())
+    print(
+        json.dumps(
+            {
+                "metric": "serving_resilience_gate",
+                "capacity_rps": round(capacity, 1),
+                "recovery_vs_baseline": round(recovery_ratio, 3),
+                "threshold": GATE_RECOVERY,
+                "checks": checks,
+                "pass": ok,
+            }
+        ),
+        flush=True,
+    )
+    return 0 if (ok or not gate) else 1
+
+
+if __name__ == "__main__":
+    if "--sigterm-child" in _sys.argv:
+        raise SystemExit(_sigterm_child())
+    raise SystemExit(main(gate="--gate" in _sys.argv))

@@ -294,3 +294,69 @@ def test_exact_midepoch_resume_stateful_dataset():
     fresh.load_state_dict(state)
     resumed = [float(np.asarray(b["x"]).ravel()[0]) for b in fresh]
     assert resumed == [3.0, 4.0, 5.0]
+
+
+# ------------------------------------------------------------ prefetch leaks
+def test_prefetcher_close_unblocks_and_joins_worker():
+    """A consumer-less prefetcher's worker blocks in q.put holding staged
+    batches; close() must signal it, drain the queue, and join — no leaked
+    daemon thread pinning HBM."""
+    staged = []
+
+    def put_fn(x):
+        staged.append(x)
+        return x
+
+    pf = dl._DevicePrefetcher(iter(range(100)), put_fn, depth=2)
+    # worker fills the depth-2 queue then blocks in put on item 3
+    deadline = 50
+    while len(staged) < 3 and deadline:
+        deadline -= 1
+        import time as _t
+        _t.sleep(0.01)
+    assert pf.thread.is_alive()
+    assert pf.close(timeout=5)
+    assert not pf.thread.is_alive()
+    assert pf.closed
+    assert pf.q.empty()  # nothing staged stays pinned behind the queue
+    assert pf.close(timeout=1)  # idempotent
+
+
+def test_prefetcher_close_after_exhaustion_is_clean():
+    pf = dl._DevicePrefetcher(iter([1, 2]), lambda x: x, depth=2)
+    assert list(pf) == [1, 2]
+    assert pf.close(timeout=5)
+
+
+def test_loader_abandoned_iteration_closes_prefetcher():
+    """break-ing out of a prefetching loader must reap the worker thread
+    (GeneratorExit path), and re-iteration must reap the previous epoch's."""
+    mesh = make_mesh(dp_shard_size=8)
+    data = {"x": np.arange(400.0)[:, None]}
+    loader = dl.prepare_data_loader(data, mesh=mesh, batch_size=8, drop_last=True)
+    assert getattr(loader, "device_prefetch", True)
+
+    it = iter(loader)
+    next(it)
+    pf1 = loader._active_prefetcher
+    assert pf1 is not None and pf1.thread.is_alive()
+    it.close()  # the consumer abandons iteration (break/exception)
+    assert pf1.closed
+    assert loader._active_prefetcher is None
+
+    # re-iteration with a still-referenced half-consumed iterator: the NEW
+    # prefetcher must survive the stale generator's eventual finalization
+    it2 = iter(loader)
+    next(it2)
+    pf2 = loader._active_prefetcher
+    it3 = iter(loader)
+    next(it3)
+    pf3 = loader._active_prefetcher
+    assert pf3 is not pf2
+    it2.close()  # stale generator closes ITS prefetcher, not the active one
+    assert pf2.closed
+    assert loader._active_prefetcher is pf3
+    assert not pf3.closed
+    remaining = sum(1 for _ in it3)
+    assert remaining == 49
+    assert pf3.closed  # normal exhaustion also reaps

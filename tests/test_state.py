@@ -61,3 +61,40 @@ def test_accelerator_state_builds_mesh():
     mesh = state.get_device_mesh()
     assert mesh.devices.size == 8
     assert "dp_shard" in mesh.axis_names
+
+
+# ---------------------------------------------------------- barrier timeout
+def test_barrier_timeout_raises_typed_error():
+    import time
+
+    from accelerate_tpu.state import _run_with_barrier_timeout
+    from accelerate_tpu.utils.fault import BarrierTimeoutError
+
+    with pytest.raises(BarrierTimeoutError) as exc_info:
+        _run_with_barrier_timeout(
+            lambda: time.sleep(5), "unit.test_barrier", timeout=0.05
+        )
+    assert "unit.test_barrier" in str(exc_info.value)  # names the site
+
+
+def test_barrier_timeout_fast_path_and_error_propagation():
+    from accelerate_tpu.state import _run_with_barrier_timeout
+
+    calls = []
+    _run_with_barrier_timeout(lambda: calls.append(1), "t", timeout=5.0)
+    assert calls == [1]
+    # timeout unset/0 runs inline with original semantics
+    _run_with_barrier_timeout(lambda: calls.append(2), "t", timeout=None)
+    _run_with_barrier_timeout(lambda: calls.append(3), "t", timeout=0)
+    assert calls == [1, 2, 3]
+    # a barrier that itself fails re-raises the real error, not a timeout
+    def boom():
+        raise RuntimeError("distributed runtime error")
+
+    with pytest.raises(RuntimeError, match="distributed runtime"):
+        _run_with_barrier_timeout(boom, "t", timeout=5.0)
+
+
+def test_wait_for_everyone_single_process_ignores_timeout_env(monkeypatch):
+    monkeypatch.setenv("ACCELERATE_BARRIER_TIMEOUT", "0.01")
+    PartialState().wait_for_everyone()  # no-op, no thread, no raise
