@@ -47,7 +47,7 @@ import signal
 import sys
 import threading
 import time
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional, Sequence
 
@@ -100,10 +100,12 @@ class _Request:
     def group_key(self) -> tuple:
         """Requests sharing this key can ride one ``generate()`` batch: the
         sampling params are batch-uniform traced operands and the shapes
-        (prompt length, token budget) are the compile key. ``seed`` is
-        deliberately excluded — rows of one categorical draw are independent
-        given the batch key, and keying on it would kill batching for
-        sampled traffic."""
+        (prompt length, token budget) are the compile key. ``seed`` joins
+        the key only for sampled traffic (``temperature > 0``) — greedy
+        decoding never consumes it, so keying greedy requests on seed would
+        kill batching for nothing, while a sampled request's draws must
+        come from *its* seed, not whichever request happened to lead the
+        batch."""
         return (
             self.input_ids.shape[-1],
             self.effective_max_new_tokens,
@@ -112,6 +114,7 @@ class _Request:
             self.top_p,
             self.eos_token_id,
             self.pad_token_id,
+            self.seed if self.temperature > 0.0 else None,
         )
 
 
@@ -286,6 +289,7 @@ class InferenceServer:
         self._queue: collections.deque[_Request] = collections.deque()
         self._draining = False
         self._closed = False
+        self._worker_error: Optional[BaseException] = None
         self._drained = threading.Event()
         self.metrics = ServingMetrics()
         self._breaker = _CircuitBreaker(
@@ -325,13 +329,19 @@ class InferenceServer:
 
         ``deadline_s`` is relative seconds from now (``None`` →
         ``config.default_deadline_s``).
+
+        ``seed`` drives sampling (``temperature > 0``) deterministically:
+        sampled requests only batch with requests sharing their seed (it is
+        part of the batching group key), so another request's seed is never
+        used for this request's draws. A row's draw still depends on its
+        position inside the executed batch, so bitwise reproducibility
+        additionally requires the same batch composition. Greedy requests
+        (``temperature == 0``) ignore ``seed`` entirely.
         """
         fault_point("serving_submit")
         if self._closed or self._draining or preemption_requested():
             self.metrics.bump("rejected_draining")
-            raise ServerDrainingError(
-                "server is draining — resubmit to another replica"
-            )
+            raise ServerDrainingError(self._drain_reason())
         if self._breaker.rejects_admission:
             self.metrics.bump("rejected_breaker")
             raise CircuitOpenError(
@@ -366,9 +376,7 @@ class InferenceServer:
         with self._wake:
             if self._draining or self._closed:
                 self.metrics.bump("rejected_draining")
-                raise ServerDrainingError(
-                    "server is draining — resubmit to another replica"
-                )
+                raise ServerDrainingError(self._drain_reason())
             if len(self._queue) >= self.config.max_queue:
                 self.metrics.bump("rejected_queue_full")
                 raise ServerOverloaded(
@@ -386,6 +394,35 @@ class InferenceServer:
         return self.submit(input_ids, **kwargs).result(timeout=timeout).tokens
 
     # ------------------------------------------------------------- lifecycle
+    def _drain_reason(self) -> str:
+        if self._worker_error is not None:
+            return (
+                "serving worker died "
+                f"({type(self._worker_error).__name__}: {self._worker_error})"
+                " — this replica cannot serve; resubmit to another replica"
+            )
+        return "server is draining — resubmit to another replica"
+
+    @staticmethod
+    def _resolve(
+        future: Future, *, result=None, exception: Optional[BaseException] = None
+    ) -> bool:
+        """Resolve a client Future exactly once. Callers may ``cancel()``
+        a pending Future at any moment (client-side timeout), so every
+        worker-side resolution must tolerate the done/cancelled race
+        instead of dying on ``InvalidStateError``. Returns True when this
+        call actually delivered the outcome."""
+        if future.done():
+            return False
+        try:
+            if exception is not None:
+                future.set_exception(exception)
+            else:
+                future.set_result(result)
+            return True
+        except InvalidStateError:  # lost the race to a concurrent cancel()
+            return False
+
     @property
     def draining(self) -> bool:
         return self._draining or self._closed
@@ -436,11 +473,15 @@ class InferenceServer:
                         if preemption_requested():
                             self._draining = True
                             break
-                        self._maybe_flush_metrics_locked()
+                        if self._flush_due():
+                            break  # emit below, after releasing the lock
                         self._wake.wait(timeout=0.05)
                     if self._draining or preemption_requested():
                         self._draining = True
                         break
+                # flush with the lock released — a slow tracker must never
+                # stall submit() or worker wakeups
+                self._flush_metrics()
                 st = self._breaker.state()
                 if st == _CircuitBreaker.OPEN:
                     # fail fast is submit()'s job; here just shed requests
@@ -453,11 +494,18 @@ class InferenceServer:
                 )
                 if batch:
                     self._execute(batch)
-                self._flush_metrics()
-        except BaseException:  # noqa: BLE001 — a dead worker must not hang clients
+        except BaseException as exc:  # noqa: BLE001 — a dead worker must not hang clients
+            # stop admission FIRST: nothing consumes the queue anymore, so a
+            # later submit() must fail fast instead of parking a Future that
+            # can never resolve
+            with self._lock:
+                self._worker_error = exc
+                self._draining = True
             logger.exception("serving worker died; failing queued requests")
             raise
         finally:
+            with self._lock:
+                self._draining = True
             self._reject_queued()
             self._drained.set()
             self._flush_metrics(force=True)
@@ -483,14 +531,16 @@ class InferenceServer:
         req.effective_max_new_tokens = budget
 
     def _shed(self, req: _Request, now: float) -> None:
-        self.metrics.bump("shed_deadline")
-        req.future.set_exception(
-            RequestDeadlineExceeded(
+        shed = self._resolve(
+            req.future,
+            exception=RequestDeadlineExceeded(
                 f"deadline passed {now - req.deadline:.3f}s ago at dequeue "
                 f"(estimated batch time {self._estimated_batch_s():.3f}s) — "
                 "shed instead of wasting a batch slot"
-            )
+            ),
         )
+        if shed:
+            self.metrics.bump("shed_deadline")
 
     def _shed_expired(self) -> None:
         """Drop queued requests that can no longer make their deadline
@@ -604,6 +654,9 @@ class InferenceServer:
                 fault_point("serving_after_batch")
             except BaseException as exc:  # noqa: BLE001 — classified below
                 if isinstance(exc, (KeyboardInterrupt, SystemExit)):
+                    # the worker is about to die — the in-flight batch must
+                    # not leave clients blocked on unresolved futures
+                    self._fail_batch(batch, exc, "worker interrupted mid-batch")
                     raise
                 attempt += 1
                 self.metrics.bump("batch_failures")
@@ -616,14 +669,10 @@ class InferenceServer:
                         cfg.breaker_threshold, exc,
                     )
                 if attempt > cfg.max_retries or self._draining:
-                    err = BatchExecutionError(
-                        f"batch failed permanently after {attempt} attempt(s): "
-                        f"{type(exc).__name__}: {exc}"
+                    self._fail_batch(
+                        batch, exc,
+                        f"batch failed permanently after {attempt} attempt(s)",
                     )
-                    err.__cause__ = exc
-                    for req in batch:
-                        if not req.future.done():
-                            req.future.set_exception(err)
                     return
                 self.metrics.bump("retries")
                 backoff = min(
@@ -641,39 +690,63 @@ class InferenceServer:
                     self._wake.wait(timeout=backoff)
                 continue
             break
-        # success epilogue
-        self._breaker.record_success()
-        self.metrics.bump("batches")
-        self._batch_time_ewma = (
-            dt if self._batch_time_ewma == 0.0
-            else 0.8 * self._batch_time_ewma + 0.2 * dt
-        )
-        fault_point("serving_before_reply")
-        now = self._clock()
-        for i, req in enumerate(batch):
-            if req.future.done():  # already shed/cancelled — never double-reply
-                continue
-            if req.deadline is not None and now > req.deadline:
-                self.metrics.bump("completed_late")
-                req.future.set_exception(
-                    RequestDeadlineExceeded(
-                        f"batch completed {now - req.deadline:.3f}s past the "
-                        "deadline"
-                    )
-                )
-                continue
-            self.metrics.bump("completed")
-            latency = now - req.submitted_at
-            self.metrics.latency.add(latency)
-            self.metrics.queue_wait.add(max(0.0, latency - dt))
-            req.future.set_result(
-                ServingResult(
-                    tokens=out[i],
-                    latency_s=latency,
-                    batch_size=len(batch),
-                    degraded=req.degraded,
-                )
+        # success epilogue — guarded: the batch has already executed, so any
+        # failure past this point (an armed ``serving_before_reply`` fault,
+        # a pathological tracker/metrics error) must fail THIS batch's
+        # outstanding futures rather than escape with them unresolved
+        try:
+            self._breaker.record_success()
+            self.metrics.bump("batches")
+            self._batch_time_ewma = (
+                dt if self._batch_time_ewma == 0.0
+                else 0.8 * self._batch_time_ewma + 0.2 * dt
             )
+            fault_point("serving_before_reply")
+            now = self._clock()
+            for i, req in enumerate(batch):
+                if req.deadline is not None and now > req.deadline:
+                    late = self._resolve(
+                        req.future,
+                        exception=RequestDeadlineExceeded(
+                            f"batch completed {now - req.deadline:.3f}s past "
+                            "the deadline"
+                        ),
+                    )
+                    if late:
+                        self.metrics.bump("completed_late")
+                    continue
+                latency = now - req.submitted_at
+                delivered = self._resolve(
+                    req.future,
+                    result=ServingResult(
+                        tokens=out[i],
+                        latency_s=latency,
+                        batch_size=len(batch),
+                        degraded=req.degraded,
+                    ),
+                )
+                if delivered:
+                    self.metrics.bump("completed")
+                    self.metrics.latency.add(latency)
+                    self.metrics.queue_wait.add(max(0.0, latency - dt))
+        except BaseException as exc:  # noqa: BLE001 — never strand a batch
+            self._fail_batch(batch, exc, "batch executed but the reply failed")
+            if isinstance(exc, (KeyboardInterrupt, SystemExit)):
+                raise
+            logger.exception(
+                "serving reply epilogue failed; the batch's outstanding "
+                "futures were failed with BatchExecutionError"
+            )
+
+    def _fail_batch(
+        self, batch: list[_Request], cause: BaseException, reason: str
+    ) -> None:
+        err = BatchExecutionError(
+            f"{reason}: {type(cause).__name__}: {cause}"
+        )
+        err.__cause__ = cause
+        for req in batch:
+            self._resolve(req.future, exception=err)
 
     def _reject_queued(self) -> None:
         with self._lock:
@@ -681,24 +754,24 @@ class InferenceServer:
             self._queue.clear()
             self.metrics.gauge("queue_depth", 0)
         for req in pending:
-            if not req.future.done():
+            rejected = self._resolve(
+                req.future,
+                exception=ServerDrainingError(
+                    "server drained before this request was batched — "
+                    "resubmit to another replica"
+                ),
+            )
+            if rejected:
                 self.metrics.bump("rejected_draining")
-                req.future.set_exception(
-                    ServerDrainingError(
-                        "server drained before this request was batched — "
-                        "resubmit to another replica"
-                    )
-                )
 
     # --------------------------------------------------------------- metrics
-    def _maybe_flush_metrics_locked(self) -> None:
-        # called with self._lock held (idle wait) — snapshot outside is fine,
-        # the counters have their own locks
-        if self.config.metrics_interval_s is None or not self.trackers:
-            return
-        if self._clock() - self._last_metrics_flush >= self.config.metrics_interval_s:
-            self._last_metrics_flush = self._clock()
-            self._emit_snapshot()
+    def _flush_due(self) -> bool:
+        interval = self.config.metrics_interval_s
+        return (
+            bool(self.trackers)
+            and interval is not None
+            and self._clock() - self._last_metrics_flush >= interval
+        )
 
     def _flush_metrics(self, force: bool = False) -> None:
         if not self.trackers:

@@ -167,6 +167,59 @@ def test_deadline_enforced_at_completion():
         assert srv.metrics["completed_late"] == 1
 
 
+def test_cancelled_request_does_not_kill_worker():
+    """A client cancelling its pending Future (client-side timeout) must not
+    crash the dispatch loop when the worker later tries to shed/resolve it
+    (regression: InvalidStateError killed the worker)."""
+    gate = threading.Event()
+
+    def gated(model, ids, max_new_tokens=4, **kw):
+        gate.wait(10)
+        return np.concatenate(
+            [ids, np.ones((ids.shape[0], max_new_tokens), np.int32)], axis=1
+        )
+
+    cfg = ServingConfig(max_batch_size=1, batch_window_s=0.0)
+    srv = InferenceServer(object(), cfg, generate_fn=gated)
+    try:
+        blocker = srv.submit(np.arange(3))
+        assert wait_until(lambda: srv.queue_depth() == 0)
+        doomed = srv.submit(np.arange(3), deadline_s=0.001)
+        assert doomed.cancel()  # client gave up while still queued
+        time.sleep(0.05)  # its deadline passes behind the blocker
+        gate.set()
+        assert blocker.result(5).tokens is not None
+        # the worker survived resolving the cancelled request: still serving
+        assert srv.submit(np.arange(3)).result(5).tokens is not None
+        assert srv.metrics["shed_deadline"] == 0  # cancelled, not shed
+    finally:
+        gate.set()
+        srv.close()
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+)
+def test_worker_death_fails_fast_instead_of_hanging():
+    """When the dispatch worker dies, the in-flight batch's futures resolve
+    with a typed error and later submit() calls fail fast — nothing hangs
+    on a queue no loop consumes anymore."""
+
+    def lethal(model, ids, **kw):
+        raise SystemExit(3)  # not retried: kills the worker thread
+
+    cfg = ServingConfig(max_batch_size=1, batch_window_s=0.0)
+    srv = InferenceServer(object(), cfg, generate_fn=lethal)
+    f = srv.submit(np.arange(3))
+    with pytest.raises(BatchExecutionError):
+        f.result(5)
+    assert srv._drained.wait(5)  # worker exited, queue rejected
+    with pytest.raises(ServerDrainingError) as exc_info:
+        srv.submit(np.arange(3))
+    assert "worker died" in str(exc_info.value)
+    assert exc_info.value.retriable  # a healthy replica can take it
+
+
 # ------------------------------------------------------------ retry / breaker
 def test_retry_recovers_after_transient_failures():
     state = {"fails": 2}
@@ -384,6 +437,63 @@ def test_fault_injected_batch_death_loses_and_duplicates_nothing(fault_inject):
         assert len(batches) == 1  # ONE successful execution, no replays
     finally:
         srv.close()
+
+
+def test_reply_fault_fails_batch_and_server_keeps_serving(fault_inject):
+    """A failure AFTER the batch executed (armed ``serving_before_reply``)
+    fails that batch's futures with BatchExecutionError instead of killing
+    the worker with the results stranded — and the server keeps serving."""
+    cfg = ServingConfig(max_batch_size=2, batch_window_s=0.0, max_retries=0)
+    srv = InferenceServer(object(), cfg, generate_fn=echo_gen())
+    try:
+        fault_inject("serving_before_reply:raise")
+        f = srv.submit(np.arange(3))
+        with pytest.raises(BatchExecutionError) as exc_info:
+            f.result(5)
+        assert isinstance(exc_info.value.__cause__, fault.FaultInjected)
+        os.environ.pop(fault.FAULT_INJECT_ENV, None)
+        # the reply-stage failure cost one batch, not the worker
+        assert srv.submit(np.arange(3)).result(5).tokens is not None
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------- seed keying
+def test_sampled_requests_batch_only_with_matching_seed():
+    """Sampled traffic keys batching on seed (a request's draws must come
+    from ITS seed); greedy traffic ignores seed and coalesces freely."""
+    gate = threading.Event()
+    recorded = []
+
+    def fn(model, ids, max_new_tokens=4, seed=0, **kw):
+        if not gate.is_set():
+            gate.wait(10)
+        recorded.append((ids.shape[0], seed))
+        return np.concatenate(
+            [ids, np.ones((ids.shape[0], max_new_tokens), np.int32)], axis=1
+        )
+
+    cfg = ServingConfig(max_batch_size=4, batch_window_s=0.0, batch_bucket=False)
+    srv = InferenceServer(object(), cfg, generate_fn=fn)
+    try:
+        blocker = srv.submit(np.arange(4))
+        assert wait_until(lambda: srv.queue_depth() == 0)
+        futs = [
+            srv.submit(np.arange(4), temperature=0.7, seed=1),
+            srv.submit(np.arange(4), temperature=0.7, seed=1),
+            srv.submit(np.arange(4), temperature=0.7, seed=2),
+            srv.submit(np.arange(4), seed=5),  # greedy: seed is irrelevant
+            srv.submit(np.arange(4), seed=6),
+        ]
+        gate.set()
+        blocker.result(5)
+        [f.result(5) for f in futs]
+    finally:
+        gate.set()
+        srv.close()
+    # after the blocker: the seed-1 pair shares a batch, seed 2 rides alone,
+    # the two greedy requests coalesce despite different seeds
+    assert recorded[1:] == [(2, 1), (1, 2), (2, 5)]
 
 
 # ------------------------------------------------------------- degradation
