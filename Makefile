@@ -9,7 +9,7 @@
 PY := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu python
 PY_SLOW := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu RUN_SLOW=1 python
 
-.PHONY: test test_all test_core test_data test_parallel test_models test_cli test_big_modeling test-fault test-serving bench bench-telemetry bench-serving
+.PHONY: test test_all test_core test_data test_parallel test_models test_cli test_big_modeling test-fault test-serving bench bench-telemetry bench-serving bench-continuous
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -23,9 +23,10 @@ test-fault:
 
 # resilient-serving suite (docs/serving.md): dynamic batching, deadline
 # shedding, backpressure, retry/backoff, circuit breaker, SIGTERM drain,
-# fault-injected batch death (exactly-once replies)
+# fault-injected batch death (exactly-once replies), plus the continuous-
+# batching engine (slot lifecycle, seed reproducibility, mode parity)
 test-serving:
-	$(PY) -m pytest tests/test_serving.py -q
+	$(PY) -m pytest tests/test_serving.py tests/test_engine.py -q
 
 test_all:
 	$(PY_SLOW) -m pytest tests/test_state.py tests/test_operations.py tests/test_parallelism_config.py tests/test_accelerator.py tests/test_checkpointing.py tests/test_tracking.py tests/test_data_loader.py tests/test_data_shard_info.py tests/test_misc.py tests/test_cli.py tests/test_big_modeling.py tests/test_losses.py tests/test_flatbuf.py tests/test_local_sgd.py tests/test_api_parity.py tests/test_hlo_analysis.py tests/test_tracking_fakes.py tests/test_powersgd.py -q
@@ -65,3 +66,9 @@ bench-telemetry:
 # (docs/serving.md)
 bench-serving:
 	$(PY) benchmarks/serving_bench.py --gate
+
+# continuous-batching gate: mixed-length/mixed-budget greedy workload,
+# continuous mode >= 1.3x static goodput with TTFT p99 no worse, exactly
+# two compiled engine programs, bitwise output parity (docs/serving.md)
+bench-continuous:
+	$(PY) benchmarks/continuous_bench.py --gate

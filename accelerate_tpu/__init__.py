@@ -45,6 +45,9 @@ _LAZY = {
     "generate": ("inference", "generate"),
     "prepare_inference": ("inference", "prepare_inference"),
     "generate_cache_stats": ("inference", "generate_cache_stats"),
+    "last_generate_stats": ("inference", "last_generate_stats"),
+    "ContinuousBatchingEngine": ("engine", "ContinuousBatchingEngine"),
+    "SlotOccupant": ("engine", "SlotOccupant"),
     "InferenceServer": ("serving", "InferenceServer"),
     "ServingResult": ("serving", "ServingResult"),
     "ServingMetrics": ("serving", "ServingMetrics"),

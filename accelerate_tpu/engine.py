@@ -1,0 +1,494 @@
+"""Continuous-batching decode engine: slot-based KV arena + iteration-level
+scheduling state (Orca-style, the technique behind vLLM-class serving
+throughput).
+
+The static serving path (:class:`~accelerate_tpu.serving.InferenceServer`
+``mode="static"``) batches whole ``generate()`` calls at admission time:
+requests only coalesce when they share a group key (prompt length, token
+budget, sampling-branch flags, seed for sampled traffic), and every batch
+then runs its full fused prefill+decode scan to ``max_new_tokens`` even if
+every row hit EOS at step 3. This module removes all three costs at once:
+
+* **Slot-based KV arena** — a fixed ``(layers, slots, max_len, kv_heads,
+  head_dim)`` per-layer KV buffer plus per-slot ``pos/done/budget/token``
+  vectors and per-slot sampling params (temperature, top_k, top_p, eos id,
+  PRNG key). Mixed greedy/sampled/any-seed traffic shares ONE compiled
+  decode program: sampling params are per-row traced operands, not compile
+  keys, so the seed and ``max_new_tokens`` group-key fragmentation of the
+  static path disappears entirely.
+* **Exactly two jitted programs** per (slots, max_len) configuration:
+  ``prefill_insert`` (bucketed prompt forward via the models'
+  ``*_prefill_at``, then scatter its KV rows into a free arena slot with
+  ``lax.dynamic_update_slice``) and ``decode_step`` (one fused step over
+  ALL slots — finished/vacant slots ride along masked). The KV arena and
+  per-slot position/PRNG state are donated across calls, so steady-state
+  decode performs zero reallocation of the arena.
+* **Iteration-level scheduling state** — the host (the serving worker)
+  retires finished slots, admits queued requests into freed slots with an
+  interleaved prefill, and enforces per-slot token budgets exactly. The
+  done-mask readback is deferred ``readback_lag`` programs (the same
+  deferred-ring trick as telemetry's :class:`DeferredReadbackRing`), so
+  retirement decisions never force a synchronous device round-trip on the
+  decode hot path.
+
+The engine is deliberately server-agnostic: occupants carry an opaque
+``tag`` (the server's request object) and the engine only speaks tokens.
+Scheduling policy — deadlines, backpressure, degradation, drain — lives in
+:mod:`accelerate_tpu.serving`.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .logging import get_logger
+
+logger = get_logger(__name__)
+
+__all__ = ["ContinuousBatchingEngine", "SlotOccupant"]
+
+
+# ------------------------------------------------------------------ occupants
+@dataclass
+class SlotOccupant:
+    """Host-side record of one request living in an arena slot."""
+
+    slot: int
+    tag: Any  # opaque (the server's request); the engine never inspects it
+    prompt: np.ndarray  # (prompt_len,) int32, UNpadded
+    budget: int  # exact number of new tokens owed (post-degradation clamp)
+    pad_id: int
+    eos_id: Optional[int]
+    inserted_s: float
+    tokens: List[int] = field(default_factory=list)  # emitted new tokens
+    finished: bool = False
+    first_token_s: Optional[float] = None  # host clock at first popped token
+
+    def output_row(self) -> np.ndarray:
+        """prompt + emitted tokens, padded with ``pad_id`` to the full
+        budget — byte-compatible with the static ``generate()`` row shape
+        (prompt_len + max_new_tokens,) so static/continuous outputs compare
+        directly."""
+        out = np.full(len(self.prompt) + self.budget, self.pad_id, dtype=np.int32)
+        out[: len(self.prompt)] = self.prompt
+        out[len(self.prompt) : len(self.prompt) + len(self.tokens)] = self.tokens
+        return out
+
+
+def _sample_rows(logits, subkeys, temp, top_k, top_p):
+    """Per-row sampling over (N, V) logits: per-row temperature (0 = greedy
+    argmax), per-row top-k (0 or >= V = off) and top-p (>= 1 = off) via ONE
+    descending sort — both filters are dynamic per-row operands, so a
+    greedy row, a seeded nucleus row and a top-k row share this one traced
+    body (no structural sampling branches, unlike the static ``generate()``
+    whose top_k width is a compile key)."""
+    n, v = logits.shape
+    safe_t = jnp.where(temp > 0, temp, jnp.float32(1.0))
+    scaled = logits / safe_t[:, None]
+    sorted_l = jnp.sort(scaled, axis=-1)[:, ::-1]
+    k_on = (top_k > 0) & (top_k < v)
+    k_eff = jnp.clip(top_k, 1, v)
+    rank = jnp.arange(v)[None, :]
+    # top-k: drop everything below the kth-largest (rank view keeps sort
+    # order, so the top-p pass below sees the k-filtered distribution — the
+    # same k-then-p order as the static sampler)
+    sorted_f = jnp.where(~k_on[:, None] | (rank < k_eff[:, None]), sorted_l, -jnp.inf)
+    kth = jnp.take_along_axis(sorted_l, (k_eff - 1)[:, None], axis=-1)
+    filtered = jnp.where(k_on[:, None] & (scaled < kth), -jnp.inf, scaled)
+    # top-p (nucleus): smallest prefix with cumulative probability >= p; the
+    # cumsum is exclusive so the top token always survives, and p >= 1
+    # degenerates to keep-everything
+    probs = jax.nn.softmax(sorted_f, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1) - probs
+    p_eff = jnp.where(top_p < 1.0, top_p, jnp.float32(1.0))
+    cutoff_idx = jnp.maximum(
+        jnp.sum((cum < p_eff[:, None]).astype(jnp.int32), axis=-1) - 1, 0
+    )
+    cutoff = jnp.take_along_axis(sorted_f, cutoff_idx[:, None], axis=-1)
+    final = jnp.where(filtered < cutoff, -jnp.inf, filtered)
+    sampled = jax.vmap(jax.random.categorical)(subkeys, final).astype(jnp.int32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jnp.where(temp > 0, sampled, greedy)
+
+
+# --------------------------------------------------------------------- engine
+class ContinuousBatchingEngine:
+    """Persistent slot-based decode state for one model.
+
+    Host API (all single-threaded — the serving worker owns the engine):
+
+    * :meth:`insert` — admit one request into a free slot (bucketed prompt
+      prefill + KV scatter; raises when no slot is free).
+    * :meth:`step` — one fused decode step over every slot.
+    * :meth:`poll` — pop matured deferred-readback entries, collect tokens,
+      retire finished occupants (returned so the caller can reply).
+    * :meth:`cancel` — force-retire an occupant (deadline shed); its slot
+      frees immediately, stale in-flight ring tokens are ignored.
+    * :meth:`drain` — step until every occupant retires.
+    * :meth:`reset` — drop all state after a device failure; returns the
+      orphaned occupants so the caller can fail their futures.
+
+    ``readback_lag`` defers the host materialization of each program's
+    (token, done) outputs by that many subsequent programs, keeping the
+    decode loop free of synchronous device round-trips; ``0`` reads back
+    every step (deterministic scheduling for tests).
+    """
+
+    def __init__(
+        self,
+        model,
+        *,
+        slots: int = 8,
+        max_len: int = 256,
+        prompt_bucket: Optional[int] = None,
+        readback_lag: int = 2,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        from .models.gpt2 import GPT2Config, gpt2_decode_step, gpt2_prefill_at
+        from .models.llama import llama_decode_step, llama_prefill_at
+
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
+        if max_len < 2:
+            raise ValueError(f"max_len must be >= 2, got {max_len}")
+        if readback_lag < 0:
+            raise ValueError(f"readback_lag must be >= 0, got {readback_lag}")
+        self.model = model
+        self.config = model.config
+        self.slots = slots
+        self.max_len = max_len
+        self.prompt_bucket = prompt_bucket if prompt_bucket is not None else max(1, max_len // 2)
+        if not 1 <= self.prompt_bucket <= max_len - 1:
+            raise ValueError(
+                f"prompt_bucket must be in [1, max_len-1], got "
+                f"{self.prompt_bucket} (max_len={max_len})"
+            )
+        self.readback_lag = readback_lag
+        self._clock = clock
+        if isinstance(self.config, GPT2Config):
+            self._prefill_at_fn, self._decode_fn = gpt2_prefill_at, gpt2_decode_step
+        else:
+            self._prefill_at_fn, self._decode_fn = llama_prefill_at, llama_decode_step
+        self._key_width = jax.random.key_data(jax.random.key(0)).shape[-1]
+
+        self._donated, self._carried = self._init_state()
+        # donate only argument 0 (the arena + per-slot pos/PRNG): the ring
+        # must keep reading the PREVIOUS carried token/done arrays after the
+        # next program dispatches, so carried state is small and undonated
+        self._decode_jit = jax.jit(self._decode_impl, donate_argnums=(0,))
+        self._prefill_jit = jax.jit(self._prefill_impl, donate_argnums=(0,))
+
+        self._occupants: List[Optional[SlotOccupant]] = [None] * slots
+        self._free: List[int] = list(range(slots))
+        # deferred-readback ring: (tick, kind, payload) — the same
+        # K-programs-late trick as telemetry's DeferredReadbackRing, here
+        # over (token, done) vectors instead of health verdicts
+        self._ring: collections.deque = collections.deque()
+        self._tick = 0
+        self.inserted = 0
+        self.steps = 0
+        self.retired = 0
+        # distinct (program, operand-shape) signatures actually dispatched —
+        # the "<= 2 compiled programs" acceptance stat (one prompt bucket →
+        # one prefill signature + one decode signature)
+        self._programs: dict[str, set] = {}
+
+    # ----------------------------------------------------------- state init
+    def _init_state(self):
+        cfg = self.config
+        kvh = getattr(cfg, "num_key_value_heads", None) or cfg.num_attention_heads
+        shape = (cfg.num_hidden_layers, self.slots, self.max_len, kvh, cfg.head_dim)
+        cdt = cfg.compute_dtype
+        s = self.slots
+        keys = jax.random.split(jax.random.key(0), s)
+        donated = {
+            "cache": {"k": jnp.zeros(shape, cdt), "v": jnp.zeros(shape, cdt)},
+            "pos": jnp.zeros((s,), jnp.int32),
+            "key": jax.random.key_data(keys),  # (S, key_width) uint32
+        }
+        carried = {
+            # vacant slots are permanently "done": they ride every decode
+            # step masked (pad token, no budget burn, pos frozen)
+            "token": jnp.zeros((s,), jnp.int32),
+            "done": jnp.ones((s,), bool),
+            "budget": jnp.zeros((s,), jnp.int32),
+            "temp": jnp.zeros((s,), jnp.float32),
+            "top_k": jnp.zeros((s,), jnp.int32),
+            "top_p": jnp.ones((s,), jnp.float32),
+            "eos": jnp.full((s,), -1, jnp.int32),
+            "pad": jnp.zeros((s,), jnp.int32),
+        }
+        return donated, carried
+
+    # ------------------------------------------------------------- programs
+    def _decode_impl(self, donated, carried, params):
+        cache, pos, key_data = donated["cache"], donated["pos"], donated["key"]
+        token, done = carried["token"], carried["done"]
+        logits, cache = self._decode_fn(self.config, params, cache, token[:, None], pos)
+        pairs = jax.vmap(jax.random.split)(jax.random.wrap_key_data(key_data))
+        next_kd = jax.random.key_data(pairs[:, 0])
+        subs = pairs[:, 1]
+        nxt = _sample_rows(logits, subs, carried["temp"], carried["top_k"], carried["top_p"])
+        emitting = ~done
+        nxt = jnp.where(emitting, nxt, carried["pad"])
+        budget = carried["budget"] - emitting.astype(jnp.int32)
+        hit_eos = (carried["eos"] >= 0) & (nxt == carried["eos"])
+        new_done = done | (emitting & (hit_eos | (budget <= 0)))
+        new_pos = pos + emitting.astype(jnp.int32)
+        new_donated = {"cache": cache, "pos": new_pos, "key": next_kd}
+        new_carried = {**carried, "token": nxt, "done": new_done, "budget": budget}
+        return new_donated, new_carried
+
+    def _prefill_impl(
+        self, donated, carried, params, prompt, length, slot, key_data,
+        temp, top_k, top_p, eos, pad, budget,
+    ):
+        # bucketed prompt forward; logits at the last REAL position. The
+        # returned cache is max_len wide with zeros beyond the bucket, so
+        # scattering it wipes every stale byte of the slot's previous
+        # occupant — KV isolation across slot reuse is structural.
+        logits, new_cache = self._prefill_at_fn(
+            self.config, params, prompt, self.max_len, (length - 1)[None]
+        )
+        keys = jax.random.split(jax.random.wrap_key_data(key_data), 2)
+        t0 = _sample_rows(logits, keys[1:2], temp[None], top_k[None], top_p[None])[0]
+        hit_eos = (eos >= 0) & (t0 == eos)
+        budget_left = budget - 1
+        done0 = hit_eos | (budget_left <= 0)
+        cache = {
+            "k": lax.dynamic_update_slice(
+                donated["cache"]["k"],
+                new_cache["k"].astype(donated["cache"]["k"].dtype),
+                (0, slot, 0, 0, 0),
+            ),
+            "v": lax.dynamic_update_slice(
+                donated["cache"]["v"],
+                new_cache["v"].astype(donated["cache"]["v"].dtype),
+                (0, slot, 0, 0, 0),
+            ),
+        }
+        new_donated = {
+            "cache": cache,
+            "pos": donated["pos"].at[slot].set(length),
+            "key": donated["key"].at[slot].set(jax.random.key_data(keys[0])),
+        }
+        new_carried = {
+            "token": carried["token"].at[slot].set(t0),
+            "done": carried["done"].at[slot].set(done0),
+            "budget": carried["budget"].at[slot].set(budget_left),
+            "temp": carried["temp"].at[slot].set(temp),
+            "top_k": carried["top_k"].at[slot].set(top_k),
+            "top_p": carried["top_p"].at[slot].set(top_p),
+            "eos": carried["eos"].at[slot].set(eos),
+            "pad": carried["pad"].at[slot].set(pad),
+        }
+        return new_donated, new_carried, t0, done0
+
+    def _record(self, name: str, sig: tuple) -> None:
+        self._programs.setdefault(name, set()).add(sig)
+
+    # -------------------------------------------------------------- host API
+    def free_slots(self) -> int:
+        return len(self._free)
+
+    def live_count(self) -> int:
+        return sum(1 for o in self._occupants if o is not None and not o.finished)
+
+    def occupants(self) -> List[SlotOccupant]:
+        """Snapshot of live (unfinished) occupants, for scheduler policy
+        passes (deadline shed) over in-flight slots."""
+        return [o for o in self._occupants if o is not None and not o.finished]
+
+    def validate_request(self, prompt_len: int, max_new_tokens: int) -> None:
+        """Raise ValueError when a request cannot fit this engine's arena
+        (checked at admission so the typed error reaches the submitter)."""
+        if prompt_len < 1 or prompt_len > self.prompt_bucket:
+            raise ValueError(
+                f"prompt length {prompt_len} exceeds the engine prompt "
+                f"bucket ({self.prompt_bucket}); raise "
+                "ServingConfig.engine_prompt_bucket or shorten the prompt"
+            )
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        if prompt_len + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds the KV arena length ({self.max_len}); raise "
+                "ServingConfig.engine_max_len or lower the budget"
+            )
+
+    def insert(
+        self,
+        prompt,
+        *,
+        max_new_tokens: int,
+        temperature: float = 0.0,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
+        eos_token_id: Optional[int] = None,
+        pad_token_id: Optional[int] = None,
+        seed: int = 0,
+        tag: Any = None,
+    ) -> SlotOccupant:
+        """Admit one request into a free slot: bucketed prefill, KV scatter,
+        first token sampled inside the same program."""
+        prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
+        self.validate_request(len(prompt), max_new_tokens)
+        if not self._free:
+            raise RuntimeError("no free arena slot (caller must gate on free_slots())")
+        slot = self._free.pop()
+        padded = np.zeros((1, self.prompt_bucket), np.int32)
+        padded[0, : len(prompt)] = prompt
+        pad_id = (
+            pad_token_id if pad_token_id is not None
+            else (eos_token_id if eos_token_id is not None else 0)
+        )
+        kd = jax.random.key_data(jax.random.key(seed))
+        self._record("prefill_insert", (self.prompt_bucket,))
+        self._donated, self._carried, t0, d0 = self._prefill_jit(
+            self._donated, self._carried, self.model.params,
+            jnp.asarray(padded), jnp.int32(len(prompt)), jnp.int32(slot), kd,
+            jnp.float32(temperature),
+            jnp.int32(top_k if top_k is not None else 0),
+            jnp.float32(top_p if top_p is not None else 1.0),
+            jnp.int32(eos_token_id if eos_token_id is not None else -1),
+            jnp.int32(pad_id), jnp.int32(max_new_tokens),
+        )
+        occ = SlotOccupant(
+            slot=slot, tag=tag, prompt=prompt, budget=max_new_tokens,
+            pad_id=pad_id, eos_id=eos_token_id, inserted_s=self._clock(),
+        )
+        self._occupants[slot] = occ
+        self.inserted += 1
+        self._tick += 1
+        self._ring.append((self._tick, "prefill", (occ, t0, d0)))
+        return occ
+
+    def step(self) -> bool:
+        """One fused decode step over every slot (vacant/finished slots ride
+        masked). Returns False (no dispatch) when nothing is live."""
+        if self.live_count() == 0:
+            return False
+        self._record("decode_step", ())
+        self._donated, self._carried = self._decode_jit(
+            self._donated, self._carried, self.model.params
+        )
+        self.steps += 1
+        self._tick += 1
+        self._ring.append(
+            (self._tick, "decode",
+             (tuple(self._occupants), self._carried["token"], self._carried["done"]))
+        )
+        return True
+
+    def poll(self, force: bool = False) -> List[SlotOccupant]:
+        """Pop every ring entry at least ``readback_lag`` programs old
+        (all of them with ``force=True``), collect tokens, and return the
+        occupants retired by this poll. Entries referencing occupants that
+        finished (or were cancelled) earlier are skipped — their token
+        values are pad by construction."""
+        retired: List[SlotOccupant] = []
+        while self._ring and (
+            force or self._tick - self._ring[0][0] >= self.readback_lag
+        ):
+            _, kind, payload = self._ring.popleft()
+            if kind == "prefill":
+                occ, tok, done = payload
+                self._absorb(occ, int(tok), bool(done), retired)
+            else:
+                occs, toks, dones = payload
+                toks = np.asarray(toks)
+                dones = np.asarray(dones)
+                for occ in occs:
+                    if occ is None or occ.finished:
+                        continue
+                    self._absorb(occ, int(toks[occ.slot]), bool(dones[occ.slot]), retired)
+        return retired
+
+    def _absorb(self, occ: SlotOccupant, token: int, done: bool, retired: list) -> None:
+        if occ.finished:
+            return
+        if occ.first_token_s is None:
+            occ.first_token_s = self._clock()
+        occ.tokens.append(token)
+        # the device done mask is authoritative (EOS or budget exhausted);
+        # the host-side budget guard is belt-and-braces
+        if done or len(occ.tokens) >= occ.budget:
+            self._retire(occ, retired)
+
+    def _retire(self, occ: SlotOccupant, retired: list) -> None:
+        occ.finished = True
+        self._occupants[occ.slot] = None
+        self._free.append(occ.slot)
+        self.retired += 1
+        retired.append(occ)
+
+    def cancel(self, occ: SlotOccupant) -> None:
+        """Force-retire (deadline shed / external cancel): the slot frees
+        immediately for reuse; the device keeps masking it until a new
+        occupant's prefill resets it."""
+        if occ.finished:
+            return
+        occ.finished = True
+        if self._occupants[occ.slot] is occ:
+            self._occupants[occ.slot] = None
+            self._free.append(occ.slot)
+        self.retired += 1
+
+    def drain(self) -> List[SlotOccupant]:
+        """Step until every occupant retires (bounded by the per-slot budget
+        mask: at most ~max_len + readback_lag steps)."""
+        retired: List[SlotOccupant] = []
+        guard = 2 * self.max_len + self.readback_lag + 4
+        while self.live_count() > 0:
+            if guard <= 0:
+                raise RuntimeError(
+                    "engine drain did not converge (device done mask never "
+                    "caught up with live occupants)"
+                )
+            guard -= 1
+            self.step()
+            retired.extend(self.poll())
+        retired.extend(self.poll(force=True))
+        return retired
+
+    def reset(self) -> List[SlotOccupant]:
+        """Drop all device state after a failure; fresh arena, empty ring.
+        Returns the orphaned (unfinished) occupants so the caller can fail
+        their futures — their tokens cannot be trusted."""
+        orphans = [o for o in self._occupants if o is not None and not o.finished]
+        for occ in orphans:
+            occ.finished = True
+        self._occupants = [None] * self.slots
+        self._free = list(range(self.slots))
+        self._ring.clear()
+        self._donated, self._carried = self._init_state()
+        return orphans
+
+    def stats(self) -> dict:
+        """Observability twin of ``generate_cache_stats``: how many distinct
+        (program, operand-shape) signatures this engine dispatched — the
+        acceptance gate asserts <= 2 per (slots, max_len) config — plus
+        lifetime counters."""
+        programs = {name: len(sigs) for name, sigs in self._programs.items()}
+        return {
+            "slots": self.slots,
+            "max_len": self.max_len,
+            "prompt_bucket": self.prompt_bucket,
+            "live": self.live_count(),
+            "free": len(self._free),
+            "inserted": self.inserted,
+            "steps": self.steps,
+            "retired": self.retired,
+            "programs": programs,
+            "program_count": sum(programs.values()),
+        }

@@ -22,14 +22,31 @@ from jax import lax
 
 from .model import Model
 
-__all__ = ["generate", "prepare_inference", "generate_cache_stats"]
+__all__ = [
+    "generate",
+    "prepare_inference",
+    "generate_cache_stats",
+    "last_generate_stats",
+]
 
 # compiled generate() programs kept per Model (serving loops with varying
 # prompt lengths compile per length; this caps host-side executable count).
 # ACCELERATE_GENERATE_CACHE_MAX tunes it for serving deployments whose
 # bucket grid (batch pow-2s × prompt lengths × total-len multiples) is
-# wider than the default.
-_GENERATE_CACHE_MAX = int(os.environ.get("ACCELERATE_GENERATE_CACHE_MAX", "16"))
+# wider than the default. The env var is read when a model's cache is
+# first attached (not at import), so deployments can set it after import
+# without import-order games; this constant is only the fallback default.
+_GENERATE_CACHE_MAX = 16
+
+
+def _generate_cache_max() -> int:
+    raw = os.environ.get("ACCELERATE_GENERATE_CACHE_MAX")
+    if raw is None:
+        return _GENERATE_CACHE_MAX
+    try:
+        return max(1, int(raw))
+    except ValueError:
+        return _GENERATE_CACHE_MAX
 
 # guards the lazy attach of a model's LRU + lock (double-checked below);
 # the per-model lock then guards that model's OrderedDict — concurrent
@@ -47,6 +64,9 @@ def _model_generate_cache(model: Model):
             if lock is None:
                 lock = model._generate_cache_lock = threading.Lock()
             if cache is None:
+                # env read HERE (attach time), so the bound is whatever the
+                # deployment set before its first generate on this model
+                model._generate_cache_max = _generate_cache_max()
                 cache = model._generate_cache = OrderedDict()
     return cache, lock
 
@@ -151,20 +171,24 @@ def generate(
             done0 = jnp.zeros((b,), dtype=bool)
 
             def decode_body(carry, t):
-                cache, logits, key, done = carry
+                cache, logits, key, done, wasted = carry
+                # rows already EOS-frozen still ride the full scan — count
+                # them so the serving bench can quantify what continuous
+                # batching's iteration-level retirement recovers
+                wasted = wasted + jnp.sum(done, dtype=jnp.int32)
                 key, sub = jax.random.split(key)
                 token = sample(logits, sub, temp, p_threshold)
                 if eos_on:
                     token = jnp.where(done, pad_id, token)
                     done = done | (token == eos_id)
                 logits, cache = decode_fn(config, params, cache, token[:, None], t)
-                return (cache, logits, key, done), token
+                return (cache, logits, key, done, wasted), token
 
-            (_, _, _, _), new_tokens = lax.scan(
-                decode_body, (cache, logits, key, done0),
+            (_, _, _, _, wasted), new_tokens = lax.scan(
+                decode_body, (cache, logits, key, done0, jnp.int32(0)),
                 prompt_len + jnp.arange(max_new_tokens),
             )
-            return jnp.concatenate([input_ids, new_tokens.T], axis=1)
+            return jnp.concatenate([input_ids, new_tokens.T], axis=1), wasted
 
         # jit() itself is cheap (tracing happens at first call) and two
         # threads racing here just build equivalent wrappers — last insert
@@ -172,15 +196,20 @@ def generate(
         run = jax.jit(_run)
         with cache_lock:
             jit_cache[cache_key] = run
-            while len(jit_cache) > _GENERATE_CACHE_MAX:
+            cache_max = getattr(model, "_generate_cache_max", _GENERATE_CACHE_MAX)
+            while len(jit_cache) > cache_max:
                 jit_cache.popitem(last=False)
-    return run(
+    out, wasted = run(
         model.params, input_ids, jax.random.key(seed),
         jnp.float32(temperature if temp_on else 1.0),
         jnp.float32(top_p if top_p_on else 1.0),
         jnp.int32(eos_token_id if eos_on else -1),
         jnp.int32(pad_token_id),
     )
+    # device scalar, NOT read back here — materialized lazily by
+    # last_generate_stats() so generate() stays dispatch-only
+    model._last_generate_wasted = wasted
+    return out
 
 
 def generate_cache_stats(model: Model) -> dict:
@@ -190,14 +219,28 @@ def generate_cache_stats(model: Model) -> dict:
     executable count bounded under varied traffic."""
     cache = getattr(model, "_generate_cache", None)
     lock = getattr(model, "_generate_cache_lock", None)
+    cache_max = getattr(model, "_generate_cache_max", _GENERATE_CACHE_MAX)
     if cache is None:
-        return {"size": 0, "max": _GENERATE_CACHE_MAX, "keys": []}
+        return {"size": 0, "max": cache_max, "keys": []}
     if lock is not None:
         with lock:
             keys = list(cache.keys())
     else:
         keys = list(cache.keys())
-    return {"size": len(keys), "max": _GENERATE_CACHE_MAX, "keys": keys}
+    return {"size": len(keys), "max": cache_max, "keys": keys}
+
+
+def last_generate_stats(model: Model) -> dict:
+    """Early-exit telemetry for the most recent ``generate()`` on this
+    model: ``wasted_decode_steps`` counts (row, step) pairs where the row
+    was already EOS-frozen but the fused scan still ran its decode compute.
+    The counter lives on device until this accessor reads it back, so the
+    generate hot path never blocks; static mode behavior is unchanged —
+    this only measures what ``mode="continuous"`` recovers."""
+    wasted = getattr(model, "_last_generate_wasted", None)
+    if wasted is None:
+        return {"wasted_decode_steps": 0}
+    return {"wasted_decode_steps": int(wasted)}
 
 
 def prepare_inference(model: Model, mesh=None, rules=None) -> Model:

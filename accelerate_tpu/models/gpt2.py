@@ -28,7 +28,13 @@ from ..model import Model
 from ..ops.attention import dispatch_attention
 from ..parallel.sharding import constrain_activation, replicate_over_fsdp
 from .bert import _apply_dense, _dense, layer_norm
-from .llama import _ce_from_hidden, _remat_policy, llama_ce_denominator, llama_loss
+from .llama import (
+    _ce_from_hidden,
+    _remat_policy,
+    _write_kv_at,
+    llama_ce_denominator,
+    llama_loss,
+)
 
 __all__ = [
     "GPT2Config",
@@ -326,9 +332,9 @@ def gpt2_pipeline_parts(config: GPT2Config, attention_fn=None):
 
 
 # ------------------------------------------------------------ generation
-def gpt2_prefill(config: GPT2Config, params, input_ids, max_len: int):
-    """One full forward over the prompt → (last-position logits (B, V),
-    KV cache padded to ``max_len``). Same contract as llama_prefill."""
+def _gpt2_prefill_stack(config: GPT2Config, params, input_ids, max_len: int):
+    """Shared prefill layer stack → (pre-ln_f hidden (B, S, D), cache
+    padded to ``max_len``)."""
     cdt = config.compute_dtype
     b, s = input_ids.shape
     if max_len > config.max_position_embeddings:
@@ -347,18 +353,41 @@ def gpt2_prefill(config: GPT2Config, params, input_ids, max_len: int):
         return x, (k, v)
 
     x, (ks, vs) = lax.scan(body, x, params["layers"])  # (L, B, S, h, hd)
-    x = layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"], config.layer_norm_eps)
-    logits = x @ params["wte"]["embedding"].astype(cdt).T
     pad = max_len - s
     cache = {
         "k": jnp.pad(ks, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0))),
         "v": jnp.pad(vs, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0))),
     }
-    return logits[:, -1].astype(jnp.float32), cache
+    return x, cache
+
+
+def _gpt2_head(config: GPT2Config, params, x):
+    """Final layer norm + tied LM head on (B, D) rows → f32 (B, V)."""
+    cdt = config.compute_dtype
+    x = layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"], config.layer_norm_eps)
+    return (x @ params["wte"]["embedding"].astype(cdt).T).astype(jnp.float32)
+
+
+def gpt2_prefill(config: GPT2Config, params, input_ids, max_len: int):
+    """One full forward over the prompt → (last-position logits (B, V),
+    KV cache padded to ``max_len``). Same contract as llama_prefill."""
+    x, cache = _gpt2_prefill_stack(config, params, input_ids, max_len)
+    return _gpt2_head(config, params, x[:, -1]), cache
+
+
+def gpt2_prefill_at(config: GPT2Config, params, input_ids, max_len: int, last_index):
+    """Prefill a RIGHT-padded prompt batch with logits at per-row
+    ``last_index`` (B,) — same contract as :func:`~.llama.llama_prefill_at`."""
+    x, cache = _gpt2_prefill_stack(config, params, input_ids, max_len)
+    x_last = x[jnp.arange(x.shape[0]), last_index]
+    return _gpt2_head(config, params, x_last), cache
 
 
 def _gpt2_decode_layer(config: GPT2Config, lp, x, cache_k, cache_v, pos):
-    """One block, one new position; updates the (B, max_len, h, hd) caches."""
+    """One block, one new position; updates the (B, max_len, h, hd) caches.
+    ``pos`` is a traced scalar (lockstep batch) or (B,) vector (per-row
+    positions — continuous-batching slots), same contract as llama's
+    ``_decode_layer``."""
     cdt = config.compute_dtype
     b, s, d = x.shape  # s == 1
     h, hd = config.num_attention_heads, config.head_dim
@@ -367,13 +396,14 @@ def _gpt2_decode_layer(config: GPT2Config, lp, x, cache_k, cache_v, pos):
     q = _apply_dense(lp["attn"]["c_attn_q"], y, cdt).reshape(b, s, h, hd)
     k = _apply_dense(lp["attn"]["c_attn_k"], y, cdt).reshape(b, s, h, hd)
     v = _apply_dense(lp["attn"]["c_attn_v"], y, cdt).reshape(b, s, h, hd)
-    cache_k = lax.dynamic_update_slice(cache_k, k.astype(cache_k.dtype), (0, pos, 0, 0))
-    cache_v = lax.dynamic_update_slice(cache_v, v.astype(cache_v.dtype), (0, pos, 0, 0))
+    cache_k = _write_kv_at(cache_k, k, pos)
+    cache_v = _write_kv_at(cache_v, v, pos)
     scores = jnp.einsum(
         "bqhd,bkhd->bhqk", q * (1.0 / np.sqrt(hd)), cache_k.astype(cdt)
     ).astype(jnp.float32)
     k_pos = lax.broadcasted_iota(jnp.int32, scores.shape, 3)
-    scores = jnp.where(k_pos <= pos, scores, -1e6)
+    pos_b = pos if jnp.ndim(pos) == 0 else pos[:, None, None, None]
+    scores = jnp.where(k_pos <= pos_b, scores, -1e6)
     weights = jax.nn.softmax(scores, axis=-1)
     attn = jnp.einsum("bhqk,bkhd->bqhd", weights.astype(cdt), cache_v.astype(cdt))
     attn = _apply_dense(lp["attn"]["c_proj"], attn.reshape(b, s, d), cdt)
@@ -386,11 +416,16 @@ def _gpt2_decode_layer(config: GPT2Config, lp, x, cache_k, cache_v, pos):
 
 
 def gpt2_decode_step(config: GPT2Config, params, cache, token, pos):
-    """One decode step: token (B, 1) at traced position ``pos`` →
-    (logits (B, V), new cache). Same contract as llama_decode_step."""
+    """One decode step: token (B, 1) at traced position ``pos`` (scalar, or
+    (B,) per-row positions for continuous-batching slots) → (logits (B, V),
+    new cache). Same contract as llama_decode_step."""
     cdt = config.compute_dtype
     x = params["wte"]["embedding"].astype(cdt)[token]
-    x = x + jnp.take(params["wpe"]["embedding"].astype(cdt), pos, axis=0)[None, None]
+    wpe = params["wpe"]["embedding"].astype(cdt)
+    if jnp.ndim(pos) == 0:
+        x = x + jnp.take(wpe, pos, axis=0)[None, None]
+    else:
+        x = x + jnp.take(wpe, pos, axis=0)[:, None]
 
     def body(x, inputs):
         lp, ck, cv = inputs

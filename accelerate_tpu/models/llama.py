@@ -1183,9 +1183,12 @@ def init_kv_cache(config: LlamaConfig, batch_size: int, max_len: int, dtype=None
 def _decode_layer(config: LlamaConfig, layer_params, x, cache_k, cache_v, pos,
                   sliding=None):
     """One block, one new position; returns updated (cache_k, cache_v).
-    ``sliding``: None = uniform config.sliding_window behavior; a traced
-    bool applies the window only when true (Gemma-2 alternating layers —
-    the flag rides the decode scan as a per-layer xs array)."""
+    ``pos`` is a traced scalar (whole batch at one position — the fused
+    generate scan) or a traced (B,) vector (per-row positions — the
+    continuous-batching engine's slot decode). ``sliding``: None = uniform
+    config.sliding_window behavior; a traced bool applies the window only
+    when true (Gemma-2 alternating layers — the flag rides the decode scan
+    as a per-layer xs array)."""
     h, kvh, hd = config.num_attention_heads, config.num_key_value_heads, config.head_dim
     b, s, d = x.shape  # s == 1
     cdt = config.compute_dtype
@@ -1204,25 +1207,29 @@ def _decode_layer(config: LlamaConfig, layer_params, x, cache_k, cache_v, pos,
     v = _dproj("v_proj").reshape(b, s, kvh, hd)
     q = apply_rope_at(q, pos, config.rope_theta, config._rope_scaling_key())
     k = apply_rope_at(k, pos, config.rope_theta, config._rope_scaling_key())
-    cache_k = lax.dynamic_update_slice(cache_k, k.astype(cache_k.dtype), (0, pos, 0, 0))
-    cache_v = lax.dynamic_update_slice(cache_v, v.astype(cache_v.dtype), (0, pos, 0, 0))
-    # attend over positions 0..pos (mask the tail)
-    kk = repeat_kv_cache(cache_k, h // kvh)
-    vv = repeat_kv_cache(cache_v, h // kvh)
+    cache_k = _write_kv_at(cache_k, k, pos)
+    cache_v = _write_kv_at(cache_v, v, pos)
+    # attend over positions 0..pos (mask the tail). GQA attends GROUPED: q is
+    # reshaped (B, 1, Hkv, n_rep, hd) and each kv head broadcasts over its
+    # n_rep query heads inside the einsum — the cache is never physically
+    # tiled n_rep×, so decode reads Hkv heads of KV, not H.
+    n_rep = h // kvh
     attn_scale = 1.0 / np.sqrt(config.query_pre_attn_scalar or hd)
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q * attn_scale, kk.astype(cdt)).astype(
+    qg = (q * attn_scale).reshape(b, s, kvh, n_rep, hd)
+    scores = jnp.einsum("bqgrd,bkgd->bgrqk", qg, cache_k.astype(cdt)).astype(
         jnp.float32
     )
     scores = _tanh_softcap(scores, config.attn_logit_softcap)  # pre-mask
-    k_pos = lax.broadcasted_iota(jnp.int32, scores.shape, 3)
-    scores = jnp.where(k_pos <= pos, scores, -1e6)
+    k_pos = lax.broadcasted_iota(jnp.int32, scores.shape, 4)
+    pos_b = pos if jnp.ndim(pos) == 0 else pos[:, None, None, None, None]
+    scores = jnp.where(k_pos <= pos_b, scores, -1e6)
     if config.sliding_window is not None:
-        in_window = pos - k_pos < config.sliding_window
+        in_window = pos_b - k_pos < config.sliding_window
         if sliding is not None:  # per-layer alternating flag (traced)
             in_window = jnp.logical_or(jnp.logical_not(sliding), in_window)
         scores = jnp.where(in_window, scores, -1e6)
     weights = jax.nn.softmax(scores, axis=-1)
-    attn = jnp.einsum("bhqk,bkhd->bqhd", weights.astype(cdt), vv.astype(cdt))
+    attn = jnp.einsum("bgrqk,bkgd->bqgrd", weights.astype(cdt), cache_v.astype(cdt))
     attn = attn.reshape(b, s, h * hd) @ layer_params["attn"]["o_proj"]["kernel"].astype(cdt)
     if config.post_block_norms:
         attn = rms_norm(attn, layer_params["attn_out_norm"]["scale"],
@@ -1256,31 +1263,55 @@ def _decode_layer(config: LlamaConfig, layer_params, x, cache_k, cache_v, pos,
 
 
 def repeat_kv_cache(c, n_rep):
+    """Physically tile a (B, S, Hkv, D) cache n_rep× over the head dim.
+
+    The decode/prefill hot paths no longer call this — attention broadcasts
+    over the GQA group dim inside the einsum instead of materializing
+    n_rep× the KV bytes — but it stays as the reference semantics the
+    grouped path is bit-checked against (tests/test_llama.py)."""
     if n_rep == 1:
         return c
     b, s, h, d = c.shape
     return jnp.broadcast_to(c[:, :, :, None, :], (b, s, h, n_rep, d)).reshape(b, s, h * n_rep, d)
 
 
+def _write_kv_at(cache, kv, pos):
+    """Write one new position's K (or V) rows into a (B, max_len, H, D)
+    cache. Scalar ``pos`` writes every row at the same position (the fused
+    generate scan); a (B,) ``pos`` scatters each row at its own position
+    (continuous-batching slots, each mid-way through its own sequence)."""
+    kv = kv.astype(cache.dtype)
+    if jnp.ndim(pos) == 0:
+        return lax.dynamic_update_slice(cache, kv, (0, pos, 0, 0))
+    return jax.vmap(
+        lambda c, n, p: lax.dynamic_update_slice(c, n, (p, 0, 0))
+    )(cache, kv, pos)
+
+
 def apply_rope_at(x, pos, theta, scaling=None):
-    """RoPE for a single traced position ``pos`` (decode step)."""
+    """RoPE for a traced decode position: scalar ``pos`` rotates the whole
+    batch at one position; a (B,) ``pos`` rotates each row at its own
+    (continuous-batching slots)."""
     b, s, h, d = x.shape
     freqs = jnp.asarray(_rope_freqs(d, theta, scaling), dtype=jnp.float32)
-    angles = pos.astype(jnp.float32) * freqs  # (d/2,)
-    cos = jnp.cos(angles)[None, None, None, :]
-    sin = jnp.sin(angles)[None, None, None, :]
+    if jnp.ndim(pos) == 0:
+        angles = pos.astype(jnp.float32) * freqs  # (d/2,)
+        cos = jnp.cos(angles)[None, None, None, :]
+        sin = jnp.sin(angles)[None, None, None, :]
+    else:
+        angles = pos.astype(jnp.float32)[:, None] * freqs[None, :]  # (B, d/2)
+        cos = jnp.cos(angles)[:, None, None, :]
+        sin = jnp.sin(angles)[:, None, None, :]
     x1, x2 = x[..., 0::2], x[..., 1::2]
     y1 = x1 * cos - x2 * sin
     y2 = x2 * cos + x1 * sin
     return jnp.stack([y1, y2], axis=-1).reshape(b, s, h, d).astype(x.dtype)
 
 
-def llama_prefill(config: LlamaConfig, params, input_ids, max_len: int):
-    """Full-forward prefill: one pass over the prompt (vs token-by-token
-    decode), returning (last-position logits (B, V), filled KV cache sized
-    ``max_len``)."""
+def _prefill_stack(config: LlamaConfig, params, input_ids):
+    """Shared prefill layer stack: one full forward over the prompt →
+    (pre-final-norm hidden (B, S, D), stacked K (L, B, S, kvh, hd), V)."""
     cdt = config.compute_dtype
-    b, s = input_ids.shape
     x = params["embed_tokens"]["embedding"].astype(cdt)[input_ids]
     if config.scale_embeddings:
         x = x * jnp.asarray(config.hidden_size**0.5, dtype=cdt)
@@ -1306,23 +1337,56 @@ def llama_prefill(config: LlamaConfig, params, input_ids, max_len: int):
             return x, (k, v)
 
         x, (ks, vs) = lax.scan(body, x, params["layers"])  # ks: (L, B, S, kvh, hd)
+    return x, ks, vs
+
+
+def _prefill_head(config: LlamaConfig, params, x):
+    """Final norm + LM head on gathered hidden rows (B, D) → f32 (B, V)."""
+    cdt = config.compute_dtype
     x = rms_norm(x, params["final_norm"]["scale"], config.rms_norm_eps, config.rms_norm_offset)
     if config.tie_word_embeddings:
         logits = x @ params["embed_tokens"]["embedding"].astype(cdt).T
     else:
         logits = x @ params["lm_head"]["kernel"].astype(cdt)
-    logits = _tanh_softcap(logits, config.final_logit_softcap)
+    return _tanh_softcap(logits, config.final_logit_softcap).astype(jnp.float32)
+
+
+def _pad_prefill_cache(ks, vs, max_len: int):
+    s = ks.shape[2]
     pad = max_len - s
-    cache = {
+    return {
         "k": jnp.pad(ks, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0))),
         "v": jnp.pad(vs, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0))),
     }
-    return logits[:, -1].astype(jnp.float32), cache
+
+
+def llama_prefill(config: LlamaConfig, params, input_ids, max_len: int):
+    """Full-forward prefill: one pass over the prompt (vs token-by-token
+    decode), returning (last-position logits (B, V), filled KV cache sized
+    ``max_len``)."""
+    x, ks, vs = _prefill_stack(config, params, input_ids)
+    return _prefill_head(config, params, x[:, -1]), _pad_prefill_cache(ks, vs, max_len)
+
+
+def llama_prefill_at(config: LlamaConfig, params, input_ids, max_len: int, last_index):
+    """Prefill a RIGHT-padded prompt batch: same full forward as
+    :func:`llama_prefill`, but logits are taken at per-row ``last_index``
+    (B,) — the last REAL prompt position — instead of position -1. Padding
+    rows beyond ``last_index`` still write (garbage) KV, which is safe
+    because decode masks ``k_pos <= pos`` and overwrites each position
+    before it ever becomes attendable. The LM head runs only on the B
+    gathered rows, not the full (B, S, V) logits."""
+    x, ks, vs = _prefill_stack(config, params, input_ids)
+    b = x.shape[0]
+    x_last = x[jnp.arange(b), last_index]
+    return _prefill_head(config, params, x_last), _pad_prefill_cache(ks, vs, max_len)
 
 
 def llama_decode_step(config: LlamaConfig, params, cache, token, pos):
-    """One greedy-decode step: token (B, 1) at position ``pos`` (traced
-    scalar). Returns (logits (B, V), new cache)."""
+    """One decode step: token (B, 1) at position ``pos`` — a traced scalar
+    (whole batch in lockstep, the fused generate scan) or a traced (B,)
+    vector (each row at its own position — continuous-batching slots).
+    Returns (logits (B, V), new cache)."""
     cdt = config.compute_dtype
     x = params["embed_tokens"]["embedding"].astype(cdt)[token]
     if config.scale_embeddings:

@@ -90,31 +90,40 @@ def dot_product_attention(
     to future keys. There is no symmetric/two-sided window mode; pass a
     ``bias`` for bidirectional locality patterns."""
     b, sq, h, d = q.shape
-    n_rep = h // k.shape[2]
-    k = repeat_kv(k, n_rep)
-    v = repeat_kv(v, n_rep)
+    h_kv = k.shape[2]
+    n_rep = h // h_kv
+    sk = k.shape[1]
     scale = 1.0 / math.sqrt(d)
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(softmax_dtype) * scale
+    # GQA attends grouped: q reshaped (b, sq, h_kv, n_rep, d) so each kv
+    # head broadcasts over its n_rep query heads INSIDE the einsum — K/V are
+    # never physically tiled n_rep× (an n_rep× KV bandwidth/memory saving,
+    # same trick as the flash kernel's head-index mapping). n_rep == 1
+    # degenerates to plain MHA with a size-1 group dim.
+    qg = q.reshape(b, sq, h_kv, n_rep, d)
+    scores = jnp.einsum("bqgrd,bkgd->bgrqk", qg, k).astype(softmax_dtype) * scale
     scores = tanh_softcap(scores, softcap)
     if causal:
-        mask = _causal_mask_bias(sq, k.shape[1], q_offset=q_offset - kv_offset, dtype=softmax_dtype)
-        scores = scores + mask[None, None, :, :]
+        mask = _causal_mask_bias(sq, sk, q_offset=q_offset - kv_offset, dtype=softmax_dtype)
+        scores = scores + mask[None, None, None, :, :]
     if bias is not None:
+        # callers pass bias broadcastable against (b, h, sq, sk); regroup the
+        # head dim to match the (b, h_kv, n_rep, sq, sk) grouped scores
+        bias = jnp.broadcast_to(bias, (b, h, sq, sk)).reshape(b, h_kv, n_rep, sq, sk)
         scores = scores + bias
     if segment_ids is not None:
         same = segment_ids[:, :, None] == segment_ids[:, None, :]  # (b, sq, sk)
-        scores = jnp.where(same[:, None], scores, NEG_INF)
+        scores = jnp.where(same[:, None, None], scores, NEG_INF)
     if window is not None:
         # Mistral convention 0 <= q_pos - k_pos < window: the lower bound
         # applies even when causal=False, so windowed queries never see
         # future keys (flash/blockwise enforce the same).
         q_pos = jnp.arange(sq)[:, None] + q_offset
-        k_pos = jnp.arange(k.shape[1])[None, :] + kv_offset
+        k_pos = jnp.arange(sk)[None, :] + kv_offset
         diff = q_pos - k_pos
-        scores = jnp.where(((diff >= 0) & (diff < window))[None, None], scores, NEG_INF)
+        scores = jnp.where(((diff >= 0) & (diff < window))[None, None, None], scores, NEG_INF)
     weights = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bhqk,bkhd->bqhd", weights.astype(v.dtype), v)
-    return out
+    out = jnp.einsum("bgrqk,bkgd->bqgrd", weights.astype(v.dtype), v)
+    return out.reshape(b, sq, h, d)
 
 
 def _shard_map_over_batch_heads(fn, q, k):

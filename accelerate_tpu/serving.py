@@ -37,6 +37,15 @@ Every lifecycle moment has a named :func:`~accelerate_tpu.utils.fault
 ``serving_after_batch``, ``serving_before_reply``) so the test suite can
 prove each failure mode, and queue depth / latency percentiles / shed-
 timeout-retry-breaker counters flow through ``GeneralTracker.log_batch``.
+
+Two scheduling modes (``ServingConfig.mode``, docs/serving.md):
+``"static"`` (default, everything above) batches whole ``generate()``
+calls at admission time; ``"continuous"`` replaces admission-time batching
+with iteration-level scheduling over a slot-based KV arena
+(:mod:`accelerate_tpu.engine`) — requests join and leave the running
+decode batch every step, so mixed lengths/budgets/seeds stop fragmenting
+batches and EOS'd rows stop burning decode steps. All robustness
+semantics above apply to both modes.
 """
 
 from __future__ import annotations
@@ -126,6 +135,10 @@ class ServingResult:
     latency_s: float
     batch_size: int  # real occupancy (before row padding)
     degraded: bool  # token budget was clamped by the pressure ladder
+    # time-to-first-token. Static mode materializes the whole batch at once,
+    # so TTFT == latency there; continuous mode records the host clock when
+    # the slot's first token popped out of the deferred-readback ring.
+    ttft_s: Optional[float] = None
 
 
 # -------------------------------------------------------------------- metrics
@@ -149,6 +162,10 @@ class ServingMetrics:
         "batches",
         "breaker_opens",
         "degraded",
+        # continuous mode (ServingConfig.mode="continuous") only:
+        "engine_inserts",  # requests admitted into arena slots
+        "engine_steps",  # fused decode steps dispatched
+        "engine_retired",  # occupants retired (EOS / budget / cancel)
     )
 
     def __init__(self):
@@ -268,6 +285,12 @@ class InferenceServer:
         batches every ``config.metrics_interval_s`` (and once at drain).
     clock:
         Monotonic time source (injectable for deterministic tests).
+    engine:
+        Continuous mode only: inject a pre-built
+        :class:`~accelerate_tpu.engine.ContinuousBatchingEngine` (tests);
+        ``None`` builds one from the ``engine_*`` config knobs. In
+        continuous mode ``generate_fn`` is inert — the engine owns the
+        device programs.
     """
 
     def __init__(
@@ -278,12 +301,28 @@ class InferenceServer:
         generate_fn: Optional[Callable[..., Any]] = None,
         trackers: Sequence = (),
         clock: Callable[[], float] = time.monotonic,
+        engine=None,
     ):
         self.model = model
         self.config = config or ServingConfig()
         self.trackers = list(trackers)
         self._clock = clock
         self._generate_fn = generate_fn or self._default_generate
+        self._engine = None
+        if self.config.mode == "continuous":
+            if engine is not None:
+                self._engine = engine
+            else:
+                from .engine import ContinuousBatchingEngine
+
+                self._engine = ContinuousBatchingEngine(
+                    model,
+                    slots=self.config.engine_slots,
+                    max_len=self.config.engine_max_len,
+                    prompt_bucket=self.config.engine_prompt_bucket,
+                    readback_lag=self.config.engine_readback_lag,
+                    clock=clock,
+                )
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
         self._queue: collections.deque[_Request] = collections.deque()
@@ -358,6 +397,13 @@ class InferenceServer:
             ids = ids[0]
         if ids.ndim != 1 or ids.shape[0] == 0:
             raise ValueError(f"input_ids must be a non-empty 1-D prompt, got {ids.shape}")
+        if self._engine is not None:
+            # arena fit is a structural property of the request — reject at
+            # the door (synchronously, like the shape checks above) instead
+            # of parking a Future that can only ever fail
+            self._engine.validate_request(
+                ids.shape[0], max_new_tokens or self.config.default_max_new_tokens
+            )
         now = self._clock()
         if deadline_s is None:
             deadline_s = self.config.default_deadline_s
@@ -467,33 +513,10 @@ class InferenceServer:
     # ----------------------------------------------------------- worker loop
     def _serve_loop(self) -> None:
         try:
-            while True:
-                with self._wake:
-                    while not self._queue and not self._draining:
-                        if preemption_requested():
-                            self._draining = True
-                            break
-                        if self._flush_due():
-                            break  # emit below, after releasing the lock
-                        self._wake.wait(timeout=0.05)
-                    if self._draining or preemption_requested():
-                        self._draining = True
-                        break
-                # flush with the lock released — a slow tracker must never
-                # stall submit() or worker wakeups
-                self._flush_metrics()
-                st = self._breaker.state()
-                if st == _CircuitBreaker.OPEN:
-                    # fail fast is submit()'s job; here just shed requests
-                    # whose deadline will pass before the next probe
-                    self._shed_expired()
-                    time.sleep(min(0.01, max(self._breaker.seconds_until_probe(), 0.001)))
-                    continue
-                batch = self._collect_batch(
-                    probe=(st == _CircuitBreaker.HALF_OPEN)
-                )
-                if batch:
-                    self._execute(batch)
+            if self._engine is not None:
+                self._loop_continuous()
+            else:
+                self._loop_static()
         except BaseException as exc:  # noqa: BLE001 — a dead worker must not hang clients
             # stop admission FIRST: nothing consumes the queue anymore, so a
             # later submit() must fail fast instead of parking a Future that
@@ -506,9 +529,277 @@ class InferenceServer:
         finally:
             with self._lock:
                 self._draining = True
+            if self._engine is not None:
+                # normal drain retires everyone, so this is empty; a worker
+                # death mid-flight leaves occupants whose tokens can no
+                # longer be delivered — fail them, never strand them
+                for occ in self._engine.reset():
+                    self._resolve(
+                        occ.tag.future,
+                        exception=BatchExecutionError(
+                            "serving worker exited with this request still "
+                            "in a decode slot"
+                        ),
+                    )
             self._reject_queued()
             self._drained.set()
             self._flush_metrics(force=True)
+
+    def _loop_static(self) -> None:
+        """PR 3 semantics: admission-time dynamic batching of whole
+        ``generate()`` calls."""
+        while True:
+            with self._wake:
+                while not self._queue and not self._draining:
+                    if preemption_requested():
+                        self._draining = True
+                        break
+                    if self._flush_due():
+                        break  # emit below, after releasing the lock
+                    self._wake.wait(timeout=0.05)
+                if self._draining or preemption_requested():
+                    self._draining = True
+                    return
+            # flush with the lock released — a slow tracker must never
+            # stall submit() or worker wakeups
+            self._flush_metrics()
+            st = self._breaker.state()
+            if st == _CircuitBreaker.OPEN:
+                # fail fast is submit()'s job; here just shed requests
+                # whose deadline will pass before the next probe
+                self._shed_expired()
+                time.sleep(min(0.01, max(self._breaker.seconds_until_probe(), 0.001)))
+                continue
+            batch = self._collect_batch(
+                probe=(st == _CircuitBreaker.HALF_OPEN)
+            )
+            if batch:
+                self._execute(batch)
+
+    def _loop_continuous(self) -> None:
+        """Iteration-level scheduler over the slot engine: each pass retires
+        finished slots, admits queued requests into freed slots (interleaved
+        prefill), dispatches one fused decode step, and sheds mid-flight
+        deadline misses. Draining stops admission but keeps stepping until
+        every in-flight slot retires — the continuous analogue of static
+        mode's "finish the in-flight batch"."""
+        eng = self._engine
+        while True:
+            with self._wake:
+                while (
+                    not self._queue
+                    and eng.live_count() == 0
+                    and not self._draining
+                    and not preemption_requested()
+                    and not self._flush_due()
+                ):
+                    self._wake.wait(timeout=0.05)
+                if self._draining or preemption_requested():
+                    self._draining = True
+                    if eng.live_count() == 0:
+                        return  # queued requests rejected by the finally
+            self._flush_metrics()
+            st = self._breaker.state()
+            if st == _CircuitBreaker.OPEN:
+                # engine failures reset the arena, so an open breaker means
+                # no live occupants: shed hopeless queued requests and wait
+                # out the probe window like static mode
+                self._shed_expired()
+                if eng.live_count() == 0:
+                    time.sleep(
+                        min(0.01, max(self._breaker.seconds_until_probe(), 0.001))
+                    )
+                    continue
+            elif not self._draining:
+                self._admit_slots(probe=(st == _CircuitBreaker.HALF_OPEN))
+            self._engine_tick()
+
+    # ------------------------------------------------- continuous scheduling
+    def _estimated_completion_s(self, budget: int) -> float:
+        """Continuous mode: the EWMA tracks per-decode-step time, so a
+        request's completion estimate scales with its token budget."""
+        return self._batch_time_ewma * max(1, budget)
+
+    def _admit_slots(self, probe: bool = False) -> None:
+        """Admit queued requests into free arena slots. Each admission is an
+        interleaved ``prefill_insert`` program; live slots keep their state
+        and simply decode alongside the newcomer on the next step. ``probe``
+        (half-open breaker) admits at most one — risk the minimum."""
+        eng = self._engine
+        limit = 1 if probe else eng.free_slots()
+        admitted = 0
+        while admitted < limit and eng.free_slots() > 0:
+            with self._wake:
+                if not self._queue:
+                    break
+                now = self._clock()
+                req = self._queue.popleft()
+                level = self._degrade_level(len(self._queue) + 1)
+                self.metrics.gauge("queue_depth", len(self._queue))
+            if (
+                req.deadline is not None
+                and now + self._estimated_completion_s(req.max_new_tokens)
+                > req.deadline
+            ):
+                self._shed(req, now)
+                continue
+            # the ladder clamps this request's SLOT budget — the whole point
+            # of iteration-level scheduling is that degradation never
+            # touches anyone else's slot
+            self._clamp_budget(req, level)
+            if req.degraded:
+                self.metrics.bump("degraded")
+            try:
+                fault_point("serving_before_batch")
+                eng.insert(
+                    req.input_ids,
+                    max_new_tokens=req.effective_max_new_tokens,
+                    temperature=req.temperature,
+                    top_k=req.top_k,
+                    top_p=req.top_p,
+                    eos_token_id=req.eos_token_id,
+                    pad_token_id=req.pad_token_id,
+                    seed=req.seed,
+                    tag=req,
+                )
+            except BaseException as exc:  # noqa: BLE001 — classified below
+                if isinstance(exc, (KeyboardInterrupt, SystemExit)):
+                    self._fail_batch([req], exc, "worker interrupted mid-insert")
+                    raise
+                self._engine_failure(exc, also_fail=req)
+                return
+            self.metrics.bump("engine_inserts")
+            admitted += 1
+
+    def _engine_tick(self) -> None:
+        """One fused decode step + deferred-ring poll + retirement replies +
+        mid-flight deadline shed."""
+        eng = self._engine
+        if eng.live_count() == 0:
+            # nothing decoding; flush any stale ring entries (all-cancelled
+            # slots) so they don't pin device arrays
+            self._reply_retired(eng.poll(force=True), 0.0)
+            return
+        try:
+            t0 = self._clock()
+            eng.step()
+            retired = eng.poll()
+            dt = self._clock() - t0
+            fault_point("serving_after_batch")
+        except BaseException as exc:  # noqa: BLE001 — classified below
+            if isinstance(exc, (KeyboardInterrupt, SystemExit)):
+                self._fail_batch(
+                    [o.tag for o in eng.reset()], exc, "worker interrupted mid-step"
+                )
+                raise
+            self._engine_failure(exc)
+            return
+        self.metrics.bump("engine_steps")
+        self._breaker.record_success()
+        self._batch_time_ewma = (
+            dt if self._batch_time_ewma == 0.0
+            else 0.8 * self._batch_time_ewma + 0.2 * dt
+        )
+        self._reply_retired(retired, dt)
+        # mid-flight deadline enforcement: a slot that can no longer make
+        # its deadline frees immediately for the next queued request
+        now = self._clock()
+        for occ in eng.occupants():
+            req = occ.tag
+            if req.deadline is not None and now > req.deadline:
+                eng.cancel(occ)
+                self.metrics.bump("engine_retired")
+                if self._resolve(
+                    req.future,
+                    exception=RequestDeadlineExceeded(
+                        f"deadline passed {now - req.deadline:.3f}s ago "
+                        "mid-decode — slot freed for queued traffic"
+                    ),
+                ):
+                    self.metrics.bump("shed_deadline")
+
+    def _reply_retired(self, retired: list, dt: float) -> None:
+        """Resolve futures of occupants the deferred ring just retired.
+        Guarded like static mode's reply epilogue: the tokens exist, so any
+        failure here must fail THESE requests, not strand them."""
+        if not retired:
+            return
+        reqs = [occ.tag for occ in retired]
+        try:
+            fault_point("serving_before_reply")
+            now = self._clock()
+            occupancy = self._engine.live_count() + len(retired)
+            for occ in retired:
+                req = occ.tag
+                self.metrics.bump("engine_retired")
+                if req.deadline is not None and now > req.deadline:
+                    if self._resolve(
+                        req.future,
+                        exception=RequestDeadlineExceeded(
+                            f"decode finished {now - req.deadline:.3f}s past "
+                            "the deadline"
+                        ),
+                    ):
+                        self.metrics.bump("completed_late")
+                    continue
+                latency = now - req.submitted_at
+                ttft = (
+                    occ.first_token_s - req.submitted_at
+                    if occ.first_token_s is not None
+                    else latency
+                )
+                delivered = self._resolve(
+                    req.future,
+                    result=ServingResult(
+                        tokens=occ.output_row(),
+                        latency_s=latency,
+                        batch_size=occupancy,
+                        degraded=req.degraded,
+                        ttft_s=max(0.0, ttft),
+                    ),
+                )
+                if delivered:
+                    self.metrics.bump("completed")
+                    self.metrics.latency.add(latency)
+                    self.metrics.queue_wait.add(
+                        max(0.0, occ.inserted_s - req.submitted_at)
+                    )
+        except BaseException as exc:  # noqa: BLE001 — never strand a retiree
+            self._fail_batch(reqs, exc, "decode finished but the reply failed")
+            if isinstance(exc, (KeyboardInterrupt, SystemExit)):
+                raise
+            logger.exception(
+                "continuous reply epilogue failed; the retired slots' "
+                "outstanding futures were failed with BatchExecutionError"
+            )
+
+    def _engine_failure(self, exc: BaseException, also_fail=None) -> None:
+        """An engine program failed. Device state is donated across programs
+        so a failed dispatch cannot be replayed — the blast radius is every
+        in-flight slot (documented trade-off vs static mode's per-batch
+        retry): fail their futures, rebuild the arena, and let the breaker
+        gate re-admission."""
+        self.metrics.bump("batch_failures")
+        opened = self._breaker.record_failure()
+        if opened:
+            self.metrics.bump("breaker_opens")
+            logger.warning(
+                "circuit breaker OPEN after %d consecutive engine failures "
+                "(last: %s)", self.config.breaker_threshold, exc,
+            )
+        orphans = self._engine.reset()
+        victims = [o.tag for o in orphans]
+        if also_fail is not None:
+            victims.append(also_fail)
+        if victims:
+            self._fail_batch(
+                victims, exc,
+                f"engine program failed; {len(victims)} in-flight slot(s) lost",
+            )
+        logger.warning(
+            "engine failure reset the KV arena (%d in-flight request(s) "
+            "failed): %s: %s", len(victims), type(exc).__name__, exc,
+        )
 
     def _estimated_batch_s(self) -> float:
         return self._batch_time_ewma
@@ -723,6 +1014,7 @@ class InferenceServer:
                         latency_s=latency,
                         batch_size=len(batch),
                         degraded=req.degraded,
+                        ttft_s=latency,  # whole batch materializes at once
                     ),
                 )
                 if delivered:

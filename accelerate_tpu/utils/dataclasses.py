@@ -324,8 +324,29 @@ class ServingConfig(KwargsHandler):
     ``metrics_interval_s`` — when set (and trackers are attached), the
     worker pushes a metrics snapshot through ``GeneralTracker.log_batch``
     at this cadence.
+
+    Scheduling mode: ``mode="static"`` (default) keeps admission-time
+    batching of whole ``generate()`` calls; ``mode="continuous"`` runs the
+    slot-based continuous-batching engine
+    (:class:`accelerate_tpu.engine.ContinuousBatchingEngine`) — the worker
+    becomes an iteration-level scheduler admitting requests into
+    ``engine_slots`` KV-arena slots of ``engine_max_len`` positions each.
+    Prompts must fit ``engine_prompt_bucket`` (default ``engine_max_len //
+    2``) and ``prompt + max_new_tokens <= engine_max_len``;
+    ``engine_readback_lag`` defers done-mask readback that many device
+    programs (0 = synchronous, deterministic scheduling for tests). In
+    continuous mode ``max_batch_size``/``batch_window_s``/``batch_bucket``/
+    ``pad_total_multiple`` are inert (no admission-time batches exist);
+    everything else — deadlines, backpressure, retry/breaker, degradation
+    (clamping the per-slot budget, not the batch), drain — applies
+    unchanged.
     """
 
+    mode: str = "static"
+    engine_slots: int = 8
+    engine_max_len: int = 256
+    engine_prompt_bucket: Optional[int] = None
+    engine_readback_lag: int = 2
     max_queue: int = 256
     max_batch_size: int = 8
     batch_window_s: float = 0.002
@@ -346,6 +367,28 @@ class ServingConfig(KwargsHandler):
     metrics_interval_s: Optional[float] = None
 
     def __post_init__(self):
+        if self.mode not in ("static", "continuous"):
+            raise ValueError(
+                f"mode must be 'static' or 'continuous', got {self.mode!r}"
+            )
+        if self.engine_slots < 1:
+            raise ValueError(f"engine_slots must be >= 1, got {self.engine_slots}")
+        if self.engine_max_len < 2:
+            raise ValueError(
+                f"engine_max_len must be >= 2, got {self.engine_max_len}"
+            )
+        if self.engine_prompt_bucket is not None and not (
+            1 <= self.engine_prompt_bucket <= self.engine_max_len - 1
+        ):
+            raise ValueError(
+                "engine_prompt_bucket must be in [1, engine_max_len-1], got "
+                f"{self.engine_prompt_bucket} (engine_max_len="
+                f"{self.engine_max_len})"
+            )
+        if self.engine_readback_lag < 0:
+            raise ValueError(
+                f"engine_readback_lag must be >= 0, got {self.engine_readback_lag}"
+            )
         if self.max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {self.max_queue}")
         if self.max_batch_size < 1:

@@ -624,6 +624,14 @@ if __name__ == "__main__":
         from benchmarks.serving_bench import main as serving_main
 
         sys.exit(serving_main(gate=True))
+    if "--continuous-gate" in sys.argv:
+        # continuous-batching gate: mixed-length/mixed-budget workload must
+        # reach >= 1.3x static-mode goodput with TTFT p99 no worse, <= 2
+        # compiled engine programs, and greedy output parity
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from benchmarks.continuous_bench import main as continuous_main
+
+        sys.exit(continuous_main(gate=True))
     if "--child" in sys.argv:
         # the actual measurement; parent enforces the wall-clock watchdog
         try:

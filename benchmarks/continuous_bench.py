@@ -1,0 +1,236 @@
+"""Continuous-vs-static serving bench: mixed-length / mixed-budget goodput.
+
+Drives the SAME greedy workload — prompt lengths and token budgets cycling
+out of phase so requests rarely share a static group key, plus an EOS id
+chosen so many requests finish well before their budget — through an
+:class:`~accelerate_tpu.serving.InferenceServer` in both scheduling modes
+against the real compiled path on a tiny llama:
+
+- ``static_cold`` / ``continuous_cold`` — first contact, nothing compiled.
+  Static mode pays one fused prefill+decode compile per (batch, prompt_len,
+  budget) group and then runs every batch to its full budget; continuous
+  mode compiles exactly TWO programs (prefill_insert, decode_step) and
+  retires each slot the moment it hits EOS/budget.
+- ``static_warm`` / ``continuous_warm`` — the same burst again with every
+  program cached: what steady-state fragmentation + wasted decode steps
+  cost on their own.
+
+Reported per phase: tokens/s goodput (non-pad new tokens delivered / wall
+time), TTFT p50/p99, per-output-token latency p50, and for static mode the
+``wasted_decode_steps`` the done-mask telemetry counted (the steps
+continuous mode does not pay).
+
+``--gate`` (also reached via ``bench.py --continuous-gate`` / ``make
+bench-continuous``) enforces the acceptance criteria on the cold phases:
+continuous >= ``CB_GATE_RATIO`` (default 1.3) x static goodput, continuous
+TTFT p99 no worse than static, <= 2 compiled engine programs, and bitwise
+greedy output parity between the modes.
+"""
+
+from __future__ import annotations
+
+import os
+import sys as _sys
+
+_sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))  # runnable as `python benchmarks/x.py`
+
+import collections
+import json
+import time
+
+import numpy as np
+
+N_REQUESTS = int(os.environ.get("CB_N", "24"))
+SLOTS = int(os.environ.get("CB_SLOTS", "8"))
+MAX_LEN = int(os.environ.get("CB_MAX_LEN", "64"))
+PROMPT_BUCKET = int(os.environ.get("CB_PROMPT_BUCKET", "16"))
+GATE_RATIO = float(os.environ.get("CB_GATE_RATIO", "1.3"))
+PROMPT_LENS = (4, 6, 9, 12)
+BUDGETS = (4, 8, 14)  # cycle out of phase with PROMPT_LENS: 12 group keys
+
+
+def _p(values, q):
+    if not values:
+        return None
+    s = sorted(values)
+    return s[min(len(s) - 1, int(q * len(s)))]
+
+
+def _workload():
+    rng = np.random.default_rng(0)
+    reqs = []
+    for i in range(N_REQUESTS):
+        plen = PROMPT_LENS[i % len(PROMPT_LENS)]
+        budget = BUDGETS[i % len(BUDGETS)]
+        reqs.append((rng.integers(1, 255, size=plen).astype(np.int32), budget))
+    return reqs
+
+
+def _pick_eos(model, reqs):
+    """Choose the most frequently emitted token as EOS so early exit is a
+    REAL property of the workload, not a synthetic constant."""
+    import jax.numpy as jnp
+
+    from accelerate_tpu.inference import generate
+
+    probe = np.asarray(
+        generate(
+            model, jnp.asarray([reqs[0][0].tolist()], jnp.int32),
+            max_new_tokens=16, pad_token_id=0,
+        )
+    )[0, len(reqs[0][0]):]
+    counts = collections.Counter(int(t) for t in probe)
+    return counts.most_common(1)[0][0]
+
+
+def _useful_tokens(row, plen, eos):
+    """Non-pad goodput tokens: everything up to and including the first EOS
+    (or the full budget when EOS never fired)."""
+    new = [int(t) for t in row[plen:]]
+    if eos in new:
+        return new[: new.index(eos) + 1]
+    return new
+
+
+def _run_burst(srv, reqs, eos, phase):
+    futures = []
+    t0 = time.perf_counter()
+    for prompt, budget in reqs:
+        futures.append(
+            srv.submit(prompt, max_new_tokens=budget, eos_token_id=eos, pad_token_id=0)
+        )
+    results = [f.result(timeout=600) for f in futures]
+    wall = time.perf_counter() - t0
+    useful, ttfts, tpots, outputs = 0, [], [], []
+    for (prompt, budget), res in zip(reqs, results):
+        toks = _useful_tokens(res.tokens, len(prompt), eos)
+        useful += len(toks)
+        outputs.append(np.asarray(res.tokens))
+        ttft = res.ttft_s if res.ttft_s is not None else res.latency_s
+        ttfts.append(ttft)
+        if len(toks) > 1:
+            tpots.append((res.latency_s - ttft) / (len(toks) - 1))
+    row = {
+        "phase": phase,
+        "requests": len(reqs),
+        "wall_s": round(wall, 3),
+        "useful_tokens": useful,
+        "goodput_tps": round(useful / wall, 2),
+        "ttft_p50_s": round(_p(ttfts, 0.50), 4),
+        "ttft_p99_s": round(_p(ttfts, 0.99), 4),
+        "tpot_p50_s": round(_p(tpots, 0.50), 4) if tpots else None,
+    }
+    return row, outputs
+
+
+def main(gate: bool = False) -> int:
+    # attach-time cache-bound tuning (the PR 4 satellite): the static mode's
+    # mixed workload needs more than the default 16 structural keys
+    os.environ.setdefault("ACCELERATE_GENERATE_CACHE_MAX", "64")
+
+    import jax.numpy as jnp
+
+    from accelerate_tpu.inference import generate_cache_stats, last_generate_stats
+    from accelerate_tpu.models.llama import LlamaConfig, create_llama
+    from accelerate_tpu.serving import InferenceServer
+    from accelerate_tpu.utils.dataclasses import ServingConfig
+
+    model = create_llama(LlamaConfig.tiny(compute_dtype=jnp.float32), seed=0)
+    reqs = _workload()
+    eos = _pick_eos(model, reqs)
+    print(json.dumps({"phase": "setup", "eos_token": eos, "requests": len(reqs)}),
+          flush=True)
+
+    rows = {}
+    wasted = {"static": 0}
+
+    def counting_generate(mdl, ids, **kw):
+        from accelerate_tpu.inference import generate
+
+        out = generate(mdl, ids, **kw)
+        wasted["static"] += last_generate_stats(mdl)["wasted_decode_steps"]
+        return out
+
+    static_cfg = ServingConfig(
+        max_queue=max(64, 2 * N_REQUESTS),
+        max_batch_size=8,
+        batch_window_s=0.005,
+        pad_total_multiple=MAX_LEN,
+        drain_timeout_s=120.0,
+    )
+    static_out = {}
+    with InferenceServer(model, static_cfg, generate_fn=counting_generate) as srv:
+        rows["static_cold"], static_out["cold"] = _run_burst(srv, reqs, eos, "static_cold")
+        rows["static_cold"]["wasted_decode_steps"] = wasted["static"]
+        print(json.dumps(rows["static_cold"]), flush=True)
+        wasted["static"] = 0
+        rows["static_warm"], static_out["warm"] = _run_burst(srv, reqs, eos, "static_warm")
+        rows["static_warm"]["wasted_decode_steps"] = wasted["static"]
+        rows["static_warm"]["compiled_programs"] = generate_cache_stats(model)["size"]
+        print(json.dumps(rows["static_warm"]), flush=True)
+
+    cont_cfg = ServingConfig(
+        mode="continuous",
+        engine_slots=SLOTS,
+        engine_max_len=MAX_LEN,
+        engine_prompt_bucket=PROMPT_BUCKET,
+        engine_readback_lag=2,
+        max_queue=max(64, 2 * N_REQUESTS),
+        drain_timeout_s=120.0,
+    )
+    cont_out = {}
+    with InferenceServer(model, cont_cfg) as srv:
+        rows["continuous_cold"], cont_out["cold"] = _run_burst(
+            srv, reqs, eos, "continuous_cold"
+        )
+        engine_stats = srv._engine.stats()  # noqa: SLF001
+        rows["continuous_cold"]["engine_programs"] = engine_stats["program_count"]
+        print(json.dumps(rows["continuous_cold"]), flush=True)
+        rows["continuous_warm"], cont_out["warm"] = _run_burst(
+            srv, reqs, eos, "continuous_warm"
+        )
+        engine_stats = srv._engine.stats()  # noqa: SLF001
+        rows["continuous_warm"]["engine_programs"] = engine_stats["program_count"]
+        print(json.dumps(rows["continuous_warm"]), flush=True)
+
+    parity = all(
+        np.array_equal(a, b)
+        for a, b in zip(static_out["cold"], cont_out["cold"])
+    ) and all(
+        np.array_equal(a, b)
+        for a, b in zip(static_out["warm"], cont_out["warm"])
+    )
+    ratio_cold = rows["continuous_cold"]["goodput_tps"] / max(
+        rows["static_cold"]["goodput_tps"], 1e-9
+    )
+    ratio_warm = rows["continuous_warm"]["goodput_tps"] / max(
+        rows["static_warm"]["goodput_tps"], 1e-9
+    )
+    checks = {
+        "goodput_ratio": ratio_cold >= GATE_RATIO,
+        "ttft_p99_no_worse": (
+            rows["continuous_cold"]["ttft_p99_s"] <= rows["static_cold"]["ttft_p99_s"]
+        ),
+        "engine_programs_le_2": rows["continuous_warm"]["engine_programs"] <= 2,
+        "greedy_parity": parity,
+    }
+    ok = all(checks.values())
+    print(
+        json.dumps(
+            {
+                "metric": "continuous_batching_gate",
+                "goodput_ratio_cold": round(ratio_cold, 2),
+                "goodput_ratio_warm": round(ratio_warm, 2),
+                "threshold": GATE_RATIO,
+                "static_wasted_decode_steps": rows["static_cold"]["wasted_decode_steps"],
+                "checks": checks,
+                "pass": ok,
+            }
+        ),
+        flush=True,
+    )
+    return 0 if (ok or not gate) else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(gate="--gate" in _sys.argv))

@@ -552,3 +552,88 @@ def test_gemma2_chunked_ce_matches_dense():
         ce_chunk_size=64,
     ))
     np.testing.assert_allclose(chunked, dense, rtol=1e-5)
+
+
+def test_gqa_grouped_attention_bit_parity_with_repeat_kv_cache():
+    """PR 4 rewrote decode attention to broadcast over the GQA group dim
+    instead of physically tiling KV n_rep x (repeat_kv_cache). The grouped
+    einsum must reproduce the tiled reference bit-for-bit, including the
+    head ordering (head j = group j//n_rep, repeat j%n_rep) and the per-row
+    causal mask."""
+    from jax import lax
+
+    from accelerate_tpu.models.llama import repeat_kv_cache
+
+    rng = np.random.default_rng(0)
+    b, s, h, kvh, hd, kl = 2, 1, 8, 2, 16, 12
+    n_rep = h // kvh
+    q = jnp.asarray(rng.normal(size=(b, s, h, hd)), jnp.float32)
+    ck = jnp.asarray(rng.normal(size=(b, kl, kvh, hd)), jnp.float32)
+    cv = jnp.asarray(rng.normal(size=(b, kl, kvh, hd)), jnp.float32)
+    pos = jnp.asarray([5, 9], jnp.int32)
+
+    # reference: the old path — materialize KV n_rep x, then plain MHA
+    rk, rv = repeat_kv_cache(ck, n_rep), repeat_kv_cache(cv, n_rep)
+    ref_scores = jnp.einsum("bqhd,bkhd->bhqk", q, rk).astype(jnp.float32)
+    kp = lax.broadcasted_iota(jnp.int32, ref_scores.shape, 3)
+    ref_scores = jnp.where(kp <= pos[:, None, None, None], ref_scores, -1e6)
+    ref_probs = jax.nn.softmax(ref_scores, axis=-1)
+    ref_out = jnp.einsum(
+        "bhqk,bkhd->bqhd", ref_probs.astype(rv.dtype), rv
+    ).reshape(b, s, h * hd)
+
+    # grouped: the shipped path — no tiling, broadcast over the group dim
+    qg = q.reshape(b, s, kvh, n_rep, hd)
+    scores = jnp.einsum("bqgrd,bkgd->bgrqk", qg, ck).astype(jnp.float32)
+    kp5 = lax.broadcasted_iota(jnp.int32, scores.shape, 4)
+    scores = jnp.where(kp5 <= pos[:, None, None, None, None], scores, -1e6)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum(
+        "bgrqk,bkgd->bqgrd", probs.astype(cv.dtype), cv
+    ).reshape(b, s, h * hd)
+
+    # (b, g, r, q, k) with g, r adjacent flattens to the reference head
+    # order — scores (the part the GQA rewrite touches: head mapping, mask,
+    # softmax input) must be BIT-exact
+    np.testing.assert_array_equal(
+        np.asarray(scores.reshape(b, h, s, kl)), np.asarray(ref_scores)
+    )
+    # the value contraction accumulates over k in a different loop order
+    # than the tiled reference, so only ULP-level drift is allowed there
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref_out), rtol=1e-6, atol=1e-6
+    )
+
+
+def test_gqa_decode_step_matches_full_forward():
+    """End-to-end check that the grouped-GQA decode path (vector positions,
+    per-row KV writes) reproduces the full forward's logits on a GQA config
+    with rows at DIFFERENT positions — the shape the continuous engine
+    drives."""
+    from accelerate_tpu.models.llama import llama_decode_step, llama_prefill_at
+
+    cfg = LlamaConfig.tiny(compute_dtype=jnp.float32)
+    assert cfg.num_attention_heads != cfg.num_key_value_heads  # really GQA
+    params = init_llama_params(cfg, jax.random.key(0))
+    rng = np.random.default_rng(3)
+    max_len = 24
+    lens = np.array([5, 9])
+    ids = np.zeros((2, 12), np.int32)
+    for i, n in enumerate(lens):
+        ids[i, :n] = rng.integers(1, cfg.vocab_size, size=n)
+
+    logits, cache = llama_prefill_at(
+        cfg, params, jnp.asarray(ids), max_len, jnp.asarray(lens - 1)
+    )
+    # feed each row's argmax back at its own position
+    tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    step_logits, _ = llama_decode_step(
+        cfg, params, cache, tok[:, None], jnp.asarray(lens, jnp.int32)
+    )
+    # reference: full forward over prompt + token, read the last position
+    for i, n in enumerate(lens):
+        row = np.concatenate([ids[i, :n], np.asarray(tok)[i : i + 1]])
+        full = llama_apply(cfg, params, jnp.asarray(row[None]))
+        np.testing.assert_allclose(
+            np.asarray(step_logits)[i], np.asarray(full)[0, -1], rtol=2e-5, atol=2e-5
+        )
