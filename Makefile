@@ -9,17 +9,18 @@
 PY := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu python
 PY_SLOW := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu RUN_SLOW=1 python
 
-.PHONY: test test_all test_core test_data test_parallel test_models test_cli test_big_modeling test-fault test-serving bench bench-telemetry bench-serving bench-continuous
+.PHONY: test test_all test_core test_data test_parallel test_models test_cli test_big_modeling test-fault test-serving bench bench-telemetry bench-serving bench-continuous bench-recovery
 
 test:
 	$(PY) -m pytest tests/ -q
 
 # durable-checkpointing suite (docs/fault_tolerance.md): atomic commit,
 # kill-mid-save rollback via ACCELERATE_TPU_FAULT_INJECT, preemption,
-# health watchdog, supervisor backoff/crash-loop — fast, on 8 virtual
-# CPU devices (XLA_FLAGS comes from tests/conftest.py)
+# health watchdog, supervisor backoff/crash-loop, plus the elastic layer —
+# replication kill points, consensus, replica restore, topology-change
+# resume — fast, on 8 virtual CPU devices (XLA_FLAGS from tests/conftest.py)
 test-fault:
-	$(PY) -m pytest tests/test_durability.py tests/test_checkpointing.py tests/test_serving.py -q
+	$(PY) -m pytest tests/test_durability.py tests/test_checkpointing.py tests/test_serving.py tests/test_elastic.py -q
 
 # resilient-serving suite (docs/serving.md): dynamic batching, deadline
 # shedding, backpressure, retry/backoff, circuit breaker, SIGTERM drain,
@@ -72,3 +73,9 @@ bench-serving:
 # two compiled engine programs, bitwise output parity (docs/serving.md)
 bench-continuous:
 	$(PY) benchmarks/continuous_bench.py --gate
+
+# elastic-recovery gate: MTTR per restore path (local / replica / elastic
+# reshard, restart-to-resumed wall clock) + consensus/replication must stay
+# within 5% of replication-off steps/s (docs/fault_tolerance.md)
+bench-recovery:
+	$(PY) benchmarks/recovery_bench.py --gate

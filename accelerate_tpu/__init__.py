@@ -72,6 +72,14 @@ _LAZY = {
     "CheckpointUncommittedError": ("utils.fault", "CheckpointUncommittedError"),
     "CheckpointCorruptError": ("utils.fault", "CheckpointCorruptError"),
     "CheckpointComponentMissingError": ("utils.fault", "CheckpointComponentMissingError"),
+    "CheckpointDivergedError": ("utils.fault", "CheckpointDivergedError"),
+    "CheckpointTopologyError": ("utils.fault", "CheckpointTopologyError"),
+    "ReplicaUnavailableError": ("utils.fault", "ReplicaUnavailableError"),
+    "ReplicationConfig": ("utils.dataclasses", "ReplicationConfig"),
+    "CheckpointReplicator": ("elastic", "CheckpointReplicator"),
+    "resolve_consensus_checkpoint": ("elastic", "resolve_consensus_checkpoint"),
+    "restore_from_replica": ("elastic", "restore_from_replica"),
+    "remap_sampler_state": ("elastic", "remap_sampler_state"),
     "TrainingHealthError": ("utils.fault", "TrainingHealthError"),
     "TrainingHealthConfig": ("utils.dataclasses", "TrainingHealthConfig"),
     "install_preemption_handler": ("utils.fault", "install_preemption_handler"),

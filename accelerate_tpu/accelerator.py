@@ -45,6 +45,7 @@ from .utils.dataclasses import (
     KwargsHandler,
     MixedPrecisionPolicy,
     ProjectConfiguration,
+    ReplicationConfig,
     TrainingHealthConfig,
 )
 from .utils.fault import TrainingHealthError
@@ -143,6 +144,7 @@ class Accelerator:
         step_scheduler_with_optimizer: bool = True,
         kwargs_handlers: Optional[Sequence[KwargsHandler]] = None,
         health_config: Optional[TrainingHealthConfig] = None,
+        replication_config: Optional[ReplicationConfig] = None,
         async_logging: bool = False,
     ):
         if project_config is not None:
@@ -169,6 +171,8 @@ class Accelerator:
                 gradient_accumulation_plugin = handler
             elif isinstance(handler, TrainingHealthConfig) and health_config is None:
                 health_config = handler
+            elif isinstance(handler, ReplicationConfig) and replication_config is None:
+                replication_config = handler
 
         self.dataloader_config = dataloader_config or DataLoaderConfiguration()
         if fsdp_plugin is None and os.environ.get("ACCELERATE_USE_FSDP", "") == "true":
@@ -233,6 +237,21 @@ class Accelerator:
 
         self.async_logging = async_logging or _flag("ACCELERATE_ASYNC_LOGGING")
         self._tracker_flusher = None
+
+        # checkpoint replication (docs/fault_tolerance.md "Replication &
+        # elastic resume"): every committed checkpoint is mirrored to
+        # durable storage by a bounded background replicator; the env path
+        # lets `accelerate-tpu launch` arm it fleet-wide without code edits
+        if replication_config is None:
+            _target = os.environ.get("ACCELERATE_REPLICATION_TARGET")
+            if _target:
+                replication_config = ReplicationConfig(
+                    target=_target,
+                    copies=int(os.environ.get("ACCELERATE_REPLICATION_COPIES", "1")),
+                    async_replicate=not _flag("ACCELERATE_REPLICATION_SYNC"),
+                )
+        self.replication_config = replication_config
+        self._replicator = None
 
         self.mesh = self.state.get_device_mesh()
 
@@ -779,7 +798,9 @@ class Accelerator:
             except OSError:
                 pass
 
-    def resume_from_latest(self, input_dir: Optional[str] = None) -> bool:
+    def resume_from_latest(
+        self, input_dir: Optional[str] = None, elastic: Optional[bool] = None
+    ) -> bool:
         """Auto-resume glue for the fault-tolerant launcher: load the latest
         checkpoint under ``project_dir`` (or ``input_dir``) if one exists.
         Returns True when state was restored, False when there is nothing to
@@ -789,9 +810,58 @@ class Accelerator:
         exact mid-epoch position automatically (their state rides
         ``save_state``); ``skip_first_batches`` is only for loaders the
         Accelerator does not manage — do not apply it on top of a restored
-        prepared loader, that would skip twice."""
+        prepared loader, that would skip twice.
+
+        Elastic recovery (docs/fault_tolerance.md "Replication & elastic
+        resume"): multi-process resumes go through **cluster consensus** —
+        every host all-gathers its newest committed (index, manifest digest)
+        and the gang loads the highest index committed on all hosts
+        (:class:`~accelerate_tpu.utils.fault.CheckpointDivergedError` on
+        content disagreement). A host missing the consensus checkpoint
+        fetches it from the configured replica target. ``elastic=True``
+        (default from ``ACCELERATE_ELASTIC``, exported by ``accelerate-tpu
+        launch --elastic``) additionally permits resuming a checkpoint saved
+        on a DIFFERENT world size, resharding onto the live mesh."""
+        if elastic is None:
+            from .utils.environment import parse_flag_from_env
+
+            elastic = parse_flag_from_env("ACCELERATE_ELASTIC")
+        load_kwargs = {"elastic": True} if elastic else {}
+        pc = self.project_configuration
         try:
-            self.load_state(input_dir)
+            if input_dir is None and self.num_processes > 1 and pc.project_dir:
+                from . import elastic as _elastic
+
+                base = os.path.join(pc.project_dir, "checkpoints")
+                consensus = _elastic.resolve_consensus_checkpoint(base)
+                if consensus is None:
+                    # no host has anything locally: first launch, unless a
+                    # replica set exists (every local disk was lost)
+                    if self.replication_config is None:
+                        return False
+                    path = _elastic.ensure_local_checkpoint(
+                        self.replication_config, base
+                    )
+                elif consensus.local_path is None:
+                    if self.replication_config is None:
+                        from .utils.fault import ReplicaUnavailableError
+
+                        raise ReplicaUnavailableError(
+                            f"host {self.process_index} does not hold the "
+                            f"consensus checkpoint_{consensus.index} and no "
+                            "ReplicationConfig is active to fetch it"
+                        )
+                    path = _elastic.ensure_local_checkpoint(
+                        self.replication_config,
+                        base,
+                        name=f"checkpoint_{consensus.index}",
+                        expected_digest=consensus.digest,
+                    )
+                else:
+                    path = consensus.local_path
+                self.load_state(path, **load_kwargs)
+            else:
+                self.load_state(input_dir, **load_kwargs)
         except FileNotFoundError:
             return False
         pc = self.project_configuration
@@ -1588,12 +1658,12 @@ class Accelerator:
         return result
 
     def load_state(self, input_dir: Optional[str] = None, **load_kwargs) -> None:
-        from .checkpointing import _resolve_dir, load_accelerator_state, wait_for_async_saves
+        from .checkpointing import _resolve_for_load, load_accelerator_state, wait_for_async_saves
 
         # join (and commit) any in-flight async save first, so latest-committed
         # resolution below can see it
         wait_for_async_saves()
-        input_dir = _resolve_dir(self, input_dir, for_save=False)
+        input_dir = _resolve_for_load(self, input_dir)
         for hook in self._load_state_pre_hooks:
             hook(self._models, input_dir)
         self._touch_heartbeat()
@@ -1606,6 +1676,34 @@ class Accelerator:
         from .checkpointing import wait_for_async_saves
 
         wait_for_async_saves()
+
+    # ------------------------------------------------------------ replication
+    def _get_replicator(self):
+        if self.replication_config is None:
+            return None
+        if self._replicator is None:
+            from .elastic import CheckpointReplicator
+
+            self._replicator = CheckpointReplicator(self.replication_config)
+        return self._replicator
+
+    def _submit_replication(self, committed_dir: str) -> None:
+        """Post-commit hook (called by ``checkpointing._commit_staged`` on
+        the main process): hand the durable checkpoint to the background
+        replicator. With ``async_replicate=False`` the mirror runs inline
+        and failures raise out of ``save_state`` — the checkpoint itself is
+        already committed either way."""
+        if self.replication_config is None or not self.is_main_process:
+            return
+        self._get_replicator().submit(committed_dir)
+
+    def wait_for_replication(self, timeout: Optional[float] = None) -> None:
+        """Drain the background checkpoint replicator: block until every
+        submitted mirror finished, then surface the first deferred mirror
+        error. Called by ``end_training``, the preemption handler, and
+        atexit — the replica set never ends a run half-mirrored silently."""
+        if self._replicator is not None:
+            self._replicator.drain(timeout=timeout)
 
     def install_preemption_handler(self, **kwargs) -> bool:
         """Checkpoint-then-exit on SIGTERM/SIGINT (TPU preemption /
@@ -1825,17 +1923,22 @@ class Accelerator:
 
         wait_for_async_saves()
         try:
-            # pending deferred health verdicts are applied before shutdown —
-            # a tail-step NaN still raises/skips/restores per policy
-            self.health_drain()
+            # the replicator drains AFTER async saves land (their commits are
+            # what feed it); deferred mirror errors surface here, not atexit
+            self.wait_for_replication()
         finally:
             try:
-                if self._tracker_flusher is not None:
-                    flusher, self._tracker_flusher = self._tracker_flusher, None
-                    flusher.close()
+                # pending deferred health verdicts are applied before shutdown —
+                # a tail-step NaN still raises/skips/restores per policy
+                self.health_drain()
             finally:
-                for tracker in self.trackers:
-                    tracker.finish()
+                try:
+                    if self._tracker_flusher is not None:
+                        flusher, self._tracker_flusher = self._tracker_flusher, None
+                        flusher.close()
+                finally:
+                    for tracker in self.trackers:
+                        tracker.finish()
 
     # ------------------------------------------------------------------ misc
     @contextlib.contextmanager

@@ -342,6 +342,13 @@ def _commit_staged(staging: str, final: str, accelerator) -> None:
     state.wait_for_everyone()  # every host's staged writes are on disk
     fault_point("before_commit")
     if state.is_main_process:
+        try:
+            from .elastic import build_topology
+
+            topology = build_topology(accelerator)
+        except Exception as exc:  # topology is advisory; never fail a commit
+            logger.warning(f"could not record checkpoint topology: {exc}")
+            topology = {"num_processes": state.num_processes}
         manifest = {
             "format": 1,
             "files": _build_manifest(staging),
@@ -350,6 +357,7 @@ def _commit_staged(staging: str, final: str, accelerator) -> None:
                 accelerator.project_configuration, "iteration", 0
             ),
             "num_processes": state.num_processes,
+            "topology": topology,
             "time": time.time(),
         }
         marker = os.path.join(staging, CHECKPOINT_COMMITTED_MARKER)
@@ -367,6 +375,12 @@ def _commit_staged(staging: str, final: str, accelerator) -> None:
     state.wait_for_everyone()  # no host reads `final` before it exists
     fault_point("before_gc")
     _gc_checkpoints(accelerator)
+    # hand the now-durable checkpoint to the replicator (main process only;
+    # elastic.py mirrors it to ReplicationConfig.target in the background)
+    if state.is_main_process:
+        submit = getattr(accelerator, "_submit_replication", None)
+        if submit is not None:
+            submit(final)
 
 
 def _gc_checkpoints(accelerator) -> None:
@@ -613,10 +627,80 @@ def _restore_upgraded_opt_state(path, target, shardings, upgrade):
     )
 
 
+def _resolve_for_load(accelerator, input_dir: Optional[str]) -> str:
+    """``_resolve_dir(for_save=False)`` with the elastic-recovery fallback:
+    when the LOCAL tree has no committed checkpoint at all but a
+    :class:`~accelerate_tpu.utils.dataclasses.ReplicationConfig` is active,
+    the newest verified replica is restored into the local tree first (the
+    "host whose disk is gone" path). First launches — no local checkpoint
+    AND no replica — still raise :class:`CheckpointNotFoundError` so
+    ``resume_from_latest`` keeps returning False."""
+    try:
+        return _resolve_dir(accelerator, input_dir, for_save=False)
+    except CheckpointNotFoundError:
+        rc = getattr(accelerator, "replication_config", None)
+        pc = accelerator.project_configuration
+        if rc is None or input_dir is not None or pc.project_dir is None:
+            raise
+        from .elastic import ensure_local_checkpoint
+
+        base = os.path.join(pc.project_dir, "checkpoints")
+        logger.warning(
+            f"no committed checkpoint under {base}; attempting replica "
+            f"restore from {rc.target}"
+        )
+        return ensure_local_checkpoint(rc, base)
+
+
+def _topology_gate(accelerator, input_dir: str, elastic: bool) -> Optional[dict]:
+    """Read the manifest topology and enforce the elastic contract: a world
+    change (``num_processes`` or device count) without ``elastic=True``
+    raises :class:`CheckpointTopologyError` up front, BEFORE orbax touches a
+    single shard — naming both topologies instead of the opaque sharding
+    mismatch orbax would eventually produce. Returns the saved topology
+    block (``None`` for unverifiable pre-durability trees)."""
+    from .elastic import manifest_topology
+    from .utils.fault import CheckpointTopologyError
+
+    try:
+        manifest = read_commit_manifest(input_dir)
+    except CheckpointError:
+        return None  # verify="off" escape hatch for pre-durability layouts
+    topo = manifest_topology(manifest)
+    state = PartialState()
+    saved_procs = topo.get("num_processes")
+    saved_devices = topo.get("num_devices")
+    mismatches = []
+    if saved_procs is not None and saved_procs != state.num_processes:
+        mismatches.append(
+            f"num_processes {saved_procs} (saved) != {state.num_processes} (live)"
+        )
+    if saved_devices is not None and saved_devices != state.num_devices:
+        mismatches.append(
+            f"num_devices {saved_devices} (saved) != {state.num_devices} (live)"
+        )
+    if mismatches and not elastic:
+        saved_axes = topo.get("mesh_axes") or {}
+        raise CheckpointTopologyError(
+            f"checkpoint {input_dir} was saved on a different topology: "
+            + "; ".join(mismatches)
+            + (f"; saved mesh axes {saved_axes}" if saved_axes else "")
+            + ". Pass elastic=True to load_state/resume_from_latest (or "
+            "launch with --elastic) to reshard onto the current mesh."
+        )
+    if mismatches:
+        logger.warning(
+            f"elastic load: resharding {input_dir} onto the live topology "
+            f"({'; '.join(mismatches)})"
+        )
+    return topo
+
+
 def load_accelerator_state(
     accelerator,
     input_dir: Optional[str] = None,
     verify: Optional[str] = None,
+    elastic: bool = False,
     **kwargs,
 ) -> None:
     """Restore the training state (reference load_accelerator_state,
@@ -631,10 +715,19 @@ def load_accelerator_state(
     :class:`CheckpointUncommittedError` (interrupted save),
     :class:`CheckpointCorruptError` (manifest mismatch), or
     :class:`CheckpointComponentMissingError` (live state has no counterpart
-    in the checkpoint)."""
+    in the checkpoint).
+
+    Elastic recovery (docs/fault_tolerance.md "Replication & elastic
+    resume"): a missing or corrupt local tree falls back to a
+    checksum-verified replica when a ``ReplicationConfig`` is active; a
+    checkpoint saved on a different world topology raises
+    :class:`CheckpointTopologyError` unless ``elastic=True``, which reshards
+    model/optimizer pytrees onto the live mesh (orbax's shardings-aware
+    restore) and remaps dataloader positions across the new global batch
+    (:func:`accelerate_tpu.elastic.remap_sampler_state`)."""
     state = PartialState()
     wait_for_async_saves()  # ensure no half-written checkpoint is read
-    input_dir = _resolve_dir(accelerator, input_dir, for_save=False)
+    input_dir = _resolve_for_load(accelerator, input_dir)
     if not os.path.isdir(input_dir):
         # a same-name overwrite that died between its two renames parks the
         # previous committed checkpoint at <dir>.old — recover it
@@ -647,11 +740,47 @@ def load_accelerator_state(
             if state.is_main_process:
                 os.rename(parked, input_dir)
             state.wait_for_everyone()
+        elif getattr(accelerator, "replication_config", None) is not None:
+            from .elastic import ensure_local_checkpoint
+
+            logger.warning(
+                f"{input_dir} missing; attempting replica restore from "
+                f"{accelerator.replication_config.target}"
+            )
+            ensure_local_checkpoint(
+                accelerator.replication_config,
+                os.path.dirname(input_dir),
+                name=os.path.basename(input_dir),
+            )
         else:
             raise CheckpointNotFoundError(
                 f"checkpoint directory {input_dir} does not exist"
             )
-    verify_checkpoint(input_dir, level=_verify_level(verify))
+    try:
+        verify_checkpoint(input_dir, level=_verify_level(verify))
+    except CheckpointCorruptError:
+        rc = getattr(accelerator, "replication_config", None)
+        if rc is None:
+            raise
+        # the local bytes are damaged: park them out of the way and pull a
+        # checksum-verified replica over the same name
+        from .elastic import ensure_local_checkpoint
+
+        logger.warning(
+            f"local checkpoint {input_dir} is corrupt; restoring from "
+            f"replica {rc.target}"
+        )
+        if state.is_main_process and os.path.isdir(input_dir):
+            corrupt = input_dir + ".corrupt"
+            shutil.rmtree(corrupt, ignore_errors=True)
+            os.rename(input_dir, corrupt)
+        if state.num_processes > 1:
+            state.wait_for_everyone("accelerate_tpu.elastic.park_corrupt")
+        ensure_local_checkpoint(
+            rc, os.path.dirname(input_dir), name=os.path.basename(input_dir)
+        )
+        verify_checkpoint(input_dir, level=_verify_level(verify))
+    saved_topology = _topology_gate(accelerator, input_dir, elastic)
 
     for i, model in enumerate(accelerator._models):
         suffix = "" if i == 0 else f"_{i}"
@@ -718,8 +847,23 @@ def load_accelerator_state(
             payload = json.load(f)
         accelerator.step = payload.get("step", 0)
         for dl, sd in zip(accelerator._dataloaders, payload.get("dataloaders", [])):
-            if hasattr(dl, "load_state_dict"):
-                dl.load_state_dict(sd)
+            if not hasattr(dl, "load_state_dict"):
+                continue
+            if elastic and sd:
+                new_total = getattr(dl, "total_batch_size", None)
+                old_total = sd.get("total_batch_size")
+                if old_total is None and saved_topology:
+                    # pre-elastic checkpoint: assume the per-process batch
+                    # size is unchanged, so the old global batch scales
+                    # with the saved world size
+                    saved_procs = saved_topology.get("num_processes")
+                    if saved_procs and getattr(dl, "batch_size", None):
+                        old_total = dl.batch_size * saved_procs
+                if old_total and new_total and int(old_total) != int(new_total):
+                    from .elastic import remap_sampler_state
+
+                    sd = remap_sampler_state(sd, int(old_total), int(new_total))
+            dl.load_state_dict(sd)
 
     p = os.path.join(input_dir, "scaler.json")
     if accelerator.scaler is not None and os.path.exists(p):

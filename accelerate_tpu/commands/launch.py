@@ -20,14 +20,21 @@ relaunches the script when it dies; ``--monitor_interval``/
 touches ``ACCELERATE_HEARTBEAT_FILE`` every optimizer step). On a
 multi-host SPMD job a single dead host makes every other host's
 collectives fail, so all supervisors restart their worker together and
-``jax.distributed`` re-forms with the same process count — recovery is
-whole-job restart + resume from the latest checkpoint
-(``Accelerator.resume_from_latest`` + ``skip_first_batches``), which is
-the only sound recovery on a TPU pod (no per-rank elasticity).
+``jax.distributed`` re-forms — recovery is whole-job restart + resume from
+the latest checkpoint (``Accelerator.resume_from_latest`` +
+``skip_first_batches``), which is the only sound recovery on a TPU pod (no
+per-rank elasticity). With ``--elastic`` the whole-job restart may re-form
+at a DIFFERENT world size (``ACCELERATE_ELASTIC_TOPOLOGY_FILE`` updated by
+an external orchestrator between restarts): workers resume from the
+cluster-consensus checkpoint with ``elastic=True``, resharding state onto
+the new mesh, and ``--replicate_to`` gives hosts that lost their local
+checkpoint tree a durable replica to restore from
+(docs/fault_tolerance.md "Replication & elastic resume").
 """
 
 from __future__ import annotations
 
+import json
 import os
 import shlex
 import signal
@@ -104,6 +111,7 @@ def _supervise(cmd, env, max_restarts: int, monitor_interval: float,
     try:
         while True:
             env["ACCELERATE_RESTART_COUNT"] = str(attempt)
+            _apply_elastic_topology(env, attempt)
             if hb_file:
                 os.utime(hb_file, None)
             started = time.time()
@@ -213,6 +221,43 @@ def _supervise(cmd, env, max_restarts: int, monitor_interval: float,
                 pass
 
 
+def _apply_elastic_topology(env: dict, attempt: int) -> None:
+    """Gang restart with a NEW topology: before every (re)launch the
+    supervisor re-reads ``ACCELERATE_ELASTIC_TOPOLOGY_FILE`` (JSON with any
+    of ``num_processes`` / ``process_id`` / ``coordinator_address``) and
+    exports the values to the worker. An external orchestrator that lost a
+    host updates the file on every surviving host; at the next whole-job
+    restart the gang re-forms at the new world size and
+    ``resume_from_latest(elastic=True)`` reshards from the consensus
+    checkpoint. Without the env var (or the file) this is a no-op — the
+    restart keeps the original fixed topology."""
+    topo_file = env.get("ACCELERATE_ELASTIC_TOPOLOGY_FILE") or os.environ.get(
+        "ACCELERATE_ELASTIC_TOPOLOGY_FILE"
+    )
+    if not topo_file or not os.path.exists(topo_file):
+        return
+    try:
+        with open(topo_file) as f:
+            topo = json.load(f)
+    except (json.JSONDecodeError, OSError) as exc:
+        print(f"[launch] unreadable elastic topology file {topo_file}: {exc}",
+              file=sys.stderr)
+        return
+    changed = []
+    for key in ("num_processes", "process_id", "coordinator_address"):
+        if key in topo:
+            var = f"ACCELERATE_{key.upper()}"
+            val = str(topo[key])
+            if env.get(var) != val:
+                changed.append(f"{var}={val}")
+            env[var] = val
+    if changed and attempt:
+        print(
+            f"[launch] elastic relaunch with {' '.join(changed)}",
+            file=sys.stderr,
+        )
+
+
 def _supervision_settings(args, cfg) -> tuple[int, float]:
     """CLI flags override the config file; an EXPLICIT --max_restarts 0 /
     --watchdog_timeout 0 disables supervision (flags default to None so
@@ -247,14 +292,25 @@ def launch_command(args, script_args) -> int:
     if args.debug:
         cfg.debug = True
 
-    env = dict(os.environ)
-    env.update(cfg.to_env())
+    flag_env: dict = {}
     if args.process_id is not None:
-        env["ACCELERATE_PROCESS_ID"] = str(args.process_id)
+        flag_env["ACCELERATE_PROCESS_ID"] = str(args.process_id)
     if args.handle_preemption:
         # every worker's Accelerator installs the SIGTERM/SIGINT
         # checkpoint-then-exit handler (utils/fault.py)
-        env["ACCELERATE_HANDLE_PREEMPTION"] = "1"
+        flag_env["ACCELERATE_HANDLE_PREEMPTION"] = "1"
+    if args.elastic:
+        # workers resume with elastic=True: a restart at a different world
+        # size reshards from the cluster-consensus checkpoint instead of
+        # failing the topology gate (docs/fault_tolerance.md)
+        flag_env["ACCELERATE_ELASTIC"] = "1"
+    if args.replicate_to:
+        flag_env["ACCELERATE_REPLICATION_TARGET"] = args.replicate_to
+        if args.replicate_copies is not None:
+            flag_env["ACCELERATE_REPLICATION_COPIES"] = str(args.replicate_copies)
+    env = dict(os.environ)
+    env.update(cfg.to_env())
+    env.update(flag_env)
 
     if not args.training_script:
         print("error: no training script given", file=sys.stderr)
@@ -277,6 +333,12 @@ def launch_command(args, script_args) -> int:
             supervisor_flags += ["--crash_loop_limit", str(args.crash_loop_limit)]
         if args.handle_preemption:
             supervisor_flags += ["--handle_preemption"]
+        if args.elastic:
+            supervisor_flags += ["--elastic"]
+        if args.replicate_to:
+            supervisor_flags += ["--replicate_to", args.replicate_to]
+            if args.replicate_copies is not None:
+                supervisor_flags += ["--replicate_copies", str(args.replicate_copies)]
         inner = " ".join(
             [f"{k}={shlex.quote(v)}" for k, v in cfg.to_env().items()]
             + ["python", "-m", "accelerate_tpu.commands.accelerate_cli", "launch"]
@@ -295,7 +357,7 @@ def launch_command(args, script_args) -> int:
 
     if args.dry_run:
         print(" ".join(shlex.quote(c) for c in cmd))
-        for k, v in sorted(cfg.to_env().items()):
+        for k, v in sorted({**cfg.to_env(), **flag_env}.items()):
             print(f"  {k}={v}")
         return 0
     max_restarts, watchdog = _supervision_settings(args, cfg)
@@ -339,6 +401,19 @@ def add_parser(subparsers) -> None:
                    help="workers checkpoint and exit cleanly on SIGTERM/SIGINT "
                         "(TPU preemption); the supervisor forwards the signal and "
                         "treats the shutdown as planned")
+    p.add_argument("--elastic", action="store_true",
+                   help="exports ACCELERATE_ELASTIC=1: resume_from_latest loads "
+                        "the cluster-consensus checkpoint with elastic=True, so a "
+                        "gang restart at a DIFFERENT world size (see "
+                        "ACCELERATE_ELASTIC_TOPOLOGY_FILE) reshards instead of "
+                        "failing the topology gate")
+    p.add_argument("--replicate_to", default=None,
+                   help="exports ACCELERATE_REPLICATION_TARGET: every committed "
+                        "checkpoint is mirrored (manifest-verified, background) "
+                        "under this durable path; a host that lost its local tree "
+                        "restores from the replica on resume")
+    p.add_argument("--replicate_copies", type=int, default=None,
+                   help="number of replica copies under --replicate_to (default 1)")
     p.add_argument("--debug", action="store_true", help="enable collective shape verification")
     p.add_argument("--dry_run", action="store_true", help="print the command and env, don't run")
     p.add_argument("training_script", nargs="?")

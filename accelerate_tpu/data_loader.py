@@ -733,6 +733,11 @@ class DataLoaderShard(_BaseAcceleratedLoader):
             "position": self._position,
             "epoch": getattr(self.sampler, "epoch", 0) if self.sampler is not None else 0,
         }
+        # self-describing position: `position` counts GLOBAL batches of this
+        # size, so an elastic resume on a different world can remap it
+        # (elastic.remap_sampler_state) instead of guessing the old ratio
+        if self.total_batch_size:
+            state["total_batch_size"] = self.total_batch_size
         ds = self.dataset
         if self._last_ds_state is not None:
             state["dataset_state"] = self._last_ds_state

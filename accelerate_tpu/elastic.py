@@ -1,0 +1,663 @@
+"""Elastic recovery: cluster-consensus resume, checkpoint replication, and
+topology-change restarts (docs/fault_tolerance.md "Replication & elastic
+resume").
+
+The durability layer (checkpointing.py) makes one host's checkpoints atomic
+and verified; this module makes recovery survive *host loss* and *world-size
+change* — the preemptible-pod reality of the ROADMAP north star:
+
+* **Cluster-consensus resume** — each host contributes its local view of the
+  committed checkpoint tree ``{index: manifest digest}``; every host loads
+  the highest index committed on all hosts that have any checkpoints. A
+  digest mismatch at the chosen index (two hosts holding *different bytes*
+  for the same step) raises :class:`CheckpointDivergedError` instead of
+  silently training from skewed state, the failure veScale-style
+  single-device-semantics checkpoints are designed to exclude.
+* **Checkpoint replication** — :class:`CheckpointReplicator` mirrors every
+  committed checkpoint under ``ReplicationConfig.target`` (durable storage
+  that outlives the host) on a bounded background thread: manifest-verified
+  staged copies, atomic rename, retry with exponential backoff, drained by
+  ``end_training`` / preemption / atexit exactly like async saves. On
+  restore, a host whose local tree is missing or corrupt proves a replica's
+  integrity against the replica's own manifest checksums before copying it
+  back (:func:`restore_from_replica`).
+* **Topology block** — the commit manifest grows a ``topology`` section
+  (mesh axes, ``num_processes``, device count, per-component PartitionSpecs)
+  so ``load_state(elastic=True)`` can reshard onto the current mesh (orbax's
+  shardings-aware restore does the array movement — PAPERS: memory-efficient
+  array redistribution) and remap dataloader positions across the new dp
+  size (:func:`remap_sampler_state`).
+
+Fault-injection points (``ACCELERATE_TPU_FAULT_INJECT``): ``before_replicate``
+(post-commit, before any mirror work), ``during_replicate`` (between file
+copies into replica staging), ``after_replicate`` (after a replica commit),
+``before_replica_restore`` (before copying a verified replica back over a
+missing/corrupt local tree).
+"""
+
+from __future__ import annotations
+
+import atexit
+import collections
+import hashlib
+import json
+import os
+import shutil
+import threading
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from .logging import get_logger
+from .state import PartialState
+from .utils.constants import (
+    CHECKPOINT_COMMITTED_MARKER,
+    CHECKPOINT_DIR_PREFIX,
+    CHECKPOINT_OLD_SUFFIX,
+    CHECKPOINT_STAGING_SUFFIX,
+    RNG_STATE_NAME,
+)
+from .utils.dataclasses import ReplicationConfig
+from .utils.fault import (
+    CheckpointDivergedError,
+    CheckpointError,
+    CheckpointNotFoundError,
+    ReplicaUnavailableError,
+    fault_point,
+)
+
+logger = get_logger(__name__)
+
+__all__ = [
+    "ReplicationConfig",
+    "CheckpointReplicator",
+    "ConsensusResult",
+    "manifest_digest",
+    "checkpoint_digest",
+    "local_checkpoint_views",
+    "resolve_consensus_checkpoint",
+    "restore_from_replica",
+    "ensure_local_checkpoint",
+    "build_topology",
+    "manifest_topology",
+    "remap_sampler_state",
+]
+
+
+# ---------------------------------------------------------- manifest digests
+def manifest_digest(manifest: dict) -> str:
+    """Content fingerprint of a commit manifest, comparable ACROSS hosts.
+
+    Hashes the sorted (path, size, crc32) triples plus the recorded step —
+    excluding per-rank ``random_states_*.pkl`` entries (each host writes its
+    own; legitimately different) and the wall-clock ``time`` field. Two hosts
+    holding the same checkpoint index with different digests hold different
+    *training state bytes*: that is divergence, not skew.
+    """
+    entries = sorted(
+        (rel, meta.get("size"), meta.get("crc32"))
+        for rel, meta in manifest.get("files", {}).items()
+        if not os.path.basename(rel).startswith(RNG_STATE_NAME)
+    )
+    payload = json.dumps(
+        {"files": entries, "step": manifest.get("step"), "format": manifest.get("format")},
+        sort_keys=True,
+    )
+    return hashlib.sha256(payload.encode()).hexdigest()[:16]
+
+
+def checkpoint_digest(ckpt_dir: str) -> str:
+    """Digest of a committed checkpoint directory (reads its manifest)."""
+    from .checkpointing import read_commit_manifest
+
+    return manifest_digest(read_commit_manifest(ckpt_dir))
+
+
+def local_checkpoint_views(base: str) -> dict:
+    """This host's view of the committed tree: ``{index: digest}``."""
+    from .checkpointing import checkpoint_index, list_checkpoints
+
+    views = {}
+    for path in list_checkpoints(base, committed_only=True):
+        idx = checkpoint_index(os.path.basename(path))
+        if idx is None:
+            continue
+        try:
+            views[idx] = checkpoint_digest(path)
+        except CheckpointError:
+            continue  # raced a concurrent GC/commit; treat as absent
+    return views
+
+
+# ------------------------------------------------------------------ consensus
+@dataclass
+class ConsensusResult:
+    """Outcome of cluster-consensus resolution for ONE host.
+
+    ``local_path`` is ``None`` when this host does not hold the consensus
+    checkpoint locally (empty or lagging tree) and must fetch it from a
+    replica before loading.
+    """
+
+    index: int
+    digest: str
+    local_path: Optional[str]
+
+
+def _consensus_from_views(views: list, base: str, rank: int) -> Optional[ConsensusResult]:
+    """Pure consensus rule over the gathered per-host views (unit-testable
+    without a cluster). ``views[r]`` is rank r's ``{index: digest}``.
+
+    * Hosts with an EMPTY view (disk wiped / fresh replacement node) do not
+      veto: they are excluded from the intersection and later fetch the
+      consensus checkpoint from a replica.
+    * Consensus index = the highest index present on every non-empty host —
+      a laggard one checkpoint behind pulls the gang back to the common
+      index rather than forking.
+    * Any digest disagreement at the consensus index, or non-empty hosts
+      with no common index at all, raises :class:`CheckpointDivergedError`.
+    """
+    nonempty = [(r, v) for r, v in enumerate(views) if v]
+    if not nonempty:
+        return None
+    common = set(nonempty[0][1])
+    for _r, v in nonempty[1:]:
+        common &= set(v)
+    if not common:
+        summary = ", ".join(
+            f"rank {r}: {sorted(v)}" for r, v in nonempty
+        )
+        raise CheckpointDivergedError(
+            f"no committed checkpoint index is shared by every host under "
+            f"{base} — the hosts' histories have diverged ({summary}). "
+            "Refusing to resume from skewed steps; restore the replica set "
+            "or clear the stale trees."
+        )
+    index = max(common)
+    digests = {v[index] for _r, v in nonempty}
+    if len(digests) > 1:
+        detail = ", ".join(
+            f"rank {r}: {v[index]}" for r, v in nonempty
+        )
+        raise CheckpointDivergedError(
+            f"checkpoint_{index} under {base} has DIFFERENT content across "
+            f"hosts (manifest digests {detail}). Same index, different "
+            "bytes: training forked. Refusing to resume."
+        )
+    digest = digests.pop()
+    mine = views[rank] if rank < len(views) else {}
+    local_path = (
+        os.path.join(base, f"{CHECKPOINT_DIR_PREFIX}_{index}")
+        if index in mine
+        else None
+    )
+    return ConsensusResult(index=index, digest=digest, local_path=local_path)
+
+
+def resolve_consensus_checkpoint(base: str) -> Optional[ConsensusResult]:
+    """All-gather every host's committed-tree view and apply the consensus
+    rule. Collective — every process must call it together. Returns ``None``
+    when no host has any committed checkpoint (first launch)."""
+    state = PartialState()
+    mine = local_checkpoint_views(base)
+    views = state.gather_object(mine)
+    result = _consensus_from_views(views, base, state.process_index)
+    if result is not None and state.is_main_process:
+        holders = sum(1 for v in views if result.index in v)
+        logger.info(
+            f"consensus resume: checkpoint_{result.index} "
+            f"(digest {result.digest}, held by {holders}/{len(views)} hosts)"
+        )
+    return result
+
+
+# ---------------------------------------------------------------- replication
+def _copy_roots(config: ReplicationConfig) -> list:
+    """The replica copy directories ``target/r0 … target/r{copies-1}``."""
+    return [os.path.join(config.target, f"r{k}") for k in range(config.copies)]
+
+
+def _mirror_one(src: str, dst: str, config: ReplicationConfig) -> None:
+    """Mirror one committed checkpoint into one replica slot: stage a full
+    copy, verify the staged bytes against the source manifest, and rename —
+    the same stage/verify/commit shape as the local save protocol, so a
+    death at ANY point leaves either no replica or a complete verified one,
+    never a half-mirrored tree that later loads as corrupt."""
+    from .checkpointing import read_commit_manifest, verify_checkpoint
+
+    manifest = read_commit_manifest(src)  # src must be committed
+    staging = dst + CHECKPOINT_STAGING_SUFFIX
+    if os.path.exists(staging):
+        shutil.rmtree(staging, ignore_errors=True)
+    os.makedirs(staging)
+    for rel in sorted(manifest.get("files", {})):
+        full = os.path.join(src, rel)
+        out = os.path.join(staging, rel)
+        os.makedirs(os.path.dirname(out), exist_ok=True)
+        shutil.copy2(full, out)
+        fault_point("during_replicate")
+    # the marker goes LAST: a replica staging dir is never committed until
+    # every payload file it describes is already on the target
+    shutil.copy2(
+        os.path.join(src, CHECKPOINT_COMMITTED_MARKER),
+        os.path.join(staging, CHECKPOINT_COMMITTED_MARKER),
+    )
+    verify_checkpoint(staging, level=config.verify)
+    old = dst + CHECKPOINT_OLD_SUFFIX
+    if os.path.exists(dst):
+        if os.path.exists(old):
+            shutil.rmtree(old, ignore_errors=True)
+        os.rename(dst, old)
+    os.rename(staging, dst)
+    shutil.rmtree(old, ignore_errors=True)
+
+
+def _gc_replicas(root: str, keep: int) -> None:
+    from .checkpointing import list_checkpoints
+
+    committed = list_checkpoints(root, committed_only=True)
+    for victim in committed[:-keep] if keep else []:
+        logger.info(f"replica retention: removing {victim}")
+        shutil.rmtree(victim, ignore_errors=True)
+
+
+class CheckpointReplicator:
+    """Bounded background mirror of committed checkpoints.
+
+    ``submit(ckpt_dir)`` (main process, after a commit) enqueues a mirror
+    job; a daemon thread copies the checkpoint into every replica slot with
+    retry + exponential backoff. The queue holds at most two pending jobs —
+    replication that cannot keep up drops the OLDEST pending checkpoint
+    (latest-wins; the newest committed state is the one recovery wants) and
+    never blocks the step loop. ``drain()`` joins all pending work and
+    raises the first deferred mirror error; it is called by
+    ``Accelerator.end_training``, the preemption handler, and atexit.
+
+    With ``async_replicate=False`` the mirror runs inline in ``submit`` and
+    failures raise immediately (deterministic: tests, final checkpoints).
+    """
+
+    _MAX_PENDING = 2
+
+    def __init__(self, config: ReplicationConfig):
+        self.config = config
+        self._cond = threading.Condition()
+        self._pending: collections.deque = collections.deque()
+        self._inflight: Optional[str] = None
+        self._errors: list = []
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+
+    # ------------------------------------------------------------- lifecycle
+    def submit(self, ckpt_dir: str) -> None:
+        fault_point("before_replicate")
+        if not self.config.async_replicate:
+            self._mirror_with_retry(ckpt_dir)
+            return
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("CheckpointReplicator is closed")
+            self._ensure_thread()
+            while len(self._pending) >= self._MAX_PENDING:
+                dropped = self._pending.popleft()
+                logger.warning(
+                    f"replication backlog: dropping {dropped} in favor of "
+                    f"newer checkpoint {ckpt_dir} (latest-wins)"
+                )
+            self._pending.append(ckpt_dir)
+            self._cond.notify_all()
+
+    def drain(self, timeout: Optional[float] = None) -> None:
+        """Block until every submitted mirror has finished (or ``timeout``
+        seconds elapsed), then surface the first deferred mirror error."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while self._pending or self._inflight is not None:
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        logger.warning(
+                            "replication drain timed out with "
+                            f"{len(self._pending)} pending mirror(s)"
+                        )
+                        break
+                self._cond.wait(remaining)
+            errors, self._errors = self._errors, []
+        if errors:
+            raise errors[0]
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    @property
+    def pending(self) -> int:
+        with self._cond:
+            return len(self._pending) + (1 if self._inflight else 0)
+
+    def _ensure_thread(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._thread = threading.Thread(
+            target=self._run, name="checkpoint-replicator", daemon=True
+        )
+        self._thread.start()
+        atexit.register(self._drain_quietly)
+
+    def _drain_quietly(self) -> None:
+        try:
+            self.drain()
+        except Exception as exc:  # atexit: nothing to do but report
+            logger.error(f"checkpoint replication failed during exit: {exc}")
+
+    # ------------------------------------------------------------ the mirror
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while not self._pending and not self._closed:
+                    self._cond.wait()
+                if not self._pending:
+                    return  # closed and drained
+                self._inflight = self._pending.popleft()
+                job = self._inflight
+            try:
+                self._mirror_with_retry(job)
+            except Exception as exc:  # deferred to drain()
+                with self._cond:
+                    self._errors.append(exc)
+            finally:
+                with self._cond:
+                    self._inflight = None
+                    self._cond.notify_all()
+
+    def _mirror_with_retry(self, src: str) -> None:
+        name = os.path.basename(src.rstrip(os.sep))
+        last: Optional[BaseException] = None
+        for root in _copy_roots(self.config):
+            os.makedirs(root, exist_ok=True)
+            dst = os.path.join(root, name)
+            for attempt in range(self.config.max_retries + 1):
+                try:
+                    _mirror_one(src, dst, self.config)
+                    last = None
+                    break
+                except Exception as exc:
+                    last = exc
+                    if attempt == self.config.max_retries:
+                        break
+                    backoff = self.config.retry_backoff_s * (2**attempt)
+                    logger.warning(
+                        f"replica mirror {src} -> {dst} failed "
+                        f"(attempt {attempt + 1}): {exc}; retrying in "
+                        f"{backoff:.2f}s"
+                    )
+                    time.sleep(backoff)
+            if last is not None:
+                raise last
+            if self.config.keep:
+                _gc_replicas(root, self.config.keep)
+        fault_point("after_replicate")
+        logger.info(
+            f"replicated {src} to {self.config.copies} "
+            f"cop{'y' if self.config.copies == 1 else 'ies'} under "
+            f"{self.config.target}"
+        )
+
+
+# ------------------------------------------------------------ replica restore
+def _replica_candidates(config: ReplicationConfig, name: Optional[str]) -> list:
+    """Candidate replica dirs, best-first. With ``name`` given, only that
+    checkpoint across copy slots; otherwise every committed replica, newest
+    index first, interleaved across slots."""
+    from .checkpointing import checkpoint_index, list_checkpoints
+
+    if name is not None:
+        return [
+            os.path.join(root, name)
+            for root in _copy_roots(config)
+            if os.path.isdir(os.path.join(root, name))
+        ]
+    ranked = []
+    for slot, root in enumerate(_copy_roots(config)):
+        for path in list_checkpoints(root, committed_only=True):
+            idx = checkpoint_index(os.path.basename(path))
+            ranked.append((-(idx if idx is not None else -1), slot, path))
+    ranked.sort()
+    return [path for _neg, _slot, path in ranked]
+
+
+def restore_from_replica(
+    config: ReplicationConfig,
+    local_base: str,
+    name: Optional[str] = None,
+    expected_digest: Optional[str] = None,
+) -> str:
+    """Copy a verified replica back into the local checkpoint tree.
+
+    Every candidate replica is fully checksum-verified against ITS OWN
+    manifest before a byte lands locally — a corrupt replica file means
+    that copy is skipped (checksum refusal), the next copy slot is tried,
+    and :class:`ReplicaUnavailableError` is raised when none survive.
+    ``expected_digest`` (from consensus) additionally pins the content.
+    The restore itself is staged + renamed, so a death mid-restore leaves
+    an ignorable ``.tmp``, never a half-written "committed" checkpoint.
+    """
+    from .checkpointing import verify_checkpoint
+
+    candidates = _replica_candidates(config, name)
+    if not candidates and name is None:
+        raise CheckpointNotFoundError(
+            f"no committed replica under {config.target} "
+            f"({config.copies} copy slot(s) checked)"
+        )
+    failures = []
+    for replica in candidates:
+        try:
+            verify_checkpoint(replica, level="checksum")
+            if expected_digest is not None:
+                got = checkpoint_digest(replica)
+                if got != expected_digest:
+                    raise CheckpointDivergedError(
+                        f"replica {replica} digest {got} != consensus "
+                        f"digest {expected_digest}"
+                    )
+        except CheckpointError as exc:
+            logger.warning(f"replica {replica} refused: {exc}")
+            failures.append(f"{replica}: {exc}")
+            continue
+        fault_point("before_replica_restore")
+        dest = os.path.join(local_base, os.path.basename(replica))
+        staging = dest + CHECKPOINT_STAGING_SUFFIX
+        if os.path.exists(staging):
+            shutil.rmtree(staging, ignore_errors=True)
+        os.makedirs(local_base, exist_ok=True)
+        shutil.copytree(replica, staging)
+        verify_checkpoint(staging, level="checksum")
+        if os.path.exists(dest):
+            shutil.rmtree(dest, ignore_errors=True)
+        os.rename(staging, dest)
+        logger.warning(f"restored {dest} from replica {replica}")
+        return dest
+    raise ReplicaUnavailableError(
+        f"no usable replica for "
+        f"{name if name is not None else 'the latest checkpoint'} under "
+        f"{config.target}: " + ("; ".join(failures) if failures else "none found")
+    )
+
+
+def ensure_local_checkpoint(
+    config: ReplicationConfig,
+    local_base: str,
+    name: Optional[str] = None,
+    expected_digest: Optional[str] = None,
+) -> str:
+    """Make the named checkpoint (or, with ``name=None``, the newest
+    committed replica) present and committed in ``local_base``, fetching
+    from a replica when missing. Collective-safe: on a shared filesystem
+    the main process performs the copy and everyone else picks it up after
+    the barrier; on host-local disks each host that is still missing the
+    tree after the barrier restores its own.
+    """
+    from .checkpointing import is_checkpoint_committed
+
+    state = PartialState()
+
+    def _local(nm: str) -> Optional[str]:
+        path = os.path.join(local_base, nm)
+        return path if is_checkpoint_committed(path) else None
+
+    if name is not None and _local(name):
+        return os.path.join(local_base, name)
+
+    restored: Optional[str] = None
+    if state.is_main_process:
+        if name is None or _local(name) is None:
+            restored = restore_from_replica(
+                config, local_base, name=name, expected_digest=expected_digest
+            )
+    if state.num_processes > 1:
+        state.wait_for_everyone("accelerate_tpu.elastic.replica_restore")
+        if restored is None:
+            # main restored `name=None` to some index; on a shared
+            # filesystem its restore is now the newest local committed
+            # checkpoint, otherwise every host re-derives the same name
+            # from the (shared, identical-bytes) replica target ordering
+            target_name = name
+            if target_name is None:
+                from .checkpointing import list_checkpoints
+
+                local = list_checkpoints(local_base, committed_only=True)
+                if local:
+                    return local[-1]
+                cands = _replica_candidates(config, None)
+                if not cands:
+                    raise ReplicaUnavailableError(
+                        f"no committed replica under {config.target}"
+                    )
+                target_name = os.path.basename(cands[0])
+            if _local(target_name) is None:
+                # host-local disk: this host fetches its own copy
+                restored = restore_from_replica(
+                    config,
+                    local_base,
+                    name=target_name,
+                    expected_digest=expected_digest,
+                )
+            else:
+                restored = os.path.join(local_base, target_name)
+    if restored is None:
+        # single-process and nothing restored: the tree was already present
+        if name is not None and _local(name):
+            restored = os.path.join(local_base, name)
+        else:
+            raise ReplicaUnavailableError(
+                f"replica restore produced no local checkpoint under "
+                f"{local_base}"
+            )
+    return restored
+
+
+# ------------------------------------------------------------------- topology
+def build_topology(accelerator) -> dict:
+    """The manifest ``topology`` block: enough to detect a world change up
+    front and to document how the saved arrays were laid out. PartitionSpecs
+    are informational — orbax's shardings-aware restore performs the actual
+    resharding from the arrays' own metadata."""
+    state = PartialState()
+    block = {
+        "num_processes": state.num_processes,
+        "num_devices": state.num_devices,
+        "mesh_axes": {},
+        "partition_specs": {},
+    }
+    mesh = getattr(accelerator, "mesh", None)
+    if mesh is not None:
+        try:
+            block["mesh_axes"] = {
+                str(k): int(v) for k, v in dict(mesh.shape).items()
+            }
+        except Exception:
+            pass
+    for i, model in enumerate(getattr(accelerator, "_models", [])):
+        suffix = "" if i == 0 else f"_{i}"
+        shardings = getattr(model, "shardings", None)
+        if shardings is None:
+            continue
+        try:
+            block["partition_specs"][f"model{suffix}"] = _serialize_specs(shardings)
+        except Exception:
+            pass
+    return block
+
+
+def _serialize_specs(shardings) -> dict:
+    """``{tree path: [axis names per dim]}`` for every sharded leaf."""
+    import jax
+
+    out = {}
+    flat, _ = jax.tree_util.tree_flatten_with_path(shardings)
+    for path, sharding in flat:
+        spec = getattr(sharding, "spec", None)
+        if spec is None:
+            continue
+        dims = []
+        for entry in tuple(spec):
+            if entry is None:
+                dims.append(None)
+            elif isinstance(entry, (tuple, list)):
+                dims.append([str(e) for e in entry])
+            else:
+                dims.append(str(entry))
+        out[jax.tree_util.keystr(path)] = dims
+    return out
+
+
+def manifest_topology(manifest: dict) -> dict:
+    """The topology recorded in a manifest, tolerating pre-elastic manifests
+    (which record only a top-level ``num_processes``)."""
+    topo = manifest.get("topology")
+    if isinstance(topo, dict):
+        return topo
+    out = {}
+    if "num_processes" in manifest:
+        out["num_processes"] = manifest["num_processes"]
+    return out
+
+
+# -------------------------------------------------------------- sampler remap
+def remap_sampler_state(sd: dict, old_total_batch: int, new_total_batch: int) -> dict:
+    """Remap one dataloader's saved position across a global-batch change.
+
+    Positions (``position``, ``skip_batches``) count GLOBAL batches consumed
+    this epoch. When the world resizes, the per-process batch size is fixed
+    (``global = batch_size x num_processes``) so the global batch changes
+    and the batch count no longer measures the same number of samples.
+    Semantics: **conserve samples** — the resumed loader skips
+    ``floor(old_position x old_total_batch / new_total_batch)`` new-size
+    batches. Exact when the sample count divides the new global batch;
+    otherwise up to ``new_total_batch - 1`` samples are replayed (warned) —
+    replaying a few samples is the safe direction (never silently skipping
+    unseen data). A caller that kept the global batch constant (scaling
+    per-process batch by the world change) hits the ``old == new`` early
+    return and resumes exactly.
+    """
+    if old_total_batch == new_total_batch or old_total_batch <= 0 or new_total_batch <= 0:
+        return sd
+    out = dict(sd)
+    for key in ("position", "skip_batches"):
+        if key not in sd:
+            continue
+        old = int(sd[key])
+        samples = old * old_total_batch
+        new = samples // new_total_batch
+        if samples % new_total_batch:
+            logger.warning(
+                f"elastic sampler remap: {key}={old} x global batch "
+                f"{old_total_batch} = {samples} samples does not divide the "
+                f"new global batch {new_total_batch}; resuming at {key}={new} "
+                f"replays {samples - new * new_total_batch} sample(s)"
+            )
+        out[key] = new
+    return out

@@ -77,16 +77,25 @@ def _cluster_worker(rank, num_processes, port, function, args, queue,
         os.environ["ACCELERATE_PROCESS_ID"] = str(rank)
         for key, value in (extra_env or {}).items():
             os.environ[key] = value
+        # deterministic cluster size regardless of the parent's XLA_FLAGS
+        # (pytest forces an 8-device host; workers are 1 device each unless
+        # the caller asks otherwise). XLA_FLAGS is read at backend creation,
+        # so rewriting it here — before any device query — is binding, and
+        # unlike the jax_num_cpu_devices config option it exists on every
+        # jax version in the support window.
+        flags = [
+            f
+            for f in os.environ.get("XLA_FLAGS", "").split()
+            if not f.startswith("--xla_force_host_platform_device_count")
+        ]
+        flags.append(f"--xla_force_host_platform_device_count={local_devices}")
+        os.environ["XLA_FLAGS"] = " ".join(flags)
         import jax
 
         # the env var alone is NOT enough: a sitecustomize-registered TPU
         # plugin selects its platform via jax config at interpreter startup,
         # and a worker that touches it hangs on a dead relay
         jax.config.update("jax_platforms", "cpu")
-        # deterministic cluster size regardless of the parent's XLA_FLAGS
-        # (pytest forces an 8-device host; workers are 1 device each unless
-        # the caller asks otherwise)
-        jax.config.update("jax_num_cpu_devices", local_devices)
 
         jax.distributed.initialize(
             coordinator_address=f"127.0.0.1:{port}",

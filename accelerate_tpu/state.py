@@ -84,6 +84,45 @@ def _maybe_init_jax_distributed() -> None:
         )
 
 
+def _coordination_client():
+    """The jax.distributed coordination-service client, or None when this
+    process is not part of a distributed job."""
+    try:
+        from jax._src import distributed as _dist
+
+        return _dist.global_state.client
+    except (ImportError, AttributeError):
+        return None
+
+
+# wait_at_barrier requires a fresh barrier id per rendezvous; a per-tag
+# counter keeps ids aligned across processes because barriers are
+# collective (every process reaches the same sites in the same order).
+_BARRIER_SEQ: dict = {}
+
+
+def _coordination_barrier(client, tag: str, timeout: Optional[float]) -> None:
+    """Host-level barrier over the coordination service (pure gRPC — no XLA
+    program). This is the barrier path on CPU multiprocess clusters, where
+    this jaxlib cannot run cross-process XLA computations at all; elastic
+    recovery's consensus and replica-restore barriers must still work
+    there (a gang restart is exactly when the cluster is least healthy)."""
+    seq = _BARRIER_SEQ.get(tag, 0)
+    _BARRIER_SEQ[tag] = seq + 1
+    # the service requires a finite timeout; "unbounded" becomes 1h
+    ms = int(timeout * 1000) if timeout and timeout > 0 else 3_600_000
+    try:
+        client.wait_at_barrier(f"{tag}#{seq}", ms)
+    except Exception as e:  # noqa: BLE001 — typed below
+        from .utils.fault import BarrierTimeoutError
+
+        raise BarrierTimeoutError(
+            f"barrier {tag!r} did not complete within {ms / 1000:g}s — a "
+            "peer process is likely dead or wedged (set "
+            "ACCELERATE_BARRIER_TIMEOUT=0 to restore unbounded waits)"
+        ) from e
+
+
 def _run_with_barrier_timeout(sync_fn: Callable[[], Any], tag: str, timeout: Optional[float]) -> None:
     """Run a blocking barrier with an optional upper bound.
 
@@ -235,14 +274,38 @@ class PartialState:
         instead of a stale-heartbeat kill."""
         if self.num_processes <= 1:
             return
+        import jax
+
         from jax.experimental import multihost_utils
 
         if timeout is None:
             raw = os.environ.get("ACCELERATE_BARRIER_TIMEOUT", "")
             timeout = float(raw) if raw else None
+        client = _coordination_client()
+        if client is not None and jax.default_backend() == "cpu":
+            # this jaxlib's CPU backend cannot run multiprocess XLA
+            # computations, so sync_global_devices (a jitted psum) would
+            # fail; rendezvous over the coordination service instead
+            _coordination_barrier(client, tag, timeout)
+            return
         _run_with_barrier_timeout(
             lambda: multihost_utils.sync_global_devices(tag), tag, timeout
         )
+
+    def gather_object(self, obj):
+        """All-gather one picklable host object per process; returns the list
+        indexed by process rank (single-process: ``[obj]``). This is the
+        consensus primitive of elastic recovery: each host contributes its
+        local view of the checkpoint tree and every host sees all views.
+        Collective — every process must call it together."""
+        if self.num_processes <= 1:
+            return [obj]
+        # _object_allgather keeps exactly one element per rank (the public
+        # ops.gather_object flattens list payloads, which would corrupt a
+        # host view that happens to be a list).
+        from .ops.operations import _object_allgather
+
+        return _object_allgather(obj)
 
     @contextmanager
     def split_between_processes(self, inputs, apply_padding: bool = False):

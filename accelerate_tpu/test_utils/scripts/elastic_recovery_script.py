@@ -1,0 +1,136 @@
+"""Elastic-recovery drive script: replication kill points and topology-change
+resume, each phase a separate process (tests/test_elastic.py).
+
+* ``--phase train`` — step 1, a committed + replicated ``save_state``
+  (checkpoint_0), dump post-step-1 params to ``<ref_out>.step1.npy``; step 2,
+  dump ``<ref_out>.step2.npy``, arm ``ACCELERATE_TPU_FAULT_INJECT=<--fault>``
+  (unless ``none``) and save again — the second save's *replication* dies at
+  the injected point, leaving whatever partial replica the crash produced.
+  Replication itself is configured by the parent through
+  ``ACCELERATE_REPLICATION_TARGET`` / ``ACCELERATE_REPLICATION_SYNC``.
+* ``--phase verify`` — fresh process: ``resume_from_latest()`` (optionally
+  ``--elastic``) must restore *some* committed checkpoint — locally, or from
+  a replica when the parent wiped the local tree — and dump the restored
+  params to ``--ref_out`` for the parent to compare.
+* ``--phase parity`` — train ``--steps`` steps from scratch at whatever
+  device count the parent pinned via XLA_FLAGS, ``save_state`` after step
+  ``--save_at``, dump per-step losses to ``--losses_out`` plus final params /
+  optimizer moments to ``<ref_out>`` / ``<ref_out>.opt.npy``.
+* ``--phase parity-resume`` — ``resume_from_latest(elastic=...)`` at a
+  *different* device count, run ``--steps`` more steps, dump the same
+  artifacts; the parent checks the post-resume trajectory and moments match
+  the uninterrupted run's tail.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import numpy as np
+
+import jax
+import optax
+
+
+def _flat(tree) -> np.ndarray:
+    leaves = [
+        np.asarray(jax.device_get(leaf)).ravel()
+        for leaf in jax.tree_util.tree_leaves(tree)
+    ]
+    if not leaves:
+        return np.zeros((0,), dtype=np.float32)
+    return np.concatenate(leaves)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--project_dir", required=True)
+    ap.add_argument(
+        "--phase",
+        choices=["train", "verify", "parity", "parity-resume"],
+        required=True,
+    )
+    ap.add_argument("--ref_out", required=True)
+    ap.add_argument("--losses_out", default=None)
+    ap.add_argument("--fault", default="none",
+                    help="fault spec armed before the SECOND save's "
+                         "replication (point[:action], see utils/fault.py)")
+    ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--save_at", type=int, default=2)
+    ap.add_argument("--batch_size", type=int, default=16)
+    ap.add_argument("--elastic", action="store_true")
+    args = ap.parse_args()
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.test_utils.training import (
+        RegressionModel,
+        make_regression_data,
+        regression_loss,
+    )
+
+    accelerator = Accelerator(project_dir=args.project_dir)
+    accelerator.project_configuration.automatic_checkpoint_naming = True
+
+    model = RegressionModel()
+    optimizer = optax.adam(0.1)
+    data = make_regression_data(96)
+    loader = accelerator.prepare_data_loader(
+        data, batch_size=args.batch_size, drop_last=True
+    )
+    model, optimizer = accelerator.prepare(model, optimizer)
+
+    def one_step(batch):
+        with accelerator.accumulate(model):
+            loss = accelerator.backward(regression_loss, batch)
+            optimizer.step()
+            optimizer.zero_grad()
+        return float(np.asarray(jax.device_get(loss)))
+
+    if args.phase == "verify":
+        resumed = accelerator.resume_from_latest(elastic=args.elastic or None)
+        print(f"resumed={resumed}", flush=True)
+        np.save(args.ref_out, _flat(model.params))
+        accelerator.end_training()
+        return
+
+    if args.phase in ("parity", "parity-resume"):
+        if args.phase == "parity-resume":
+            resumed = accelerator.resume_from_latest(elastic=args.elastic or None)
+            print(f"resumed={resumed}", flush=True)
+        losses = []
+        step = 0
+        while step < args.steps:
+            for batch in loader:
+                losses.append(one_step(batch))
+                step += 1
+                if args.phase == "parity" and step == args.save_at:
+                    accelerator.save_state()
+                if step >= args.steps:
+                    break
+        if args.losses_out:
+            np.save(args.losses_out, np.asarray(losses, dtype=np.float64))
+        np.save(args.ref_out, _flat(model.params))
+        np.save(args.ref_out + ".opt.npy", _flat(optimizer.opt_state))
+        accelerator.end_training()
+        return
+
+    # --phase train: replication kill-point arming, fault_save_script style.
+    batches = list(loader)
+    one_step(batches[0])
+    accelerator.save_state()  # checkpoint_0, mirrored synchronously
+    np.save(args.ref_out + ".step1.npy", _flat(model.params))
+    print("committed checkpoint_0", flush=True)
+
+    one_step(batches[1])
+    np.save(args.ref_out + ".step2.npy", _flat(model.params))
+    if args.fault != "none":
+        os.environ["ACCELERATE_TPU_FAULT_INJECT"] = args.fault
+    accelerator.save_state()  # checkpoint_1's replication hits the fault
+    # only reachable when the armed action doesn't kill the process
+    print("second save finished", flush=True)
+    accelerator.end_training()
+
+
+if __name__ == "__main__":
+    main()

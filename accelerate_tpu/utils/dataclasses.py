@@ -279,6 +279,61 @@ class TrainingHealthConfig(KwargsHandler):
 
 
 @dataclass
+class ReplicationConfig(KwargsHandler):
+    """Checkpoint replication policy for the elastic recovery subsystem
+    (``accelerate_tpu.elastic``; docs/fault_tolerance.md "Replication &
+    elastic resume").
+
+    After every atomic commit the main process hands the committed
+    checkpoint to a bounded background replicator that mirrors it —
+    manifest-verified, retried with exponential backoff — under ``target``
+    (durable storage that survives host loss: NFS, PD, a bucket mount).
+    On restore, a host whose local tree is missing or fails checksum
+    verification falls back to a replica, proving integrity against the
+    replica's own manifest before copying it back.
+
+    * ``target`` — root directory replicas are mirrored under. ``copies``
+      independent copies live at ``target/r0/…``, ``target/r1/…``.
+    * ``copies`` — how many mirror copies to maintain per checkpoint.
+    * ``async_replicate`` — mirror on a background thread (never blocks the
+      step loop; drained by ``end_training``/preemption/atexit like async
+      saves). ``False`` mirrors synchronously inside ``save_state`` and
+      raises mirror failures inline — deterministic, for tests and final
+      checkpoints.
+    * ``max_retries`` / ``retry_backoff_s`` — per-mirror retry budget and
+      initial backoff (doubles per attempt).
+    * ``verify`` — integrity level a freshly staged replica must pass
+      before its commit rename: ``"size"`` or ``"checksum"``.
+    * ``keep`` — replica retention: keep only the newest ``keep`` committed
+      replicas per copy dir (``None`` keeps everything).
+    """
+
+    target: str = ""
+    copies: int = 1
+    async_replicate: bool = True
+    max_retries: int = 3
+    retry_backoff_s: float = 0.25
+    verify: str = "checksum"
+    keep: Optional[int] = None
+
+    def __post_init__(self):
+        if not self.target:
+            raise ValueError("ReplicationConfig.target must be a non-empty path")
+        if self.copies < 1:
+            raise ValueError("copies must be a positive integer")
+        if self.max_retries < 0:
+            raise ValueError("max_retries must be >= 0")
+        if self.retry_backoff_s < 0:
+            raise ValueError("retry_backoff_s must be >= 0")
+        if self.verify not in ("size", "checksum"):
+            raise ValueError(
+                f"verify must be size|checksum, got {self.verify!r}"
+            )
+        if self.keep is not None and self.keep < 1:
+            raise ValueError("keep must be None or a positive integer")
+
+
+@dataclass
 class ServingConfig(KwargsHandler):
     """Policy knobs for :class:`accelerate_tpu.serving.InferenceServer`
     (docs/serving.md). Robustness-first defaults: bounded everything.

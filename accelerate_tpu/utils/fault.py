@@ -34,6 +34,9 @@ __all__ = [
     "CheckpointUncommittedError",
     "CheckpointCorruptError",
     "CheckpointComponentMissingError",
+    "CheckpointDivergedError",
+    "CheckpointTopologyError",
+    "ReplicaUnavailableError",
     "TrainingHealthError",
     "BarrierTimeoutError",
     "ServingError",
@@ -82,6 +85,25 @@ class CheckpointCorruptError(CheckpointError):
 class CheckpointComponentMissingError(CheckpointError):
     """A component the live training state requires (model_1, optimizer, …)
     has no counterpart in the checkpoint directory."""
+
+
+class CheckpointDivergedError(CheckpointError):
+    """Cluster-consensus resume found hosts disagreeing about the *content*
+    of the same checkpoint index (manifest digests differ), or holding
+    committed-checkpoint histories with no common index at all. Training
+    from skewed steps would silently fork the replicas; refuse instead."""
+
+
+class CheckpointTopologyError(CheckpointError):
+    """The checkpoint's commit manifest records a world topology
+    (``num_processes`` / device count) different from the live cluster and
+    the load was not requested with ``elastic=True``. Raised up front —
+    before orbax sees a single shard — naming both topologies."""
+
+
+class ReplicaUnavailableError(CheckpointError):
+    """A replica restore was required (local tree missing or corrupt) but no
+    replica copy passed manifest-checksum verification."""
 
 
 class TrainingHealthError(RuntimeError):
@@ -163,7 +185,12 @@ def fault_point(name: str) -> None:
 
     Checkpointing calls this at the named moments of the save lifecycle
     (``after_model_save``, ``after_optimizer_save``, ``before_commit``,
-    ``before_rename``, ``before_gc``); the serving loop at the named moments
+    ``before_rename``, ``before_gc``); the replication pipeline at the named
+    moments of a mirror's lifecycle (``before_replicate`` — post-commit,
+    before any mirror work; ``during_replicate`` — between file copies into
+    the replica staging dir; ``after_replicate`` — after a replica commit;
+    ``before_replica_restore`` — before copying a verified replica back over
+    a missing/corrupt local tree); the serving loop at the named moments
     of a batch's lifecycle (``serving_submit``, ``serving_before_batch``,
     ``serving_after_batch``, ``serving_before_reply``). The env var is read
     at call time so a test script can arm a point between two saves.
@@ -269,6 +296,12 @@ def _emergency_save(accelerator, signum: int) -> None:
         path = accelerator.save_state()
         logger.warning("emergency checkpoint committed at %s", path)
         print(f"emergency checkpoint committed at {path}", flush=True)
+        # A half-mirrored replica left behind by SIGTERM would sit as an
+        # uncommitted staging dir forever; join the replicator so the
+        # emergency checkpoint's mirror lands too.
+        drain = getattr(accelerator, "wait_for_replication", None)
+        if drain is not None:
+            drain()
     finally:
         try:
             accelerator.end_training()
@@ -306,6 +339,13 @@ def mark_save_finished(
         from ..checkpointing import wait_for_async_saves
 
         wait_for_async_saves()  # an async save's deferred commit must land
+        if accelerator is not None:
+            drain = getattr(accelerator, "wait_for_replication", None)
+            if drain is not None:
+                try:
+                    drain()
+                except Exception:
+                    pass  # exiting on preemption; replica gaps heal on resume
         if path is not None:
             print(f"emergency checkpoint committed at {path}", flush=True)
     finally:

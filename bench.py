@@ -617,6 +617,14 @@ if __name__ == "__main__":
         from benchmarks.telemetry_bench import main as telemetry_main
 
         sys.exit(telemetry_main(gate=True))
+    if "--recovery-gate" in sys.argv:
+        # elastic-recovery gate: MTTR per restore path (local / replica /
+        # elastic reshard) + consensus/replication steady-state overhead
+        # must stay within 5% of replication-off steps/s
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from benchmarks.recovery_bench import main as recovery_main
+
+        sys.exit(recovery_main(gate=True))
     if "--serving-gate" in sys.argv:
         # resilience gate: load ramp at 1x/2x/4x capacity + fault/recovery +
         # SIGTERM drain (docs/serving.md acceptance criteria)
