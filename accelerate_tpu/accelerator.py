@@ -842,13 +842,23 @@ class Accelerator:
                     path = _elastic.ensure_local_checkpoint(
                         self.replication_config, base
                     )
-                elif consensus.local_path is None:
+                elif consensus.missing_ranks:
+                    # SOME host lacks the consensus checkpoint. The fetch
+                    # path is collective (ensure_local_checkpoint gathers
+                    # internally), and missing_ranks is derived from the
+                    # gathered views — identical on every rank — so the
+                    # WHOLE gang enters it together, hosts that already
+                    # hold the tree included (they no-op inside), or the
+                    # whole gang raises together. Per-host branching on
+                    # local_path alone would let the holders skip the
+                    # fetch's collectives and wedge the job.
                     if self.replication_config is None:
                         from .utils.fault import ReplicaUnavailableError
 
                         raise ReplicaUnavailableError(
-                            f"host {self.process_index} does not hold the "
-                            f"consensus checkpoint_{consensus.index} and no "
+                            f"host(s) {sorted(consensus.missing_ranks)} do "
+                            f"not hold the consensus "
+                            f"checkpoint_{consensus.index} and no "
                             "ReplicationConfig is active to fetch it"
                         )
                     path = _elastic.ensure_local_checkpoint(

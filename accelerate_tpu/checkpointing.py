@@ -728,54 +728,130 @@ def load_accelerator_state(
     state = PartialState()
     wait_for_async_saves()  # ensure no half-written checkpoint is read
     input_dir = _resolve_for_load(accelerator, input_dir)
-    if not os.path.isdir(input_dir):
+    rc = getattr(accelerator, "replication_config", None)
+
+    # ---- presence: a PER-HOST fact (host-local checkpoint trees are the
+    # disk-loss scenario replication exists for), but every recovery path
+    # below contains collectives — so the verdict is gathered first and the
+    # whole gang enters the same branches together, or nobody does.
+    have_local = os.path.isdir(input_dir)
+    if state.num_processes > 1:
+        any_missing = not all(state.gather_object(have_local))
+    else:
+        any_missing = not have_local
+    if any_missing:
         # a same-name overwrite that died between its two renames parks the
-        # previous committed checkpoint at <dir>.old — recover it
-        parked = input_dir + CHECKPOINT_OLD_SUFFIX
-        if os.path.isdir(parked) and is_checkpoint_committed(parked):
-            logger.warning(
-                f"{input_dir} missing but committed {parked} found (save "
-                "interrupted mid-rename); recovering it"
-            )
-            if state.is_main_process:
+        # previous committed checkpoint at <dir>.old — recover it. Main
+        # recovers first; after the barrier each remaining host recovers
+        # its OWN parked tree (a shared-filesystem tree is already back by
+        # then, so the guarded rename no-ops).
+        def _recover_parked() -> None:
+            parked = input_dir + CHECKPOINT_OLD_SUFFIX
+            if (
+                not os.path.isdir(input_dir)
+                and os.path.isdir(parked)
+                and is_checkpoint_committed(parked)
+            ):
+                logger.warning(
+                    f"{input_dir} missing but committed {parked} found (save "
+                    "interrupted mid-rename); recovering it"
+                )
                 os.rename(parked, input_dir)
-            state.wait_for_everyone()
-        elif getattr(accelerator, "replication_config", None) is not None:
+
+        if state.is_main_process:
+            _recover_parked()
+        if state.num_processes > 1:
+            state.wait_for_everyone("accelerate_tpu.checkpointing.recover_parked")
+            if not state.is_main_process:
+                _recover_parked()
+            still_missing = not all(state.gather_object(os.path.isdir(input_dir)))
+        else:
+            still_missing = not os.path.isdir(input_dir)
+        if still_missing:
+            if rc is None:
+                raise CheckpointNotFoundError(
+                    f"checkpoint directory {input_dir} does not exist"
+                    if not os.path.isdir(input_dir)
+                    else f"checkpoint directory {input_dir} is missing on a "
+                    "peer host and no ReplicationConfig is active to fetch it"
+                )
             from .elastic import ensure_local_checkpoint
 
             logger.warning(
-                f"{input_dir} missing; attempting replica restore from "
-                f"{accelerator.replication_config.target}"
+                f"{input_dir} missing on at least one host; attempting "
+                f"replica restore from {rc.target}"
             )
             ensure_local_checkpoint(
-                accelerator.replication_config,
-                os.path.dirname(input_dir),
-                name=os.path.basename(input_dir),
+                rc, os.path.dirname(input_dir), name=os.path.basename(input_dir)
             )
-        else:
-            raise CheckpointNotFoundError(
-                f"checkpoint directory {input_dir} does not exist"
-            )
+
+    # ---- integrity: verify on EVERY rank first, then decide collectively.
+    # Corruption visible to only some hosts (host-local trees) must still
+    # route the whole gang through the same park+restore collectives, and
+    # no rename may happen until every rank has finished verifying — the
+    # gather below is that rendezvous (a rank racing its verify against
+    # main's rename would see the directory vanish mid-read).
+    verify_exc: Optional[CheckpointError] = None
     try:
         verify_checkpoint(input_dir, level=_verify_level(verify))
-    except CheckpointCorruptError:
-        rc = getattr(accelerator, "replication_config", None)
-        if rc is None:
-            raise
-        # the local bytes are damaged: park them out of the way and pull a
-        # checksum-verified replica over the same name
+    except CheckpointError as exc:
+        verify_exc = exc
+    my_verdict = (
+        None
+        if verify_exc is None
+        else (
+            isinstance(verify_exc, CheckpointCorruptError),
+            f"{type(verify_exc).__name__}: {verify_exc}",
+        )
+    )
+    verdicts = (
+        state.gather_object(my_verdict)
+        if state.num_processes > 1
+        else [my_verdict]
+    )
+    failed = [(r, v) for r, v in enumerate(verdicts) if v is not None]
+    if failed:
+        # replica healing applies only to CORRUPT trees; every other verify
+        # failure (uncommitted, unreadable manifest) raises as before — but
+        # on EVERY rank, so one host's failure cannot strand its peers in
+        # the next collective.
+        if rc is None or not all(corrupt for _r, (corrupt, _m) in failed):
+            if verify_exc is not None:
+                raise verify_exc
+            detail = "; ".join(f"rank {r}: {m}" for r, (_c, m) in failed)
+            cls = (
+                CheckpointCorruptError
+                if all(corrupt for _r, (corrupt, _m) in failed)
+                else CheckpointError
+            )
+            raise cls(
+                f"checkpoint {input_dir} failed verification on peer "
+                f"host(s): {detail}"
+            )
+        # damaged bytes on at least one host: park the corrupt tree(s) out
+        # of the way and pull a checksum-verified replica over the same
+        # name. Main parks first; after the barrier each remaining corrupt
+        # host parks its OWN tree (on shared storage it is already gone).
         from .elastic import ensure_local_checkpoint
 
         logger.warning(
-            f"local checkpoint {input_dir} is corrupt; restoring from "
+            f"local checkpoint {input_dir} is corrupt on "
+            f"{len(failed)}/{state.num_processes} host(s); restoring from "
             f"replica {rc.target}"
         )
-        if state.is_main_process and os.path.isdir(input_dir):
-            corrupt = input_dir + ".corrupt"
-            shutil.rmtree(corrupt, ignore_errors=True)
-            os.rename(input_dir, corrupt)
+
+        def _park_corrupt() -> None:
+            if os.path.isdir(input_dir):
+                corrupt = input_dir + ".corrupt"
+                shutil.rmtree(corrupt, ignore_errors=True)
+                os.rename(input_dir, corrupt)
+
+        if state.is_main_process and verify_exc is not None:
+            _park_corrupt()
         if state.num_processes > 1:
             state.wait_for_everyone("accelerate_tpu.elastic.park_corrupt")
+            if not state.is_main_process and verify_exc is not None:
+                _park_corrupt()
         ensure_local_checkpoint(
             rc, os.path.dirname(input_dir), name=os.path.basename(input_dir)
         )

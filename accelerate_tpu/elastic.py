@@ -136,12 +136,17 @@ class ConsensusResult:
 
     ``local_path`` is ``None`` when this host does not hold the consensus
     checkpoint locally (empty or lagging tree) and must fetch it from a
-    replica before loading.
+    replica before loading. ``missing_ranks`` lists every rank whose local
+    tree lacks the consensus checkpoint — derived from the gathered views,
+    so it is identical on every rank and the "does anyone need a replica
+    fetch" decision is collective (a fetch path containing collectives must
+    be entered by the whole gang or by nobody).
     """
 
     index: int
     digest: str
     local_path: Optional[str]
+    missing_ranks: tuple = ()
 
 
 def _consensus_from_views(views: list, base: str, rank: int) -> Optional[ConsensusResult]:
@@ -191,7 +196,10 @@ def _consensus_from_views(views: list, base: str, rank: int) -> Optional[Consens
         if index in mine
         else None
     )
-    return ConsensusResult(index=index, digest=digest, local_path=local_path)
+    missing = tuple(r for r, v in enumerate(views) if index not in v)
+    return ConsensusResult(
+        index=index, digest=digest, local_path=local_path, missing_ranks=missing
+    )
 
 
 def resolve_consensus_checkpoint(base: str) -> Optional[ConsensusResult]:
@@ -374,10 +382,12 @@ class CheckpointReplicator:
 
     def _mirror_with_retry(self, src: str) -> None:
         name = os.path.basename(src.rstrip(os.sep))
-        last: Optional[BaseException] = None
+        failures: list = []
+        succeeded = 0
         for root in _copy_roots(self.config):
             os.makedirs(root, exist_ok=True)
             dst = os.path.join(root, name)
+            last: Optional[BaseException] = None
             for attempt in range(self.config.max_retries + 1):
                 try:
                     _mirror_one(src, dst, self.config)
@@ -395,13 +405,28 @@ class CheckpointReplicator:
                     )
                     time.sleep(backoff)
             if last is not None:
-                raise last
+                # an exhausted slot must not cost the OTHER slots their
+                # fresh copy — that would zero out redundancy exactly when
+                # one mirror target is degraded; record and keep mirroring
+                logger.warning(f"replica slot {dst} exhausted retries: {last}")
+                failures.append((dst, last))
+                continue
+            succeeded += 1
             if self.config.keep:
                 _gc_replicas(root, self.config.keep)
+        if failures:
+            if len(failures) == 1 and succeeded == 0:
+                raise failures[0][1]
+            detail = "; ".join(f"{dst}: {exc}" for dst, exc in failures)
+            raise CheckpointError(
+                f"replica mirror of {src} failed for {len(failures)}/"
+                f"{self.config.copies} copy slot(s) "
+                f"({succeeded} succeeded): {detail}"
+            ) from failures[0][1]
         fault_point("after_replicate")
         logger.info(
-            f"replicated {src} to {self.config.copies} "
-            f"cop{'y' if self.config.copies == 1 else 'ies'} under "
+            f"replicated {src} to {succeeded} "
+            f"cop{'y' if succeeded == 1 else 'ies'} under "
             f"{self.config.target}"
         )
 
@@ -487,6 +512,20 @@ def restore_from_replica(
     )
 
 
+def _rehydrate_error(kind: str, msg: str) -> CheckpointError:
+    """Rebuild a peer's typed checkpoint error from its gathered
+    ``(class name, message)`` verdict, so every rank raises the SAME
+    taxonomy error (``CheckpointNotFoundError`` stays a
+    ``FileNotFoundError`` subclass on every rank — ``resume_from_latest``
+    turns it into a uniform "first launch" False gang-wide)."""
+    from .utils import fault as _fault
+
+    cls = getattr(_fault, kind, None)
+    if not (isinstance(cls, type) and issubclass(cls, CheckpointError)):
+        cls = ReplicaUnavailableError
+    return cls(msg)
+
+
 def ensure_local_checkpoint(
     config: ReplicationConfig,
     local_base: str,
@@ -495,10 +534,19 @@ def ensure_local_checkpoint(
 ) -> str:
     """Make the named checkpoint (or, with ``name=None``, the newest
     committed replica) present and committed in ``local_base``, fetching
-    from a replica when missing. Collective-safe: on a shared filesystem
-    the main process performs the copy and everyone else picks it up after
-    the barrier; on host-local disks each host that is still missing the
-    tree after the barrier restores its own.
+    from a replica when missing.
+
+    Collective: in a multi-process job EVERY rank must call this together —
+    including ranks that already hold the tree (they no-op internally after
+    the verdict exchange). The main process resolves/restores first, and its
+    outcome — the target checkpoint name, or a typed failure — travels to
+    every rank as DATA through the collective gather rather than being
+    thrown past it: a failed restore (e.g. first launch with replication
+    configured but no replicas yet) raises the same taxonomy error on every
+    rank instead of stranding peers at a rendezvous main never reaches.
+    Each remaining host then fetches its own copy (host-local disks) or
+    picks up main's restore (shared filesystem), and a second collective
+    verdict surfaces any per-host failure gang-wide.
     """
     from .checkpointing import is_checkpoint_committed
 
@@ -508,55 +556,55 @@ def ensure_local_checkpoint(
         path = os.path.join(local_base, nm)
         return path if is_checkpoint_committed(path) else None
 
-    if name is not None and _local(name):
-        return os.path.join(local_base, name)
-
-    restored: Optional[str] = None
-    if state.is_main_process:
-        if name is None or _local(name) is None:
-            restored = restore_from_replica(
-                config, local_base, name=name, expected_digest=expected_digest
-            )
-    if state.num_processes > 1:
-        state.wait_for_everyone("accelerate_tpu.elastic.replica_restore")
-        if restored is None:
-            # main restored `name=None` to some index; on a shared
-            # filesystem its restore is now the newest local committed
-            # checkpoint, otherwise every host re-derives the same name
-            # from the (shared, identical-bytes) replica target ordering
-            target_name = name
-            if target_name is None:
-                from .checkpointing import list_checkpoints
-
-                local = list_checkpoints(local_base, committed_only=True)
-                if local:
-                    return local[-1]
-                cands = _replica_candidates(config, None)
-                if not cands:
-                    raise ReplicaUnavailableError(
-                        f"no committed replica under {config.target}"
-                    )
-                target_name = os.path.basename(cands[0])
-            if _local(target_name) is None:
-                # host-local disk: this host fetches its own copy
-                restored = restore_from_replica(
-                    config,
-                    local_base,
-                    name=target_name,
-                    expected_digest=expected_digest,
-                )
-            else:
-                restored = os.path.join(local_base, target_name)
-    if restored is None:
-        # single-process and nothing restored: the tree was already present
+    if state.num_processes <= 1:
         if name is not None and _local(name):
-            restored = os.path.join(local_base, name)
-        else:
-            raise ReplicaUnavailableError(
-                f"replica restore produced no local checkpoint under "
-                f"{local_base}"
+            return os.path.join(local_base, name)
+        return restore_from_replica(
+            config, local_base, name=name, expected_digest=expected_digest
+        )
+
+    verdict: dict = {}
+    if state.is_main_process:
+        try:
+            if name is not None and _local(name):
+                restored = os.path.join(local_base, name)
+            else:
+                restored = restore_from_replica(
+                    config, local_base, name=name, expected_digest=expected_digest
+                )
+            verdict = {"name": os.path.basename(restored)}
+        except CheckpointError as exc:
+            verdict = {"error": type(exc).__name__, "msg": str(exc)}
+    verdict = state.gather_object(verdict)[0]
+    if "error" in verdict:
+        raise _rehydrate_error(verdict["error"], verdict["msg"])
+    target_name = verdict["name"]
+
+    # the gather above doubles as the post-restore rendezvous: main's copy
+    # is fully committed (staged + renamed) before its verdict is readable,
+    # so on a shared filesystem _local() already sees it here
+    failure: Optional[tuple] = None
+    restored_path = _local(target_name)
+    if restored_path is None:
+        try:
+            # host-local disk: main's restore did not land on this host
+            restored_path = restore_from_replica(
+                config, local_base, name=target_name, expected_digest=expected_digest
             )
-    return restored
+        except CheckpointError as exc:
+            failure = (type(exc).__name__, str(exc))
+    # second collective verdict: a host that could not materialize the tree
+    # fails the WHOLE gang here, uniformly, instead of throwing past the
+    # peers' next collective
+    outcomes = state.gather_object(failure)
+    bad = [(r, f) for r, f in enumerate(outcomes) if f is not None]
+    if bad:
+        detail = "; ".join(f"rank {r}: {kind}: {msg}" for r, (kind, msg) in bad)
+        raise ReplicaUnavailableError(
+            f"replica restore of {target_name} failed on {len(bad)}/"
+            f"{state.num_processes} host(s): {detail}"
+        )
+    return restored_path
 
 
 # ------------------------------------------------------------------- topology

@@ -100,6 +100,22 @@ def _coordination_client():
 # collective (every process reaches the same sites in the same order).
 _BARRIER_SEQ: dict = {}
 
+# The coordination service requires a FINITE wait on every blocking call,
+# so "unbounded" (ACCELERATE_BARRIER_TIMEOUT unset or 0) becomes a 7-day
+# sentinel — long enough to outlive any real recovery window, and the
+# error message says so instead of promising an unbounded wait the
+# service cannot deliver.
+_UNBOUNDED_WAIT_MS = 7 * 24 * 3_600_000
+
+
+def _service_wait_ms(timeout: Optional[float]) -> int:
+    """Milliseconds bound for a coordination-service blocking call,
+    honoring ``ACCELERATE_BARRIER_TIMEOUT`` when ``timeout`` is None."""
+    if timeout is None:
+        raw = os.environ.get("ACCELERATE_BARRIER_TIMEOUT", "")
+        timeout = float(raw) if raw else None
+    return int(timeout * 1000) if timeout and timeout > 0 else _UNBOUNDED_WAIT_MS
+
 
 def _coordination_barrier(client, tag: str, timeout: Optional[float]) -> None:
     """Host-level barrier over the coordination service (pure gRPC — no XLA
@@ -109,17 +125,23 @@ def _coordination_barrier(client, tag: str, timeout: Optional[float]) -> None:
     there (a gang restart is exactly when the cluster is least healthy)."""
     seq = _BARRIER_SEQ.get(tag, 0)
     _BARRIER_SEQ[tag] = seq + 1
-    # the service requires a finite timeout; "unbounded" becomes 1h
-    ms = int(timeout * 1000) if timeout and timeout > 0 else 3_600_000
+    bounded = bool(timeout and timeout > 0)
+    ms = _service_wait_ms(timeout)
     try:
         client.wait_at_barrier(f"{tag}#{seq}", ms)
     except Exception as e:  # noqa: BLE001 — typed below
         from .utils.fault import BarrierTimeoutError
 
+        hint = (
+            "(set ACCELERATE_BARRIER_TIMEOUT=0 to wait the coordination "
+            "service's 7-day cap — the service requires a finite bound)"
+            if bounded
+            else "(this was the 7-day 'unbounded' cap; the coordination "
+            "service requires a finite bound)"
+        )
         raise BarrierTimeoutError(
             f"barrier {tag!r} did not complete within {ms / 1000:g}s — a "
-            "peer process is likely dead or wedged (set "
-            "ACCELERATE_BARRIER_TIMEOUT=0 to restore unbounded waits)"
+            f"peer process is likely dead or wedged {hint}"
         ) from e
 
 

@@ -248,6 +248,40 @@ def test_sync_replication_failure_raises_after_retries(tmp_path, monkeypatch):
     )
 
 
+def test_degraded_slot_does_not_cost_healthy_slots_their_copy(tmp_path, monkeypatch):
+    """Regression: a persistently failing r0 must not skip r1's mirror —
+    every copy slot is attempted independently and the failure is raised
+    (aggregated) only after all slots were tried."""
+    import accelerate_tpu.elastic as elastic_mod
+    from accelerate_tpu.checkpointing import is_checkpoint_committed
+    from accelerate_tpu.utils.fault import CheckpointError
+
+    acc = _fresh(
+        tmp_path / "proj",
+        replication_config=_sync_config(
+            tmp_path, copies=2, max_retries=0, retry_backoff_s=0.0
+        ),
+    )
+    model, optimizer, loader = _prepared(acc)
+    _one_step(acc, model, optimizer, next(iter(loader)))
+
+    real_mirror = elastic_mod._mirror_one
+
+    def _r0_down(src, dst, config):
+        if f"{os.sep}r0{os.sep}" in dst:
+            raise OSError("r0 volume gone")
+        real_mirror(src, dst, config)
+
+    monkeypatch.setattr(elastic_mod, "_mirror_one", _r0_down)
+    with pytest.raises(CheckpointError, match=r"1/2 copy slot"):
+        acc.save_state()
+    # the healthy slot got its fresh copy despite r0's failure
+    assert is_checkpoint_committed(
+        str(tmp_path / "replica" / "r1" / "checkpoint_0")
+    )
+    assert not os.path.isdir(tmp_path / "replica" / "r0" / "checkpoint_0")
+
+
 # ------------------------------------------------------------ replica restore
 def test_resume_restores_bit_identical_from_replica_after_tree_wipe(tmp_path):
     proj = tmp_path / "proj"
@@ -417,6 +451,22 @@ def test_consensus_empty_host_fetches_from_replica(tmp_path):
     assert res1.local_path.endswith("checkpoint_1")
 
     assert _consensus_from_views([{}, {}], str(tmp_path), rank=0) is None
+
+
+def test_consensus_missing_ranks_is_identical_on_every_rank(tmp_path):
+    """missing_ranks drives the collective fetch decision — derived from the
+    gathered views, it must be the same tuple on every rank (the fetch path
+    contains collectives, so holders and non-holders must branch together)."""
+    from accelerate_tpu.elastic import _consensus_from_views
+
+    views = [{0: "a", 1: "b"}, {0: "a"}, {}]  # rank 1 lags, rank 2 wiped
+    for rank in range(3):
+        res = _consensus_from_views(views, str(tmp_path), rank=rank)
+        assert res.index == 0
+        assert res.missing_ranks == (2,)
+    full = [{1: "b"}, {1: "b"}]
+    for rank in range(2):
+        assert _consensus_from_views(full, str(tmp_path), rank=rank).missing_ranks == ()
 
 
 def test_consensus_digest_mismatch_is_divergence(tmp_path):
@@ -748,3 +798,78 @@ def test_host_loss_with_world_size_change_resumes_via_replica(tmp_path):
     # bit-exact: the resumed trajectory must MATCH, not approximate
     np.testing.assert_array_equal(np.load(resumed_losses), np.load(ref_losses)[2:])
     np.testing.assert_array_equal(np.load(resumed_params), np.load(ref_params))
+
+
+def _cluster_first_launch_body(project, replica):
+    from accelerate_tpu import Accelerator as _Accelerator
+    from accelerate_tpu.utils.dataclasses import ReplicationConfig as _RC
+
+    acc = _Accelerator(
+        project_dir=project,
+        replication_config=_RC(target=replica, async_replicate=False),
+    )
+    acc.project_configuration.automatic_checkpoint_naming = True
+    assert acc.num_processes == 2
+    assert acc.resume_from_latest() is False
+    acc.end_training()
+
+
+@pytest.mark.slow
+def test_first_launch_with_replication_multiprocess_returns_false(tmp_path):
+    """Regression: first launch with replication configured but no replicas
+    yet must return False on EVERY rank. Main's restore_from_replica used to
+    raise CheckpointNotFoundError past the replica-restore rendezvous,
+    wedging the other ranks at it (up to the coordination-service cap)
+    while main started training — the consensus failure now travels to
+    every rank as data and the whole gang agrees it is a first launch."""
+    from accelerate_tpu.launchers import _free_port, _spawn_cluster
+
+    _spawn_cluster(
+        _cluster_first_launch_body,
+        (str(tmp_path / "proj"), str(tmp_path / "replica")),
+        num_processes=2, local_devices=1, port=_free_port(), timeout=120,
+    )
+
+
+def _cluster_corrupt_heal_body(project, replica):
+    import os as _os
+
+    from accelerate_tpu import Accelerator as _Accelerator
+    from accelerate_tpu.utils.dataclasses import ReplicationConfig as _RC
+
+    acc = _Accelerator(
+        project_dir=project,
+        replication_config=_RC(target=replica, async_replicate=False),
+    )
+    acc.project_configuration.automatic_checkpoint_naming = True
+    assert acc.num_processes == 2
+    trainer = _NumpySGD()
+    acc.register_for_checkpointing(trainer)
+    trainer.train_step()
+    ckpt = acc.save_state()
+    trainer.a, trainer.b, trainer.step = 99.0, 99.0, 99
+    if acc.is_main_process:
+        # same-size bit-flip: only the checksum proof can catch it
+        victim = _os.path.join(ckpt, "custom_checkpoint_0.pkl")
+        size = _os.path.getsize(victim)
+        with open(victim, "wb") as f:
+            f.write(b"X" * size)
+    acc.wait_for_everyone()
+    acc.load_state(ckpt, verify="checksum")  # collective park + replica heal
+    assert trainer.step == 1, trainer.step
+    acc.end_training()
+
+
+@pytest.mark.slow
+def test_corrupt_checkpoint_healed_collectively_in_cluster(tmp_path):
+    """A corrupt tree discovered at load time in a multi-process job routes
+    the WHOLE gang through the same verify-verdict gather, park barrier, and
+    collective replica restore — no rank renames until every rank has
+    finished verifying, and no rank skips the restore collectives."""
+    from accelerate_tpu.launchers import _free_port, _spawn_cluster
+
+    _spawn_cluster(
+        _cluster_corrupt_heal_body,
+        (str(tmp_path / "proj"), str(tmp_path / "replica")),
+        num_processes=2, local_devices=1, port=_free_port(), timeout=180,
+    )

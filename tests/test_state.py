@@ -98,3 +98,21 @@ def test_barrier_timeout_fast_path_and_error_propagation():
 def test_wait_for_everyone_single_process_ignores_timeout_env(monkeypatch):
     monkeypatch.setenv("ACCELERATE_BARRIER_TIMEOUT", "0.01")
     PartialState().wait_for_everyone()  # no-op, no thread, no raise
+
+
+def test_service_wait_ms_honors_configured_timeout(monkeypatch):
+    """The coordination service requires a finite bound on every blocking
+    call: 'unbounded' becomes the 7-day sentinel, and a configured
+    ACCELERATE_BARRIER_TIMEOUT is honored by barriers AND KV allgathers."""
+    from accelerate_tpu.state import _UNBOUNDED_WAIT_MS, _service_wait_ms
+
+    monkeypatch.delenv("ACCELERATE_BARRIER_TIMEOUT", raising=False)
+    assert _service_wait_ms(None) == _UNBOUNDED_WAIT_MS
+    assert _service_wait_ms(0) == _UNBOUNDED_WAIT_MS
+    assert _service_wait_ms(2.5) == 2500
+    monkeypatch.setenv("ACCELERATE_BARRIER_TIMEOUT", "3")
+    assert _service_wait_ms(None) == 3000  # env honored, not a 1h cap
+    monkeypatch.setenv("ACCELERATE_BARRIER_TIMEOUT", "0")
+    assert _service_wait_ms(None) == _UNBOUNDED_WAIT_MS
+    # an explicit timeout wins over the env
+    assert _service_wait_ms(1.0) == 1000
