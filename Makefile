@@ -9,7 +9,7 @@
 PY := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu python
 PY_SLOW := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu RUN_SLOW=1 python
 
-.PHONY: test test_all test_core test_data test_parallel test_models test_cli test_big_modeling test-fault test-serving bench bench-telemetry bench-serving bench-continuous bench-recovery
+.PHONY: test test_all test_core test_data test_parallel test_models test_cli test_big_modeling test-fault test-serving bench bench-telemetry bench-serving bench-continuous bench-recovery bench-kv
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -25,9 +25,10 @@ test-fault:
 # resilient-serving suite (docs/serving.md): dynamic batching, deadline
 # shedding, backpressure, retry/backoff, circuit breaker, SIGTERM drain,
 # fault-injected batch death (exactly-once replies), plus the continuous-
-# batching engine (slot lifecycle, seed reproducibility, mode parity)
+# batching engine (slot lifecycle, seed reproducibility, mode parity) and
+# the paged KV-cache subsystem (block tables, COW prefix cache, int8 KV)
 test-serving:
-	$(PY) -m pytest tests/test_serving.py tests/test_engine.py -q
+	$(PY) -m pytest tests/test_serving.py tests/test_engine.py tests/test_kvcache.py -q
 
 test_all:
 	$(PY_SLOW) -m pytest tests/test_state.py tests/test_operations.py tests/test_parallelism_config.py tests/test_accelerator.py tests/test_checkpointing.py tests/test_tracking.py tests/test_data_loader.py tests/test_data_shard_info.py tests/test_misc.py tests/test_cli.py tests/test_big_modeling.py tests/test_losses.py tests/test_flatbuf.py tests/test_local_sgd.py tests/test_api_parity.py tests/test_hlo_analysis.py tests/test_tracking_fakes.py tests/test_powersgd.py -q
@@ -73,6 +74,13 @@ bench-serving:
 # two compiled engine programs, bitwise output parity (docs/serving.md)
 bench-continuous:
 	$(PY) benchmarks/continuous_bench.py --gate
+
+# paged KV-cache gate: a paged engine must admit >= 4x the concurrent slots
+# of dense at ~equal pool HBM with bitwise greedy parity and <= 2 compiled
+# programs; COW prefix caching must dedup >= 90% of shared-system-prompt
+# blocks; int8 KV must be bitwise run-to-run deterministic (docs/serving.md)
+bench-kv:
+	$(PY) benchmarks/continuous_bench.py --kv-gate
 
 # elastic-recovery gate: MTTR per restore path (local / replica / elastic
 # reshard, restart-to-resumed wall clock) + consensus/replication must stay

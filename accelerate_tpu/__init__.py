@@ -48,6 +48,13 @@ _LAZY = {
     "last_generate_stats": ("inference", "last_generate_stats"),
     "ContinuousBatchingEngine": ("engine", "ContinuousBatchingEngine"),
     "SlotOccupant": ("engine", "SlotOccupant"),
+    "KVCacheBackend": ("kvcache", "KVCacheBackend"),
+    "DenseKVBackend": ("kvcache", "DenseKVBackend"),
+    "PagedKVBackend": ("kvcache", "PagedKVBackend"),
+    "PagedBlockPool": ("kvcache", "PagedBlockPool"),
+    "PagedKVLayout": ("kvcache", "PagedKVLayout"),
+    "make_kv_backend": ("kvcache", "make_kv_backend"),
+    "KV_BACKENDS": ("kvcache", "KV_BACKENDS"),
     "InferenceServer": ("serving", "InferenceServer"),
     "ServingResult": ("serving", "ServingResult"),
     "ServingMetrics": ("serving", "ServingMetrics"),

@@ -9,13 +9,17 @@ budget, sampling-branch flags, seed for sampled traffic), and every batch
 then runs its full fused prefill+decode scan to ``max_new_tokens`` even if
 every row hit EOS at step 3. This module removes all three costs at once:
 
-* **Slot-based KV arena** — a fixed ``(layers, slots, max_len, kv_heads,
-  head_dim)`` per-layer KV buffer plus per-slot ``pos/done/budget/token``
-  vectors and per-slot sampling params (temperature, top_k, top_p, eos id,
-  PRNG key). Mixed greedy/sampled/any-seed traffic shares ONE compiled
-  decode program: sampling params are per-row traced operands, not compile
-  keys, so the seed and ``max_new_tokens`` group-key fragmentation of the
-  static path disappears entirely.
+* **Slot-based KV store** — per-slot ``pos/done/budget/token`` vectors and
+  per-slot sampling params (temperature, top_k, top_p, eos id, PRNG key)
+  over a :mod:`~accelerate_tpu.kvcache` backend: ``dense`` (a fixed
+  ``(layers, slots, max_len, kv_heads, head_dim)`` arena), ``paged``
+  (shared block pool + per-slot block tables + copy-on-write prefix
+  caching — admission gated on free *blocks*, so HBM stops reserving every
+  slot's worst case), or ``paged_int8`` (int8 pool with per-block scales).
+  Mixed greedy/sampled/any-seed traffic shares ONE compiled decode
+  program: sampling params are per-row traced operands, not compile keys,
+  so the seed and ``max_new_tokens`` group-key fragmentation of the static
+  path disappears entirely.
 * **Exactly two jitted programs** per (slots, max_len) configuration:
   ``prefill_insert`` (bucketed prompt forward via the models'
   ``*_prefill_at``, then scatter its KV rows into a free arena slot with
@@ -151,8 +155,12 @@ class ContinuousBatchingEngine:
         max_len: int = 256,
         prompt_bucket: Optional[int] = None,
         readback_lag: int = 2,
+        kv_cache: str = "dense",
+        block_size: int = 16,
+        pool_blocks: Optional[int] = None,
         clock: Callable[[], float] = time.monotonic,
     ):
+        from .kvcache import make_kv_backend
         from .models.gpt2 import GPT2Config, gpt2_decode_step, gpt2_prefill_at
         from .models.llama import llama_decode_step, llama_prefill_at
 
@@ -174,6 +182,11 @@ class ContinuousBatchingEngine:
             )
         self.readback_lag = readback_lag
         self._clock = clock
+        self._backend = make_kv_backend(
+            kv_cache, config=self.config, slots=slots, max_len=max_len,
+            prompt_bucket=self.prompt_bucket, block_size=block_size,
+            pool_blocks=pool_blocks,
+        )
         if isinstance(self.config, GPT2Config):
             self._prefill_at_fn, self._decode_fn = gpt2_prefill_at, gpt2_decode_step
         else:
@@ -189,6 +202,7 @@ class ContinuousBatchingEngine:
 
         self._occupants: List[Optional[SlotOccupant]] = [None] * slots
         self._free: List[int] = list(range(slots))
+        self.peak_live = 0
         # deferred-readback ring: (tick, kind, payload) — the same
         # K-programs-late trick as telemetry's DeferredReadbackRing, here
         # over (token, done) vectors instead of health verdicts
@@ -204,14 +218,13 @@ class ContinuousBatchingEngine:
 
     # ----------------------------------------------------------- state init
     def _init_state(self):
-        cfg = self.config
-        kvh = getattr(cfg, "num_key_value_heads", None) or cfg.num_attention_heads
-        shape = (cfg.num_hidden_layers, self.slots, self.max_len, kvh, cfg.head_dim)
-        cdt = cfg.compute_dtype
         s = self.slots
         keys = jax.random.split(jax.random.key(0), s)
         donated = {
-            "cache": {"k": jnp.zeros(shape, cdt), "v": jnp.zeros(shape, cdt)},
+            # dense: the (L, S, max_len, kvh, hd) arena; paged: the shared
+            # block pool (+ per-block scales when int8) — either way donated
+            # across programs so steady-state decode reallocates nothing
+            "cache": self._backend.init_device_state(),
             "pos": jnp.zeros((s,), jnp.int32),
             "key": jax.random.key_data(keys),  # (S, key_width) uint32
         }
@@ -230,10 +243,21 @@ class ContinuousBatchingEngine:
         return donated, carried
 
     # ------------------------------------------------------------- programs
-    def _decode_impl(self, donated, carried, params):
+    def _decode_impl(self, donated, carried, params, tables):
         cache, pos, key_data = donated["cache"], donated["pos"], donated["key"]
         token, done = carried["token"], carried["done"]
-        logits, cache = self._decode_fn(self.config, params, cache, token[:, None], pos)
+        # tables are traced OPERANDS (shape static per config): paged table
+        # churn — admissions, retirements, COW sharing — never recompiles,
+        # preserving the exactly-two-programs discipline
+        layout = self._backend.make_layout(tables)
+        if layout is None:
+            logits, cache = self._decode_fn(
+                self.config, params, cache, token[:, None], pos
+            )
+        else:
+            logits, cache = self._decode_fn(
+                self.config, params, cache, token[:, None], pos, kv_layout=layout
+            )
         pairs = jax.vmap(jax.random.split)(jax.random.wrap_key_data(key_data))
         next_kd = jax.random.key_data(pairs[:, 0])
         subs = pairs[:, 1]
@@ -250,12 +274,14 @@ class ContinuousBatchingEngine:
 
     def _prefill_impl(
         self, donated, carried, params, prompt, length, slot, key_data,
-        temp, top_k, top_p, eos, pad, budget,
+        temp, top_k, top_p, eos, pad, budget, table_row,
     ):
-        # bucketed prompt forward; logits at the last REAL position. The
-        # returned cache is max_len wide with zeros beyond the bucket, so
-        # scattering it wipes every stale byte of the slot's previous
-        # occupant — KV isolation across slot reuse is structural.
+        # bucketed prompt forward; logits at the last REAL position. Dense:
+        # the returned max_len-wide cache (zeros beyond the bucket) scatters
+        # over the full slot row, wiping every stale byte of the previous
+        # occupant. Paged: per-block dynamic_update_slice writes into the
+        # slot's table-row blocks (recycled blocks rely on the write-before-
+        # attend invariant instead of a wipe — kvcache.py docstring).
         logits, new_cache = self._prefill_at_fn(
             self.config, params, prompt, self.max_len, (length - 1)[None]
         )
@@ -264,18 +290,9 @@ class ContinuousBatchingEngine:
         hit_eos = (eos >= 0) & (t0 == eos)
         budget_left = budget - 1
         done0 = hit_eos | (budget_left <= 0)
-        cache = {
-            "k": lax.dynamic_update_slice(
-                donated["cache"]["k"],
-                new_cache["k"].astype(donated["cache"]["k"].dtype),
-                (0, slot, 0, 0, 0),
-            ),
-            "v": lax.dynamic_update_slice(
-                donated["cache"]["v"],
-                new_cache["v"].astype(donated["cache"]["v"].dtype),
-                (0, slot, 0, 0, 0),
-            ),
-        }
+        cache = self._backend.prefill_write(
+            donated["cache"], new_cache, slot, table_row
+        )
         new_donated = {
             "cache": cache,
             "pos": donated["pos"].at[slot].set(length),
@@ -325,6 +342,21 @@ class ContinuousBatchingEngine:
                 f"exceeds the KV arena length ({self.max_len}); raise "
                 "ServingConfig.engine_max_len or lower the budget"
             )
+        # backend-specific structural checks (paged: the request's block
+        # count must fit the pool — names engine_block_size / pool blocks)
+        self._backend.validate_request(prompt_len, max_new_tokens)
+
+    def can_admit(self, prompt, max_new_tokens: int) -> bool:
+        """True when a slot AND the KV capacity for this request are free
+        right now. Dense backends only need the slot; paged backends also
+        need ``ceil((prompt+budget)/block_size)`` blocks net of COW
+        prefix hits. The scheduler gates admission here instead of on
+        ``free_slots()`` alone."""
+        if not self._free:
+            return False
+        return self._backend.can_admit(
+            np.asarray(prompt, dtype=np.int32).reshape(-1), max_new_tokens
+        )
 
     def insert(
         self,
@@ -346,6 +378,14 @@ class ContinuousBatchingEngine:
         if not self._free:
             raise RuntimeError("no free arena slot (caller must gate on free_slots())")
         slot = self._free.pop()
+        try:
+            # paged: allocate/COW-share the request's blocks and install the
+            # slot's table row; raises RuntimeError when the pool is out of
+            # blocks (callers gate on can_admit()). Dense: a no-op row.
+            table_row, _shared = self._backend.acquire(slot, prompt, max_new_tokens)
+        except BaseException:
+            self._free.append(slot)
+            raise
         padded = np.zeros((1, self.prompt_bucket), np.int32)
         padded[0, : len(prompt)] = prompt
         pad_id = (
@@ -362,6 +402,7 @@ class ContinuousBatchingEngine:
             jnp.float32(top_p if top_p is not None else 1.0),
             jnp.int32(eos_token_id if eos_token_id is not None else -1),
             jnp.int32(pad_id), jnp.int32(max_new_tokens),
+            jnp.asarray(table_row),
         )
         occ = SlotOccupant(
             slot=slot, tag=tag, prompt=prompt, budget=max_new_tokens,
@@ -369,6 +410,7 @@ class ContinuousBatchingEngine:
         )
         self._occupants[slot] = occ
         self.inserted += 1
+        self.peak_live = max(self.peak_live, self.live_count())
         self._tick += 1
         self._ring.append((self._tick, "prefill", (occ, t0, d0)))
         return occ
@@ -380,7 +422,8 @@ class ContinuousBatchingEngine:
             return False
         self._record("decode_step", ())
         self._donated, self._carried = self._decode_jit(
-            self._donated, self._carried, self.model.params
+            self._donated, self._carried, self.model.params,
+            self._backend.device_tables(),
         )
         self.steps += 1
         self._tick += 1
@@ -429,6 +472,11 @@ class ContinuousBatchingEngine:
         occ.finished = True
         self._occupants[occ.slot] = None
         self._free.append(occ.slot)
+        # drops block refcounts AND resets the slot's table row to the null
+        # block, so the ghost slot's masked decode writes (it rides every
+        # step until a new prefill resets it) land in the garbage sink, not
+        # in blocks recycled to someone else
+        self._backend.release(occ.slot)
         self.retired += 1
         retired.append(occ)
 
@@ -442,6 +490,7 @@ class ContinuousBatchingEngine:
         if self._occupants[occ.slot] is occ:
             self._occupants[occ.slot] = None
             self._free.append(occ.slot)
+            self._backend.release(occ.slot)
         self.retired += 1
 
     def drain(self) -> List[SlotOccupant]:
@@ -468,27 +517,55 @@ class ContinuousBatchingEngine:
         orphans = [o for o in self._occupants if o is not None and not o.finished]
         for occ in orphans:
             occ.finished = True
+        self.peak_live = 0
         self._occupants = [None] * self.slots
         self._free = list(range(self.slots))
         self._ring.clear()
+        self._backend.reset()  # fresh pool + empty prefix registry/tables
         self._donated, self._carried = self._init_state()
         return orphans
+
+    def live_tokens(self) -> int:
+        """Positions actually holding useful KV right now: each live
+        occupant's prompt + emitted tokens (host-side, no device sync)."""
+        return sum(
+            len(o.prompt) + len(o.tokens)
+            for o in self._occupants
+            if o is not None and not o.finished
+        )
 
     def stats(self) -> dict:
         """Observability twin of ``generate_cache_stats``: how many distinct
         (program, operand-shape) signatures this engine dispatched — the
         acceptance gate asserts <= 2 per (slots, max_len) config — plus
-        lifetime counters."""
+        lifetime counters and the KV store's memory economics (``kv``:
+        pool/arena HBM bytes, live- vs reserved-token utilization, prefix-
+        cache hit rate) so benches gate on measured memory, not inference."""
         programs = {name: len(sigs) for name, sigs in self._programs.items()}
+        kv = self._backend.stats()
+        live_tok = self.live_tokens()
+        reserved_tok = self._backend.reserved_tokens()
+        if self._backend.kind == "dense":
+            # dense reserves every slot's worst case up front; utilization
+            # against LIVE slots' reservation is the honest comparison
+            reserved_live = self.live_count() * self.max_len
+        else:
+            reserved_live = reserved_tok
+        kv.update(
+            live_tokens=live_tok,
+            utilization=(live_tok / reserved_live) if reserved_live else 0.0,
+        )
         return {
             "slots": self.slots,
             "max_len": self.max_len,
             "prompt_bucket": self.prompt_bucket,
             "live": self.live_count(),
+            "peak_live": self.peak_live,
             "free": len(self._free),
             "inserted": self.inserted,
             "steps": self.steps,
             "retired": self.retired,
             "programs": programs,
             "program_count": sum(programs.values()),
+            "kv": kv,
         }

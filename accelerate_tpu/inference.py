@@ -83,6 +83,8 @@ def generate(
     top_p: Optional[float] = None,
     eos_token_id: Optional[int] = None,
     pad_token_id: Optional[int] = None,
+    kv_backend: str = "dense",
+    kv_block_size: int = 16,
 ):
     """Greedy (temperature=0) or sampled generation for the causal-LM
     families (llama/mixtral/mistral, gpt2 — dispatched on the model's config
@@ -93,10 +95,26 @@ def generate(
     distribution; ``eos_token_id`` freezes a finished sequence (subsequent
     positions emit ``pad_token_id``, defaulting to the EOS id — HF's
     convention when pad is unset). Returns (B, prompt+new) token ids.
+
+    ``kv_backend`` selects the decode-scan KV layout: ``"dense"`` (default,
+    in-place writes at ``pos``), ``"paged"`` (the prefill cache is re-laid as
+    a block pool with identity tables and decode runs through the same
+    gather/commit ops as the continuous engine — bitwise-identical greedy
+    outputs in f32), or ``"paged_int8"`` (pool stored int8 with per-block
+    scales). Paged rounds the total length up to a ``kv_block_size``
+    multiple, so outputs may carry extra scan steps like ``pad_to`` does.
     """
     from .models.gpt2 import GPT2Config, gpt2_decode_step, gpt2_prefill
     from .models.llama import llama_decode_step, llama_prefill
+    from .kvcache import KV_BACKENDS, PagedKVLayout, pool_from_dense
 
+    if kv_backend not in KV_BACKENDS:
+        raise ValueError(
+            f"kv_backend must be one of {KV_BACKENDS}, got {kv_backend!r}"
+        )
+    paged = kv_backend != "dense"
+    if paged and kv_block_size < 1:
+        raise ValueError(f"kv_block_size must be >= 1, got {kv_block_size}")
     config = model.config
     if isinstance(config, GPT2Config):
         prefill_fn, decode_fn = gpt2_prefill, gpt2_decode_step
@@ -107,6 +125,8 @@ def generate(
     total_len = prompt_len + max_new_tokens
     if pad_to is not None:
         total_len = max(total_len, pad_to)
+    if paged:  # the pool relay needs whole blocks
+        total_len = -(-total_len // kv_block_size) * kv_block_size
     if pad_token_id is None:
         pad_token_id = eos_token_id if eos_token_id is not None else 0
 
@@ -131,6 +151,7 @@ def generate(
     cache_key = (
         type(config).__name__, b, prompt_len, total_len, max_new_tokens,
         temp_on, top_k_width, top_p_on, eos_on,
+        kv_backend, kv_block_size if paged else None,
     )
     jit_cache, cache_lock = _model_generate_cache(model)
     with cache_lock:
@@ -168,6 +189,16 @@ def generate(
             # prefill: ONE full forward fills the cache (O(S) matmul work
             # vs O(S²) for token-by-token decode over the prompt)
             logits, cache = prefill_fn(config, params, input_ids, total_len)
+            if paged:
+                # re-lay as a block pool with identity tables: decode now
+                # exercises the engine's gather/commit ops inside this same
+                # program (still ONE executable per cache_key)
+                cache, tables = pool_from_dense(
+                    cache, kv_block_size, quantized=kv_backend == "paged_int8"
+                )
+                kv_layout = PagedKVLayout(tables, kv_block_size, config.compute_dtype)
+            else:
+                kv_layout = None
             done0 = jnp.zeros((b,), dtype=bool)
 
             def decode_body(carry, t):
@@ -181,7 +212,9 @@ def generate(
                 if eos_on:
                     token = jnp.where(done, pad_id, token)
                     done = done | (token == eos_id)
-                logits, cache = decode_fn(config, params, cache, token[:, None], t)
+                logits, cache = decode_fn(
+                    config, params, cache, token[:, None], t, kv_layout=kv_layout
+                )
                 return (cache, logits, key, done, wasted), token
 
             (_, _, _, _, wasted), new_tokens = lax.scan(

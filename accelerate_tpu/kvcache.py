@@ -1,0 +1,647 @@
+"""Paged KV-cache subsystem: block pool, block tables, copy-on-write prefix
+caching, and optional int8 KV for the decode paths.
+
+The continuous engine's original KV store is a dense arena ``(layers, slots,
+max_len, kv_heads, head_dim)``: every slot reserves its worst case, so HBM —
+not compute — caps concurrency (ROADMAP open item 1). This module replaces
+that store with the vLLM/Orca-class paged design while keeping the engine's
+two-jitted-programs discipline intact:
+
+* **Block pool + block tables** — one shared device pool ``(layers,
+  num_blocks, block_size, kv_heads, head_dim)``; each slot owns a row of a
+  host-side block table mapping its logical positions to pool blocks. Decode
+  gathers a slot's blocks into the dense per-layer view the model attention
+  already consumes (``pool[tables]`` + reshape), writes the new token column
+  back with one scatter, and prefill writes each bucket block with
+  ``lax.dynamic_update_slice``. Tables ride into the compiled programs as
+  *traced operands* (values change, shapes don't), so a paged engine still
+  dispatches exactly one prefill and one decode program per config.
+* **Admission by free blocks, not max_len** — a request needs
+  ``ceil((prompt + budget) / block_size)`` blocks, so short requests stop
+  paying long requests' reservation. The engine/server gate admission on
+  :meth:`PagedBlockPool.can_admit` instead of slot count alone.
+* **Copy-on-write prefix caching** — full prompt blocks register in a
+  host-side registry keyed by the exact block-aligned prompt prefix bytes;
+  a request whose prefix matches takes a refcount on the existing blocks
+  instead of new ones (system prompts dedup across every concurrent user).
+  Refcounts release on retirement; zero-ref registered blocks park in an
+  LRU "cached" tier that still serves hits and is evicted only on demand.
+  Shared-prefix prefill re-writes are bitwise idempotent: causal attention
+  makes prefix KV depend only on prefix tokens, so every sharer computes
+  the same bytes (and, with deterministic quantization, the same int8).
+* **int8 KV** — pool stored as int8 plus per-(layer, block, position) f32
+  scales; quantized on write (prefill blocks and the decode column) and
+  dequantized inside the compiled step right before attention. Halves-to-
+  quarters pool HBM at a bounded, deterministic accuracy cost.
+
+Safety invariants (the reasons slot recycling cannot corrupt KV):
+
+* Block 0 is the reserved **null block**: vacant/retired slots' table rows
+  point at it, so the unconditional per-step KV writes of masked slots land
+  in a garbage sink nobody ever attends to (``k_pos <= pos`` masking keeps
+  every unallocated position out of attention with exp-underflow-exact
+  zero weights — see ``NEG_INF`` in ops/attention.py).
+* A live slot writes position ``p`` in the same program that first attends
+  it, so blocks recycled from a previous occupant never leak stale KV.
+* Decode writes happen at ``pos >= prompt_len`` while registered (shared)
+  blocks only cover positions ``< floor(prompt_len/bs)*bs``, so shared
+  content is never written after registration — COW without copies.
+
+Backends:
+
+``dense``       today's arena semantics behind the same interface
+``paged``       block pool + tables + COW prefix cache
+``paged_int8``  same, int8 pool + per-block-position scales
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .logging import get_logger
+
+logger = get_logger(__name__)
+
+__all__ = [
+    "KVCacheBackend",
+    "DenseKVBackend",
+    "PagedKVBackend",
+    "PagedBlockPool",
+    "PagedKVLayout",
+    "make_kv_backend",
+    "kv_quantize",
+    "kv_dequantize",
+    "KV_BACKENDS",
+]
+
+KV_BACKENDS = ("dense", "paged", "paged_int8")
+
+_NULL_BLOCK = 0  # reserved garbage sink; never allocated, never attended
+
+
+def _ceil_div(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+# ------------------------------------------------------------------ int8 ops
+def kv_quantize(x):
+    """Symmetric int8 quantization with one scale per leading position:
+    ``x`` is ``(..., kv_heads, head_dim)``; the amax reduces over the last
+    two axes so every (layer, block, position) gets its own scale — the
+    per-block-scale granularity the int8 KV pool stores. Deterministic
+    (pure round/clip), so identical inputs quantize to identical bytes —
+    the property shared-prefix COW re-writes rely on."""
+    amax = jnp.maximum(jnp.max(jnp.abs(x.astype(jnp.float32)), axis=(-1, -2)), 1e-6)
+    scale = (amax / 127.0).astype(jnp.float32)
+    q = jnp.clip(
+        jnp.round(x.astype(jnp.float32) / scale[..., None, None]), -127, 127
+    ).astype(jnp.int8)
+    return q, scale
+
+
+def kv_dequantize(q, scale, dtype):
+    """Inverse of :func:`kv_quantize`: ``q (..., kv_heads, head_dim)`` int8
+    times per-position ``scale (...)`` back to ``dtype``."""
+    return (q.astype(jnp.float32) * scale[..., None, None]).astype(dtype)
+
+
+# --------------------------------------------------------------- device side
+class PagedKVLayout:
+    """Device-side view/commit ops over one layer's pool slice, closed over
+    the (traced) block tables. Built *inside* a jitted program each dispatch
+    — tables are operands, not constants, so table churn never recompiles.
+
+    The model decode layers keep consuming a dense ``(B, max_len, kvh, hd)``
+    cache: :meth:`view` gathers it from the pool (dequantizing int8),
+    :meth:`commit` extracts the single new column the layer wrote at ``pos``
+    and scatters it back (quantizing int8). Everything else in attention is
+    untouched — one KV story for dense and paged."""
+
+    def __init__(self, tables, block_size: int, compute_dtype):
+        self.tables = tables  # (B, blocks_per_row) int32, traced
+        self.block_size = block_size
+        self.compute_dtype = compute_dtype
+
+    def view(self, layer_cache):
+        """Gather one layer's pool slice into the dense per-slot view:
+        ``(num_blocks, bs, kvh, hd)`` (or the int8 ``{"q","s"}`` pair) →
+        ``(B, blocks_per_row * bs, kvh, hd)``. Unallocated table entries
+        gather the null block — masked out of attention by ``k_pos <=
+        pos``."""
+        if isinstance(layer_cache, dict):
+            q = layer_cache["q"][self.tables]  # (B, bpr, bs, kvh, hd)
+            s = layer_cache["s"][self.tables]  # (B, bpr, bs)
+            dense = kv_dequantize(q, s, self.compute_dtype)
+        else:
+            dense = layer_cache[self.tables]
+        b, bpr, bs, kvh, hd = dense.shape
+        return dense.reshape(b, bpr * bs, kvh, hd).astype(self.compute_dtype)
+
+    def commit(self, layer_cache, view, pos):
+        """Scatter the one new column the decode layer wrote at ``pos``
+        back into the pool slice. ``pos`` is a traced (B,) vector (engine
+        slots) or scalar (the fused generate scan). Ghost slots (retired /
+        vacant) carry null-block table entries, so their unconditional
+        masked-step writes land in the garbage sink."""
+        if jnp.ndim(pos) == 0:
+            pos = jnp.broadcast_to(pos, (self.tables.shape[0],))
+        col = jnp.take_along_axis(view, pos[:, None, None, None], axis=1)[:, 0]
+        blk = jnp.take_along_axis(
+            self.tables, (pos // self.block_size)[:, None], axis=1
+        )[:, 0]
+        off = pos % self.block_size
+        if isinstance(layer_cache, dict):
+            q, s = kv_quantize(col)
+            return {
+                "q": layer_cache["q"].at[blk, off].set(q),
+                "s": layer_cache["s"].at[blk, off].set(s),
+            }
+        return layer_cache.at[blk, off].set(col.astype(layer_cache.dtype))
+
+
+# ------------------------------------------------------------ host block pool
+class PagedBlockPool:
+    """Host-side allocator for the device block pool: free list, refcounts,
+    per-slot block-table rows, and the COW prefix registry.
+
+    Single-threaded by design — the serving worker owns the engine. Block
+    states:
+
+    * **free** — on the free list, content meaningless.
+    * **active** — refcount >= 1; owned by >= 1 live slots.
+    * **cached** — refcount 0 but still registered under its prompt-prefix
+      key; serves prefix hits across *sequential* waves and is evicted LRU
+      only when the free list runs dry (so "free capacity" = free + cached).
+
+    The registry keys are the exact prefix bytes ``prompt[: (d+1) *
+    block_size]`` — no hash collisions, and a lookup walks depths 0, 1, 2…
+    stopping at the first miss, so evicting a shallow block simply orphans
+    (and stops serving) its deeper extensions."""
+
+    def __init__(self, *, num_blocks: int, block_size: int, slots: int,
+                 blocks_per_row: int):
+        if num_blocks < 2:
+            raise ValueError(
+                f"pool needs >= 2 blocks (1 is the reserved null block), "
+                f"got {num_blocks}"
+            )
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self.slots = slots
+        self.blocks_per_row = blocks_per_row
+        self.reset()
+
+    # -------------------------------------------------------------- lifecycle
+    def reset(self) -> None:
+        self._free: List[int] = list(range(self.num_blocks - 1, _NULL_BLOCK, -1))
+        self._ref = np.zeros(self.num_blocks, dtype=np.int64)
+        self._registry: Dict[bytes, int] = {}
+        self._key_of: Dict[int, bytes] = {}
+        self._cached: "collections.OrderedDict[int, None]" = collections.OrderedDict()
+        self._rows: List[List[int]] = [[] for _ in range(self.slots)]
+        self.tables = np.zeros((self.slots, self.blocks_per_row), dtype=np.int32)
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+
+    # ------------------------------------------------------------- accounting
+    def blocks_needed(self, prompt_len: int, budget: int) -> int:
+        # budget tokens occupy positions [prompt_len, prompt_len+budget):
+        # the last decode write lands at prompt_len+budget-1 (done slots
+        # keep re-writing their frozen final position until retired)
+        return _ceil_div(prompt_len + budget, self.block_size)
+
+    def max_request_blocks(self) -> int:
+        return self.num_blocks - 1  # everything but the null block
+
+    def free_blocks(self) -> int:
+        """Allocatable capacity: truly free + LRU-evictable cached."""
+        return len(self._free) + len(self._cached)
+
+    def active_blocks(self) -> int:
+        return int((self._ref > 0).sum())
+
+    def _shared_prefix(self, prompt: np.ndarray) -> List[int]:
+        """Registry hits for ``prompt``'s full blocks, deepest-first walk
+        stopping at the first miss. Read-only (used by both the admission
+        probe and acquire)."""
+        bs = self.block_size
+        hits: List[int] = []
+        for depth in range(len(prompt) // bs):
+            blk = self._registry.get(prompt[: (depth + 1) * bs].tobytes())
+            if blk is None:
+                break
+            hits.append(blk)
+        return hits
+
+    def can_admit(self, prompt: np.ndarray, budget: int) -> bool:
+        """True when ``acquire`` for this request would succeed right now.
+        Cached blocks the request would *hit* are not double-counted as
+        evictable capacity."""
+        prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
+        hits = self._shared_prefix(prompt)
+        needed = self.blocks_needed(len(prompt), budget) - len(hits)
+        evictable = len(self._cached) - sum(1 for b in hits if self._ref[b] == 0)
+        return needed <= len(self._free) + evictable
+
+    # -------------------------------------------------------------- allocation
+    def _evict_one(self) -> int:
+        blk, _ = self._cached.popitem(last=False)  # LRU
+        key = self._key_of.pop(blk)
+        del self._registry[key]
+        return blk
+
+    def _alloc_block(self) -> int:
+        if self._free:
+            return self._free.pop()
+        return self._evict_one()
+
+    def acquire(self, slot: int, prompt: np.ndarray, budget: int) -> Tuple[np.ndarray, int]:
+        """Allocate (or COW-share) the blocks for one admitted request and
+        install the slot's table row. Returns ``(row, shared_blocks)`` where
+        ``row`` is the full ``(blocks_per_row,)`` int32 table row (null
+        beyond the allocation). Raises ``RuntimeError`` when the pool lacks
+        capacity — callers gate on :meth:`can_admit` first."""
+        prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
+        total = self.blocks_needed(len(prompt), budget)
+        if total > self.blocks_per_row:
+            raise RuntimeError(
+                f"request needs {total} blocks but a table row holds "
+                f"{self.blocks_per_row}"
+            )
+        if not self.can_admit(prompt, budget):
+            raise RuntimeError(
+                "no free KV blocks (caller must gate on can_admit())"
+            )
+        bs = self.block_size
+        full = len(prompt) // bs
+        hits = self._shared_prefix(prompt)
+        row: List[int] = []
+        for blk in hits:
+            if self._ref[blk] == 0:  # cached -> active
+                del self._cached[blk]
+            self._ref[blk] += 1
+            row.append(blk)
+        self.prefix_hits += len(hits)
+        self.prefix_misses += full - len(hits)
+        # private blocks; full prompt blocks past the shared depth register
+        # so the NEXT request with this prefix shares them
+        for j in range(len(hits), total):
+            blk = self._alloc_block()
+            self._ref[blk] = 1
+            if j < full:
+                key = prompt[: (j + 1) * bs].tobytes()
+                self._registry[key] = blk
+                self._key_of[blk] = key
+            row.append(blk)
+        self._rows[slot] = row
+        self.tables[slot] = _NULL_BLOCK
+        self.tables[slot, : len(row)] = row
+        return self.tables[slot].copy(), len(hits)
+
+    def release(self, slot: int) -> None:
+        """Drop the slot's references; zero-ref registered blocks park in
+        the cached LRU (still serving prefix hits), unregistered ones free.
+        The table row resets to the null block so the ghost slot's masked
+        decode writes stop touching real blocks — this is what makes block
+        recycling safe under the deferred-readback ring."""
+        for blk in self._rows[slot]:
+            self._ref[blk] -= 1
+            if self._ref[blk] == 0:
+                if blk in self._key_of:
+                    self._cached[blk] = None  # most-recently-released = MRU
+                    self._cached.move_to_end(blk)
+                else:
+                    self._free.append(blk)
+        self._rows[slot] = []
+        self.tables[slot] = _NULL_BLOCK
+
+    def stats(self) -> dict:
+        lookups = self.prefix_hits + self.prefix_misses
+        return {
+            "blocks_total": self.num_blocks,
+            "blocks_free": len(self._free),
+            "blocks_cached": len(self._cached),
+            "blocks_active": self.active_blocks(),
+            "prefix_hits": self.prefix_hits,
+            "prefix_misses": self.prefix_misses,
+            "prefix_hit_rate": (self.prefix_hits / lookups) if lookups else 0.0,
+        }
+
+
+# ------------------------------------------------------------------- backends
+class KVCacheBackend:
+    """Interface both inference paths program against. Device methods
+    (``init_device_state``, ``make_layout``, ``prefill_write``) are called
+    inside jitted programs; host methods manage admission and the table."""
+
+    kind: str = "abstract"
+
+    # device side -----------------------------------------------------------
+    def init_device_state(self):
+        raise NotImplementedError
+
+    def make_layout(self, tables) -> Optional[PagedKVLayout]:
+        """None = the model decode consumes the cache directly (dense)."""
+        raise NotImplementedError
+
+    def prefill_write(self, cache, new_cache, slot, table_row):
+        """Scatter a bucketed prefill's KV (``(L, 1, max_len, kvh, hd)``
+        per leaf) into the store for ``slot``/``table_row``."""
+        raise NotImplementedError
+
+    # host side -------------------------------------------------------------
+    def device_tables(self):
+        raise NotImplementedError
+
+    def acquire(self, slot: int, prompt: np.ndarray, budget: int) -> Tuple[np.ndarray, int]:
+        raise NotImplementedError
+
+    def release(self, slot: int) -> None:
+        raise NotImplementedError
+
+    def can_admit(self, prompt: np.ndarray, budget: int) -> bool:
+        raise NotImplementedError
+
+    def validate_request(self, prompt_len: int, budget: int) -> None:
+        """Extra structural admission checks (beyond the engine's bucket /
+        max_len checks); raises typed ``ValueError``."""
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+    def hbm_bytes(self) -> int:
+        raise NotImplementedError
+
+    def reserved_tokens(self) -> int:
+        """Positions currently reserved in the store (dense: every slot's
+        worst case; paged: allocated blocks × block_size, shared counted
+        once)."""
+        raise NotImplementedError
+
+    def stats(self) -> dict:
+        raise NotImplementedError
+
+
+class DenseKVBackend(KVCacheBackend):
+    """Today's arena semantics behind the backend interface: one dense
+    ``(L, slots, max_len, kvh, hd)`` row per slot, full-row prefill wipe
+    (structural KV isolation), no admission constraint beyond slots."""
+
+    kind = "dense"
+
+    def __init__(self, *, config, slots: int, max_len: int):
+        self.config = config
+        self.slots = slots
+        self.max_len = max_len
+        kvh = getattr(config, "num_key_value_heads", None) or config.num_attention_heads
+        self._shape = (config.num_hidden_layers, slots, max_len, kvh, config.head_dim)
+        self._dtype = config.compute_dtype
+        # tables are inert for dense; a constant (slots, 1) zero array keeps
+        # the engine's program signatures uniform across backends
+        self._tables = jnp.zeros((slots, 1), jnp.int32)
+
+    def init_device_state(self):
+        return {
+            "k": jnp.zeros(self._shape, self._dtype),
+            "v": jnp.zeros(self._shape, self._dtype),
+        }
+
+    def make_layout(self, tables):
+        return None
+
+    def prefill_write(self, cache, new_cache, slot, table_row):
+        # full-row dynamic_update_slice: zeros beyond the bucket wipe every
+        # stale byte of the slot's previous occupant
+        return {
+            which: lax.dynamic_update_slice(
+                cache[which],
+                new_cache[which].astype(cache[which].dtype),
+                (0, slot, 0, 0, 0),
+            )
+            for which in ("k", "v")
+        }
+
+    def device_tables(self):
+        return self._tables
+
+    def acquire(self, slot, prompt, budget):
+        return np.zeros((1,), np.int32), 0
+
+    def release(self, slot):
+        pass
+
+    def can_admit(self, prompt, budget):
+        return True
+
+    def reset(self):
+        pass
+
+    def hbm_bytes(self):
+        return 2 * int(np.prod(self._shape)) * jnp.dtype(self._dtype).itemsize
+
+    def reserved_tokens(self):
+        return self.slots * self.max_len
+
+    def stats(self):
+        return {
+            "backend": self.kind,
+            "hbm_bytes": self.hbm_bytes(),
+            "reserved_tokens": self.reserved_tokens(),
+        }
+
+
+class PagedKVBackend(KVCacheBackend):
+    """Block pool + tables + COW prefix cache (+ optional int8 storage).
+
+    ``pool_blocks=None`` fully provisions: ``slots * max_len/block_size``
+    blocks + the null block — same token capacity as the dense arena.
+    Smaller pools oversubscribe: more slots than worst-case HBM, with
+    admission gated on actual free blocks (the whole point)."""
+
+    def __init__(self, *, config, slots: int, max_len: int, prompt_bucket: int,
+                 block_size: int = 16, pool_blocks: Optional[int] = None,
+                 quantized: bool = False):
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        if max_len % block_size != 0:
+            raise ValueError(
+                f"max_len ({max_len}) must be a multiple of engine_block_size "
+                f"({block_size}) so a table row covers it exactly"
+            )
+        self.config = config
+        self.slots = slots
+        self.max_len = max_len
+        self.block_size = block_size
+        self.blocks_per_row = max_len // block_size
+        self.prefill_blocks = _ceil_div(prompt_bucket, block_size)
+        self.quantized = quantized
+        if pool_blocks is None:
+            pool_blocks = slots * self.blocks_per_row + 1
+        if pool_blocks < self.prefill_blocks + 1:
+            raise ValueError(
+                f"engine_pool_blocks ({pool_blocks}) must cover at least one "
+                f"bucketed prefill + the null block "
+                f"({self.prefill_blocks + 1} blocks of engine_block_size="
+                f"{block_size})"
+            )
+        self.pool_blocks = pool_blocks
+        kvh = getattr(config, "num_key_value_heads", None) or config.num_attention_heads
+        self._kvh, self._hd = kvh, config.head_dim
+        self._layers = config.num_hidden_layers
+        self._dtype = config.compute_dtype
+        self.kind = "paged_int8" if quantized else "paged"
+        self.pool = PagedBlockPool(
+            num_blocks=pool_blocks, block_size=block_size, slots=slots,
+            blocks_per_row=self.blocks_per_row,
+        )
+        self._device_tables_cache = None
+
+    # ------------------------------------------------------------ device side
+    def init_device_state(self):
+        shape = (self._layers, self.pool_blocks, self.block_size, self._kvh, self._hd)
+        if self.quantized:
+            leaf = lambda: {
+                "q": jnp.zeros(shape, jnp.int8),
+                "s": jnp.zeros(shape[:3], jnp.float32),
+            }
+            return {"k": leaf(), "v": leaf()}
+        return {"k": jnp.zeros(shape, self._dtype), "v": jnp.zeros(shape, self._dtype)}
+
+    def make_layout(self, tables):
+        return PagedKVLayout(tables, self.block_size, self._dtype)
+
+    def prefill_write(self, cache, new_cache, slot, table_row):
+        """Per-block ``dynamic_update_slice`` writes of the bucketed prefill
+        KV into the slot's blocks. The loop bound is static
+        (``ceil(prompt_bucket / block_size)``), so this stays ONE compiled
+        program; rows whose allocation is shorter than the bucket carry
+        null-block table entries there, harmlessly absorbing the extra
+        writes. Shared (COW) prefix blocks are re-written with bitwise
+        identical content — see the module docstring invariants."""
+        bs = self.block_size
+        out = {}
+        for which in ("k", "v"):
+            pool = cache[which]
+            fresh = new_cache[which][:, 0]  # (L, max_len, kvh, hd)
+            for j in range(self.prefill_blocks):
+                blk = fresh[:, j * bs:(j + 1) * bs]  # (L, bs, kvh, hd)
+                bid = table_row[j]
+                if self.quantized:
+                    q, s = kv_quantize(blk)
+                    pool = {
+                        "q": lax.dynamic_update_slice(
+                            pool["q"], q[:, None], (0, bid, 0, 0, 0)
+                        ),
+                        "s": lax.dynamic_update_slice(
+                            pool["s"], s[:, None], (0, bid, 0)
+                        ),
+                    }
+                else:
+                    pool = lax.dynamic_update_slice(
+                        pool, blk[:, None].astype(pool.dtype), (0, bid, 0, 0, 0)
+                    )
+            out[which] = pool
+        return out
+
+    # -------------------------------------------------------------- host side
+    def device_tables(self):
+        if self._device_tables_cache is None:
+            self._device_tables_cache = jnp.asarray(self.pool.tables)
+        return self._device_tables_cache
+
+    def acquire(self, slot, prompt, budget):
+        row, shared = self.pool.acquire(slot, prompt, budget)
+        self._device_tables_cache = None
+        return row, shared
+
+    def release(self, slot):
+        self.pool.release(slot)
+        self._device_tables_cache = None
+
+    def can_admit(self, prompt, budget):
+        return self.pool.can_admit(prompt, budget)
+
+    def validate_request(self, prompt_len, budget):
+        needed = self.pool.blocks_needed(prompt_len, budget)
+        if needed > min(self.pool.max_request_blocks(), self.blocks_per_row):
+            raise ValueError(
+                f"request needs {needed} KV blocks "
+                f"(engine_block_size={self.block_size}) but the pool only "
+                f"has {min(self.pool.max_request_blocks(), self.blocks_per_row)} "
+                "allocatable blocks per request; raise "
+                "ServingConfig.engine_pool_blocks / engine_max_len or lower "
+                "the budget"
+            )
+
+    def reset(self):
+        self.pool.reset()
+        self._device_tables_cache = None
+
+    def hbm_bytes(self):
+        per_block = self._layers * self.block_size * self._kvh * self._hd
+        if self.quantized:
+            # int8 payload + f32 per-position scales
+            per_block = per_block * 1 + self._layers * self.block_size * 4
+        else:
+            per_block *= jnp.dtype(self._dtype).itemsize
+        return 2 * self.pool_blocks * per_block
+
+    def reserved_tokens(self):
+        return (self.pool.active_blocks()) * self.block_size
+
+    def stats(self):
+        return {
+            "backend": self.kind,
+            "block_size": self.block_size,
+            "pool_blocks": self.pool_blocks,
+            "hbm_bytes": self.hbm_bytes(),
+            "reserved_tokens": self.reserved_tokens(),
+            **self.pool.stats(),
+        }
+
+
+def make_kv_backend(kind: str, *, config, slots: int, max_len: int,
+                    prompt_bucket: int, block_size: int = 16,
+                    pool_blocks: Optional[int] = None) -> KVCacheBackend:
+    """Factory the engine (and ``ServingConfig.kv_cache``) selects through."""
+    if kind == "dense":
+        return DenseKVBackend(config=config, slots=slots, max_len=max_len)
+    if kind in ("paged", "paged_int8"):
+        return PagedKVBackend(
+            config=config, slots=slots, max_len=max_len,
+            prompt_bucket=prompt_bucket, block_size=block_size,
+            pool_blocks=pool_blocks, quantized=(kind == "paged_int8"),
+        )
+    raise ValueError(
+        f"kv_cache must be one of {KV_BACKENDS}, got {kind!r}"
+    )
+
+
+# --------------------------------------------------- static generate() bridge
+def pool_from_dense(cache, block_size: int, quantized: bool):
+    """Re-lay a dense prefill cache ``(L, B, total_len, kvh, hd)`` as a
+    block pool with identity tables — the bridge that lets static
+    ``generate()`` run its decode scan through the same
+    :class:`PagedKVLayout` gather/commit ops as the engine (one KV story,
+    bitwise parity in f32). ``total_len`` must divide by ``block_size``."""
+    def relay(dense):
+        L, b, total, kvh, hd = dense.shape
+        nb = total // block_size
+        pool = dense.reshape(L, b * nb, block_size, kvh, hd)
+        if quantized:
+            q, s = kv_quantize(pool)
+            return {"q": q, "s": s}
+        return pool
+    k = relay(cache["k"])
+    v = relay(cache["v"])
+    b = cache["k"].shape[1]
+    nb = cache["k"].shape[2] // block_size
+    tables = jnp.arange(b * nb, dtype=jnp.int32).reshape(b, nb)
+    return {"k": k, "v": v}, tables

@@ -415,10 +415,13 @@ def _gpt2_decode_layer(config: GPT2Config, lp, x, cache_k, cache_v, pos):
     return x + y, cache_k, cache_v
 
 
-def gpt2_decode_step(config: GPT2Config, params, cache, token, pos):
+def gpt2_decode_step(config: GPT2Config, params, cache, token, pos, *,
+                     kv_layout=None):
     """One decode step: token (B, 1) at traced position ``pos`` (scalar, or
     (B,) per-row positions for continuous-batching slots) → (logits (B, V),
-    new cache). Same contract as llama_decode_step."""
+    new cache). Same contract as llama_decode_step, including the optional
+    paged ``kv_layout`` (per-layer pool slices gathered to a dense view
+    before the layer attends, new column committed back after)."""
     cdt = config.compute_dtype
     x = params["wte"]["embedding"].astype(cdt)[token]
     wpe = params["wpe"]["embedding"].astype(cdt)
@@ -429,7 +432,13 @@ def gpt2_decode_step(config: GPT2Config, params, cache, token, pos):
 
     def body(x, inputs):
         lp, ck, cv = inputs
+        if kv_layout is not None:
+            ck_pool, cv_pool = ck, cv
+            ck, cv = kv_layout.view(ck), kv_layout.view(cv)
         x, ck, cv = _gpt2_decode_layer(config, lp, x, ck, cv, pos)
+        if kv_layout is not None:
+            ck = kv_layout.commit(ck_pool, ck, pos)
+            cv = kv_layout.commit(cv_pool, cv, pos)
         return x, (ck, cv)
 
     x, (new_k, new_v) = lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))

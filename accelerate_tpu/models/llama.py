@@ -1382,15 +1382,33 @@ def llama_prefill_at(config: LlamaConfig, params, input_ids, max_len: int, last_
     return _prefill_head(config, params, x_last), _pad_prefill_cache(ks, vs, max_len)
 
 
-def llama_decode_step(config: LlamaConfig, params, cache, token, pos):
+def llama_decode_step(config: LlamaConfig, params, cache, token, pos, *,
+                      kv_layout=None):
     """One decode step: token (B, 1) at position ``pos`` — a traced scalar
     (whole batch in lockstep, the fused generate scan) or a traced (B,)
     vector (each row at its own position — continuous-batching slots).
-    Returns (logits (B, V), new cache)."""
+    Returns (logits (B, V), new cache).
+
+    ``kv_layout`` (a :class:`~accelerate_tpu.kvcache.PagedKVLayout`) swaps
+    the KV store for a paged block pool: ``cache`` leaves are per-layer pool
+    slices the scan carries, gathered into the dense per-slot view right
+    before the layer attends and committed back as one scattered column
+    after. ``None`` keeps the dense arena path byte-for-byte unchanged."""
     cdt = config.compute_dtype
     x = params["embed_tokens"]["embedding"].astype(cdt)[token]
     if config.scale_embeddings:
         x = x * jnp.asarray(config.hidden_size**0.5, dtype=cdt)
+
+    def layer_step(x, layer_params, ck, cv, sliding=None):
+        if kv_layout is not None:
+            ck_pool, cv_pool = ck, cv
+            ck, cv = kv_layout.view(ck), kv_layout.view(cv)
+        x, ck, cv = _decode_layer(config, layer_params, x, ck, cv, pos,
+                                  sliding=sliding)
+        if kv_layout is not None:
+            ck = kv_layout.commit(ck_pool, ck, pos)
+            cv = kv_layout.commit(cv_pool, cv, pos)
+        return x, ck, cv
 
     if config.alternating_sliding_window:
         L = config.num_hidden_layers
@@ -1399,9 +1417,7 @@ def llama_decode_step(config: LlamaConfig, params, cache, token, pos):
         def body(carry, inputs):
             x = carry
             layer_params, ck, cv, sliding = inputs
-            x, ck, cv = _decode_layer(
-                config, layer_params, x, ck, cv, pos, sliding=sliding
-            )
+            x, ck, cv = layer_step(x, layer_params, ck, cv, sliding=sliding)
             return x, (ck, cv)
 
         x, (new_k, new_v) = lax.scan(
@@ -1411,7 +1427,7 @@ def llama_decode_step(config: LlamaConfig, params, cache, token, pos):
         def body(carry, inputs):
             x = carry
             layer_params, ck, cv = inputs
-            x, ck, cv = _decode_layer(config, layer_params, x, ck, cv, pos)
+            x, ck, cv = layer_step(x, layer_params, ck, cv)
             return x, (ck, cv)
 
         x, (new_k, new_v) = lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))

@@ -26,6 +26,7 @@ __all__ = [
     "dot_product_attention",
     "blockwise_attention",
     "dispatch_attention",
+    "paged_attention",
     "repeat_kv",
     "tanh_softcap",
 ]
@@ -121,6 +122,66 @@ def dot_product_attention(
         k_pos = jnp.arange(sk)[None, :] + kv_offset
         diff = q_pos - k_pos
         scores = jnp.where(((diff >= 0) & (diff < window))[None, None, None], scores, NEG_INF)
+    weights = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bgrqk,bkgd->bqgrd", weights.astype(v.dtype), v)
+    return out.reshape(b, sq, h, d)
+
+
+def paged_attention(
+    q: jax.Array,
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    block_tables: jax.Array,
+    pos: jax.Array,
+    *,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
+    softmax_dtype=jnp.float32,
+) -> jax.Array:
+    """Single-token decode attention over a paged KV pool — the reference
+    semantics (and kernel contract) for the kvcache subsystem's decode path.
+
+    Shapes, per layer:
+      - ``q``:            (B, 1, h, d) — one query token per live slot
+      - ``k_pool/v_pool``: (num_blocks, block_size, h_kv, d); int8 when the
+        pool is quantized, in which case ``k_scale``/``v_scale``
+        (num_blocks, block_size) carry per-(block, position) scales and
+        dequantization happens here, after the gather
+      - ``block_tables``: (B, blocks_per_row) int32 — each row's ordered
+        block ids; released rows point at the null block (id 0)
+      - ``pos``:          (B,) int32 — the query's position; keys strictly
+        beyond it are masked
+
+    The gather ``pool[tables]`` materializes each row's (blocks_per_row *
+    block_size) context window, then attention is the exact grouped-GQA math
+    of :func:`dot_product_attention` with a per-row length mask: masked
+    scores hit ``NEG_INF``, softmax underflows them to exactly 0.0, and
+    0 × garbage == 0 — which is why recycled/unwritten block content can
+    never leak between slots (the dense↔paged bitwise-parity argument, and
+    the property a fused Pallas kernel must preserve: it may skip masked
+    blocks entirely, never partially weight them)."""
+    b, sq, h, d = q.shape
+    ctx = k_pool[block_tables]  # (B, bpr, bs, h_kv, d)
+
+    def flat(pool_rows, scale):
+        bpr, bs = pool_rows.shape[1], pool_rows.shape[2]
+        x = pool_rows.reshape(b, bpr * bs, *pool_rows.shape[3:])
+        if scale is not None:
+            s = scale[block_tables].reshape(b, bpr * bs)
+            x = x.astype(softmax_dtype) * s[:, :, None, None]
+        return x
+
+    k = flat(ctx, k_scale)
+    v = flat(v_pool[block_tables], v_scale)
+    sk = k.shape[1]
+    h_kv = k.shape[2]
+    n_rep = h // h_kv
+    scale = 1.0 / math.sqrt(d)
+    qg = q.reshape(b, sq, h_kv, n_rep, d)
+    scores = jnp.einsum("bqgrd,bkgd->bgrqk", qg, k).astype(softmax_dtype) * scale
+    k_pos = jnp.arange(sk, dtype=jnp.int32)
+    live = k_pos[None, :] <= pos[:, None]  # (B, sk)
+    scores = jnp.where(live[:, None, None, None, :], scores, NEG_INF)
     weights = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bgrqk,bkgd->bqgrd", weights.astype(v.dtype), v)
     return out.reshape(b, sq, h, d)

@@ -173,7 +173,13 @@ class ServingMetrics:
         self._counts = {name: 0 for name in self._COUNTERS}
         self.latency = LatencyReservoir()  # seconds, accepted+completed only
         self.queue_wait = LatencyReservoir()  # seconds spent queued
-        self._gauges: dict[str, float] = {"queue_depth": 0, "breaker_state": 0}
+        self._gauges: dict[str, float] = {
+            "queue_depth": 0,
+            "breaker_state": 0,
+            "kv_hbm_bytes": 0,
+            "kv_utilization": 0.0,
+            "prefix_hit_rate": 0.0,
+        }
 
     def bump(self, name: str, by: int = 1) -> None:
         with self._lock:
@@ -321,6 +327,9 @@ class InferenceServer:
                     max_len=self.config.engine_max_len,
                     prompt_bucket=self.config.engine_prompt_bucket,
                     readback_lag=self.config.engine_readback_lag,
+                    kv_cache=self.config.kv_cache,
+                    block_size=self.config.engine_block_size,
+                    pool_blocks=self.config.engine_pool_blocks,
                     clock=clock,
                 )
         self._lock = threading.Lock()
@@ -647,6 +656,15 @@ class InferenceServer:
             # of iteration-level scheduling is that degradation never
             # touches anyone else's slot
             self._clamp_budget(req, level)
+            # paged KV: a free slot is not enough — the request's blocks
+            # (net of copy-on-write prefix hits) must be free too. Requeue
+            # at the head (FIFO order preserved) and stop admitting; blocks
+            # free as live slots retire, so the next tick retries.
+            if not eng.can_admit(req.input_ids, req.effective_max_new_tokens):
+                with self._wake:
+                    self._queue.appendleft(req)
+                    self.metrics.gauge("queue_depth", len(self._queue))
+                break
             if req.degraded:
                 self.metrics.bump("degraded")
             try:
@@ -695,6 +713,7 @@ class InferenceServer:
             self._engine_failure(exc)
             return
         self.metrics.bump("engine_steps")
+        self._sync_kv_gauges()
         self._breaker.record_success()
         self._batch_time_ewma = (
             dt if self._batch_time_ewma == 0.0
@@ -772,6 +791,19 @@ class InferenceServer:
                 "continuous reply epilogue failed; the retired slots' "
                 "outstanding futures were failed with BatchExecutionError"
             )
+
+    def _sync_kv_gauges(self) -> None:
+        """Publish the engine's KV-cache health (pool HBM footprint, live-vs-
+        reserved token utilization, prefix-cache hit rate) as serving gauges."""
+        kv = self._engine.stats().get("kv")
+        if not kv:
+            return
+        self.metrics.gauge("kv_hbm_bytes", kv.get("hbm_bytes", 0))
+        self.metrics.gauge("kv_utilization", kv.get("utilization", 0.0))
+        hits = kv.get("prefix_hits", 0)
+        misses = kv.get("prefix_misses", 0)
+        if hits + misses:
+            self.metrics.gauge("prefix_hit_rate", hits / (hits + misses))
 
     def _engine_failure(self, exc: BaseException, also_fail=None) -> None:
         """An engine program failed. Device state is donated across programs
@@ -917,6 +949,12 @@ class InferenceServer:
             rows = np.concatenate([rows, pad], axis=0)
         total = rows.shape[1] + first.effective_max_new_tokens
         pad_to = -(-total // cfg.pad_total_multiple) * cfg.pad_total_multiple
+        kv_kwargs = {}
+        if cfg.kv_cache != "dense":  # dense is the default inside generate()
+            kv_kwargs = {
+                "kv_backend": cfg.kv_cache,
+                "kv_block_size": cfg.engine_block_size,
+            }
         out = self._generate_fn(
             self.model,
             rows,
@@ -928,6 +966,7 @@ class InferenceServer:
             top_p=first.top_p,
             eos_token_id=first.eos_token_id,
             pad_token_id=first.pad_token_id,
+            **kv_kwargs,
         )
         # realize on host here — a transfer error is a batch failure, not a
         # mystery the client trips over later

@@ -395,6 +395,21 @@ class ServingConfig(KwargsHandler):
     everything else — deadlines, backpressure, retry/breaker, degradation
     (clamping the per-slot budget, not the batch), drain — applies
     unchanged.
+
+    KV cache backend (docs/serving.md "Paged KV & prefix caching"):
+    ``kv_cache`` selects how KV is stored — ``"dense"`` (one
+    ``engine_max_len`` row per slot, today's arena), ``"paged"`` (shared
+    block pool + per-slot block tables + copy-on-write prefix caching;
+    admission is gated on free *blocks* so short requests stop paying long
+    requests' worst-case reservation), or ``"paged_int8"`` (paged with an
+    int8 pool + per-block scales, ~4x less KV HBM at a bounded,
+    deterministic accuracy cost). ``engine_block_size`` positions per block
+    (must divide ``engine_max_len``); ``engine_pool_blocks`` sizes the pool
+    (``None`` = full provisioning: ``engine_slots * engine_max_len /
+    engine_block_size`` + the reserved null block — same token capacity as
+    dense; set it SMALLER to oversubscribe slots at fixed HBM). In static
+    mode ``kv_cache`` selects :func:`~accelerate_tpu.inference.generate`'s
+    ``kv_backend`` so both paths share one KV story.
     """
 
     mode: str = "static"
@@ -402,6 +417,9 @@ class ServingConfig(KwargsHandler):
     engine_max_len: int = 256
     engine_prompt_bucket: Optional[int] = None
     engine_readback_lag: int = 2
+    kv_cache: str = "dense"
+    engine_block_size: int = 16
+    engine_pool_blocks: Optional[int] = None
     max_queue: int = 256
     max_batch_size: int = 8
     batch_window_s: float = 0.002
@@ -443,6 +461,30 @@ class ServingConfig(KwargsHandler):
         if self.engine_readback_lag < 0:
             raise ValueError(
                 f"engine_readback_lag must be >= 0, got {self.engine_readback_lag}"
+            )
+        if self.kv_cache not in ("dense", "paged", "paged_int8"):
+            raise ValueError(
+                "kv_cache must be 'dense', 'paged' or 'paged_int8', got "
+                f"{self.kv_cache!r}"
+            )
+        if self.engine_block_size < 1:
+            raise ValueError(
+                f"engine_block_size must be >= 1, got {self.engine_block_size}"
+            )
+        if (
+            self.kv_cache != "dense"
+            and self.engine_max_len % self.engine_block_size != 0
+        ):
+            raise ValueError(
+                f"engine_max_len ({self.engine_max_len}) must be a multiple "
+                f"of engine_block_size ({self.engine_block_size}) so a block "
+                "table row covers the arena length exactly"
+            )
+        if self.engine_pool_blocks is not None and self.engine_pool_blocks < 2:
+            raise ValueError(
+                "engine_pool_blocks must be None (full provisioning) or >= 2 "
+                f"(1 block is the reserved null block), got "
+                f"{self.engine_pool_blocks}"
             )
         if self.max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {self.max_queue}")

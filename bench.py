@@ -632,6 +632,14 @@ if __name__ == "__main__":
         from benchmarks.serving_bench import main as serving_main
 
         sys.exit(serving_main(gate=True))
+    if "--kv-gate" in sys.argv:
+        # paged KV-cache gate: >= 4x concurrent slots at fixed pool HBM with
+        # bitwise dense parity + <= 2 engine programs, >= 90% shared-prefix
+        # block dedup, deterministic int8 KV (docs/serving.md)
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from benchmarks.continuous_bench import kv_main
+
+        sys.exit(kv_main(gate=True))
     if "--continuous-gate" in sys.argv:
         # continuous-batching gate: mixed-length/mixed-budget workload must
         # reach >= 1.3x static-mode goodput with TTFT p99 no worse, <= 2

@@ -25,6 +25,22 @@ bench-continuous``) enforces the acceptance criteria on the cold phases:
 continuous >= ``CB_GATE_RATIO`` (default 1.3) x static goodput, continuous
 TTFT p99 no worse than static, <= 2 compiled engine programs, and bitwise
 greedy output parity between the modes.
+
+``--kv-gate`` (also ``bench.py --kv-gate`` / ``make bench-kv``) runs the
+paged KV-cache phases instead (docs/serving.md "Paged KV & prefix
+caching"):
+
+- **capacity** — the same short-request workload through a dense 4-slot
+  engine and a paged 16-slot engine whose pool holds the same token
+  capacity (33 blocks x 8 = 264 vs 4 x 64 = 256): paged must admit >= 4x
+  the concurrent slots at fixed HBM, bitwise-matching dense greedy outputs
+  with <= 2 compiled engine programs.
+- **prefix** — 16 requests sharing a 24-token (3-block) system prompt:
+  copy-on-write prefix caching must dedup >= 90% of the full prefix-block
+  allocations.
+- **int8** — the capacity workload on ``paged_int8``: bitwise run-to-run
+  determinism, reported HBM ratio vs the f32 pool and greedy-token
+  agreement vs dense (bounded divergence, not gated).
 """
 
 from __future__ import annotations
@@ -232,5 +248,148 @@ def main(gate: bool = False) -> int:
     return 0 if (ok or not gate) else 1
 
 
+# ----------------------------------------------------------- paged KV phases
+KV_BLOCK = int(os.environ.get("CB_KV_BLOCK", "8"))
+KV_POOL_BLOCKS = int(os.environ.get("CB_KV_POOL_BLOCKS", "33"))
+KV_DENSE_SLOTS = int(os.environ.get("CB_KV_DENSE_SLOTS", "4"))
+KV_PAGED_SLOTS = int(os.environ.get("CB_KV_PAGED_SLOTS", "16"))
+
+
+def _run_engine(eng, reqs):
+    """Drive an engine directly: admit whenever a slot AND the KV store
+    accept (paged admission gates on free blocks), step until everything
+    retires. Returns the bitwise output rows + wall time."""
+    eng.reset()
+    occs = [None] * len(reqs)
+    i = 0
+    t0 = time.perf_counter()
+    while i < len(reqs) or eng.live_count() > 0:
+        while i < len(reqs) and eng.can_admit(reqs[i][0], reqs[i][1]):
+            occs[i] = eng.insert(
+                reqs[i][0].tolist(), max_new_tokens=reqs[i][1], pad_token_id=0
+            )
+            i += 1
+        if eng.live_count() == 0:
+            if i < len(reqs):
+                raise RuntimeError("admission stalled with requests pending")
+            break
+        eng.step()
+        eng.poll()  # retirement (and block release) happens at readback
+    eng.poll(force=True)
+    return [np.asarray(o.output_row()) for o in occs], time.perf_counter() - t0
+
+
+def kv_main(gate: bool = False) -> int:
+    import jax.numpy as jnp
+
+    from accelerate_tpu.engine import ContinuousBatchingEngine
+    from accelerate_tpu.models.llama import LlamaConfig, create_llama
+
+    model = create_llama(LlamaConfig.tiny(compute_dtype=jnp.float32), seed=0)
+    rng = np.random.default_rng(0)
+    # capacity workload: KV_PAGED_SLOTS short requests, each <= 2 blocks
+    # (prompt+budget <= 2 * KV_BLOCK), so the 33-block pool holds all of
+    # them at once while the dense arena is stuck at its slot count
+    reqs = [
+        (rng.integers(1, 255, size=4 + (i % 5)).astype(np.int32), 4 + (i % 4))
+        for i in range(KV_PAGED_SLOTS)
+    ]
+
+    dense_eng = ContinuousBatchingEngine(
+        model, slots=KV_DENSE_SLOTS, max_len=MAX_LEN,
+        prompt_bucket=PROMPT_BUCKET, readback_lag=2,
+    )
+    paged_eng = ContinuousBatchingEngine(
+        model, slots=KV_PAGED_SLOTS, max_len=MAX_LEN,
+        prompt_bucket=PROMPT_BUCKET, readback_lag=2,
+        kv_cache="paged", block_size=KV_BLOCK, pool_blocks=KV_POOL_BLOCKS,
+    )
+    dense_out, dense_wall = _run_engine(dense_eng, reqs)
+    paged_out, paged_wall = _run_engine(paged_eng, reqs)
+    dense_kv = dense_eng.stats()["kv"]
+    paged_stats = paged_eng.stats()
+    paged_kv = paged_stats["kv"]
+    parity = all(np.array_equal(a, b) for a, b in zip(dense_out, paged_out))
+    row = {
+        "phase": "kv_capacity",
+        "requests": len(reqs),
+        "dense": {"slots": KV_DENSE_SLOTS, "peak_live": dense_eng.peak_live,
+                  "hbm_bytes": dense_kv["hbm_bytes"], "wall_s": round(dense_wall, 3)},
+        "paged": {"slots": KV_PAGED_SLOTS, "peak_live": paged_eng.peak_live,
+                  "hbm_bytes": paged_kv["hbm_bytes"], "wall_s": round(paged_wall, 3),
+                  "engine_programs": paged_stats["program_count"]},
+        "greedy_parity": parity,
+    }
+    print(json.dumps(row), flush=True)
+
+    # prefix phase: 3 full shared blocks across every request
+    shared = rng.integers(1, 255, size=3 * KV_BLOCK).astype(np.int32)
+    prefix_reqs = [
+        (np.concatenate([shared, np.asarray([i + 1, i + 2], np.int32)]), 4)
+        for i in range(16)
+    ]
+    prefix_eng = ContinuousBatchingEngine(
+        model, slots=8, max_len=MAX_LEN, prompt_bucket=4 * KV_BLOCK,
+        readback_lag=2, kv_cache="paged", block_size=KV_BLOCK,
+    )
+    _run_engine(prefix_eng, prefix_reqs)
+    pkv = prefix_eng.stats()["kv"]
+    dedup = pkv["prefix_hit_rate"]
+    print(json.dumps({
+        "phase": "kv_prefix",
+        "requests": len(prefix_reqs),
+        "shared_prefix_blocks": int(len(shared) // KV_BLOCK),
+        "prefix_hits": pkv["prefix_hits"],
+        "prefix_misses": pkv["prefix_misses"],
+        "block_dedup": round(dedup, 4),
+    }), flush=True)
+
+    # int8 phase: capacity workload, quantized pool, run twice
+    int8_eng = ContinuousBatchingEngine(
+        model, slots=KV_PAGED_SLOTS, max_len=MAX_LEN,
+        prompt_bucket=PROMPT_BUCKET, readback_lag=2,
+        kv_cache="paged_int8", block_size=KV_BLOCK, pool_blocks=KV_POOL_BLOCKS,
+    )
+    int8_a, _ = _run_engine(int8_eng, reqs)
+    int8_b, _ = _run_engine(int8_eng, reqs)
+    int8_kv = int8_eng.stats()["kv"]
+    deterministic = all(np.array_equal(a, b) for a, b in zip(int8_a, int8_b))
+    agree = total = 0
+    for (prompt, budget), d, q in zip(reqs, dense_out, int8_a):
+        agree += int((d[len(prompt):] == q[len(prompt):]).sum())
+        total += budget
+    print(json.dumps({
+        "phase": "kv_int8",
+        "deterministic": deterministic,
+        "hbm_bytes": int8_kv["hbm_bytes"],
+        "hbm_ratio_vs_f32_pool": round(paged_kv["hbm_bytes"] / int8_kv["hbm_bytes"], 2),
+        "greedy_agreement_vs_dense": round(agree / total, 4),
+    }), flush=True)
+
+    checks = {
+        "concurrency_4x": paged_eng.peak_live >= 4 * dense_eng.peak_live,
+        "fixed_hbm": paged_kv["hbm_bytes"] <= 1.05 * dense_kv["hbm_bytes"],
+        "greedy_parity": parity,
+        "engine_programs_le_2": paged_stats["program_count"] <= 2,
+        "prefix_dedup_ge_90": dedup >= 0.90,
+        "int8_deterministic": deterministic,
+    }
+    ok = all(checks.values())
+    print(json.dumps({
+        "metric": "paged_kv_gate",
+        "paged_peak_live": paged_eng.peak_live,
+        "dense_peak_live": dense_eng.peak_live,
+        "hbm_ratio_paged_vs_dense": round(
+            paged_kv["hbm_bytes"] / dense_kv["hbm_bytes"], 3
+        ),
+        "block_dedup": round(dedup, 4),
+        "checks": checks,
+        "pass": ok,
+    }), flush=True)
+    return 0 if (ok or not gate) else 1
+
+
 if __name__ == "__main__":
+    if "--kv-gate" in _sys.argv or "--kv" in _sys.argv:
+        raise SystemExit(kv_main(gate="--kv-gate" in _sys.argv))
     raise SystemExit(main(gate="--gate" in _sys.argv))

@@ -1,0 +1,412 @@
+"""Paged KV-cache subsystem suite (docs/serving.md "Paged KV & prefix
+caching"):
+
+* host allocator units — blocks_needed math, free/active/cached accounting,
+  COW refcounts (owner-retires-first included), LRU eviction, capacity
+  errors;
+* int8 KV quantization — per-position roundtrip error bound and bitwise
+  determinism;
+* ``paged_attention`` reference op parity against dense attention;
+* dense↔paged bitwise-greedy parity through the engine AND the real
+  :class:`InferenceServer`, including slot reuse under a deliberately tiny
+  (block-recycling) pool;
+* admission gating on free blocks + the typed ``ValueError`` naming the
+  paged knobs;
+* the "exactly two compiled programs" property for a paged engine;
+* stats/metrics satellites: pool HBM bytes, live-vs-reserved utilization,
+  prefix-cache hit rate (engine stats and serving gauges);
+* static ``generate(kv_backend=...)`` parity and the ``ServingConfig``
+  validation surface.
+
+Engines compile two programs each, so tests share per-shape engines via a
+module-scoped cache (``reset()`` restores a pristine pool between tests).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from accelerate_tpu.engine import ContinuousBatchingEngine
+from accelerate_tpu.inference import generate
+from accelerate_tpu.kvcache import (
+    PagedBlockPool,
+    PagedKVBackend,
+    kv_dequantize,
+    kv_quantize,
+    make_kv_backend,
+)
+from accelerate_tpu.models.llama import LlamaConfig, create_llama
+from accelerate_tpu.ops.attention import dot_product_attention, paged_attention
+from accelerate_tpu.serving import InferenceServer
+from accelerate_tpu.utils.dataclasses import ServingConfig
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = LlamaConfig.tiny(compute_dtype=jnp.float32)
+    return create_llama(cfg, seed=0)
+
+
+_ENGINES: dict = {}
+
+
+@pytest.fixture
+def get_engine(model):
+    """Engine per shape+backend, cached across the module so each config
+    pays its two compiles once; reset before handout."""
+
+    def _get(slots=2, max_len=64, prompt_bucket=16, readback_lag=2,
+             kv_cache="paged", block_size=8, pool_blocks=None):
+        key = (slots, max_len, prompt_bucket, readback_lag,
+               kv_cache, block_size, pool_blocks)
+        eng = _ENGINES.get(key)
+        if eng is None:
+            eng = _ENGINES[key] = ContinuousBatchingEngine(
+                model, slots=slots, max_len=max_len,
+                prompt_bucket=prompt_bucket, readback_lag=readback_lag,
+                kv_cache=kv_cache, block_size=block_size,
+                pool_blocks=pool_blocks,
+            )
+        eng.reset()
+        return eng
+
+    return _get
+
+
+def _prompts(n, lens=(5, 9, 3, 12, 7, 4, 10, 6), seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, 255, size=lens[i % len(lens)]).tolist() for i in range(n)]
+
+
+def _ref(model, prompt, budget, **kw):
+    out = generate(
+        model, jnp.asarray([prompt], jnp.int32), max_new_tokens=budget,
+        pad_token_id=kw.pop("pad_token_id", 0), **kw,
+    )
+    return np.asarray(out)[0]
+
+
+# ---------------------------------------------------------- allocator units
+def _pool(num_blocks=9, block_size=4, slots=3, blocks_per_row=4):
+    return PagedBlockPool(
+        num_blocks=num_blocks, block_size=block_size, slots=slots,
+        blocks_per_row=blocks_per_row,
+    )
+
+
+def test_pool_blocks_needed_covers_final_decode_write():
+    pool = _pool(block_size=4)
+    # budget tokens end at position prompt+budget-1; a done slot keeps
+    # re-writing that frozen position, so it must own its block
+    assert pool.blocks_needed(4, 4) == 2
+    assert pool.blocks_needed(5, 4) == 3
+    assert pool.blocks_needed(1, 2) == 1
+
+
+def test_pool_acquire_release_roundtrip_and_null_row():
+    pool = _pool()
+    prompt = np.arange(1, 7, dtype=np.int32)  # 6 tokens, bs=4 -> 1 full block
+    row, shared = pool.acquire(0, prompt, budget=3)
+    assert shared == 0
+    assert row.shape == (4,)
+    used = pool.blocks_needed(6, 3)
+    assert (row[:used] != 0).all() and (row[used:] == 0).all()
+    assert pool.active_blocks() == used
+    pool.release(0)
+    # row resets to the null block so ghost-slot writes land in the sink
+    assert (pool.tables[0] == 0).all()
+    # the full prompt block registered -> cached; the partial block freed
+    assert pool.stats()["blocks_cached"] == 1
+    assert pool.free_blocks() == pool.num_blocks - 1
+
+
+def test_pool_cow_shares_full_prompt_blocks():
+    pool = _pool(block_size=4)
+    prompt = np.arange(1, 11, dtype=np.int32)  # 10 tokens -> 2 full blocks
+    row_a, shared_a = pool.acquire(0, prompt, budget=2)
+    row_b, shared_b = pool.acquire(1, prompt, budget=2)
+    assert shared_a == 0 and shared_b == 2
+    np.testing.assert_array_equal(row_a[:2], row_b[:2])  # shared prefix ids
+    assert row_a[2] != row_b[2]  # private tail blocks differ
+    assert pool._ref[row_a[0]] == 2
+    # diverging prompt shares only the depth-1 block
+    other = prompt.copy()
+    other[5] += 1
+    _, shared_c = pool.acquire(2, other, budget=2)
+    assert shared_c == 1
+
+
+def test_pool_cow_owner_retires_first_keeps_serving_hits():
+    pool = _pool(block_size=4)
+    prompt = np.arange(1, 10, dtype=np.int32)  # 2 full blocks + partial
+    row_a, _ = pool.acquire(0, prompt, budget=2)
+    pool.release(0)  # owner gone; registered blocks park in the cached tier
+    assert pool.stats()["blocks_cached"] == 2
+    row_b, shared = pool.acquire(1, prompt, budget=2)
+    assert shared == 2
+    np.testing.assert_array_equal(row_a[:2], row_b[:2])
+    assert pool.stats()["blocks_cached"] == 0  # revived cached -> active
+    assert pool._ref[row_b[0]] == 1
+
+
+def test_pool_lru_eviction_and_capacity_errors():
+    pool = _pool(num_blocks=5, block_size=4, slots=2, blocks_per_row=3)
+    a = np.arange(1, 5, dtype=np.int32)
+    b = np.arange(10, 14, dtype=np.int32)
+    pool.acquire(0, a, budget=4)  # 2 blocks (1 registered)
+    pool.acquire(1, b, budget=4)  # 2 blocks -> pool fully allocated
+    assert not pool.can_admit(a, budget=4)  # a's hit is active, not evictable
+    with pytest.raises(RuntimeError, match="no free KV blocks"):
+        pool.acquire(0, np.arange(20, 24, dtype=np.int32), budget=4)
+    pool.release(0)
+    pool.release(1)
+    # both registered blocks cached (2 free): a stranger needing 3 blocks
+    # must evict the LRU one (a's, released first) — b's keeps serving
+    assert pool.can_admit(np.arange(30, 34, dtype=np.int32), budget=8)
+    pool.acquire(0, np.arange(30, 34, dtype=np.int32), budget=8)
+    assert pool._shared_prefix(b) != [] and pool._shared_prefix(a) == []
+    # a row can never exceed blocks_per_row
+    with pytest.raises(RuntimeError, match="table row"):
+        pool.acquire(1, np.arange(1, 9, dtype=np.int32), budget=8)
+
+
+# ------------------------------------------------------------------ int8 KV
+def test_kv_quantize_roundtrip_bound_and_determinism():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(scale=3.0, size=(2, 5, 8, 4, 16)).astype(np.float32))
+    q, s = kv_quantize(x)
+    assert q.dtype == jnp.int8 and s.shape == (2, 5, 8)
+    deq = kv_dequantize(q, s, jnp.float32)
+    # symmetric round-to-nearest: error <= scale/2 per element (+ulp slack)
+    bound = np.asarray(s)[..., None, None] * 0.5 + 1e-6
+    assert (np.abs(np.asarray(x - deq)) <= bound).all()
+    q2, s2 = kv_quantize(x)
+    assert np.array_equal(np.asarray(q), np.asarray(q2))
+    assert np.array_equal(np.asarray(s), np.asarray(s2))
+
+
+# -------------------------------------------------------- paged_attention op
+def test_paged_attention_matches_dense_reference():
+    rng = np.random.default_rng(1)
+    b, h, kvh, d, bs, bpr = 2, 4, 2, 8, 4, 3
+    S = bs * bpr
+    q = jnp.asarray(rng.normal(size=(b, 1, h, d)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(b, S, kvh, d)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(b, S, kvh, d)).astype(np.float32))
+    k_pool = k.reshape(b * bpr, bs, kvh, d)
+    v_pool = v.reshape(b * bpr, bs, kvh, d)
+    tables = jnp.arange(b * bpr, dtype=jnp.int32).reshape(b, bpr)
+    pos = jnp.asarray([5, 9], jnp.int32)
+    out = np.asarray(paged_attention(q, k_pool, v_pool, tables, pos))
+    for i, p in enumerate((5, 9)):
+        ref = dot_product_attention(
+            q[i : i + 1], k[i : i + 1, : p + 1], v[i : i + 1, : p + 1],
+            causal=False,
+        )
+        np.testing.assert_array_equal(out[i], np.asarray(ref)[0])
+    # int8 pools: dequantization inside the op, bounded divergence
+    qk, sk = kv_quantize(k_pool)
+    qv, sv = kv_quantize(v_pool)
+    out8 = np.asarray(
+        paged_attention(q, qk, qv, tables, pos, k_scale=sk, v_scale=sv)
+    )
+    assert np.abs(out8 - out).max() < 0.1
+
+
+# ----------------------------------------------------- engine bitwise parity
+def test_engine_dense_vs_paged_bitwise_parity_with_block_recycling(model, get_engine):
+    """Three waves through a 2-slot paged engine whose pool is deliberately
+    tiny (9 blocks vs the 17 a full provision would take): wave 2+ decodes
+    into blocks recycled from earlier occupants, and every wave must still
+    match the dense static reference bitwise."""
+    eng = get_engine(slots=2, max_len=32, pool_blocks=9)
+    assert eng.stats()["kv"]["backend"] == "paged"
+    budgets = [5, 7]
+    for s in (1, 2, 3):
+        wave = _prompts(2, seed=s)
+        occs = [
+            eng.insert(p, max_new_tokens=b, pad_token_id=0, tag=i)
+            for i, (p, b) in enumerate(zip(wave, budgets))
+        ]
+        retired = eng.drain()
+        assert sorted(o.tag for o in retired) == [0, 1]
+        for p, b, occ in zip(wave, budgets, occs):
+            np.testing.assert_array_equal(occ.output_row(), _ref(model, p, b))
+    stats = eng.stats()
+    assert stats["programs"] == {"prefill_insert": 1, "decode_step": 1}
+    kv = stats["kv"]
+    assert kv["blocks_active"] == 0 and kv["reserved_tokens"] == 0
+
+
+def test_engine_prefix_cache_dedups_shared_system_prompt(model, get_engine):
+    """Same block-aligned system prompt on every request: after the first,
+    each admission hits the registry for all full prefix blocks — across
+    live sharers AND across waves via the cached tier."""
+    eng = get_engine(slots=2, max_len=64, block_size=8)
+    sys_prompt = _prompts(1, lens=(8,), seed=7)[0]  # one full shared block
+    for wave in range(2):
+        occs = [
+            eng.insert(sys_prompt + [50 + wave, i], max_new_tokens=4,
+                       pad_token_id=0, tag=i)
+            for i in range(2)
+        ]
+        eng.drain()
+        for i, occ in enumerate(occs):
+            np.testing.assert_array_equal(
+                occ.output_row(), _ref(model, sys_prompt + [50 + wave, i], 4)
+            )
+    kv = eng.stats()["kv"]
+    # 4 requests sharing one full prompt block: only the first allocates it
+    # (the second wave hits through the cached tier, across retirement)
+    assert kv["prefix_hits"] == 3 and kv["prefix_misses"] == 1
+    assert kv["prefix_hit_rate"] == pytest.approx(0.75)
+
+
+def test_engine_int8_kv_deterministic_and_close_to_dense(model, get_engine):
+    eng = get_engine(slots=2, max_len=32, kv_cache="paged_int8", pool_blocks=9)
+    assert eng.stats()["kv"]["backend"] == "paged_int8"
+    prompts = _prompts(2, seed=11)
+    budgets = [6, 8]
+    runs = []
+    for _ in range(2):
+        eng.reset()
+        occs = [
+            eng.insert(p, max_new_tokens=b, pad_token_id=0)
+            for p, b in zip(prompts, budgets)
+        ]
+        eng.drain()
+        runs.append([occ.output_row() for occ in occs])
+    agree = total = 0
+    for p, b, r0, r1 in zip(prompts, budgets, runs[0], runs[1]):
+        np.testing.assert_array_equal(r0, r1)  # bitwise deterministic
+        np.testing.assert_array_equal(r0[: len(p)], p)  # prompt echo intact
+        dense = _ref(model, p, b)
+        agree += int((r0[len(p):] == dense[len(p):]).sum())
+        total += b
+    # bounded divergence: quantization error may flip some greedy argmaxes
+    # but most generated tokens must agree with the dense reference
+    assert agree / total >= 0.5
+
+
+# ------------------------------------------------------------- admission gate
+def test_backend_validate_request_names_paged_knobs(model):
+    backend = make_kv_backend(
+        "paged", config=model.config, slots=2, max_len=64, prompt_bucket=16,
+        block_size=8, pool_blocks=4,
+    )
+    with pytest.raises(ValueError, match=r"engine_block_size=8"):
+        backend.validate_request(prompt_len=4, budget=30)
+    with pytest.raises(ValueError, match=r"engine_pool_blocks"):
+        backend.validate_request(prompt_len=4, budget=30)
+    backend.validate_request(prompt_len=4, budget=10)  # 2 blocks: fits
+
+
+def test_engine_can_admit_gates_on_free_blocks(model, get_engine):
+    eng = get_engine(slots=2, max_len=32, pool_blocks=9)  # 8 allocatable
+    p = _prompts(2, lens=(9, 12), seed=13)
+    a = eng.insert(p[0], max_new_tokens=15, pad_token_id=0)  # 3 blocks
+    eng.insert(p[1], max_new_tokens=12, pad_token_id=0)  # 3 blocks
+    # both slots busy -> no slot either way; free the accounting question by
+    # asking the backend directly: 2 free blocks < 3 needed
+    assert not eng._backend.can_admit(np.arange(1, 10, dtype=np.int32), 15)
+    assert eng._backend.can_admit(np.arange(1, 10, dtype=np.int32), 5)
+    eng.drain()
+    assert eng.can_admit(np.arange(1, 10, dtype=np.int32), 15)
+    assert a.finished
+
+
+def test_serving_config_validates_paged_knobs():
+    with pytest.raises(ValueError, match="kv_cache"):
+        ServingConfig(kv_cache="paged_int4")
+    with pytest.raises(ValueError, match="engine_block_size"):
+        ServingConfig(mode="continuous", kv_cache="paged",
+                      engine_max_len=60, engine_block_size=16)
+    with pytest.raises(ValueError, match="engine_pool_blocks"):
+        ServingConfig(kv_cache="paged", engine_pool_blocks=1)
+    ServingConfig(mode="continuous", kv_cache="paged", engine_max_len=64,
+                  engine_block_size=16)  # valid
+
+
+# ------------------------------------------------------------ server parity
+def test_server_paged_parity_and_kv_gauges(model, get_engine):
+    eng = get_engine(slots=2, max_len=64, block_size=8)
+    cfg = ServingConfig(
+        mode="continuous", engine_slots=2, engine_max_len=64,
+        engine_prompt_bucket=16, engine_readback_lag=2,
+        kv_cache="paged", engine_block_size=8,
+    )
+    shared = _prompts(1, lens=(8,), seed=17)[0]  # one full shared block
+    prompts = [shared + [i] for i in range(4)]
+    budgets = [6, 4, 8, 5]
+    with InferenceServer(model, cfg, engine=eng) as srv:
+        futs = [
+            srv.submit(p, max_new_tokens=b, pad_token_id=0)
+            for p, b in zip(prompts, budgets)
+        ]
+        cont = [f.result(timeout=120) for f in futs]
+        snap = srv.metrics.snapshot()
+    for p, b, res in zip(prompts, budgets, cont):
+        np.testing.assert_array_equal(res.tokens, _ref(model, p, b))
+    assert snap["serving/kv_hbm_bytes"] == eng.stats()["kv"]["hbm_bytes"] > 0
+    assert snap["serving/prefix_hit_rate"] == pytest.approx(0.75)  # 3 of 4
+    assert 0.0 <= snap["serving/kv_utilization"] <= 1.0
+
+
+def test_server_static_mode_routes_kv_backend_to_generate(model):
+    cfg = ServingConfig(
+        mode="static", kv_cache="paged", engine_block_size=8,
+        max_batch_size=1, batch_window_s=0.0, batch_bucket=False,
+    )
+    p = _prompts(1, seed=19)[0]
+    with InferenceServer(model, cfg) as srv:
+        res = srv.submit(p, max_new_tokens=6, pad_token_id=0).result(timeout=120)
+    np.testing.assert_array_equal(res.tokens, _ref(model, p, 6))
+
+
+# ----------------------------------------------------------- memory economics
+def test_paged_pool_hbm_is_smaller_and_stats_track_live_tokens(model, get_engine):
+    dense = make_kv_backend("dense", config=model.config, slots=8,
+                            max_len=256, prompt_bucket=16)
+    paged = make_kv_backend("paged", config=model.config, slots=8,
+                            max_len=256, prompt_bucket=16, block_size=16,
+                            pool_blocks=33)  # 4x oversubscribed
+    int8 = make_kv_backend("paged_int8", config=model.config, slots=8,
+                           max_len=256, prompt_bucket=16, block_size=16,
+                           pool_blocks=33)
+    assert paged.hbm_bytes() < dense.hbm_bytes() / 3
+    assert int8.hbm_bytes() < paged.hbm_bytes()
+    # live-vs-reserved utilization from a real engine
+    eng = get_engine(slots=2, max_len=64, block_size=8)
+    occ = eng.insert(_prompts(1, seed=23)[0], max_new_tokens=6, pad_token_id=0)
+    kv = eng.stats()["kv"]
+    assert kv["reserved_tokens"] > 0
+    assert 0.0 < kv["utilization"] <= 1.0
+    assert eng.live_tokens() == len(occ.prompt) + len(occ.tokens)
+    eng.drain()
+    assert eng.stats()["kv"]["utilization"] == 0.0
+    assert eng.peak_live == 1
+
+
+# --------------------------------------------------------- static generate()
+def test_generate_paged_backends_match_dense(model):
+    rng = np.random.default_rng(29)
+    ids = rng.integers(1, 255, size=(2, 9)).astype(np.int32)
+    dense = np.asarray(generate(model, ids, max_new_tokens=10))
+    paged = np.asarray(
+        generate(model, ids, max_new_tokens=10, kv_backend="paged",
+                 kv_block_size=8)
+    )
+    np.testing.assert_array_equal(dense, paged)
+    int8_a = np.asarray(
+        generate(model, ids, max_new_tokens=10, kv_backend="paged_int8",
+                 kv_block_size=8)
+    )
+    int8_b = np.asarray(
+        generate(model, ids, max_new_tokens=10, kv_backend="paged_int8",
+                 kv_block_size=8)
+    )
+    np.testing.assert_array_equal(int8_a, int8_b)
+    np.testing.assert_array_equal(int8_a[:, :9], ids)
+    with pytest.raises(ValueError, match="kv_backend"):
+        generate(model, ids, max_new_tokens=4, kv_backend="dense8")
