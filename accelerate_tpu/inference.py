@@ -101,8 +101,10 @@ def generate(
     a block pool with identity tables and decode runs through the same
     gather/commit ops as the continuous engine — bitwise-identical greedy
     outputs in f32), or ``"paged_int8"`` (pool stored int8 with per-block
-    scales). Paged rounds the total length up to a ``kv_block_size``
-    multiple, so outputs may carry extra scan steps like ``pad_to`` does.
+    scales). Paged rounds the cache length up to a ``kv_block_size``
+    multiple, which only enlarges the KV pool with extra masked positions —
+    the decode scan always runs exactly ``max_new_tokens`` steps, so the
+    output token count is unchanged.
     """
     from .models.gpt2 import GPT2Config, gpt2_decode_step, gpt2_prefill
     from .models.llama import llama_decode_step, llama_prefill

@@ -254,7 +254,11 @@ class PagedBlockPool:
     def _evict_one(self) -> int:
         blk, _ = self._cached.popitem(last=False)  # LRU
         key = self._key_of.pop(blk)
-        del self._registry[key]
+        # defensive: only drop the registry entry if it still points at this
+        # block (acquire deregisters superseded mappings, so a mismatch here
+        # would mean a newer block owns the key)
+        if self._registry.get(key) == blk:
+            del self._registry[key]
         return blk
 
     def _alloc_block(self) -> int:
@@ -297,6 +301,18 @@ class PagedBlockPool:
             self._ref[blk] = 1
             if j < full:
                 key = prompt[: (j + 1) * bs].tobytes()
+                # A stale registration can exist here: evicting a shallow
+                # prefix block orphans deeper extensions (the depth walk
+                # stops at the first miss), so this key may still map to an
+                # old block. Deregister it first — otherwise the old block's
+                # eventual eviction would delete OUR registry entry, and
+                # evicting this block afterwards would KeyError.
+                old = self._registry.get(key)
+                if old is not None and old != blk:
+                    del self._key_of[old]
+                    if old in self._cached:  # orphan at ref 0: plain free now
+                        del self._cached[old]
+                        self._free.append(old)
                 self._registry[key] = blk
                 self._key_of[blk] = key
             row.append(blk)

@@ -171,6 +171,49 @@ def test_pool_lru_eviction_and_capacity_errors():
         pool.acquire(1, np.arange(1, 9, dtype=np.int32), budget=8)
 
 
+def test_pool_reregistration_after_partial_prefix_eviction():
+    # Evicting a SHALLOW prefix block while a deeper sibling stays cached
+    # orphans the deep registration (the depth walk stops at the first
+    # miss). A repeat of the prefix must supersede the orphan's registry
+    # entry cleanly — the buggy overwrite left the orphan's _key_of alias
+    # alive, so its eviction deleted the NEW block's registration and a
+    # later eviction of the new block raised KeyError.
+    pool = _pool(num_blocks=12, block_size=4, slots=4, blocks_per_row=4)
+    prefix = np.arange(1, 9, dtype=np.int32)  # 8 tokens -> 2 full blocks
+    pool.acquire(0, prefix, budget=4)  # 3 blocks; depths 0,1 register
+    pool.release(0)  # both prefix blocks park cached, LRU front = depth 0
+    # burn the 9 free blocks + force exactly ONE eviction (the shallow
+    # depth-0 block) with prompts too short to register anything
+    pool.acquire(1, np.array([100], np.int32), budget=11)  # 3 blocks
+    pool.acquire(2, np.array([101], np.int32), budget=15)  # 4 blocks
+    pool.acquire(3, np.array([102], np.int32), budget=11)  # 3, evicts one
+    assert pool.stats()["blocks_cached"] == 1  # deep sibling survived
+    pool.release(1)  # free capacity for the repeat
+    # repeat of the same prefix: depth 0 misses, so fresh blocks register
+    # both depths — the deep key collides with the orphaned cached block
+    row, shared = pool.acquire(0, prefix, budget=4)
+    assert shared == 0
+    # invariant: registry and key_of are exact inverses, orphan freed
+    assert pool.stats()["blocks_cached"] == 0
+    assert {k: b for b, k in pool._key_of.items()} == {
+        k: b for k, b in pool._registry.items()
+    }
+    # churn evictions through the re-registered blocks: must not KeyError,
+    # and the prefix must still serve hits until its blocks are evicted
+    pool.release(0)
+    row2, shared2 = pool.acquire(0, prefix, budget=4)
+    assert shared2 == 2 and (row2[:2] == row[:2]).all()
+    pool.release(0)
+    pool.release(2)
+    pool.release(3)
+    big = np.arange(50, 54, dtype=np.int32)
+    pool.acquire(0, big, budget=12)        # 4 blocks
+    pool.acquire(1, big + 100, budget=12)  # 4 blocks
+    pool.acquire(2, big + 200, budget=8)   # 3: drains free, evicts both
+    assert pool._shared_prefix(prefix) == []
+    assert pool.active_blocks() == 11
+
+
 # ------------------------------------------------------------------ int8 KV
 def test_kv_quantize_roundtrip_bound_and_determinism():
     rng = np.random.default_rng(0)
