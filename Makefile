@@ -9,7 +9,7 @@
 PY := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu python
 PY_SLOW := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu RUN_SLOW=1 python
 
-.PHONY: test test_all test_core test_data test_parallel test_models test_cli test_big_modeling test-fault test-serving bench bench-telemetry bench-serving bench-continuous bench-recovery bench-kv
+.PHONY: test test_all test_core test_data test_parallel test_models test_cli test_big_modeling test-fault test-serving bench bench-telemetry bench-serving bench-continuous bench-recovery bench-kv bench-spec
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -28,7 +28,7 @@ test-fault:
 # batching engine (slot lifecycle, seed reproducibility, mode parity) and
 # the paged KV-cache subsystem (block tables, COW prefix cache, int8 KV)
 test-serving:
-	$(PY) -m pytest tests/test_serving.py tests/test_engine.py tests/test_kvcache.py -q
+	$(PY) -m pytest tests/test_serving.py tests/test_engine.py tests/test_kvcache.py tests/test_spec.py -q
 
 test_all:
 	$(PY_SLOW) -m pytest tests/test_state.py tests/test_operations.py tests/test_parallelism_config.py tests/test_accelerator.py tests/test_checkpointing.py tests/test_tracking.py tests/test_data_loader.py tests/test_data_shard_info.py tests/test_misc.py tests/test_cli.py tests/test_big_modeling.py tests/test_losses.py tests/test_flatbuf.py tests/test_local_sgd.py tests/test_api_parity.py tests/test_hlo_analysis.py tests/test_tracking_fakes.py tests/test_powersgd.py -q
@@ -81,6 +81,14 @@ bench-continuous:
 # blocks; int8 KV must be bitwise run-to-run deterministic (docs/serving.md)
 bench-kv:
 	$(PY) benchmarks/continuous_bench.py --kv-gate
+
+# speculative-decoding gate: prompt-lookup drafts + fused verify must reach
+# >= 1.5x plain continuous tokens/s on the repetitive-suffix workload with
+# bitwise greedy parity, stay within noise + bitwise identical on the
+# adversarial incompressible workload, keep <= 3 compiled engine programs,
+# and match dense-vs-paged spec outputs bitwise (docs/serving.md)
+bench-spec:
+	$(PY) benchmarks/continuous_bench.py --spec-gate
 
 # elastic-recovery gate: MTTR per restore path (local / replica / elastic
 # reshard, restart-to-resumed wall clock) + consensus/replication must stay

@@ -26,7 +26,24 @@ every row hit EOS at step 3. This module removes all three costs at once:
   ``lax.dynamic_update_slice``) and ``decode_step`` (one fused step over
   ALL slots — finished/vacant slots ride along masked). The KV arena and
   per-slot position/PRNG state are donated across calls, so steady-state
-  decode performs zero reallocation of the arena.
+  decode performs zero reallocation of the arena. Speculative decoding
+  (``spec="ngram"``) adds exactly ONE more: ``verify_step``, a fused
+  multi-token forward over a fixed-``spec_draft_len`` padded draft window
+  for every slot at once (actual per-slot draft lengths are traced mask
+  operands, never compile keys), bounding the engine at three programs
+  per (slots, max_len, spec_draft_len) config.
+* **Prompt-lookup speculative decoding** — a host-side per-slot n-gram
+  drafter matches the last tokens of a slot's history (prompt + emitted)
+  against earlier occurrences and proposes the continuation, no second
+  model needed (strongest on code/RAG-style repetitive traffic). One
+  ``verify_step`` scores all drafts, accepts each slot's longest matching
+  prefix (exact for greedy; standard rejection sampling against the
+  verifier's filtered distribution for ``temperature>0``), and commits
+  ONLY accepted tokens' KV columns — a rejected suffix "rewinds" by never
+  being committed, so paged block tables/refcounts have no rollback path.
+  A per-slot acceptance-rate EWMA stops drafting for incompressible
+  traffic, and a step where nobody drafted falls back to the plain
+  ``decode_step`` program (the k=0 path costs nothing extra).
 * **Iteration-level scheduling state** — the host (the serving worker)
   retires finished slots, admits queued requests into freed slots with an
   interleaved prefill, and enforces per-slot token budgets exactly. The
@@ -76,6 +93,16 @@ class SlotOccupant:
     tokens: List[int] = field(default_factory=list)  # emitted new tokens
     finished: bool = False
     first_token_s: Optional[float] = None  # host clock at first popped token
+    # speculative-decoding state: per-slot acceptance EWMA (starts above
+    # the gate floor so fresh occupants draft immediately, but low enough
+    # that a few rejected drafts gate an incompressible slot off fast), a
+    # cooldown counter for re-probing after the EWMA gates the slot, and
+    # the current cooldown length (doubles on every all-rejected verify up
+    # to _SPEC_COOLDOWN_MAX, resets once a draft lands — exponential
+    # backoff so hopeless slots probe rarely)
+    spec_ewma: float = 0.3
+    spec_skips: int = 0
+    spec_cooldown: int = 8
 
     def output_row(self) -> np.ndarray:
         """prompt + emitted tokens, padded with ``pad_id`` to the full
@@ -88,13 +115,13 @@ class SlotOccupant:
         return out
 
 
-def _sample_rows(logits, subkeys, temp, top_k, top_p):
-    """Per-row sampling over (N, V) logits: per-row temperature (0 = greedy
-    argmax), per-row top-k (0 or >= V = off) and top-p (>= 1 = off) via ONE
-    descending sort — both filters are dynamic per-row operands, so a
-    greedy row, a seeded nucleus row and a top-k row share this one traced
-    body (no structural sampling branches, unlike the static ``generate()``
-    whose top_k width is a compile key)."""
+def _filter_logits(logits, temp, top_k, top_p):
+    """The filtering half of :func:`_sample_rows`: per-row temperature
+    scaling, top-k and top-p over (N, V) logits → filtered scaled logits
+    (suppressed entries at ``-inf``), the distribution ``categorical``
+    samples from. Split out so speculative verify can score draft tokens
+    against EXACTLY the distribution plain decode would have sampled from
+    (rejection sampling is only exact against the same filtered dist)."""
     n, v = logits.shape
     safe_t = jnp.where(temp > 0, temp, jnp.float32(1.0))
     scaled = logits / safe_t[:, None]
@@ -118,7 +145,17 @@ def _sample_rows(logits, subkeys, temp, top_k, top_p):
         jnp.sum((cum < p_eff[:, None]).astype(jnp.int32), axis=-1) - 1, 0
     )
     cutoff = jnp.take_along_axis(sorted_f, cutoff_idx[:, None], axis=-1)
-    final = jnp.where(filtered < cutoff, -jnp.inf, filtered)
+    return jnp.where(filtered < cutoff, -jnp.inf, filtered)
+
+
+def _sample_rows(logits, subkeys, temp, top_k, top_p):
+    """Per-row sampling over (N, V) logits: per-row temperature (0 = greedy
+    argmax), per-row top-k (0 or >= V = off) and top-p (>= 1 = off) via ONE
+    descending sort — both filters are dynamic per-row operands, so a
+    greedy row, a seeded nucleus row and a top-k row share this one traced
+    body (no structural sampling branches, unlike the static ``generate()``
+    whose top_k width is a compile key)."""
+    final = _filter_logits(logits, temp, top_k, top_p)
     sampled = jax.vmap(jax.random.categorical)(subkeys, final).astype(jnp.int32)
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     return jnp.where(temp > 0, sampled, greedy)
@@ -145,7 +182,24 @@ class ContinuousBatchingEngine:
     (token, done) outputs by that many subsequent programs, keeping the
     decode loop free of synchronous device round-trips; ``0`` reads back
     every step (deterministic scheduling for tests).
+
+    ``spec="ngram"`` turns on prompt-lookup speculative decoding: a host
+    drafter proposes up to ``spec_draft_len`` continuation tokens per slot
+    from n-gram matches in the slot's own history, and one fused
+    ``verify_step`` program scores/accepts them (see the module
+    docstring). Drafting needs each slot's true current history, so
+    spec-mode steps materialize pending ring payloads to host before
+    drafting — retirement still happens at :meth:`poll` with unchanged
+    ``readback_lag`` semantics.
     """
+
+    # speculative acceptance-EWMA gate: a slot whose EWMA falls below the
+    # floor stops drafting (its traffic is incompressible — every wasted
+    # draft costs a k×-wider forward) and re-probes after the cooldown
+    _SPEC_EWMA_ALPHA = 0.2
+    _SPEC_MIN_ACCEPT = 0.1
+    _SPEC_COOLDOWN = 8
+    _SPEC_COOLDOWN_MAX = 128
 
     def __init__(
         self,
@@ -158,11 +212,19 @@ class ContinuousBatchingEngine:
         kv_cache: str = "dense",
         block_size: int = 16,
         pool_blocks: Optional[int] = None,
+        spec: Optional[str] = None,
+        spec_draft_len: int = 4,
+        spec_ngram: int = 3,
+        spec_ngram_min: int = 2,
         clock: Callable[[], float] = time.monotonic,
     ):
         from .kvcache import make_kv_backend
-        from .models.gpt2 import GPT2Config, gpt2_decode_step, gpt2_prefill_at
-        from .models.llama import llama_decode_step, llama_prefill_at
+        from .models.gpt2 import (
+            GPT2Config, gpt2_decode_step, gpt2_prefill_at, gpt2_verify_step,
+        )
+        from .models.llama import (
+            llama_decode_step, llama_prefill_at, llama_verify_step,
+        )
 
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
@@ -170,6 +232,20 @@ class ContinuousBatchingEngine:
             raise ValueError(f"max_len must be >= 2, got {max_len}")
         if readback_lag < 0:
             raise ValueError(f"readback_lag must be >= 0, got {readback_lag}")
+        if spec not in (None, "ngram"):
+            raise ValueError(f"spec must be None or 'ngram', got {spec!r}")
+        if spec is not None and spec_draft_len < 1:
+            raise ValueError(
+                f"spec_draft_len must be >= 1 when spec is enabled, got "
+                f"{spec_draft_len}"
+            )
+        if spec is not None and spec_ngram < 1:
+            raise ValueError(f"spec_ngram must be >= 1, got {spec_ngram}")
+        if spec is not None and not 1 <= spec_ngram_min <= spec_ngram:
+            raise ValueError(
+                f"spec_ngram_min must be in [1, spec_ngram], got "
+                f"{spec_ngram_min} (spec_ngram={spec_ngram})"
+            )
         self.model = model
         self.config = model.config
         self.slots = slots
@@ -189,9 +265,31 @@ class ContinuousBatchingEngine:
         )
         if isinstance(self.config, GPT2Config):
             self._prefill_at_fn, self._decode_fn = gpt2_prefill_at, gpt2_decode_step
+            self._verify_fn = gpt2_verify_step
         else:
             self._prefill_at_fn, self._decode_fn = llama_prefill_at, llama_decode_step
+            self._verify_fn = llama_verify_step
         self._key_width = jax.random.key_data(jax.random.key(0)).shape[-1]
+
+        self.spec = spec
+        self.spec_draft_len = spec_draft_len if spec is not None else 0
+        self.spec_ngram = spec_ngram
+        # precision floor: 1-gram fallback matches are noise on
+        # incompressible traffic (any repeated token sparks a draft), and
+        # every wrong draft costs a full k-wide verify forward
+        self.spec_ngram_min = spec_ngram_min
+        # host-side draft clamp, adjustable at runtime WITHOUT recompiling:
+        # the verify program is always padded to spec_draft_len, so any
+        # limit in [0, spec_draft_len] reuses the same compiled program
+        # (0 = every step takes the plain decode path)
+        self._spec_limit = self.spec_draft_len
+        self.spec_drafted = 0
+        self.spec_accepted = 0
+        self.spec_wasted = 0
+        self.spec_verify_steps = 0
+        self.spec_emitted = 0
+        self.spec_slot_steps = 0
+        self.spec_ewma = 1.0  # engine-wide acceptance EWMA (optimistic)
 
         self._donated, self._carried = self._init_state()
         # donate only argument 0 (the arena + per-slot pos/PRNG): the ring
@@ -199,6 +297,7 @@ class ContinuousBatchingEngine:
         # next program dispatches, so carried state is small and undonated
         self._decode_jit = jax.jit(self._decode_impl, donate_argnums=(0,))
         self._prefill_jit = jax.jit(self._prefill_impl, donate_argnums=(0,))
+        self._verify_jit = jax.jit(self._verify_impl, donate_argnums=(0,))
 
         self._occupants: List[Optional[SlotOccupant]] = [None] * slots
         self._free: List[int] = list(range(slots))
@@ -271,6 +370,130 @@ class ContinuousBatchingEngine:
         new_donated = {"cache": cache, "pos": new_pos, "key": next_kd}
         new_carried = {**carried, "token": nxt, "done": new_done, "budget": budget}
         return new_donated, new_carried
+
+    def _verify_impl(self, donated, carried, params, tables, draft, draft_len):
+        """The third jitted program: verify a fixed-k padded draft window
+        for every slot at once. ``draft`` (S, k) / ``draft_len`` (S,) are
+        traced operands — actual per-slot match lengths are MASKS, never
+        compile keys, so mixed draft lengths share this one program.
+
+        Window token j of slot b is ``[token_b, draft_b]`` at absolute
+        position ``pos_b + j``. Acceptance walks the longest matching
+        prefix: greedy rows accept a draft iff it equals the argmax of the
+        verifier's logits at its position (exactness — the emitted
+        sequence is bitwise what sequential decode would produce); sampled
+        rows run standard rejection sampling against the verifier's
+        FILTERED distribution (a deterministic drafter is a delta
+        proposal: accept ``d`` w.p. ``p(d)``, on rejection sample the
+        residual = ``p`` with ``d`` masked out, on full acceptance sample
+        the bonus position normally). Only the accepted tokens' KV columns
+        commit back to the store (``commit_window``); a rejected suffix
+        simply never existed.
+
+        PRNG discipline: exactly one split per program, same as decode —
+        a slot's key stream advances identically whether a tick ran
+        ``decode_step`` or ``verify_step``, and a ``draft_len=0`` row's
+        final sample consumes ``subkey`` on the window-0 logits, bitwise
+        identical to plain decode (alone-vs-packed reproducibility cannot
+        be broken by OTHER slots' drafts flipping the dispatch kind).
+        Acceptance uniforms draw from ``fold_in(subkey, 1+i)`` and the
+        post-rejection sample from ``fold_in(subkey, 1000+a)`` — disjoint
+        derived streams, never the raw subkey consumed twice."""
+        cache, pos, key_data = donated["cache"], donated["pos"], donated["key"]
+        token, done = carried["token"], carried["done"]
+        s, k = draft.shape
+        w = k + 1
+        layout = self._backend.make_layout(tables)
+        tokens = jnp.concatenate([token[:, None], draft], axis=1)  # (S, W)
+        if layout is None:
+            logits, win_kv = self._verify_fn(
+                self.config, params, cache, tokens, pos
+            )
+        else:
+            logits, win_kv = self._verify_fn(
+                self.config, params, cache, tokens, pos, kv_layout=layout
+            )
+        # logits: (S, W, V) f32 — logits[:, j] is the next-token dist after
+        # consuming window token j (position pos+j)
+        v = logits.shape[-1]
+        temp, top_k, top_p = carried["temp"], carried["top_k"], carried["top_p"]
+        finals = _filter_logits(
+            logits.reshape(s * w, v),
+            jnp.repeat(temp, w), jnp.repeat(top_k, w), jnp.repeat(top_p, w),
+        ).reshape(s, w, v)
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (S, W)
+
+        pairs = jax.vmap(jax.random.split)(jax.random.wrap_key_data(key_data))
+        next_kd = jax.random.key_data(pairs[:, 0])
+        subs = pairs[:, 1]
+
+        # longest accepted prefix a ∈ [0, draft_len]
+        idx_k = jnp.arange(k, dtype=jnp.int32)
+
+        def row_uniforms(sk):
+            ks = jax.vmap(lambda i: jax.random.fold_in(sk, 1 + i))(idx_k)
+            return jax.vmap(jax.random.uniform)(ks)
+
+        u = jax.vmap(row_uniforms)(subs)  # (S, k)
+        probs = jax.nn.softmax(finals[:, :k], axis=-1)
+        p_draft = jnp.take_along_axis(probs, draft[..., None], axis=-1)[..., 0]
+        acc = jnp.where(temp[:, None] > 0, u < p_draft, draft == greedy[:, :k])
+        acc = acc & (idx_k[None, :] < draft_len[:, None])
+        a = jnp.sum(jnp.cumprod(acc.astype(jnp.int32), axis=1), axis=1)  # (S,)
+
+        # final token at window index a: greedy argmax, or a categorical on
+        # the filtered dist with the rejected draft masked out (residual of
+        # rejection sampling against a delta proposal); full acceptance
+        # (a == draft_len) keeps the distribution unmasked (bonus sample)
+        finals_a = jnp.take_along_axis(finals, a[:, None, None], axis=1)[:, 0]
+        draft_ext = jnp.concatenate([draft, draft[:, :1]], axis=1)  # (S, W)
+        d_rej = jnp.take_along_axis(draft_ext, a[:, None], axis=1)[:, 0]
+        is_rej = a < draft_len
+        vocab = jnp.arange(v, dtype=jnp.int32)
+        resid = jnp.where(
+            is_rej[:, None] & (vocab[None, :] == d_rej[:, None]), -jnp.inf, finals_a
+        )
+        folded = jax.vmap(jax.random.fold_in)(subs, 1000 + a)
+        kd_final = jnp.where(
+            (a == 0)[:, None],
+            jax.random.key_data(subs), jax.random.key_data(folded),
+        )
+        sampled_final = jax.vmap(jax.random.categorical)(
+            jax.random.wrap_key_data(kd_final), resid
+        ).astype(jnp.int32)
+        greedy_final = jnp.take_along_axis(greedy, a[:, None], axis=1)[:, 0]
+        t_final = jnp.where(temp > 0, sampled_final, greedy_final)
+
+        # emitted sequence E_0..E_a = accepted drafts + the final sample;
+        # truncate at the remaining budget and at the first EOS
+        jw = jnp.arange(w, dtype=jnp.int32)[None, :]
+        emitted = jnp.where(jw < a[:, None], draft_ext, t_final[:, None])
+        emitted = jnp.where(jw == a[:, None], t_final[:, None], emitted)
+        eos = carried["eos"]
+        is_eos = (eos[:, None] >= 0) & (emitted == eos[:, None]) & (jw <= a[:, None])
+        first_eos = jnp.min(jnp.where(is_eos, jw, w + 1), axis=1)  # (S,)
+        emitting = ~done
+        m = jnp.minimum(jnp.minimum(a + 1, carried["budget"]), first_eos + 1)
+        m = jnp.where(emitting, m, 0)
+        emitted = jnp.where(jw < m[:, None], emitted, carried["pad"][:, None])
+
+        # commit exactly m columns (positions pos..pos+m-1): the carried
+        # token + accepted drafts whose successors are now determined. The
+        # LAST emitted token's KV is NOT committed — it becomes the new
+        # carried token and the next program writes it, exactly like
+        # decode's sampled token
+        cache = self._backend.commit_window(cache, win_kv, tables, pos, m)
+
+        last = jnp.take_along_axis(emitted, jnp.maximum(m - 1, 0)[:, None], axis=1)[:, 0]
+        new_token = jnp.where(emitting, last, carried["pad"])
+        new_budget = carried["budget"] - m
+        new_done = done | (emitting & ((first_eos < m) | (new_budget <= 0)))
+        new_pos = pos + m
+        new_donated = {"cache": cache, "pos": new_pos, "key": next_kd}
+        new_carried = {
+            **carried, "token": new_token, "done": new_done, "budget": new_budget,
+        }
+        return new_donated, new_carried, emitted, m, a
 
     def _prefill_impl(
         self, donated, carried, params, prompt, length, slot, key_data,
@@ -416,10 +639,17 @@ class ContinuousBatchingEngine:
         return occ
 
     def step(self) -> bool:
-        """One fused decode step over every slot (vacant/finished slots ride
-        masked). Returns False (no dispatch) when nothing is live."""
+        """One fused step over every slot (vacant/finished slots ride
+        masked): a ``verify_step`` when speculative drafting produced any
+        draft this tick, the plain ``decode_step`` otherwise. Returns
+        False (no dispatch) when nothing is live."""
         if self.live_count() == 0:
             return False
+        if self.spec is not None:
+            return self._step_spec()
+        return self._dispatch_decode()
+
+    def _dispatch_decode(self) -> bool:
         self._record("decode_step", ())
         self._donated, self._carried = self._decode_jit(
             self._donated, self._carried, self.model.params,
@@ -430,6 +660,192 @@ class ContinuousBatchingEngine:
         self._ring.append(
             (self._tick, "decode",
              (tuple(self._occupants), self._carried["token"], self._carried["done"]))
+        )
+        return True
+
+    def set_spec_draft_limit(self, n: int) -> None:
+        """Clamp the host drafter's proposal length at runtime WITHOUT
+        recompiling: the verify program is always padded to the configured
+        ``spec_draft_len``, so any limit in [0, spec_draft_len] reuses the
+        same compiled program. 0 disables drafting entirely — every step
+        takes the plain ``decode_step`` path. The serving degradation
+        ladder drops this before clamping budgets or shedding."""
+        self._spec_limit = int(np.clip(n, 0, self.spec_draft_len))
+
+    def _materialize_ring(self) -> None:
+        """Convert every pending ring payload's device arrays to host numpy
+        IN PLACE (blocking until those programs complete) so the drafter
+        sees each slot's true current history. Absorption/retirement still
+        happen at :meth:`poll` with unchanged ``readback_lag`` semantics —
+        this only moves the host transfer earlier for spec-mode steps,
+        which need fresh history before they can propose drafts."""
+        for i, (tick, kind, payload) in enumerate(self._ring):
+            if kind == "prefill":
+                occ, tok, done = payload
+                if not isinstance(tok, (int, np.integer)):
+                    self._ring[i] = (
+                        tick, kind, (occ, int(np.asarray(tok)), bool(np.asarray(done)))
+                    )
+            elif kind == "decode":
+                occs, toks, dones = payload
+                if not isinstance(toks, np.ndarray):
+                    self._ring[i] = (
+                        tick, kind, (occs, np.asarray(toks), np.asarray(dones))
+                    )
+            else:  # verify
+                occs, emitted, ms, accs, dlens, dones = payload
+                if not isinstance(emitted, np.ndarray):
+                    self._ring[i] = (
+                        tick, kind,
+                        (occs, np.asarray(emitted), np.asarray(ms),
+                         np.asarray(accs), dlens, np.asarray(dones)),
+                    )
+
+    def _pending_tokens(self, occ: SlotOccupant):
+        """Tokens emitted for ``occ`` that sit in the (materialized) ring
+        but have not been absorbed yet, plus whether a pending entry
+        already marked the slot done. Entries snapshotting a different
+        (earlier) occupant of the same slot are skipped, mirroring poll."""
+        toks: List[int] = []
+        done = False
+        for _, kind, payload in self._ring:
+            if kind == "prefill":
+                p_occ, tok, d = payload
+                if p_occ is occ:
+                    toks.append(int(tok))
+                    done = done or bool(d)
+            elif kind == "decode":
+                occs, t_arr, d_arr = payload
+                if occs[occ.slot] is occ:
+                    toks.append(int(t_arr[occ.slot]))
+                    done = done or bool(d_arr[occ.slot])
+            else:  # verify
+                occs, emitted, ms, accs, dlens, d_arr = payload
+                if occs[occ.slot] is occ:
+                    m = int(ms[occ.slot])
+                    toks.extend(int(t) for t in emitted[occ.slot, :m])
+                    done = done or bool(d_arr[occ.slot])
+        return toks, done
+
+    def _prompt_lookup(self, hist: np.ndarray, limit: int) -> np.ndarray:
+        """Prompt-lookup n-gram draft: match the longest suffix n-gram of
+        ``hist`` (n = spec_ngram down to spec_ngram_min) against an earlier
+        occurrence and propose the tokens that followed it — preferring the
+        MOST RECENT match with a full ``limit``-token continuation, else the
+        earliest match (whose continuation is longest). A naive
+        latest-match rule starves on cyclic histories: the latest
+        occurrence ends right before the suffix, leaving a 1-token
+        continuation. Deterministic, history-only — drafts depend on
+        nothing outside the slot, which is what keeps per-slot streams
+        reproducible alone-vs-packed."""
+        n = len(hist)
+        if limit <= 0 or n < 2:
+            return np.zeros(0, np.int32)
+        for g in range(min(self.spec_ngram, n - 1), self.spec_ngram_min - 1, -1):
+            pat = hist[n - g:]
+            body = hist[: n - 1]  # suffix occurrence at the very end excluded
+            if len(body) < g:
+                continue
+            windows = np.lib.stride_tricks.sliding_window_view(body, g)
+            matches = np.nonzero((windows == pat[None, :]).all(axis=1))[0]
+            if len(matches) == 0:
+                continue
+            ends = matches + g - 1  # match end indices; n-1-end tokens follow
+            full = ends[n - 1 - ends >= limit]
+            end = int(full[-1]) if len(full) else int(ends[0])
+            cont = hist[end + 1 : end + 1 + limit]
+            if len(cont):
+                return cont.astype(np.int32)
+        return np.zeros(0, np.int32)
+
+    def _step_spec(self) -> bool:
+        """Draft for every live slot, then dispatch ONE program: the fused
+        ``verify_step`` when anyone drafted, the plain ``decode_step`` when
+        nobody did (incompressible traffic pays zero verify overhead — the
+        k=0 path IS the existing program)."""
+        # fast path: every live slot sits in EWMA cooldown, so nobody can
+        # draft this tick — skip the blocking ring readback entirely and
+        # keep the decode pipeline as deep as plain (non-spec) mode. This
+        # is what makes incompressible traffic run at ~plain throughput
+        # instead of paying a per-step sync it gets nothing for.
+        gated = []
+        for occ in self._occupants:
+            if occ is None or occ.finished:
+                continue
+            if not (occ.spec_ewma < self._SPEC_MIN_ACCEPT
+                    and occ.spec_skips + 1 < occ.spec_cooldown):
+                gated = None
+                break
+            gated.append(occ)
+        if gated:
+            for occ in gated:
+                occ.spec_skips += 1
+            return self._dispatch_decode()
+        self._materialize_ring()
+        k = self.spec_draft_len
+        draft = np.zeros((self.slots, k), np.int32)
+        dlen = np.zeros((self.slots,), np.int32)
+        for occ in self._occupants:
+            if occ is None or occ.finished:
+                continue
+            pending, pending_done = self._pending_tokens(occ)
+            if pending_done:
+                continue
+            # acceptance-EWMA gate: incompressible slots stop paying the
+            # k×-wider verify forward; after the cooldown the EWMA resets
+            # to the floor so one probe draft can rehabilitate the slot
+            if occ.spec_ewma < self._SPEC_MIN_ACCEPT:
+                occ.spec_skips += 1
+                if occ.spec_skips < occ.spec_cooldown:
+                    continue
+                occ.spec_skips = 0
+                occ.spec_ewma = self._SPEC_MIN_ACCEPT
+            emitted_count = len(occ.tokens) + len(pending)
+            # the final budgeted token needs no draft (it is sampled by the
+            # verify/decode program itself), hence the -1; this cap also
+            # keeps every real window position inside prompt+budget <=
+            # max_len, so commits can never overhang the arena
+            limit = min(self._spec_limit, occ.budget - emitted_count - 1)
+            if limit <= 0:
+                continue
+            hist = np.concatenate(
+                [occ.prompt, np.asarray(occ.tokens + pending, np.int32)]
+            )
+            d = self._prompt_lookup(hist, limit)
+            if len(d) == 0:
+                # finding nothing to propose is itself incompressibility
+                # evidence: decay the EWMA (and back off like a failed
+                # probe once below the floor) so matchless slots gate off
+                # and stop paying the pre-draft blocking readback on every
+                # step — without this, a slot that never matches anything
+                # also never updates its EWMA and drags forever
+                occ.spec_ewma *= 1 - self._SPEC_EWMA_ALPHA
+                if occ.spec_ewma < self._SPEC_MIN_ACCEPT:
+                    occ.spec_cooldown = min(
+                        2 * occ.spec_cooldown, self._SPEC_COOLDOWN_MAX
+                    )
+                continue
+            draft[occ.slot, : len(d)] = d
+            dlen[occ.slot] = len(d)
+        total = int(dlen.sum())
+        if total == 0:
+            return self._dispatch_decode()
+        self._record("verify_step", (k,))
+        # numpy operands go straight to the jitted call: its C++ fast path
+        # does the host->device transfer cheaper than an explicit
+        # device_put, and this sits on the serial critical path (each spec
+        # step blocks on the previous verify before it can draft)
+        (self._donated, self._carried, emitted, m, a) = self._verify_jit(
+            self._donated, self._carried, self.model.params,
+            self._backend.device_tables(), draft, dlen,
+        )
+        self.steps += 1
+        self.spec_verify_steps += 1
+        self.spec_drafted += total
+        self._tick += 1
+        self._ring.append(
+            (self._tick, "verify",
+             (tuple(self._occupants), emitted, m, a, dlen, self._carried["done"]))
         )
         return True
 
@@ -446,8 +862,8 @@ class ContinuousBatchingEngine:
             _, kind, payload = self._ring.popleft()
             if kind == "prefill":
                 occ, tok, done = payload
-                self._absorb(occ, int(tok), bool(done), retired)
-            else:
+                self._absorb(occ, int(np.asarray(tok)), bool(np.asarray(done)), retired)
+            elif kind == "decode":
                 occs, toks, dones = payload
                 toks = np.asarray(toks)
                 dones = np.asarray(dones)
@@ -455,6 +871,45 @@ class ContinuousBatchingEngine:
                     if occ is None or occ.finished:
                         continue
                     self._absorb(occ, int(toks[occ.slot]), bool(dones[occ.slot]), retired)
+            else:  # verify: up to W tokens per slot, done applies to the last
+                occs, emitted, ms, accs, dlens, dones = payload
+                emitted = np.asarray(emitted)
+                ms = np.asarray(ms)
+                accs = np.asarray(accs)
+                dones = np.asarray(dones)
+                for occ in occs:
+                    if occ is None or occ.finished:
+                        continue
+                    s = occ.slot
+                    dl = int(dlens[s])
+                    if dl > 0:
+                        acc = int(accs[s])
+                        self.spec_accepted += acc
+                        self.spec_wasted += dl - acc
+                        self.spec_emitted += int(ms[s])
+                        self.spec_slot_steps += 1
+                        rate = acc / dl
+                        al = self._SPEC_EWMA_ALPHA
+                        occ.spec_ewma = (1 - al) * occ.spec_ewma + al * rate
+                        self.spec_ewma = (1 - al) * self.spec_ewma + al * rate
+                        # exponential probe backoff: a verify that accepted
+                        # nothing doubles the slot's cooldown (capped), any
+                        # accepted token resets it — hopeless slots probe
+                        # rarely, recovering slots re-engage immediately
+                        if acc == 0:
+                            occ.spec_cooldown = min(
+                                2 * occ.spec_cooldown, self._SPEC_COOLDOWN_MAX
+                            )
+                        else:
+                            occ.spec_cooldown = self._SPEC_COOLDOWN
+                    m = int(ms[s])
+                    d = bool(dones[s])
+                    for j in range(m):
+                        if occ.finished:
+                            break
+                        self._absorb(
+                            occ, int(emitted[s, j]), d and j == m - 1, retired
+                        )
         return retired
 
     def _absorb(self, occ: SlotOccupant, token: int, done: bool, retired: list) -> None:
@@ -537,10 +992,14 @@ class ContinuousBatchingEngine:
     def stats(self) -> dict:
         """Observability twin of ``generate_cache_stats``: how many distinct
         (program, operand-shape) signatures this engine dispatched — the
-        acceptance gate asserts <= 2 per (slots, max_len) config — plus
-        lifetime counters and the KV store's memory economics (``kv``:
-        pool/arena HBM bytes, live- vs reserved-token utilization, prefix-
-        cache hit rate) so benches gate on measured memory, not inference."""
+        acceptance gate asserts <= 2 per (slots, max_len) config (<= 3 with
+        speculative decoding's ``verify_step``) — plus lifetime counters,
+        speculative acceptance accounting (``spec``: drafted/accepted/
+        wasted token counters, acceptance EWMA, emitted-tokens-per-verify;
+        accepted/wasted lag drafted by up to ``readback_lag`` polls), and
+        the KV store's memory economics (``kv``: pool/arena HBM bytes,
+        live- vs reserved-token utilization, prefix-cache hit rate) so
+        benches gate on measured memory, not inference."""
         programs = {name: len(sigs) for name, sigs in self._programs.items()}
         kv = self._backend.stats()
         live_tok = self.live_tokens()
@@ -568,4 +1027,24 @@ class ContinuousBatchingEngine:
             "programs": programs,
             "program_count": sum(programs.values()),
             "kv": kv,
+            "spec": {
+                "mode": self.spec or "off",
+                "draft_len": self.spec_draft_len,
+                "draft_limit": self._spec_limit,
+                "drafted": self.spec_drafted,
+                "accepted": self.spec_accepted,
+                "wasted": self.spec_wasted,
+                "acceptance_rate": (
+                    (self.spec_accepted / self.spec_drafted)
+                    if self.spec_drafted else 0.0
+                ),
+                "acceptance_ewma": self.spec_ewma,
+                "verify_steps": self.spec_verify_steps,
+                # emitted tokens per (slot, verify step) pair that drafted:
+                # 1.0 = verify never beat decode, k+1 = every draft landed
+                "tokens_per_step": (
+                    (self.spec_emitted / self.spec_slot_steps)
+                    if self.spec_slot_steps else 0.0
+                ),
+            },
         }

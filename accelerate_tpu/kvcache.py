@@ -5,7 +5,8 @@ The continuous engine's original KV store is a dense arena ``(layers, slots,
 max_len, kv_heads, head_dim)``: every slot reserves its worst case, so HBM —
 not compute — caps concurrency (ROADMAP open item 1). This module replaces
 that store with the vLLM/Orca-class paged design while keeping the engine's
-two-jitted-programs discipline intact:
+bounded-program discipline intact (two jitted programs per config; three
+when speculative decoding adds its ``verify_step``):
 
 * **Block pool + block tables** — one shared device pool ``(layers,
   num_blocks, block_size, kv_heads, head_dim)``; each slot owns a row of a
@@ -164,6 +165,36 @@ class PagedKVLayout:
                 "s": layer_cache["s"].at[blk, off].set(s),
             }
         return layer_cache.at[blk, off].set(col.astype(layer_cache.dtype))
+
+    def commit_window(self, layer_cache, window, pos, count):
+        """Scatter the first ``count[b]`` columns of a speculative-verify
+        window into the pool, stacked over layers: ``window`` is
+        ``(L, B, W, kvh, hd)`` holding the window K (or V) rows at positions
+        ``pos .. pos+W-1``, ``count`` (B,) the per-slot accepted length.
+        Rejected/padded columns (``j >= count``) and positions past the
+        row's table coverage route to the null block — a failed speculation
+        "rewinds" by simply never being committed, so block tables and
+        refcounts need no rollback path. Like decode commits, windows start
+        at ``pos >= prompt_len``, so registered COW prefix blocks are never
+        written here."""
+        bs = self.block_size
+        w = window.shape[2]
+        bpr = self.tables.shape[1]
+        j = jnp.arange(w, dtype=jnp.int32)[None, :]
+        abs_pos = pos[:, None] + j  # (B, W)
+        valid = (j < count[:, None]) & (abs_pos < bpr * bs)
+        blk = jnp.take_along_axis(
+            self.tables, jnp.clip(abs_pos // bs, 0, bpr - 1), axis=1
+        )
+        blk = jnp.where(valid, blk, _NULL_BLOCK)
+        off = abs_pos % bs
+        if isinstance(layer_cache, dict):
+            q, s = kv_quantize(window)  # per-(layer, slot, position) scales
+            return {
+                "q": layer_cache["q"].at[:, blk, off].set(q),
+                "s": layer_cache["s"].at[:, blk, off].set(s),
+            }
+        return layer_cache.at[:, blk, off].set(window.astype(layer_cache.dtype))
 
 
 # ------------------------------------------------------------ host block pool
@@ -372,6 +403,14 @@ class KVCacheBackend:
         per leaf) into the store for ``slot``/``table_row``."""
         raise NotImplementedError
 
+    def commit_window(self, cache, window_kv, tables, pos, count):
+        """Scatter the first ``count[b]`` columns of a speculative-verify
+        window (``window_kv``: ``{"k","v"}`` of ``(L, B, W, kvh, hd)``) into
+        the store at positions ``pos .. pos+count-1`` per slot. Columns past
+        ``count`` (rejected drafts / padding) are dropped, never clamped
+        onto live positions."""
+        raise NotImplementedError
+
     # host side -------------------------------------------------------------
     def device_tables(self):
         raise NotImplementedError
@@ -440,6 +479,20 @@ class DenseKVBackend(KVCacheBackend):
                 cache[which],
                 new_cache[which].astype(cache[which].dtype),
                 (0, slot, 0, 0, 0),
+            )
+            for which in ("k", "v")
+        }
+
+    def commit_window(self, cache, window_kv, tables, pos, count):
+        w = window_kv["k"].shape[2]
+        j = jnp.arange(w, dtype=jnp.int32)[None, :]
+        idx = pos[:, None] + j  # (S, W) absolute positions
+        valid = (j < count[:, None]) & (idx < self.max_len)
+        idx = jnp.where(valid, idx, self.max_len)  # pushed OOB -> dropped
+        rows = jnp.arange(self.slots)[:, None]
+        return {
+            which: cache[which].at[:, rows, idx].set(
+                window_kv[which].astype(cache[which].dtype), mode="drop"
             )
             for which in ("k", "v")
         }
@@ -565,6 +618,13 @@ class PagedKVBackend(KVCacheBackend):
                     )
             out[which] = pool
         return out
+
+    def commit_window(self, cache, window_kv, tables, pos, count):
+        layout = self.make_layout(tables)
+        return {
+            which: layout.commit_window(cache[which], window_kv[which], pos, count)
+            for which in ("k", "v")
+        }
 
     # -------------------------------------------------------------- host side
     def device_tables(self):

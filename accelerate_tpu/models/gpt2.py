@@ -32,6 +32,7 @@ from .llama import (
     _ce_from_hidden,
     _remat_policy,
     _write_kv_at,
+    _write_kv_window,
     llama_ce_denominator,
     llama_loss,
 )
@@ -445,6 +446,70 @@ def gpt2_decode_step(config: GPT2Config, params, cache, token, pos, *,
     x = layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"], config.layer_norm_eps)
     logits = x @ params["wte"]["embedding"].astype(cdt).T
     return logits[:, 0].astype(jnp.float32), {"k": new_k, "v": new_v}
+
+
+def _gpt2_verify_layer(config: GPT2Config, lp, x, cache_k, cache_v, pos):
+    """One block over a W-token speculative-verify window at positions
+    ``pos .. pos+W-1`` (``pos`` a traced (B,) vector). Same read-only-cache
+    contract as llama's ``_verify_layer``: the window's K/V go into a
+    temporary scatter-written copy for the causal attend, and the raw
+    window K/V are returned for the caller's accepted-prefix commit."""
+    cdt = config.compute_dtype
+    b, w, d = x.shape
+    h, hd = config.num_attention_heads, config.head_dim
+
+    y = layer_norm(x, lp["ln_1"]["scale"], lp["ln_1"]["bias"], config.layer_norm_eps)
+    q = _apply_dense(lp["attn"]["c_attn_q"], y, cdt).reshape(b, w, h, hd)
+    k = _apply_dense(lp["attn"]["c_attn_k"], y, cdt).reshape(b, w, h, hd)
+    v = _apply_dense(lp["attn"]["c_attn_v"], y, cdt).reshape(b, w, h, hd)
+    win_k, win_v = k, v
+    cache_k = _write_kv_window(cache_k, k, pos)
+    cache_v = _write_kv_window(cache_v, v, pos)
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q * (1.0 / np.sqrt(hd)), cache_k.astype(cdt)
+    ).astype(jnp.float32)
+    k_pos = lax.broadcasted_iota(jnp.int32, scores.shape, 3)
+    q_idx = lax.broadcasted_iota(jnp.int32, scores.shape, 2)
+    pos_b = pos[:, None, None, None]
+    scores = jnp.where(k_pos <= pos_b + q_idx, scores, -1e6)
+    weights = jax.nn.softmax(scores, axis=-1)
+    attn = jnp.einsum("bhqk,bkhd->bqhd", weights.astype(cdt), cache_v.astype(cdt))
+    attn = _apply_dense(lp["attn"]["c_proj"], attn.reshape(b, w, d), cdt)
+    x = x + attn
+
+    y = layer_norm(x, lp["ln_2"]["scale"], lp["ln_2"]["bias"], config.layer_norm_eps)
+    y = jax.nn.gelu(_apply_dense(lp["mlp"]["c_fc"], y, cdt), approximate=True)
+    y = _apply_dense(lp["mlp"]["c_proj"], y, cdt)
+    return x + y, win_k, win_v
+
+
+def gpt2_verify_step(config: GPT2Config, params, cache, tokens, pos, *,
+                     kv_layout=None):
+    """Speculative-verify forward: ``tokens`` (B, W) at positions
+    ``pos .. pos+W-1`` → (logits (B, W, V) f32, window KV (L, B, W, h, hd)).
+    Same contract as :func:`~.llama.llama_verify_step`: the cache is
+    read-only here; the caller commits the accepted prefix. Learned
+    positions use a clamping ``jnp.take`` (matching decode) — padded
+    window positions past ``max_position_embeddings`` clamp harmlessly
+    because their logits are discarded by the engine's length mask."""
+    cdt = config.compute_dtype
+    b, w = tokens.shape
+    x = params["wte"]["embedding"].astype(cdt)[tokens]
+    wpe = params["wpe"]["embedding"].astype(cdt)
+    abs_pos = pos[:, None] + jnp.arange(w, dtype=pos.dtype)[None, :]  # (B, W)
+    x = x + jnp.take(wpe, abs_pos, axis=0)
+
+    def body(x, inputs):
+        lp, ck, cv = inputs
+        if kv_layout is not None:
+            ck, cv = kv_layout.view(ck), kv_layout.view(cv)
+        x, wk, wv = _gpt2_verify_layer(config, lp, x, ck, cv, pos)
+        return x, (wk, wv)
+
+    x, (win_k, win_v) = lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
+    x = layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"], config.layer_norm_eps)
+    logits = x @ params["wte"]["embedding"].astype(cdt).T
+    return logits.astype(jnp.float32), {"k": win_k, "v": win_v}
 
 
 def upgrade_legacy_state(tree: dict) -> dict:

@@ -1262,6 +1262,94 @@ def _decode_layer(config: LlamaConfig, layer_params, x, cache_k, cache_v, pos,
     return residual + y, cache_k, cache_v
 
 
+def _verify_layer(config: LlamaConfig, layer_params, x, cache_k, cache_v, pos,
+                  sliding=None):
+    """One block over a W-token speculative-verify window: ``x`` is
+    (B, W, D) — the carried token plus k draft tokens — at positions
+    ``pos .. pos+W-1`` (``pos`` a traced (B,) vector). The cache operands
+    are READ-ONLY: the window's K/V are scatter-written into a temporary
+    copy so the window can attend itself causally, and the raw rotated
+    per-position K/V are returned so the caller can commit only the
+    accepted prefix afterwards — "rewind" is simply not committing.
+    Padded window positions that land past the cache length are dropped by
+    the scatter (``mode='drop'``), never clamped onto a live column; their
+    queries produce garbage logits that the engine's length mask discards,
+    and their keys sit strictly after every valid query's causal horizon."""
+    h, kvh, hd = config.num_attention_heads, config.num_key_value_heads, config.head_dim
+    b, w, d = x.shape
+    cdt = config.compute_dtype
+
+    residual = x
+    y = rms_norm(x, layer_params["input_norm"]["scale"], config.rms_norm_eps, config.rms_norm_offset)
+    def _dproj(name):
+        p = layer_params["attn"][name]
+        out = y @ p["kernel"].astype(cdt)
+        if "bias" in p:
+            out = out + p["bias"].astype(cdt)
+        return out
+
+    q = _dproj("q_proj").reshape(b, w, h, hd)
+    k = _dproj("k_proj").reshape(b, w, kvh, hd)
+    v = _dproj("v_proj").reshape(b, w, kvh, hd)
+    q = apply_rope_window(q, pos, config.rope_theta, config._rope_scaling_key())
+    k = apply_rope_window(k, pos, config.rope_theta, config._rope_scaling_key())
+    win_k, win_v = k, v
+    cache_k = _write_kv_window(cache_k, k, pos)
+    cache_v = _write_kv_window(cache_v, v, pos)
+    # Causal over past + window: query j (absolute position pos+j) attends
+    # k_pos <= pos+j. Same grouped-GQA einsum as _decode_layer — per-(q, k)
+    # score elements are independent dot products, so the q_idx=0 row of
+    # this window reproduces the single-token decode scores bitwise.
+    n_rep = h // kvh
+    attn_scale = 1.0 / np.sqrt(config.query_pre_attn_scalar or hd)
+    qg = (q * attn_scale).reshape(b, w, kvh, n_rep, hd)
+    scores = jnp.einsum("bqgrd,bkgd->bgrqk", qg, cache_k.astype(cdt)).astype(
+        jnp.float32
+    )
+    scores = _tanh_softcap(scores, config.attn_logit_softcap)  # pre-mask
+    k_pos = lax.broadcasted_iota(jnp.int32, scores.shape, 4)
+    q_idx = lax.broadcasted_iota(jnp.int32, scores.shape, 3)
+    pos_b = pos[:, None, None, None, None]
+    scores = jnp.where(k_pos <= pos_b + q_idx, scores, -1e6)
+    if config.sliding_window is not None:
+        in_window = (pos_b + q_idx) - k_pos < config.sliding_window
+        if sliding is not None:  # per-layer alternating flag (traced)
+            in_window = jnp.logical_or(jnp.logical_not(sliding), in_window)
+        scores = jnp.where(in_window, scores, -1e6)
+    weights = jax.nn.softmax(scores, axis=-1)
+    attn = jnp.einsum("bgrqk,bkgd->bqgrd", weights.astype(cdt), cache_v.astype(cdt))
+    attn = attn.reshape(b, w, h * hd) @ layer_params["attn"]["o_proj"]["kernel"].astype(cdt)
+    if config.post_block_norms:
+        attn = rms_norm(attn, layer_params["attn_out_norm"]["scale"],
+                        config.rms_norm_eps, config.rms_norm_offset)
+    x = residual + attn
+
+    residual = x
+    y = rms_norm(x, layer_params["post_attn_norm"]["scale"], config.rms_norm_eps, config.rms_norm_offset)
+    if config.num_experts > 1:
+        from ..ops.moe import moe_ffn
+
+        y, _aux = moe_ffn(
+            y,
+            layer_params["mlp"]["router"]["kernel"],
+            layer_params["mlp"]["experts"]["w_gate"],
+            layer_params["mlp"]["experts"]["w_up"],
+            layer_params["mlp"]["experts"]["w_down"],
+            num_selected=config.num_experts_per_tok,
+            capacity_factor=config.expert_capacity_factor,
+            compute_dtype=cdt,
+        )
+    else:
+        gate = y @ layer_params["mlp"]["gate_proj"]["kernel"].astype(cdt)
+        up = y @ layer_params["mlp"]["up_proj"]["kernel"].astype(cdt)
+        y = _mlp_act(config, gate) * up
+        y = y @ layer_params["mlp"]["down_proj"]["kernel"].astype(cdt)
+    if config.post_block_norms:
+        y = rms_norm(y, layer_params["mlp_out_norm"]["scale"],
+                     config.rms_norm_eps, config.rms_norm_offset)
+    return residual + y, win_k, win_v
+
+
 def repeat_kv_cache(c, n_rep):
     """Physically tile a (B, S, Hkv, D) cache n_rep× over the head dim.
 
@@ -1306,6 +1394,41 @@ def apply_rope_at(x, pos, theta, scaling=None):
     y1 = x1 * cos - x2 * sin
     y2 = x2 * cos + x1 * sin
     return jnp.stack([y1, y2], axis=-1).reshape(b, s, h, d).astype(x.dtype)
+
+
+def _write_kv_window(cache, kv, pos):
+    """Write a W-position window of K (or V) rows into a (B, S_cache, H, D)
+    cache at per-row start positions ``pos`` (B,). Unlike
+    :func:`_write_kv_at`'s ``dynamic_update_slice`` (which CLAMPS start
+    indices, silently shifting an overhanging write onto live columns),
+    this scatters each position independently and DROPS any that fall past
+    the cache length — required for verify windows whose padded tail can
+    legally overhang the arena."""
+    kv = kv.astype(cache.dtype)
+    w = kv.shape[1]
+
+    def one(c, n, p):
+        idx = p + jnp.arange(w, dtype=jnp.int32)
+        return c.at[idx].set(n, mode="drop")
+
+    return jax.vmap(one)(cache, kv, pos)
+
+
+def apply_rope_window(x, pos, theta, scaling=None):
+    """RoPE for a W-token verify window: ``x`` (B, W, H, D) where window
+    offset j sits at absolute position ``pos[b] + j`` — each (row, offset)
+    gets its own rotation angle, unlike :func:`apply_rope_at` which rotates
+    every s-position of a row identically."""
+    b, w, h, d = x.shape
+    freqs = jnp.asarray(_rope_freqs(d, theta, scaling), dtype=jnp.float32)
+    abs_pos = pos.astype(jnp.float32)[:, None] + jnp.arange(w, dtype=jnp.float32)[None, :]
+    angles = abs_pos[:, :, None] * freqs[None, None, :]  # (B, W, d/2)
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    y1 = x1 * cos - x2 * sin
+    y2 = x2 * cos + x1 * sin
+    return jnp.stack([y1, y2], axis=-1).reshape(b, w, h, d).astype(x.dtype)
 
 
 def _prefill_stack(config: LlamaConfig, params, input_ids):
@@ -1438,6 +1561,62 @@ def llama_decode_step(config: LlamaConfig, params, cache, token, pos, *,
         logits = x @ params["lm_head"]["kernel"].astype(cdt)
     logits = _tanh_softcap(logits, config.final_logit_softcap)
     return logits[:, 0].astype(jnp.float32), {"k": new_k, "v": new_v}
+
+
+def llama_verify_step(config: LlamaConfig, params, cache, tokens, pos, *,
+                      kv_layout=None):
+    """Speculative-verify forward: ``tokens`` (B, W) — each row's carried
+    token followed by W-1 draft tokens — at positions ``pos .. pos+W-1``
+    (``pos`` a traced (B,) vector). Returns (logits (B, W, V) f32,
+    window KV {"k","v"}: (L, B, W, kvh, hd)).
+
+    The cache is consumed READ-ONLY (scan xs, not donated-through): nothing
+    is committed here. The caller decides the accepted prefix from the
+    logits and commits exactly that many window columns via the backend's
+    ``commit_window`` — so a rejected draft suffix never touches the
+    persistent arena/pool and there is no rollback path. With
+    ``kv_layout`` the per-layer pool slice is gathered into the dense view
+    first (same as decode), and the window attends a temporary copy of
+    that view."""
+    cdt = config.compute_dtype
+    x = params["embed_tokens"]["embedding"].astype(cdt)[tokens]
+    if config.scale_embeddings:
+        x = x * jnp.asarray(config.hidden_size**0.5, dtype=cdt)
+
+    def layer_verify(x, layer_params, ck, cv, sliding=None):
+        if kv_layout is not None:
+            ck, cv = kv_layout.view(ck), kv_layout.view(cv)
+        return _verify_layer(config, layer_params, x, ck, cv, pos,
+                             sliding=sliding)
+
+    if config.alternating_sliding_window:
+        L = config.num_hidden_layers
+        flags = (jnp.arange(L) % 2) == 0  # even layers local (HF layer_types)
+
+        def body(carry, inputs):
+            x = carry
+            layer_params, ck, cv, sliding = inputs
+            x, wk, wv = layer_verify(x, layer_params, ck, cv, sliding=sliding)
+            return x, (wk, wv)
+
+        x, (win_k, win_v) = lax.scan(
+            body, x, (params["layers"], cache["k"], cache["v"], flags)
+        )
+    else:
+        def body(carry, inputs):
+            x = carry
+            layer_params, ck, cv = inputs
+            x, wk, wv = layer_verify(x, layer_params, ck, cv)
+            return x, (wk, wv)
+
+        x, (win_k, win_v) = lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
+    x = rms_norm(x, params["final_norm"]["scale"], config.rms_norm_eps, config.rms_norm_offset)
+    if config.tie_word_embeddings:
+        logits = x @ params["embed_tokens"]["embedding"].astype(cdt).T
+    else:
+        logits = x @ params["lm_head"]["kernel"].astype(cdt)
+    logits = _tanh_softcap(logits, config.final_logit_softcap)
+    return logits.astype(jnp.float32), {"k": win_k, "v": win_v}
 
 
 def create_llama(config: LlamaConfig, seed: int = 0, abstract: bool = False) -> Model:

@@ -27,6 +27,7 @@ __all__ = [
     "blockwise_attention",
     "dispatch_attention",
     "paged_attention",
+    "verify_attention",
     "repeat_kv",
     "tanh_softcap",
 ]
@@ -182,6 +183,61 @@ def paged_attention(
     k_pos = jnp.arange(sk, dtype=jnp.int32)
     live = k_pos[None, :] <= pos[:, None]  # (B, sk)
     scores = jnp.where(live[:, None, None, None, :], scores, NEG_INF)
+    weights = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bgrqk,bkgd->bqgrd", weights.astype(v.dtype), v)
+    return out.reshape(b, sq, h, d)
+
+
+def verify_attention(
+    q: jax.Array,
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    block_tables: jax.Array,
+    pos: jax.Array,
+    *,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
+    softmax_dtype=jnp.float32,
+) -> jax.Array:
+    """Masked multi-query speculative-verify attention over a paged KV
+    pool — the reference semantics (and kernel contract) for the engine's
+    ``verify_step``. Identical to :func:`paged_attention` except ``q`` is a
+    W-token window (B, W, h, d) whose query j sits at absolute position
+    ``pos[b] + j``: the length mask becomes the windowed causal
+    ``k_pos <= pos + j``, so query 0 reproduces the single-token decode
+    scores bitwise (per-(q, k) score elements are independent dot products)
+    and each draft token attends every earlier draft in the same window.
+
+    The window's own K/V must already be present in the pool positions it
+    attends (the model's verify layer scatter-writes them into a temporary
+    view first; a fused kernel would read them from registers). Per-slot
+    draft-length masking is NOT applied here — padded queries past a row's
+    real draft length produce garbage rows the caller discards; their
+    positions sit strictly after every valid query's causal horizon, so
+    they can never contaminate valid output."""
+    b, sq, h, d = q.shape
+    ctx = k_pool[block_tables]  # (B, bpr, bs, h_kv, d)
+
+    def flat(pool_rows, scale):
+        bpr, bs = pool_rows.shape[1], pool_rows.shape[2]
+        x = pool_rows.reshape(b, bpr * bs, *pool_rows.shape[3:])
+        if scale is not None:
+            s = scale[block_tables].reshape(b, bpr * bs)
+            x = x.astype(softmax_dtype) * s[:, :, None, None]
+        return x
+
+    k = flat(ctx, k_scale)
+    v = flat(v_pool[block_tables], v_scale)
+    sk = k.shape[1]
+    h_kv = k.shape[2]
+    n_rep = h // h_kv
+    scale = 1.0 / math.sqrt(d)
+    qg = q.reshape(b, sq, h_kv, n_rep, d)
+    scores = jnp.einsum("bqgrd,bkgd->bgrqk", qg, k).astype(softmax_dtype) * scale
+    k_pos = jnp.arange(sk, dtype=jnp.int32)
+    q_idx = jnp.arange(sq, dtype=jnp.int32)
+    live = k_pos[None, None, :] <= pos[:, None, None] + q_idx[None, :, None]
+    scores = jnp.where(live[:, None, None, :, :], scores, NEG_INF)
     weights = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bgrqk,bkgd->bqgrd", weights.astype(v.dtype), v)
     return out.reshape(b, sq, h, d)

@@ -179,6 +179,8 @@ class ServingMetrics:
             "kv_hbm_bytes": 0,
             "kv_utilization": 0.0,
             "prefix_hit_rate": 0.0,
+            "spec_acceptance_rate": 0.0,
+            "spec_tokens_per_step": 0.0,
         }
 
     def bump(self, name: str, by: int = 1) -> None:
@@ -330,6 +332,8 @@ class InferenceServer:
                     kv_cache=self.config.kv_cache,
                     block_size=self.config.engine_block_size,
                     pool_blocks=self.config.engine_pool_blocks,
+                    spec=self.config.speculative,
+                    spec_draft_len=self.config.spec_draft_len,
                     clock=clock,
                 )
         self._lock = threading.Lock()
@@ -698,6 +702,9 @@ class InferenceServer:
             # slots) so they don't pin device arrays
             self._reply_retired(eng.poll(force=True), 0.0)
             return
+        with self._wake:
+            depth = len(self._queue)
+        self._apply_spec_degradation(self._degrade_level(depth))
         try:
             t0 = self._clock()
             eng.step()
@@ -794,16 +801,26 @@ class InferenceServer:
 
     def _sync_kv_gauges(self) -> None:
         """Publish the engine's KV-cache health (pool HBM footprint, live-vs-
-        reserved token utilization, prefix-cache hit rate) as serving gauges."""
-        kv = self._engine.stats().get("kv")
-        if not kv:
-            return
-        self.metrics.gauge("kv_hbm_bytes", kv.get("hbm_bytes", 0))
-        self.metrics.gauge("kv_utilization", kv.get("utilization", 0.0))
-        hits = kv.get("prefix_hits", 0)
-        misses = kv.get("prefix_misses", 0)
-        if hits + misses:
-            self.metrics.gauge("prefix_hit_rate", hits / (hits + misses))
+        reserved token utilization, prefix-cache hit rate) and speculative-
+        decoding acceptance (acceptance rate, emitted tokens per verify
+        step) as serving gauges, refreshed every tick."""
+        stats = self._engine.stats()
+        kv = stats.get("kv")
+        if kv:
+            self.metrics.gauge("kv_hbm_bytes", kv.get("hbm_bytes", 0))
+            self.metrics.gauge("kv_utilization", kv.get("utilization", 0.0))
+            hits = kv.get("prefix_hits", 0)
+            misses = kv.get("prefix_misses", 0)
+            if hits + misses:
+                self.metrics.gauge("prefix_hit_rate", hits / (hits + misses))
+        spec = stats.get("spec")
+        if spec and spec.get("mode") != "off":
+            self.metrics.gauge(
+                "spec_acceptance_rate", spec.get("acceptance_rate", 0.0)
+            )
+            self.metrics.gauge(
+                "spec_tokens_per_step", spec.get("tokens_per_step", 0.0)
+            )
 
     def _engine_failure(self, exc: BaseException, also_fail=None) -> None:
         """An engine program failed. Device state is donated across programs
@@ -843,6 +860,24 @@ class InferenceServer:
         if frac >= self.config.degrade_queue_fraction:
             return 1
         return 0
+
+    def _apply_spec_degradation(self, level: int) -> None:
+        """First rung of the continuous degradation ladder: under queue
+        pressure, shrink the speculative draft limit before touching anyone's
+        token budget (level 1 halves it, level 2 disables drafting). Wasted
+        draft compute is the cheapest thing to shed, and the clamp is free —
+        the verify program stays padded to the configured draft length, so
+        no recompile. Restores the full limit once pressure subsides."""
+        eng = self._engine
+        if eng is None or getattr(eng, "spec", None) is None:
+            return
+        full = self.config.spec_draft_len
+        if level >= 2:
+            eng.set_spec_draft_limit(0)
+        elif level == 1:
+            eng.set_spec_draft_limit(max(1, full // 2))
+        else:
+            eng.set_spec_draft_limit(full)
 
     def _clamp_budget(self, req: _Request, level: int) -> None:
         budget = req.max_new_tokens

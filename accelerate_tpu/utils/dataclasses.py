@@ -410,6 +410,19 @@ class ServingConfig(KwargsHandler):
     dense; set it SMALLER to oversubscribe slots at fixed HBM). In static
     mode ``kv_cache`` selects :func:`~accelerate_tpu.inference.generate`'s
     ``kv_backend`` so both paths share one KV story.
+
+    Speculative decoding (docs/serving.md "Speculative decoding"):
+    ``speculative`` — ``None`` (off, default) or ``"ngram"``: continuous
+    mode drafts up to ``spec_draft_len`` tokens per live slot from a
+    host-side prompt-lookup n-gram match over the slot's own history (no
+    second model) and verifies the whole window in ONE fused
+    ``verify_step`` program, committing only the accepted prefix's KV.
+    Greedy outputs are bitwise identical to plain decode; sampled outputs
+    keep the engine's seeded-reproducibility contract. The worker drops
+    the draft limit under queue pressure (cheapest rung of the
+    degradation ladder) and restores it when pressure subsides; the
+    engine itself falls back to plain ``decode_step`` for slots whose
+    acceptance EWMA collapses. Requires ``mode="continuous"``.
     """
 
     mode: str = "static"
@@ -420,6 +433,8 @@ class ServingConfig(KwargsHandler):
     kv_cache: str = "dense"
     engine_block_size: int = 16
     engine_pool_blocks: Optional[int] = None
+    speculative: Optional[str] = None
+    spec_draft_len: int = 4
     max_queue: int = 256
     max_batch_size: int = 8
     batch_window_s: float = 0.002
@@ -485,6 +500,20 @@ class ServingConfig(KwargsHandler):
                 "engine_pool_blocks must be None (full provisioning) or >= 2 "
                 f"(1 block is the reserved null block), got "
                 f"{self.engine_pool_blocks}"
+            )
+        if self.speculative not in (None, "ngram"):
+            raise ValueError(
+                f"speculative must be None or 'ngram', got {self.speculative!r}"
+            )
+        if self.speculative is not None and self.mode != "continuous":
+            raise ValueError(
+                "speculative decoding requires mode='continuous' (the static "
+                "path has no slot engine to verify drafts in)"
+            )
+        if self.speculative is not None and self.spec_draft_len < 1:
+            raise ValueError(
+                f"spec_draft_len must be >= 1 when speculative is enabled, "
+                f"got {self.spec_draft_len}"
             )
         if self.max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {self.max_queue}")

@@ -640,6 +640,15 @@ if __name__ == "__main__":
         from benchmarks.continuous_bench import kv_main
 
         sys.exit(kv_main(gate=True))
+    if "--spec-gate" in sys.argv:
+        # speculative-decoding gate: >= 1.5x tokens/s on the repetitive-
+        # suffix workload, bitwise parity + within-noise throughput on the
+        # adversarial workload, <= 3 compiled engine programs, and dense-
+        # vs-paged spec outputs bitwise identical (docs/serving.md)
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from benchmarks.continuous_bench import spec_main
+
+        sys.exit(spec_main(gate=True))
     if "--continuous-gate" in sys.argv:
         # continuous-batching gate: mixed-length/mixed-budget workload must
         # reach >= 1.3x static-mode goodput with TTFT p99 no worse, <= 2
