@@ -9,10 +9,20 @@
 PY := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu python
 PY_SLOW := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu RUN_SLOW=1 python
 
-.PHONY: test test_all test_core test_data test_parallel test_models test_cli test_big_modeling test-fault test-serving bench bench-telemetry bench-serving bench-continuous bench-recovery bench-kv bench-spec
+.PHONY: test test_all test_core test_data test_parallel test_models test_cli test_big_modeling test-fault test-serving check-static bench bench-telemetry bench-serving bench-continuous bench-recovery bench-kv bench-spec
 
-test:
+test: check-static
 	$(PY) -m pytest tests/ -q
+
+# graftcheck: static invariant analysis (docs/static_analysis.md).
+# Level 1 AOT-lowers the registered hot programs (fused train step, engine
+# prefill/decode/verify per backend) and checks callbacks, donation
+# aliasing, weak types, and program/collective budgets against
+# runs/static_baseline.json; Level 2 is the host AST lint (G101-G105).
+# Exit 0 = clean. Re-baseline deliberate program changes with:
+#   $(PY) -m accelerate_tpu.analysis --update-baseline
+check-static:
+	$(PY) -m accelerate_tpu.analysis
 
 # durable-checkpointing suite (docs/fault_tolerance.md): atomic commit,
 # kill-mid-save rollback via ACCELERATE_TPU_FAULT_INJECT, preemption,

@@ -1589,8 +1589,8 @@ class Accelerator:
         return pad_across_processes(tensor, dim=dim, pad_index=pad_index, pad_first=pad_first)
 
     # -------------------------------------------------------- process control
-    def wait_for_everyone(self):
-        self.state.wait_for_everyone()
+    def wait_for_everyone(self, tag: str = "accelerate_tpu.Accelerator.wait_for_everyone"):
+        self.state.wait_for_everyone(tag)
 
     def split_between_processes(self, inputs, apply_padding: bool = False):
         return self.state.split_between_processes(inputs, apply_padding=apply_padding)

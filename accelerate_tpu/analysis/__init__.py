@@ -1,0 +1,74 @@
+"""graftcheck — static invariant analysis for jitted programs and host code.
+
+Seven PRs of this repo accumulated hard invariants that were only enforced
+by runtime tests which must *hit* the violating path: ≤2/≤3 jitted programs
+per engine config, one host transfer per train step, donated-vs-carried
+arena discipline, the typed error taxonomy, barriers-with-timeout. This
+package checks them at the **program** level (AOT-lowered jaxpr/StableHLO
+inspection, no TPU needed) and the **host** level (an AST lint with
+repo-specific rules), in the spirit of veScale's static SPMD-consistency
+verification (arxiv 2509.07003).
+
+Run it as ``python -m accelerate_tpu.analysis`` (or ``make check-static``).
+
+Rules
+-----
+Level 1 — program analysis (``analysis/program.py``):
+
+* **G001** host-callback / transfer primitive inside a jitted hot program
+* **G002** donation correctness: every donated invar aliased to an output,
+  and nothing outside the donated arguments aliased (a donated carried
+  array would corrupt the deferred-readback ring)
+* **G003** weak-typed (python-scalar) operands that fragment the jit cache
+* **G004** program-count / collective-inventory drift against the committed
+  baseline (``runs/static_baseline.json``)
+
+Level 2 — host lint (``analysis/host.py``):
+
+* **G101** blocking readback on device values in a hot-path module without
+  a ``# graft: sync-ok`` waiver
+* **G102** coordination wait without a timeout route (bare ``.wait()`` /
+  ``.join()``) or anonymous ``wait_for_everyone()`` barrier
+* **G103** bare ``RuntimeError``/``Exception`` raise where the
+  ``utils/fault.py`` taxonomy has a precise type
+* **G104** tracker/metrics I/O while holding the server lock
+* **G105** fault-injection point referenced by tests/docs but absent from
+  the code's ``fault_point`` registry
+
+Waivers are line-scoped comments, same line or the line above:
+``# graft: sync-ok`` (G101), ``# graft: wait-ok`` (G102),
+``# graft: raise-ok`` (G103), ``# graft: lock-ok`` (G104),
+``# graft: fault-ok`` (G105), or the universal ``# graft: GXXX-ok``.
+See ``docs/static_analysis.md`` for the full table and re-baselining.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+RULES = {
+    "G001": "host-callback/transfer primitive inside a jitted program",
+    "G002": "donation aliasing broken or a non-donated operand aliased",
+    "G003": "weak-typed operand fragments the jit cache",
+    "G004": "program-count/collective inventory drifted from baseline",
+    "G101": "blocking readback in a hot-path module without a waiver",
+    "G102": "coordination wait without a timeout route / anonymous barrier",
+    "G103": "untyped raise where a fault-taxonomy type exists",
+    "G104": "tracker/metrics call while holding the server lock",
+    "G105": "referenced fault-injection point missing from the registry",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    code: str  # rule id, e.g. "G101"
+    path: str  # repo-relative file, or a program name for Level 1
+    line: int  # 1-based; 0 when the finding is not line-addressable
+    message: str
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}" if self.line else self.path
+        return f"{loc}: {self.code} {self.message}"
+
+
+__all__ = ["Finding", "RULES"]

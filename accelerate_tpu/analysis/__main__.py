@@ -1,0 +1,108 @@
+"""graftcheck CLI: ``python -m accelerate_tpu.analysis`` (make check-static).
+
+Exit 0 when the tree is clean, 1 when any finding survives. Level `host`
+is pure-AST and fast; level `program` traces and lowers the real hot
+programs, so the environment is pinned to the CPU backend with 8 virtual
+devices BEFORE jax loads (the dp=8 train step needs a mesh, and CI boxes
+have no accelerator).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+
+def _pin_cpu_backend() -> None:
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    from . import RULES, Finding
+
+    parser = argparse.ArgumentParser(
+        prog="python -m accelerate_tpu.analysis",
+        description="graftcheck: static invariant analysis for jitted "
+        "programs (G001-G004) and host hot paths (G101-G105).",
+    )
+    parser.add_argument(
+        "--level", choices=("host", "program", "all"), default="all",
+        help="host = AST lint only (fast); program = lower and inspect the "
+        "jitted programs; all = both (default)",
+    )
+    parser.add_argument(
+        "--root", default=".", help="repo root to lint (default: cwd)"
+    )
+    parser.add_argument(
+        "--baseline", default=None,
+        help="program-budget baseline path (default: runs/static_baseline.json "
+        "under --root)",
+    )
+    parser.add_argument(
+        "--update-baseline", action="store_true",
+        help="rewrite the baseline from the current tree instead of "
+        "comparing against it",
+    )
+    parser.add_argument(
+        "--no-collectives", action="store_true",
+        help="skip the SPMD compile for the collective inventory (faster)",
+    )
+    parser.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="emit findings as a JSON array instead of file:line lines",
+    )
+    args = parser.parse_args(argv)
+
+    root = os.path.abspath(args.root)
+    baseline = args.baseline or os.path.join(root, "runs", "static_baseline.json")
+    findings: List[Finding] = []
+
+    if args.level in ("host", "all"):
+        from .host import lint_package
+
+        findings.extend(lint_package(root))
+
+    if args.level in ("program", "all"):
+        _pin_cpu_backend()
+        from .program import run_program_checks
+
+        findings.extend(run_program_checks(
+            baseline_path=baseline,
+            update_baseline=args.update_baseline,
+            with_collectives=not args.no_collectives,
+        ))
+
+    if args.as_json:
+        print(json.dumps(
+            [dataclasses_asdict(f) for f in findings], indent=2, sort_keys=True
+        ))
+    else:
+        for f in findings:
+            print(f.render())
+        if findings:
+            codes = sorted({f.code for f in findings})
+            print(f"graftcheck: {len(findings)} finding(s) "
+                  f"[{', '.join(codes)}] — see docs/static_analysis.md")
+            for code in codes:
+                print(f"  {code}: {RULES.get(code, '?')}")
+        else:
+            print("graftcheck: clean")
+    return 1 if findings else 0
+
+
+def dataclasses_asdict(f):
+    import dataclasses
+
+    return dataclasses.asdict(f)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

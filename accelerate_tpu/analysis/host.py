@@ -1,0 +1,400 @@
+"""graftcheck Level 2: AST lint over the host-side code (rules G101–G105).
+
+Pure-stdlib (ast + re) — no jax import, so ``--level host`` runs in well
+under a second. Rules are repo-specific by design; each one encodes an
+invariant a past PR or review cycle established:
+
+* G101 — engine/serving hot loops must not block on device values
+  (PR 2/PR 4 pipelining). Deliberate sync points carry ``# graft: sync-ok``.
+* G102 — every coordination wait needs a timeout route, and every
+  ``wait_for_everyone`` barrier a site tag, so a dead peer produces a
+  nameable ``BarrierTimeoutError`` instead of a silent hang (PR 1/PR 5).
+* G103 — raise the ``utils/fault.py`` taxonomy, not bare RuntimeError, in
+  modules that have one (clients dispatch on ``retriable``; PR 1/PR 3).
+* G104 — no tracker/metrics I/O while holding the server lock (the PR 4
+  review's lock-held-flush stall).
+* G105 — a fault-injection point referenced by tests/docs must exist in
+  code, or the test silently stops testing anything (PR 1 harness).
+
+Waivers are line-scoped comments on the finding line or the line above:
+the per-rule token (``sync-ok``, ``wait-ok``, ``raise-ok``, ``lock-ok``,
+``fault-ok``) or the universal ``gXXX-ok`` form, e.g. ``# graft: g101-ok``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Iterable, List, Optional, Set
+
+from . import Finding
+
+# ------------------------------------------------------------ rule scoping
+# Modules whose loops sit on the decode/serving critical path: one stray
+# blocking readback stalls the whole pipelining scheme.
+HOT_MODULES = {"engine.py", "serving.py"}
+# Modules where the fault taxonomy applies (they import/raise it already).
+TYPED_RAISE_MODULES = {
+    "engine.py", "serving.py", "kvcache.py", "telemetry.py", "elastic.py",
+    "checkpointing.py",
+}
+
+# Device-value taint seeds: engine/serving state that holds jax Arrays.
+_SEED_ATTRS = {"_donated", "_carried", "_ring"}
+# Calls whose results are device values (jitted dispatches, generate).
+_DEVICE_CALL_RE = re.compile(r"(_jit|_generate_fn)$")
+# Lock attributes guarding the serving dispatch/admission path.
+_LOCK_ATTR_RE = re.compile(r"^(_lock|_wake|_mu)\w*$|^lock$")
+# Tracker/metrics I/O entry points that must never run under those locks.
+_TRACKER_SINKS = {"_flush_metrics", "_emit_snapshot", "log_batch"}
+
+_WAIVER_RE = re.compile(r"#\s*graft:\s*([\w ,-]+)")
+_RULE_TOKENS = {
+    "G101": "sync-ok",
+    "G102": "wait-ok",
+    "G103": "raise-ok",
+    "G104": "lock-ok",
+    "G105": "fault-ok",
+}
+
+FAULT_ENV = "ACCELERATE_TPU_FAULT_INJECT"
+
+
+# --------------------------------------------------------------- waivers
+def parse_waivers(text: str) -> dict:
+    """line number -> set of waiver tokens on that line."""
+    out: dict = {}
+    for i, line in enumerate(text.splitlines(), start=1):
+        m = _WAIVER_RE.search(line)
+        if m:
+            out[i] = {tok.strip().lower() for tok in m.group(1).split(",")}
+    return out
+
+
+def _waived(code: str, line: int, waivers: dict) -> bool:
+    allowed = {_RULE_TOKENS[code], f"{code.lower()}-ok"}
+    for ln in (line, line - 1):
+        if waivers.get(ln, set()) & allowed:
+            return True
+    return False
+
+
+# ---------------------------------------------------------- ast utilities
+def _attr_chain(node: ast.AST) -> List[str]:
+    """x.y.z -> ["x", "y", "z"]; non-name roots contribute nothing."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return list(reversed(parts))
+
+
+def _is_np_call(func: ast.AST, name: str) -> bool:
+    return (
+        isinstance(func, ast.Attribute)
+        and func.attr == name
+        and isinstance(func.value, ast.Name)
+        and func.value.id in ("np", "numpy", "onp")
+    )
+
+
+def _is_jax_device_get(func: ast.AST) -> bool:
+    return isinstance(func, ast.Attribute) and func.attr == "device_get"
+
+
+def _assigned_names(target: ast.AST) -> Iterable[str]:
+    for node in ast.walk(target):
+        if isinstance(node, ast.Name):
+            yield node.id
+
+
+# ------------------------------------------------------------------- G101
+class _TaintLint:
+    """Per-function forward taint pass: names assigned from device-valued
+    expressions (jit dispatch results, the arena/ring state) are tainted;
+    a materializing call (np.asarray / device_get) both *fires the rule*
+    and launders its result back to host data, so downstream host math on
+    the materialized copy stays quiet."""
+
+    def __init__(self, relpath: str, waivers: dict, findings: list):
+        self.relpath = relpath
+        self.waivers = waivers
+        self.findings = findings
+        self.tainted: Set[str] = set()
+
+    # -- taint classification
+    def _expr_taints(self, node: Optional[ast.AST]) -> bool:
+        """Does evaluating this expression yield (or contain) device data?"""
+        if node is None:
+            return False
+        for sub in ast.walk(node):
+            if self._direct_seed(sub):
+                return True
+            if isinstance(sub, ast.Name) and sub.id in self.tainted:
+                return True
+        return False
+
+    def _direct_seed(self, sub: ast.AST) -> bool:
+        if isinstance(sub, ast.Attribute) and sub.attr in _SEED_ATTRS:
+            return True
+        if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Attribute):
+            if _DEVICE_CALL_RE.search(sub.func.attr):
+                return True
+        return False
+
+    def _is_materializer(self, node: ast.AST) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        return (
+            _is_np_call(node.func, "asarray")
+            or _is_np_call(node.func, "array")
+            or _is_jax_device_get(node.func)
+        )
+
+    # -- sinks
+    def _check_call(self, node: ast.Call) -> None:
+        func = node.func
+        line = node.lineno
+        args_taint = any(self._expr_taints(a) for a in node.args)
+        direct = any(
+            any(self._direct_seed(s) for s in ast.walk(a)) for a in node.args
+        )
+        if isinstance(func, ast.Attribute) and func.attr == "block_until_ready":
+            self._emit(line, "block_until_ready() stalls the dispatch pipeline")
+        elif _is_jax_device_get(func):
+            self._emit(line, "jax.device_get() is a blocking device readback")
+        elif (_is_np_call(func, "asarray") or _is_np_call(func, "array")) and args_taint:
+            self._emit(line, "np.asarray on a device value blocks until the "
+                             "program completes")
+        elif isinstance(func, ast.Attribute) and func.attr == "item" and (
+            self._expr_taints(func.value)
+        ):
+            self._emit(line, ".item() on a device value is a blocking readback")
+        elif isinstance(func, ast.Name) and func.id in ("float", "int", "bool") and direct:
+            self._emit(line, f"{func.id}() on a device value is a blocking readback")
+
+    def _emit(self, line: int, msg: str) -> None:
+        if not _waived("G101", line, self.waivers):
+            self.findings.append(Finding("G101", self.relpath, line, msg))
+
+    # -- forward walk
+    def run(self, fn: ast.AST) -> None:
+        for stmt in getattr(fn, "body", []):
+            self._stmt(stmt)
+
+    def _stmt(self, stmt: ast.stmt) -> None:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call):
+                self._check_call(node)
+        # propagate AFTER checking, in statement order
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = getattr(stmt, "value", None)
+            targets = stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+            taint = self._expr_taints(value) and not self._is_materializer(value)
+            for tgt in targets:
+                for name in _assigned_names(tgt):
+                    if taint:
+                        self.tainted.add(name)
+                    else:
+                        self.tainted.discard(name)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            if self._expr_taints(stmt.iter):
+                self.tainted.update(_assigned_names(stmt.target))
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                if item.optional_vars is not None and self._expr_taints(item.context_expr):
+                    self.tainted.update(_assigned_names(item.optional_vars))
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.stmt):
+                self._stmt(child)
+
+
+# --------------------------------------------------------------- the lint
+def lint_source(text: str, relpath: str) -> List[Finding]:
+    """Lint one python source (rules G101–G104). ``relpath`` decides which
+    module-scoped rules apply; G105 is cross-file and lives in
+    :func:`check_fault_registry`."""
+    try:
+        tree = ast.parse(text)
+    except SyntaxError as exc:
+        return [Finding("G000", relpath, exc.lineno or 0,
+                        f"unparseable: {exc.msg}")]
+    waivers = parse_waivers(text)
+    base = os.path.basename(relpath)
+    findings: List[Finding] = []
+
+    # G101 — per-function taint pass, hot modules only
+    if base in HOT_MODULES:
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                _TaintLint(relpath, waivers, findings).run(node)
+
+    # G102 — unbounded waits + anonymous barriers, package-wide
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        line = node.lineno
+        func = node.func
+        bare = not node.args and not node.keywords
+        if isinstance(func, ast.Attribute) and func.attr in ("wait", "join") and bare:
+            # ".".join(...) always has args, so a bare join is a thread/queue
+            # join; a bare wait is a Condition/Event/process wait
+            if not _waived("G102", line, waivers):
+                findings.append(Finding(
+                    "G102", relpath, line,
+                    f"bare .{func.attr}() can block forever — pass a timeout "
+                    "or waive with '# graft: wait-ok'",
+                ))
+        name = func.attr if isinstance(func, ast.Attribute) else (
+            func.id if isinstance(func, ast.Name) else None
+        )
+        if name == "wait_for_everyone" and bare:
+            if not _waived("G102", line, waivers):
+                findings.append(Finding(
+                    "G102", relpath, line,
+                    "anonymous barrier: pass a site tag so a stuck peer "
+                    "raises a nameable BarrierTimeoutError",
+                ))
+
+    # G103 — untyped raises where the taxonomy applies
+    if base in TYPED_RAISE_MODULES:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Raise) or node.exc is None:
+                continue
+            exc = node.exc
+            exc_name = None
+            if isinstance(exc, ast.Call) and isinstance(exc.func, ast.Name):
+                exc_name = exc.func.id
+            elif isinstance(exc, ast.Name):
+                exc_name = exc.id
+            if exc_name in ("RuntimeError", "Exception"):
+                if not _waived("G103", node.lineno, waivers):
+                    findings.append(Finding(
+                        "G103", relpath, node.lineno,
+                        f"bare {exc_name}: use (or add) a utils/fault.py "
+                        "taxonomy type so callers can dispatch on it",
+                    ))
+
+    # G104 — tracker I/O under the server lock
+    _lint_lock_held(tree, relpath, waivers, findings)
+
+    return _dedupe(findings)
+
+
+def _lint_lock_held(tree, relpath, waivers, findings) -> None:
+    def visit(node: ast.AST, held: bool) -> None:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                ctx = item.context_expr
+                if isinstance(ctx, ast.Attribute) and _LOCK_ATTR_RE.match(ctx.attr):
+                    held = True
+        if held and isinstance(node, ast.Call):
+            chain = _attr_chain(node.func)
+            sink = (chain and chain[-1] in _TRACKER_SINKS) or any(
+                part in ("tracker", "trackers") for part in chain[:-1]
+            )
+            if sink and not _waived("G104", node.lineno, waivers):
+                findings.append(Finding(
+                    "G104", relpath, node.lineno,
+                    f"{'.'.join(chain)}() performs tracker/metrics I/O while "
+                    "holding the server lock (stalls every submitter)",
+                ))
+        for child in ast.iter_child_nodes(node):
+            # a nested function body does not inherit the caller's lock
+            child_held = held and not isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef)
+            )
+            visit(child, child_held)
+
+    visit(tree, False)
+
+
+# ------------------------------------------------------------------- G105
+_FAULT_POINT_RE = re.compile(r"fault_point\(\s*[\"']([^\"']+)[\"']")
+_FAULT_REF_RES = [
+    re.compile(r"fault_inject\(\s*[\"']([^\"']+)[\"']"),
+    re.compile(r"setenv\(\s*[\"']" + FAULT_ENV + r"[\"']\s*,\s*[\"']([^\"']+)[\"']"),
+    re.compile(r"environ\[[\"']" + FAULT_ENV + r"[\"']\]\s*=\s*[\"']([^\"']+)[\"']"),
+    re.compile(FAULT_ENV + r"=([\w:,.\[\]\-]+)"),
+]
+
+
+def _spec_points(spec: str) -> Iterable[str]:
+    for item in spec.split(","):
+        if "[" in item or "]" in item:
+            continue  # grammar placeholder (docs: "point[:action]")
+        point = item.strip().partition(":")[0]
+        if point:
+            yield point
+
+
+def check_fault_registry(repo_root: str) -> List[Finding]:
+    """G105: every fault point referenced by tests/ or docs/ must exist as a
+    ``fault_point("...")`` call in the package — otherwise the referencing
+    test arms a point that can never fire and silently tests nothing."""
+    defined: Set[str] = set()
+    for path in _walk_py(os.path.join(repo_root, "accelerate_tpu")):
+        with open(path, encoding="utf-8") as f:
+            defined.update(_FAULT_POINT_RE.findall(f.read()))
+
+    findings: List[Finding] = []
+    ref_files = list(_walk_py(os.path.join(repo_root, "tests")))
+    ref_files += _walk_suffix(os.path.join(repo_root, "docs"), ".md")
+    for path in ref_files:
+        rel = os.path.relpath(path, repo_root)
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        waivers = parse_waivers(text)
+        for i, line in enumerate(text.splitlines(), start=1):
+            for ref_re in _FAULT_REF_RES:
+                for m in ref_re.finditer(line):
+                    for point in _spec_points(m.group(1)):
+                        if point in defined:
+                            continue
+                        if _waived("G105", i, waivers):
+                            continue
+                        findings.append(Finding(
+                            "G105", rel, i,
+                            f"fault point {point!r} is referenced here but "
+                            "no fault_point() call defines it",
+                        ))
+    return _dedupe(findings)
+
+
+# ------------------------------------------------------------ entry points
+def _walk_py(root: str) -> Iterable[str]:
+    yield from _walk_suffix(root, ".py")
+
+
+def _walk_suffix(root: str, suffix: str) -> List[str]:
+    out: List[str] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if fn.endswith(suffix):
+                out.append(os.path.join(dirpath, fn))
+    return out
+
+
+def _dedupe(findings: List[Finding]) -> List[Finding]:
+    seen, out = set(), []
+    for f in findings:
+        key = (f.code, f.path, f.line, f.message)
+        if key not in seen:
+            seen.add(key)
+            out.append(f)
+    return out
+
+
+def lint_package(repo_root: str) -> List[Finding]:
+    """Run G101–G105 over the whole package tree."""
+    findings: List[Finding] = []
+    for path in _walk_py(os.path.join(repo_root, "accelerate_tpu")):
+        rel = os.path.relpath(path, repo_root)
+        with open(path, encoding="utf-8") as f:
+            findings.extend(lint_source(f.read(), rel))
+    findings.extend(check_fault_registry(repo_root))
+    return _dedupe(findings)

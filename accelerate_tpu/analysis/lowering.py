@@ -1,0 +1,334 @@
+"""Shared AOT-lowering and module-inspection helpers.
+
+One code path for two consumers (ISSUE 8 satellite: the bench and the
+checker must not fork):
+
+* ``benchmarks/hlo_report.py`` — the compile-time perf report — imports
+  :func:`parse_collectives` / :func:`ici_bytes_per_chip` /
+  :func:`compile_and_extract_spmd` from here;
+* ``accelerate_tpu.analysis.program`` — graftcheck Level 1 — uses the same
+  helpers to extract the collective inventory for the program-budget
+  baseline, plus the jaxpr/StableHLO inspection primitives below
+  (:func:`collect_primitives`, :func:`aliased_input_indices`,
+  :func:`weak_typed_inputs`).
+
+Everything heavy (jax) is imported lazily inside functions so the host-lint
+level of graftcheck never pays for it.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+# ------------------------------------------------------------- HLO parsing
+# "= <shape or tuple shape> all-reduce(...)"; grad reductions commonly fuse a
+# whole layer's grads into ONE tuple-shaped all-reduce, so the shape part can
+# contain spaces and nested brackets. "-done" halves of async pairs are
+# intentionally not matched (counting them would double the -start).
+_COLL_RE = re.compile(
+    r"=\s+(?P<shape>\(?[^=]*?)\s*(?P<op>all-gather|reduce-scatter|all-reduce|"
+    r"collective-permute)(?:-start)?\(",
+)
+
+_DTYPE_BYTES = {"f32": 4, "bf16": 2, "f16": 2, "s32": 4, "u32": 4, "pred": 1,
+                "f64": 8, "s8": 1, "u8": 1, "s64": 8, "u64": 8}
+
+
+def _shape_bytes(shape: str) -> tuple[int, str]:
+    """Sum bytes over every 'dtype[dims]' in the (possibly tuple) shape."""
+    total = 0
+    dtypes = []
+    for m in re.finditer(r"([a-z]+[0-9]*)\[([\d,]*)\]", shape):
+        dtype, dims = m.group(1), m.group(2)
+        n = 1
+        if dims:
+            for d in dims.split(","):
+                n *= int(d)
+        total += n * _DTYPE_BYTES.get(dtype, 4)
+        dtypes.append(dtype)
+    if not dtypes:
+        return 0, "?"
+    dtype = dtypes[0] if len(set(dtypes)) == 1 else "+".join(sorted(set(dtypes)))
+    return total, dtype
+
+
+def _group_size(line: str, n_devices: int) -> int:
+    m = re.search(r"replica_groups=\{\{([\d,]+)\}", line)
+    if m:
+        return len(m.group(1).split(","))
+    m = re.search(r"replica_groups=\[(\d+),(\d+)\]", line)
+    if m:  # iota v2 form [ngroups,group_size]
+        return int(m.group(2))
+    return n_devices
+
+
+def parse_collectives(hlo: str, n_devices: int):
+    """Per-computation collective inventory with while-loop trip counts.
+
+    Splits the module into computations, walks the entry computation, and
+    multiplies ops inside while bodies by the loop trip count (parsed from
+    the condition's compare-against-constant; layer scans and grad-accum
+    loops all lower this way). Unparseable trip counts fall back to 1 with
+    a note — counts are then LOWER bounds."""
+    # Computation definitions start at column 0; instructions are indented.
+    # Older XLA text prints "%name (params) -> ... {", newer emitters drop
+    # the parameter list (and the % sigils) and print just "name {" — accept
+    # both by matching only the leading name up to a paren OR the brace.
+    comps: dict[str, list[str]] = {}
+    entry = None
+    name = None
+    for raw in hlo.splitlines():
+        header = re.match(r"^(ENTRY\s+)?%?([\w.\-]+)\s*[({]", raw)
+        if header and raw.rstrip().endswith("{"):
+            name = header.group(2)
+            comps[name] = []
+            if header.group(1):
+                entry = name
+        elif name is not None:
+            comps[name].append(raw)
+    if entry is None:  # single-computation module
+        entry = next(iter(comps), None)
+
+    def trip_count(line: str, cond_name):
+        # Post-optimization modules stamp the statically-known trip count on
+        # the while op itself
+        m = re.search(r'"known_trip_count":\{"n":"(\d+)"\}', line)
+        if m:
+            return int(m.group(1))
+        # Post-SPMD modules don't: read the condition's compare-against-
+        # constant bound (induction always starts at 0 with step 1 for
+        # lax.scan lowerings)
+        body = comps.get(cond_name or "", [])
+        consts = {}
+        for cline in body:
+            cm = re.match(
+                r"\s*%?([\w.\-]+)\s*=\s*s32\[\]\s*constant\((\d+)\)", cline
+            )
+            if cm:
+                consts[cm.group(1)] = int(cm.group(2))
+        for cline in body:
+            cm = re.search(r"compare\(%?([\w.\-]+),\s*%?([\w.\-]+)\)", cline)
+            if cm:
+                for operand in (cm.group(1), cm.group(2)):
+                    if operand in consts:
+                        return consts[operand]
+        if len(consts) == 1:
+            return next(iter(consts.values()))
+        return None
+
+    notes = []
+    totals: dict[tuple[str, str, int], dict] = {}
+
+    def reduce_scatter_like(comp: str, result_name: str) -> bool:
+        """An all-reduce whose every consumer is a (dynamic-)slice IS a
+        reduce-scatter the backend decomposed (XLA:CPU) or the
+        ReduceScatterCreator pass will re-fuse (TPU pipeline) — count it at
+        reduce-scatter cost."""
+        uses = [
+            l for l in comps.get(comp, [])
+            if result_name + ")" in l or result_name + "," in l
+            or l.rstrip().endswith(result_name)
+        ]
+        uses = [l for l in uses if f"= " in l and result_name not in l.split("=")[0]]
+        return bool(uses) and all(
+            re.search(r"dynamic-slice|slice\(", l) for l in uses
+        )
+
+    def walk(comp: str, multiplier: int, seen: tuple):
+        if comp in seen or comp not in comps:
+            return
+        for line in comps[comp]:
+            wm = re.search(r"while\(", line)
+            if wm:
+                targets = dict(
+                    re.findall(r"(body|condition)=%?([\w.\-]+)", line)
+                )
+                body = targets.get("body")
+                cond = targets.get("condition")
+                tc = trip_count(line, cond)
+                if tc is None:
+                    tc = 1
+                    notes.append(
+                        f"while body {body!r}: trip count unparseable, counted once"
+                    )
+                if body:
+                    walk(body, multiplier * tc, seen + (comp,))
+                continue
+            # tuple shapes embed /*index=N*/ comments whose '=' breaks the
+            # shape capture — strip comments before matching
+            cm = _COLL_RE.search(re.sub(r"/\*.*?\*/", "", line))
+            if cm:
+                nbytes, dtype = _shape_bytes(cm.group("shape"))
+                g = _group_size(line, n_devices)
+                op = cm.group("op")
+                if op == "all-reduce":
+                    nm = re.match(r"\s*(%?[\w.\-]+)\s*=", line)
+                    if nm and reduce_scatter_like(comp, nm.group(1)):
+                        op = "all-reduce[rs-pattern]"
+                key = (op, dtype, nbytes)
+                rec = totals.setdefault(
+                    key, dict(op=op, dtype=dtype, bytes=nbytes,
+                              group=g, count=0),
+                )
+                rec["count"] += multiplier
+            # calls/fusions that might contain collectives (conditionals)
+            for sub in re.findall(r"(?:true_computation|false_computation|"
+                                  r"branch_computations)=\{?%?([\w.\-]+)", line):
+                walk(sub, multiplier, seen + (comp,))
+            cm2 = re.search(r"\bcall\(.*to_apply=%?([\w.\-]+)", line)
+            if cm2:
+                walk(cm2.group(1), multiplier, seen + (comp,))
+    walk(entry, 1, ())
+    return list(totals.values()), notes
+
+
+def ici_bytes_per_chip(collectives) -> float:
+    """Ring-algorithm bytes each chip must move over ICI per step."""
+    total = 0.0
+    for rec in collectives:
+        g = rec["group"]
+        if g <= 1:
+            continue
+        frac = (g - 1) / g
+        if rec["op"] in ("all-gather", "reduce-scatter",
+                         "all-reduce[rs-pattern]"):
+            total += rec["bytes"] * frac * rec["count"]
+        elif rec["op"] == "all-reduce":
+            total += 2 * rec["bytes"] * frac * rec["count"]
+        elif rec["op"] == "collective-permute":
+            total += rec["bytes"] * rec["count"]
+    return total
+
+
+def compile_and_extract_spmd(lowered, prefix="hlo_report_", want_dump=True):
+    """Compile with the SPMD-pass dump and return (compiled, hlo_text) —
+    the post-partitioning module when the dump is available, else the
+    final optimized text (CPU-legalized; dtype/RS info degraded). Shared by
+    the train and decode reports so dump/selection fixes apply once."""
+    import glob as _glob
+    import tempfile
+
+    if not want_dump:
+        return lowered.compile(), None
+    dump_dir = tempfile.mkdtemp(prefix=prefix)
+    try:
+        compiled = lowered.compile(
+            {"xla_dump_to": dump_dir, "xla_dump_hlo_pass_re": "spmd.*"}
+        )
+    except Exception:  # older jax: no compiler options
+        compiled = lowered.compile()
+    spmd = sorted(
+        _glob.glob(os.path.join(dump_dir, "*after_spmd-partitioning*"))
+    )
+    if spmd:
+        with open(spmd[-1]) as f:
+            return compiled, f.read()
+    return compiled, None
+
+
+# ------------------------------------------------- graftcheck inspection
+# Primitives that smuggle host work or host<->device transfers into a jitted
+# program. Matching is by exact name OR the "callback" substring so jax
+# renames (debug_callback / pure_callback / io_callback / ordered variants)
+# stay covered.
+_FORBIDDEN_EXACT = frozenset({"infeed", "outfeed", "host_local_array_to_global",
+                              "global_array_to_host_local"})
+
+
+def is_forbidden_primitive(name: str) -> bool:
+    return "callback" in name or name in _FORBIDDEN_EXACT
+
+
+def collect_primitives(closed_jaxpr) -> set:
+    """Every primitive name reachable from a (Closed)Jaxpr, recursing into
+    sub-jaxprs carried in eqn params (pjit, scan, while, cond bodies)."""
+    from jax._src import core as jcore
+
+    jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
+    acc: set = set()
+
+    def visit(jx):
+        for eqn in jx.eqns:
+            acc.add(eqn.primitive.name)
+            for val in eqn.params.values():
+                for sub in _subjaxprs(val, jcore):
+                    visit(sub)
+
+    visit(jaxpr)
+    return acc
+
+
+def _subjaxprs(val, jcore):
+    if isinstance(val, jcore.ClosedJaxpr):
+        yield val.jaxpr
+    elif isinstance(val, jcore.Jaxpr):
+        yield val
+    elif isinstance(val, (tuple, list)):
+        for item in val:
+            yield from _subjaxprs(item, jcore)
+
+
+# MLIR signature args print as "%argN: tensor<...> {attrs}" (no space before
+# the colon); body uses print with a spaced " : " trailing type, so this
+# pattern only matches the @main signature's parameters.
+_ARG_RE = re.compile(r"%arg(\d+): tensor<[^>]*>(?:\s*\{([^}]*)\})?")
+_ALIAS_RE = re.compile(r"tf\.aliasing_output\s*=\s*(\d+)")
+_DONOR_RE = re.compile(r"jax\.buffer_donor\s*=\s*true")
+
+
+def aliased_input_indices(stablehlo_text: str) -> dict:
+    """Map flat input index -> aliased output index, parsed from the arg
+    attributes jax stamps on donated inputs at lowering time
+    (platform-independent: present even on the CPU backend, which later
+    drops donation at runtime). Unsharded programs carry the explicit
+    pairing ``tf.aliasing_output = N``; sharded programs defer the pairing
+    to XLA and mark the input ``jax.buffer_donor = true`` instead — those
+    map to output index -1 (donated, pairing decided at compile time)."""
+    aliased = {}
+    for m in _ARG_RE.finditer(stablehlo_text):
+        attrs = m.group(2) or ""
+        am = _ALIAS_RE.search(attrs)
+        if am:
+            aliased[int(m.group(1))] = int(am.group(1))
+        elif _DONOR_RE.search(attrs):
+            aliased[int(m.group(1))] = -1
+    return aliased
+
+
+def input_count(stablehlo_text: str) -> int:
+    """Number of flat inputs of the lowered module's @main."""
+    idx = [int(m.group(1)) for m in _ARG_RE.finditer(stablehlo_text)]
+    return max(idx) + 1 if idx else 0
+
+
+def flat_in_avals(lowered):
+    """Flattened input avals of a Lowered/Traced, in @main argument order."""
+    import jax
+
+    return jax.tree_util.tree_leaves(lowered.in_avals)
+
+
+def weak_typed_inputs(lowered) -> list:
+    """Flat input indices whose aval is weak-typed — python-scalar operands
+    that fragment the jit cache (a later call with a strongly-typed array of
+    the same shape/dtype compiles a SECOND program)."""
+    return [
+        i for i, av in enumerate(flat_in_avals(lowered))
+        if getattr(av, "weak_type", False)
+    ]
+
+
+def abstractify(tree):
+    """ShapeDtypeStruct skeleton of a pytree (nothing materialized)."""
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), tree
+    )
+
+
+def leaf_count(tree) -> int:
+    import jax
+
+    return len(jax.tree_util.tree_leaves(tree))

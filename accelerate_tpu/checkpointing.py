@@ -109,7 +109,7 @@ def save_pytree(tree, path: str, async_save: bool = False) -> None:
     state = PartialState()
     if state.is_main_process and os.path.exists(path):
         shutil.rmtree(path)
-    state.wait_for_everyone()
+    state.wait_for_everyone("accelerate_tpu.checkpointing.stale_dir_cleanup")
     if async_save:
         global _ATEXIT_REGISTERED
         if not _ATEXIT_REGISTERED:
@@ -339,7 +339,8 @@ def _commit_staged(staging: str, final: str, accelerator) -> None:
     ``<final>.old`` until the rename lands — the previous committed state is
     only ever deleted after the new one is durable."""
     state = PartialState()
-    state.wait_for_everyone()  # every host's staged writes are on disk
+    # every host's staged writes are on disk
+    state.wait_for_everyone("accelerate_tpu.checkpointing.pre_commit")
     fault_point("before_commit")
     if state.is_main_process:
         try:
@@ -372,7 +373,8 @@ def _commit_staged(staging: str, final: str, accelerator) -> None:
             os.rename(final, old)
         os.rename(staging, final)
         shutil.rmtree(old, ignore_errors=True)
-    state.wait_for_everyone()  # no host reads `final` before it exists
+    # no host reads `final` before it exists
+    state.wait_for_everyone("accelerate_tpu.checkpointing.post_commit_rename")
     fault_point("before_gc")
     _gc_checkpoints(accelerator)
     # hand the now-durable checkpoint to the replicator (main process only;
@@ -517,7 +519,7 @@ def save_accelerator_state(
         for leftover in (staging, output_dir + CHECKPOINT_OLD_SUFFIX):
             if os.path.exists(leftover):
                 shutil.rmtree(leftover, ignore_errors=True)
-    state.wait_for_everyone()
+    state.wait_for_everyone("accelerate_tpu.checkpointing.pre_stage")
     os.makedirs(staging, exist_ok=True)
 
     for i, model in enumerate(accelerator._models):
@@ -980,7 +982,7 @@ def save_model_checkpoint(model, save_directory: str, max_shard_size: str = "10G
     host_params = jax.tree_util.tree_map(lambda p: np.asarray(jax.device_get(p)), model.params)
     if state.is_main_process:
         save_sharded_safetensors(host_params, save_directory, max_shard_size=max_shard_size)
-    state.wait_for_everyone()
+    state.wait_for_everyone("accelerate_tpu.checkpointing.save_model_checkpoint")
 
 
 def load_model_checkpoint(model, load_directory: str) -> None:

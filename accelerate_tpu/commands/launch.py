@@ -131,7 +131,7 @@ def _supervise(cmd, env, max_restarts: int, monitor_interval: float,
                             file=sys.stderr,
                         )
                         proc.kill()
-                        proc.wait()
+                        proc.wait()  # graft: wait-ok — reaping a just-SIGKILLed child
                         rc = 1
             uptime = time.time() - started
             if child["terminating"]:

@@ -62,6 +62,7 @@ from .utils.fault import (
     CheckpointDivergedError,
     CheckpointError,
     CheckpointNotFoundError,
+    ComponentClosedError,
     ReplicaUnavailableError,
     fault_point,
 )
@@ -304,7 +305,7 @@ class CheckpointReplicator:
             return
         with self._cond:
             if self._closed:
-                raise RuntimeError("CheckpointReplicator is closed")
+                raise ComponentClosedError("CheckpointReplicator is closed")
             self._ensure_thread()
             while len(self._pending) >= self._MAX_PENDING:
                 dropped = self._pending.popleft()
@@ -365,7 +366,10 @@ class CheckpointReplicator:
         while True:
             with self._cond:
                 while not self._pending and not self._closed:
-                    self._cond.wait()
+                    # periodic wake: the loop re-checks its predicate, so a
+                    # lost notify (or a close() racing thread startup) can
+                    # delay exit by at most one tick instead of wedging
+                    self._cond.wait(timeout=1.0)
                 if not self._pending:
                     return  # closed and drained
                 self._inflight = self._pending.popleft()

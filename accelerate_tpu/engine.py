@@ -72,6 +72,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .logging import get_logger
+from .utils.fault import EngineCapacityError, EngineInvariantError
 
 logger = get_logger(__name__)
 
@@ -599,7 +600,9 @@ class ContinuousBatchingEngine:
         prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
         self.validate_request(len(prompt), max_new_tokens)
         if not self._free:
-            raise RuntimeError("no free arena slot (caller must gate on free_slots())")
+            raise EngineCapacityError(
+                "no free arena slot (caller must gate on free_slots())"
+            )
         slot = self._free.pop()
         try:
             # paged: allocate/COW-share the request's blocks and install the
@@ -683,13 +686,13 @@ class ContinuousBatchingEngine:
             if kind == "prefill":
                 occ, tok, done = payload
                 if not isinstance(tok, (int, np.integer)):
-                    self._ring[i] = (
+                    self._ring[i] = (  # graft: sync-ok — spec drafting needs true history
                         tick, kind, (occ, int(np.asarray(tok)), bool(np.asarray(done)))
                     )
             elif kind == "decode":
                 occs, toks, dones = payload
                 if not isinstance(toks, np.ndarray):
-                    self._ring[i] = (
+                    self._ring[i] = (  # graft: sync-ok — spec drafting needs true history
                         tick, kind, (occs, np.asarray(toks), np.asarray(dones))
                     )
             else:  # verify
@@ -697,8 +700,8 @@ class ContinuousBatchingEngine:
                 if not isinstance(emitted, np.ndarray):
                     self._ring[i] = (
                         tick, kind,
-                        (occs, np.asarray(emitted), np.asarray(ms),
-                         np.asarray(accs), dlens, np.asarray(dones)),
+                        (occs, np.asarray(emitted), np.asarray(ms),  # graft: sync-ok
+                         np.asarray(accs), dlens, np.asarray(dones)),  # graft: sync-ok
                     )
 
     def _pending_tokens(self, occ: SlotOccupant):
@@ -862,21 +865,21 @@ class ContinuousBatchingEngine:
             _, kind, payload = self._ring.popleft()
             if kind == "prefill":
                 occ, tok, done = payload
+                # graft: sync-ok — the ring IS the readback point (K programs late)
                 self._absorb(occ, int(np.asarray(tok)), bool(np.asarray(done)), retired)
             elif kind == "decode":
                 occs, toks, dones = payload
-                toks = np.asarray(toks)
-                dones = np.asarray(dones)
+                # graft: sync-ok — the ring IS the readback point (K programs late)
+                toks, dones = np.asarray(toks), np.asarray(dones)
                 for occ in occs:
                     if occ is None or occ.finished:
                         continue
                     self._absorb(occ, int(toks[occ.slot]), bool(dones[occ.slot]), retired)
             else:  # verify: up to W tokens per slot, done applies to the last
                 occs, emitted, ms, accs, dlens, dones = payload
-                emitted = np.asarray(emitted)
-                ms = np.asarray(ms)
-                accs = np.asarray(accs)
-                dones = np.asarray(dones)
+                # the ring IS the readback point (K programs late)
+                emitted, ms = np.asarray(emitted), np.asarray(ms)  # graft: sync-ok
+                accs, dones = np.asarray(accs), np.asarray(dones)  # graft: sync-ok
                 for occ in occs:
                     if occ is None or occ.finished:
                         continue
@@ -955,7 +958,7 @@ class ContinuousBatchingEngine:
         guard = 2 * self.max_len + self.readback_lag + 4
         while self.live_count() > 0:
             if guard <= 0:
-                raise RuntimeError(
+                raise EngineInvariantError(
                     "engine drain did not converge (device done mask never "
                     "caught up with live occupants)"
                 )

@@ -301,17 +301,20 @@ class PagedBlockPool:
         """Allocate (or COW-share) the blocks for one admitted request and
         install the slot's table row. Returns ``(row, shared_blocks)`` where
         ``row`` is the full ``(blocks_per_row,)`` int32 table row (null
-        beyond the allocation). Raises ``RuntimeError`` when the pool lacks
-        capacity — callers gate on :meth:`can_admit` first."""
+        beyond the allocation). Raises ``EngineCapacityError`` (a retriable
+        RuntimeError) when the pool lacks capacity — callers gate on
+        :meth:`can_admit` first."""
+        from .utils.fault import EngineCapacityError
+
         prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
         total = self.blocks_needed(len(prompt), budget)
         if total > self.blocks_per_row:
-            raise RuntimeError(
+            raise EngineCapacityError(
                 f"request needs {total} blocks but a table row holds "
                 f"{self.blocks_per_row}"
             )
         if not self.can_admit(prompt, budget):
-            raise RuntimeError(
+            raise EngineCapacityError(
                 "no free KV blocks (caller must gate on can_admit())"
             )
         bs = self.block_size

@@ -41,7 +41,7 @@ class MultiProcessAdapter(logging.LoggerAdapter):
                     if i == state.process_index:
                         msg, kwargs = self.process(msg, kwargs)
                         self.logger.log(level, msg, *args, **kwargs)
-                    state.wait_for_everyone()
+                    state.wait_for_everyone("accelerate_tpu.logging.in_order")
             elif self._should_log(main_process_only):
                 msg, kwargs = self.process(msg, kwargs)
                 self.logger.log(level, msg, *args, **kwargs)

@@ -1005,7 +1005,7 @@ class InferenceServer:
         )
         # realize on host here — a transfer error is a batch failure, not a
         # mystery the client trips over later
-        return np.asarray(out)[: len(batch)]
+        return np.asarray(out)[: len(batch)]  # graft: sync-ok — batch boundary
 
     def _execute(self, batch: list[_Request]) -> None:
         cfg = self.config

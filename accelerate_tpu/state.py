@@ -375,18 +375,22 @@ class PartialState:
         """Main process runs the body first, others wait; then the rest run
         (reference state.py:513-554). Guards e.g. dataset cache writes."""
         if not self.is_main_process:
-            self.wait_for_everyone()
+            self.wait_for_everyone("accelerate_tpu.state.main_process_first.enter")
         yield
         if self.is_main_process:
-            self.wait_for_everyone()
+            self.wait_for_everyone("accelerate_tpu.state.main_process_first.exit")
 
     @contextmanager
     def local_main_process_first(self):
         if not self.is_local_main_process:
-            self.wait_for_everyone()
+            self.wait_for_everyone(
+                "accelerate_tpu.state.local_main_process_first.enter"
+            )
         yield
         if self.is_local_main_process:
-            self.wait_for_everyone()
+            self.wait_for_everyone(
+                "accelerate_tpu.state.local_main_process_first.exit"
+            )
 
     def on_main_process(self, function: Callable) -> Callable:
         """Decorator: run only on the main process (reference state.py:555)."""

@@ -31,6 +31,7 @@ from __future__ import annotations
 import collections
 import queue
 import threading
+import time
 from typing import Any, NamedTuple, Optional
 
 import numpy as np
@@ -261,7 +262,9 @@ class AsyncTrackerFlusher:
     # ------------------------------------------------------------- hot path
     def submit(self, values: dict, step=None, log_kwargs: Optional[dict] = None):
         if self._closed:
-            raise RuntimeError("AsyncTrackerFlusher is closed")
+            from .utils.fault import ComponentClosedError
+
+            raise ComponentClosedError("AsyncTrackerFlusher is closed")
         self._queue.put((values, step, log_kwargs or {}))
 
     # ------------------------------------------------------------ background
@@ -312,23 +315,57 @@ class AsyncTrackerFlusher:
         if self._errors:
             raise self._errors.pop(0)
 
+    # a queue.join() has no timeout parameter, so a flusher thread that died
+    # (or a record stuck inside a tracker's write) would hang flush()/close()
+    # — and with them end_training and the preemption emergency save —
+    # forever. Bound the drain instead: give up after this many seconds, or
+    # immediately once the worker thread is dead (nobody is left to call
+    # task_done).
+    DRAIN_TIMEOUT_S = 60.0
+
+    def _drain_queue(self, timeout: Optional[float] = None) -> bool:
+        """Bounded equivalent of ``queue.join()``: True when every queued
+        record was processed, False on timeout or worker death."""
+        deadline = time.monotonic() + (
+            self.DRAIN_TIMEOUT_S if timeout is None else timeout
+        )
+        q = self._queue
+        with q.all_tasks_done:
+            while q.unfinished_tasks:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self._thread.is_alive():
+                    return False
+                q.all_tasks_done.wait(min(remaining, 0.2))
+        return True
+
     def flush(self) -> None:
-        """Block until every submitted record has been written (or failed);
-        re-raise the first deferred tracker error."""
+        """Block (bounded) until every submitted record has been written or
+        failed; re-raise the first deferred tracker error."""
         self._draining.set()
         try:
-            self._queue.join()
+            if not self._drain_queue():
+                logger.warning(
+                    "tracker flush gave up after "
+                    f"{self.DRAIN_TIMEOUT_S:.0f}s with "
+                    f"{self._queue.unfinished_tasks} record(s) unwritten"
+                )
         finally:
             self._draining.clear()
         self._raise_pending()
 
     def close(self) -> None:
         """Flush everything, stop the thread, surface deferred errors.
-        Idempotent."""
+        Idempotent; bounded like :meth:`flush` so a dead or wedged flusher
+        thread cannot hang ``end_training``."""
         if not self._closed:
             self._closed = True
             self._draining.set()
             self._queue.put(_STOP)
-            self._queue.join()
+            if not self._drain_queue():
+                logger.warning(
+                    "tracker close gave up after "
+                    f"{self.DRAIN_TIMEOUT_S:.0f}s with "
+                    f"{self._queue.unfinished_tasks} record(s) unwritten"
+                )
             self._thread.join(timeout=30)
         self._raise_pending()

@@ -30,7 +30,7 @@ def _ensure_devices():
 
 def process_control_check(accelerator):
     assert accelerator.process_index < accelerator.num_processes
-    accelerator.wait_for_everyone()
+    accelerator.wait_for_everyone("accelerate_tpu.test_script.process_control")
     with accelerator.split_between_processes(list(range(10))) as chunk:
         assert len(chunk) >= 10 // max(accelerator.num_processes, 1)
     accelerator.print("process control ok")

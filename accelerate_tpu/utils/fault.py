@@ -45,6 +45,9 @@ __all__ = [
     "CircuitOpenError",
     "ServerDrainingError",
     "BatchExecutionError",
+    "EngineCapacityError",
+    "EngineInvariantError",
+    "ComponentClosedError",
     "FaultInjected",
     "fault_point",
     "install_preemption_handler",
@@ -165,6 +168,30 @@ class BatchExecutionError(ServingError):
     underlying exception."""
 
     retriable = False
+
+
+class EngineCapacityError(ServingError):
+    """The decode engine's arena or KV block pool has no room for this
+    request right now (callers must gate on ``free_slots()`` /
+    ``can_admit()``). Backpressure, not an outage: slots and blocks free as
+    occupants retire, so backing off and resubmitting can succeed.
+    Subclasses :class:`ServingError` (hence ``RuntimeError``) so
+    pre-taxonomy callers catching RuntimeError keep working."""
+
+    retriable = True
+
+
+class EngineInvariantError(RuntimeError):
+    """An engine-internal invariant broke (e.g. drain's device done mask
+    never converged on the live occupants). Not retriable — this is a bug,
+    and the engine state cannot be trusted; callers should ``reset()``."""
+
+
+class ComponentClosedError(RuntimeError):
+    """A lifecycle method was called on a component that is already closed
+    (``AsyncTrackerFlusher``, ``CheckpointReplicator``). Subclasses
+    RuntimeError so pre-taxonomy ``except RuntimeError`` callers keep
+    working."""
 
 
 class FaultInjected(RuntimeError):

@@ -34,7 +34,7 @@ def extract_model_from_parallel(model, keep_fp32_wrapper: bool = True, recursive
 def wait_for_everyone() -> None:
     from ..state import PartialState
 
-    PartialState().wait_for_everyone()
+    PartialState().wait_for_everyone("accelerate_tpu.utils.wait_for_everyone")
 
 
 def save(obj: Any, f, save_on_each_node: bool = False, safe_serialization: bool = False) -> None:

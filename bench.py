@@ -649,6 +649,14 @@ if __name__ == "__main__":
         from benchmarks.continuous_bench import spec_main
 
         sys.exit(spec_main(gate=True))
+    if "--static-gate" in sys.argv:
+        # graftcheck: static invariant analysis — host-lint rules G101-G105
+        # plus AOT-lowered program checks G001-G004 against the committed
+        # program/collective baseline (docs/static_analysis.md)
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from accelerate_tpu.analysis.__main__ import main as static_main
+
+        sys.exit(static_main([a for a in sys.argv[1:] if a != "--static-gate"]))
     if "--continuous-gate" in sys.argv:
         # continuous-batching gate: mixed-length/mixed-budget workload must
         # reach >= 1.3x static-mode goodput with TTFT p99 no worse, <= 2

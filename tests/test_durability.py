@@ -122,15 +122,17 @@ def test_sigkill_mid_save_rolls_back_bit_identical(tmp_path, fault):
 
 # ---------------------------------------------------------- fault_point
 def test_fault_point_actions(fault_inject):
+    # synthetic point names: this test exercises the injector machinery
+    # itself, so the names deliberately exist nowhere in the code
     fault_point("unarmed")  # no spec → no-op
-    fault_inject("mypoint:raise")
+    fault_inject("mypoint:raise")  # graft: fault-ok
     fault_point("other")  # armed, different point → no-op
     with pytest.raises(FaultInjected):
         fault_point("mypoint")
-    fault_inject("a:raise,b:raise")
+    fault_inject("a:raise,b:raise")  # graft: fault-ok
     with pytest.raises(FaultInjected):
         fault_point("b")
-    fault_inject("mypoint:bogus")
+    fault_inject("mypoint:bogus")  # graft: fault-ok
     with pytest.raises(ValueError):
         fault_point("mypoint")
 

