@@ -9,7 +9,7 @@
 PY := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu python
 PY_SLOW := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu RUN_SLOW=1 python
 
-.PHONY: test test_all test_core test_data test_parallel test_models test_cli test_big_modeling test-fault test-serving check-static bench bench-telemetry bench-serving bench-continuous bench-recovery bench-kv bench-spec
+.PHONY: test test_all test_core test_data test_parallel test_models test_cli test_big_modeling test-fault test-serving check-static check-sharding bench bench-telemetry bench-serving bench-continuous bench-recovery bench-kv bench-spec
 
 test: check-static
 	$(PY) -m pytest tests/ -q
@@ -18,11 +18,20 @@ test: check-static
 # Level 1 AOT-lowers the registered hot programs (fused train step, engine
 # prefill/decode/verify per backend) and checks callbacks, donation
 # aliasing, weak types, and program/collective budgets against
-# runs/static_baseline.json; Level 2 is the host AST lint (G101-G105).
-# Exit 0 = clean. Re-baseline deliberate program changes with:
+# runs/static_baseline.json; Level 2 is the host AST lint (G101-G105);
+# Level 3 audits SPMD shardings + static HBM budgets (G201-G205) against
+# runs/sharding_baseline.json. check-static runs ALL levels; exit 0 =
+# clean. Re-baseline deliberate program/budget changes atomically
+# (both baselines, write-to-temp + rename) with:
 #   $(PY) -m accelerate_tpu.analysis --update-baseline
 check-static:
 	$(PY) -m accelerate_tpu.analysis
+
+# Level 3 alone: replicated-state, implicit-reshard, HBM-budget, DCN-loop,
+# and missed-donation audit of the lowered hot programs across the
+# parallelism variants (dp8 / fsdp8 / tp2 / hsdp2x4 + engine backends)
+check-sharding:
+	$(PY) -m accelerate_tpu.analysis --level sharding
 
 # durable-checkpointing suite (docs/fault_tolerance.md): atomic commit,
 # kill-mid-save rollback via ACCELERATE_TPU_FAULT_INJECT, preemption,

@@ -35,6 +35,24 @@ Level 2 — host lint (``analysis/host.py``):
 * **G105** fault-injection point referenced by tests/docs but absent from
   the code's ``fault_point`` registry
 
+Level 3 — sharding & memory audit (``analysis/sharding.py``):
+
+* **G201** a large state tensor (param / optimizer moment / KV arena)
+  fully replicated while the active ``ParallelismConfig`` claims it is
+  sharded
+* **G202** GSPMD-inserted reshard collective (all-gather / all-to-all /
+  collective-permute) over a mesh axis the declared specs in
+  ``parallel/sharding.py`` never imply for that op
+* **G203** static per-device HBM footprint growth past the budget in
+  ``runs/sharding_baseline.json`` (growth fails, shrinkage passes)
+* **G204** collective crossing the slow DCN axis inside a while-loop
+  body, trip-count-weighted
+* **G205** a large non-donated input whose buffer is dead after the call
+  (an output of the same shape/dtype could have reused it)
+
+Level 3 waivers live in ``runs/sharding_baseline.json`` (program-level
+findings have no source line to comment on); see docs/static_analysis.md.
+
 Waivers are line-scoped comments, same line or the line above:
 ``# graft: sync-ok`` (G101), ``# graft: wait-ok`` (G102),
 ``# graft: raise-ok`` (G103), ``# graft: lock-ok`` (G104),
@@ -56,6 +74,11 @@ RULES = {
     "G103": "untyped raise where a fault-taxonomy type exists",
     "G104": "tracker/metrics call while holding the server lock",
     "G105": "referenced fault-injection point missing from the registry",
+    "G201": "large state tensor replicated where the config claims sharding",
+    "G202": "GSPMD reshard collective not implied by the declared specs",
+    "G203": "static per-device HBM footprint grew past the committed budget",
+    "G204": "collective crosses the DCN axis inside a while-loop body",
+    "G205": "large non-donated input dead after the call (missed donation)",
 }
 
 
@@ -65,6 +88,10 @@ class Finding:
     path: str  # repo-relative file, or a program name for Level 1
     line: int  # 1-based; 0 when the finding is not line-addressable
     message: str
+    # stable lowered-program name ("train.fsdp8/fused_train_step",
+    # "engine.paged/decode_step") for program-scoped findings — empty for
+    # host-lint findings. Serialized in --json so CI diffs key on it.
+    program: str = ""
 
     def render(self) -> str:
         loc = f"{self.path}:{self.line}" if self.line else self.path

@@ -1,10 +1,16 @@
 """graftcheck CLI: ``python -m accelerate_tpu.analysis`` (make check-static).
 
 Exit 0 when the tree is clean, 1 when any finding survives. Level `host`
-is pure-AST and fast; level `program` traces and lowers the real hot
-programs, so the environment is pinned to the CPU backend with 8 virtual
-devices BEFORE jax loads (the dp=8 train step needs a mesh, and CI boxes
-have no accelerator).
+is pure-AST and fast; levels `program` and `sharding` trace and lower the
+real hot programs, so the environment is pinned to the CPU backend with 8
+virtual devices BEFORE jax loads (the dp=8 train step needs a mesh, and CI
+boxes have no accelerator).
+
+``--update-baseline`` is atomic across BOTH baselines: every level that
+ran appends its new baseline to a sink, and the files
+(``runs/static_baseline.json``, ``runs/sharding_baseline.json``) are
+committed together via write-to-temp + rename only after every level
+finished — a crash mid-run leaves both untouched.
 """
 
 from __future__ import annotations
@@ -34,9 +40,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         "programs (G001-G004) and host hot paths (G101-G105).",
     )
     parser.add_argument(
-        "--level", choices=("host", "program", "all"), default="all",
+        "--level", choices=("host", "program", "sharding", "all"),
+        default="all",
         help="host = AST lint only (fast); program = lower and inspect the "
-        "jitted programs; all = both (default)",
+        "jitted programs (G001-G004); sharding = SPMD layout + HBM audit "
+        "(G201-G205); all = everything (default)",
     )
     parser.add_argument(
         "--root", default=".", help="repo root to lint (default: cwd)"
@@ -44,6 +52,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument(
         "--baseline", default=None,
         help="program-budget baseline path (default: runs/static_baseline.json "
+        "under --root)",
+    )
+    parser.add_argument(
+        "--sharding-baseline", default=None,
+        help="HBM-budget baseline path (default: runs/sharding_baseline.json "
         "under --root)",
     )
     parser.add_argument(
@@ -63,7 +76,14 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     root = os.path.abspath(args.root)
     baseline = args.baseline or os.path.join(root, "runs", "static_baseline.json")
+    sharding_baseline = args.sharding_baseline or os.path.join(
+        root, "runs", "sharding_baseline.json"
+    )
     findings: List[Finding] = []
+    # deferred (path, baseline) writes: every level that ran contributes,
+    # then everything is committed atomically below — one flag, whichever
+    # levels ran, all-or-nothing
+    baseline_sink: List = []
 
     if args.level in ("host", "all"):
         from .host import lint_package
@@ -78,7 +98,25 @@ def main(argv: Optional[List[str]] = None) -> int:
             baseline_path=baseline,
             update_baseline=args.update_baseline,
             with_collectives=not args.no_collectives,
+            baseline_sink=baseline_sink,
         ))
+
+    if args.level in ("sharding", "all"):
+        _pin_cpu_backend()
+        from .sharding import run_sharding_checks
+
+        findings.extend(run_sharding_checks(
+            baseline_path=sharding_baseline,
+            update_baseline=args.update_baseline,
+            with_collectives=not args.no_collectives,
+            baseline_sink=baseline_sink,
+        ))
+
+    if args.update_baseline and baseline_sink:
+        from .lowering import atomic_write_json
+
+        for path, obj in baseline_sink:
+            atomic_write_json(obj, path)
 
     if args.as_json:
         print(json.dumps(

@@ -28,7 +28,7 @@ import re
 # intentionally not matched (counting them would double the -start).
 _COLL_RE = re.compile(
     r"=\s+(?P<shape>\(?[^=]*?)\s*(?P<op>all-gather|reduce-scatter|all-reduce|"
-    r"collective-permute)(?:-start)?\(",
+    r"all-to-all|collective-permute)(?:-start)?\(",
 )
 
 _DTYPE_BYTES = {"f32": 4, "bf16": 2, "f16": 2, "s32": 4, "u32": 4, "pred": 1,
@@ -63,18 +63,81 @@ def _group_size(line: str, n_devices: int) -> int:
     return n_devices
 
 
-def parse_collectives(hlo: str, n_devices: int):
-    """Per-computation collective inventory with while-loop trip counts.
+def parse_replica_groups(line: str, n_devices: int):
+    """Concrete device-id groups of one collective instruction, or None.
 
-    Splits the module into computations, walks the entry computation, and
-    multiplies ops inside while bodies by the loop trip count (parsed from
-    the condition's compare-against-constant; layer scans and grad-accum
-    loops all lower this way). Unparseable trip counts fall back to 1 with
-    a note — counts are then LOWER bounds."""
-    # Computation definitions start at column 0; instructions are indented.
-    # Older XLA text prints "%name (params) -> ... {", newer emitters drop
-    # the parameter list (and the % sigils) and print just "name {" — accept
-    # both by matching only the leading name up to a paren OR the brace.
+    Handles every form the SPMD partitioner emits: explicit
+    ``replica_groups={{0,1},{2,3}}``, the iota v2 short form
+    ``replica_groups=[ngroups,gsize]<=[N]`` (row-major consecutive ids),
+    the transposed iota ``[ngroups,gsize]<=[d0,d1,...]T(perm)`` (ids laid
+    out over the mesh then permuted — this is how cross-axis groups on a
+    non-minor mesh axis print), and ``source_target_pairs`` on
+    collective-permute (each pair is a 2-device group for axis-attribution
+    purposes)."""
+    m = re.search(r"replica_groups=\{(\{[\d, ]+\}(?:,\s*\{[\d, ]+\})*)\}", line)
+    if m:
+        return [
+            [int(d) for d in grp.split(",")]
+            for grp in re.findall(r"\{([\d, ]+)\}", m.group(1))
+        ]
+    m = re.search(
+        r"replica_groups=\[(\d+),(\d+)\]<=\[([\d,]+)\](?:T\(([\d,]+)\))?", line
+    )
+    if m:
+        import numpy as np
+
+        ngroups, gsize = int(m.group(1)), int(m.group(2))
+        dims = [int(d) for d in m.group(3).split(",")]
+        ids = np.arange(int(np.prod(dims))).reshape(dims)
+        if m.group(4):
+            ids = ids.transpose([int(p) for p in m.group(4).split(",")])
+        return ids.reshape(ngroups, gsize).tolist()
+    m = re.search(r"source_target_pairs=\{((?:\{\d+,\d+\},?)*)\}", line)
+    if m:
+        return [
+            [int(a), int(b)]
+            for a, b in re.findall(r"\{(\d+),(\d+)\}", m.group(1))
+        ]
+    return None
+
+
+def mesh_device_coords(mesh) -> dict:
+    """device id -> per-axis coordinate tuple for a jax Mesh."""
+    import numpy as np
+
+    coords = {}
+    for idx in np.ndindex(mesh.devices.shape):
+        coords[mesh.devices[idx].id] = tuple(int(i) for i in idx)
+    return coords
+
+
+def groups_mesh_axes(groups, axis_names, coords_by_id) -> set:
+    """Mesh axes that VARY inside any of a collective's device groups —
+    i.e. the axes the collective actually communicates over. ``groups`` is
+    the :func:`parse_replica_groups` output; unknown device ids (synthetic
+    fixtures bigger than the mesh) attribute to no axis."""
+    axes: set = set()
+    for group in groups or ():
+        known = [coords_by_id[d] for d in group if d in coords_by_id]
+        if len(known) < 2:
+            continue
+        for pos, name in enumerate(axis_names):
+            if len({c[pos] for c in known}) > 1:
+                axes.add(name)
+    return axes
+
+
+_META_SRC_RE = re.compile(r'source_file="([^"]+)"(?:.*?source_line=(\d+))?')
+_META_OP_RE = re.compile(r'op_name="([^"]+)"')
+
+
+def split_computations(hlo: str):
+    """(comps, entry): computation name -> instruction lines, + entry name.
+
+    Computation definitions start at column 0; instructions are indented.
+    Older XLA text prints "%name (params) -> ... {", newer emitters drop
+    the parameter list (and the % sigils) and print just "name {" — accept
+    both by matching only the leading name up to a paren OR the brace."""
     comps: dict[str, list[str]] = {}
     entry = None
     name = None
@@ -89,6 +152,21 @@ def parse_collectives(hlo: str, n_devices: int):
             comps[name].append(raw)
     if entry is None:  # single-computation module
         entry = next(iter(comps), None)
+    return comps, entry
+
+
+def iter_collectives(hlo: str, n_devices: int):
+    """Per-INSTRUCTION collective records with while-loop trip weighting.
+
+    Returns ``(instrs, notes)``. Each record carries everything the
+    aggregate inventory (:func:`parse_collectives`) and the sharding
+    auditor (graftcheck Level 3) need: ``op`` (with the rs-pattern
+    rewrite applied), ``dtype``, ``bytes``, ``group`` (devices per group),
+    ``groups`` (concrete id groups, or None when unparseable),
+    ``multiplier`` (product of enclosing while trip counts), ``comp``,
+    ``result``/``operand`` instruction names, and the jax ``op_name`` /
+    ``source`` metadata when present."""
+    comps, entry = split_computations(hlo)
 
     def trip_count(line: str, cond_name):
         # Post-optimization modules stamp the statically-known trip count on
@@ -118,7 +196,7 @@ def parse_collectives(hlo: str, n_devices: int):
         return None
 
     notes = []
-    totals: dict[tuple[str, str, int], dict] = {}
+    instrs: list[dict] = []
 
     def reduce_scatter_like(comp: str, result_name: str) -> bool:
         """An all-reduce whose every consumer is a (dynamic-)slice IS a
@@ -162,16 +240,26 @@ def parse_collectives(hlo: str, n_devices: int):
                 nbytes, dtype = _shape_bytes(cm.group("shape"))
                 g = _group_size(line, n_devices)
                 op = cm.group("op")
-                if op == "all-reduce":
-                    nm = re.match(r"\s*(%?[\w.\-]+)\s*=", line)
-                    if nm and reduce_scatter_like(comp, nm.group(1)):
-                        op = "all-reduce[rs-pattern]"
-                key = (op, dtype, nbytes)
-                rec = totals.setdefault(
-                    key, dict(op=op, dtype=dtype, bytes=nbytes,
-                              group=g, count=0),
+                nm = re.match(r"\s*(%?[\w.\-]+)\s*=", line)
+                result = nm.group(1).lstrip("%") if nm else "?"
+                if op == "all-reduce" and nm and reduce_scatter_like(comp, result):
+                    op = "all-reduce[rs-pattern]"
+                om = re.search(
+                    r"(?:all-gather|reduce-scatter|all-reduce|all-to-all|"
+                    r"collective-permute)(?:-start)?\(\s*%?([\w.\-]+)", line
                 )
-                rec["count"] += multiplier
+                sm = _META_SRC_RE.search(line)
+                opm = _META_OP_RE.search(line)
+                instrs.append(dict(
+                    op=op, dtype=dtype, bytes=nbytes, group=g,
+                    groups=parse_replica_groups(line, n_devices),
+                    multiplier=multiplier, comp=comp, result=result,
+                    operand=om.group(1) if om else "?",
+                    op_name=opm.group(1) if opm else "",
+                    source=(f"{os.path.basename(sm.group(1))}:{sm.group(2)}"
+                            if sm and sm.group(2)
+                            else os.path.basename(sm.group(1)) if sm else ""),
+                ))
             # calls/fusions that might contain collectives (conditionals)
             for sub in re.findall(r"(?:true_computation|false_computation|"
                                   r"branch_computations)=\{?%?([\w.\-]+)", line):
@@ -180,6 +268,27 @@ def parse_collectives(hlo: str, n_devices: int):
             if cm2:
                 walk(cm2.group(1), multiplier, seen + (comp,))
     walk(entry, 1, ())
+    return instrs, notes
+
+
+def parse_collectives(hlo: str, n_devices: int):
+    """Aggregate collective inventory with while-loop trip counts.
+
+    Walks the entry computation (via :func:`iter_collectives`) and sums
+    per-instruction records into one row per distinct (op, dtype, bytes),
+    multiplying ops inside while bodies by the loop trip count (parsed from
+    the condition's compare-against-constant; layer scans and grad-accum
+    loops all lower this way). Unparseable trip counts fall back to 1 with
+    a note — counts are then LOWER bounds."""
+    instrs, notes = iter_collectives(hlo, n_devices)
+    totals: dict[tuple, dict] = {}
+    for rec in instrs:
+        key = (rec["op"], rec["dtype"], rec["bytes"])
+        agg = totals.setdefault(
+            key, dict(op=rec["op"], dtype=rec["dtype"], bytes=rec["bytes"],
+                      group=rec["group"], count=0),
+        )
+        agg["count"] += rec["multiplier"]
     return list(totals.values()), notes
 
 
@@ -225,6 +334,54 @@ def compile_and_extract_spmd(lowered, prefix="hlo_report_", want_dump=True):
         with open(spmd[-1]) as f:
             return compiled, f.read()
     return compiled, None
+
+
+# per-device HBM accounting fields XLA's memory_analysis exposes; one table
+# shared by benchmarks/hlo_report.py and graftcheck G203 so the bench report
+# and the static budget gate can never disagree on what "live" means.
+_MEM_FIELDS = ("argument_size_in_bytes", "output_size_in_bytes",
+               "temp_size_in_bytes", "generated_code_size_in_bytes")
+
+
+def memory_table(compiled) -> dict:
+    """Static per-device HBM accounting of a compiled program.
+
+    Returns the raw ``memory_analysis()`` byte fields plus ``hbm_live`` —
+    arguments + temps, since donated outputs alias their argument buffers
+    (the same estimate ``benchmarks/hlo_report.py`` reports as
+    ``hbm_live_estimate``). Fields XLA does not expose on this backend are
+    simply absent."""
+    mem = compiled.memory_analysis()
+    table = {
+        k: int(getattr(mem, k)) for k in _MEM_FIELDS if hasattr(mem, k)
+    }
+    table["hbm_live"] = (
+        table.get("argument_size_in_bytes", 0)
+        + table.get("temp_size_in_bytes", 0)
+    )
+    return table
+
+
+def atomic_write_json(obj, path: str) -> None:
+    """Write-to-temp + rename so a crash mid-update never leaves a torn
+    baseline; both graftcheck baselines commit through this."""
+    import json
+    import tempfile
+
+    d = os.path.dirname(path) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=os.path.basename(path) + ".")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(obj, f, indent=2, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 
 # ------------------------------------------------- graftcheck inspection

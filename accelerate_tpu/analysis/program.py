@@ -228,6 +228,7 @@ def check_callbacks(rec: ProgramRecord) -> List[Finding]:
             "G001", rec.source, 1,
             f"{rec.group}/{rec.name}: host callback primitive "
             f"'{prim}' inside a jitted program",
+            program=f"{rec.group}/{rec.name}",
         ))
     return findings
 
@@ -243,6 +244,7 @@ def check_donation(rec: ProgramRecord) -> List[Finding]:
             "G002", rec.source, 1,
             f"{rec.group}/{rec.name}: donated flat input(s) {missing} carry "
             "no tf.aliasing_output (donated-but-unused doubles peak memory)",
+            program=f"{rec.group}/{rec.name}",
         ))
     if extra:
         findings.append(Finding(
@@ -250,6 +252,7 @@ def check_donation(rec: ProgramRecord) -> List[Finding]:
             f"{rec.group}/{rec.name}: non-donated flat input(s) {extra} are "
             "aliased to outputs (donating the carried/ring tree breaks the "
             "deferred-readback ring)",
+            program=f"{rec.group}/{rec.name}",
         ))
     return findings
 
@@ -263,6 +266,7 @@ def check_weak_types(rec: ProgramRecord) -> List[Finding]:
         "G003", rec.source, 1,
         f"{rec.group}/{rec.name}: weak-typed flat input(s) {sorted(weak)} "
         "(pass jnp.int32(...)/jnp.float32(...), not python scalars)",
+        program=f"{rec.group}/{rec.name}",
     )]
 
 
@@ -323,8 +327,8 @@ def compare_baseline(observed: Dict[str, Any],
     """G004 — growth (never shrinkage) vs the committed baseline fails."""
     findings: List[Finding] = []
 
-    def flag(msg: str) -> None:
-        findings.append(Finding("G004", baseline_path, 1, msg))
+    def flag(msg: str, program: str = "") -> None:
+        findings.append(Finding("G004", baseline_path, 1, msg, program=program))
 
     base_programs = baseline.get("programs", {})
     ceilings = baseline.get("ceilings", {})
@@ -332,29 +336,34 @@ def compare_baseline(observed: Dict[str, Any],
         known = base_programs.get(group)
         if known is None:
             flag(f"program group '{group}' is not in the baseline "
-                 "(re-baseline with --update-baseline if intended)")
+                 "(re-baseline with --update-baseline if intended)",
+                 program=group)
             continue
         for name in sorted(set(names) - set(known)):
             flag(f"unexplained new jitted program '{group}/{name}' "
-                 f"(baseline knows {sorted(known)})")
+                 f"(baseline knows {sorted(known)})",
+                 program=f"{group}/{name}")
         ceiling = ceilings.get(
             group, ENGINE_PROGRAM_CEILING if group.startswith("engine.") else None
         )
         if ceiling is not None and len(names) > ceiling:
             flag(f"group '{group}' dispatches {len(names)} programs, over "
-                 f"the {ceiling}-programs-per-config ceiling")
+                 f"the {ceiling}-programs-per-config ceiling",
+                 program=group)
 
     base_coll = baseline.get("collectives", {})
     for prog, ops in observed.get("collectives", {}).items():
         known_ops = base_coll.get(prog)
         if known_ops is None:
             if base_coll:
-                flag(f"no collective baseline for program '{prog}'")
+                flag(f"no collective baseline for program '{prog}'",
+                     program=prog)
             continue
         for op, count in sorted(ops.items()):
             if count > int(known_ops.get(op, 0)):
                 flag(f"collective growth in '{prog}': {op} x{count} vs "
-                     f"baseline x{known_ops.get(op, 0)}")
+                     f"baseline x{known_ops.get(op, 0)}",
+                     program=prog)
     return findings
 
 
@@ -366,11 +375,10 @@ def load_baseline(path: str = BASELINE_PATH) -> Optional[Dict[str, Any]]:
 
 
 def write_baseline(observed: Dict[str, Any], path: str = BASELINE_PATH) -> Dict[str, Any]:
+    from .lowering import atomic_write_json
+
     baseline = make_baseline(observed)
-    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    with open(path, "w") as f:
-        json.dump(baseline, f, indent=2, sort_keys=True)
-        f.write("\n")
+    atomic_write_json(baseline, path)
     return baseline
 
 
@@ -379,12 +387,19 @@ def run_program_checks(
     update_baseline: bool = False,
     groups: Optional[Sequence[str]] = None,
     with_collectives: bool = True,
+    baseline_sink: Optional[list] = None,
 ) -> List[Finding]:
     records = build_programs(groups)
     findings = check_programs(records)
     observed = observe(records, with_collectives=with_collectives)
     if update_baseline:
-        write_baseline(observed, baseline_path)
+        if baseline_sink is not None:
+            # deferred: __main__ commits every level's baseline atomically
+            # after ALL levels ran clean through — a sharding-level crash
+            # must not leave a half-updated static baseline behind
+            baseline_sink.append((baseline_path, make_baseline(observed)))
+        else:
+            write_baseline(observed, baseline_path)
         return findings
     baseline = load_baseline(baseline_path)
     if baseline is None:

@@ -31,10 +31,42 @@ __all__ = [
     "replicated",
     "apply_shardings",
     "shard_params",
+    "spec_used_axes",
     "ShardingRule",
+    "IMPLIED_RESHARD_AXES",
 ]
 
 ShardingRule = tuple[str, P]
+
+# Which mesh axes each RESHAPE collective is implied on by the specs this
+# module declares — the contract graftcheck G202 audits the lowered HLO
+# against. (all-reduce / reduce-scatter are REDUCTIONS, implied wherever a
+# contraction crosses an axis, so they are not reshard evidence and are
+# deliberately absent.)
+#
+#   all-gather          fsdp storage→use gathers (gather_over_fsdp),
+#                       Megatron-SP sequence re-gathers at block entry
+#                       (constrain_activation "residual"→"heads"), sp/cp
+#                       sequence assembly
+#   all-to-all          Ulysses head<->sequence exchange on sp, and the
+#                       Megatron-SP seq-shard→head-shard transition on tp
+#                       (the "residual"→"heads" constraint pair lowers to
+#                       an a2a over tp — cheaper than gather+slice)
+#   collective-permute  ring context-parallel block rotation (cp) and
+#                       pipeline-stage boundary shifts (pp)
+#
+# A lowered program containing one of these ops over any OTHER >1 mesh axis
+# means GSPMD invented a reshard the declared specs never asked for —
+# exactly the "involuntary full rematerialization" class the activation
+# anchors below exist to prevent. (GSPMD sometimes DECOMPOSES a declared
+# gather into an a2a+permute pair — arXiv 2112.01075's portable
+# redistribution — those known sites carry documented waivers in
+# runs/sharding_baseline.json rather than a blanket allowance here.)
+IMPLIED_RESHARD_AXES = {
+    "all-gather": ("dp_shard", "tp", "sp", "cp"),
+    "all-to-all": ("sp", "tp"),
+    "collective-permute": ("cp", "pp"),
+}
 
 
 def path_of(key_path) -> str:
@@ -63,7 +95,10 @@ def _axes_size(mesh: Mesh, axes: Sequence[str]) -> int:
     return size
 
 
-def _spec_used_axes(spec: P) -> set:
+def spec_used_axes(spec: P) -> set:
+    """Mesh axes a PartitionSpec actually shards over (flattened through
+    tuple entries). Empty set = fully replicated — the predicate graftcheck
+    G201 applies to every prepared param/moment leaf."""
     used = set()
     for entry in spec:
         if entry is None:
@@ -73,6 +108,9 @@ def _spec_used_axes(spec: P) -> set:
         else:
             used.update(entry)
     return used
+
+
+_spec_used_axes = spec_used_axes
 
 
 def _norm_spec(spec: P) -> P:

@@ -106,6 +106,17 @@ class ParallelismConfig:
         return tuple(n for n in ("cp", "sp") if self.axis_sizes[n] > 1)
 
     @property
+    def dcn_axis_names(self) -> tuple[str, ...]:
+        """Axes placed on the slow inter-slice DCN fabric: ``dp_replicate``
+        when :attr:`hybrid_dcn_replicate` maps it across slices, else
+        nothing (a single-slice mesh is all-ICI). graftcheck G204 flags
+        trip-weighted collectives that cross these axes inside while-loop
+        bodies — per-layer DCN traffic is the multi-slice scaling killer."""
+        if self.hybrid_dcn_replicate and self.dp_replicate_size > 1:
+            return ("dp_replicate",)
+        return ()
+
+    @property
     def data_parallel_size(self) -> int:
         return self.dp_replicate_size * self.dp_shard_size
 

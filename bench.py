@@ -657,6 +657,18 @@ if __name__ == "__main__":
         from accelerate_tpu.analysis.__main__ import main as static_main
 
         sys.exit(static_main([a for a in sys.argv[1:] if a != "--static-gate"]))
+    if "--sharding-gate" in sys.argv:
+        # graftcheck Level 3: static SPMD sharding & HBM audit — replicated
+        # state, implicit reshards, per-program HBM budgets, DCN loop
+        # collectives, missed donations (G201-G205) against
+        # runs/sharding_baseline.json (docs/static_analysis.md)
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from accelerate_tpu.analysis.__main__ import main as static_main
+
+        sys.exit(static_main(
+            ["--level", "sharding"]
+            + [a for a in sys.argv[1:] if a != "--sharding-gate"]
+        ))
     if "--continuous-gate" in sys.argv:
         # continuous-batching gate: mixed-length/mixed-budget workload must
         # reach >= 1.3x static-mode goodput with TTFT p99 no worse, <= 2

@@ -45,6 +45,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))) 
 from accelerate_tpu.analysis.lowering import (  # noqa: E402
     compile_and_extract_spmd,
     ici_bytes_per_chip,
+    memory_table,
     parse_collectives,
 )
 
@@ -271,10 +272,9 @@ def run_decode(args):
         (param_bytes / n) / (chip["hbm_bw"] * HBM_EFF),
     )
 
-    mem = results["decode"]["compiled"].memory_analysis()
-    hbm_live = int(getattr(mem, "argument_size_in_bytes", 0)) + int(
-        getattr(mem, "temp_size_in_bytes", 0)
-    )
+    # shared per-buffer accounting with graftcheck G203 (one size table —
+    # the bench report and the static budget gate can never disagree)
+    hbm_live = memory_table(results["decode"]["compiled"])["hbm_live"]
 
     # reference anchor: GPT-J-6B fp16, 0.05 s/token on 2 GPUs (BASELINE.md)
     ref_s_tok = 0.05
@@ -468,17 +468,10 @@ def main():
     recompute_fraction = POLICY_RECOMPUTE.get(args.remat, 0.85)
     actual_flops_chip = useful_flops_chip * (3.0 + recompute_fraction) / 3.0
 
-    mem = compiled.memory_analysis()
-    mem_bytes = {
-        k: int(getattr(mem, k))
-        for k in ("argument_size_in_bytes", "output_size_in_bytes",
-                  "temp_size_in_bytes", "generated_code_size_in_bytes")
-        if hasattr(mem, k)
-    }
-    # arguments and donated outputs alias; live ≈ args + temps
-    hbm_live = mem_bytes.get("argument_size_in_bytes", 0) + mem_bytes.get(
-        "temp_size_in_bytes", 0
-    )
+    # shared per-buffer accounting with graftcheck G203: arguments and
+    # donated outputs alias, so live ≈ args + temps (memory_table docs)
+    mem_bytes = memory_table(compiled)
+    hbm_live = mem_bytes.pop("hbm_live")
 
     ici_bytes = ici_bytes_per_chip(collectives)
 

@@ -1,0 +1,397 @@
+"""graftcheck Level 3 (sharding & HBM audit) — rule fixtures + regression.
+
+Every rule has a failing fixture and a passing/waived fixture, mirroring
+tests/test_static_analysis.py. Rule functions are pure (facts in, findings
+out), so the fixtures are synthetic leaves / synthetic HLO text — no
+compiles. The compile-heavy whole-repo run is slow-marked, same as Level
+1's CLI regression; the runtime-vs-static KV drift test builds one real
+paged engine (trace only, nothing executes).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from accelerate_tpu.analysis import RULES, Finding
+from accelerate_tpu.analysis.lowering import (
+    atomic_write_json,
+    groups_mesh_axes,
+    iter_collectives,
+    memory_table,
+    parse_replica_groups,
+)
+from accelerate_tpu.analysis.sharding import (
+    HBM_TOLERANCE,
+    StateLeaf,
+    apply_waivers,
+    build_engine_sharded,
+    check_dcn_loops,
+    check_missed_donation,
+    check_replication,
+    check_reshards,
+    compare_hbm,
+    make_sharding_baseline,
+    static_kv_bytes,
+)
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _leaf(kind="moment", path="mu/layers/mlp/up", shape=(256, 64),
+          axes=(), dtype=np.float32):
+    size = int(np.prod(shape))
+    return StateLeaf(kind=kind, path=path, shape=shape, size=size,
+                     nbytes=size * np.dtype(dtype).itemsize,
+                     axes=frozenset(axes))
+
+
+# ---------------------------------------------------------- replica groups
+def test_parse_replica_groups_explicit():
+    groups = parse_replica_groups("... replica_groups={{0,1},{2,3}}, ...", 4)
+    assert groups == [[0, 1], [2, 3]]
+
+
+def test_parse_replica_groups_iota():
+    groups = parse_replica_groups("... replica_groups=[2,4]<=[8], ...", 8)
+    assert groups == [[0, 1, 2, 3], [4, 5, 6, 7]]
+
+
+def test_parse_replica_groups_iota_transposed():
+    # ids laid over a (2,4) mesh then transposed: groups pair device ids
+    # that differ in the MAJOR (first) mesh coordinate
+    groups = parse_replica_groups("... replica_groups=[4,2]<=[2,4]T(1,0), ...", 8)
+    assert groups == [[0, 4], [1, 5], [2, 6], [3, 7]]
+
+
+def test_parse_replica_groups_source_target_pairs():
+    groups = parse_replica_groups(
+        "... source_target_pairs={{0,1},{1,0}}, ...", 2)
+    assert groups == [[0, 1], [1, 0]]
+    assert parse_replica_groups("no groups here", 8) is None
+
+
+def test_groups_mesh_axes():
+    # (dp_replicate=2, dp_shard=4) mesh, id = r*4 + s
+    coords = {r * 4 + s: (r, s) for r in range(2) for s in range(4)}
+    names = ("dp_replicate", "dp_shard")
+    assert groups_mesh_axes([[0, 1, 2, 3]], names, coords) == {"dp_shard"}
+    assert groups_mesh_axes([[0, 4]], names, coords) == {"dp_replicate"}
+    assert groups_mesh_axes([[0, 5]], names, coords) == {"dp_replicate",
+                                                         "dp_shard"}
+    # unknown ids (fixture bigger than mesh) and singleton groups: no axes
+    assert groups_mesh_axes([[40, 41]], names, coords) == set()
+    assert groups_mesh_axes([[2]], names, coords) == set()
+    assert groups_mesh_axes(None, names, coords) == set()
+
+
+# --------------------------------------------------------------- G201
+def test_g201_replicated_moment_flags():
+    leaves = [
+        _leaf(axes=("dp_shard",)),                      # properly sharded
+        _leaf(path="nu/layers/mlp/up"),                  # replicated, big
+    ]
+    findings = check_replication("train.fsdp8/fused_train_step",
+                                 "accelerate_tpu/accelerator.py",
+                                 leaves, frozenset({"dp_shard"}))
+    assert [f.code for f in findings] == ["G201"]
+    assert "nu/layers/mlp/up" in findings[0].message
+    assert findings[0].program == "train.fsdp8/fused_train_step"
+
+
+def test_g201_small_or_claimless_passes():
+    small = _leaf(shape=(64,), path="norm/scale")  # under MIN_SHARDED_SIZE
+    big_replicated = _leaf()
+    # tiny leaves stay replicated by design
+    assert check_replication("p", "s", [small], frozenset({"dp_shard"})) == []
+    # a config that claims nothing (pure DP) may replicate everything
+    assert check_replication("p", "s", [big_replicated], frozenset()) == []
+
+
+# --------------------------------------------------------------- G202
+# (2, 4) mesh used by the G204 fixtures too: id = major * 4 + minor
+_COORDS_2x4 = {r * 4 + s: (r, s) for r in range(2) for s in range(4)}
+
+
+def _instr(op, groups, multiplier=1, nbytes=4096, operand="copy.1"):
+    return dict(op=op, dtype="bf16", bytes=nbytes, group=len(groups[0]),
+                groups=groups, multiplier=multiplier, comp="main",
+                result="c.1", operand=operand, op_name="", source="x.py:1")
+
+
+def test_g202_undeclared_permute_flags():
+    names = ("dp_shard", "tp")
+    coords = {i: (i // 2, i % 2) for i in range(8)}
+    instrs = [_instr("collective-permute", [[0, 2], [2, 4]])]  # varies dp_shard
+    findings = check_reshards("train.tp2/fused_train_step", "src.py",
+                              instrs, names, coords)
+    assert [f.code for f in findings] == ["G202"]
+    assert "dp_shard" in findings[0].message
+    assert "copy.1" in findings[0].message  # source tensor reported
+
+
+def test_g202_declared_gather_passes():
+    names = ("dp_shard", "tp")
+    coords = {i: (i // 2, i % 2) for i in range(8)}
+    # all-gather over dp_shard (fsdp storage->use) and a2a over tp
+    # (Megatron-SP seq<->heads) are both implied by the declared specs
+    instrs = [
+        _instr("all-gather", [[0, 2, 4, 6]]),
+        _instr("all-to-all", [[0, 1]]),
+        _instr("all-reduce", [[0, 2], [1, 3]]),  # reductions never flag
+    ]
+    assert check_reshards("p", "s", instrs, names, coords) == []
+
+
+def test_g202_waiver_silences_with_reason():
+    names = ("dp_shard", "tp")
+    coords = {i: (i // 2, i % 2) for i in range(8)}
+    instrs = [_instr("collective-permute", [[0, 2]])]
+    findings = check_reshards("train.tp2/fused_train_step", "src.py",
+                              instrs, names, coords)
+    assert findings
+    baseline = {"waivers": {"G202": {
+        r"train\.tp2/.*collective-permute": "declared-gather decomposition",
+    }}}
+    kept, waived = apply_waivers(findings, baseline)
+    assert kept == [] and waived == 1
+    # a waiver for the wrong rule code does not leak across codes
+    kept, waived = apply_waivers(findings, {"waivers": {"G204": {".*": "x"}}})
+    assert len(kept) == 1 and waived == 0
+
+
+# --------------------------------------------------------------- G203
+_BASE = {"hbm": {"train.fsdp8/fused_train_step": {"hbm_live": 1_000_000}},
+         "tolerance": 0.02}
+
+
+def test_g203_growth_fails_shrinkage_passes():
+    grown = {"train.fsdp8/fused_train_step": {"hbm_live": 1_100_000}}
+    findings = compare_hbm(grown, _BASE, "runs/sharding_baseline.json")
+    assert [f.code for f in findings] == ["G203"]
+    assert "1100000" in findings[0].message
+
+    shrunk = {"train.fsdp8/fused_train_step": {"hbm_live": 700_000}}
+    assert compare_hbm(shrunk, _BASE) == []
+    within = {"train.fsdp8/fused_train_step": {"hbm_live": 1_015_000}}
+    assert compare_hbm(within, _BASE) == []
+
+
+def test_g203_missing_budget_flags():
+    findings = compare_hbm({"train.new/fused_train_step": {"hbm_live": 1}},
+                           _BASE)
+    assert [f.code for f in findings] == ["G203"]
+    assert "update-baseline" in findings[0].message
+
+
+def test_rebaseline_preserves_waivers_and_tolerance():
+    prev = {"hbm": {}, "tolerance": 0.05,
+            "waivers": {"G204": {"pat": "reason"}}}
+    new = make_sharding_baseline(
+        {"p": {"hbm_live": 3, "generated_code_size_in_bytes": 9}}, prev)
+    assert new["tolerance"] == 0.05
+    assert new["waivers"] == {"G204": {"pat": "reason"}}
+    # code size jitters across XLA builds — never part of the budget
+    assert "generated_code_size_in_bytes" not in new["hbm"]["p"]
+    assert make_sharding_baseline({})["tolerance"] == HBM_TOLERANCE
+
+
+# --------------------------------------------------------------- G204
+# The satellite fixture: a synthetic DCN all-gather inside a scan — the
+# while body gathers over groups that pair devices across dp_replicate
+# (iota-T groups on a (2,4) mesh), trip count 4.
+_HLO_DCN_LOOP = """\
+HloModule jit_f, num_partitions=8
+
+cond {
+  c = s32[] constant(4)
+  gte = s32[] get-tuple-element(p), index=0
+  ROOT lt = pred[] compare(gte, c), direction=LT
+}
+
+body {
+  ag = f32[16,8]{1,0} all-gather(x), channel_id=1, replica_groups=[4,2]<=[2,4]T(1,0), dimensions={0}
+}
+
+ENTRY main {
+  w = (s32[]) while(t), condition=cond, body=body
+}
+"""
+
+
+def test_g204_dcn_gather_in_scan_flags():
+    instrs, notes = iter_collectives(_HLO_DCN_LOOP, 8)
+    assert notes == []
+    assert len(instrs) == 1 and instrs[0]["multiplier"] == 4
+    names = ("dp_replicate", "dp_shard")
+    findings = check_dcn_loops("train.hsdp2x4/fused_train_step", "src.py",
+                               instrs, names, _COORDS_2x4,
+                               dcn_axes=("dp_replicate",))
+    assert [f.code for f in findings] == ["G204"]
+    assert "x4 per step" in findings[0].message
+
+
+def test_g204_ici_or_no_dcn_axis_passes():
+    instrs, _ = iter_collectives(_HLO_DCN_LOOP, 8)
+    names = ("dp_replicate", "dp_shard")
+    # no declared DCN axis (single-slice mesh): nothing to check
+    assert check_dcn_loops("p", "s", instrs, names, _COORDS_2x4, ()) == []
+    # same op OUTSIDE the loop (multiplier 1) never flags
+    flat = [dict(instrs[0], multiplier=1)]
+    assert check_dcn_loops("p", "s", flat, names, _COORDS_2x4,
+                           ("dp_replicate",)) == []
+    # ICI-only groups inside the loop are fine
+    ici = [dict(instrs[0], groups=[[0, 1], [2, 3]])]
+    assert check_dcn_loops("p", "s", ici, names, _COORDS_2x4,
+                           ("dp_replicate",)) == []
+
+
+# --------------------------------------------------------------- G205
+def _avals(*shapes, dtype=np.float32):
+    return [jax.ShapeDtypeStruct(s, dtype) for s in shapes]
+
+
+def test_g205_undonated_dead_buffer_flags():
+    big = (512, 512)  # 1 MiB f32
+    in_leaves = _avals(big, (4,))
+    out_leaves = [(big, "float32"), ((4,), "float32")]
+    findings = check_missed_donation(
+        "train.dp8/fused_train_step", "src.py", in_leaves, out_leaves,
+        donated=set(), donated_optional=set(), nondonate_ok=set(),
+        aliased={},
+    )
+    assert [f.code for f in findings] == ["G205"]
+    assert "flat input 0" in findings[0].message
+
+
+def test_g205_donated_waived_or_small_passes():
+    big = (512, 512)
+    in_leaves = _avals(big)
+    out_leaves = [(big, "float32")]
+    # donated (aliased) input: clean
+    assert check_missed_donation("p", "s", in_leaves, out_leaves,
+                                 {0}, set(), set(), {0: 0}) == []
+    # deliberate non-donation (the engine's carried ring): clean
+    assert check_missed_donation("p", "s", in_leaves, out_leaves,
+                                 set(), set(), {0}, {}) == []
+    # no matching output shape — the buffer stays live, donation impossible
+    assert check_missed_donation("p", "s", in_leaves, [((7,), "float32")],
+                                 set(), set(), set(), {}) == []
+    # under the 1 MiB floor: bookkeeping, not HBM
+    assert check_missed_donation("p", "s", _avals((8, 8)), [((8, 8), "float32")],
+                                 set(), set(), set(), {}) == []
+    # an output already claimed by a donated twin is not double-counted
+    two_in = _avals(big, big)
+    assert check_missed_donation("p", "s", two_in, out_leaves,
+                                 {0}, set(), set(), {0: 0}) == []
+
+
+# ------------------------------------------------- atomic baseline commits
+def test_atomic_write_json(tmp_path):
+    path = tmp_path / "sub" / "baseline.json"
+    atomic_write_json({"a": 1}, str(path))
+    assert json.loads(path.read_text()) == {"a": 1}
+    # a failed serialization must leave the committed file untouched and
+    # no temp debris behind
+    with pytest.raises(TypeError):
+        atomic_write_json({"bad": object()}, str(path))
+    assert json.loads(path.read_text()) == {"a": 1}
+    assert os.listdir(path.parent) == ["baseline.json"]
+
+
+def test_update_baseline_sink_defers_writes(tmp_path):
+    # the __main__ contract: levels append (path, baseline) to the sink and
+    # nothing touches disk until every level succeeded
+    from accelerate_tpu.analysis.sharding import run_sharding_checks
+
+    path = tmp_path / "sharding_baseline.json"
+    sink = []
+    findings = run_sharding_checks(
+        baseline_path=str(path), update_baseline=True, groups=[],
+        baseline_sink=sink,
+    )
+    assert findings == []
+    assert not path.exists()
+    assert len(sink) == 1 and sink[0][0] == str(path)
+    atomic_write_json(sink[0][1], sink[0][0])
+    assert "hbm" in json.loads(path.read_text())
+
+
+def test_finding_json_carries_program_field():
+    f = Finding("G203", "runs/sharding_baseline.json", 1, "m", program="a/b")
+    import dataclasses
+
+    d = dataclasses.asdict(f)
+    assert d["program"] == "a/b"
+    # Level 3 codes are registered for the CLI summary footer
+    assert {"G201", "G202", "G203", "G204", "G205"} <= set(RULES)
+
+
+def test_memory_table_fake_compiled():
+    class Mem:
+        argument_size_in_bytes = 10
+        temp_size_in_bytes = 5
+        output_size_in_bytes = 3
+
+    class Compiled:
+        def memory_analysis(self):
+            return Mem()
+
+    t = memory_table(Compiled())
+    assert t["hbm_live"] == 15
+    assert t["output_size_in_bytes"] == 3
+    assert "generated_code_size_in_bytes" not in t
+
+
+def test_dcn_axis_names_property():
+    from accelerate_tpu.parallelism_config import ParallelismConfig
+
+    hsdp = ParallelismConfig(dp_replicate_size=2, dp_shard_size=4,
+                             hybrid_dcn_replicate=True)
+    assert hsdp.dcn_axis_names == ("dp_replicate",)
+    flat = ParallelismConfig(dp_replicate_size=8)
+    assert flat.dcn_axis_names == ()
+
+
+# --------------------------------------------- runtime-vs-static KV drift
+# Documented tolerance: the static estimate reads the decode program's
+# donated cache avals; the runtime gauge multiplies the pool geometry. Both
+# describe the same arrays, so they must agree within 2% (the slack covers
+# per-block quantization-scale padding, not structural drift).
+_KV_DRIFT_TOLERANCE = 0.02
+
+
+def test_paged_kv_gauge_matches_static_estimate():
+    from accelerate_tpu.engine import ContinuousBatchingEngine
+    from accelerate_tpu.models.llama import LlamaConfig, create_llama
+
+    model = create_llama(LlamaConfig.tiny(num_hidden_layers=2), seed=0)
+    engine = ContinuousBatchingEngine(
+        model, slots=2, max_len=16, readback_lag=0,
+        kv_cache="paged", block_size=4,
+    )
+    gauge = engine.stats()["kv"]["hbm_bytes"]
+
+    records = build_engine_sharded(["engine.paged"])
+    decode = next(r for r in records if r.name == "engine.paged/decode_step")
+    static = static_kv_bytes(decode)
+    assert static > 0
+    assert abs(static - gauge) <= _KV_DRIFT_TOLERANCE * gauge, (
+        f"static {static}B vs runtime gauge {gauge}B drifted past "
+        f"{_KV_DRIFT_TOLERANCE:.0%}"
+    )
+
+
+# ------------------------------------------------------------- regression
+@pytest.mark.slow
+def test_cli_sharding_level_exits_zero(capsys):
+    """The merged tree passes its own sharding/HBM budgets (train variants
+    + engine backends vs runs/sharding_baseline.json, waivers applied)."""
+    from accelerate_tpu.analysis.__main__ import main
+
+    assert main(["--level", "sharding", "--root", _ROOT]) == 0, (
+        capsys.readouterr().out
+    )

@@ -483,7 +483,8 @@ def test_finding_render():
     f = Finding("G101", "accelerate_tpu/engine.py", 7, "boom")
     assert f.render() == "accelerate_tpu/engine.py:7: G101 boom"
     assert set(RULES) == {
-        "G001", "G002", "G003", "G004", "G101", "G102", "G103", "G104", "G105"
+        "G001", "G002", "G003", "G004", "G101", "G102", "G103", "G104", "G105",
+        "G201", "G202", "G203", "G204", "G205",
     }
 
 
