@@ -9,7 +9,7 @@
 PY := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu python
 PY_SLOW := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu RUN_SLOW=1 python
 
-.PHONY: test test_all test_core test_data test_parallel test_models test_cli test_big_modeling test-fault test-serving check-static check-sharding bench bench-telemetry bench-serving bench-continuous bench-recovery bench-kv bench-spec
+.PHONY: test test_all test_core test_data test_parallel test_models test_cli test_big_modeling test-fault test-serving check-static check-sharding bench bench-telemetry bench-serving bench-continuous bench-recovery bench-kv bench-spec bench-fleet
 
 test: check-static
 	$(PY) -m pytest tests/ -q
@@ -39,7 +39,7 @@ check-sharding:
 # replication kill points, consensus, replica restore, topology-change
 # resume — fast, on 8 virtual CPU devices (XLA_FLAGS from tests/conftest.py)
 test-fault:
-	$(PY) -m pytest tests/test_durability.py tests/test_checkpointing.py tests/test_serving.py tests/test_elastic.py -q
+	$(PY) -m pytest tests/test_durability.py tests/test_checkpointing.py tests/test_serving.py tests/test_elastic.py tests/test_fleet.py -q
 
 # resilient-serving suite (docs/serving.md): dynamic batching, deadline
 # shedding, backpressure, retry/backoff, circuit breaker, SIGTERM drain,
@@ -47,7 +47,7 @@ test-fault:
 # batching engine (slot lifecycle, seed reproducibility, mode parity) and
 # the paged KV-cache subsystem (block tables, COW prefix cache, int8 KV)
 test-serving:
-	$(PY) -m pytest tests/test_serving.py tests/test_engine.py tests/test_kvcache.py tests/test_spec.py -q
+	$(PY) -m pytest tests/test_serving.py tests/test_engine.py tests/test_kvcache.py tests/test_spec.py tests/test_fleet.py -q
 
 test_all:
 	$(PY_SLOW) -m pytest tests/test_state.py tests/test_operations.py tests/test_parallelism_config.py tests/test_accelerator.py tests/test_checkpointing.py tests/test_tracking.py tests/test_data_loader.py tests/test_data_shard_info.py tests/test_misc.py tests/test_cli.py tests/test_big_modeling.py tests/test_losses.py tests/test_flatbuf.py tests/test_local_sgd.py tests/test_api_parity.py tests/test_hlo_analysis.py tests/test_tracking_fakes.py tests/test_powersgd.py -q
@@ -108,6 +108,13 @@ bench-kv:
 # and match dense-vs-paged spec outputs bitwise (docs/serving.md)
 bench-spec:
 	$(PY) benchmarks/continuous_bench.py --spec-gate
+
+# fleet gate: replica-ramp goodput scaling (>= 1.8x goodput at 2x
+# replicas), kill-one-replica-mid-batch chaos with zero dropped futures
+# (typed errors or completions only, failover observed), and TTFT p99 no
+# worse with prefill/decode disaggregation than without (docs/serving.md)
+bench-fleet:
+	$(PY) benchmarks/serving_bench.py --fleet-gate
 
 # elastic-recovery gate: MTTR per restore path (local / replica / elastic
 # reshard, restart-to-resumed wall clock) + consensus/replication must stay

@@ -66,6 +66,14 @@ _LAZY = {
     "CircuitOpenError": ("utils.fault", "CircuitOpenError"),
     "ServerDrainingError": ("utils.fault", "ServerDrainingError"),
     "BatchExecutionError": ("utils.fault", "BatchExecutionError"),
+    "ReplicaDeadError": ("utils.fault", "ReplicaDeadError"),
+    "NoHealthyReplicaError": ("utils.fault", "NoHealthyReplicaError"),
+    "FailoverExhaustedError": ("utils.fault", "FailoverExhaustedError"),
+    "FleetRouter": ("fleet", "FleetRouter"),
+    "FleetMetrics": ("fleet", "FleetMetrics"),
+    "FleetConfig": ("utils.dataclasses", "FleetConfig"),
+    "FleetMembership": ("elastic", "FleetMembership"),
+    "RemotePrefill": ("engine", "RemotePrefill"),
     "BarrierTimeoutError": ("utils.fault", "BarrierTimeoutError"),
     "LocalSGD": ("local_sgd", "LocalSGD"),
     "GeneralTracker": ("tracking", "GeneralTracker"),

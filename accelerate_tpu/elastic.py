@@ -82,6 +82,7 @@ __all__ = [
     "build_topology",
     "manifest_topology",
     "remap_sampler_state",
+    "FleetMembership",
 ]
 
 
@@ -713,3 +714,91 @@ def remap_sampler_state(sd: dict, old_total_batch: int, new_total_batch: int) ->
             )
         out[key] = new
     return out
+
+
+# ----------------------------------------------------------- fleet membership
+class FleetMembership:
+    """Replica membership ledger for the serving fleet — the serving twin of
+    the training gang's consensus machinery above. Where training elasticity
+    is collective (every host votes, then everyone moves together), serving
+    elasticity is incremental: replicas join (supervisor relaunch =
+    scale-up) and leave (graceful drain = zero-drop scale-down) one at a
+    time while the router keeps placing traffic. This ledger makes those
+    transitions *observable state changes* instead of silent router-internal
+    mutations:
+
+    * a monotonic ``version`` bumped by every join/leave, so pollers can
+      cheaply detect "the fleet changed since I last looked";
+    * :meth:`snapshot` — a consistent ``{version, members}`` view;
+    * :meth:`subscribe` — callbacks ``(event, replica_id, version)`` fired
+      on every transition (``event`` is ``"join"`` or ``"leave"``), invoked
+      OUTSIDE the ledger lock so a slow subscriber can never wedge a
+      scale-down.
+
+    Thread-safe; used by :class:`accelerate_tpu.fleet.FleetRouter` for every
+    replica lifecycle change (docs/serving.md "Multi-replica fleet").
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._members: dict = {}
+        self._version = 0
+        self._subscribers: list = []
+
+    @property
+    def version(self) -> int:
+        with self._lock:
+            return self._version
+
+    def join(self, replica_id: str, meta: Optional[dict] = None) -> int:
+        """Record a replica joining (idempotent per id — rejoining updates
+        its metadata); returns the new membership version."""
+        with self._lock:
+            self._members[replica_id] = dict(meta or {})
+            self._version += 1
+            version = self._version
+            subscribers = list(self._subscribers)
+        self._notify(subscribers, "join", replica_id, version)
+        return version
+
+    def leave(self, replica_id: str) -> int:
+        """Record a replica leaving (idempotent — a double leave does not
+        bump the version); returns the membership version."""
+        with self._lock:
+            if replica_id not in self._members:
+                return self._version
+            del self._members[replica_id]
+            self._version += 1
+            version = self._version
+            subscribers = list(self._subscribers)
+        self._notify(subscribers, "leave", replica_id, version)
+        return version
+
+    def members(self) -> dict:
+        """Current ``{replica_id: metadata}`` membership view."""
+        with self._lock:
+            return {k: dict(v) for k, v in sorted(self._members.items())}
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "version": self._version,
+                "members": {k: dict(v) for k, v in self._members.items()},
+            }
+
+    def subscribe(self, callback) -> None:
+        """Register ``callback(event, replica_id, version)`` for future
+        membership transitions."""
+        with self._lock:
+            self._subscribers.append(callback)
+
+    @staticmethod
+    def _notify(subscribers, event: str, replica_id: str, version: int) -> None:
+        for cb in subscribers:
+            try:
+                cb(event, replica_id, version)
+            except Exception as exc:  # noqa: BLE001 — observers never wedge lifecycle
+                logger.warning(
+                    "fleet membership subscriber failed on %s(%s): %s: %s",
+                    event, replica_id, type(exc).__name__, exc,
+                )

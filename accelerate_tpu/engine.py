@@ -76,7 +76,7 @@ from .utils.fault import EngineCapacityError, EngineInvariantError
 
 logger = get_logger(__name__)
 
-__all__ = ["ContinuousBatchingEngine", "SlotOccupant"]
+__all__ = ["ContinuousBatchingEngine", "SlotOccupant", "RemotePrefill"]
 
 
 # ------------------------------------------------------------------ occupants
@@ -114,6 +114,37 @@ class SlotOccupant:
         out[: len(self.prompt)] = self.prompt
         out[len(self.prompt) : len(self.prompt) + len(self.tokens)] = self.tokens
         return out
+
+
+@dataclass
+class RemotePrefill:
+    """A prompt forward computed OFF the decode loop (prefill/decode
+    disaggregation): the bucketed prefill's KV window, first sampled token,
+    and advanced PRNG key, ready for :meth:`ContinuousBatchingEngine
+    .insert_prefilled` to scatter into an arena slot with a cheap
+    commit-only program. Produced by :meth:`ContinuousBatchingEngine
+    .prefill_remote` — safe to call from dedicated prefill worker threads
+    because it touches no arena or slot state. The split is bitwise
+    equivalent to :meth:`~ContinuousBatchingEngine.insert`: same forward,
+    same key discipline, same first-token sample."""
+
+    prompt: np.ndarray  # (prompt_len,) int32, UNpadded
+    max_new_tokens: int
+    temperature: float
+    top_k: Optional[int]
+    top_p: Optional[float]
+    eos_token_id: Optional[int]
+    pad_token_id: Optional[int]
+    seed: int
+    cache: Any  # the forward's max_len-wide KV window (device pytree)
+    t0: Any  # first sampled token (device scalar)
+    next_key: Any  # advanced per-slot PRNG key data (device)
+    # structural compatibility stamp: a RemotePrefill may only be committed
+    # into an engine with the same model config, prompt bucket, and arena
+    # length it was computed against (failover recomputes instead)
+    engine_config: Any = None
+    prompt_bucket: int = 0
+    max_len: int = 0
 
 
 def _filter_logits(logits, temp, top_k, top_p):
@@ -299,6 +330,15 @@ class ContinuousBatchingEngine:
         self._decode_jit = jax.jit(self._decode_impl, donate_argnums=(0,))
         self._prefill_jit = jax.jit(self._prefill_impl, donate_argnums=(0,))
         self._verify_jit = jax.jit(self._verify_impl, donate_argnums=(0,))
+        # prefill/decode disaggregation split (docs/serving.md fleet
+        # section): the forward half is UNdonated and arena-free so
+        # dedicated prefill worker threads can run it concurrently with the
+        # decode loop; the commit half donates the arena like every other
+        # arena program. Neither compiles unless prefill_remote is used.
+        self._prefill_fwd_jit = jax.jit(self._prefill_forward_impl)
+        self._prefill_commit_jit = jax.jit(
+            self._prefill_commit_impl, donate_argnums=(0,)
+        )
 
         self._occupants: List[Optional[SlotOccupant]] = [None] * slots
         self._free: List[int] = list(range(slots))
@@ -309,6 +349,7 @@ class ContinuousBatchingEngine:
         self._ring: collections.deque = collections.deque()
         self._tick = 0
         self.inserted = 0
+        self.remote_prefills = 0
         self.steps = 0
         self.retired = 0
         # distinct (program, operand-shape) signatures actually dispatched —
@@ -534,6 +575,50 @@ class ContinuousBatchingEngine:
         }
         return new_donated, new_carried, t0, done0
 
+    def _prefill_forward_impl(self, params, prompt, length, key_data, temp, top_k, top_p):
+        # the arena-free half of _prefill_impl: same bucketed forward, same
+        # key split, same first-token sample — so prefill_remote +
+        # insert_prefilled is bitwise identical to a plain insert. Nothing
+        # here reads or writes slot state, which is what makes it safe off
+        # the single-controller decode thread.
+        logits, new_cache = self._prefill_at_fn(
+            self.config, params, prompt, self.max_len, (length - 1)[None]
+        )
+        keys = jax.random.split(jax.random.wrap_key_data(key_data), 2)
+        t0 = _sample_rows(logits, keys[1:2], temp[None], top_k[None], top_p[None])[0]
+        return new_cache, t0, jax.random.key_data(keys[0])
+
+    def _prefill_commit_impl(
+        self, donated, carried, new_cache, t0, next_key, slot, length,
+        temp, top_k, top_p, eos, pad, budget, table_row,
+    ):
+        # the arena half of _prefill_impl: scatter the precomputed KV
+        # window and install the slot's carried state. done0 is recomputed
+        # here (not in the forward) so a degradation-clamped budget at
+        # commit time behaves exactly like a plain insert with that budget.
+        hit_eos = (eos >= 0) & (t0 == eos)
+        budget_left = budget - 1
+        done0 = hit_eos | (budget_left <= 0)
+        cache = self._backend.prefill_write(
+            donated["cache"], new_cache, slot, table_row
+        )
+        new_donated = {
+            "cache": cache,
+            "pos": donated["pos"].at[slot].set(length),
+            "key": donated["key"].at[slot].set(next_key),
+        }
+        new_carried = {
+            "token": carried["token"].at[slot].set(t0),
+            "done": carried["done"].at[slot].set(done0),
+            "budget": carried["budget"].at[slot].set(budget_left),
+            "temp": carried["temp"].at[slot].set(temp),
+            "top_k": carried["top_k"].at[slot].set(top_k),
+            "top_p": carried["top_p"].at[slot].set(top_p),
+            "eos": carried["eos"].at[slot].set(eos),
+            "pad": carried["pad"].at[slot].set(pad),
+        }
+        return new_donated, new_carried, t0, done0
+
     def _record(self, name: str, sig: tuple) -> None:
         self._programs.setdefault(name, set()).add(sig)
 
@@ -633,6 +718,121 @@ class ContinuousBatchingEngine:
         occ = SlotOccupant(
             slot=slot, tag=tag, prompt=prompt, budget=max_new_tokens,
             pad_id=pad_id, eos_id=eos_token_id, inserted_s=self._clock(),
+        )
+        self._occupants[slot] = occ
+        self.inserted += 1
+        self.peak_live = max(self.peak_live, self.live_count())
+        self._tick += 1
+        self._ring.append((self._tick, "prefill", (occ, t0, d0)))
+        return occ
+
+    def prefill_remote(
+        self,
+        prompt,
+        *,
+        max_new_tokens: int,
+        temperature: float = 0.0,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
+        eos_token_id: Optional[int] = None,
+        pad_token_id: Optional[int] = None,
+        seed: int = 0,
+    ) -> RemotePrefill:
+        """Run a request's prompt forward WITHOUT admitting it: the
+        compute-bound half of prefill, safe from any thread (touches no
+        arena, slot, or KV-pool state). The returned :class:`RemotePrefill`
+        is later scattered into a slot by :meth:`insert_prefilled` on the
+        decode thread — a cheap commit-only program, so decode slots stop
+        stalling behind prompt forwards (prefill/decode disaggregation;
+        ``ServingResult.ttft_s`` is the metric)."""
+        prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
+        self.validate_request(len(prompt), max_new_tokens)
+        padded = np.zeros((1, self.prompt_bucket), np.int32)
+        padded[0, : len(prompt)] = prompt
+        kd = jax.random.key_data(jax.random.key(seed))
+        self._record("prefill_forward", (self.prompt_bucket,))
+        new_cache, t0, next_key = self._prefill_fwd_jit(
+            self.model.params, jnp.asarray(padded), jnp.int32(len(prompt)), kd,
+            jnp.float32(temperature),
+            jnp.int32(top_k if top_k is not None else 0),
+            jnp.float32(top_p if top_p is not None else 1.0),
+        )
+        self.remote_prefills += 1
+        return RemotePrefill(
+            prompt=prompt, max_new_tokens=max_new_tokens,
+            temperature=temperature, top_k=top_k, top_p=top_p,
+            eos_token_id=eos_token_id, pad_token_id=pad_token_id, seed=seed,
+            cache=new_cache, t0=t0, next_key=next_key,
+            engine_config=self.config, prompt_bucket=self.prompt_bucket,
+            max_len=self.max_len,
+        )
+
+    def accepts_prefill(self, pre) -> bool:
+        """Whether :meth:`insert_prefilled` can commit this
+        :class:`RemotePrefill`: it must have been computed against the same
+        model config, prompt bucket, and arena length (after a failover to
+        a differently-shaped replica the caller falls back to a plain
+        :meth:`insert`, recomputing the forward)."""
+        return (
+            isinstance(pre, RemotePrefill)
+            and pre.engine_config is self.config
+            and pre.prompt_bucket == self.prompt_bucket
+            and pre.max_len == self.max_len
+        )
+
+    def insert_prefilled(
+        self, pre: RemotePrefill, *, max_new_tokens: Optional[int] = None,
+        tag: Any = None,
+    ) -> SlotOccupant:
+        """Admit a remotely prefilled request into a free slot: scatter its
+        precomputed KV window + first token with the commit-only program
+        (no prompt forward on the decode thread). ``max_new_tokens``
+        overrides (only downward — the degradation ladder clamps budgets at
+        admission) the budget the prefill was computed with; the commit
+        program re-derives done/budget state so the result is bitwise what
+        :meth:`insert` with that budget would have produced."""
+        if not self.accepts_prefill(pre):
+            raise ValueError(
+                "RemotePrefill is not compatible with this engine (model "
+                "config / prompt_bucket / max_len mismatch) — recompute via "
+                "prefill_remote or fall back to insert()"
+            )
+        budget = pre.max_new_tokens if max_new_tokens is None else max_new_tokens
+        if budget > pre.max_new_tokens:
+            raise ValueError(
+                f"insert_prefilled budget ({budget}) cannot exceed the "
+                f"prefill's budget ({pre.max_new_tokens})"
+            )
+        prompt = pre.prompt
+        self.validate_request(len(prompt), budget)
+        if not self._free:
+            raise EngineCapacityError(
+                "no free arena slot (caller must gate on free_slots())"
+            )
+        slot = self._free.pop()
+        try:
+            table_row, _shared = self._backend.acquire(slot, prompt, budget)
+        except BaseException:
+            self._free.append(slot)
+            raise
+        pad_id = (
+            pre.pad_token_id if pre.pad_token_id is not None
+            else (pre.eos_token_id if pre.eos_token_id is not None else 0)
+        )
+        self._record("prefill_commit", ())
+        self._donated, self._carried, t0, d0 = self._prefill_commit_jit(
+            self._donated, self._carried, pre.cache, pre.t0, pre.next_key,
+            jnp.int32(slot), jnp.int32(len(prompt)),
+            jnp.float32(pre.temperature),
+            jnp.int32(pre.top_k if pre.top_k is not None else 0),
+            jnp.float32(pre.top_p if pre.top_p is not None else 1.0),
+            jnp.int32(pre.eos_token_id if pre.eos_token_id is not None else -1),
+            jnp.int32(pad_id), jnp.int32(budget),
+            jnp.asarray(table_row),
+        )
+        occ = SlotOccupant(
+            slot=slot, tag=tag, prompt=prompt, budget=budget,
+            pad_id=pad_id, eos_id=pre.eos_token_id, inserted_s=self._clock(),
         )
         self._occupants[slot] = occ
         self.inserted += 1
@@ -1025,6 +1225,7 @@ class ContinuousBatchingEngine:
             "peak_live": self.peak_live,
             "free": len(self._free),
             "inserted": self.inserted,
+            "remote_prefills": self.remote_prefills,
             "steps": self.steps,
             "retired": self.retired,
             "programs": programs,

@@ -1,0 +1,898 @@
+"""Fault-tolerant multi-replica serving fleet: health-probed routing,
+transparent failover, and zero-drop elastic scale-down (docs/serving.md
+"Multi-replica fleet").
+
+One :class:`~accelerate_tpu.serving.InferenceServer` is one mesh; the
+ROADMAP north star ("heavy traffic from millions of users") needs N of
+them behind a router. :class:`FleetRouter` spreads ``submit()`` across
+replicas and turns every single-replica failure mode the serving layer
+already *types* into something clients never see:
+
+* **Placement** — least-loaded + deadline-aware: each routable replica is
+  scored by its queued + in-flight work weighted by its recent batch-time
+  EWMA (both read from one cheap
+  :meth:`~accelerate_tpu.serving.InferenceServer.health` sample), and the
+  request goes to the minimum. Draining, dead, and breaker-open replicas
+  are never candidates.
+* **Health probes + per-replica breakers** — a prober thread samples every
+  replica's health each ``probe_interval_s``; the router keeps its own
+  per-replica :class:`~accelerate_tpu.serving._CircuitBreaker` (the same
+  three-state machine the server uses internally) over replica-level
+  failures, so a flapping replica is excluded from placement until its
+  reset window passes, then re-admitted via one half-open probe request.
+* **Transparent failover** — a replica death
+  (:class:`~accelerate_tpu.utils.fault.ReplicaDeadError`), drain
+  (:class:`~accelerate_tpu.utils.fault.ServerDrainingError`), or open
+  breaker mid-request resubmits the affected request to a surviving
+  replica. The decision dispatches on the error taxonomy's machine-
+  readable ``retriable``/``replica_id`` attributes — never on message
+  prose. Unplanned failovers spend a fleet-wide **retry budget** (token
+  bucket), so a full outage degrades into typed
+  :class:`~accelerate_tpu.utils.fault.FailoverExhaustedError` responses
+  instead of amplifying into a retry storm; planned drains are exempt
+  (each queued request fails exactly once), which is what makes
+  scale-down zero-drop by construction.
+* **Hedged dispatch** — optionally, a near-deadline request is dispatched
+  to a second replica (first result wins, the loser is cancelled); hedges
+  spend retry-budget tokens too.
+* **Elastic lifecycle** — :meth:`FleetRouter.scale_down` = drain handler →
+  queued work redistributed to survivors (zero drop);
+  :meth:`FleetRouter.add_replica` (or ``auto_respawn`` +
+  ``replica_factory``, the supervisor-relaunch path) = scale-up. Every
+  transition goes through a
+  :class:`~accelerate_tpu.elastic.FleetMembership` ledger so joins/leaves
+  are observable, versioned events.
+* **Prefill/decode disaggregation** — with
+  ``FleetConfig(disaggregate_prefill=True)``, dedicated prefill worker
+  threads run each continuous-mode request's compute-bound prompt forward
+  (:meth:`~accelerate_tpu.engine.ContinuousBatchingEngine.prefill_remote`)
+  off the decode loop and hand the decode replica a precomputed KV window
+  to scatter (``insert_prefilled``, a cheap commit-only program).
+  ``ServingResult.ttft_s`` is the metric: decode slots stop stalling
+  behind prompt forwards.
+
+Fault-injection points (``ACCELERATE_TPU_FAULT_INJECT``): ``fleet_route``
+(placement, before any replica sees the request), ``fleet_failover``
+(a retriable failure is about to be resubmitted), ``fleet_probe`` (the
+prober is about to sample one replica), ``fleet_scale_down`` (a replica is
+about to be drained out of the fleet).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future, InvalidStateError
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from .elastic import FleetMembership
+from .logging import get_logger
+from .serving import InferenceServer, _CircuitBreaker
+from .utils.dataclasses import FleetConfig
+from .utils.fault import (
+    FailoverExhaustedError,
+    NoHealthyReplicaError,
+    RequestDeadlineExceeded,
+    ServerDrainingError,
+    ServingError,
+    fault_point,
+)
+
+logger = get_logger(__name__)
+
+__all__ = ["FleetRouter", "FleetMetrics", "ReplicaHandle"]
+
+
+# --------------------------------------------------------------- retry budget
+class _TokenBucket:
+    """Fleet-wide retry/hedge budget: ``capacity`` tokens refilled at
+    ``refill_per_s``. A failover or hedge that cannot take a token is
+    denied — the storm-control backstop that bounds how much *extra* work
+    an outage can inject into the surviving replicas."""
+
+    def __init__(self, capacity: int, refill_per_s: float, clock: Callable[[], float]):
+        self.capacity = float(capacity)
+        self.refill_per_s = float(refill_per_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._tokens = float(capacity)
+        self._last = clock()
+
+    def _refill_locked(self) -> None:
+        now = self._clock()
+        self._tokens = min(
+            self.capacity, self._tokens + (now - self._last) * self.refill_per_s
+        )
+        self._last = now
+
+    def try_acquire(self, n: float = 1.0) -> bool:
+        with self._lock:
+            self._refill_locked()
+            if self._tokens >= n:
+                self._tokens -= n
+                return True
+            return False
+
+    def available(self) -> float:
+        with self._lock:
+            self._refill_locked()
+            return self._tokens
+
+
+# -------------------------------------------------------------------- metrics
+class FleetMetrics:
+    """Thread-safe fleet counters (monotonic) + gauges; :meth:`snapshot`
+    flattens everything into one ``fleet/...`` dict, the router-level twin
+    of :class:`~accelerate_tpu.serving.ServingMetrics`."""
+
+    _COUNTERS = (
+        "submitted",
+        "completed",
+        "failed",
+        "routed",
+        "rejected_no_replica",
+        "failovers",
+        "redistributed",  # failovers caused by planned drains (scale-down)
+        "failover_denied_budget",
+        "failover_denied_cap",
+        "hedges",
+        "hedge_wins",
+        "probes",
+        "probe_failures",
+        "respawns",
+        "replicas_added",
+        "replicas_removed",
+        "prefills",  # prompt forwards run on dedicated prefill workers
+        "prefill_fallbacks",  # disaggregation unavailable → plain submit
+    )
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts = {name: 0 for name in self._COUNTERS}
+        self._gauges: Dict[str, float] = {
+            "replicas": 0,
+            "routable_replicas": 0,
+            "retry_budget": 0.0,
+        }
+
+    def bump(self, name: str, by: int = 1) -> None:
+        with self._lock:
+            self._counts[name] += by
+
+    def gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = value
+
+    def __getitem__(self, name: str) -> int:
+        with self._lock:
+            return self._counts[name]
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = {f"fleet/{k}": v for k, v in self._counts.items()}
+            out.update({f"fleet/{k}": v for k, v in self._gauges.items()})
+        return out
+
+
+# ------------------------------------------------------------ replica handles
+@dataclass
+class ReplicaHandle:
+    """Router-side record of one replica: the server, the router's breaker
+    over replica-level failures, and load/lifecycle bookkeeping."""
+
+    replica_id: str
+    server: InferenceServer
+    breaker: _CircuitBreaker
+    outstanding: int = 0  # requests routed here and not yet resolved
+    generation: int = 0  # bumped on every supervisor respawn
+    leaving: bool = False  # scale-down in progress; never a candidate
+    last_respawn_s: float = float("-inf")
+    completed: int = 0
+    failed: int = 0
+
+
+@dataclass
+class _FleetRequest:
+    """One request's router-side lifetime (the client holds ``future``)."""
+
+    input_ids: np.ndarray
+    max_new_tokens: Optional[int]
+    deadline: Optional[float]  # absolute, router clock domain
+    temperature: float
+    top_k: Optional[int]
+    top_p: Optional[float]
+    eos_token_id: Optional[int]
+    pad_token_id: Optional[int]
+    seed: int
+    submitted_at: float
+    future: Future = field(default_factory=Future)
+    lock: threading.Lock = field(default_factory=threading.Lock)
+    failovers: int = 0
+    hedged: bool = False
+    # replica ids that FAILED this request (excluded from re-placement
+    # while any alternative exists)
+    tried: set = field(default_factory=set)
+    # pending (handle, inner_future) pairs — losers cancelled on delivery
+    inner: list = field(default_factory=list)
+
+    def submit_kwargs(
+        self, remaining_deadline: Optional[float], arrival_s: Optional[float]
+    ) -> dict:
+        return dict(
+            max_new_tokens=self.max_new_tokens,
+            deadline_s=remaining_deadline,
+            temperature=self.temperature,
+            top_k=self.top_k,
+            top_p=self.top_p,
+            eos_token_id=self.eos_token_id,
+            pad_token_id=self.pad_token_id,
+            seed=self.seed,
+            arrival_s=arrival_s,
+        )
+
+
+# --------------------------------------------------------------------- router
+class FleetRouter:
+    """Spread ``submit()`` across N :class:`~accelerate_tpu.serving
+    .InferenceServer` replicas with health-probed, least-loaded +
+    deadline-aware placement, transparent failover under a fleet-wide
+    retry budget, optional hedged dispatch, and zero-drop elastic
+    scale-down (module docstring; docs/serving.md "Multi-replica fleet").
+
+    Parameters
+    ----------
+    replicas:
+        ``{replica_id: InferenceServer}`` (or a sequence of servers, keyed
+        by each server's own ``replica_id`` when set, else
+        ``replica-0..N-1``). May be empty — add replicas later via
+        :meth:`add_replica`.
+    config:
+        :class:`~accelerate_tpu.utils.dataclasses.FleetConfig`.
+    membership:
+        A shared :class:`~accelerate_tpu.elastic.FleetMembership` ledger
+        (``None`` builds a private one). Every add/remove/respawn goes
+        through it.
+    replica_factory:
+        ``factory(replica_id) -> InferenceServer`` used by ``auto_respawn``
+        to relaunch a replica whose worker died (supervisor-style
+        scale-up) and by :meth:`scale_up`.
+    clock:
+        Monotonic time source (injectable for deterministic tests).
+
+    ``submit()`` always returns a Future (placement, prefill, hedging and
+    failover all complete asynchronously); admission-time failures resolve
+    it with the typed error instead of raising — except structural
+    ``ValueError`` (bad prompt shape), which raises synchronously when
+    placement happens inline.
+    """
+
+    def __init__(
+        self,
+        replicas=None,
+        config: Optional[FleetConfig] = None,
+        *,
+        membership: Optional[FleetMembership] = None,
+        replica_factory: Optional[Callable[[str], InferenceServer]] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.config = config or FleetConfig()
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._handles: Dict[str, ReplicaHandle] = {}
+        self._closed = False
+        self._rr = 0
+        self._replica_factory = replica_factory
+        self._membership = membership if membership is not None else FleetMembership()
+        self.metrics = FleetMetrics()
+        self._budget = _TokenBucket(
+            self.config.retry_budget_capacity,
+            self.config.retry_budget_refill_per_s,
+            clock,
+        )
+        if isinstance(replicas, dict):
+            items = list(replicas.items())
+        elif replicas:
+            # A server that already carries a replica_id keeps it as its
+            # handle key — otherwise results/typed errors would attribute
+            # to a name scale_down()/stats() has never heard of.
+            items = [
+                (getattr(srv, "replica_id", None) or f"replica-{i}", srv)
+                for i, srv in enumerate(replicas)
+            ]
+        else:
+            items = []
+        for replica_id, server in items:
+            self.add_replica(replica_id, server)
+        self._stop = threading.Event()
+        self._prefill_q: "queue.Queue" = queue.Queue()
+        self._prefill_threads: list = []
+        if self.config.disaggregate_prefill:
+            for i in range(self.config.prefill_workers):
+                t = threading.Thread(
+                    target=self._prefill_loop, name=f"fleet-prefill-{i}",
+                    daemon=True,
+                )
+                t.start()
+                self._prefill_threads.append(t)
+        self._prober = threading.Thread(
+            target=self._probe_loop, name="fleet-probe", daemon=True
+        )
+        self._prober.start()
+
+    # ------------------------------------------------------------- lifecycle
+    @property
+    def membership(self) -> FleetMembership:
+        return self._membership
+
+    def replica_ids(self) -> list:
+        with self._lock:
+            return sorted(self._handles)
+
+    def add_replica(self, replica_id: str, server: InferenceServer) -> None:
+        """Register a replica (scale-up). The server is stamped with the
+        ``replica_id`` if it does not already carry one, so its typed
+        errors and results attribute correctly."""
+        if self._closedf():
+            raise ServerDrainingError("fleet router is closed")
+        if getattr(server, "replica_id", None) is None:
+            server.replica_id = replica_id
+        handle = ReplicaHandle(
+            replica_id=replica_id,
+            server=server,
+            breaker=_CircuitBreaker(
+                self.config.breaker_threshold,
+                self.config.breaker_reset_s,
+                self._clock,
+            ),
+        )
+        with self._lock:
+            if replica_id in self._handles:
+                raise ValueError(f"replica {replica_id!r} already registered")
+            self._handles[replica_id] = handle
+        self.metrics.bump("replicas_added")
+        self._membership.join(
+            replica_id,
+            {"mode": server.config.mode, "generation": handle.generation},
+        )
+
+    def scale_up(self, replica_id: str) -> InferenceServer:
+        """Launch a replica via ``replica_factory`` and register it."""
+        if self._replica_factory is None:
+            raise ValueError("scale_up requires a replica_factory")
+        server = self._replica_factory(replica_id)
+        self.add_replica(replica_id, server)
+        return server
+
+    def scale_down(self, replica_id: str, timeout: Optional[float] = None) -> bool:
+        """Elastic scale-down with ZERO dropped work: stop placing onto the
+        replica, record the membership leave, then drain it — in-flight
+        requests finish and reply; queued-but-unbatched requests fail with
+        retriable :class:`~accelerate_tpu.utils.fault.ServerDrainingError`,
+        which the per-request callbacks transparently resubmit to the
+        surviving replicas (exempt from the retry budget: an orderly drain
+        fails each request exactly once). Returns True when the drain
+        finished within ``timeout`` (default ``config.drain_timeout_s``)."""
+        fault_point("fleet_scale_down")
+        with self._lock:
+            handle = self._handles.get(replica_id)
+            if handle is None:
+                raise ValueError(f"unknown replica {replica_id!r}")
+            handle.leaving = True
+        self._membership.leave(replica_id)
+        self.metrics.bump("replicas_removed")
+        ok = handle.server.drain(
+            self.config.drain_timeout_s if timeout is None else timeout
+        )
+        handle.server.close(drain=False)
+        with self._lock:
+            self._handles.pop(replica_id, None)
+        return ok
+
+    def _closedf(self) -> bool:
+        with self._lock:
+            return self._closed
+
+    def close(self, drain: bool = True, timeout: Optional[float] = None) -> None:
+        """Stop routing, stop the prober and prefill workers, and close
+        every replica (draining by default). Idempotent."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            handles = list(self._handles.values())
+        self._stop.set()
+        for _ in self._prefill_threads:
+            self._prefill_q.put(None)
+        for t in self._prefill_threads:
+            t.join(timeout=5.0)
+        self._prober.join(timeout=5.0)
+        for handle in handles:
+            try:
+                handle.server.close(drain=drain, timeout=timeout)
+            except Exception as exc:  # noqa: BLE001 — close every replica regardless
+                logger.warning(
+                    "fleet close: replica %s close failed: %s: %s",
+                    handle.replica_id, type(exc).__name__, exc,
+                )
+            self._membership.leave(handle.replica_id)
+
+    def __enter__(self) -> "FleetRouter":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close()
+
+    # ------------------------------------------------------------- admission
+    def submit(
+        self,
+        input_ids,
+        *,
+        max_new_tokens: Optional[int] = None,
+        deadline_s: Optional[float] = None,
+        temperature: float = 0.0,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
+        eos_token_id: Optional[int] = None,
+        pad_token_id: Optional[int] = None,
+        seed: int = 0,
+    ) -> Future:
+        """Route one request into the fleet; returns a Future resolving to
+        :class:`~accelerate_tpu.serving.ServingResult` (its ``replica_id``
+        names the replica that served it) or raising the typed serving
+        error that ended it. Unlike a single server's ``submit``, placement
+        failures (no healthy replica, every queue full) resolve the Future
+        instead of raising — failover, hedging, and disaggregated prefill
+        all complete asynchronously, so the Future is the one uniform
+        contract."""
+        if self._closedf():
+            raise ServerDrainingError("fleet router is closed")
+        self.metrics.bump("submitted")
+        ids = np.asarray(input_ids, dtype=np.int32)
+        if ids.ndim == 2 and ids.shape[0] == 1:
+            ids = ids[0]
+        if ids.ndim != 1 or ids.shape[0] == 0:
+            raise ValueError(
+                f"input_ids must be a non-empty 1-D prompt, got {ids.shape}"
+            )
+        now = self._clock()
+        if deadline_s is None:
+            deadline_s = self.config.default_deadline_s
+        freq = _FleetRequest(
+            input_ids=ids,
+            max_new_tokens=max_new_tokens,
+            deadline=(now + deadline_s) if deadline_s is not None else None,
+            temperature=temperature,
+            top_k=top_k,
+            top_p=top_p,
+            eos_token_id=eos_token_id,
+            pad_token_id=pad_token_id,
+            seed=seed,
+            submitted_at=now,
+        )
+        try:
+            self._dispatch(freq)
+        except ServingError as exc:
+            if isinstance(exc, NoHealthyReplicaError):
+                self.metrics.bump("rejected_no_replica")
+            if self._finish(freq, exception=exc):
+                self.metrics.bump("failed")
+        return freq.future
+
+    def generate(self, input_ids, *, timeout: Optional[float] = None, **kwargs):
+        """Blocking convenience wrapper: ``submit(...).result().tokens``."""
+        return self.submit(input_ids, **kwargs).result(timeout=timeout).tokens
+
+    # ------------------------------------------------------------- placement
+    def _candidates(self, exclude=frozenset()) -> list:
+        """Routable replicas (with their health samples): not leaving, not
+        draining, worker alive, router breaker not OPEN, replica's own
+        breaker not OPEN, not in ``exclude``."""
+        with self._lock:
+            handles = list(self._handles.values())
+        out = []
+        for h in handles:
+            if h.leaving or h.replica_id in exclude:
+                continue
+            if h.breaker.rejects_admission:
+                continue
+            try:
+                hh = h.server.health()
+            except Exception:  # noqa: BLE001 — an unprobeable replica is unroutable
+                continue
+            if hh["draining"] or not hh["worker_alive"]:
+                continue
+            if hh["breaker_state"] == _CircuitBreaker.OPEN:
+                continue
+            out.append((h, hh))
+        return out
+
+    def _score(self, handle: ReplicaHandle, health: dict) -> float:
+        """Estimated completion cost: outstanding work × recent batch-time
+        EWMA. With no deadline this still orders by load (the EWMA floor
+        keeps the product monotonic in load)."""
+        load = max(handle.outstanding, health["queue_depth"] + health["inflight"])
+        return (load + 1) * max(health["batch_ewma_s"], 1e-4)
+
+    def _order(self, cands: list, freq: _FleetRequest) -> list:
+        if self.config.placement == "round_robin":
+            with self._lock:
+                self._rr += 1
+                rot = self._rr % len(cands)
+            return cands[rot:] + cands[:rot]
+        return sorted(cands, key=lambda ch: self._score(ch[0], ch[1]))
+
+    def _dispatch(self, freq: _FleetRequest) -> None:
+        """Place (or re-place, on failover) one request. Synchronous
+        admission rejections walk down the candidate order — spillover is
+        routing, not retry, so it spends no budget; it is bounded by the
+        candidate count. Raises a typed ServingError when nobody admits."""
+        fault_point("fleet_route")
+        now = self._clock()
+        if freq.deadline is not None and now >= freq.deadline:
+            raise RequestDeadlineExceeded(
+                f"deadline passed {now - freq.deadline:.3f}s ago before "
+                "placement"
+            )
+        cands = self._candidates(exclude=freq.tried)
+        if not cands and freq.tried:
+            # every survivor already failed this request once — a replica
+            # may have healed (transient overload); retry the full set
+            # rather than failing work we could still place
+            cands = self._candidates()
+        if not cands:
+            raise NoHealthyReplicaError(
+                "no routable replica (all draining, dead, or breaker-open); "
+                "back off and resubmit"
+            )
+        ordered = self._order(cands, freq)
+        last_exc: Optional[ServingError] = None
+        for i, (handle, health) in enumerate(ordered):
+            try:
+                self._submit_to(handle, freq)
+            except ServingError as exc:
+                last_exc = exc
+                continue
+            if i == 0:
+                self._maybe_hedge(freq, ordered)
+            return
+        raise last_exc if last_exc is not None else NoHealthyReplicaError(
+            "every routable replica refused admission"
+        )
+
+    def _remaining(self, freq: _FleetRequest) -> Optional[float]:
+        if freq.deadline is None:
+            return None
+        return max(1e-3, freq.deadline - self._clock())
+
+    def _arrival(self, freq: _FleetRequest) -> Optional[float]:
+        """Back-date the replica's ``submitted_at`` to the router-side
+        arrival, so latency/TTFT cover prefill hand-off and failover hops —
+        only valid when router and replicas share the monotonic clock
+        domain (always true outside clock-injected tests)."""
+        return freq.submitted_at if self._clock is time.monotonic else None
+
+    def _use_prefill(self, handle: ReplicaHandle) -> bool:
+        if not self.config.disaggregate_prefill:
+            return False
+        eng = getattr(handle.server, "engine", None)
+        return eng is not None and hasattr(eng, "prefill_remote")
+
+    def _submit_to(
+        self, handle: ReplicaHandle, freq: _FleetRequest, hedge: bool = False
+    ) -> None:
+        if self._use_prefill(handle) and not hedge:
+            with self._lock:
+                handle.outstanding += 1
+            self._prefill_q.put((freq, handle))
+            return
+        inner = handle.server.submit(
+            freq.input_ids,
+            **freq.submit_kwargs(self._remaining(freq), self._arrival(freq)),
+        )
+        self._track(freq, handle, inner, hedge=hedge)
+
+    def _track(
+        self, freq: _FleetRequest, handle: ReplicaHandle, inner: Future,
+        hedge: bool = False,
+    ) -> None:
+        with freq.lock:
+            freq.inner.append((handle, inner))
+        with self._lock:
+            handle.outstanding += 1
+        self.metrics.bump("routed")
+        inner.add_done_callback(
+            lambda f, h=handle, hg=hedge: self._on_inner_done(freq, h, f, hg)
+        )
+
+    def _maybe_hedge(self, freq: _FleetRequest, ordered: list) -> None:
+        """Near-deadline hedged dispatch: when the remaining deadline is
+        under ``hedge_deadline_fraction`` × the primary's estimated
+        completion and a second candidate exists, dispatch there too —
+        first result wins. Spends a retry-budget token so hedging is
+        bounded by the same storm control as failover."""
+        frac = self.config.hedge_deadline_fraction
+        if frac is None or freq.deadline is None or freq.hedged:
+            return
+        if len(ordered) < 2:
+            return
+        remaining = freq.deadline - self._clock()
+        est = self._score(ordered[0][0], ordered[0][1])
+        if remaining >= frac * est:
+            return
+        if not self._budget.try_acquire():
+            return
+        freq.hedged = True
+        handle = ordered[1][0]
+        try:
+            self._submit_to(handle, freq, hedge=True)
+        except ServingError:
+            return  # the primary dispatch stands; hedging is best-effort
+        self.metrics.bump("hedges")
+
+    # -------------------------------------------------------------- failover
+    def _on_inner_done(
+        self, freq: _FleetRequest, handle: ReplicaHandle, fut: Future,
+        hedge: bool = False,
+    ) -> None:
+        with self._lock:
+            handle.outstanding = max(0, handle.outstanding - 1)
+        if fut.cancelled():
+            return  # hedge loser, or client-side cancel
+        exc = fut.exception()
+        if exc is None:
+            handle.breaker.record_success()
+            handle.completed += 1
+            if self._finish(freq, result=fut.result(), winner=fut):
+                self.metrics.bump("completed")
+                if hedge:
+                    self.metrics.bump("hedge_wins")
+            return
+        handle.failed += 1
+        self._handle_replica_failure(freq, handle, exc)
+
+    def _handle_replica_failure(
+        self, freq: _FleetRequest, handle: ReplicaHandle, exc: BaseException
+    ) -> None:
+        """The machine-readable failover decision (never message prose):
+        a retriable typed error from a replica is resubmitted to a
+        survivor, under the per-request cap and — for unplanned failures —
+        the fleet-wide token bucket. Planned drains are budget-exempt so
+        scale-down redistribution can never be starved by outage retries."""
+        if isinstance(exc, ServingError):
+            failed_on = exc.replica_id or handle.replica_id
+            if not isinstance(exc, (ServerDrainingError, RequestDeadlineExceeded)):
+                # drain is lifecycle and deadline is the client's clock —
+                # neither says the replica malfunctioned; everything else
+                # (dead worker, failed batch, open breaker, overload)
+                # counts toward the router's per-replica breaker
+                handle.breaker.record_failure()
+        else:
+            failed_on = handle.replica_id
+            handle.breaker.record_failure()
+        retriable = isinstance(exc, ServingError) and exc.retriable
+        if not retriable or self._closedf():
+            if self._finish(freq, exception=exc):
+                self.metrics.bump("failed")
+            return
+        if freq.future.done():
+            return  # a hedge sibling already delivered
+        planned = isinstance(exc, ServerDrainingError)
+        with freq.lock:
+            freq.tried.add(failed_on)
+            if freq.failovers >= self.config.max_failovers:
+                denied = "cap"
+            elif planned or self._budget.try_acquire():
+                freq.failovers += 1
+                denied = None
+            else:
+                denied = "budget"
+        if denied is not None:
+            self.metrics.bump(f"failover_denied_{denied}")
+            err = FailoverExhaustedError(
+                f"failover denied ({denied}) after {freq.failovers} "
+                f"attempt(s); last error from replica "
+                f"{failed_on!r}: {type(exc).__name__}: {exc}",
+                replica_id=failed_on,
+            )
+            err.__cause__ = exc
+            if self._finish(freq, exception=err):
+                self.metrics.bump("failed")
+            return
+        fault_point("fleet_failover")
+        self.metrics.bump("failovers")
+        if planned:
+            self.metrics.bump("redistributed")
+        try:
+            self._dispatch(freq)
+        except (ServingError, ValueError) as exc2:
+            if isinstance(exc2, ServingError):
+                exc2.__cause__ = exc
+            if self._finish(freq, exception=exc2):
+                self.metrics.bump("failed")
+
+    def _finish(
+        self, freq: _FleetRequest, *, result=None,
+        exception: Optional[BaseException] = None, winner: Optional[Future] = None,
+    ) -> bool:
+        """Resolve the client Future exactly once (race-safe against client
+        cancel and hedge siblings); on delivery, cancel every still-pending
+        inner future so a hedge loser stops consuming replica capacity as
+        soon as it can."""
+        fut = freq.future
+        delivered = False
+        if not fut.done():
+            try:
+                if exception is not None:
+                    fut.set_exception(exception)
+                else:
+                    fut.set_result(result)
+                delivered = True
+            except InvalidStateError:
+                delivered = False
+        if delivered and exception is None:
+            with freq.lock:
+                pending = [f for _h, f in freq.inner if f is not winner]
+            for f in pending:
+                if not f.done():
+                    f.cancel()
+        return delivered
+
+    # -------------------------------------------------- prefill worker threads
+    def _prefill_loop(self) -> None:
+        """Dedicated prefill worker: run the compute-bound prompt forward
+        off the decode loop (``prefill_remote``), then hand the decode
+        replica a precomputed KV window (``submit(prefilled=...)``).
+        Any prefill problem falls back to a plain submit — disaggregation
+        is an optimization, never a new failure mode."""
+        while True:
+            item = self._prefill_q.get()
+            if item is None:
+                return
+            freq, handle = item
+            with self._lock:
+                handle.outstanding = max(0, handle.outstanding - 1)
+            if freq.future.done():
+                continue
+            pre = None
+            eng = getattr(handle.server, "engine", None)
+            if eng is not None and hasattr(eng, "prefill_remote"):
+                budget = (
+                    freq.max_new_tokens
+                    if freq.max_new_tokens is not None
+                    else handle.server.config.default_max_new_tokens
+                )
+                try:
+                    pre = eng.prefill_remote(
+                        freq.input_ids,
+                        max_new_tokens=budget,
+                        temperature=freq.temperature,
+                        top_k=freq.top_k,
+                        top_p=freq.top_p,
+                        eos_token_id=freq.eos_token_id,
+                        pad_token_id=freq.pad_token_id,
+                        seed=freq.seed,
+                    )
+                    self.metrics.bump("prefills")
+                except Exception as exc:  # noqa: BLE001 — fall back to plain submit
+                    pre = None
+                    self.metrics.bump("prefill_fallbacks")
+                    logger.warning(
+                        "remote prefill failed on %s (%s: %s); falling back "
+                        "to in-loop prefill",
+                        handle.replica_id, type(exc).__name__, exc,
+                    )
+            else:
+                self.metrics.bump("prefill_fallbacks")
+            try:
+                inner = handle.server.submit(
+                    freq.input_ids,
+                    prefilled=pre,
+                    **freq.submit_kwargs(
+                        self._remaining(freq), self._arrival(freq)
+                    ),
+                )
+            except ServingError as exc:
+                # the replica started draining (or filled up) between
+                # placement and prefill completion — the drain-during-
+                # failover race; route through the normal failover decision
+                self._handle_replica_failure(freq, handle, exc)
+            except ValueError as exc:
+                if self._finish(freq, exception=exc):
+                    self.metrics.bump("failed")
+            else:
+                self._track(freq, handle, inner)
+
+    # ------------------------------------------------------------ health probes
+    def _probe_loop(self) -> None:
+        while not self._stop.wait(self.config.probe_interval_s):
+            with self._lock:
+                handles = list(self._handles.values())
+            for handle in handles:
+                if handle.leaving:
+                    continue
+                try:
+                    fault_point("fleet_probe")
+                    self.metrics.bump("probes")
+                    health = handle.server.health()
+                    dead = not health["worker_alive"]
+                except Exception:  # noqa: BLE001 — an unprobeable replica is dead
+                    dead = True
+                if dead:
+                    self.metrics.bump("probe_failures")
+                    handle.breaker.record_failure()
+                    if self.config.auto_respawn and self._replica_factory:
+                        self._respawn(handle)
+            self.metrics.gauge("retry_budget", self._budget.available())
+            with self._lock:
+                total = len(self._handles)
+            self.metrics.gauge("replicas", total)
+            self.metrics.gauge("routable_replicas", len(self._candidates()))
+
+    def _respawn(self, handle: ReplicaHandle) -> None:
+        """Supervisor-style scale-up: relaunch a dead replica via the
+        factory (bounded by ``respawn_backoff_s``), swap it into the
+        handle, and bump the membership generation."""
+        now = self._clock()
+        if now - handle.last_respawn_s < self.config.respawn_backoff_s:
+            return
+        handle.last_respawn_s = now
+        try:
+            server = self._replica_factory(handle.replica_id)
+        except Exception as exc:  # noqa: BLE001 — a failed respawn retries next probe
+            logger.warning(
+                "respawn of replica %s failed: %s: %s",
+                handle.replica_id, type(exc).__name__, exc,
+            )
+            return
+        if getattr(server, "replica_id", None) is None:
+            server.replica_id = handle.replica_id
+        old = handle.server
+        with self._lock:
+            handle.server = server
+            handle.generation += 1
+        handle.breaker.record_success()  # fresh replica, fresh breaker state
+        try:
+            old.close(drain=False, timeout=0.0)
+        except Exception:  # noqa: BLE001 — the old worker is already dead
+            pass
+        self.metrics.bump("respawns")
+        self._membership.join(
+            handle.replica_id,
+            {"mode": server.config.mode, "generation": handle.generation},
+        )
+        logger.warning(
+            "replica %s respawned (generation %d)",
+            handle.replica_id, handle.generation,
+        )
+
+    # --------------------------------------------------------------- stats
+    def stats(self) -> dict:
+        """Router + per-replica observability: metrics snapshot, membership
+        snapshot, retry-budget level, and each replica's handle state."""
+        with self._lock:
+            handles = list(self._handles.values())
+        replicas = {}
+        for h in handles:
+            try:
+                health = h.server.health()
+            except Exception:  # noqa: BLE001 — report what is reportable
+                health = {"worker_alive": False}
+            replicas[h.replica_id] = {
+                "outstanding": h.outstanding,
+                "completed": h.completed,
+                "failed": h.failed,
+                "generation": h.generation,
+                "leaving": h.leaving,
+                "breaker_state": h.breaker.state(),
+                "health": health,
+            }
+        return {
+            "replicas": replicas,
+            "metrics": self.metrics.snapshot(),
+            "membership": self._membership.snapshot(),
+            "retry_budget": self._budget.available(),
+        }

@@ -69,6 +69,7 @@ from .utils.fault import (
     PREEMPTION_EXIT_CODE,
     BatchExecutionError,
     CircuitOpenError,
+    ReplicaDeadError,
     RequestDeadlineExceeded,
     ServerDrainingError,
     ServerOverloaded,
@@ -105,6 +106,10 @@ class _Request:
     # token budget after the degradation ladder clamped it (set at dequeue)
     effective_max_new_tokens: int = 0
     degraded: bool = False
+    # continuous mode: a precomputed RemotePrefill (prefill/decode
+    # disaggregation — the fleet's prefill workers ran the prompt forward
+    # already; admission scatters it instead of re-running the forward)
+    prefill: Any = None
 
     def group_key(self) -> tuple:
         """Requests sharing this key can ride one ``generate()`` batch: the
@@ -139,6 +144,9 @@ class ServingResult:
     # so TTFT == latency there; continuous mode records the host clock when
     # the slot's first token popped out of the deferred-readback ring.
     ttft_s: Optional[float] = None
+    # which replica served it (None outside a fleet) — lets clients and the
+    # router attribute latency without guessing
+    replica_id: Optional[str] = None
 
 
 # -------------------------------------------------------------------- metrics
@@ -299,6 +307,13 @@ class InferenceServer:
         ``None`` builds one from the ``engine_*`` config knobs. In
         continuous mode ``generate_fn`` is inert — the engine owns the
         device programs.
+    replica_id:
+        Identity of this server inside a fleet (``None`` standalone).
+        Stamped onto every typed :class:`~accelerate_tpu.utils.fault
+        .ServingError` this server raises and onto every ``ServingResult`` so
+        :class:`~accelerate_tpu.fleet.FleetRouter` can attribute failures
+        and exclude the failed replica during failover without parsing
+        message prose.
     """
 
     def __init__(
@@ -310,9 +325,11 @@ class InferenceServer:
         trackers: Sequence = (),
         clock: Callable[[], float] = time.monotonic,
         engine=None,
+        replica_id: Optional[str] = None,
     ):
         self.model = model
         self.config = config or ServingConfig()
+        self.replica_id = replica_id
         self.trackers = list(trackers)
         self._clock = clock
         self._generate_fn = generate_fn or self._default_generate
@@ -368,6 +385,8 @@ class InferenceServer:
         eos_token_id: Optional[int] = None,
         pad_token_id: Optional[int] = None,
         seed: int = 0,
+        prefilled=None,
+        arrival_s: Optional[float] = None,
     ) -> Future:
         """Admit one request; returns a Future resolving to
         :class:`ServingResult` (or raising the typed serving error that
@@ -389,16 +408,33 @@ class InferenceServer:
         position inside the executed batch, so bitwise reproducibility
         additionally requires the same batch composition. Greedy requests
         (``temperature == 0``) ignore ``seed`` entirely.
+
+        ``prefilled`` (continuous mode, fleet-internal) carries a
+        :class:`~accelerate_tpu.engine.RemotePrefill` computed by a
+        dedicated prefill worker; admission scatters it into a slot with
+        the cheap commit-only program instead of re-running the prompt
+        forward on the decode thread.
+
+        ``arrival_s`` (fleet-internal) back-dates ``submitted_at`` to the
+        request's *original* arrival on this server's clock domain, so
+        latency and TTFT stay honest when a fleet router re-submits the
+        request after a failover or a remote prefill — without it, every
+        hop would reset the clock and under-report client-observed
+        latency. Deadlines are unaffected (``deadline_s`` is always
+        relative to now).
         """
         fault_point("serving_submit")
         if self._closed or self._draining or preemption_requested():
             self.metrics.bump("rejected_draining")
-            raise ServerDrainingError(self._drain_reason())
+            raise ServerDrainingError(
+                self._drain_reason(), replica_id=self.replica_id
+            )
         if self._breaker.rejects_admission:
             self.metrics.bump("rejected_breaker")
             raise CircuitOpenError(
                 "circuit breaker open after repeated batch failures; retry "
-                f"in {self._breaker.seconds_until_probe():.2f}s"
+                f"in {self._breaker.seconds_until_probe():.2f}s",
+                replica_id=self.replica_id,
             )
         ids = np.asarray(input_ids, dtype=np.int32)
         if ids.ndim == 2:
@@ -417,6 +453,11 @@ class InferenceServer:
             self._engine.validate_request(
                 ids.shape[0], max_new_tokens or self.config.default_max_new_tokens
             )
+        if prefilled is not None and self._engine is None:
+            raise ValueError(
+                "prefilled= requires mode='continuous' (no slot engine to "
+                "commit the precomputed prefill into)"
+            )
         now = self._clock()
         if deadline_s is None:
             deadline_s = self.config.default_deadline_s
@@ -430,17 +471,21 @@ class InferenceServer:
             eos_token_id=eos_token_id,
             pad_token_id=pad_token_id,
             seed=seed,
-            submitted_at=now,
+            submitted_at=arrival_s if arrival_s is not None else now,
+            prefill=prefilled,
         )
         with self._wake:
             if self._draining or self._closed:
                 self.metrics.bump("rejected_draining")
-                raise ServerDrainingError(self._drain_reason())
+                raise ServerDrainingError(
+                    self._drain_reason(), replica_id=self.replica_id
+                )
             if len(self._queue) >= self.config.max_queue:
                 self.metrics.bump("rejected_queue_full")
                 raise ServerOverloaded(
                     f"admission queue full ({self.config.max_queue}); apply "
-                    "backpressure and resubmit after backoff"
+                    "backpressure and resubmit after backoff",
+                    replica_id=self.replica_id,
                 )
             self._queue.append(req)
             self.metrics.bump("submitted")
@@ -486,9 +531,51 @@ class InferenceServer:
     def draining(self) -> bool:
         return self._draining or self._closed
 
+    @property
+    def engine(self):
+        """The continuous-mode slot engine (``None`` in static mode). The
+        fleet's prefill workers reach :meth:`~accelerate_tpu.engine
+        .ContinuousBatchingEngine.prefill_remote` through this; everything
+        else on the engine belongs to the serving worker thread."""
+        return self._engine
+
     def queue_depth(self) -> int:
         with self._lock:
             return len(self._queue)
+
+    def health(self) -> dict:
+        """One cheap, lock-light health sample for routers and probers —
+        no device work, no tracker I/O:
+
+        * ``draining`` — admission is (or is about to be) stopped;
+        * ``worker_alive`` — the serving worker thread is running;
+        * ``worker_error`` — exception type name that killed the worker
+          (``None`` while healthy);
+        * ``breaker_state`` — 0 CLOSED / 1 OPEN / 2 HALF_OPEN;
+        * ``queue_depth`` / ``queue_free`` — admission backlog and
+          remaining bounded-queue room;
+        * ``inflight`` — live engine slots (continuous) — static mode
+          reports 0 (in-flight state lives inside the executing batch);
+        * ``batch_ewma_s`` — recent per-batch (static) / per-step
+          (continuous) execution time, the placement cost estimate;
+        * ``mode`` / ``replica_id`` — identity.
+        """
+        depth = self.queue_depth()
+        return {
+            "replica_id": self.replica_id,
+            "mode": self.config.mode,
+            "draining": self.draining or preemption_requested(),
+            "worker_alive": self._worker.is_alive(),
+            "worker_error": (
+                type(self._worker_error).__name__
+                if self._worker_error is not None else None
+            ),
+            "breaker_state": self._breaker.state(),
+            "queue_depth": depth,
+            "queue_free": max(0, self.config.max_queue - depth),
+            "inflight": self._engine.live_count() if self._engine is not None else 0,
+            "batch_ewma_s": self._batch_time_ewma,
+        }
 
     def drain(self, timeout: Optional[float] = None) -> bool:
         """Stop admission, finish the in-flight batch, reject everything
@@ -549,9 +636,10 @@ class InferenceServer:
                 for occ in self._engine.reset():
                     self._resolve(
                         occ.tag.future,
-                        exception=BatchExecutionError(
+                        exception=ReplicaDeadError(
                             "serving worker exited with this request still "
-                            "in a decode slot"
+                            "in a decode slot",
+                            replica_id=self.replica_id,
                         ),
                     )
             self._reject_queued()
@@ -673,20 +761,36 @@ class InferenceServer:
                 self.metrics.bump("degraded")
             try:
                 fault_point("serving_before_batch")
-                eng.insert(
-                    req.input_ids,
-                    max_new_tokens=req.effective_max_new_tokens,
-                    temperature=req.temperature,
-                    top_k=req.top_k,
-                    top_p=req.top_p,
-                    eos_token_id=req.eos_token_id,
-                    pad_token_id=req.pad_token_id,
-                    seed=req.seed,
-                    tag=req,
-                )
+                if (
+                    req.prefill is not None
+                    and req.effective_max_new_tokens <= req.prefill.max_new_tokens
+                    and getattr(eng, "accepts_prefill", lambda _p: False)(req.prefill)
+                ):
+                    # disaggregated path: the prompt forward already ran on
+                    # a prefill worker — scatter it (commit-only program)
+                    eng.insert_prefilled(
+                        req.prefill,
+                        max_new_tokens=req.effective_max_new_tokens,
+                        tag=req,
+                    )
+                else:
+                    eng.insert(
+                        req.input_ids,
+                        max_new_tokens=req.effective_max_new_tokens,
+                        temperature=req.temperature,
+                        top_k=req.top_k,
+                        top_p=req.top_p,
+                        eos_token_id=req.eos_token_id,
+                        pad_token_id=req.pad_token_id,
+                        seed=req.seed,
+                        tag=req,
+                    )
             except BaseException as exc:  # noqa: BLE001 — classified below
                 if isinstance(exc, (KeyboardInterrupt, SystemExit)):
-                    self._fail_batch([req], exc, "worker interrupted mid-insert")
+                    self._fail_batch(
+                        [req], exc, "worker interrupted mid-insert",
+                        err_cls=ReplicaDeadError,
+                    )
                     raise
                 self._engine_failure(exc, also_fail=req)
                 return
@@ -714,7 +818,8 @@ class InferenceServer:
         except BaseException as exc:  # noqa: BLE001 — classified below
             if isinstance(exc, (KeyboardInterrupt, SystemExit)):
                 self._fail_batch(
-                    [o.tag for o in eng.reset()], exc, "worker interrupted mid-step"
+                    [o.tag for o in eng.reset()], exc,
+                    "worker interrupted mid-step", err_cls=ReplicaDeadError,
                 )
                 raise
             self._engine_failure(exc)
@@ -739,7 +844,8 @@ class InferenceServer:
                     req.future,
                     exception=RequestDeadlineExceeded(
                         f"deadline passed {now - req.deadline:.3f}s ago "
-                        "mid-decode — slot freed for queued traffic"
+                        "mid-decode — slot freed for queued traffic",
+                        replica_id=self.replica_id,
                     ),
                 ):
                     self.metrics.bump("shed_deadline")
@@ -763,7 +869,8 @@ class InferenceServer:
                         req.future,
                         exception=RequestDeadlineExceeded(
                             f"decode finished {now - req.deadline:.3f}s past "
-                            "the deadline"
+                            "the deadline",
+                            replica_id=self.replica_id,
                         ),
                     ):
                         self.metrics.bump("completed_late")
@@ -782,6 +889,7 @@ class InferenceServer:
                         batch_size=occupancy,
                         degraded=req.degraded,
                         ttft_s=max(0.0, ttft),
+                        replica_id=self.replica_id,
                     ),
                 )
                 if delivered:
@@ -894,7 +1002,8 @@ class InferenceServer:
             exception=RequestDeadlineExceeded(
                 f"deadline passed {now - req.deadline:.3f}s ago at dequeue "
                 f"(estimated batch time {self._estimated_batch_s():.3f}s) — "
-                "shed instead of wasting a batch slot"
+                "shed instead of wasting a batch slot",
+                replica_id=self.replica_id,
             ),
         )
         if shed:
@@ -1021,7 +1130,10 @@ class InferenceServer:
                 if isinstance(exc, (KeyboardInterrupt, SystemExit)):
                     # the worker is about to die — the in-flight batch must
                     # not leave clients blocked on unresolved futures
-                    self._fail_batch(batch, exc, "worker interrupted mid-batch")
+                    self._fail_batch(
+                        batch, exc, "worker interrupted mid-batch",
+                        err_cls=ReplicaDeadError,
+                    )
                     raise
                 attempt += 1
                 self.metrics.bump("batch_failures")
@@ -1074,7 +1186,8 @@ class InferenceServer:
                         req.future,
                         exception=RequestDeadlineExceeded(
                             f"batch completed {now - req.deadline:.3f}s past "
-                            "the deadline"
+                            "the deadline",
+                            replica_id=self.replica_id,
                         ),
                     )
                     if late:
@@ -1089,6 +1202,7 @@ class InferenceServer:
                         batch_size=len(batch),
                         degraded=req.degraded,
                         ttft_s=latency,  # whole batch materializes at once
+                        replica_id=self.replica_id,
                     ),
                 )
                 if delivered:
@@ -1105,10 +1219,12 @@ class InferenceServer:
             )
 
     def _fail_batch(
-        self, batch: list[_Request], cause: BaseException, reason: str
+        self, batch: list[_Request], cause: BaseException, reason: str,
+        err_cls: type = BatchExecutionError,
     ) -> None:
-        err = BatchExecutionError(
-            f"{reason}: {type(cause).__name__}: {cause}"
+        err = err_cls(
+            f"{reason}: {type(cause).__name__}: {cause}",
+            replica_id=self.replica_id,
         )
         err.__cause__ = cause
         for req in batch:
@@ -1124,7 +1240,8 @@ class InferenceServer:
                 req.future,
                 exception=ServerDrainingError(
                     "server drained before this request was batched — "
-                    "resubmit to another replica"
+                    "resubmit to another replica",
+                    replica_id=self.replica_id,
                 ),
             )
             if rejected:

@@ -565,6 +565,122 @@ class ServingConfig(KwargsHandler):
 
 
 @dataclass
+class FleetConfig(KwargsHandler):
+    """Policy knobs for :class:`accelerate_tpu.fleet.FleetRouter`
+    (docs/serving.md "Multi-replica fleet"). All failover/hedging traffic
+    is bounded — a replica outage must degrade goodput, never amplify it.
+
+    Placement: ``placement`` — ``"least_loaded"`` (default) scores each
+    routable replica by outstanding work (queued + in flight, scaled by
+    its batch-time EWMA when a deadline makes time matter) and takes the
+    minimum; ``"round_robin"`` ignores load. Replicas that are draining,
+    dead, or behind an OPEN router-side breaker are never candidates.
+
+    Health / breakers: a prober thread samples every replica's
+    :meth:`~accelerate_tpu.serving.InferenceServer.health` each
+    ``probe_interval_s``; per-replica circuit breakers (same three-state
+    machine as the server's own) open after ``breaker_threshold``
+    consecutive replica-level failures and re-probe after
+    ``breaker_reset_s``. With ``auto_respawn`` and a ``replica_factory``,
+    a replica whose worker died is relaunched (supervisor-style scale-up)
+    after ``respawn_backoff_s``.
+
+    Failover: a request that fails with a *retriable* typed error
+    (``retriable`` attribute — never message prose) is transparently
+    resubmitted to a surviving replica, at most ``max_failovers`` times
+    per request, spending one token of the fleet-wide retry budget (a
+    token bucket of ``retry_budget_capacity`` refilled at
+    ``retry_budget_refill_per_s``) per unplanned failover. Planned drains
+    (:class:`~accelerate_tpu.utils.fault.ServerDrainingError`, i.e.
+    scale-down redistribution) are exempt from the bucket — an orderly
+    drain fails each queued request exactly once, so zero-drop scale-down
+    never competes with outage retries for budget.
+
+    Hedging: with ``hedge_deadline_fraction`` set, a request whose
+    remaining deadline is below that fraction of its estimated completion
+    time on the chosen replica is dispatched to a second replica as well
+    (first result wins, the loser is cancelled); each hedge also spends a
+    retry-budget token so hedging can never storm.
+
+    Prefill/decode disaggregation: ``disaggregate_prefill`` routes
+    continuous-mode requests through ``prefill_workers`` dedicated worker
+    threads that run the engine's prompt forward
+    (:meth:`~accelerate_tpu.engine.ContinuousBatchingEngine
+    .prefill_remote`) *off* the decode loop, handing the decode replica a
+    precomputed KV window to scatter (``insert_prefilled``). Decode slots
+    stop stalling behind compute-bound prompt forwards;
+    ``ServingResult.ttft_s`` is the metric.
+    """
+
+    placement: str = "least_loaded"
+    probe_interval_s: float = 0.25
+    breaker_threshold: int = 3
+    breaker_reset_s: float = 2.0
+    max_failovers: int = 3
+    retry_budget_capacity: int = 64
+    retry_budget_refill_per_s: float = 16.0
+    hedge_deadline_fraction: Optional[float] = None
+    disaggregate_prefill: bool = False
+    prefill_workers: int = 2
+    auto_respawn: bool = False
+    respawn_backoff_s: float = 0.5
+    drain_timeout_s: float = 30.0
+    default_deadline_s: Optional[float] = None
+
+    def __post_init__(self):
+        if self.placement not in ("least_loaded", "round_robin"):
+            raise ValueError(
+                "placement must be 'least_loaded' or 'round_robin', got "
+                f"{self.placement!r}"
+            )
+        if self.probe_interval_s <= 0:
+            raise ValueError(
+                f"probe_interval_s must be > 0, got {self.probe_interval_s}"
+            )
+        if self.breaker_threshold < 1:
+            raise ValueError(
+                f"breaker_threshold must be >= 1, got {self.breaker_threshold}"
+            )
+        if self.breaker_reset_s <= 0:
+            raise ValueError(
+                f"breaker_reset_s must be > 0, got {self.breaker_reset_s}"
+            )
+        if self.max_failovers < 0:
+            raise ValueError(
+                f"max_failovers must be >= 0, got {self.max_failovers}"
+            )
+        if self.retry_budget_capacity < 0:
+            raise ValueError(
+                "retry_budget_capacity must be >= 0, got "
+                f"{self.retry_budget_capacity}"
+            )
+        if self.retry_budget_refill_per_s < 0:
+            raise ValueError(
+                "retry_budget_refill_per_s must be >= 0, got "
+                f"{self.retry_budget_refill_per_s}"
+            )
+        if self.hedge_deadline_fraction is not None and not (
+            0 < self.hedge_deadline_fraction
+        ):
+            raise ValueError(
+                "hedge_deadline_fraction must be None or > 0, got "
+                f"{self.hedge_deadline_fraction}"
+            )
+        if self.prefill_workers < 1:
+            raise ValueError(
+                f"prefill_workers must be >= 1, got {self.prefill_workers}"
+            )
+        if self.respawn_backoff_s < 0:
+            raise ValueError(
+                f"respawn_backoff_s must be >= 0, got {self.respawn_backoff_s}"
+            )
+        if self.drain_timeout_s < 0:
+            raise ValueError(
+                f"drain_timeout_s must be >= 0, got {self.drain_timeout_s}"
+            )
+
+
+@dataclass
 class FSDPPlugin(KwargsHandler):
     """FSDP strategy knobs mapped to GSPMD equivalents
     (reference FullyShardedDataParallelPlugin, utils/dataclasses.py:1586-2191).

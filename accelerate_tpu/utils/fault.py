@@ -45,6 +45,9 @@ __all__ = [
     "CircuitOpenError",
     "ServerDrainingError",
     "BatchExecutionError",
+    "ReplicaDeadError",
+    "NoHealthyReplicaError",
+    "FailoverExhaustedError",
     "EngineCapacityError",
     "EngineInvariantError",
     "ComponentClosedError",
@@ -124,11 +127,24 @@ class BarrierTimeoutError(RuntimeError):
 # ----------------------------------------------------- serving error taxonomy
 class ServingError(RuntimeError):
     """Base class for :class:`accelerate_tpu.serving.InferenceServer`
-    failures. ``retriable`` tells a client whether backing off and
-    resubmitting can succeed (load/lifecycle conditions) or the request
-    itself is a lost cause (deadline passed, batch permanently failed)."""
+    failures. Two machine-readable attributes form the routing contract
+    consumed by :class:`accelerate_tpu.fleet.FleetRouter` (a router must
+    NEVER string-match error prose):
+
+    * ``retriable`` — whether backing off and resubmitting (possibly to
+      another replica) can succeed: load/lifecycle conditions are
+      retriable, while a passed deadline or a permanently failed batch is
+      a lost cause;
+    * ``replica_id`` — which replica raised it (``None`` when the server
+      was not given an identity), so failover can exclude the failed
+      replica instead of bouncing the request straight back to it.
+    """
 
     retriable: bool = False
+
+    def __init__(self, *args, replica_id: Optional[str] = None):
+        super().__init__(*args)
+        self.replica_id = replica_id
 
 
 class ServerOverloaded(ServingError):
@@ -168,6 +184,37 @@ class BatchExecutionError(ServingError):
     underlying exception."""
 
     retriable = False
+
+
+class ReplicaDeadError(BatchExecutionError):
+    """The replica's serving worker died (SystemExit/KeyboardInterrupt or
+    an unrecoverable loop crash) with this request still in flight. Unlike
+    a plain :class:`BatchExecutionError` the *request* is fine — it was the
+    replica that failed — so the work is retriable on another replica.
+    Subclasses :class:`BatchExecutionError` so pre-fleet callers catching
+    the batch-failure type keep working."""
+
+    retriable = True
+
+
+class NoHealthyReplicaError(ServingError):
+    """The fleet router found no replica able to take this request right
+    now — every replica is draining, dead, breaker-open, or refused
+    admission. Retriable: replicas heal, respawn, and drain queues; back
+    off and resubmit."""
+
+    retriable = True
+
+
+class FailoverExhaustedError(ServingError):
+    """Transparent failover gave up on this request: either its per-request
+    failover cap was reached or the fleet-wide retry budget (token bucket)
+    was empty — the storm-control backstop that keeps a full outage from
+    amplifying into a retry storm. ``__cause__`` carries the last
+    replica-level error. Retriable by the *client* after backoff (the
+    budget refills), but the router itself will not retry further."""
+
+    retriable = True
 
 
 class EngineCapacityError(ServingError):
@@ -219,8 +266,14 @@ def fault_point(name: str) -> None:
     ``before_replica_restore`` — before copying a verified replica back over
     a missing/corrupt local tree); the serving loop at the named moments
     of a batch's lifecycle (``serving_submit``, ``serving_before_batch``,
-    ``serving_after_batch``, ``serving_before_reply``). The env var is read
-    at call time so a test script can arm a point between two saves.
+    ``serving_after_batch``, ``serving_before_reply``); and the fleet
+    router at the named moments of a request's cross-replica lifecycle
+    (``fleet_route`` — placement decision, before any replica sees the
+    request; ``fleet_failover`` — a retriable replica failure is about to
+    be resubmitted to a surviving replica; ``fleet_probe`` — the health
+    prober is about to read one replica's health; ``fleet_scale_down`` —
+    a replica is about to be drained out of the fleet). The env var is
+    read at call time so a test script can arm a point between two saves.
     """
     spec = os.environ.get(FAULT_INJECT_ENV)
     if not spec:

@@ -632,6 +632,15 @@ if __name__ == "__main__":
         from benchmarks.serving_bench import main as serving_main
 
         sys.exit(serving_main(gate=True))
+    if "--fleet-gate" in sys.argv:
+        # fleet gate: replica-ramp goodput scaling (>= 1.8x at 2x replicas),
+        # kill-one-replica-mid-batch chaos with zero dropped futures, and
+        # TTFT p99 no worse with prefill/decode disaggregation
+        # (docs/serving.md acceptance criteria)
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from benchmarks.serving_bench import fleet_main
+
+        sys.exit(fleet_main(gate=True))
     if "--kv-gate" in sys.argv:
         # paged KV-cache gate: >= 4x concurrent slots at fixed pool HBM with
         # bitwise dense parity + <= 2 engine programs, >= 90% shared-prefix

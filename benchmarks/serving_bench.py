@@ -293,7 +293,387 @@ def main(gate: bool = False) -> int:
     return 0 if (ok or not gate) else 1
 
 
+# ===================================================================== fleet
+# Multi-replica fleet bench (PR 10): goodput ramp at 1x/2x/4x replicas, a
+# chaos probe (kill one replica mid-batch under load: zero dropped futures),
+# and TTFT p99 with vs without prefill/decode disaggregation. Reached via
+# ``--fleet`` / ``--fleet-gate`` (also ``bench.py --fleet-gate`` /
+# ``make bench-fleet``).
+
+FLEET_PHASE_S = float(os.environ.get("SB_FLEET_PHASE_S", "1.5"))
+FLEET_OFFERED_X = float(os.environ.get("SB_FLEET_OFFERED_X", "2.5"))
+FLEET_GATE_SCALE = float(os.environ.get("SB_FLEET_GATE_SCALE", "1.8"))
+FLEET_TTFT_TOL = float(os.environ.get("SB_FLEET_TTFT_TOL", "1.10"))
+
+
+class _KillableEngine(_SyntheticEngine):
+    """Synthetic engine whose next batch takes the whole serving worker
+    down with SystemExit — the in-process analogue of SIGKILLing a replica
+    mid-batch (a thread cannot be SIGKILLed individually)."""
+
+    def __init__(self, service_s: float):
+        super().__init__(service_s)
+        self.kill_next = False
+
+    def __call__(self, model, ids, max_new_tokens=4, **kw):
+        if self.kill_next:
+            self.kill_next = False
+            raise SystemExit(1)
+        return super().__call__(model, ids, max_new_tokens=max_new_tokens, **kw)
+
+
+class _SynOccupant:
+    """Slot-occupant stand-in: tag/budget/token bookkeeping plus the two
+    attributes the reply epilogue reads (first_token_s, inserted_s)."""
+
+    def __init__(self, prompt, budget, tag, now):
+        self.prompt = np.asarray(prompt, dtype=np.int32)
+        self.budget = budget
+        self.tag = tag
+        self.tokens = 0
+        self.inserted_s = now
+        self.first_token_s = None
+
+    def output_row(self):
+        new = np.repeat(self.prompt[:1], self.tokens)
+        return np.concatenate([self.prompt, new])
+
+
+class _SynPrefill:
+    def __init__(self, engine, prompt, max_new_tokens):
+        self.engine = engine
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+
+
+class _SyntheticSlotEngine:
+    """Continuous-engine stand-in with explicit prefill/decode costs, so
+    the disaggregation comparison measures *scheduling*, not model math:
+
+    * ``insert`` (the in-loop path) sleeps ``prefill_s`` — the decode loop
+      stalls behind every prompt forward it runs itself;
+    * ``prefill_remote`` sleeps ``prefill_s`` on the *calling* (prefill
+      worker) thread; ``insert_prefilled`` commits in ~zero time — the
+      decode loop only scatters precomputed KV;
+    * ``step`` sleeps ``decode_step_s`` and advances every live slot one
+      token.
+
+    Implements exactly the engine surface InferenceServer's continuous
+    loop drives (insert/step/poll/reset/occupants/cancel/stats/...).
+    Thread-safe where the fleet needs it: prefill workers call
+    ``prefill_remote`` while the serving worker steps."""
+
+    spec = None  # no speculative decoding: the degrade ladder skips us
+
+    def __init__(self, slots=8, prefill_s=0.02, decode_step_s=0.002):
+        import threading
+
+        self.slots = slots
+        self.prefill_s = prefill_s
+        self.decode_step_s = decode_step_s
+        self._lock = threading.Lock()
+        self._live = []
+        self._retired = []
+
+    # --- admission
+    def validate_request(self, prompt_len, max_new_tokens):
+        if prompt_len <= 0 or max_new_tokens <= 0:
+            raise ValueError("empty prompt or budget")
+
+    def can_admit(self, ids, max_new_tokens):
+        return True
+
+    def free_slots(self):
+        with self._lock:
+            return self.slots - len(self._live)
+
+    def live_count(self):
+        with self._lock:
+            return len(self._live)
+
+    def insert(self, prompt, max_new_tokens, tag=None, **kw):
+        time.sleep(self.prefill_s)  # prompt forward runs IN the decode loop
+        now = time.monotonic()
+        occ = _SynOccupant(prompt, max_new_tokens, tag, now)
+        occ.first_token_s = now  # prefill emits the first token
+        with self._lock:
+            self._live.append(occ)
+        return occ
+
+    # --- disaggregated path
+    def prefill_remote(self, prompt, *, max_new_tokens, **kw):
+        time.sleep(self.prefill_s)  # prompt forward on the PREFILL worker
+        return _SynPrefill(self, np.asarray(prompt, np.int32), max_new_tokens)
+
+    def accepts_prefill(self, pre):
+        return isinstance(pre, _SynPrefill) and pre.engine is self
+
+    def insert_prefilled(self, pre, *, max_new_tokens, tag=None):
+        now = time.monotonic()
+        occ = _SynOccupant(pre.prompt, max_new_tokens, tag, now)
+        occ.first_token_s = now  # commit publishes the precomputed token
+        with self._lock:
+            self._live.append(occ)
+        return occ
+
+    # --- decode loop
+    def step(self):
+        time.sleep(self.decode_step_s)
+        with self._lock:
+            still = []
+            for occ in self._live:
+                occ.tokens += 1
+                (self._retired if occ.tokens >= occ.budget else still).append(occ)
+            self._live = still
+
+    def poll(self, force=False):
+        with self._lock:
+            out, self._retired = self._retired, []
+        return out
+
+    def occupants(self):
+        with self._lock:
+            return list(self._live)
+
+    def cancel(self, occ):
+        with self._lock:
+            if occ in self._live:
+                self._live.remove(occ)
+
+    def reset(self):
+        with self._lock:
+            orphans, self._live, self._retired = self._live, [], []
+        return orphans
+
+    def stats(self):
+        with self._lock:
+            return {"slots": self.slots, "live": len(self._live)}
+
+
+def _fleet_imports():
+    from accelerate_tpu.fleet import FleetRouter
+    from accelerate_tpu.serving import InferenceServer
+    from accelerate_tpu.utils.dataclasses import FleetConfig, ServingConfig
+
+    return FleetRouter, InferenceServer, FleetConfig, ServingConfig
+
+
+def _run_fleet_phase(router, name, rate_rps, duration_s, deadline_s=None,
+                     mid_phase=None):
+    """Open-loop arrivals against the router. The router's contract is
+    "always a Future", so admission failures surface on the futures —
+    the gate wants exactly: every future resolves, failures are typed and
+    retriable, nothing is dropped."""
+    from accelerate_tpu.utils.fault import (
+        RequestDeadlineExceeded,
+        ServingError,
+    )
+
+    futures = []
+    start = time.perf_counter()
+    i = 0
+    fired_mid = mid_phase is None
+    while True:
+        now = time.perf_counter()
+        if now - start >= duration_s:
+            break
+        if not fired_mid and now - start >= duration_s / 2:
+            fired_mid = True
+            mid_phase()
+        next_t = start + i / rate_rps
+        if next_t > now:
+            time.sleep(min(next_t - now, 0.01))
+            continue
+        i += 1
+        futures.append(
+            router.submit(PROMPT, max_new_tokens=4, deadline_s=deadline_s)
+        )
+
+    ttfts, latencies = [], []
+    completed = shed = typed_retriable = typed_final = untyped = dropped = 0
+    for f in futures:
+        try:
+            res = f.result(timeout=30)
+            completed += 1
+            latencies.append(res.latency_s)
+            if res.ttft_s is not None:
+                ttfts.append(res.ttft_s)
+        except RequestDeadlineExceeded:
+            shed += 1
+        except ServingError as exc:
+            if exc.retriable:
+                typed_retriable += 1
+            else:
+                typed_final += 1
+        except TimeoutError:
+            dropped += 1  # the zero-drop gate: this must stay 0
+        except Exception:  # noqa: BLE001 — gate counts anything untyped
+            untyped += 1
+    elapsed = time.perf_counter() - start
+    row = {
+        "phase": name,
+        "offered_rps": round(i / elapsed, 1),
+        "goodput_rps": round(completed / elapsed, 1),
+        "shed": shed,
+        "typed_retriable": typed_retriable,
+        "typed_final": typed_final,
+        "untyped_errors": untyped,
+        "dropped_futures": dropped,
+        "p99_s": round(_p(latencies, 0.99), 4) if latencies else None,
+        "ttft_p99_s": round(_p(ttfts, 0.99), 4) if ttfts else None,
+    }
+    print(json.dumps(row), flush=True)
+    return row
+
+
+def _fleet_ramp(n_replicas):
+    """Goodput at fixed offered load (FLEET_OFFERED_X × one replica's
+    capacity) as the fleet scales — the scaling gate compares 2x vs 1x."""
+    FleetRouter, InferenceServer, FleetConfig, ServingConfig = _fleet_imports()
+    capacity = MAX_BATCH / SERVICE_S
+    scfg = ServingConfig(
+        max_queue=256, max_batch_size=MAX_BATCH, batch_window_s=0.001,
+        default_max_new_tokens=4, max_retries=0, drain_timeout_s=10.0,
+    )
+    servers = {
+        f"r{i}": InferenceServer(
+            object(), scfg, generate_fn=_SyntheticEngine(SERVICE_S),
+            replica_id=f"r{i}",
+        )
+        for i in range(n_replicas)
+    }
+    router = FleetRouter(servers, FleetConfig(probe_interval_s=0.1))
+    try:
+        return _run_fleet_phase(
+            router, f"ramp_{n_replicas}x", FLEET_OFFERED_X * capacity,
+            FLEET_PHASE_S, deadline_s=DEADLINE_S,
+        )
+    finally:
+        router.close(drain=False)
+
+
+def _fleet_chaos():
+    """Kill one of three replicas mid-batch at mid-phase under load. The
+    acceptance bar: every submitted future resolves — completed or typed-
+    retriable (and transparently failed over) — with zero drops."""
+    FleetRouter, InferenceServer, FleetConfig, ServingConfig = _fleet_imports()
+    capacity = MAX_BATCH / SERVICE_S
+    scfg = ServingConfig(
+        max_queue=256, max_batch_size=MAX_BATCH, batch_window_s=0.001,
+        default_max_new_tokens=4, max_retries=0, drain_timeout_s=10.0,
+    )
+    engines = [_KillableEngine(SERVICE_S) for _ in range(3)]
+    servers = {
+        f"r{i}": InferenceServer(
+            object(), scfg, generate_fn=engines[i], replica_id=f"r{i}"
+        )
+        for i in range(3)
+    }
+    router = FleetRouter(servers, FleetConfig(probe_interval_s=0.05))
+
+    def kill_one():
+        engines[0].kill_next = True
+
+    try:
+        row = _run_fleet_phase(
+            router, "chaos_kill", 1.5 * capacity, FLEET_PHASE_S,
+            mid_phase=kill_one,
+        )
+        row["failovers"] = router.metrics["failovers"]
+        row["probe_failures"] = router.metrics["probe_failures"]
+        print(json.dumps({"phase": "chaos_kill_router",
+                          "failovers": row["failovers"],
+                          "probe_failures": row["probe_failures"]}), flush=True)
+        return row
+    finally:
+        router.close(drain=False)
+
+
+def _fleet_ttft(disaggregate):
+    """TTFT p99 through a continuous-mode replica under a prompt burst,
+    with and without dedicated prefill workers. Costs are explicit in
+    _SyntheticSlotEngine, so the delta is pure scheduling: in-loop prompt
+    forwards serialize behind each other; remote prefills overlap."""
+    FleetRouter, InferenceServer, FleetConfig, ServingConfig = _fleet_imports()
+    eng = _SyntheticSlotEngine(slots=8, prefill_s=0.02, decode_step_s=0.002)
+    scfg = ServingConfig(
+        mode="continuous", max_queue=256, default_max_new_tokens=4,
+        drain_timeout_s=10.0,
+    )
+    srv = InferenceServer(object(), scfg, engine=eng, replica_id="decode-0")
+    router = FleetRouter(
+        {"decode-0": srv},
+        FleetConfig(
+            probe_interval_s=0.1,
+            disaggregate_prefill=disaggregate,
+            prefill_workers=4,
+        ),
+    )
+    name = "ttft_disagg" if disaggregate else "ttft_plain"
+    try:
+        futs = [router.submit(PROMPT, max_new_tokens=4) for _ in range(48)]
+        ttfts = [f.result(timeout=30).ttft_s for f in futs]
+        row = {
+            "phase": name,
+            "n": len(ttfts),
+            "ttft_p50_s": round(_p(ttfts, 0.50), 4),
+            "ttft_p99_s": round(_p(ttfts, 0.99), 4),
+            "remote_prefills": router.metrics["prefills"],
+        }
+        print(json.dumps(row), flush=True)
+        return row
+    finally:
+        router.close(drain=False)
+
+
+def fleet_main(gate: bool = False) -> int:
+    ramp = {n: _fleet_ramp(n) for n in (1, 2, 4)}
+    chaos = _fleet_chaos()
+    ttft_plain = _fleet_ttft(False)
+    ttft_disagg = _fleet_ttft(True)
+
+    scale_2x = ramp[2]["goodput_rps"] / max(ramp[1]["goodput_rps"], 1e-9)
+    scale_4x = ramp[4]["goodput_rps"] / max(ramp[1]["goodput_rps"], 1e-9)
+    checks = {
+        "goodput_scales_2x": scale_2x >= FLEET_GATE_SCALE,
+        "chaos_zero_dropped": chaos["dropped_futures"] == 0,
+        "chaos_typed_only": chaos["untyped_errors"] == 0
+        and chaos["typed_final"] == 0,
+        "chaos_failed_over": chaos["failovers"] >= 1,
+        "ttft_disagg_no_worse": (
+            ttft_disagg["ttft_p99_s"] <= ttft_plain["ttft_p99_s"] * FLEET_TTFT_TOL
+        ),
+        "ttft_used_remote_prefill": ttft_disagg["remote_prefills"] >= 1,
+        "ramp_zero_dropped": all(
+            r["dropped_futures"] == 0 and r["untyped_errors"] == 0
+            for r in ramp.values()
+        ),
+    }
+    ok = all(checks.values())
+    print(
+        json.dumps(
+            {
+                "metric": "fleet_gate",
+                "goodput_1x": ramp[1]["goodput_rps"],
+                "goodput_2x": ramp[2]["goodput_rps"],
+                "goodput_4x": ramp[4]["goodput_rps"],
+                "scale_2x": round(scale_2x, 2),
+                "scale_4x": round(scale_4x, 2),
+                "scale_threshold": FLEET_GATE_SCALE,
+                "ttft_p99_plain": ttft_plain["ttft_p99_s"],
+                "ttft_p99_disagg": ttft_disagg["ttft_p99_s"],
+                "checks": checks,
+                "pass": ok,
+            }
+        ),
+        flush=True,
+    )
+    return 0 if (ok or not gate) else 1
+
+
 if __name__ == "__main__":
     if "--sigterm-child" in _sys.argv:
         raise SystemExit(_sigterm_child())
+    if "--fleet" in _sys.argv or "--fleet-gate" in _sys.argv:
+        raise SystemExit(fleet_main(gate="--fleet-gate" in _sys.argv))
     raise SystemExit(main(gate="--gate" in _sys.argv))

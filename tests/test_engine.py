@@ -212,6 +212,46 @@ def test_mixed_traffic_compiles_exactly_two_programs(get_engine):
     assert stats["program_count"] <= 2
 
 
+# ---------------------------------------------------- remote-prefill parity
+def test_remote_prefill_bitwise_parity(model, get_engine):
+    """prefill_remote + insert_prefilled must be bitwise identical to a
+    plain insert — same forward, same sample, same key evolution — for
+    greedy AND sampled requests, including a downward budget override at
+    commit time (the disaggregated path docs/serving.md promises)."""
+    eng = get_engine(slots=2, max_len=32, prompt_bucket=8)
+    prompts = _prompts(2, lens=(5, 7), seed=11)
+    cases = [
+        dict(temperature=0.0),
+        dict(temperature=0.8, top_k=40, top_p=0.9, seed=13),
+    ]
+    plain = []
+    for p, kw in zip(prompts, cases):
+        occ = eng.insert(p, max_new_tokens=6, pad_token_id=0, **kw)
+        eng.drain()
+        plain.append(occ.output_row())
+        eng.reset()
+    for p, kw, want in zip(prompts, cases, plain):
+        pre = eng.prefill_remote(p, max_new_tokens=6, pad_token_id=0, **kw)
+        assert eng.accepts_prefill(pre)
+        occ = eng.insert_prefilled(pre, tag="rp")
+        eng.drain()
+        np.testing.assert_array_equal(occ.output_row(), want)
+        eng.reset()
+    # Budget can only be clamped downward at commit; the clamped result
+    # matches a plain insert at the clamped budget, padding and all.
+    p, kw = prompts[0], cases[0]
+    occ = eng.insert(p, max_new_tokens=3, pad_token_id=0, **kw)
+    eng.drain()
+    want3 = occ.output_row()
+    eng.reset()
+    pre = eng.prefill_remote(p, max_new_tokens=6, pad_token_id=0, **kw)
+    with pytest.raises(ValueError):
+        eng.insert_prefilled(pre, max_new_tokens=7)
+    occ = eng.insert_prefilled(pre, max_new_tokens=3)
+    eng.drain()
+    np.testing.assert_array_equal(occ.output_row(), want3)
+
+
 # ------------------------------------------------- static vs continuous parity
 def test_greedy_static_vs_continuous_parity_through_server(model, get_engine):
     """Same greedy requests, both scheduling modes, identical tokens — with

@@ -1,0 +1,512 @@
+"""Multi-replica fleet suite (docs/serving.md "Multi-replica fleet"):
+
+* placement — round-robin spread, least-loaded avoidance of a busy
+  replica, results stamped with the serving ``replica_id``;
+* transparent failover — replica death mid-batch under load (the chaos
+  probe: zero dropped futures), failover-exhaustion is typed and
+  retriable, the retry budget denies unplanned failover storms;
+* zero-drop elastic scale-down — queued work redistributes to survivors
+  (budget-exempt), drain racing an in-progress failover still lands the
+  request, membership records every transition;
+* health probes — a dead replica opens the router-side breaker and (with
+  ``auto_respawn``) is relaunched via the replica factory;
+* hedged dispatch — a near-deadline request dispatched to two replicas
+  resolves from whichever answers first;
+* disaggregation plumbing — engine-less replicas fall back to plain
+  submits (the optimization is never a failure mode); numerical parity of
+  the remote-prefill path itself is covered in tests/test_engine.py.
+
+All tests run on the static-mode server with fake generate_fns — the
+fleet layer is pure host-side control plane, so no compiles are needed.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from accelerate_tpu.elastic import FleetMembership
+from accelerate_tpu.fleet import FleetRouter, _TokenBucket
+from accelerate_tpu.serving import InferenceServer, ServingResult
+from accelerate_tpu.utils.dataclasses import FleetConfig, ServingConfig
+from accelerate_tpu.utils.fault import (
+    FailoverExhaustedError,
+    NoHealthyReplicaError,
+    ReplicaDeadError,
+    ServerDrainingError,
+    ServingError,
+)
+
+
+def echo_gen(delay=0.0, batches=None):
+    def fn(model, ids, max_new_tokens=8, **kw):
+        if batches is not None:
+            batches.append(ids.shape)
+        if delay:
+            time.sleep(delay)
+        new = np.repeat(ids[:, :1], max_new_tokens, axis=1)
+        return np.concatenate([ids, new], axis=1)
+
+    return fn
+
+
+def killable_gen(kill_event, delay=0.005):
+    """Dies with SystemExit (the in-process analogue of SIGKILLing the
+    worker: the serving thread terminates mid-batch) while ``kill_event``
+    is set; serves normally otherwise."""
+
+    def fn(model, ids, max_new_tokens=8, **kw):
+        if kill_event.is_set():
+            kill_event.clear()
+            raise SystemExit(1)
+        if delay:
+            time.sleep(delay)
+        new = np.repeat(ids[:, :1], max_new_tokens, axis=1)
+        return np.concatenate([ids, new], axis=1)
+
+    return fn
+
+
+def wait_until(predicate, timeout=5.0, interval=0.005):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def make_server(gen_fn, replica_id=None, **cfg_kw):
+    cfg_kw.setdefault("max_queue", 128)
+    cfg_kw.setdefault("max_batch_size", 4)
+    cfg_kw.setdefault("batch_window_s", 0.001)
+    cfg_kw.setdefault("max_retries", 0)
+    cfg = ServingConfig(**cfg_kw)
+    return InferenceServer(object(), cfg, generate_fn=gen_fn, replica_id=replica_id)
+
+
+def make_fleet(n=3, gen=None, fleet_kw=None, server_kw=None, **router_kw):
+    gens = gen if isinstance(gen, (list, tuple)) else [gen or echo_gen()] * n
+    servers = {
+        f"r{i}": make_server(gens[i], replica_id=f"r{i}", **(server_kw or {}))
+        for i in range(n)
+    }
+    fcfg = FleetConfig(**{"probe_interval_s": 0.05, **(fleet_kw or {})})
+    return FleetRouter(servers, fcfg, **router_kw)
+
+
+PROMPT = np.arange(1, 6, dtype=np.int32)
+
+
+# ----------------------------------------------------------------- placement
+def test_round_robin_spreads_across_all_replicas():
+    router = make_fleet(3, fleet_kw={"placement": "round_robin"})
+    try:
+        res = [
+            router.submit(PROMPT, max_new_tokens=2).result(10) for _ in range(9)
+        ]
+        assert {r.replica_id for r in res} == {"r0", "r1", "r2"}
+        assert all(isinstance(r, ServingResult) for r in res)
+    finally:
+        router.close()
+
+
+def test_least_loaded_avoids_busy_replica():
+    gate = threading.Event()
+
+    def stuck(model, ids, max_new_tokens=8, **kw):
+        gate.wait(timeout=10)
+        new = np.repeat(ids[:, :1], max_new_tokens, axis=1)
+        return np.concatenate([ids, new], axis=1)
+
+    router = make_fleet(2, gen=[stuck, echo_gen()])
+    try:
+        # occupy r0 with an in-flight batch plus queue depth
+        blocked = [router.submit(PROMPT, max_new_tokens=2) for _ in range(3)]
+        assert wait_until(lambda: router.stats()["replicas"]["r0"]["outstanding"] > 0)
+        fast = [
+            router.submit(PROMPT, max_new_tokens=2).result(10) for _ in range(6)
+        ]
+        assert {r.replica_id for r in fast} == {"r1"}
+        gate.set()
+        assert {f.result(10).replica_id for f in blocked} >= {"r0"}
+    finally:
+        gate.set()
+        router.close()
+
+
+def test_results_and_errors_carry_replica_id():
+    router = make_fleet(1)
+    try:
+        res = router.submit(PROMPT, max_new_tokens=2).result(10)
+        assert res.replica_id == "r0"
+    finally:
+        router.close()
+
+
+def test_empty_fleet_fails_future_typed_retriable():
+    router = FleetRouter({}, FleetConfig(probe_interval_s=0.05))
+    try:
+        fut = router.submit(PROMPT)
+        with pytest.raises(NoHealthyReplicaError) as ei:
+            fut.result(5)
+        assert ei.value.retriable  # caller may back off and resubmit
+        assert router.metrics["rejected_no_replica"] == 1
+    finally:
+        router.close()
+
+
+def test_submit_after_close_raises_draining():
+    router = make_fleet(1)
+    router.close()
+    with pytest.raises(ServerDrainingError):
+        router.submit(PROMPT)
+
+
+def test_submit_validates_prompt_shape():
+    router = make_fleet(1)
+    try:
+        with pytest.raises(ValueError):
+            router.submit(np.zeros((2, 3), np.int32))
+        with pytest.raises(ValueError):
+            router.submit(np.zeros((0,), np.int32))
+    finally:
+        router.close()
+
+
+# ------------------------------------------------------------ chaos failover
+def test_replica_death_mid_batch_drops_nothing():
+    """The acceptance chaos probe: kill one replica mid-batch under load —
+    every future completes (or would fail typed-retriable); nothing hangs,
+    nothing is silently dropped."""
+    kill = threading.Event()
+    router = make_fleet(3, gen=[killable_gen(kill), echo_gen(0.005), echo_gen(0.005)])
+    try:
+        futs = [router.submit(PROMPT, max_new_tokens=2) for _ in range(10)]
+        kill.set()  # next batch on r0 takes the worker down with it
+        futs += [router.submit(PROMPT, max_new_tokens=2) for _ in range(30)]
+        res = [f.result(15) for f in futs]
+        assert len(res) == 40
+        assert router.metrics["failovers"] >= 1
+        # the dead replica's router-side breaker opened; survivors served
+        assert wait_until(lambda: router.metrics["probe_failures"] >= 1)
+        assert {r.replica_id for r in res} <= {"r0", "r1", "r2"}
+    finally:
+        router.close(drain=False)
+
+
+def test_single_replica_death_exhausts_typed_and_retriable():
+    kill = threading.Event()
+    kill.set()
+    router = make_fleet(1, gen=killable_gen(kill))
+    try:
+        fut = router.submit(PROMPT, max_new_tokens=2)
+        with pytest.raises(ServingError) as ei:
+            fut.result(10)
+        # dead worker with no survivor: the router reports a typed,
+        # retriable error chaining the root cause — never a bare hang
+        assert ei.value.retriable
+        assert isinstance(
+            ei.value, (FailoverExhaustedError, NoHealthyReplicaError, ReplicaDeadError)
+        )
+    finally:
+        router.close(drain=False)
+
+
+def test_retry_budget_denies_unplanned_failover_storm():
+    kill = threading.Event()
+    router = make_fleet(
+        1,
+        gen=killable_gen(kill),
+        fleet_kw={"retry_budget_capacity": 1, "retry_budget_refill_per_s": 0.001},
+    )
+    try:
+        while router._budget.try_acquire():
+            pass  # drain the bucket: every unplanned failover must be denied
+        kill.set()
+        fut = router.submit(PROMPT, max_new_tokens=2)
+        with pytest.raises(FailoverExhaustedError) as ei:
+            fut.result(10)
+        assert ei.value.retriable
+        assert isinstance(ei.value.__cause__, ReplicaDeadError)
+        assert ei.value.replica_id == "r0"
+        assert router.metrics["failover_denied_budget"] == 1
+        assert router.metrics["failovers"] == 0
+    finally:
+        router.close(drain=False)
+
+
+# --------------------------------------------------------- elastic scale-down
+def test_scale_down_redistributes_queued_work_zero_drop():
+    gate = threading.Event()
+
+    def slow_r0(model, ids, max_new_tokens=8, **kw):
+        gate.wait(timeout=10)
+        new = np.repeat(ids[:, :1], max_new_tokens, axis=1)
+        return np.concatenate([ids, new], axis=1)
+
+    router = make_fleet(2, gen=[slow_r0, echo_gen(0.002)])
+    try:
+        v0 = router.membership.version
+        # build queue depth on r0 while its first batch is gated in-flight
+        futs = [router.submit(PROMPT, max_new_tokens=2) for _ in range(8)]
+        assert wait_until(lambda: router.stats()["replicas"]["r0"]["outstanding"] >= 1)
+
+        done = threading.Event()
+
+        def drain_out():
+            gate.set()  # let the in-flight batch finish so drain completes
+            router.scale_down("r0")
+            done.set()
+
+        threading.Thread(target=drain_out, daemon=True).start()
+        res = [f.result(15) for f in futs]
+        assert done.wait(10)
+        assert len(res) == 8  # zero dropped futures
+        assert router.replica_ids() == ["r1"]
+        assert router.membership.version > v0
+        assert "r0" not in router.membership.members()
+        # queued requests that failed over were planned-drain redistributions
+        assert router.metrics["redistributed"] == router.metrics["failovers"]
+    finally:
+        gate.set()
+        router.close()
+
+
+def test_scale_down_is_budget_exempt():
+    """Planned drains must redistribute even with an empty retry budget —
+    the zero-drop guarantee cannot be starved by concurrent outage retries."""
+    gate = threading.Event()
+
+    def slow_r0(model, ids, max_new_tokens=8, **kw):
+        gate.wait(timeout=10)
+        new = np.repeat(ids[:, :1], max_new_tokens, axis=1)
+        return np.concatenate([ids, new], axis=1)
+
+    router = make_fleet(
+        2,
+        gen=[slow_r0, echo_gen()],
+        fleet_kw={"retry_budget_capacity": 1, "retry_budget_refill_per_s": 0.001},
+    )
+    try:
+        while router._budget.try_acquire():
+            pass
+        futs = [router.submit(PROMPT, max_new_tokens=2) for _ in range(6)]
+        assert wait_until(lambda: router.stats()["replicas"]["r0"]["outstanding"] >= 1)
+        gate.set()
+        router.scale_down("r0")
+        res = [f.result(15) for f in futs]
+        assert len(res) == 6
+        assert router.metrics["failover_denied_budget"] == 0
+    finally:
+        gate.set()
+        router.close()
+
+
+def test_drain_during_failover_lands_on_survivor():
+    """A replica dies; while its requests fail over, the chosen target
+    starts draining — the failover chain must keep walking to a healthy
+    replica instead of dropping the request."""
+    kill = threading.Event()
+    gate = threading.Event()
+
+    def drain_target(model, ids, max_new_tokens=8, **kw):
+        gate.wait(timeout=10)
+        new = np.repeat(ids[:, :1], max_new_tokens, axis=1)
+        return np.concatenate([ids, new], axis=1)
+
+    router = make_fleet(
+        3, gen=[killable_gen(kill), drain_target, echo_gen(0.002)]
+    )
+    try:
+        # park work on r1 so it has something to drain
+        parked = [router.submit(PROMPT, max_new_tokens=2) for _ in range(4)]
+        assert wait_until(lambda: router.stats()["replicas"]["r1"]["outstanding"] >= 1)
+        kill.set()
+        futs = [router.submit(PROMPT, max_new_tokens=2) for _ in range(12)]
+
+        def drain_r1():
+            gate.set()
+            router.scale_down("r1")
+
+        threading.Thread(target=drain_r1, daemon=True).start()
+        res = [f.result(15) for f in futs] + [f.result(15) for f in parked]
+        assert len(res) == 16  # zero drops across death + concurrent drain
+    finally:
+        gate.set()
+        router.close(drain=False)
+
+
+def test_scale_up_registers_and_serves():
+    calls = []
+
+    def factory(replica_id):
+        calls.append(replica_id)
+        return make_server(echo_gen(), replica_id=replica_id)
+
+    router = make_fleet(
+        1, fleet_kw={"placement": "round_robin"}, replica_factory=factory
+    )
+    try:
+        router.scale_up("r9")
+        assert calls == ["r9"]
+        assert router.replica_ids() == ["r0", "r9"]
+        assert "r9" in router.membership.members()
+        res = [
+            router.submit(PROMPT, max_new_tokens=2).result(10) for _ in range(8)
+        ]
+        assert "r9" in {r.replica_id for r in res}
+    finally:
+        router.close()
+
+
+# ------------------------------------------------------------- health probes
+def test_probe_detects_death_and_auto_respawns():
+    kill = threading.Event()
+
+    def factory(replica_id):
+        return make_server(echo_gen(), replica_id=replica_id)
+
+    router = make_fleet(
+        1,
+        gen=killable_gen(kill),
+        fleet_kw={"auto_respawn": True, "respawn_backoff_s": 0.01,
+                  "probe_interval_s": 0.03},
+        replica_factory=factory,
+    )
+    try:
+        kill.set()
+        with pytest.raises(ServingError):
+            router.submit(PROMPT, max_new_tokens=2).result(10)
+        assert wait_until(lambda: router.metrics["respawns"] >= 1)
+        # the relaunched generation serves traffic again
+        assert wait_until(
+            lambda: router.stats()["replicas"]["r0"]["health"].get("worker_alive"),
+        )
+        res = router.submit(PROMPT, max_new_tokens=2).result(10)
+        assert res.replica_id == "r0"
+        assert router.stats()["replicas"]["r0"]["generation"] >= 1
+        assert router.membership.members()["r0"]["generation"] >= 1
+    finally:
+        router.close(drain=False)
+
+
+# ------------------------------------------------------------ hedged dispatch
+def test_hedged_dispatch_first_result_wins():
+    router = make_fleet(
+        2,
+        gen=[echo_gen(delay=0.6), echo_gen(delay=0.005)],
+        fleet_kw={"hedge_deadline_fraction": 10_000.0},
+    )
+    try:
+        # both replicas idle → placement ties → the slow r0 is primary; the
+        # huge fraction makes any deadlined request hedge-eligible
+        t0 = time.monotonic()
+        res = router.submit(PROMPT, max_new_tokens=2, deadline_s=0.5).result(10)
+        elapsed = time.monotonic() - t0
+        assert router.metrics["hedges"] >= 1
+        # the hedge on fast r1 delivered; nobody waited out r0's 0.6s batch
+        assert res.replica_id == "r1"
+        assert elapsed < 0.55
+        assert wait_until(lambda: router.metrics["hedge_wins"] >= 1)
+    finally:
+        router.close(drain=False)
+
+
+# ------------------------------------------------------- disaggregation edges
+def test_disaggregation_falls_back_without_engine():
+    """Engine-less (static-mode) replicas have nowhere to run a remote
+    prefill: the router routes around the prefill workers entirely and
+    every request still completes — the optimization is never a failure
+    mode."""
+    router = make_fleet(2, fleet_kw={"disaggregate_prefill": True,
+                                     "prefill_workers": 2})
+    try:
+        res = [
+            router.submit(PROMPT, max_new_tokens=2).result(10) for _ in range(8)
+        ]
+        assert len(res) == 8
+        assert router.metrics["prefills"] == 0
+    finally:
+        router.close()
+
+
+# ------------------------------------------------------------- unit coverage
+def test_token_bucket_refills_at_rate():
+    now = {"t": 0.0}
+    bucket = _TokenBucket(2, 1.0, lambda: now["t"])
+    assert bucket.try_acquire() and bucket.try_acquire()
+    assert not bucket.try_acquire()
+    now["t"] = 0.5
+    assert not bucket.try_acquire()  # only half a token back
+    now["t"] = 1.1
+    assert bucket.try_acquire()
+    now["t"] = 100.0
+    assert bucket.available() == pytest.approx(2.0)  # capped at capacity
+
+
+def test_fleet_membership_versions_and_subscribers():
+    m = FleetMembership()
+    events = []
+    m.subscribe(lambda ev, rid, version: events.append((ev, rid, version)))
+    v1 = m.join("a", {"zone": 1})
+    v2 = m.join("b")
+    assert v2 > v1
+    assert m.join("a", {"zone": 2}) > v2  # metadata update bumps the version
+    assert m.members()["a"]["zone"] == 2
+    v_leave = m.leave("a")
+    assert m.leave("a") == v_leave  # double-leave is a no-bump no-op
+    assert set(m.members()) == {"b"}
+    kinds = [e[0] for e in events]
+    assert kinds == ["join", "join", "join", "leave"]
+    assert events[-1][1] == "a"
+
+
+def test_fleet_config_validation():
+    with pytest.raises(ValueError):
+        FleetConfig(placement="random")
+    with pytest.raises(ValueError):
+        FleetConfig(probe_interval_s=0.0)
+    with pytest.raises(ValueError):
+        FleetConfig(max_failovers=-1)
+    with pytest.raises(ValueError):
+        FleetConfig(retry_budget_capacity=-1)
+    with pytest.raises(ValueError):
+        FleetConfig(hedge_deadline_fraction=0.0)
+    with pytest.raises(ValueError):
+        FleetConfig(prefill_workers=0)
+
+
+def test_sequence_replicas_keep_their_own_replica_id():
+    """A server list (not dict) must register pre-named servers under
+    their OWN replica_id — otherwise results/typed errors attribute to a
+    name scale_down()/stats() has never heard of; anonymous servers still
+    get positional replica-N names."""
+    named = make_server(echo_gen(), replica_id="east-1")
+    anon = make_server(echo_gen(), replica_id=None)
+    router = FleetRouter([named, anon], FleetConfig(probe_interval_s=0.05))
+    try:
+        assert set(router.stats()["replicas"]) == {"east-1", "replica-1"}
+        res = [
+            router.submit(PROMPT, max_new_tokens=2).result(10) for _ in range(4)
+        ]
+        assert {r.replica_id for r in res} <= {"east-1", "replica-1"}
+        assert router.scale_down("east-1", timeout=5.0)
+        assert set(router.stats()["replicas"]) == {"replica-1"}
+    finally:
+        router.close()
+
+
+def test_stats_shape_and_metrics_namespace():
+    router = make_fleet(2)
+    try:
+        router.submit(PROMPT, max_new_tokens=2).result(10)
+        st = router.stats()
+        assert set(st) == {"replicas", "metrics", "membership", "retry_budget"}
+        assert set(st["replicas"]) == {"r0", "r1"}
+        assert all(k.startswith("fleet/") for k in st["metrics"])
+        assert st["metrics"]["fleet/completed"] == 1
+        assert st["membership"]["version"] >= 2
+    finally:
+        router.close()

@@ -339,6 +339,116 @@ def test_drain_completes_inflight_and_rejects_queued():
     assert srv.metrics["rejected_draining"] == 4  # 3 queued + 1 post-drain
 
 
+def test_half_open_probe_races_concurrent_submits():
+    """PR-10 satellite: many threads submit the instant the breaker's reset
+    window elapses. Exactly one HALF_OPEN probe batch must execute (batch
+    capped at 1), and whatever the race outcome, every future resolves —
+    a success closes the breaker, admission races get typed retriable
+    CircuitOpenError, and nothing hangs."""
+    state = {"broken": True}
+    executed = []
+
+    def fn(model, ids, max_new_tokens=4, **kw):
+        executed.append(ids.shape[0])
+        if state["broken"]:
+            raise RuntimeError("backend down")
+        return np.concatenate(
+            [ids, np.ones((ids.shape[0], max_new_tokens), np.int32)], axis=1
+        )
+
+    cfg = ServingConfig(
+        max_retries=0, breaker_threshold=1, breaker_reset_s=0.15,
+        max_batch_size=8, batch_window_s=0.01, max_queue=64,
+    )
+    srv = InferenceServer(object(), cfg, generate_fn=fn)
+    try:
+        with pytest.raises(BatchExecutionError):
+            srv.submit(np.arange(3)).result(5)
+        assert wait_until(lambda: srv._breaker.rejects_admission)
+        state["broken"] = False
+        time.sleep(0.2)  # reset window elapsed: next state() is HALF_OPEN
+
+        futures, errors = [], []
+        barrier = threading.Barrier(8)
+
+        def race():
+            barrier.wait(timeout=5)
+            try:
+                futures.append(srv.submit(np.arange(3)))
+            except CircuitOpenError as exc:
+                assert exc.retriable
+                errors.append(exc)
+
+        threads = [threading.Thread(target=race) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=5)
+        # every admitted future resolves; none hang on the probe race
+        for f in futures:
+            assert f.result(5).tokens.shape == (35,)
+        assert len(futures) + len(errors) == 8
+        # the HALF_OPEN probe ran alone: the first post-recovery batch had
+        # exactly one row, regardless of how many submits raced it
+        post_recovery = executed[1:]
+        assert post_recovery and post_recovery[0] == 1
+        assert not srv._breaker.rejects_admission
+        assert srv.submit(np.arange(3)).result(5).tokens.shape == (35,)
+    finally:
+        srv.close()
+
+
+def test_concurrent_submits_during_drain_resolve_typed():
+    """PR-10 satellite: submits racing a drain never hang — each either
+    completes (admitted before the drain flag) or raises/receives a typed
+    retriable ServerDrainingError a fleet router can transparently retry."""
+    gate = threading.Event()
+
+    def gated(model, ids, max_new_tokens=4, **kw):
+        gate.wait(10)
+        return np.concatenate(
+            [ids, np.ones((ids.shape[0], max_new_tokens), np.int32)], axis=1
+        )
+
+    cfg = ServingConfig(max_batch_size=1, batch_window_s=0.0, max_queue=64)
+    srv = InferenceServer(object(), cfg, generate_fn=gated, replica_id="rX")
+    inflight = srv.submit(np.arange(3))
+    assert wait_until(lambda: srv.queue_depth() == 0)
+
+    outcomes = []
+    start = threading.Barrier(9)
+
+    def submitter():
+        start.wait(timeout=5)
+        try:
+            fut = srv.submit(np.arange(3))
+        except ServerDrainingError as exc:
+            outcomes.append(("sync", exc))
+            return
+        try:
+            outcomes.append(("ok", fut.result(10)))
+        except ServerDrainingError as exc:
+            outcomes.append(("async", exc))
+
+    threads = [threading.Thread(target=submitter) for _ in range(8)]
+    for t in threads:
+        t.start()
+    start.wait(timeout=5)
+    time.sleep(0.01)
+    gate.set()
+    assert srv.close(drain=True, timeout=10)
+    for t in threads:
+        t.join(timeout=10)
+
+    assert inflight.result(1).tokens.shape == (35,)
+    assert len(outcomes) == 8  # zero hung/dropped racers
+    for kind, out in outcomes:
+        if kind == "ok":
+            assert out.tokens.shape == (35,)
+        else:
+            assert out.retriable and out.replica_id == "rX"
+
+
 def test_preemption_signal_triggers_drain():
     """The training-side preemption flag (set by SIGTERM via
     install_preemption_handler) also stops serving admission and drains."""
