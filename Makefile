@@ -9,7 +9,7 @@
 PY := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu python
 PY_SLOW := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu RUN_SLOW=1 python
 
-.PHONY: test test_all test_core test_data test_parallel test_models test_cli test_big_modeling test-fault test-serving check-static check-sharding bench bench-telemetry bench-serving bench-continuous bench-recovery bench-kv bench-spec bench-fleet
+.PHONY: test test_all test_core test_data test_parallel test_models test_cli test_big_modeling test-fault test-serving check-static check-sharding check-concurrency bench bench-telemetry bench-serving bench-continuous bench-recovery bench-kv bench-spec bench-fleet
 
 test: check-static
 	$(PY) -m pytest tests/ -q
@@ -20,9 +20,11 @@ test: check-static
 # aliasing, weak types, and program/collective budgets against
 # runs/static_baseline.json; Level 2 is the host AST lint (G101-G105);
 # Level 3 audits SPMD shardings + static HBM budgets (G201-G205) against
-# runs/sharding_baseline.json. check-static runs ALL levels; exit 0 =
-# clean. Re-baseline deliberate program/budget changes atomically
-# (both baselines, write-to-temp + rename) with:
+# runs/sharding_baseline.json; Level 4 audits host concurrency & gang
+# safety (G301-G306) against the lock-order DAG in
+# runs/concurrency_baseline.json. check-static runs ALL levels; exit 0 =
+# clean. Re-baseline deliberate program/budget/lock-order changes
+# atomically (all baselines, write-to-temp + rename) with:
 #   $(PY) -m accelerate_tpu.analysis --update-baseline
 check-static:
 	$(PY) -m accelerate_tpu.analysis
@@ -32,6 +34,14 @@ check-static:
 # parallelism variants (dp8 / fsdp8 / tp2 / hsdp2x4 + engine backends)
 check-sharding:
 	$(PY) -m accelerate_tpu.analysis --level sharding
+
+# Level 4 alone: host concurrency & gang-safety audit of the threaded
+# modules (serving/fleet/elastic/engine/telemetry/state/data_loader) —
+# lock-order DAG vs runs/concurrency_baseline.json, blocking-under-lock,
+# cross-thread races, thread leaks, Future-resolution discipline, and
+# gang-divergent collectives (G301-G306). Pure AST: no jax import, <1s.
+check-concurrency:
+	$(PY) -m accelerate_tpu.analysis --level concurrency
 
 # durable-checkpointing suite (docs/fault_tolerance.md): atomic commit,
 # kill-mid-save rollback via ACCELERATE_TPU_FAULT_INJECT, preemption,

@@ -53,11 +53,33 @@ Level 3 — sharding & memory audit (``analysis/sharding.py``):
 Level 3 waivers live in ``runs/sharding_baseline.json`` (program-level
 findings have no source line to comment on); see docs/static_analysis.md.
 
+Level 4 — host concurrency & gang-safety audit (``analysis/concurrency.py``):
+
+* **G301** lock-order edge (or cycle) outside the baseline DAG committed
+  in ``runs/concurrency_baseline.json`` — a potential deadlock; a runtime
+  witness (``analysis/witness.py``) asserts the order actually observed
+  during the fleet chaos test is a subgraph of the same DAG
+* **G302** blocking operation while holding a lock (timeout-less
+  ``queue.get``/``Future.result``/``join``/foreign ``wait``,
+  ``time.sleep``, blocking device readbacks)
+* **G303** shared attribute written from ≥2 thread entrypoints without a
+  common guarding lock
+* **G304** spawned thread with no join route from its owner's
+  close()/drain()
+* **G305** bare ``set_result``/``set_exception`` outside the race-safe
+  resolver in serving/fleet
+* **G306** collective call reachable only under host-local state (rank
+  test, filesystem check, caught exception) — gang divergence
+
 Waivers are line-scoped comments, same line or the line above:
 ``# graft: sync-ok`` (G101), ``# graft: wait-ok`` (G102),
 ``# graft: raise-ok`` (G103), ``# graft: lock-ok`` (G104),
-``# graft: fault-ok`` (G105), or the universal ``# graft: GXXX-ok``.
-See ``docs/static_analysis.md`` for the full table and re-baselining.
+``# graft: fault-ok`` (G105), ``# graft: block-ok`` (G302),
+``# graft: race-ok`` (G303), ``# graft: thread-ok`` (G304),
+``# graft: resolve-ok`` (G305), ``# graft: gang-ok`` (G306), or the
+universal ``# graft: GXXX-ok``. G301 is edge-scoped — its waivers live
+in the baseline JSON like Level 3's. See ``docs/static_analysis.md``
+for the full table and re-baselining.
 """
 
 from __future__ import annotations
@@ -79,6 +101,12 @@ RULES = {
     "G203": "static per-device HBM footprint grew past the committed budget",
     "G204": "collective crosses the DCN axis inside a while-loop body",
     "G205": "large non-donated input dead after the call (missed donation)",
+    "G301": "lock-order edge/cycle outside the committed DAG (deadlock risk)",
+    "G302": "blocking operation while holding a lock",
+    "G303": "shared attribute written from ≥2 threads without a common lock",
+    "G304": "spawned thread has no join route from its owner's close/drain",
+    "G305": "bare set_result/set_exception outside the race-safe resolver",
+    "G306": "collective reachable only under host-local state (gang split)",
 }
 
 

@@ -1,16 +1,17 @@
 """graftcheck CLI: ``python -m accelerate_tpu.analysis`` (make check-static).
 
-Exit 0 when the tree is clean, 1 when any finding survives. Level `host`
-is pure-AST and fast; levels `program` and `sharding` trace and lower the
-real hot programs, so the environment is pinned to the CPU backend with 8
-virtual devices BEFORE jax loads (the dp=8 train step needs a mesh, and CI
-boxes have no accelerator).
+Exit 0 when the tree is clean, 1 when any finding survives. Levels `host`
+and `concurrency` are pure-AST and fast (no jax import); levels `program`
+and `sharding` trace and lower the real hot programs, so the environment
+is pinned to the CPU backend with 8 virtual devices BEFORE jax loads (the
+dp=8 train step needs a mesh, and CI boxes have no accelerator).
 
-``--update-baseline`` is atomic across BOTH baselines: every level that
+``--update-baseline`` is atomic across ALL baselines: every level that
 ran appends its new baseline to a sink, and the files
-(``runs/static_baseline.json``, ``runs/sharding_baseline.json``) are
-committed together via write-to-temp + rename only after every level
-finished — a crash mid-run leaves both untouched.
+(``runs/static_baseline.json``, ``runs/sharding_baseline.json``,
+``runs/concurrency_baseline.json``) are committed together via
+write-to-temp + rename only after every level finished — a crash mid-run
+leaves all of them untouched.
 """
 
 from __future__ import annotations
@@ -40,11 +41,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         "programs (G001-G004) and host hot paths (G101-G105).",
     )
     parser.add_argument(
-        "--level", choices=("host", "program", "sharding", "all"),
+        "--level", choices=("host", "program", "sharding", "concurrency", "all"),
         default="all",
         help="host = AST lint only (fast); program = lower and inspect the "
         "jitted programs (G001-G004); sharding = SPMD layout + HBM audit "
-        "(G201-G205); all = everything (default)",
+        "(G201-G205); concurrency = host lock/thread/gang audit "
+        "(G301-G306, fast); all = everything (default)",
     )
     parser.add_argument(
         "--root", default=".", help="repo root to lint (default: cwd)"
@@ -58,6 +60,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--sharding-baseline", default=None,
         help="HBM-budget baseline path (default: runs/sharding_baseline.json "
         "under --root)",
+    )
+    parser.add_argument(
+        "--concurrency-baseline", default=None,
+        help="lock-order baseline path (default: "
+        "runs/concurrency_baseline.json under --root)",
     )
     parser.add_argument(
         "--update-baseline", action="store_true",
@@ -79,6 +86,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     sharding_baseline = args.sharding_baseline or os.path.join(
         root, "runs", "sharding_baseline.json"
     )
+    concurrency_baseline = args.concurrency_baseline or os.path.join(
+        root, "runs", "concurrency_baseline.json"
+    )
     findings: List[Finding] = []
     # deferred (path, baseline) writes: every level that ran contributes,
     # then everything is committed atomically below — one flag, whichever
@@ -89,6 +99,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         from .host import lint_package
 
         findings.extend(lint_package(root))
+
+    if args.level in ("concurrency", "all"):
+        from .concurrency import run_concurrency_checks
+
+        findings.extend(run_concurrency_checks(
+            repo_root=root,
+            baseline_path=concurrency_baseline,
+            update_baseline=args.update_baseline,
+            baseline_sink=baseline_sink,
+        ))
 
     if args.level in ("program", "all"):
         _pin_cpu_backend()

@@ -1,0 +1,994 @@
+"""graftcheck Level 4: host concurrency & gang-safety audit (G301–G306).
+
+Levels 1–3 statically guard the *device* side (program counts, donation,
+sharding, HBM); every review-fix cycle so far has been a *host-side
+concurrency* bug: the Future-cancel race and the lock-held tracker flush
+in the serving PR, the gang-wedging host-subset collectives in the
+elastic PR, the hangable ``queue.join()`` in telemetry, the leaked
+``_DevicePrefetcher`` worker. This level gives that bug class the same
+baseline-gated static treatment — pure stdlib (ast + re), no jax import,
+so ``--level concurrency`` runs in well under a second.
+
+Rules over the threaded host stack (``serving.py``, ``fleet.py``,
+``elastic.py``, ``engine.py``, ``telemetry.py``, ``state.py``,
+``data_loader.py``):
+
+* **G301** — lock-order graph. An AST pass collects every lock
+  acquisition (``with self._lock:`` and friends) plus the locks acquired
+  *transitively* by calls made while a lock is held, and builds the
+  inter-module edge set ``held-lock -> acquired-lock``. Any cycle
+  (including a self-edge: re-acquiring a non-reentrant ``Lock`` you
+  already hold) is a potential deadlock and always fails; acyclic edges
+  are committed as a baseline DAG in ``runs/concurrency_baseline.json``
+  so a *new* edge fails the build until reviewed and re-baselined
+  (``--update-baseline``, atomic with the other baselines). A runtime
+  witness (``analysis/witness.py``) records the *observed* acquisition
+  order during the fleet chaos test and asserts it is a subgraph of this
+  DAG, so the static graph cannot silently rot.
+* **G302** — blocking operation while holding a lock: timeout-less
+  ``queue.get()`` / ``Future.result()`` / bare ``.join()`` / foreign
+  ``.wait()``, ``time.sleep``, and blocking device readbacks
+  (``block_until_ready`` / ``device_get`` / ``.item()``) — generalizing
+  G104's "tracker I/O under the server lock" to every lock. Waiting on
+  the *held* condition itself (``self._wake.wait(...)`` inside ``with
+  self._wake:``) releases the lock and is exempt.
+* **G303** — shared-mutable-state race: a ``self.<attr>`` assigned from
+  two or more thread entrypoints (reachability from every
+  ``threading.Thread(target=...)`` / ``add_done_callback`` site through
+  the intra-class call graph, plus the public API surface) without a
+  common guarding lock across all writes. ``__init__`` writes
+  (happens-before thread start) and threading-primitive attributes are
+  exempt. Waive deliberate benign races with ``# graft: race-ok <why>``.
+* **G304** — thread-lifecycle discipline: every ``threading.Thread``
+  spawn must have a join route — the thread object (or the container it
+  is stored in) is ``.join()``-ed somewhere in the module, typically
+  from the owner's ``close()``/``drain()`` — the leak class
+  ``_DevicePrefetcher`` had before PR 5. Deliberate fire-and-forget
+  threads carry ``# graft: thread-ok <why>``.
+* **G305** — future-resolution discipline: every ``set_result`` /
+  ``set_exception`` in ``serving.py`` / ``fleet.py`` must live inside
+  the race-safe resolver (``resolve_future`` / ``_resolve``) so the
+  client-cancel ``InvalidStateError`` race (the PR-4 bug class) cannot
+  reappear at a new call site.
+* **G306** — gang divergence: a collective call (``wait_for_everyone``,
+  ``gather_object``, coordination-service barriers) lexically reachable
+  only under a condition tainted by *host-local* state — a rank test, a
+  local-filesystem check, or a caught exception — wedges the gang when
+  hosts diverge. Deliberate paired-barrier patterns carry
+  ``# graft: gang-ok <why>`` (the collective-verdict rule the elastic
+  review fixes established).
+
+Line-scoped waiver tokens (same syntax as Level 2 — the token on the
+finding line or the line above): ``block-ok`` (G302), ``race-ok``
+(G303), ``thread-ok`` (G304), ``resolve-ok`` (G305), ``gang-ok``
+(G306), or the universal ``gXXX-ok``. G301 findings are edge-scoped,
+not line-scoped, so their waivers live in the baseline JSON
+(``waivers: {"G301": {"<edge regex>": "<reason>"}}``), mirroring
+Level 3.
+
+Known static limits (kept deliberately, like Level 2): attribute writes
+on non-``self`` receivers, properties that take locks, and
+dynamically-built call targets are not modeled; the runtime witness
+exists to catch what the static pass cannot see.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from . import Finding
+from .host import _attr_chain, _dedupe, _walk_py, parse_waivers
+
+BASELINE_PATH = os.path.join("runs", "concurrency_baseline.json")
+
+# The threaded host stack this level audits (ISSUE 11).
+AUDITED_MODULES = (
+    "serving.py",
+    "fleet.py",
+    "elastic.py",
+    "engine.py",
+    "telemetry.py",
+    "state.py",
+    "data_loader.py",
+)
+
+# Modules where G305 applies: the Future-resolution discipline modules.
+RESOLVE_MODULES = {"serving.py", "fleet.py"}
+# Function names allowed to touch set_result/set_exception directly.
+RESOLVER_NAMES = {"_resolve", "resolve_future"}
+
+# Lock-looking attributes (superset of Level 2's server-lock regex:
+# condition variables participate in the lock-order graph too).
+_LOCK_ATTR_RE = re.compile(r"^(_lock|_cond|_wake|_mu)\w*$|^lock$")
+# Receivers that look like queues for the G302 timeout-less .get() check.
+_QUEUEISH_RE = re.compile(r"(^|_)q(ueue)?s?$|queue")
+
+_RULE_TOKENS = {
+    "G302": "block-ok",
+    "G303": "race-ok",
+    "G304": "thread-ok",
+    "G305": "resolve-ok",
+    "G306": "gang-ok",
+}
+
+# Collective entry points whose *reachability* must be gang-consistent.
+COLLECTIVE_CALLS = {
+    "wait_for_everyone",
+    "gather_object",
+    "broadcast_object",
+    "sync_global_devices",
+    "wait_at_barrier",
+    "_coordination_barrier",
+    "_object_allgather",
+    "allgather",
+}
+
+# Host-local state that taints a branch condition for G306.
+_RANK_MARKERS = {
+    "is_main_process",
+    "is_local_main_process",
+    "is_last_process",
+    "process_index",
+    "local_process_index",
+    "rank",
+    "local_rank",
+}
+_FS_MARKERS = {"exists", "isfile", "isdir", "is_file", "is_dir", "lexists"}
+
+
+def _waived(code: str, line: int, waivers: dict) -> bool:
+    allowed = {_RULE_TOKENS.get(code, ""), f"{code.lower()}-ok"}
+    for ln in (line, line - 1):
+        if waivers.get(ln, set()) & allowed:
+            return True
+    return False
+
+
+# ==========================================================================
+# module / class model
+# ==========================================================================
+
+class ClassInfo:
+    def __init__(self, name: str, module: "ModuleInfo", node: ast.ClassDef):
+        self.name = name
+        self.module = module
+        self.node = node
+        self.methods: Dict[str, ast.FunctionDef] = {}
+        # attr -> class name (constructor-call or annotation inference)
+        self.attr_types: Dict[str, str] = {}
+        # Condition-over-lock aliases: acquiring the alias acquires the
+        # aliased lock (self._wake = threading.Condition(self._lock)).
+        self.lock_aliases: Dict[str, str] = {}
+
+    def canon(self, attr: str) -> str:
+        seen = set()
+        while attr in self.lock_aliases and attr not in seen:
+            seen.add(attr)
+            attr = self.lock_aliases[attr]
+        return attr
+
+
+class ModuleInfo:
+    def __init__(self, relpath: str, text: str, tree: ast.Module):
+        self.relpath = relpath
+        self.name = os.path.splitext(os.path.basename(relpath))[0]
+        self.text = text
+        self.tree = tree
+        self.waivers = parse_waivers(text)
+        self.classes: Dict[str, ClassInfo] = {}
+        self.functions: Dict[str, ast.FunctionDef] = {}
+
+
+def _ann_name(ann: Optional[ast.AST]) -> Optional[str]:
+    """Annotation -> class name (Name, string constant, or Optional[X])."""
+    if ann is None:
+        return None
+    if isinstance(ann, ast.Name):
+        return ann.id
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        # forward reference: "FleetRouter" or "queue.Queue"
+        return ann.value.split(".")[-1].strip("'\" ")
+    if isinstance(ann, ast.Attribute):
+        return ann.attr
+    if isinstance(ann, ast.Subscript):  # Optional[X] / list[X] — take X
+        return _ann_name(ann.slice)
+    return None
+
+
+def _is_threading_ctor(node: ast.AST) -> Optional[str]:
+    """threading.Lock()/RLock()/Condition(...)/Event()/Thread(...) -> name."""
+    if isinstance(node, ast.Call):
+        chain = _attr_chain(node.func)
+        if len(chain) == 2 and chain[0] == "threading":
+            return chain[1]
+        if len(chain) == 2 and chain[0] == "queue" and chain[1] == "Queue":
+            return "Queue"
+    return None
+
+
+class Index:
+    """Cross-module symbol table for the audited set."""
+
+    def __init__(self, modules: Sequence[ModuleInfo]):
+        self.modules = {m.name: m for m in modules}
+        self.classes: Dict[str, ClassInfo] = {}
+        for m in modules:
+            for node in m.tree.body:
+                if isinstance(node, ast.FunctionDef):
+                    m.functions[node.name] = node
+                elif isinstance(node, ast.ClassDef):
+                    ci = ClassInfo(node.name, m, node)
+                    for item in node.body:
+                        if isinstance(item, ast.FunctionDef):
+                            ci.methods[item.name] = item
+                        elif isinstance(item, ast.AnnAssign) and isinstance(
+                            item.target, ast.Name
+                        ):
+                            ty = _ann_name(item.annotation)
+                            if ty:
+                                ci.attr_types[item.target.id] = ty
+                    m.classes[node.name] = ci
+                    self.classes.setdefault(node.name, ci)
+        # infer self.<attr> types and lock aliases from method bodies
+        for m in modules:
+            for ci in m.classes.values():
+                for fn in ci.methods.values():
+                    for stmt in ast.walk(fn):
+                        if not isinstance(stmt, ast.Assign):
+                            continue
+                        for tgt in stmt.targets:
+                            chain = _attr_chain(tgt)
+                            if len(chain) != 2 or chain[0] != "self":
+                                continue
+                            attr = chain[1]
+                            prim = _is_threading_ctor(stmt.value)
+                            if prim == "Condition" and isinstance(
+                                stmt.value, ast.Call
+                            ) and stmt.value.args:
+                                inner = _attr_chain(stmt.value.args[0])
+                                if len(inner) == 2 and inner[0] == "self":
+                                    ci.lock_aliases[attr] = inner[1]
+                            if prim:
+                                ci.attr_types.setdefault(attr, f"threading.{prim}")
+                                continue
+                            if isinstance(stmt.value, ast.Call) and isinstance(
+                                stmt.value.func, ast.Name
+                            ):
+                                if stmt.value.func.id in self.classes:
+                                    ci.attr_types.setdefault(
+                                        attr, stmt.value.func.id
+                                    )
+
+    def resolve_class(self, name: Optional[str]) -> Optional[ClassInfo]:
+        return self.classes.get(name) if name else None
+
+
+# ==========================================================================
+# lock-node resolution + transitive lock sets (G301 substrate)
+# ==========================================================================
+
+class _Ctx:
+    """Where an expression lives: module, enclosing class, enclosing fn."""
+
+    def __init__(self, module: ModuleInfo, cls: Optional[ClassInfo],
+                 fn: ast.FunctionDef):
+        self.module = module
+        self.cls = cls
+        self.fn = fn
+        self.params: Dict[str, str] = {}
+        args = fn.args
+        for a in list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs):
+            ty = _ann_name(a.annotation)
+            if ty:
+                self.params[a.arg] = ty
+
+
+def _lock_node(index: Index, ctx: _Ctx, expr: ast.AST) -> Optional[str]:
+    """Resolve a with-item / receiver expression to a canonical lock node
+    ``module:Class.attr`` — or None when it is not a lock acquisition."""
+    chain = _attr_chain(expr)
+    if len(chain) < 2:
+        return None
+    attr = chain[-1]
+    if not _LOCK_ATTR_RE.match(attr):
+        return None
+    owner: Optional[ClassInfo] = None
+    if chain[0] == "self" and ctx.cls is not None:
+        if len(chain) == 2:
+            owner = ctx.cls
+        elif len(chain) == 3:
+            owner = index.resolve_class(ctx.cls.attr_types.get(chain[1]))
+    elif len(chain) == 2:
+        owner = index.resolve_class(ctx.params.get(chain[0]))
+        if owner is None and chain[0] == "cls" and ctx.cls is not None:
+            owner = ctx.cls
+    if owner is not None:
+        return f"{owner.module.name}:{owner.name}.{owner.canon(attr)}"
+    # unknown receiver — still a deterministic node so edges stay stable
+    return f"{ctx.module.name}:{'.'.join(chain[:-1])}.{attr}"
+
+
+def _callee(index: Index, ctx: _Ctx, call: ast.Call
+            ) -> Optional[Tuple[ModuleInfo, Optional[ClassInfo], ast.FunctionDef]]:
+    """Resolve a call to an audited function/method, or None."""
+    func = call.func
+    if isinstance(func, ast.Name):
+        fn = ctx.module.functions.get(func.id)
+        if fn is not None:
+            return (ctx.module, None, fn)
+        for m in index.modules.values():
+            if func.id in m.functions:
+                return (m, None, m.functions[func.id])
+        return None
+    chain = _attr_chain(func)
+    if len(chain) < 2:
+        return None
+    meth = chain[-1]
+    owner: Optional[ClassInfo] = None
+    if chain[0] == "self" and ctx.cls is not None:
+        if len(chain) == 2:
+            owner = ctx.cls
+        elif len(chain) == 3:
+            owner = index.resolve_class(ctx.cls.attr_types.get(chain[1]))
+            # self.handle.server.submit style: walk one more hop
+        if owner is None and len(chain) == 4:
+            mid = index.resolve_class(ctx.cls.attr_types.get(chain[1]))
+            if mid is not None:
+                owner = index.resolve_class(mid.attr_types.get(chain[2]))
+    elif len(chain) >= 2:
+        owner = index.resolve_class(ctx.params.get(chain[0]))
+        if owner is not None and len(chain) == 3:
+            owner = index.resolve_class(owner.attr_types.get(chain[1]))
+    if owner is not None and meth in owner.methods:
+        return (owner.module, owner, owner.methods[meth])
+    return None
+
+
+class LockAnalysis:
+    """Transitive ``locks_of(fn)`` with memoization + cycle guard."""
+
+    def __init__(self, index: Index):
+        self.index = index
+        self._memo: Dict[int, Set[str]] = {}
+        self._stack: Set[int] = set()
+
+    def locks_of(self, module: ModuleInfo, cls: Optional[ClassInfo],
+                 fn: ast.FunctionDef) -> Set[str]:
+        key = id(fn)
+        if key in self._memo:
+            return self._memo[key]
+        if key in self._stack:
+            return set()  # recursion cycle — already being computed
+        self._stack.add(key)
+        ctx = _Ctx(module, cls, fn)
+        out: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    n = _lock_node(self.index, ctx, item.context_expr)
+                    if n:
+                        out.add(n)
+            elif isinstance(node, ast.Call):
+                resolved = _callee(self.index, ctx, node)
+                if resolved is not None:
+                    out |= self.locks_of(*resolved)
+        self._stack.discard(key)
+        self._memo[key] = out
+        return out
+
+
+def collect_lock_edges(index: Index) -> Dict[Tuple[str, str], Tuple[str, int]]:
+    """edge (held -> acquired) -> first (relpath, line) witness site."""
+    la = LockAnalysis(index)
+    edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+
+    def add(a: str, b: str, relpath: str, line: int) -> None:
+        edges.setdefault((a, b), (relpath, line))
+
+    def visit(ctx: _Ctx, node: ast.AST, held: List[str]) -> None:
+        acquired: List[str] = []
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                n = _lock_node(index, ctx, item.context_expr)
+                if n:
+                    for h in held:
+                        add(h, n, ctx.module.relpath, node.lineno)
+                    acquired.append(n)
+            held = held + acquired
+        if held and isinstance(node, ast.Call):
+            resolved = _callee(index, ctx, node)
+            if resolved is not None:
+                for b in la.locks_of(*resolved):
+                    for h in held:
+                        add(h, b, ctx.module.relpath, node.lineno)
+        for child in ast.iter_child_nodes(node):
+            # a nested function body does not inherit the held set
+            child_held = (
+                [] if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef))
+                else held
+            )
+            visit(ctx, child, child_held)
+
+    for m in index.modules.values():
+        for fn in m.functions.values():
+            visit(_Ctx(m, None, fn), fn, [])
+        for ci in m.classes.values():
+            for fn in ci.methods.values():
+                visit(_Ctx(m, ci, fn), fn, [])
+    return edges
+
+
+def find_cycles(edges: Iterable[Tuple[str, str]]) -> List[List[str]]:
+    """Minimal cycle inventory: self-edges plus one witness cycle per
+    strongly-connected component with >= 2 nodes."""
+    graph: Dict[str, Set[str]] = {}
+    for a, b in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    cycles: List[List[str]] = []
+    for a, succs in sorted(graph.items()):
+        if a in succs:
+            cycles.append([a, a])
+    # Tarjan SCC
+    idx: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on: Set[str] = set()
+    stack: List[str] = []
+    counter = [0]
+
+    def strong(v: str) -> None:
+        idx[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on.add(v)
+        for w in sorted(graph[v]):
+            if w not in idx:
+                strong(w)
+                low[v] = min(low[v], low[w])
+            elif w in on:
+                low[v] = min(low[v], idx[w])
+        if low[v] == idx[v]:
+            comp = []
+            while True:
+                w = stack.pop()
+                on.discard(w)
+                comp.append(w)
+                if w == v:
+                    break
+            if len(comp) > 1:
+                cycles.append(sorted(comp) + [sorted(comp)[0]])
+
+    for v in sorted(graph):
+        if v not in idx:
+            strong(v)
+    return cycles
+
+
+# ==========================================================================
+# G302 — blocking operations while holding a lock
+# ==========================================================================
+
+def _has_timeout_kw(call: ast.Call) -> bool:
+    return any(kw.arg == "timeout" for kw in call.keywords)
+
+
+def _lint_blocking_under_lock(index: Index, m: ModuleInfo,
+                              findings: List[Finding]) -> None:
+    def emit(line: int, msg: str) -> None:
+        if not _waived("G302", line, m.waivers):
+            findings.append(Finding("G302", m.relpath, line, msg))
+
+    def check_call(ctx: _Ctx, node: ast.Call, held: List[str]) -> None:
+        chain = _attr_chain(node.func)
+        if not chain:
+            return
+        last = chain[-1]
+        lock_names = ", ".join(sorted(set(held)))
+        if last == "sleep" and chain[0] == "time":
+            emit(node.lineno,
+                 f"time.sleep() while holding {lock_names} stalls every "
+                 "other thread contending for the lock")
+        elif last == "get" and len(chain) >= 2 and not _has_timeout_kw(node):
+            recv = chain[-2]
+            if _QUEUEISH_RE.search(recv):
+                emit(node.lineno,
+                     f"timeout-less queue.get() while holding {lock_names} "
+                     "can block forever with the lock held")
+        elif last == "result" and not node.args and not _has_timeout_kw(node):
+            emit(node.lineno,
+                 f"timeout-less Future.result() while holding {lock_names} "
+                 "deadlocks if the resolver needs the same lock")
+        elif last == "join" and not node.args and not node.keywords:
+            emit(node.lineno,
+                 f"bare .join() while holding {lock_names} can block "
+                 "forever with the lock held")
+        elif last == "wait":
+            recv = _lock_node(index, ctx, node.func.value) if isinstance(
+                node.func, ast.Attribute) else None
+            if recv is None or recv not in held:
+                emit(node.lineno,
+                     f".wait() on a foreign object while holding {lock_names} "
+                     "blocks without releasing the lock (only the held "
+                     "condition's own wait releases it)")
+        elif last in ("block_until_ready", "device_get") or (
+            last == "item" and len(chain) >= 2
+        ):
+            emit(node.lineno,
+                 f"blocking device readback ({last}) while holding "
+                 f"{lock_names} stalls every submitter for a full "
+                 "program execution")
+
+    def visit(ctx: _Ctx, node: ast.AST, held: List[str]) -> None:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired = []
+            for item in node.items:
+                n = _lock_node(index, ctx, item.context_expr)
+                if n:
+                    acquired.append(n)
+            held = held + acquired
+        if held and isinstance(node, ast.Call):
+            check_call(ctx, node, held)
+        for child in ast.iter_child_nodes(node):
+            child_held = (
+                [] if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef))
+                else held
+            )
+            visit(ctx, child, child_held)
+
+    for fn in m.functions.values():
+        visit(_Ctx(m, None, fn), fn, [])
+    for ci in m.classes.values():
+        for fn in ci.methods.values():
+            visit(_Ctx(m, ci, fn), fn, [])
+
+
+# ==========================================================================
+# G303 — shared-mutable-state races
+# ==========================================================================
+
+def _thread_entrypoints(ci: ClassInfo) -> Set[str]:
+    """Method names used as Thread targets or done-callbacks in this class."""
+    out: Set[str] = set()
+
+    def target_methods(expr: ast.AST) -> Iterable[str]:
+        chain = _attr_chain(expr)
+        if len(chain) == 2 and chain[0] == "self":
+            yield chain[1]
+        if isinstance(expr, ast.Lambda):
+            for sub in ast.walk(expr.body):
+                if isinstance(sub, ast.Call):
+                    ch = _attr_chain(sub.func)
+                    if len(ch) == 2 and ch[0] == "self":
+                        yield ch[1]
+
+    for fn in ci.methods.values():
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if _is_threading_ctor(node) == "Thread":
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        out.update(target_methods(kw.value))
+            chain = _attr_chain(node.func)
+            if chain and chain[-1] == "add_done_callback" and node.args:
+                out.update(target_methods(node.args[0]))
+    return out & set(ci.methods)
+
+
+def _class_callgraph(ci: ClassInfo) -> Dict[str, Set[str]]:
+    graph: Dict[str, Set[str]] = {}
+    for name, fn in ci.methods.items():
+        callees: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                chain = _attr_chain(node.func)
+                if len(chain) == 2 and chain[0] == "self" and chain[1] in ci.methods:
+                    callees.add(chain[1])
+        graph[name] = callees
+    return graph
+
+
+def _reachable(graph: Dict[str, Set[str]], roots: Iterable[str]) -> Set[str]:
+    seen: Set[str] = set()
+    todo = [r for r in roots if r in graph]
+    while todo:
+        cur = todo.pop()
+        if cur in seen:
+            continue
+        seen.add(cur)
+        todo.extend(graph.get(cur, ()))
+    return seen
+
+
+def _lint_shared_state(index: Index, m: ModuleInfo,
+                       findings: List[Finding]) -> None:
+    for ci in m.classes.values():
+        targets = _thread_entrypoints(ci)
+        if not targets:
+            continue
+        graph = _class_callgraph(ci)
+        domains = {t: _reachable(graph, [t]) for t in targets}
+        api_roots = [
+            n for n in ci.methods
+            if (not n.startswith("_") or n in ("__enter__", "__exit__"))
+            and n not in targets
+        ]
+        domains["<api>"] = _reachable(graph, api_roots)
+
+        # attr -> list of (method, line, guard node or None)
+        writes: Dict[str, List[Tuple[str, int, Optional[str]]]] = {}
+        for name, fn in ci.methods.items():
+            if name == "__init__":
+                continue  # happens-before the thread start
+            ctx = _Ctx(m, ci, fn)
+
+            def visit(node: ast.AST, held: List[str]) -> None:
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    acquired = []
+                    for item in node.items:
+                        n = _lock_node(index, ctx, item.context_expr)
+                        if n:
+                            acquired.append(n)
+                    held = held + acquired
+                if isinstance(node, (ast.Assign, ast.AugAssign)):
+                    tgts = (
+                        node.targets if isinstance(node, ast.Assign)
+                        else [node.target]
+                    )
+                    value = getattr(node, "value", None)
+                    for tgt in tgts:
+                        chain = _attr_chain(tgt)
+                        if len(chain) != 2 or chain[0] != "self":
+                            continue
+                        attr = chain[1]
+                        if attr.startswith("__") or _LOCK_ATTR_RE.match(attr):
+                            continue
+                        if value is not None and _is_threading_ctor(value):
+                            continue
+                        guard = held[-1] if held else None
+                        writes.setdefault(attr, []).append(
+                            (name, node.lineno, guard)
+                        )
+                for child in ast.iter_child_nodes(node):
+                    child_held = (
+                        [] if isinstance(
+                            child, (ast.FunctionDef, ast.AsyncFunctionDef))
+                        else held
+                    )
+                    visit(child, child_held)
+
+            visit(fn, [])
+
+        for attr, sites in sorted(writes.items()):
+            owners: Set[str] = set()
+            for meth, _line, _g in sites:
+                for dom, reach in domains.items():
+                    if meth in reach:
+                        owners.add(dom)
+            if len(owners) < 2 or not (owners & set(targets)):
+                continue
+            guards = {g for _m, _l, g in sites}
+            if None not in guards and len(guards) == 1:
+                continue  # every write under one common lock
+            # report at the first unguarded (or divergently-guarded) write
+            bad = [s for s in sites if s[2] is None] or sites
+            meth, line, _g = bad[0]
+            if _waived("G303", line, m.waivers):
+                continue
+            findings.append(Finding(
+                "G303", m.relpath, line,
+                f"self.{attr} is written from {len(owners)} thread "
+                f"entrypoints ({', '.join(sorted(owners))}) without a common "
+                "guarding lock — waive deliberate benign races with "
+                "'# graft: race-ok <why>'",
+            ))
+
+
+# ==========================================================================
+# G304 — thread-lifecycle discipline
+# ==========================================================================
+
+def _lint_thread_lifecycle(m: ModuleInfo, findings: List[Finding]) -> None:
+    # join evidence: every attr/name appearing as receiver of .join(...)
+    joined: Set[str] = set()
+    # aliases that transfer join evidence back to the stored attribute:
+    # ``for t in self._threads: t.join()`` and ``t = self._thread; t.join()``
+    alias_attrs: Dict[str, Set[str]] = {}
+    for node in ast.walk(m.tree):
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            if isinstance(node.target, ast.Name):
+                attrs = set(_attr_chain(node.iter))
+                alias_attrs.setdefault(node.target.id, set()).update(attrs)
+        if isinstance(node, ast.Assign) and isinstance(
+            node.value, (ast.Attribute, ast.Name)
+        ):
+            chain = _attr_chain(node.value)
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and chain:
+                    alias_attrs.setdefault(tgt.id, set()).update(chain)
+        if isinstance(node, ast.Call):
+            chain = _attr_chain(node.func)
+            if chain and chain[-1] == "join" and (node.args or node.keywords):
+                # ",".join(...) takes a positional string — exclude constants
+                if not (node.args and isinstance(node.args[0], ast.Constant)):
+                    joined.update(chain[:-1])
+            elif chain and chain[-1] == "join" and not node.args:
+                joined.update(chain[:-1])
+    for var, attrs in alias_attrs.items():
+        if var in joined:
+            joined.update(attrs)
+
+    class _Spawns(ast.NodeVisitor):
+        def __init__(self):
+            self.sites: List[Tuple[ast.Call, ast.FunctionDef]] = []
+            self._fn: List[ast.FunctionDef] = []
+
+        def visit_FunctionDef(self, node):
+            self._fn.append(node)
+            self.generic_visit(node)
+            self._fn.pop()
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Call(self, node):
+            if _is_threading_ctor(node) == "Thread":
+                self.sites.append((node, self._fn[-1] if self._fn else None))
+            self.generic_visit(node)
+
+    sp = _Spawns()
+    sp.visit(m.tree)
+    for call, fn in sp.sites:
+        if _waived("G304", call.lineno, m.waivers):
+            continue
+        storage: Set[str] = set()
+        if fn is not None:
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) and node.value is call:
+                    for tgt in node.targets:
+                        storage.update(_attr_chain(tgt))
+            # container storage: t = Thread(...); self._threads.append(t)
+            locals_ = {n for n in storage if n != "self"}
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    chain = _attr_chain(node.func)
+                    if (
+                        chain and chain[-1] == "append" and node.args
+                        and isinstance(node.args[0], ast.Name)
+                        and node.args[0].id in locals_
+                    ):
+                        storage.update(chain[:-1])
+        storage.discard("self")
+        if storage & joined:
+            continue
+        findings.append(Finding(
+            "G304", m.relpath, call.lineno,
+            "thread spawned here has no join route — join it from the "
+            "owner's close()/drain() (bounded), or waive a deliberate "
+            "fire-and-forget with '# graft: thread-ok <why>'",
+        ))
+
+
+# ==========================================================================
+# G305 — future-resolution discipline
+# ==========================================================================
+
+def _lint_future_resolution(m: ModuleInfo, findings: List[Finding]) -> None:
+    if os.path.basename(m.relpath) not in RESOLVE_MODULES:
+        return
+
+    def visit(node: ast.AST, fn_name: Optional[str]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fn_name = node.name
+        if isinstance(node, ast.Call):
+            chain = _attr_chain(node.func)
+            if chain and chain[-1] in ("set_result", "set_exception"):
+                if fn_name not in RESOLVER_NAMES and not _waived(
+                    "G305", node.lineno, m.waivers
+                ):
+                    findings.append(Finding(
+                        "G305", m.relpath, node.lineno,
+                        f"bare .{chain[-1]}() races client-side cancel() "
+                        "(InvalidStateError) — route through the race-safe "
+                        "resolve_future()/_resolve()",
+                    ))
+        for child in ast.iter_child_nodes(node):
+            visit(child, fn_name)
+
+    visit(m.tree, None)
+
+
+# ==========================================================================
+# G306 — gang divergence
+# ==========================================================================
+
+def _condition_taint(test: ast.AST) -> Optional[str]:
+    for sub in ast.walk(test):
+        if isinstance(sub, ast.Attribute) and sub.attr in _RANK_MARKERS:
+            return f"rank test ({sub.attr})"
+        if isinstance(sub, ast.Name) and sub.id in _RANK_MARKERS:
+            return f"rank test ({sub.id})"
+        if isinstance(sub, ast.Call):
+            chain = _attr_chain(sub.func)
+            if chain and chain[-1] in _FS_MARKERS:
+                return f"local-filesystem check ({chain[-1]})"
+    return None
+
+
+def _lint_gang_divergence(m: ModuleInfo, findings: List[Finding]) -> None:
+    def visit(node: ast.AST, taints: List[str]) -> None:
+        own: List[str] = []
+        if isinstance(node, (ast.If, ast.While)):
+            t = _condition_taint(node.test)
+            if t:
+                own.append(t)
+        elif isinstance(node, ast.ExceptHandler):
+            own.append("caught-exception branch")
+        taints = taints + own
+        if taints and isinstance(node, ast.Call):
+            chain = _attr_chain(node.func)
+            name = chain[-1] if chain else None
+            if name in COLLECTIVE_CALLS and not _waived(
+                "G306", node.lineno, m.waivers
+            ):
+                findings.append(Finding(
+                    "G306", m.relpath, node.lineno,
+                    f"collective {name}() reachable only under host-local "
+                    f"state ({taints[-1]}) — hosts that diverge here wedge "
+                    "the gang; restructure to the collective-verdict "
+                    "pattern or waive a deliberate paired barrier with "
+                    "'# graft: gang-ok <why>'",
+                ))
+        if isinstance(node, (ast.If, ast.While)) and own:
+            # only the guarded body is tainted, not the statement's siblings;
+            # the else branch of a rank test is equally host-local
+            for child in node.body + node.orelse:
+                visit(child, taints)
+            visit(node.test, taints[:-1])
+            return
+        for child in ast.iter_child_nodes(node):
+            visit(child, taints)
+
+    visit(m.tree, [])
+
+
+# ==========================================================================
+# baseline + entry point
+# ==========================================================================
+
+def load_concurrency_baseline(path: str = BASELINE_PATH) -> Optional[dict]:
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def make_concurrency_baseline(
+    edges: Iterable[Tuple[str, str]], previous: Optional[dict] = None
+) -> dict:
+    """New baseline from the observed lock-order edges. Waivers are
+    REVIEWED content, not measurements — re-baselining preserves them."""
+    prev = previous or {}
+    return {
+        "lock_order": sorted(f"{a} -> {b}" for a, b in edges),
+        "waivers": prev.get("waivers", {}),
+    }
+
+
+def apply_json_waivers(
+    findings: Sequence[Finding], baseline: Optional[dict]
+) -> Tuple[List[Finding], int]:
+    """Level 3's JSON waiver model for the edge-scoped G301 findings:
+    ``baseline["waivers"]`` maps code -> {regex: mandatory reason}; the
+    regex is searched against ``"<program> <message>"``."""
+    waivers = (baseline or {}).get("waivers", {})
+    if not waivers:
+        return list(findings), 0
+    compiled = {
+        code: [(re.compile(pat), reason) for pat, reason in pats.items()]
+        for code, pats in waivers.items()
+    }
+    kept: List[Finding] = []
+    waived = 0
+    for f in findings:
+        subject = f"{f.program} {f.message}"
+        if any(pat.search(subject) for pat, _ in compiled.get(f.code, ())):
+            waived += 1
+            continue
+        kept.append(f)
+    return kept, waived
+
+
+def analyze_sources(sources: Dict[str, str]) -> Tuple[
+    List[Finding], Dict[Tuple[str, str], Tuple[str, int]]
+]:
+    """Run the line-scoped rules (G302–G306) + edge collection over
+    ``{relpath: text}``. Returns (findings, lock-order edges). G301
+    baseline comparison happens in :func:`run_concurrency_checks`; cycle
+    findings ARE included here (a cycle is never baseline-able)."""
+    modules: List[ModuleInfo] = []
+    findings: List[Finding] = []
+    for relpath, text in sorted(sources.items()):
+        try:
+            tree = ast.parse(text)
+        except SyntaxError as exc:
+            findings.append(Finding(
+                "G000", relpath, exc.lineno or 0, f"unparseable: {exc.msg}"
+            ))
+            continue
+        modules.append(ModuleInfo(relpath, text, tree))
+    index = Index(modules)
+    edges = collect_lock_edges(index)
+    for cycle in find_cycles(edges.keys()):
+        first = edges.get((cycle[0], cycle[1]))
+        path, line = first if first else (cycle[0].split(":")[0] + ".py", 0)
+        findings.append(Finding(
+            "G301", path, line,
+            "lock-order cycle (potential deadlock): "
+            + " -> ".join(cycle),
+            program=" -> ".join(cycle),
+        ))
+    for m in modules:
+        _lint_blocking_under_lock(index, m, findings)
+        _lint_shared_state(index, m, findings)
+        _lint_thread_lifecycle(m, findings)
+        _lint_future_resolution(m, findings)
+        _lint_gang_divergence(m, findings)
+    return _dedupe(findings), edges
+
+
+def _audited_sources(repo_root: str) -> Dict[str, str]:
+    pkg = os.path.join(repo_root, "accelerate_tpu")
+    wanted = set(AUDITED_MODULES)
+    out: Dict[str, str] = {}
+    for path in _walk_py(pkg):
+        if os.path.basename(path) in wanted and os.path.dirname(path) == pkg:
+            rel = os.path.relpath(path, repo_root)
+            with open(path, encoding="utf-8") as f:
+                out[rel] = f.read()
+    return out
+
+
+def run_concurrency_checks(
+    repo_root: str = ".",
+    baseline_path: str = BASELINE_PATH,
+    update_baseline: bool = False,
+    baseline_sink: Optional[list] = None,
+) -> List[Finding]:
+    findings, edges = analyze_sources(_audited_sources(repo_root))
+    baseline = load_concurrency_baseline(baseline_path)
+    if update_baseline:
+        new = make_concurrency_baseline(edges.keys(), previous=baseline)
+        if baseline_sink is not None:
+            baseline_sink.append((baseline_path, new))
+        else:
+            from .lowering import atomic_write_json
+
+            atomic_write_json(new, baseline_path)
+        kept, _ = apply_json_waivers(findings, new)
+        return kept
+    if baseline is None:
+        findings.append(Finding(
+            "G301", baseline_path, 1,
+            "concurrency baseline missing — generate it with "
+            "`python -m accelerate_tpu.analysis --level concurrency "
+            "--update-baseline`",
+        ))
+        kept, _ = apply_json_waivers(findings, None)
+        return kept
+    known = set(baseline.get("lock_order", []))
+    for (a, b), (path, line) in sorted(edges.items()):
+        edge = f"{a} -> {b}"
+        if edge not in known:
+            findings.append(Finding(
+                "G301", path, line,
+                f"new lock-order edge {edge} not in the committed DAG — "
+                "review for deadlock potential, then re-baseline with "
+                "--update-baseline",
+                program=edge,
+            ))
+    kept, _ = apply_json_waivers(findings, baseline)
+    return kept

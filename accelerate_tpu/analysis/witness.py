@@ -1,0 +1,220 @@
+"""Runtime lock-order witness for the G301 baseline DAG.
+
+The static pass in :mod:`.concurrency` builds the lock-order graph from
+the AST; this module records the *actual* acquisition order while real
+code runs (the fleet chaos test, ``make test-serving``) and asserts the
+observed edges are a **subgraph** of the committed baseline DAG in
+``runs/concurrency_baseline.json``. The two directions cover each other:
+the static pass sees paths the test never exercises, the witness sees
+dynamism the AST cannot (locks reached through properties, callbacks,
+or data-driven dispatch). If either side grows an edge the other does
+not know about, the build fails before the deadlock does.
+
+Mechanism: :class:`LockOrderWitness.patch` swaps the
+``threading.Lock`` / ``threading.RLock`` module factories. The
+replacement inspects the *caller frame*: only locks constructed from
+files under ``accelerate_tpu/`` (excluding ``analysis/`` itself) are
+wrapped in a recording proxy — stdlib internals (``queue.Queue``'s
+mutex, ``threading.Event``'s condition) and dataclass
+``default_factory`` locks (which run from generated code, not a repo
+frame) keep real, unobserved locks. The subgraph assertion makes that
+partial coverage safe: unobserved locks can only *under*-report.
+
+Each proxy remembers a weakref to the constructing frame's ``self`` and
+lazily resolves its attribute name by identity scan of the owner's
+``__dict__``, yielding the same canonical ``module:Class.attr`` node
+names the static pass uses — including the Condition-over-Lock alias
+(``self._wake = threading.Condition(self._lock)`` delegates acquisition
+to the inner ``_lock`` proxy, so the witness names the edge by
+``_lock``, exactly like the static canonicalization). A thread-local
+held-stack turns each successful acquire into ``held -> acquired``
+edges; edges whose endpoints never resolve to a node are dropped rather
+than guessed.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import weakref
+from typing import Iterable, List, Optional, Set, Tuple
+
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_ANALYSIS_DIR = os.path.join(_PKG_DIR, "analysis")
+
+
+class _LockProxy:
+    """Wraps a real primitive lock; reports acquisitions to the witness."""
+
+    def __init__(self, real, witness: "LockOrderWitness", stem: str,
+                 owner_ref, cls_name: Optional[str]):
+        self._real = real
+        self._witness = witness
+        self._stem = stem
+        self._owner_ref = owner_ref
+        self._cls = cls_name
+        self._attr: Optional[str] = None
+
+    def node(self) -> Optional[str]:
+        """``module:Class.attr`` once resolvable, else None."""
+        if self._attr is None and self._owner_ref is not None:
+            owner = self._owner_ref()
+            if owner is not None:
+                for key, value in vars(owner).items():
+                    if value is self:
+                        self._attr = key
+                        break
+        if self._attr is None or self._cls is None:
+            return None
+        return f"{self._stem}:{self._cls}.{self._attr}"
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._real.acquire(blocking, timeout)
+        if got:
+            self._witness._on_acquire(self)
+        return got
+
+    def release(self) -> None:
+        self._witness._on_release(self)
+        self._real.release()
+
+    def locked(self) -> bool:
+        return self._real.locked()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+class LockOrderWitness:
+    """Records real lock-acquisition order; asserts ⊆ the baseline DAG.
+
+    Usage (see ``tests/test_fleet.py``)::
+
+        witness = LockOrderWitness()
+        with witness.patch():
+            ... run the chaos test ...
+        witness.assert_subgraph(baseline["lock_order"])
+    """
+
+    def __init__(self) -> None:
+        # raw edges keep proxy references so attribute names can resolve
+        # lazily — an owner often gets its attr assigned after the lock
+        # object exists, and threads may acquire before we can name it.
+        self._raw_edges: Set[Tuple[_LockProxy, _LockProxy]] = set()
+        self._meta = threading.Lock()  # real: guards _raw_edges
+        self._tls = threading.local()
+        self._patched = 0
+
+    # -- recording ---------------------------------------------------------
+
+    def _stack(self) -> List[_LockProxy]:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
+    def _on_acquire(self, proxy: _LockProxy) -> None:
+        stack = self._stack()
+        proxy.node()  # resolve eagerly while the owner is alive
+        for held in stack:
+            if held is not proxy:  # reentrant re-acquire is not an edge
+                with self._meta:
+                    self._raw_edges.add((held, proxy))
+        stack.append(proxy)
+
+    def _on_release(self, proxy: _LockProxy) -> None:
+        stack = self._stack()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] is proxy:
+                del stack[i]
+                return
+
+    # -- patching ----------------------------------------------------------
+
+    def patch(self):
+        """Context manager swapping the ``threading`` lock factories."""
+        witness = self
+
+        class _Patch:
+            def __enter__(self_p):
+                witness._install()
+                return witness
+
+            def __exit__(self_p, *exc):
+                witness._uninstall()
+
+        return _Patch()
+
+    def _install(self) -> None:
+        self._patched += 1
+        if self._patched > 1:
+            return
+        self._real_lock = threading.Lock
+        self._real_rlock = threading.RLock
+        threading.Lock = self._factory(self._real_lock)  # type: ignore
+        threading.RLock = self._factory(self._real_rlock)  # type: ignore
+
+    def _uninstall(self) -> None:
+        self._patched -= 1
+        if self._patched > 0:
+            return
+        threading.Lock = self._real_lock  # type: ignore
+        threading.RLock = self._real_rlock  # type: ignore
+
+    def _factory(self, real_factory):
+        witness = self
+
+        def make_lock():
+            frame = sys._getframe(1)
+            fname = os.path.abspath(frame.f_code.co_filename)
+            in_repo = fname.startswith(_PKG_DIR + os.sep) and not fname.startswith(
+                _ANALYSIS_DIR + os.sep
+            )
+            if not in_repo:
+                return real_factory()
+            owner = frame.f_locals.get("self")
+            owner_ref = None
+            cls_name = None
+            if owner is not None:
+                cls_name = type(owner).__name__
+                try:
+                    owner_ref = weakref.ref(owner)
+                except TypeError:
+                    owner_ref = None
+            stem = os.path.splitext(os.path.basename(fname))[0]
+            return _LockProxy(real_factory(), witness, stem, owner_ref, cls_name)
+
+        return make_lock
+
+    # -- reporting ---------------------------------------------------------
+
+    def observed_edges(self) -> Set[str]:
+        """Fully-resolved ``"A -> B"`` edge strings observed so far."""
+        out: Set[str] = set()
+        with self._meta:
+            raw = list(self._raw_edges)
+        for a, b in raw:
+            na, nb = a.node(), b.node()
+            if na and nb and na != nb:
+                out.add(f"{na} -> {nb}")
+        return out
+
+    def assert_subgraph(self, allowed: Iterable[str]) -> None:
+        """Fail if any observed edge is missing from the baseline DAG."""
+        allowed_set = set(allowed)
+        extra = sorted(self.observed_edges() - allowed_set)
+        if extra:
+            raise AssertionError(
+                "lock-order witness observed edge(s) not in the committed "
+                "G301 baseline DAG (runs/concurrency_baseline.json) — "
+                "review for deadlock potential, then re-baseline with "
+                "`python -m accelerate_tpu.analysis --level concurrency "
+                "--update-baseline`: " + "; ".join(extra)
+            )
+
+
+__all__ = ["LockOrderWitness"]

@@ -319,7 +319,14 @@ class CheckpointReplicator:
 
     def drain(self, timeout: Optional[float] = None) -> None:
         """Block until every submitted mirror has finished (or ``timeout``
-        seconds elapsed), then surface the first deferred mirror error."""
+        seconds elapsed), then surface the first deferred mirror error.
+        ``timeout=None`` honors ``ACCELERATE_BARRIER_TIMEOUT`` (same
+        convention as the barrier paths in ``state.py``: unset or 0 means
+        wait without bound) instead of silently waiting forever."""
+        if timeout is None:
+            raw = os.environ.get("ACCELERATE_BARRIER_TIMEOUT", "")
+            env_timeout = float(raw) if raw else 0.0
+            timeout = env_timeout if env_timeout > 0 else None
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._cond:
             while self._pending or self._inflight is not None:
@@ -341,6 +348,13 @@ class CheckpointReplicator:
         with self._cond:
             self._closed = True
             self._cond.notify_all()
+            t = self._thread
+        # Bounded join outside the condition (the worker needs _cond to
+        # finish) so close() retires the replicator thread instead of
+        # leaking it (graftcheck G304). The worker drains remaining pending
+        # mirrors before exiting, hence the generous bound.
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=30.0)
 
     @property
     def pending(self) -> int:

@@ -63,7 +63,7 @@ from __future__ import annotations
 import queue
 import threading
 import time
-from concurrent.futures import Future, InvalidStateError
+from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Optional
 
@@ -71,7 +71,7 @@ import numpy as np
 
 from .elastic import FleetMembership
 from .logging import get_logger
-from .serving import InferenceServer, _CircuitBreaker
+from .serving import InferenceServer, _CircuitBreaker, resolve_future
 from .utils.dataclasses import FleetConfig
 from .utils.fault import (
     FailoverExhaustedError,
@@ -722,17 +722,9 @@ class FleetRouter:
         cancel and hedge siblings); on delivery, cancel every still-pending
         inner future so a hedge loser stops consuming replica capacity as
         soon as it can."""
-        fut = freq.future
-        delivered = False
-        if not fut.done():
-            try:
-                if exception is not None:
-                    fut.set_exception(exception)
-                else:
-                    fut.set_result(result)
-                delivered = True
-            except InvalidStateError:
-                delivered = False
+        delivered = resolve_future(
+            freq.future, result=result, exception=exception
+        )
         if delivered and exception is None:
             with freq.lock:
                 pending = [f for _h, f in freq.inner if f is not winner]

@@ -149,6 +149,31 @@ class ServingResult:
     replica_id: Optional[str] = None
 
 
+# ---------------------------------------------------------- future resolution
+def resolve_future(
+    future: Future, *, result=None, exception: Optional[BaseException] = None
+) -> bool:
+    """Resolve a client Future exactly once. Callers may ``cancel()`` a
+    pending Future at any moment (client-side timeout), so every
+    worker-side resolution must tolerate the done/cancelled race instead
+    of dying on ``InvalidStateError``. Returns True when this call
+    actually delivered the outcome.
+
+    This is the ONLY place ``set_result``/``set_exception`` may appear in
+    serving/fleet code — graftcheck G305 enforces it.
+    """
+    if future.done():
+        return False
+    try:
+        if exception is not None:
+            future.set_exception(exception)
+        else:
+            future.set_result(result)
+        return True
+    except InvalidStateError:  # lost the race to a concurrent cancel()
+        return False
+
+
 # -------------------------------------------------------------------- metrics
 class ServingMetrics:
     """Thread-safe serving counters + latency reservoirs.
@@ -507,25 +532,9 @@ class InferenceServer:
             )
         return "server is draining — resubmit to another replica"
 
-    @staticmethod
-    def _resolve(
-        future: Future, *, result=None, exception: Optional[BaseException] = None
-    ) -> bool:
-        """Resolve a client Future exactly once. Callers may ``cancel()``
-        a pending Future at any moment (client-side timeout), so every
-        worker-side resolution must tolerate the done/cancelled race
-        instead of dying on ``InvalidStateError``. Returns True when this
-        call actually delivered the outcome."""
-        if future.done():
-            return False
-        try:
-            if exception is not None:
-                future.set_exception(exception)
-            else:
-                future.set_result(result)
-            return True
-        except InvalidStateError:  # lost the race to a concurrent cancel()
-            return False
+    # Race-safe Future resolution (module-level so fleet.py shares it and
+    # graftcheck G305 has one blessed implementation to point at).
+    _resolve = staticmethod(resolve_future)
 
     @property
     def draining(self) -> bool:
@@ -600,6 +609,12 @@ class InferenceServer:
         worker. Idempotent."""
         done = self.drain(timeout if drain else 0.0)
         self._closed = True
+        # Bounded join so close() actually retires the worker thread
+        # (graftcheck G304) — unless close() is running *on* the worker
+        # (a request callback closing its own server) where joining
+        # yourself deadlocks.
+        if self._worker is not threading.current_thread():
+            self._worker.join(timeout=self.config.drain_timeout_s)
         if self.trackers:
             self._flush_metrics(force=True)
         return done
@@ -1264,6 +1279,7 @@ class InferenceServer:
             interval is not None
             and self._clock() - self._last_metrics_flush >= interval
         ):
+            # graft: race-ok — monotonic timestamp; a lost update costs one extra snapshot, never corruption
             self._last_metrics_flush = self._clock()
             self._emit_snapshot()
 

@@ -174,11 +174,17 @@ def _run_with_barrier_timeout(sync_fn: Callable[[], Any], tag: str, timeout: Opt
     if not done.wait(timeout):
         from .utils.fault import BarrierTimeoutError
 
+        # The native collective cannot be cancelled: the thread stays
+        # abandoned (daemon) on this path by design, and the caller exits.
         raise BarrierTimeoutError(
             f"barrier {tag!r} did not complete within {timeout:g}s — a peer "
             "process is likely dead or wedged (set ACCELERATE_BARRIER_TIMEOUT"
             "=0 to restore unbounded waits)"
         )
+    # Success: done is set inside the thread's finally, so the thread is
+    # within microseconds of exiting — the bounded join retires it instead
+    # of leaking one "barrier:<tag>" thread per successful timed barrier.
+    t.join(timeout=1.0)
     if errors:
         raise errors[0]
 
@@ -373,24 +379,30 @@ class PartialState:
     @contextmanager
     def main_process_first(self):
         """Main process runs the body first, others wait; then the rest run
-        (reference state.py:513-554). Guards e.g. dataset cache writes."""
+        (reference state.py:513-554). Guards e.g. dataset cache writes.
+
+        Both halves pass the SAME tagged barrier exactly once per rank —
+        non-main ranks arrive before the body, main arrives after it, and
+        the barrier releases everyone together. Divergent enter/exit tags
+        would key two different barriers that can never pair (every rank
+        must agree on the barrier name), wedging the gang."""
         if not self.is_main_process:
-            self.wait_for_everyone("accelerate_tpu.state.main_process_first.enter")
+            # graft: gang-ok — paired barrier: every rank passes this one tag exactly once (non-main here, main below)
+            self.wait_for_everyone("accelerate_tpu.state.main_process_first")
         yield
         if self.is_main_process:
-            self.wait_for_everyone("accelerate_tpu.state.main_process_first.exit")
+            # graft: gang-ok — second half of the paired barrier above
+            self.wait_for_everyone("accelerate_tpu.state.main_process_first")
 
     @contextmanager
     def local_main_process_first(self):
         if not self.is_local_main_process:
-            self.wait_for_everyone(
-                "accelerate_tpu.state.local_main_process_first.enter"
-            )
+            # graft: gang-ok — paired barrier, same tag on both rank branches (see main_process_first)
+            self.wait_for_everyone("accelerate_tpu.state.local_main_process_first")
         yield
         if self.is_local_main_process:
-            self.wait_for_everyone(
-                "accelerate_tpu.state.local_main_process_first.exit"
-            )
+            # graft: gang-ok — second half of the paired barrier above
+            self.wait_for_everyone("accelerate_tpu.state.local_main_process_first")
 
     def on_main_process(self, function: Callable) -> Callable:
         """Decorator: run only on the main process (reference state.py:555)."""

@@ -678,6 +678,19 @@ if __name__ == "__main__":
             ["--level", "sharding"]
             + [a for a in sys.argv[1:] if a != "--sharding-gate"]
         ))
+    if "--concurrency-gate" in sys.argv:
+        # graftcheck Level 4: host concurrency & gang-safety audit —
+        # lock-order DAG vs runs/concurrency_baseline.json, blocking ops
+        # under locks, cross-thread races, thread leaks, Future-resolution
+        # discipline, gang-divergent collectives (G301-G306)
+        # (docs/static_analysis.md)
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from accelerate_tpu.analysis.__main__ import main as static_main
+
+        sys.exit(static_main(
+            ["--level", "concurrency"]
+            + [a for a in sys.argv[1:] if a != "--concurrency-gate"]
+        ))
     if "--continuous-gate" in sys.argv:
         # continuous-batching gate: mixed-length/mixed-budget workload must
         # reach >= 1.3x static-mode goodput with TTFT p99 no worse, <= 2

@@ -179,21 +179,40 @@ def test_submit_validates_prompt_shape():
 def test_replica_death_mid_batch_drops_nothing():
     """The acceptance chaos probe: kill one replica mid-batch under load —
     every future completes (or would fail typed-retriable); nothing hangs,
-    nothing is silently dropped."""
+    nothing is silently dropped. The whole run executes under the
+    graftcheck lock-order witness: every lock acquisition the fleet +
+    serving stack actually performs must stay inside the committed G301
+    baseline DAG (``runs/concurrency_baseline.json``), so the static
+    lock-order graph cannot silently rot."""
+    import os
+
+    from accelerate_tpu.analysis.concurrency import load_concurrency_baseline
+    from accelerate_tpu.analysis.witness import LockOrderWitness
+
+    witness = LockOrderWitness()
     kill = threading.Event()
-    router = make_fleet(3, gen=[killable_gen(kill), echo_gen(0.005), echo_gen(0.005)])
-    try:
-        futs = [router.submit(PROMPT, max_new_tokens=2) for _ in range(10)]
-        kill.set()  # next batch on r0 takes the worker down with it
-        futs += [router.submit(PROMPT, max_new_tokens=2) for _ in range(30)]
-        res = [f.result(15) for f in futs]
-        assert len(res) == 40
-        assert router.metrics["failovers"] >= 1
-        # the dead replica's router-side breaker opened; survivors served
-        assert wait_until(lambda: router.metrics["probe_failures"] >= 1)
-        assert {r.replica_id for r in res} <= {"r0", "r1", "r2"}
-    finally:
-        router.close(drain=False)
+    with witness.patch():
+        router = make_fleet(
+            3, gen=[killable_gen(kill), echo_gen(0.005), echo_gen(0.005)]
+        )
+        try:
+            futs = [router.submit(PROMPT, max_new_tokens=2) for _ in range(10)]
+            kill.set()  # next batch on r0 takes the worker down with it
+            futs += [router.submit(PROMPT, max_new_tokens=2) for _ in range(30)]
+            res = [f.result(15) for f in futs]
+            assert len(res) == 40
+            assert router.metrics["failovers"] >= 1
+            # the dead replica's router-side breaker opened; survivors served
+            assert wait_until(lambda: router.metrics["probe_failures"] >= 1)
+            assert {r.replica_id for r in res} <= {"r0", "r1", "r2"}
+        finally:
+            router.close(drain=False)
+    baseline = load_concurrency_baseline(os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "runs", "concurrency_baseline.json",
+    ))
+    assert baseline is not None
+    witness.assert_subgraph(baseline["lock_order"])
 
 
 def test_single_replica_death_exhausts_typed_and_retriable():
