@@ -9,7 +9,7 @@
 PY := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu python
 PY_SLOW := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu RUN_SLOW=1 python
 
-.PHONY: test test_all test_core test_data test_parallel test_models test_cli test_big_modeling test-fault test-serving check-static check-sharding check-concurrency bench bench-telemetry bench-serving bench-continuous bench-recovery bench-kv bench-spec bench-fleet
+.PHONY: test test_all test_core test_data test_parallel test_models test_cli test_big_modeling test-fault test-serving check-static check-sharding check-concurrency check-numerics check-all bench bench-telemetry bench-serving bench-continuous bench-recovery bench-kv bench-spec bench-fleet
 
 test: check-static
 	$(PY) -m pytest tests/ -q
@@ -22,9 +22,11 @@ test: check-static
 # Level 3 audits SPMD shardings + static HBM budgets (G201-G205) against
 # runs/sharding_baseline.json; Level 4 audits host concurrency & gang
 # safety (G301-G306) against the lock-order DAG in
-# runs/concurrency_baseline.json. check-static runs ALL levels; exit 0 =
-# clean. Re-baseline deliberate program/budget/lock-order changes
-# atomically (all baselines, write-to-temp + rename) with:
+# runs/concurrency_baseline.json; Level 5 audits numerics/precision/RNG
+# discipline (G401-G405) and runs the bf16-vs-f32 drift witness against
+# runs/numerics_baseline.json. check-static runs ALL levels; exit 0 =
+# clean. Re-baseline deliberate program/budget/lock-order/drift changes
+# atomically (all four baseline files, write-to-temp + rename) with:
 #   $(PY) -m accelerate_tpu.analysis --update-baseline
 check-static:
 	$(PY) -m accelerate_tpu.analysis
@@ -42,6 +44,18 @@ check-sharding:
 # gang-divergent collectives (G301-G306). Pure AST: no jax import, <1s.
 check-concurrency:
 	$(PY) -m accelerate_tpu.analysis --level concurrency
+
+# Level 5 alone: numerics, precision & RNG audit (G401-G405) — f64/widened
+# aliases, accumulation-dtype discipline, state/scale dtype contract, PRNG
+# key reuse, non-determinism inventory, plus the bf16-vs-f32 drift witness
+# gated against runs/numerics_baseline.json. Pre-commit fast path:
+#   $(PY) -m accelerate_tpu.analysis --level numerics --changed-only
+check-numerics:
+	$(PY) -m accelerate_tpu.analysis --level numerics
+
+# every level + a SARIF report CI can annotate PRs from
+check-all:
+	$(PY) -m accelerate_tpu.analysis --level all --sarif runs/graftcheck.sarif
 
 # durable-checkpointing suite (docs/fault_tolerance.md): atomic commit,
 # kill-mid-save rollback via ACCELERATE_TPU_FAULT_INJECT, preemption,

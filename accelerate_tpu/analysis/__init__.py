@@ -71,15 +71,39 @@ Level 4 — host concurrency & gang-safety audit (``analysis/concurrency.py``):
 * **G306** collective call reachable only under host-local state (rank
   test, filesystem check, caught exception) — gang divergence
 
+Level 5 — numerics, precision & RNG audit (``analysis/numerics.py``):
+
+* **G401** unintended dtype promotion: f64 in a lowered hot program, a
+  donated input aliased to a wider output (live HBM silently widened),
+  or a bf16-vs-f32 drift-witness value outside its committed bound
+* **G402** accumulation-dtype discipline: int8/fp8 dots keeping the
+  narrow result type and LONG bf16/f16 add-reduces (>128 reduced
+  elements) are hard findings; the counts of bf16-accumulating dots
+  and of short bf16 add-reduces are inventory-gated per program
+* **G403** state-dtype contract: master weights, optimizer moments
+  (modulo the declared ``mu`` policy), the loss scalar, and every
+  quantization scale must be f32
+* **G404** RNG-key discipline: a key consumed by two samplers, or
+  consumed in a loop without per-iteration split/fold_in (AST), or a
+  program with ≥2 random draws and zero split/fold_in (jaxpr)
+* **G405** non-determinism inventory: unordered-reduction ops
+  (scatter-add, select_and_scatter, cross-replica reduces) gated
+  against the committed per-program inventory
+
+Level 5 baselines, drift bounds, and program-scoped waivers live in
+``runs/numerics_baseline.json``.
+
 Waivers are line-scoped comments, same line or the line above:
 ``# graft: sync-ok`` (G101), ``# graft: wait-ok`` (G102),
 ``# graft: raise-ok`` (G103), ``# graft: lock-ok`` (G104),
 ``# graft: fault-ok`` (G105), ``# graft: block-ok`` (G302),
 ``# graft: race-ok`` (G303), ``# graft: thread-ok`` (G304),
-``# graft: resolve-ok`` (G305), ``# graft: gang-ok`` (G306), or the
-universal ``# graft: GXXX-ok``. G301 is edge-scoped — its waivers live
-in the baseline JSON like Level 3's. See ``docs/static_analysis.md``
-for the full table and re-baselining.
+``# graft: resolve-ok`` (G305), ``# graft: gang-ok`` (G306),
+``# graft: key-ok`` (G404), or the universal ``# graft: GXXX-ok``.
+G301 is edge-scoped — its waivers live in the baseline JSON like
+Level 3's; G401-G405 program-scoped waivers live in the numerics
+baseline. See ``docs/static_analysis.md`` for the full table and
+re-baselining.
 """
 
 from __future__ import annotations
@@ -107,7 +131,73 @@ RULES = {
     "G304": "spawned thread has no join route from its owner's close/drain",
     "G305": "bare set_result/set_exception outside the race-safe resolver",
     "G306": "collective reachable only under host-local state (gang split)",
+    "G401": "unintended dtype promotion (f64 / widened alias / drift bound)",
+    "G402": "narrow matmul or reduction without f32 accumulation",
+    "G403": "master state, loss, or quantization scale not f32",
+    "G404": "PRNG key reused or consumed without split/fold_in",
+    "G405": "unordered-reduction op outside the committed inventory",
 }
+
+# rule-code century -> level name (the unified --json/--sarif schema key)
+_LEVELS = {"G0": "program", "G1": "host", "G2": "sharding",
+           "G3": "concurrency", "G4": "numerics"}
+
+
+def level_of(code: str) -> str:
+    return _LEVELS.get(code[:2], "unknown")
+
+
+def finding_record(f: "Finding", waiver: str = None) -> dict:
+    """One finding in the unified machine-readable schema shared by every
+    level (satellite of ISSUE 12): level, rule, path, line, message,
+    program, severity, waiver."""
+    return {
+        "level": level_of(f.code),
+        "rule": f.code,
+        "path": f.path,
+        "line": f.line,
+        "message": f.message,
+        "program": f.program,
+        "severity": "error",
+        "waiver": waiver,
+    }
+
+
+def sarif_report(findings) -> dict:
+    """SARIF 2.1.0 document for CI annotation (one run, tool `graftcheck`)."""
+    return {
+        "$schema": "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                   "master/Schemata/sarif-schema-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "graftcheck",
+                "informationUri": "docs/static_analysis.md",
+                "rules": [
+                    {"id": code,
+                     "shortDescription": {"text": text},
+                     "properties": {"level": level_of(code)}}
+                    for code, text in sorted(RULES.items())
+                ],
+            }},
+            "results": [
+                {
+                    "ruleId": f.code,
+                    "level": "error",
+                    "message": {"text": f.message},
+                    "locations": [{
+                        "physicalLocation": {
+                            "artifactLocation": {"uri": f.path},
+                            "region": {"startLine": max(f.line, 1)},
+                        },
+                    }],
+                    "properties": {"program": f.program,
+                                   "graftcheckLevel": level_of(f.code)},
+                }
+                for f in findings
+            ],
+        }],
+    }
 
 
 @dataclasses.dataclass(frozen=True)
@@ -126,4 +216,4 @@ class Finding:
         return f"{loc}: {self.code} {self.message}"
 
 
-__all__ = ["Finding", "RULES"]
+__all__ = ["Finding", "RULES", "level_of", "finding_record", "sarif_report"]

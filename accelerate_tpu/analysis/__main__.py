@@ -9,9 +9,15 @@ dp=8 train step needs a mesh, and CI boxes have no accelerator).
 ``--update-baseline`` is atomic across ALL baselines: every level that
 ran appends its new baseline to a sink, and the files
 (``runs/static_baseline.json``, ``runs/sharding_baseline.json``,
-``runs/concurrency_baseline.json``) are committed together via
-write-to-temp + rename only after every level finished — a crash mid-run
-leaves all of them untouched.
+``runs/concurrency_baseline.json``, ``runs/numerics_baseline.json``)
+are committed together via write-to-temp + rename only after every level
+finished — a crash mid-run leaves all of them untouched.
+
+``--json`` emits the unified schema shared by all five levels (level,
+rule, path, line, message, program, severity, waiver); ``--sarif PATH``
+writes a SARIF 2.1.0 report CI can annotate from. ``--changed-only``
+(numerics) lowers only the programs whose source modules differ from the
+merge-base — the <30s pre-commit loop.
 """
 
 from __future__ import annotations
@@ -41,12 +47,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         "programs (G001-G004) and host hot paths (G101-G105).",
     )
     parser.add_argument(
-        "--level", choices=("host", "program", "sharding", "concurrency", "all"),
+        "--level",
+        choices=("host", "program", "sharding", "concurrency", "numerics",
+                 "all"),
         default="all",
         help="host = AST lint only (fast); program = lower and inspect the "
         "jitted programs (G001-G004); sharding = SPMD layout + HBM audit "
         "(G201-G205); concurrency = host lock/thread/gang audit "
-        "(G301-G306, fast); all = everything (default)",
+        "(G301-G306, fast); numerics = dtype/accumulation/RNG audit + "
+        "bf16-vs-f32 drift witness (G401-G405); all = everything (default)",
     )
     parser.add_argument(
         "--root", default=".", help="repo root to lint (default: cwd)"
@@ -67,6 +76,26 @@ def main(argv: Optional[List[str]] = None) -> int:
         "runs/concurrency_baseline.json under --root)",
     )
     parser.add_argument(
+        "--numerics-baseline", default=None,
+        help="numerics/drift baseline path (default: "
+        "runs/numerics_baseline.json under --root)",
+    )
+    parser.add_argument(
+        "--no-witness", action="store_true",
+        help="skip the bf16-vs-f32 drift witness (numerics level; the "
+        "static rules still run)",
+    )
+    parser.add_argument(
+        "--changed-only", action="store_true",
+        help="numerics level: lower only programs whose source modules "
+        "differ from the git merge-base (fast pre-commit mode; skips the "
+        "witness unless analysis/ itself changed)",
+    )
+    parser.add_argument(
+        "--sarif", default=None, metavar="PATH",
+        help="also write a SARIF 2.1.0 report of the surviving findings",
+    )
+    parser.add_argument(
         "--update-baseline", action="store_true",
         help="rewrite the baseline from the current tree instead of "
         "comparing against it",
@@ -83,6 +112,9 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     root = os.path.abspath(args.root)
     baseline = args.baseline or os.path.join(root, "runs", "static_baseline.json")
+    numerics_baseline = args.numerics_baseline or os.path.join(
+        root, "runs", "numerics_baseline.json"
+    )
     sharding_baseline = args.sharding_baseline or os.path.join(
         root, "runs", "sharding_baseline.json"
     )
@@ -132,15 +164,36 @@ def main(argv: Optional[List[str]] = None) -> int:
             baseline_sink=baseline_sink,
         ))
 
+    if args.level in ("numerics", "all"):
+        _pin_cpu_backend()
+        from .numerics import run_numerics_checks
+
+        findings.extend(run_numerics_checks(
+            baseline_path=numerics_baseline,
+            update_baseline=args.update_baseline,
+            baseline_sink=baseline_sink,
+            with_witness=not args.no_witness,
+            changed_only=args.changed_only,
+            repo_root=root,
+        ))
+
     if args.update_baseline and baseline_sink:
         from .lowering import atomic_write_json
 
         for path, obj in baseline_sink:
             atomic_write_json(obj, path)
 
+    if args.sarif:
+        from . import sarif_report
+        from .lowering import atomic_write_json
+
+        atomic_write_json(sarif_report(findings), args.sarif)
+
     if args.as_json:
+        from . import finding_record
+
         print(json.dumps(
-            [dataclasses_asdict(f) for f in findings], indent=2, sort_keys=True
+            [finding_record(f) for f in findings], indent=2, sort_keys=True
         ))
     else:
         for f in findings:
@@ -154,12 +207,6 @@ def main(argv: Optional[List[str]] = None) -> int:
         else:
             print("graftcheck: clean")
     return 1 if findings else 0
-
-
-def dataclasses_asdict(f):
-    import dataclasses
-
-    return dataclasses.asdict(f)
 
 
 if __name__ == "__main__":

@@ -56,6 +56,8 @@ _RULE_TOKENS = {
     "G103": "raise-ok",
     "G104": "lock-ok",
     "G105": "fault-ok",
+    # Level 5's AST half (analysis/numerics.py) shares this waiver table
+    "G404": "key-ok",
 }
 
 FAULT_ENV = "ACCELERATE_TPU_FAULT_INJECT"

@@ -489,3 +489,163 @@ def leaf_count(tree) -> int:
     import jax
 
     return len(jax.tree_util.tree_leaves(tree))
+
+
+# --------------------------------------------- numerics (graftcheck Level 5)
+# StableHLO text parsers shared by analysis/numerics.py. All of these work on
+# ``lowered.as_text()`` (pre-optimization StableHLO), where dtypes are still
+# the ones jax traced — the CPU backend's later f64→f32 legalization etc.
+# never degrades them.
+
+def count_primitives(closed_jaxpr) -> dict:
+    """Primitive name -> equation count over a (Closed)Jaxpr, recursing into
+    sub-jaxprs. Unlike :func:`collect_primitives` (a set) this counts call
+    SITES — the G404 jaxpr check needs to distinguish one sampler from two."""
+    from jax._src import core as jcore
+
+    jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
+    acc: dict = {}
+
+    def visit(jx):
+        for eqn in jx.eqns:
+            acc[eqn.primitive.name] = acc.get(eqn.primitive.name, 0) + 1
+            for val in eqn.params.values():
+                for sub in _subjaxprs(val, jcore):
+                    visit(sub)
+
+    visit(jaxpr)
+    return acc
+
+
+def flat_out_avals(lowered):
+    """Flattened OUTPUT avals of a Lowered/Traced, in @main result order.
+
+    jax's Lowered carries per-output ShapeDtypeStructs in ``out_info``
+    (0.4.30+); fall back to the compiled signature's ``out_avals``."""
+    import jax
+
+    info = getattr(lowered, "out_info", None)
+    if info is not None:
+        return jax.tree_util.tree_leaves(info)
+    return list(getattr(lowered, "out_avals", []))
+
+
+# 'tensor<2x8x64xbf16>' -> 'bf16'; 'tensor<f32>' (rank 0) -> 'f32';
+# 'tensor<4x?xi8>' (dynamic dim) -> 'i8'
+def tensor_elem_type(tensor: str) -> str:
+    m = re.search(r"tensor<(?:[\d?]+x)*([^x>]+)>", tensor)
+    return m.group(1) if m else "?"
+
+
+_F64_RE = re.compile(r"tensor<(?:[\d?]+x)*f64>")
+
+
+def f64_lines(stablehlo_text: str):
+    """(1-based line number, stripped line) of every op touching an f64
+    tensor — any hit in a hot program is a G401 unintended promotion."""
+    hits = []
+    for i, line in enumerate(stablehlo_text.splitlines(), 1):
+        if _F64_RE.search(line):
+            hits.append((i, line.strip()))
+    return hits
+
+
+# 'stablehlo.dot_general ... : (tensor<AxBxbf16>, tensor<BxCxbf16>) ->
+# tensor<AxCxbf16>' / same for convolution. The trailing function-type
+# signature carries both operand and result element types.
+_DOT_RE = re.compile(
+    r"stablehlo\.(dot_general|convolution)\b.*?:\s*"
+    r"\((tensor<[^>]+>),\s*(tensor<[^>]+>)\)\s*->\s*(tensor<[^>]+>)"
+)
+
+# Dtypes whose dot_general MUST accumulate wider (f32) per the numerics
+# contract; f32/f64 dots accumulate natively.
+_NARROW = frozenset({"bf16", "f16", "i8", "si8", "ui8",
+                     "f8E4M3FN", "f8E5M2", "f8E4M3FNUZ", "f8E5M2FNUZ"})
+
+
+def narrow_dot_ops(stablehlo_text: str):
+    """Every dot_general/convolution with narrow (bf16/f16/int8/fp8)
+    operands: dicts of ``line`` (1-based), ``op``, ``lhs``/``rhs``/``out``
+    element types, and ``accumulates`` — True when the result element type
+    is wider than the operands (i.e. ``preferred_element_type`` widened the
+    accumulator, the G402 contract)."""
+    out = []
+    for i, line in enumerate(stablehlo_text.splitlines(), 1):
+        m = _DOT_RE.search(line)
+        if not m:
+            continue
+        lhs = tensor_elem_type(m.group(2))
+        rhs = tensor_elem_type(m.group(3))
+        res = tensor_elem_type(m.group(4))
+        if lhs in _NARROW or rhs in _NARROW:
+            out.append(dict(line=i, op=m.group(1), lhs=lhs, rhs=rhs, out=res,
+                            accumulates=res not in _NARROW))
+    return out
+
+
+# Compact reduce print form:
+#   %1 = stablehlo.reduce(%0 init: %cst) applies stablehlo.add across
+#        dimensions = [0] : (tensor<2x3xbf16>, tensor<bf16>) -> tensor<3xbf16>
+_REDUCE_RE = re.compile(
+    r"stablehlo\.reduce\(.*?\)\s+applies\s+stablehlo\.add\s+across\s+"
+    r"dimensions\s*=\s*\[([\d, ]*)\]\s*:\s*\(tensor<([^>]+)>,"
+)
+
+
+def narrow_add_reduces(stablehlo_text: str):
+    """Add-reductions whose operand element type is bf16/f16 — sums
+    accumulated in half precision (``jnp.sum`` upcasts internally, so these
+    only appear via raw ``lax.reduce``, explicitly narrow reductions, or
+    einsum decompositions). ``elements`` is the reduced-element count
+    (product of the reduced dims) so callers can separate long drift-prone
+    accumulations from short per-head partial sums."""
+    out = []
+    for i, line in enumerate(stablehlo_text.splitlines(), 1):
+        m = _REDUCE_RE.search(line)
+        if not m:
+            continue
+        elem = tensor_elem_type(f"tensor<{m.group(2)}>")
+        if elem not in ("bf16", "f16"):
+            continue
+        dims = [int(d) for d in m.group(1).replace(" ", "").split(",") if d]
+        shape = [int(s) for s in m.group(2).split("x")[:-1] if s.isdigit()]
+        n = 1
+        for d in dims:
+            if d < len(shape):
+                n *= shape[d]
+        out.append(dict(line=i, elem=elem, elements=n))
+    return out
+
+
+# scatter lowers in the quoted generic form with the combiner as a region:
+#   "stablehlo.scatter"(%a, %i, %u) <{...}> ({
+#     ^bb0(%arg0: tensor<f32>, %arg1: tensor<f32>):
+#       %x = stablehlo.add %arg0, %arg1 : tensor<f32>
+#       stablehlo.return %x : tensor<f32>
+#   }) : ...
+_SCATTER_RE = re.compile(
+    r'"stablehlo\.scatter"\(.*?\}\)', re.DOTALL)
+
+
+def unordered_reduction_inventory(stablehlo_text: str) -> dict:
+    """op -> count of lowered ops with unordered-reduction semantics (the
+    G405 inventory): scatter-add combiners, select_and_scatter, and the
+    cross-replica reduces whose contribution order the runtime does not fix.
+    Plain elementwise/reduce ops are deterministic on TPU and not counted."""
+    inv: dict = {}
+
+    def bump(op, n=1):
+        if n:
+            inv[op] = inv.get(op, 0) + n
+
+    for m in _SCATTER_RE.finditer(stablehlo_text):
+        body = m.group(0)
+        if "stablehlo.add" in body:
+            bump("scatter-add")
+    bump("select_and_scatter", stablehlo_text.count("select_and_scatter"))
+    bump("reduce_scatter", len(re.findall(
+        r"stablehlo\.reduce_scatter\b", stablehlo_text)))
+    bump("all_reduce", len(re.findall(
+        r"stablehlo\.all_reduce\b", stablehlo_text)))
+    return inv

@@ -1,0 +1,1025 @@
+"""graftcheck Level 5: numerics, precision & RNG-discipline audit.
+
+The repo's numerics contract lives in scattered conventions — f32
+accumulation for narrow matmuls, f32 quantization scales and master
+state, per-slot PRNG keys that are split/folded rather than reused.
+This level makes the contract checkable:
+
+  G401  unintended dtype promotion — any f64 tensor in a lowered hot
+        program; a donated input aliased to a WIDER output (a bf16→f32
+        round-trip growing live HBM past the declared policy); a drift-
+        witness value outside its committed bound
+  G402  accumulation-dtype discipline — int8/fp8 dots must not keep the
+        narrow result type and LONG bf16/f16 add-reduces (>128 reduced
+        elements: softmax denominators, logsumexp, statistics) are
+        forbidden (hard findings); the counts of bf16-accumulating dots
+        and of SHORT bf16 add-reduces (einsum-decomposition partial sums
+        over head_dim/n_rep in the attention backward — policy-conformant
+        bf16 compute) are inventory-gated per program so new ones fail
+        until reviewed
+  G403  state-dtype contract — master weights, optimizer moments (modulo
+        the declared ``mu`` policy dtype), the loss scalar, and every
+        quantization scale (kv pool, block quant) must be f32
+  G404  RNG-key discipline — an AST taint pass over the package plus a
+        jaxpr check per program: a key consumed twice, or consumed inside
+        a loop without a per-iteration split/fold_in, is a finding; a
+        program drawing ≥2 random samples with no split/fold_in is too
+  G405  non-determinism inventory — lowered ops with unordered-reduction
+        semantics (scatter-add combiners, select_and_scatter,
+        cross-replica reduces) gated against the committed inventory
+
+The static half reuses the Level 1 program builders (the REAL fused train
+step and engine programs, AOT-lowered, never executed). The runtime half
+(:func:`run_drift_witness`) executes the tiny engine configs and the fused
+train step under f32 and under the bf16 policy and gates the observed
+drift against ``runs/numerics_baseline.json`` — the same bounds ROADMAP
+item 2's Pallas kernels will reuse as their parity-gate contract.
+
+Waivers: program-scoped JSON regexes with mandatory reasons in the
+baseline's ``waivers`` table (Level 3 semantics, same matcher), plus the
+line comment ``# graft: key-ok`` for G404 AST findings.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import subprocess
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+from . import Finding
+from .lowering import (
+    aliased_input_indices,
+    count_primitives,
+    f64_lines,
+    flat_in_avals,
+    flat_out_avals,
+    narrow_add_reduces,
+    narrow_dot_ops,
+    unordered_reduction_inventory,
+)
+
+BASELINE_PATH = os.path.join("runs", "numerics_baseline.json")
+
+# The declared policy: what "correct" dtypes mean for this tree. Stored in
+# the baseline (reviewable, like the waiver reasons) and used as the G403
+# reference. ``mu`` is bf16 DELIBERATELY — the train step is prepared with
+# optax.adamw(mu_dtype=bf16), the first-moment half-precision trade the
+# sharding audit also models.
+POLICY = {
+    "compute": "bfloat16",
+    "param": "float32",
+    "mu": "bfloat16",
+    "loss": "float32",
+    "scales": "float32",
+}
+
+# int8 KV dequant drift bound: half a quantization step (0.5/127 ≈ 3.94e-3)
+# of per-position amax, rounded up. FIXED, not remeasured on re-baseline —
+# this is the parity contract a fused Pallas dequant kernel must meet.
+KV_INT8_BOUND = 4.0e-3
+
+_INT_NARROW = frozenset({"i8", "si8", "ui8", "f8E4M3FN", "f8E5M2",
+                         "f8E4M3FNUZ", "f8E5M2FNUZ"})
+
+# A bf16 add-reduce over more elements than this is a hard G402 finding
+# (softmax denominators, logsumexp, mean/var, grad-norm — drift compounds
+# with length). Shorter ones (head_dim=16 / n_rep partial sums that XLA
+# materializes when decomposing the attention-backward einsums) are within
+# the declared bf16 compute policy and only inventory-gated.
+LONG_REDUCE_ELEMS = 128
+
+
+# --------------------------------------------------------------------------
+# G401 — unintended promotion
+# --------------------------------------------------------------------------
+
+def check_f64(rec) -> List[Finding]:
+    hits = f64_lines(rec.lowered.as_text())
+    if not hits:
+        return []
+    line, text = hits[0]
+    return [Finding(
+        "G401", rec.source, 1,
+        f"{rec.group}/{rec.name}: {len(hits)} lowered op(s) touch f64 "
+        f"(first at StableHLO line {line}: {text[:80]}) — double precision "
+        "never belongs in a hot program",
+        program=f"{rec.group}/{rec.name}",
+    )]
+
+
+def check_widening_aliases(rec) -> List[Finding]:
+    """Donated input aliased to a WIDER output: live state silently grew
+    (e.g. a bf16 cache coming back f32 doubles the arena every step)."""
+    text = rec.lowered.as_text()
+    in_avals = flat_in_avals(rec.lowered)
+    out_avals = flat_out_avals(rec.lowered)
+    findings = []
+    for i, out_idx in sorted(aliased_input_indices(text).items()):
+        if out_idx < 0 or i >= len(in_avals) or out_idx >= len(out_avals):
+            continue  # sharded donor: pairing decided at compile time
+        w_in = in_avals[i].dtype.itemsize
+        w_out = out_avals[out_idx].dtype.itemsize
+        if w_out > w_in:
+            findings.append(Finding(
+                "G401", rec.source, 1,
+                f"{rec.group}/{rec.name}: donated input {i} "
+                f"({in_avals[i].dtype}) aliased to wider output {out_idx} "
+                f"({out_avals[out_idx].dtype}) — live state widened past "
+                "the declared policy",
+                program=f"{rec.group}/{rec.name}",
+            ))
+    return findings
+
+
+# --------------------------------------------------------------------------
+# G402 — accumulation discipline
+# --------------------------------------------------------------------------
+
+def check_accumulation(rec) -> Tuple[List[Finding], int, int]:
+    """Hard findings (int8/fp8 dots keeping the narrow type, LONG bf16/f16
+    add-reduces) plus the per-program counts of bf16-accumulating dots and
+    of short bf16 add-reduces — the inventory numbers gated against the
+    baseline."""
+    text = rec.lowered.as_text()
+    findings = []
+    narrow_count = 0
+    int_bad = []
+    for d in narrow_dot_ops(text):
+        if (d["lhs"] in _INT_NARROW or d["rhs"] in _INT_NARROW) and not d["accumulates"]:
+            int_bad.append(d)
+        elif not d["accumulates"]:
+            narrow_count += 1
+    if int_bad:
+        d = int_bad[0]
+        findings.append(Finding(
+            "G402", rec.source, 1,
+            f"{rec.group}/{rec.name}: {len(int_bad)} int8/fp8 {d['op']}(s) "
+            f"keep the narrow result type ({d['lhs']}x{d['rhs']}->{d['out']}) "
+            "— quantized dots must accumulate f32 "
+            "(preferred_element_type=jnp.float32)",
+            program=f"{rec.group}/{rec.name}",
+        ))
+    reduces = narrow_add_reduces(text)
+    long_reduces = [r for r in reduces if r["elements"] > LONG_REDUCE_ELEMS]
+    short_count = len(reduces) - len(long_reduces)
+    if long_reduces:
+        r = long_reduces[0]
+        findings.append(Finding(
+            "G402", rec.source, 1,
+            f"{rec.group}/{rec.name}: {len(long_reduces)} add-reduce(s) "
+            f"over >{LONG_REDUCE_ELEMS} elements accumulate in {r['elem']} "
+            f"(first reduces {r['elements']} elements at StableHLO line "
+            f"{r['line']}) — sums feeding softmax/logsumexp/mean-var/"
+            "grad-norm must compute in f32",
+            program=f"{rec.group}/{rec.name}",
+        ))
+    return findings, narrow_count, short_count
+
+
+def _compare_counts(section: str, noun: str, observed: Dict[str, int],
+                    baseline: Dict[str, Any],
+                    baseline_path: str) -> List[Finding]:
+    """Per-program counters gated against a baseline section: growth
+    fails, shrinkage passes, an unknown program fails until re-baselined."""
+    base = baseline.get(section, {})
+    findings = []
+    for prog, count in sorted(observed.items()):
+        known = base.get(prog)
+        if known is None:
+            if base:
+                findings.append(Finding(
+                    "G402", baseline_path, 1,
+                    f"no {section} baseline for program '{prog}' "
+                    "(re-baseline with --update-baseline if intended)",
+                    program=prog,
+                ))
+            continue
+        if count > int(known):
+            findings.append(Finding(
+                "G402", baseline_path, 1,
+                f"'{prog}': {count} {noun} vs baseline {known} — new "
+                "narrow accumulation must go through f32 or be "
+                "re-baselined with a review",
+                program=prog,
+            ))
+    return findings
+
+
+def compare_accum(observed: Dict[str, int], baseline: Dict[str, Any],
+                  baseline_path: str) -> List[Finding]:
+    """bf16-accumulating dot counts: growth fails, shrinkage passes."""
+    return _compare_counts("accum", "bf16-accumulating dot(s)", observed,
+                           baseline, baseline_path)
+
+
+def compare_reduce(observed: Dict[str, int], baseline: Dict[str, Any],
+                   baseline_path: str) -> List[Finding]:
+    """Short bf16 add-reduce counts (einsum-decomposition partial sums):
+    growth fails, shrinkage passes."""
+    return _compare_counts("reduce", "short bf16 add-reduce(s)", observed,
+                           baseline, baseline_path)
+
+
+# --------------------------------------------------------------------------
+# G403 — state-dtype contract
+# --------------------------------------------------------------------------
+
+def _path_str(key_path) -> str:
+    import jax
+
+    return jax.tree_util.keystr(key_path).lower()
+
+
+def check_train_state(state: Dict[str, Any]) -> List[Finding]:
+    """Master weights f32; moments f32 except ``mu`` leaves, which may be
+    the declared policy dtype; integer leaves (counts) exempt."""
+    import jax
+    import jax.numpy as jnp
+
+    src = os.path.join("accelerate_tpu", "accelerator.py")
+    findings = []
+    mu_ok = {POLICY["mu"], "float32"}
+    for tree_name, tree in state.items():
+        leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+        for key_path, leaf in leaves:
+            dtype = jnp.dtype(leaf.dtype)
+            if not jnp.issubdtype(dtype, jnp.floating):
+                continue
+            path = _path_str(key_path)
+            if tree_name == "opt_state" and ".mu" in path:
+                allowed = mu_ok
+            else:
+                allowed = {"float32"}
+            if dtype.name not in allowed:
+                findings.append(Finding(
+                    "G403", src, 1,
+                    f"train_step/fused_train_step: {tree_name} leaf "
+                    f"{path or '<root>'} is {dtype.name}, contract requires "
+                    f"{'/'.join(sorted(allowed))} (ZeRO resharding must not "
+                    "demote master state)",
+                    program="train_step/fused_train_step",
+                ))
+    return findings
+
+
+def check_loss_output(rec) -> List[Finding]:
+    """The train step's scalar float output (the loss) must be f32."""
+    import jax.numpy as jnp
+
+    findings = []
+    for idx, av in enumerate(flat_out_avals(rec.lowered)):
+        dtype = jnp.dtype(av.dtype)
+        if av.shape == () and jnp.issubdtype(dtype, jnp.floating):
+            if dtype.name != POLICY["loss"]:
+                findings.append(Finding(
+                    "G403", rec.source, 1,
+                    f"{rec.group}/{rec.name}: scalar float output {idx} "
+                    f"(the loss) is {dtype.name}, contract requires "
+                    f"{POLICY['loss']}",
+                    program=f"{rec.group}/{rec.name}",
+                ))
+    return findings
+
+
+def check_demoting_aliases(rec) -> List[Finding]:
+    """Donated f32 state aliased to a NARROWER output — the silent
+    master-weight demotion ZeRO-style resharding can introduce."""
+    text = rec.lowered.as_text()
+    in_avals = flat_in_avals(rec.lowered)
+    out_avals = flat_out_avals(rec.lowered)
+    findings = []
+    for i, out_idx in sorted(aliased_input_indices(text).items()):
+        if out_idx < 0 or i >= len(in_avals) or out_idx >= len(out_avals):
+            continue
+        if (in_avals[i].dtype.itemsize > out_avals[out_idx].dtype.itemsize
+                and i in rec.donated):
+            findings.append(Finding(
+                "G403", rec.source, 1,
+                f"{rec.group}/{rec.name}: donated input {i} "
+                f"({in_avals[i].dtype}) comes back narrower as output "
+                f"{out_idx} ({out_avals[out_idx].dtype}) — state demoted",
+                program=f"{rec.group}/{rec.name}",
+            ))
+    return findings
+
+
+def check_engine_scales(engine) -> List[Finding]:
+    """Every float leaf of the int8 engine's donated cache tree is a scale
+    table and must be f32 (the pools themselves are int8)."""
+    import jax
+    import jax.numpy as jnp
+
+    src = os.path.join("accelerate_tpu", "kvcache.py")
+    findings = []
+    leaves = jax.tree_util.tree_flatten_with_path(engine._donated["cache"])[0]
+    for key_path, leaf in leaves:
+        dtype = jnp.dtype(leaf.dtype)
+        if jnp.issubdtype(dtype, jnp.floating) and dtype.name != POLICY["scales"]:
+            findings.append(Finding(
+                "G403", src, 1,
+                f"engine.paged_int8: cache scale leaf {_path_str(key_path)} "
+                f"is {dtype.name}, contract requires {POLICY['scales']}",
+                program="engine.paged_int8/decode_step",
+            ))
+    return findings
+
+
+def check_quant_scales() -> List[Finding]:
+    """Execute the tiny quantizers and check every scale dtype is f32 —
+    direct, because these run on the host (numpy) or outside any lowered
+    program."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from accelerate_tpu.kvcache import kv_quantize
+    from accelerate_tpu.utils.quantization import QuantizedLeaf, _quantize_array
+
+    findings = []
+    rng = np.random.default_rng(0)
+
+    _q, scale = kv_quantize(jnp.asarray(rng.standard_normal((2, 4, 2, 4)),
+                                        jnp.float32))
+    if jnp.dtype(scale.dtype).name != POLICY["scales"]:
+        findings.append(Finding(
+            "G403", os.path.join("accelerate_tpu", "kvcache.py"), 1,
+            f"kv_quantize scale dtype is {scale.dtype}, contract requires "
+            f"{POLICY['scales']}",
+            program="kvcache.kv_quantize",
+        ))
+
+    arr = rng.standard_normal((8, 4)).astype(np.float32)
+    for block in (None, 4):
+        q, scales = _quantize_array(arr, bits=8, block_size=block)
+        leaf = QuantizedLeaf(q, jnp.asarray(scales), jnp.float32,
+                             block_size=block)
+        if np.dtype(scales.dtype).name != POLICY["scales"]:
+            findings.append(Finding(
+                "G403", os.path.join("accelerate_tpu", "utils",
+                                     "quantization.py"), 1,
+                f"_quantize_array(block_size={block}) scale dtype is "
+                f"{scales.dtype}, contract requires {POLICY['scales']}",
+                program="quantization._quantize_array",
+            ))
+        if jnp.dtype(leaf.scales.dtype).name != POLICY["scales"]:
+            findings.append(Finding(
+                "G403", os.path.join("accelerate_tpu", "utils",
+                                     "quantization.py"), 1,
+                f"QuantizedLeaf(block_size={block}) scale dtype is "
+                f"{leaf.scales.dtype}, contract requires {POLICY['scales']}",
+                program="quantization.QuantizedLeaf",
+            ))
+    return findings
+
+
+# --------------------------------------------------------------------------
+# G404 — RNG-key discipline (AST half)
+# --------------------------------------------------------------------------
+
+_DERIVERS = frozenset({"split", "fold_in", "key", "PRNGKey", "wrap_key_data",
+                       "clone", "make_rng_key"})
+_SAMPLERS = frozenset({
+    "uniform", "normal", "categorical", "bernoulli", "gumbel", "randint",
+    "truncated_normal", "exponential", "permutation", "choice", "laplace",
+    "beta", "gamma", "poisson", "dirichlet", "rademacher", "bits", "ball",
+    "cauchy", "logistic", "loggamma", "maxwell", "pareto", "rayleigh",
+    "weibull_min", "multivariate_normal", "orthogonal",
+})
+# numpy/torch RNG namespaces take no key — never classify their calls
+_HOST_RNG_ROOTS = frozenset({"np", "numpy", "torch"})
+
+
+def _attr_chain(node) -> List[str]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return parts[::-1]  # root first
+
+
+def _classify_call(call: ast.Call) -> Tuple[Optional[str], Optional[ast.expr]]:
+    """('deriver'|'sampler', key_arg) for jax.random-style calls, else
+    (None, None). Unwraps one level of ``jax.vmap(fn)(args)``."""
+    func = call.func
+    if (isinstance(func, ast.Call) and _attr_chain(func.func)[-1:] == ["vmap"]
+            and func.args):
+        inner_chain = _attr_chain(func.args[0])
+    else:
+        inner_chain = _attr_chain(func)
+    if not inner_chain or inner_chain[0] in _HOST_RNG_ROOTS:
+        return None, None
+    tail = inner_chain[-1]
+    qualified = len(inner_chain) > 1 and "random" in inner_chain[:-1]
+    if tail in _DERIVERS and (qualified or tail == "make_rng_key"):
+        return "deriver", None
+    if tail in _SAMPLERS and qualified:
+        return "sampler", call.args[0] if call.args else None
+    return None, None
+
+
+def _key_id(expr) -> Optional[Tuple[str, Any]]:
+    """Trackable identity of a key expression: a bare name, or a
+    constant-index subscript of a name (``keys[3]``). Anything else —
+    slices, call results — is untracked (conservative: no finding)."""
+    if isinstance(expr, ast.Name):
+        return (expr.id, None)
+    if (isinstance(expr, ast.Subscript) and isinstance(expr.value, ast.Name)
+            and isinstance(expr.slice, ast.Constant)):
+        return (expr.value.id, expr.slice.value)
+    return None
+
+
+class _RngLint:
+    """Per-function forward pass tracking key derivation and consumption.
+
+    States per tracked id: ('fresh'|'consumed'|'unknown', assignment loop
+    depth). Two findings: (a) the same key id consumed by two samplers
+    without re-derivation in between, (b) a key consumed inside a loop
+    whose (last) derivation is outside that loop — every iteration reuses
+    the same key."""
+
+    def __init__(self, relpath: str, waivers: dict):
+        self.relpath = relpath
+        self.waivers = waivers
+        self.findings: List[Finding] = []
+
+    # -- entry ------------------------------------------------------------
+    def lint(self, tree: ast.AST) -> List[Finding]:
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._lint_function(node)
+        return self.findings
+
+    def _lint_function(self, fn) -> None:
+        self.state: Dict[Tuple[str, Any], Tuple[str, int]] = {}
+        self._scan(fn.body, depth=0)
+
+    # -- statements -------------------------------------------------------
+    def _scan(self, stmts, depth: int) -> None:
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue  # nested defs get their own pass
+            if isinstance(st, ast.Assign):
+                self._visit_expr(st.value, depth)
+                for target in st.targets:
+                    self._assign(target, st.value, depth)
+                continue
+            if isinstance(st, ast.AnnAssign) and st.value is not None:
+                self._visit_expr(st.value, depth)
+                self._assign(st.target, st.value, depth)
+                continue
+            if isinstance(st, (ast.For, ast.AsyncFor)):
+                self._visit_expr(st.iter, depth)
+                self._assign(st.target, None, depth + 1)
+                self._scan(st.body, depth + 1)
+                self._scan(st.orelse, depth)
+                continue
+            if isinstance(st, ast.While):
+                self._visit_expr(st.test, depth + 1)
+                self._scan(st.body, depth + 1)
+                self._scan(st.orelse, depth)
+                continue
+            if isinstance(st, ast.If):
+                self._visit_expr(st.test, depth)
+                self._scan(st.body, depth)
+                self._scan(st.orelse, depth)
+                continue
+            if isinstance(st, ast.With):
+                for item in st.items:
+                    self._visit_expr(item.context_expr, depth)
+                self._scan(st.body, depth)
+                continue
+            if isinstance(st, ast.Try):
+                self._scan(st.body, depth)
+                for h in st.handlers:
+                    self._scan(h.body, depth)
+                self._scan(st.orelse, depth)
+                self._scan(st.finalbody, depth)
+                continue
+            for child in ast.iter_child_nodes(st):
+                if isinstance(child, ast.expr):
+                    self._visit_expr(child, depth)
+
+    # -- assignment -------------------------------------------------------
+    def _fresh_value(self, value) -> bool:
+        if value is None:
+            return False
+        if isinstance(value, ast.Call):
+            kind, _ = _classify_call(value)
+            return kind == "deriver"
+        if isinstance(value, ast.Subscript) and isinstance(value.value, ast.Name):
+            st = self.state.get((value.value.id, None))
+            return st is not None and st[0] == "fresh"
+        return False
+
+    def _assign(self, target, value, depth: int) -> None:
+        fresh = self._fresh_value(value)
+        status = "fresh" if fresh else "unknown"
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._assign(elt, value, depth)
+            return
+        kid = _key_id(target)
+        if kid is None:
+            return
+        # re-derivation of a name also resets all its tracked subscripts
+        if kid[1] is None:
+            for other in [k for k in self.state if k[0] == kid[0]]:
+                del self.state[other]
+        self.state[kid] = (status, depth)
+
+    # -- expressions ------------------------------------------------------
+    def _visit_expr(self, expr, depth: int) -> None:
+        from .host import _waived
+
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            kind, key_arg = _classify_call(node)
+            if kind != "sampler" or key_arg is None:
+                continue
+            kid = _key_id(key_arg)
+            if kid is None:
+                continue
+            line = node.lineno
+            status, assign_depth = self.state.get(kid, ("unknown", 0))
+            label = kid[0] if kid[1] is None else f"{kid[0]}[{kid[1]}]"
+            if status == "consumed":
+                if not _waived("G404", line, self.waivers):
+                    self.findings.append(Finding(
+                        "G404", self.relpath, line,
+                        f"key '{label}' consumed by a second sampler "
+                        "without split/fold_in — reusing a PRNG key "
+                        "correlates the two draws",
+                    ))
+            elif depth > 0 and assign_depth < depth:
+                if not _waived("G404", line, self.waivers):
+                    self.findings.append(Finding(
+                        "G404", self.relpath, line,
+                        f"key '{label}' consumed inside a loop but derived "
+                        "outside it — every iteration draws from the same "
+                        "key (fold_in the loop counter)",
+                    ))
+            self.state[kid] = ("consumed", assign_depth)
+
+
+def lint_rng_source(text: str, relpath: str) -> List[Finding]:
+    from .host import parse_waivers
+
+    try:
+        tree = ast.parse(text)
+    except SyntaxError:
+        return []
+    return _RngLint(relpath, parse_waivers(text)).lint(tree)
+
+
+def lint_rng_package(repo_root: str) -> List[Finding]:
+    from .host import _walk_py
+
+    pkg = os.path.join(repo_root, "accelerate_tpu")
+    findings: List[Finding] = []
+    for path in _walk_py(pkg):
+        rel = os.path.relpath(path, repo_root)
+        with open(path, encoding="utf-8") as f:
+            findings.extend(lint_rng_source(f.read(), rel))
+    return findings
+
+
+def check_rng_jaxpr(rec) -> List[Finding]:
+    """≥2 random draws in one program with zero split/fold_in means both
+    samplers consumed the same traced key."""
+    if rec.jaxpr is None:
+        return []
+    counts = count_primitives(rec.jaxpr)
+    draws = counts.get("random_bits", 0)
+    derives = counts.get("random_split", 0) + counts.get("random_fold_in", 0)
+    if draws >= 2 and derives == 0:
+        return [Finding(
+            "G404", rec.source, 1,
+            f"{rec.group}/{rec.name}: {draws} random draws but no "
+            "split/fold_in in the jaxpr — samplers share one key",
+            program=f"{rec.group}/{rec.name}",
+        )]
+    return []
+
+
+# --------------------------------------------------------------------------
+# G405 — non-determinism inventory
+# --------------------------------------------------------------------------
+
+def compare_nondeterminism(observed: Dict[str, Dict[str, int]],
+                           baseline: Dict[str, Any],
+                           baseline_path: str) -> List[Finding]:
+    base = baseline.get("nondeterminism", {})
+    findings = []
+    for prog, inv in sorted(observed.items()):
+        known = base.get(prog)
+        if known is None:
+            if inv and base:
+                findings.append(Finding(
+                    "G405", baseline_path, 1,
+                    f"no non-determinism inventory for program '{prog}' "
+                    f"but it lowers {inv} — re-baseline after review",
+                    program=prog,
+                ))
+            continue
+        for op, count in sorted(inv.items()):
+            if count > int(known.get(op, 0)):
+                findings.append(Finding(
+                    "G405", baseline_path, 1,
+                    f"'{prog}': {op} x{count} vs inventory x"
+                    f"{known.get(op, 0)} — new unordered-reduction op "
+                    "(review run-to-run determinism, then re-baseline)",
+                    program=prog,
+                ))
+    return findings
+
+
+# --------------------------------------------------------------------------
+# drift witness (runtime half)
+# --------------------------------------------------------------------------
+
+WITNESS_NAMES = ("forward", "train_step", "engine.dense", "engine.paged",
+                 "engine.spec", "kv.int8_dequant")
+
+
+def _tiny(compute_dtype):
+    from accelerate_tpu.models.llama import LlamaConfig, create_llama
+
+    return create_llama(
+        LlamaConfig.tiny(num_hidden_layers=1, compute_dtype=compute_dtype),
+        seed=0,
+    )
+
+
+def _witness_forward() -> Dict[str, float]:
+    import jax.numpy as jnp
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(1, 32, size=(2, 16)), jnp.int32)
+    logits = {}
+    for cdt in (jnp.float32, jnp.bfloat16):
+        logits[jnp.dtype(cdt).name] = np.asarray(_tiny(cdt)(ids), np.float32)
+    ref = logits["float32"]
+    denom = max(float(np.max(np.abs(ref))), 1e-6)
+    err = float(np.max(np.abs(logits["bfloat16"] - ref))) / denom
+    return {"metric": "max_rel_err", "value": err}
+
+
+def _witness_train_step() -> Dict[str, float]:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.models.llama import llama_loss
+    from accelerate_tpu.parallelism_config import ParallelismConfig
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(1, 32, size=(8, 16)), jnp.int32)
+    losses = {}
+    for cdt in (jnp.float32, jnp.bfloat16):
+        for s in (AcceleratorState, GradientState, PartialState):
+            s._reset_state()
+        try:
+            acc = Accelerator(
+                parallelism_config=ParallelismConfig(dp_shard_size=8))
+            model = _tiny(cdt)
+            model, _opt = acc.prepare(model, optax.adamw(1e-3))
+            model.policy = None
+            step = acc.train_step(llama_loss, max_grad_norm=1.0)
+            loss = step({"input_ids": ids})
+            losses[jnp.dtype(cdt).name] = float(jax.device_get(loss))
+        finally:
+            for s in (AcceleratorState, GradientState, PartialState):
+                s._reset_state()
+    ref = losses["float32"]
+    err = abs(losses["bfloat16"] - ref) / max(abs(ref), 1e-6)
+    return {"metric": "loss_rel_err", "value": float(err)}
+
+
+def _witness_engine(kind: str) -> Dict[str, float]:
+    import jax.numpy as jnp
+    import numpy as np
+
+    from accelerate_tpu.engine import ContinuousBatchingEngine
+
+    kwargs = {
+        "engine.dense": {},
+        "engine.spec": {"spec": "ngram"},
+        "engine.paged": {"kv_cache": "paged", "block_size": 4},
+    }[kind]
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, 32, size=n).tolist() for n in (3, 5, 4)]
+    rows = {}
+    for cdt in (jnp.float32, jnp.bfloat16):
+        model = _tiny(cdt)
+        eng = ContinuousBatchingEngine(
+            model, slots=2, max_len=16, readback_lag=0, **kwargs)
+        occs = []
+        for p in prompts:
+            if eng.free_slots() == 0:
+                eng.drain()
+            occs.append(eng.insert(p, max_new_tokens=4, pad_token_id=0))
+        eng.drain()
+        rows[jnp.dtype(cdt).name] = np.concatenate(
+            [np.asarray(o.output_row()) for o in occs])
+    a, b = rows["float32"], rows["bfloat16"]
+    mismatch = float(np.mean(a != b))
+    return {"metric": "token_mismatch_fraction", "value": mismatch}
+
+
+def _witness_kv_int8() -> Dict[str, float]:
+    import jax.numpy as jnp
+    import numpy as np
+
+    from accelerate_tpu.kvcache import kv_dequantize, kv_quantize
+
+    rng = np.random.default_rng(0)
+    x = np.asarray(rng.standard_normal((4, 8, 2, 4)) * 3.0, np.float32)
+    q, scale = kv_quantize(jnp.asarray(x))
+    deq = np.asarray(kv_dequantize(q, scale, jnp.float32), np.float32)
+    amax = np.maximum(np.max(np.abs(x), axis=(-1, -2), keepdims=True), 1e-6)
+    err = float(np.max(np.abs(x - deq) / amax))
+    return {"metric": "max_abs_err_over_amax", "value": err}
+
+
+def run_drift_witness(names: Optional[Sequence[str]] = None) -> Dict[str, dict]:
+    """Execute the bf16-vs-f32 drift probes; ``names`` restricts to a
+    subset (the fast suite runs forward/train_step/engine.dense/kv)."""
+    wanted = list(names) if names is not None else list(WITNESS_NAMES)
+    out: Dict[str, dict] = {}
+    for name in wanted:
+        if name == "forward":
+            out[name] = _witness_forward()
+        elif name == "train_step":
+            out[name] = _witness_train_step()
+        elif name.startswith("engine."):
+            out[name] = _witness_engine(name)
+        elif name == "kv.int8_dequant":
+            out[name] = _witness_kv_int8()
+        else:
+            raise ValueError(f"unknown witness {name!r}")
+    return out
+
+
+def drift_bound(name: str, metric: str, value: float) -> float:
+    """Re-baseline rule: rel-error bounds get 4x headroom, token mismatch
+    fractions 2x (floored at 5%, capped at 1.0), and the int8 KV bound is
+    the FIXED analytic contract — never remeasured."""
+    if name == "kv.int8_dequant":
+        return KV_INT8_BOUND
+    if metric == "token_mismatch_fraction":
+        return min(1.0, max(value * 2.0, 0.05))
+    return max(value * 4.0, 1e-6)
+
+
+def compare_drift(observed: Dict[str, dict], baseline: Dict[str, Any],
+                  baseline_path: str) -> List[Finding]:
+    base = baseline.get("drift", {})
+    findings = []
+    for name, rec in sorted(observed.items()):
+        known = base.get(name)
+        if known is None:
+            if base:
+                findings.append(Finding(
+                    "G401", baseline_path, 1,
+                    f"no drift bound for witness '{name}' "
+                    f"(observed {rec['metric']}={rec['value']:.3e}) — "
+                    "re-baseline after review",
+                    program=f"witness.{name}",
+                ))
+            continue
+        bound = float(known.get("bound", 0.0))
+        if rec["value"] > bound:
+            findings.append(Finding(
+                "G401", baseline_path, 1,
+                f"witness '{name}': {rec['metric']}={rec['value']:.3e} "
+                f"exceeds the committed bound {bound:.3e} — bf16 drift "
+                "outside the declared policy",
+                program=f"witness.{name}",
+            ))
+    return findings
+
+
+# --------------------------------------------------------------------------
+# changed-only (pre-commit fast path)
+# --------------------------------------------------------------------------
+
+# module prefix (repo-relative, '/'-separated) -> affected program groups.
+# None = every group (a change here invalidates everything lowered).
+_ENGINE_GROUPS = ("engine.dense", "engine.spec", "engine.paged",
+                  "engine.paged_int8")
+_MODULE_GROUPS = (
+    ("accelerate_tpu/analysis/", None),
+    ("runs/numerics_baseline.json", None),
+    ("accelerate_tpu/models/", None),
+    ("accelerate_tpu/ops/", None),
+    ("accelerate_tpu/model.py", None),
+    ("accelerate_tpu/engine.py", _ENGINE_GROUPS),
+    ("accelerate_tpu/kvcache.py", _ENGINE_GROUPS),
+    ("accelerate_tpu/spec.py", ("engine.spec",)),
+    ("accelerate_tpu/accelerator.py", ("train_step",)),
+    ("accelerate_tpu/optimizer.py", ("train_step",)),
+    ("accelerate_tpu/parallel/", ("train_step",)),
+    ("accelerate_tpu/parallelism_config.py", ("train_step",)),
+    ("accelerate_tpu/state.py", ("train_step",)),
+)
+
+
+def changed_paths(repo_root: str) -> Optional[List[str]]:
+    """Repo-relative paths changed vs the merge-base with origin/main
+    (falling back to HEAD), including the working tree. None when git is
+    unusable — callers then run the full set."""
+    def _git(*args):
+        return subprocess.run(
+            ["git", *args], cwd=repo_root, capture_output=True, text=True,
+            timeout=30,
+        )
+
+    try:
+        base = None
+        for ref in ("origin/main", "origin/master", "main"):
+            r = _git("merge-base", "HEAD", ref)
+            if r.returncode == 0:
+                base = r.stdout.strip()
+                break
+        diff = _git("diff", "--name-only", base or "HEAD")
+        if diff.returncode != 0:
+            return None
+        return [p for p in diff.stdout.splitlines() if p.strip()]
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+
+def changed_groups(repo_root: str) -> Tuple[Optional[List[str]], bool]:
+    """(program groups to lower, run_witness) for --changed-only. Groups
+    ``None`` = everything; ``[]`` = skip lowering entirely (AST + scale
+    checks still run — they are <1s)."""
+    paths = changed_paths(repo_root)
+    if paths is None:
+        return None, True
+    groups: Set[str] = set()
+    for p in paths:
+        p = p.replace(os.sep, "/")
+        for prefix, mapped in _MODULE_GROUPS:
+            if p.startswith(prefix):
+                if mapped is None:
+                    return None, True
+                groups.update(mapped)
+    return sorted(groups), bool(groups)
+
+
+# --------------------------------------------------------------------------
+# baseline plumbing + entry point
+# --------------------------------------------------------------------------
+
+def make_numerics_baseline(observed: Dict[str, Any],
+                           prior: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """Measurements are replaced; ``policy`` and ``waivers`` are REVIEWED
+    content and survive re-baselining (Level 3 semantics). A partial run
+    (changed-only / no witness) merges into the prior measurements instead
+    of clobbering programs it never lowered."""
+    prior = prior or {}
+    baseline: Dict[str, Any] = {
+        "policy": prior.get("policy", POLICY),
+        "accum": dict(prior.get("accum", {})),
+        "reduce": dict(prior.get("reduce", {})),
+        "nondeterminism": dict(prior.get("nondeterminism", {})),
+        "drift": dict(prior.get("drift", {})),
+        "waivers": prior.get("waivers", {}),
+    }
+    baseline["accum"].update(observed.get("accum", {}))
+    baseline["reduce"].update(observed.get("reduce", {}))
+    baseline["nondeterminism"].update(observed.get("nondeterminism", {}))
+    for name, rec in observed.get("drift", {}).items():
+        baseline["drift"][name] = {
+            "metric": rec["metric"],
+            "bound": drift_bound(name, rec["metric"], rec["value"]),
+            "observed": rec["value"],
+        }
+    return baseline
+
+
+def load_baseline(path: str = BASELINE_PATH) -> Optional[Dict[str, Any]]:
+    import json
+
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def build_numerics_records(groups: Optional[Sequence[str]] = None):
+    """(records, train_state, int8_engine): the Level 1 hot programs plus
+    the int8 engine variant Level 1 does not lower (its int8 dots and
+    scale tables are exactly what G402/G403 audit)."""
+    from . import program as program_mod
+
+    wanted = None if groups is None else set(groups)
+    records = []
+    train_state = None
+    int8_engine = None
+    if wanted is None or "train_step" in wanted:
+        rec, train_state = program_mod.build_train_step_program(
+            return_state=True)
+        records.append(rec)
+    plain_engines = [g for g in (wanted or ()) if g.startswith("engine.")
+                     and g != "engine.paged_int8"]
+    if wanted is None or plain_engines:
+        records.extend(program_mod.build_engine_programs(
+            None if wanted is None else plain_engines))
+    if wanted is None or "engine.paged_int8" in wanted:
+        from accelerate_tpu.engine import ContinuousBatchingEngine
+
+        model = program_mod._tiny_model()
+        int8_engine = ContinuousBatchingEngine(
+            model, slots=2, max_len=16, readback_lag=0,
+            kv_cache="paged_int8", block_size=4,
+        )
+        records.extend(program_mod._engine_records(
+            "engine.paged_int8", int8_engine, model))
+    return records, train_state, int8_engine
+
+
+def run_numerics_checks(
+    baseline_path: str = BASELINE_PATH,
+    update_baseline: bool = False,
+    groups: Optional[Sequence[str]] = None,
+    baseline_sink: Optional[list] = None,
+    with_witness: bool = True,
+    changed_only: bool = False,
+    repo_root: str = ".",
+) -> List[Finding]:
+    from .sharding import apply_waivers
+
+    if changed_only:
+        groups, witness_wanted = changed_groups(repo_root)
+        with_witness = with_witness and witness_wanted and groups is None
+
+    findings: List[Finding] = []
+    observed: Dict[str, Any] = {"accum": {}, "reduce": {},
+                                "nondeterminism": {}, "drift": {}}
+
+    # host half: AST RNG lint + executed scale checks (always on — <2s)
+    findings.extend(lint_rng_package(repo_root))
+    findings.extend(check_quant_scales())
+
+    skip_lowering = changed_only and groups == []
+    if not skip_lowering:
+        records, train_state, int8_engine = build_numerics_records(groups)
+        for rec in records:
+            prog = f"{rec.group}/{rec.name}"
+            findings.extend(check_f64(rec))
+            findings.extend(check_widening_aliases(rec))
+            hard, narrow_count, short_reduces = check_accumulation(rec)
+            findings.extend(hard)
+            observed["accum"][prog] = narrow_count
+            observed["reduce"][prog] = short_reduces
+            observed["nondeterminism"][prog] = unordered_reduction_inventory(
+                rec.lowered.as_text())
+            findings.extend(check_rng_jaxpr(rec))
+            if rec.group == "train_step":
+                findings.extend(check_loss_output(rec))
+                findings.extend(check_demoting_aliases(rec))
+        if train_state is not None:
+            findings.extend(check_train_state(train_state))
+        if int8_engine is not None:
+            findings.extend(check_engine_scales(int8_engine))
+
+    if with_witness:
+        observed["drift"] = run_drift_witness()
+
+    baseline = load_baseline(baseline_path)
+    if update_baseline:
+        new = make_numerics_baseline(observed, baseline)
+        if baseline_sink is not None:
+            baseline_sink.append((baseline_path, new))
+        else:
+            from .lowering import atomic_write_json
+
+            atomic_write_json(new, baseline_path)
+        kept, _ = apply_waivers(findings, new)
+        return kept
+    if baseline is None:
+        findings.append(Finding(
+            "G401", baseline_path, 1,
+            "numerics baseline missing — generate it with "
+            "`python -m accelerate_tpu.analysis --level numerics "
+            "--update-baseline`",
+        ))
+        return findings
+    findings.extend(compare_accum(observed["accum"], baseline, baseline_path))
+    findings.extend(compare_reduce(observed["reduce"], baseline,
+                                   baseline_path))
+    findings.extend(compare_nondeterminism(
+        observed["nondeterminism"], baseline, baseline_path))
+    findings.extend(compare_drift(observed["drift"], baseline, baseline_path))
+    kept, _waived = apply_waivers(findings, baseline)
+    return kept

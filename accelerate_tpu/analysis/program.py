@@ -56,6 +56,8 @@ _GROUP_SOURCE = {
     "engine.dense": os.path.join("accelerate_tpu", "engine.py"),
     "engine.spec": os.path.join("accelerate_tpu", "engine.py"),
     "engine.paged": os.path.join("accelerate_tpu", "engine.py"),
+    # lowered only by Level 5 (analysis/numerics.py): the int8 KV variant
+    "engine.paged_int8": os.path.join("accelerate_tpu", "engine.py"),
 }
 
 _CALLBACK_CUSTOM_CALL_RE = re.compile(
@@ -158,7 +160,7 @@ def build_engine_programs(groups: Optional[Sequence[str]] = None) -> List[Progra
     return records
 
 
-def build_train_step_program() -> ProgramRecord:
+def build_train_step_program(return_state: bool = False):
     """Lower the real fused train step shape-only (abstract prepare) on a
     tiny dp=8 config — the same path benchmarks/hlo_report.py drives.
 
@@ -168,6 +170,11 @@ def build_train_step_program() -> ProgramRecord:
     donated flat range is the contiguous [0, 2P + O). Params and opt_state
     must alias; the accum tree is only read when gradient accumulation is
     on, so jax strips its donation here — it may alias, never must.
+
+    With ``return_state=True`` returns ``(record, state)`` where ``state``
+    carries the abstract ``params`` and ``opt_state`` trees — graftcheck
+    Level 5 (G403) walks them by path to check the master-weight/moment
+    dtype contract without re-lowering.
     """
     import jax
     import jax.numpy as jnp
@@ -190,11 +197,14 @@ def build_train_step_program() -> ProgramRecord:
         lowered = step.lower(batch)
         p = leaf_count(model.params)
         o = leaf_count(opt.opt_state)
-        return ProgramRecord(
+        record = ProgramRecord(
             group="train_step", name="fused_train_step", lowered=lowered,
             donated=set(range(p + o)),
             donated_optional=set(range(p + o, 2 * p + o)),
         )
+        if return_state:
+            return record, {"params": model.params, "opt_state": opt.opt_state}
+        return record
     finally:
         for s in (AcceleratorState, GradientState, PartialState):
             s._reset_state()

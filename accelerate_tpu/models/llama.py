@@ -738,7 +738,10 @@ def llama_apply(
         return out
     # use-time all-gather of the fsdp-sharded head; keeps logits (and their
     # cotangents) on the batch/seq layout — see replicate_over_fsdp
-    logits = (x @ replicate_over_fsdp(head.astype(cdt))).astype(jnp.float32)
+    logits = jnp.einsum(
+        "bsd,dv->bsv", x, replicate_over_fsdp(head.astype(cdt)),
+        preferred_element_type=jnp.float32,  # G402: f32 logit accumulation
+    )
     logits = _tanh_softcap(logits, config.final_logit_softcap)  # Gemma-2
     logits = constrain_activation(logits, "vocab")
     if return_aux:
@@ -798,7 +801,10 @@ def _ce_from_hidden(config, x, head, labels, mask, *, reduction="mean",
     # full-rematerialization path (d_logits {batch,seq} -> {vocab} flip).
     # With a replicated head, d_head is a local partial + psum — clean.
     head = replicate_over_fsdp(head.astype(config.compute_dtype))
-    logits = (x @ head).astype(jnp.float32)
+    logits = jnp.einsum(
+        "bsd,dv->bsv", x, head,
+        preferred_element_type=jnp.float32,  # G402: f32 logit accumulation
+    )
     logits = _tanh_softcap(logits, getattr(config, "final_logit_softcap", None))
     logits = constrain_activation(logits, "vocab")
     return _dense_ce_from_logits(logits, labels, mask, reduction=reduction)
@@ -1216,8 +1222,9 @@ def _decode_layer(config: LlamaConfig, layer_params, x, cache_k, cache_v, pos,
     n_rep = h // kvh
     attn_scale = 1.0 / np.sqrt(config.query_pre_attn_scalar or hd)
     qg = (q * attn_scale).reshape(b, s, kvh, n_rep, hd)
-    scores = jnp.einsum("bqgrd,bkgd->bgrqk", qg, cache_k.astype(cdt)).astype(
-        jnp.float32
+    scores = jnp.einsum(
+        "bqgrd,bkgd->bgrqk", qg, cache_k.astype(cdt),
+        preferred_element_type=jnp.float32,  # G402: f32 score accumulation
     )
     scores = _tanh_softcap(scores, config.attn_logit_softcap)  # pre-mask
     k_pos = lax.broadcasted_iota(jnp.int32, scores.shape, 4)
@@ -1229,7 +1236,10 @@ def _decode_layer(config: LlamaConfig, layer_params, x, cache_k, cache_v, pos,
             in_window = jnp.logical_or(jnp.logical_not(sliding), in_window)
         scores = jnp.where(in_window, scores, -1e6)
     weights = jax.nn.softmax(scores, axis=-1)
-    attn = jnp.einsum("bgrqk,bkgd->bqgrd", weights.astype(cdt), cache_v.astype(cdt))
+    attn = jnp.einsum(
+        "bgrqk,bkgd->bqgrd", weights.astype(cdt), cache_v.astype(cdt),
+        preferred_element_type=jnp.float32,  # G402: f32 PV accumulation
+    ).astype(cdt)
     attn = attn.reshape(b, s, h * hd) @ layer_params["attn"]["o_proj"]["kernel"].astype(cdt)
     if config.post_block_norms:
         attn = rms_norm(attn, layer_params["attn_out_norm"]["scale"],
@@ -1303,8 +1313,9 @@ def _verify_layer(config: LlamaConfig, layer_params, x, cache_k, cache_v, pos,
     n_rep = h // kvh
     attn_scale = 1.0 / np.sqrt(config.query_pre_attn_scalar or hd)
     qg = (q * attn_scale).reshape(b, w, kvh, n_rep, hd)
-    scores = jnp.einsum("bqgrd,bkgd->bgrqk", qg, cache_k.astype(cdt)).astype(
-        jnp.float32
+    scores = jnp.einsum(
+        "bqgrd,bkgd->bgrqk", qg, cache_k.astype(cdt),
+        preferred_element_type=jnp.float32,  # G402: f32 score accumulation
     )
     scores = _tanh_softcap(scores, config.attn_logit_softcap)  # pre-mask
     k_pos = lax.broadcasted_iota(jnp.int32, scores.shape, 4)
@@ -1317,7 +1328,10 @@ def _verify_layer(config: LlamaConfig, layer_params, x, cache_k, cache_v, pos,
             in_window = jnp.logical_or(jnp.logical_not(sliding), in_window)
         scores = jnp.where(in_window, scores, -1e6)
     weights = jax.nn.softmax(scores, axis=-1)
-    attn = jnp.einsum("bgrqk,bkgd->bqgrd", weights.astype(cdt), cache_v.astype(cdt))
+    attn = jnp.einsum(
+        "bgrqk,bkgd->bqgrd", weights.astype(cdt), cache_v.astype(cdt),
+        preferred_element_type=jnp.float32,  # G402: f32 PV accumulation
+    ).astype(cdt)
     attn = attn.reshape(b, w, h * hd) @ layer_params["attn"]["o_proj"]["kernel"].astype(cdt)
     if config.post_block_norms:
         attn = rms_norm(attn, layer_params["attn_out_norm"]["scale"],

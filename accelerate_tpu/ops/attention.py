@@ -102,7 +102,11 @@ def dot_product_attention(
     # same trick as the flash kernel's head-index mapping). n_rep == 1
     # degenerates to plain MHA with a size-1 group dim.
     qg = q.reshape(b, sq, h_kv, n_rep, d)
-    scores = jnp.einsum("bqgrd,bkgd->bgrqk", qg, k).astype(softmax_dtype) * scale
+    # G402: accumulate the QK^T dot in softmax_dtype (f32) inside the einsum —
+    # an .astype() after a bf16-accumulated product keeps the bf16 rounding
+    scores = jnp.einsum(
+        "bqgrd,bkgd->bgrqk", qg, k, preferred_element_type=softmax_dtype
+    ) * scale
     scores = tanh_softcap(scores, softcap)
     if causal:
         mask = _causal_mask_bias(sq, sk, q_offset=q_offset - kv_offset, dtype=softmax_dtype)
@@ -124,7 +128,10 @@ def dot_product_attention(
         diff = q_pos - k_pos
         scores = jnp.where(((diff >= 0) & (diff < window))[None, None, None], scores, NEG_INF)
     weights = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bgrqk,bkgd->bqgrd", weights.astype(v.dtype), v)
+    out = jnp.einsum(
+        "bgrqk,bkgd->bqgrd", weights.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,  # G402: f32 PV accumulation
+    ).astype(v.dtype)
     return out.reshape(b, sq, h, d)
 
 
@@ -179,12 +186,19 @@ def paged_attention(
     n_rep = h // h_kv
     scale = 1.0 / math.sqrt(d)
     qg = q.reshape(b, sq, h_kv, n_rep, d)
-    scores = jnp.einsum("bqgrd,bkgd->bgrqk", qg, k).astype(softmax_dtype) * scale
+    # G402: accumulate the QK^T dot in softmax_dtype (f32) inside the einsum —
+    # an .astype() after a bf16-accumulated product keeps the bf16 rounding
+    scores = jnp.einsum(
+        "bqgrd,bkgd->bgrqk", qg, k, preferred_element_type=softmax_dtype
+    ) * scale
     k_pos = jnp.arange(sk, dtype=jnp.int32)
     live = k_pos[None, :] <= pos[:, None]  # (B, sk)
     scores = jnp.where(live[:, None, None, None, :], scores, NEG_INF)
     weights = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bgrqk,bkgd->bqgrd", weights.astype(v.dtype), v)
+    out = jnp.einsum(
+        "bgrqk,bkgd->bqgrd", weights.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,  # G402: f32 PV accumulation
+    ).astype(v.dtype)
     return out.reshape(b, sq, h, d)
 
 
@@ -233,13 +247,20 @@ def verify_attention(
     n_rep = h // h_kv
     scale = 1.0 / math.sqrt(d)
     qg = q.reshape(b, sq, h_kv, n_rep, d)
-    scores = jnp.einsum("bqgrd,bkgd->bgrqk", qg, k).astype(softmax_dtype) * scale
+    # G402: accumulate the QK^T dot in softmax_dtype (f32) inside the einsum —
+    # an .astype() after a bf16-accumulated product keeps the bf16 rounding
+    scores = jnp.einsum(
+        "bqgrd,bkgd->bgrqk", qg, k, preferred_element_type=softmax_dtype
+    ) * scale
     k_pos = jnp.arange(sk, dtype=jnp.int32)
     q_idx = jnp.arange(sq, dtype=jnp.int32)
     live = k_pos[None, None, :] <= pos[:, None, None] + q_idx[None, :, None]
     scores = jnp.where(live[:, None, None, :, :], scores, NEG_INF)
     weights = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bgrqk,bkgd->bqgrd", weights.astype(v.dtype), v)
+    out = jnp.einsum(
+        "bgrqk,bkgd->bqgrd", weights.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,  # G402: f32 PV accumulation
+    ).astype(v.dtype)
     return out.reshape(b, sq, h, d)
 
 
@@ -365,14 +386,19 @@ def _attend_block(q, k, v, bias, softcap=None):
     combination across blocks (the flash/ring attention core). All values
     stay finite: a fully-masked block yields m=NEG_INF whose contribution is
     rescaled to exactly 0 when merged with any real block."""
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    )  # G402: f32 score accumulation
     scores = tanh_softcap(scores, softcap)
     if bias is not None:
         scores = scores + bias
     m = jnp.max(scores, axis=-1)  # (b,h,q), >= NEG_INF (finite)
     p = jnp.exp(scores - m[..., None])
     l = jnp.sum(p, axis=-1)  # (b,h,q)
-    out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    out = jnp.einsum(
+        "bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,  # G402: f32 PV accumulation
+    ).astype(v.dtype)
     return out, m, l
 
 

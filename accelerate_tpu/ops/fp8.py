@@ -10,6 +10,11 @@ value being cast — stateless, vs TE's delayed amax history; simpler and
 within noise for LLM training at these scales). The quantize→dot→dequantize
 pattern lowers to native fp8 MXU ops on TPU generations that support it and
 falls back to bf16 math elsewhere — numerics are identical either way.
+
+Numerics contract (graftcheck G402, docs/static_analysis.md): every fp8
+dot here — forward and both backward dots — accumulates in f32 via
+``preferred_element_type``; a narrow dot keeping the fp8/bf16 result type
+is a hard Level 5 finding. All quantization scales are f32 (G403).
 """
 
 from __future__ import annotations

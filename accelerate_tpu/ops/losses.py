@@ -68,9 +68,13 @@ def chunked_softmax_cross_entropy(
 
         m, l, label_logit = carry
         k_chunk, c_idx = inputs
+        # G402: the chunk logits accumulate in logit_dtype (f32) inside the
+        # dot — casting a bf16-accumulated product after the fact keeps the
+        # bf16 rounding in the logsumexp carries
         logits = jnp.einsum(
-            "bsd,dc->bsc", hidden, k_chunk.astype(hidden.dtype)
-        ).astype(logit_dtype)
+            "bsd,dc->bsc", hidden, k_chunk.astype(hidden.dtype),
+            preferred_element_type=logit_dtype,
+        )
         # Gemma-2 final-logit capping, applied per chunk BEFORE the padding
         # mask (tanh(-1e30) would resurrect padded columns to -softcap and
         # corrupt the logsumexp)

@@ -4,6 +4,11 @@ The inference hot op behind utils/quantization.py: keeping weights int8 all
 the way into VMEM halves their HBM traffic vs dequantize-then-matmul, and the
 per-output-channel scale folds in AFTER the MXU dot (mathematically identical
 for column-wise scales). Interpret-mode capable for CPU validation.
+
+Numerics contract (graftcheck G402/G403, docs/static_analysis.md): the
+int8 dot accumulates in f32 via ``preferred_element_type`` — int8 operands
+keeping a narrow result type are a hard Level 5 finding — and the
+per-channel scales stay f32, applied after the accumulation.
 """
 
 from __future__ import annotations

@@ -691,6 +691,19 @@ if __name__ == "__main__":
             ["--level", "concurrency"]
             + [a for a in sys.argv[1:] if a != "--concurrency-gate"]
         ))
+    if "--numerics-gate" in sys.argv:
+        # graftcheck Level 5: numerics, precision & RNG audit — f64/widened
+        # aliases, accumulation-dtype discipline, state/scale dtype
+        # contract, PRNG key reuse, non-determinism inventory, and the
+        # bf16-vs-f32 drift witness vs runs/numerics_baseline.json
+        # (docs/static_analysis.md); accepts --no-witness/--changed-only
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from accelerate_tpu.analysis.__main__ import main as static_main
+
+        sys.exit(static_main(
+            ["--level", "numerics"]
+            + [a for a in sys.argv[1:] if a != "--numerics-gate"]
+        ))
     if "--continuous-gate" in sys.argv:
         # continuous-batching gate: mixed-length/mixed-budget workload must
         # reach >= 1.3x static-mode goodput with TTFT p99 no worse, <= 2

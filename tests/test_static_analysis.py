@@ -486,6 +486,7 @@ def test_finding_render():
         "G001", "G002", "G003", "G004", "G101", "G102", "G103", "G104", "G105",
         "G201", "G202", "G203", "G204", "G205",
         "G301", "G302", "G303", "G304", "G305", "G306",
+        "G401", "G402", "G403", "G404", "G405",
     }
 
 
