@@ -9,7 +9,7 @@
 PY := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu python
 PY_SLOW := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu RUN_SLOW=1 python
 
-.PHONY: test test_all test_core test_data test_parallel test_models test_cli test_big_modeling test-fault test-serving check-static check-sharding check-concurrency check-numerics check-all bench bench-telemetry bench-serving bench-continuous bench-recovery bench-kv bench-spec bench-fleet
+.PHONY: test test_all test_core test_data test_parallel test_models test_cli test_big_modeling test-fault test-serving check-static check-sharding check-concurrency check-numerics check-perf check-all install-hooks bench bench-telemetry bench-serving bench-continuous bench-recovery bench-kv bench-spec bench-fleet
 
 test: check-static
 	$(PY) -m pytest tests/ -q
@@ -24,9 +24,13 @@ test: check-static
 # safety (G301-G306) against the lock-order DAG in
 # runs/concurrency_baseline.json; Level 5 audits numerics/precision/RNG
 # discipline (G401-G405) and runs the bf16-vs-f32 drift witness against
-# runs/numerics_baseline.json. check-static runs ALL levels; exit 0 =
-# clean. Re-baseline deliberate program/budget/lock-order/drift changes
-# atomically (all four baseline files, write-to-temp + rename) with:
+# runs/numerics_baseline.json; Level 6 audits static performance —
+# roofline step-time/MFU/tok-s budgets, unoverlapped collectives, padding
+# waste, fusion inventory, pipeline bubbles (G501-G505) — against
+# runs/perf_baseline.json with a predicted-vs-measured ordering witness.
+# check-static runs ALL levels; exit 0 = clean. Re-baseline deliberate
+# program/budget/lock-order/drift/perf changes atomically (all five
+# baseline files, write-to-temp + rename) with:
 #   $(PY) -m accelerate_tpu.analysis --update-baseline
 check-static:
 	$(PY) -m accelerate_tpu.analysis
@@ -53,9 +57,25 @@ check-concurrency:
 check-numerics:
 	$(PY) -m accelerate_tpu.analysis --level numerics
 
-# every level + a SARIF report CI can annotate PRs from
+# Level 6 alone: static performance audit (G501-G505) — per-program
+# roofline step-time/MFU/tokens-per-second budgets, unoverlapped or
+# DCN-unhideable collectives, padding/bucket dot-FLOP waste, fusion/kernel
+# inventory, and pipeline bubble-fraction budgets vs
+# runs/perf_baseline.json, plus the predicted-vs-measured A/B ordering
+# witness (paged-vs-dense decode, dp8-vs-fsdp8 train)
+check-perf:
+	$(PY) -m accelerate_tpu.analysis --level perf
+
+# every level (1-6) + a SARIF report CI can annotate PRs from
 check-all:
 	$(PY) -m accelerate_tpu.analysis --level all --sarif runs/graftcheck.sarif
+
+# install the graftcheck pre-commit hook: the --changed-only fast path
+# (<30s — only the program groups whose sources differ from the
+# merge-base are re-lowered; witnesses skipped) + a SARIF report
+install-hooks:
+	install -m 0755 scripts/pre-commit .git/hooks/pre-commit
+	@echo "installed .git/hooks/pre-commit (graftcheck --changed-only)"
 
 # durable-checkpointing suite (docs/fault_tolerance.md): atomic commit,
 # kill-mid-save rollback via ACCELERATE_TPU_FAULT_INJECT, preemption,

@@ -93,6 +93,29 @@ Level 5 — numerics, precision & RNG audit (``analysis/numerics.py``):
 Level 5 baselines, drift bounds, and program-scoped waivers live in
 ``runs/numerics_baseline.json``.
 
+Level 6 — static performance audit (``analysis/perf.py``):
+
+* **G501** per-program roofline budgets: predicted step time, MFU floor,
+  and decode tokens-per-second vs ``runs/perf_baseline.json`` (growth
+  fails, improvement passes and invites re-baseline); an ordering
+  witness executes the tiny engines + train steps and asserts the
+  predictor's A/B ordering matches measured walltime ordering
+* **G502** unoverlapped collective: trip-count-weighted collective on
+  the critical path not lowered as an ``async-start``/``-done`` pair, or
+  a DCN-crossing collective whose modeled transfer exceeds the
+  independent compute available to hide it
+* **G503** padding/bucket waste: fraction of dot FLOPs spent on padded
+  rows (pow-2 prompt buckets, (slots, max_len) arena vs live tokens),
+  gated per program
+* **G504** fusion/kernel inventory: fusion count + dominant-op histogram
+  per program gated vs baseline (static fusion-break detector)
+* **G505** pipeline bubble-fraction budgets from the static
+  1F1B/interleaved schedule model shared with
+  ``benchmarks/pp_schedule_bench.py``
+
+Level 6 budgets and program-scoped waivers live in
+``runs/perf_baseline.json``.
+
 Waivers are line-scoped comments, same line or the line above:
 ``# graft: sync-ok`` (G101), ``# graft: wait-ok`` (G102),
 ``# graft: raise-ok`` (G103), ``# graft: lock-ok`` (G104),
@@ -136,11 +159,16 @@ RULES = {
     "G403": "master state, loss, or quantization scale not f32",
     "G404": "PRNG key reused or consumed without split/fold_in",
     "G405": "unordered-reduction op outside the committed inventory",
+    "G501": "roofline step-time/MFU/tokens-per-second budget regressed",
+    "G502": "collective on the critical path that the schedule cannot hide",
+    "G503": "padded-row dot-FLOP fraction grew past the committed budget",
+    "G504": "fusion/kernel inventory drifted from baseline (fusion break)",
+    "G505": "pipeline bubble fraction grew past the committed budget",
 }
 
 # rule-code century -> level name (the unified --json/--sarif schema key)
 _LEVELS = {"G0": "program", "G1": "host", "G2": "sharding",
-           "G3": "concurrency", "G4": "numerics"}
+           "G3": "concurrency", "G4": "numerics", "G5": "perf"}
 
 
 def level_of(code: str) -> str:

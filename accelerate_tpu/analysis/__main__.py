@@ -9,15 +9,19 @@ dp=8 train step needs a mesh, and CI boxes have no accelerator).
 ``--update-baseline`` is atomic across ALL baselines: every level that
 ran appends its new baseline to a sink, and the files
 (``runs/static_baseline.json``, ``runs/sharding_baseline.json``,
-``runs/concurrency_baseline.json``, ``runs/numerics_baseline.json``)
-are committed together via write-to-temp + rename only after every level
-finished — a crash mid-run leaves all of them untouched.
+``runs/concurrency_baseline.json``, ``runs/numerics_baseline.json``,
+``runs/perf_baseline.json``) are committed together via write-to-temp +
+rename only after every level finished — a crash mid-run leaves all of
+them untouched.
 
-``--json`` emits the unified schema shared by all five levels (level,
+``--json`` emits the unified schema shared by all six levels (level,
 rule, path, line, message, program, severity, waiver); ``--sarif PATH``
 writes a SARIF 2.1.0 report CI can annotate from. ``--changed-only``
-(numerics) lowers only the programs whose source modules differ from the
-merge-base — the <30s pre-commit loop.
+lowers only the programs whose source modules differ from the merge-base
+across EVERY lowering level (program/sharding/numerics/perf; edits to
+``analysis/``, the ``Makefile``, or any ``runs/*_baseline.json`` trigger
+a full run) — the <30s pre-commit loop installed by ``make
+install-hooks``.
 """
 
 from __future__ import annotations
@@ -49,13 +53,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument(
         "--level",
         choices=("host", "program", "sharding", "concurrency", "numerics",
-                 "all"),
+                 "perf", "all"),
         default="all",
         help="host = AST lint only (fast); program = lower and inspect the "
         "jitted programs (G001-G004); sharding = SPMD layout + HBM audit "
         "(G201-G205); concurrency = host lock/thread/gang audit "
         "(G301-G306, fast); numerics = dtype/accumulation/RNG audit + "
-        "bf16-vs-f32 drift witness (G401-G405); all = everything (default)",
+        "bf16-vs-f32 drift witness (G401-G405); perf = roofline/overlap/"
+        "padding/fusion/bubble budgets + ordering witness (G501-G505); "
+        "all = everything (default)",
     )
     parser.add_argument(
         "--root", default=".", help="repo root to lint (default: cwd)"
@@ -81,15 +87,21 @@ def main(argv: Optional[List[str]] = None) -> int:
         "runs/numerics_baseline.json under --root)",
     )
     parser.add_argument(
+        "--perf-baseline", default=None,
+        help="perf-budget baseline path (default: runs/perf_baseline.json "
+        "under --root)",
+    )
+    parser.add_argument(
         "--no-witness", action="store_true",
         help="skip the bf16-vs-f32 drift witness (numerics level; the "
         "static rules still run)",
     )
     parser.add_argument(
         "--changed-only", action="store_true",
-        help="numerics level: lower only programs whose source modules "
-        "differ from the git merge-base (fast pre-commit mode; skips the "
-        "witness unless analysis/ itself changed)",
+        help="lower only programs whose source modules differ from the git "
+        "merge-base, at every lowering level (fast pre-commit mode; skips "
+        "the witnesses unless analysis/, the Makefile, or a committed "
+        "baseline changed — those map to a full run)",
     )
     parser.add_argument(
         "--sarif", default=None, metavar="PATH",
@@ -121,11 +133,25 @@ def main(argv: Optional[List[str]] = None) -> int:
     concurrency_baseline = args.concurrency_baseline or os.path.join(
         root, "runs", "concurrency_baseline.json"
     )
+    perf_baseline = args.perf_baseline or os.path.join(
+        root, "runs", "perf_baseline.json"
+    )
     findings: List[Finding] = []
     # deferred (path, baseline) writes: every level that ran contributes,
     # then everything is committed atomically below — one flag, whichever
     # levels ran, all-or-nothing
     baseline_sink: List = []
+
+    # --changed-only computes the affected program groups ONCE and threads
+    # them through every lowering level (None = full run, [] = skip the
+    # lowering levels entirely). Re-baselining always runs the full set —
+    # a partial observation must never clobber budgets it didn't measure.
+    lower_groups = None
+    if args.changed_only and not args.update_baseline:
+        from .numerics import changed_groups
+
+        lower_groups, _witness_ok = changed_groups(root)
+    skip_lowering = args.changed_only and lower_groups == []
 
     if args.level in ("host", "all"):
         from .host import lint_package
@@ -142,24 +168,27 @@ def main(argv: Optional[List[str]] = None) -> int:
             baseline_sink=baseline_sink,
         ))
 
-    if args.level in ("program", "all"):
+    if args.level in ("program", "all") and not skip_lowering:
         _pin_cpu_backend()
         from .program import run_program_checks
 
         findings.extend(run_program_checks(
             baseline_path=baseline,
             update_baseline=args.update_baseline,
+            groups=lower_groups,
             with_collectives=not args.no_collectives,
             baseline_sink=baseline_sink,
         ))
 
-    if args.level in ("sharding", "all"):
+    if args.level in ("sharding", "all") and not skip_lowering:
         _pin_cpu_backend()
+        from .perf import _expand_groups
         from .sharding import run_sharding_checks
 
         findings.extend(run_sharding_checks(
             baseline_path=sharding_baseline,
             update_baseline=args.update_baseline,
+            groups=_expand_groups(lower_groups),
             with_collectives=not args.no_collectives,
             baseline_sink=baseline_sink,
         ))
@@ -173,7 +202,22 @@ def main(argv: Optional[List[str]] = None) -> int:
             update_baseline=args.update_baseline,
             baseline_sink=baseline_sink,
             with_witness=not args.no_witness,
-            changed_only=args.changed_only,
+            changed_only=args.changed_only and not args.update_baseline,
+            repo_root=root,
+        ))
+
+    if args.level in ("perf", "all") and not skip_lowering:
+        _pin_cpu_backend()
+        from .perf import run_perf_checks
+
+        findings.extend(run_perf_checks(
+            baseline_path=perf_baseline,
+            update_baseline=args.update_baseline,
+            groups=lower_groups,
+            with_collectives=not args.no_collectives,
+            baseline_sink=baseline_sink,
+            with_witness=not args.no_witness,
+            changed_only=args.changed_only and not args.update_baseline,
             repo_root=root,
         ))
 

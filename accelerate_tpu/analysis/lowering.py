@@ -21,14 +21,76 @@ from __future__ import annotations
 import os
 import re
 
+# ----------------------------------------------------------- chip rooflines
+# Public spec sheets; bw in bytes/s. ici_bw is the per-chip aggregate over
+# all links (v5p: 3D torus, 4800 Gbps/chip), counted once per direction.
+# Shared by benchmarks/hlo_report.py (the one-shot compile report) and
+# graftcheck Level 6 (analysis/perf.py, the standing perf gate) — the
+# ISSUE-13 dedupe satellite, same shape as the PR-9 collective-parser move.
+CHIPS = {
+    "v5p": dict(peak_bf16=459e12, hbm_bytes=95e9, hbm_bw=2765e9, ici_bw=600e9),
+    "v5e": dict(peak_bf16=197e12, hbm_bytes=16e9, hbm_bw=819e9, ici_bw=200e9),
+    "v4": dict(peak_bf16=275e12, hbm_bytes=32e9, hbm_bw=1228e9, ici_bw=300e9),
+}
+
+# Achievable fractions for the roofline (measured, not theoretical: large
+# bf16 matmuls sustain ~75% on the relay chip — see .claude verify notes —
+# and ring collectives reach ~80% of link bandwidth in practice).
+MATMUL_EFF = 0.75
+ICI_EFF = 0.8
+HBM_EFF = 0.8
+
+# Inter-slice data-center network: ~25 GB/s per host of sustained collective
+# bandwidth — two orders of magnitude below ICI, which is why G204/G502
+# treat DCN-crossing collectives as a separate, much slower lane.
+DCN_BW = 25e9
+DCN_EFF = 0.8
+
+
+def roofline(flops: float, hbm_bytes: float, ici_bytes: float = 0.0,
+             dcn_bytes: float = 0.0, chip: str = "v5p") -> dict:
+    """Roofline step-time decomposition: each lane's time at its achievable
+    bandwidth, the binding lane, and the predicted step time (the max —
+    assumes XLA overlaps the lanes; G502 audits where that assumption is
+    unearned)."""
+    spec = CHIPS[chip]
+    parts = {
+        "compute": flops / (spec["peak_bf16"] * MATMUL_EFF),
+        "hbm": hbm_bytes / (spec["hbm_bw"] * HBM_EFF),
+        "ici": ici_bytes / (spec["ici_bw"] * ICI_EFF),
+        "dcn": dcn_bytes / (DCN_BW * DCN_EFF),
+    }
+    bound = max(parts, key=lambda k: parts[k])
+    return dict(
+        t_compute_s=parts["compute"], t_hbm_s=parts["hbm"],
+        t_ici_s=parts["ici"], t_dcn_s=parts["dcn"],
+        bound=bound, step_time_s=parts[bound],
+    )
+
+
+def predicted_mfu(useful_flops: float, step_time_s: float,
+                  chip: str = "v5p") -> float:
+    """Model FLOPs utilization against the chip's bf16 peak."""
+    if step_time_s <= 0.0:
+        return 0.0
+    return useful_flops / (step_time_s * CHIPS[chip]["peak_bf16"])
+
+
+def predicted_tokens_per_s(tokens: float, step_time_s: float) -> float:
+    if step_time_s <= 0.0:
+        return 0.0
+    return tokens / step_time_s
+
+
 # ------------------------------------------------------------- HLO parsing
 # "= <shape or tuple shape> all-reduce(...)"; grad reductions commonly fuse a
 # whole layer's grads into ONE tuple-shaped all-reduce, so the shape part can
 # contain spaces and nested brackets. "-done" halves of async pairs are
-# intentionally not matched (counting them would double the -start).
+# intentionally not matched (counting them would double the -start); the
+# -start form is CAPTURED so iter_collectives can report asyncness (G502).
 _COLL_RE = re.compile(
     r"=\s+(?P<shape>\(?[^=]*?)\s*(?P<op>all-gather|reduce-scatter|all-reduce|"
-    r"all-to-all|collective-permute)(?:-start)?\(",
+    r"all-to-all|collective-permute)(?P<start>-start)?\(",
 )
 
 _DTYPE_BYTES = {"f32": 4, "bf16": 2, "f16": 2, "s32": 4, "u32": 4, "pred": 1,
@@ -164,8 +226,9 @@ def iter_collectives(hlo: str, n_devices: int):
     rewrite applied), ``dtype``, ``bytes``, ``group`` (devices per group),
     ``groups`` (concrete id groups, or None when unparseable),
     ``multiplier`` (product of enclosing while trip counts), ``comp``,
-    ``result``/``operand`` instruction names, and the jax ``op_name`` /
-    ``source`` metadata when present."""
+    ``result``/``operand`` instruction names, ``async`` (True when lowered
+    as the ``-start`` half of an async pair — the overlap evidence G502
+    audits), and the jax ``op_name`` / ``source`` metadata when present."""
     comps, entry = split_computations(hlo)
 
     def trip_count(line: str, cond_name):
@@ -250,7 +313,7 @@ def iter_collectives(hlo: str, n_devices: int):
                 )
                 sm = _META_SRC_RE.search(line)
                 opm = _META_OP_RE.search(line)
-                instrs.append(dict(
+                instrs.append({**dict(
                     op=op, dtype=dtype, bytes=nbytes, group=g,
                     groups=parse_replica_groups(line, n_devices),
                     multiplier=multiplier, comp=comp, result=result,
@@ -259,7 +322,7 @@ def iter_collectives(hlo: str, n_devices: int):
                     source=(f"{os.path.basename(sm.group(1))}:{sm.group(2)}"
                             if sm and sm.group(2)
                             else os.path.basename(sm.group(1)) if sm else ""),
-                ))
+                ), "async": bool(cm.group("start"))})
             # calls/fusions that might contain collectives (conditionals)
             for sub in re.findall(r"(?:true_computation|false_computation|"
                                   r"branch_computations)=\{?%?([\w.\-]+)", line):

@@ -818,7 +818,16 @@ _ENGINE_GROUPS = ("engine.dense", "engine.spec", "engine.paged",
                   "engine.paged_int8")
 _MODULE_GROUPS = (
     ("accelerate_tpu/analysis/", None),
+    # ANY committed baseline edit must trigger a full run: a relaxed budget
+    # in one file previously matched no program group and let the fast path
+    # skip the very level it relaxes. Same for the Makefile (it encodes the
+    # gate commands themselves).
+    ("runs/static_baseline.json", None),
+    ("runs/sharding_baseline.json", None),
+    ("runs/concurrency_baseline.json", None),
     ("runs/numerics_baseline.json", None),
+    ("runs/perf_baseline.json", None),
+    ("Makefile", None),
     ("accelerate_tpu/models/", None),
     ("accelerate_tpu/ops/", None),
     ("accelerate_tpu/model.py", None),

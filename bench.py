@@ -704,6 +704,20 @@ if __name__ == "__main__":
             ["--level", "numerics"]
             + [a for a in sys.argv[1:] if a != "--numerics-gate"]
         ))
+    if "--perf-gate" in sys.argv:
+        # graftcheck Level 6: static performance audit — roofline
+        # step-time/MFU/tokens-per-second budgets, unoverlapped-collective
+        # detection, padding/bucket waste, fusion inventory, and pipeline
+        # bubble budgets vs runs/perf_baseline.json, plus the
+        # predicted-vs-measured ordering witness (G501-G505)
+        # (docs/static_analysis.md); accepts --no-witness/--changed-only
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from accelerate_tpu.analysis.__main__ import main as static_main
+
+        sys.exit(static_main(
+            ["--level", "perf"]
+            + [a for a in sys.argv[1:] if a != "--perf-gate"]
+        ))
     if "--continuous-gate" in sys.argv:
         # continuous-batching gate: mixed-length/mixed-budget workload must
         # reach >= 1.3x static-mode goodput with TTFT p99 no worse, <= 2

@@ -39,32 +39,25 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))  # runnable as `python benchmarks/x.py`
 
 # The HLO machinery (collective inventory, ICI bytes, SPMD-dump compile)
-# lives in the analysis package so graftcheck's program budgets and this
-# report share one parser; re-imported here so `mod.parse_collectives`
-# keeps working for the tests that load this file as a module.
+# AND the chip spec sheets / roofline predictor live in the analysis
+# package so graftcheck's program + perf budgets (Levels 1 and 6) and this
+# report share one parser and one cost model; re-imported here so
+# `mod.parse_collectives` / `mod.CHIPS` keep working for the tests that
+# load this file as a module.
 from accelerate_tpu.analysis.lowering import (  # noqa: E402
+    CHIPS,
+    HBM_EFF,
+    ICI_EFF,
+    MATMUL_EFF,
     compile_and_extract_spmd,
     ici_bytes_per_chip,
     memory_table,
     parse_collectives,
+    predicted_mfu,
+    predicted_tokens_per_s,
+    roofline,
 )
 
-
-# ----------------------------------------------------------------- chips
-# Public spec sheets; bw in bytes/s. ici_bw is the per-chip aggregate over
-# all links (v5p: 3D torus, 4800 Gbps/chip), counted once per direction.
-CHIPS = {
-    "v5p": dict(peak_bf16=459e12, hbm_bytes=95e9, hbm_bw=2765e9, ici_bw=600e9),
-    "v5e": dict(peak_bf16=197e12, hbm_bytes=16e9, hbm_bw=819e9, ici_bw=200e9),
-    "v4": dict(peak_bf16=275e12, hbm_bytes=32e9, hbm_bw=1228e9, ici_bw=300e9),
-}
-
-# Achievable fractions for the roofline (measured, not theoretical: large
-# bf16 matmuls sustain ~75% on the relay chip — see .claude verify notes —
-# and ring collectives reach ~80% of link bandwidth in practice).
-MATMUL_EFF = 0.75
-ICI_EFF = 0.8
-HBM_EFF = 0.8
 
 # Fraction of the layer FORWARD recomputed in the backward per remat policy,
 # matching models/llama.py _remat_policy: "full" = no checkpoint (save all),
@@ -245,22 +238,38 @@ def run_decode(args):
         for p in jax.tree_util.tree_leaves(model.params)
     )
     # per decode token, per chip: every (sharded) weight is read once, and
-    # the (full-context) KV cache is read once + this token written
-    kv_bytes = 2 * L * b * args.seq * kvh * hd * 2  # bf16 k+v
+    # the KV cache is read once + this token written. The dense layout
+    # streams the full max-context arena row per sequence; a paged backend
+    # only touches the blocks allocated for the LIVE context, rounded up to
+    # engine_block_size — same accounting as engine.stats()["kv"] and the
+    # graftcheck G203/G503 budgets, so the roofline and the static gates
+    # can't disagree about what paged attention is worth.
+    prompt_len = args.seq // 2
+    kv_tokens = args.seq  # dense: the arena IS the max context
+    kv_itemsize = 2  # bf16 k+v
+    if args.kv_cache in ("paged", "paged_int8"):
+        blk = args.engine_block_size
+        # mean live context while decoding from prompt_len out to seq
+        live_ctx = (prompt_len + args.seq) / 2
+        kv_tokens = int(_math.ceil(live_ctx / blk)) * blk
+        if args.kv_cache == "paged_int8":
+            kv_itemsize = 1
+    kv_bytes = 2 * L * b * kv_tokens * kvh * hd * kv_itemsize
     hbm_per_token = (param_bytes + kv_bytes) / n
     # matmul FLOPs: 2*P per token per sequence, batch b rows
     n_params = model.num_parameters
     flops_per_token = 2 * n_params * b / n
     ici_decode = ici_bytes_per_chip(results["decode"]["collectives"])
 
-    t_hbm = hbm_per_token / (chip["hbm_bw"] * HBM_EFF)
-    t_compute = flops_per_token / (chip["peak_bf16"] * MATMUL_EFF)
-    t_ici = ici_decode / (chip["ici_bw"] * ICI_EFF)
-    latency = max(t_hbm, t_compute, t_ici)
-    bound = {t_hbm: "hbm", t_compute: "compute", t_ici: "ici"}[latency]
+    roof = roofline(flops_per_token, hbm_per_token, ici_decode,
+                    chip=args.chip)
+    t_hbm, t_compute, t_ici = (
+        roof["t_hbm_s"], roof["t_compute_s"], roof["t_ici_s"]
+    )
+    latency = roof["step_time_s"]
+    bound = roof["bound"]
 
     # prefill: compute-bound forward over prompt_len tokens
-    prompt_len = args.seq // 2
     from accelerate_tpu.models.llama import llama_flops_per_token
 
     prefill_flops = (
@@ -284,6 +293,12 @@ def run_decode(args):
                    context=args.seq, prompt=prompt_len, global_batch=b,
                    per_chip_batch=args.per_chip_batch,
                    weights_dtype="bf16"),
+        kv_layout=dict(backend=args.kv_cache,
+                       block_size=(args.engine_block_size
+                                   if args.kv_cache != "dense" else None),
+                       tokens_read_per_seq=kv_tokens,
+                       kv_itemsize=kv_itemsize,
+                       kv_bytes_per_token=int(kv_bytes)),
         mesh=dict(devices=n, tp=args.tp),
         chip=dict(kind=args.chip, **chip),
         compile_s=round(t_compile, 1),
@@ -294,7 +309,7 @@ def run_decode(args):
             t_hbm_s=t_hbm, t_compute_s=t_compute, t_ici_s=t_ici,
             bound=bound,
             predicted_s_per_token=latency,
-            predicted_tok_s=round(b / latency, 1),
+            predicted_tok_s=round(predicted_tokens_per_s(b, latency), 1),
             predicted_prefill_s=t_prefill,
             assumptions=dict(matmul_eff=MATMUL_EFF, ici_eff=ICI_EFF,
                              hbm_eff=HBM_EFF),
@@ -344,6 +359,10 @@ def _write_decode_md(path, r):
         "",
         "| component | value |",
         "|---|---|",
+        f"| KV layout | {r['kv_layout']['backend']}"
+        + (f" (block {r['kv_layout']['block_size']})"
+           if r['kv_layout']['block_size'] else "")
+        + f", {r['kv_layout']['tokens_read_per_seq']} tokens read/seq |",
         f"| HBM bytes/token/chip | {r['hbm_bytes_per_token_per_chip']/1e9:.3f} GB |",
         f"| t_hbm | {roof['t_hbm_s']*1e3:.2f} ms |",
         f"| t_compute | {roof['t_compute_s']*1e3:.2f} ms |",
@@ -395,6 +414,16 @@ def main():
     ap.add_argument("--pp-microbatches", type=int, default=0,
                     help="1F1B microbatches (default 2*pp)")
     ap.add_argument("--chip", default="v5p", choices=sorted(CHIPS))
+    ap.add_argument("--kv-cache", default="dense",
+                    choices=("dense", "paged", "paged_int8"),
+                    help="decode-mode KV layout for the HBM roofline: dense "
+                    "streams the full max-context arena per sequence; paged "
+                    "backends only read the engine_block_size-rounded LIVE "
+                    "context (and int8 halves the itemsize) — matching "
+                    "engine.stats()['kv'] / graftcheck G203+G503 accounting")
+    ap.add_argument("--engine-block-size", type=int, default=16,
+                    help="paged KV block size (tokens per block) used for "
+                    "the --kv-cache paged/paged_int8 byte accounting")
     ap.add_argument("--out", default="runs/hlo_report")
     ap.add_argument("--fail-below-mfu", type=float, default=None,
                     help="exit 1 if predicted MFU is below this")
@@ -490,22 +519,21 @@ def main():
     # chip only touches its stage's share of the stack
     hbm_traffic += 3 * (param_bytes // 2) // max(args.pp, 1)
 
-    t_compute = actual_flops_chip / (chip["peak_bf16"] * MATMUL_EFF)
-    t_ici = ici_bytes / (chip["ici_bw"] * ICI_EFF)
-    t_hbm = hbm_traffic / (chip["hbm_bw"] * HBM_EFF)
-    step_time = max(t_compute, t_ici, t_hbm)
+    roof = roofline(actual_flops_chip, hbm_traffic, ici_bytes,
+                    chip=args.chip)
+    t_compute, t_ici, t_hbm = (
+        roof["t_compute_s"], roof["t_ici_s"], roof["t_hbm_s"]
+    )
+    step_time = roof["step_time_s"]
+    bound = roof["bound"]
     # pipeline bubble: 1F1B idles each stage (n-1)/(m+n-1) of the step —
     # the roofline's busy time stretches by (m+n-1)/m
     bubble_factor = 1.0
     if args.pp > 1:
         bubble_factor = (m_mb + args.pp - 1) / m_mb
         step_time *= bubble_factor
-    mfu_pred = useful_flops_chip / (step_time * chip["peak_bf16"])
-    tok_s_chip = tokens_per_chip / step_time
-
-    bound = {t_compute: "compute", t_ici: "ici", t_hbm: "hbm"}[
-        max(t_compute, t_ici, t_hbm)
-    ]
+    mfu_pred = predicted_mfu(useful_flops_chip, step_time, args.chip)
+    tok_s_chip = predicted_tokens_per_s(tokens_per_chip, step_time)
 
     fp8_variant = None
     if args.fp8_speedup:

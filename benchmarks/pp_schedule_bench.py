@@ -30,6 +30,10 @@ import jax.numpy as jnp
 import optax
 
 from accelerate_tpu import Accelerator
+# the SAME schedule model graftcheck Level 6 gates (G505): the bench
+# reports its measured bubble against the identical helper, so the static
+# budget and this benchmark cannot diverge
+from accelerate_tpu.analysis.perf import bubble_fraction
 from accelerate_tpu.models.llama import LlamaConfig, create_llama, llama_loss
 from accelerate_tpu.parallelism_config import ParallelismConfig
 from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
@@ -84,10 +88,7 @@ def bench(schedule: str, num_microbatches: int, steps: int = 6, virtual: int = 1
         sch = build_interleaved_schedule(n, virtual, m)
         # full fori_loop carry: three per-chunk rings + the two wire buffers
         live = virtual * (sch.ring_f + sch.ring_s + sch.ring_b) + 2
-        wall = int((sch.fwd_valid + sch.bwd_valid).max(axis=0).sum())
-        bubble = round((wall - 2 * m * virtual) / wall, 3)
-    else:
-        bubble = round((n - 1) / (m + n - 1), 3)
+    bubble = round(bubble_fraction(n, m, virtual), 3)
     print(json.dumps({
         "schedule": schedule if virtual == 1 else f"1f1b@v{virtual}",
         "num_microbatches": m,

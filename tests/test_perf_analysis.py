@@ -1,0 +1,447 @@
+"""graftcheck Level 6 (accelerate_tpu/analysis/perf.py): per-rule fixtures
++ ordering-witness units + baseline mechanics.
+
+Every rule gets a failing fixture (the checker demonstrably flags it) and a
+passing or waived negative. The rule functions are pure, so most fixtures
+are synthetic dicts; the full-tree perf run and the walltime witness are
+slow-marked — the fast suite covers one lowered engine group and the
+`check_order` tie-band semantics the witness is built from.
+"""
+
+import json
+import os
+
+import pytest
+
+from accelerate_tpu.analysis import numerics as num
+from accelerate_tpu.analysis import perf
+from accelerate_tpu.analysis.perf import (
+    BUBBLE_CONFIGS,
+    CANON_BUDGET,
+    CANON_PROMPT_LENS,
+    ENGINE_BLOCK_SIZE,
+    ENGINE_MAX_LEN,
+    ENGINE_PROMPT_BUCKET,
+    ENGINE_SLOTS,
+    FUSION_SLACK,
+    OP_SLACK,
+    bucket_waste,
+    bubble_fraction,
+    check_order,
+    check_overlap,
+    compare_bubble,
+    compare_fusion,
+    compare_padding,
+    compare_perf,
+    kernel_inventory,
+    load_perf_baseline,
+    make_perf_baseline,
+    observe_bubbles,
+    observe_padding,
+    run_perf_checks,
+    _expand_groups,
+)
+from accelerate_tpu.analysis.sharding import TRAIN_VARIANTS, apply_waivers
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_BASELINE = os.path.join(_ROOT, "runs", "perf_baseline.json")
+
+
+def _codes(findings):
+    return [f.code for f in findings]
+
+
+# ---------------------------------------------------------------- G501
+def _entry(predicted_s=1e-5, mfu=0.01, tok_s=None, bound="hbm"):
+    ent = {"predicted_s": predicted_s, "mfu": mfu, "bound": bound,
+           "flops": 1e6, "hbm_bytes": 1e6, "ici_bytes": 0.0, "dcn_bytes": 0.0}
+    if tok_s is not None:
+        ent["tok_s"] = tok_s
+    return ent
+
+
+_G501_BASE = {"tolerance": 0.05, "programs": {
+    "p": {"predicted_s": 1e-5, "mfu": 0.01, "tok_s": 1000.0}}}
+
+
+def test_g501_within_tolerance_is_clean():
+    assert compare_perf({"p": _entry(tok_s=1000.0)}, _G501_BASE, "b") == []
+
+
+def test_g501_improvement_passes():
+    obs = {"p": _entry(predicted_s=0.5e-5, mfu=0.02, tok_s=2000.0)}
+    assert compare_perf(obs, _G501_BASE, "b") == []
+
+
+def test_g501_step_time_growth_fails():
+    found = compare_perf({"p": _entry(predicted_s=1.2e-5, tok_s=1000.0)},
+                         _G501_BASE, "b")
+    assert _codes(found) == ["G501"]
+    assert "predicted step time grew" in found[0].message
+
+
+def test_g501_mfu_drop_fails():
+    found = compare_perf({"p": _entry(mfu=0.009, tok_s=1000.0)},
+                         _G501_BASE, "b")
+    assert _codes(found) == ["G501"] and "MFU dropped" in found[0].message
+
+
+def test_g501_tok_s_drop_fails():
+    found = compare_perf({"p": _entry(tok_s=900.0)}, _G501_BASE, "b")
+    assert _codes(found) == ["G501"]
+    assert "decode throughput dropped" in found[0].message
+
+
+def test_g501_unknown_program_asks_for_rebaseline():
+    found = compare_perf({"new": _entry()}, _G501_BASE, "b")
+    assert _codes(found) == ["G501"] and "no perf budget" in found[0].message
+
+
+# ---------------------------------------------------------------- G502
+_COORDS = {0: (0,), 1: (1,)}
+
+
+def _coll(op="all-gather", nbytes=1 << 20, mult=4, is_async=False):
+    return {**dict(op=op, dtype="bf16", bytes=nbytes, group=2,
+                   groups=[[0, 1]], multiplier=mult),
+            "async": is_async}
+
+
+def test_g502_synthetic_dcn_all_gather_fails():
+    # the ISSUE's acceptance fixture: a fourth-program-style DCN all-gather
+    # whose modeled transfer dwarfs the per-iteration compute
+    found = check_overlap("train.x/prog", "src.py", [_coll()],
+                          ("dp",), _COORDS, dcn_axes=("dp",),
+                          t_compute_total=1e-6)
+    assert _codes(found) == ["G502"]
+    assert "DCN" in found[0].message and "all-gather" in found[0].message
+
+
+def test_g502_in_loop_sync_ici_fails():
+    found = check_overlap("train.x/prog", "src.py", [_coll()],
+                          ("dp",), _COORDS, dcn_axes=(),
+                          t_compute_total=1e-6)
+    assert _codes(found) == ["G502"]
+    assert "ICI" in found[0].message
+    assert "async-start/done" in found[0].message
+
+
+def test_g502_async_in_loop_ici_passes():
+    assert check_overlap("train.x/prog", "src.py",
+                         [_coll(is_async=True)], ("dp",), _COORDS,
+                         dcn_axes=(), t_compute_total=1e-6) == []
+
+
+def test_g502_hideable_collective_passes():
+    # plenty of independent compute to overlap with
+    assert check_overlap("train.x/prog", "src.py", [_coll()],
+                         ("dp",), _COORDS, dcn_axes=("dp",),
+                         t_compute_total=1.0) == []
+
+
+def test_g502_out_of_loop_non_dcn_skipped():
+    assert check_overlap("train.x/prog", "src.py", [_coll(mult=1)],
+                         ("dp",), _COORDS, dcn_axes=(),
+                         t_compute_total=1e-6) == []
+
+
+def test_g502_json_waiver_silences():
+    found = check_overlap("train.x/prog", "src.py", [_coll()],
+                          ("dp",), _COORDS, dcn_axes=("dp",),
+                          t_compute_total=1e-6)
+    baseline = {"waivers": {"G502": {
+        r"train\.x/.*all-gather.*DCN": "fixture: deliberate cross-slice"}}}
+    kept, waived = apply_waivers(found, baseline)
+    assert kept == [] and waived == 1
+    # the waiver is pinned: a different op is NOT covered
+    other = check_overlap("train.x/prog", "src.py",
+                          [_coll(op="all-reduce")], ("dp",), _COORDS,
+                          dcn_axes=("dp",), t_compute_total=1e-6)
+    kept, _ = apply_waivers(other, baseline)
+    assert _codes(kept) == ["G502"]
+
+
+def test_g502_committed_waivers_have_reasons():
+    baseline = load_perf_baseline(_BASELINE)
+    assert baseline is not None, "runs/perf_baseline.json must be committed"
+    for code, pats in baseline.get("waivers", {}).items():
+        for pat, reason in pats.items():
+            assert isinstance(reason, str) and len(reason) > 10, (code, pat)
+
+
+# ---------------------------------------------------------------- G503
+def test_g503_canonical_waste_numbers():
+    # mean prompt 4 of bucket 8; mean live 4 + 4/2 = 6
+    dense = bucket_waste(CANON_PROMPT_LENS, CANON_BUDGET, ENGINE_SLOTS,
+                         ENGINE_MAX_LEN, ENGINE_PROMPT_BUCKET)
+    assert dense["prefill_insert"] == pytest.approx(0.5)
+    assert dense["decode_step"] == pytest.approx(1 - 6 / 16)  # 0.625
+    paged = bucket_waste(CANON_PROMPT_LENS, CANON_BUDGET, ENGINE_SLOTS,
+                         ENGINE_MAX_LEN, ENGINE_PROMPT_BUCKET,
+                         block_size=ENGINE_BLOCK_SIZE)
+    assert paged["decode_step"] == pytest.approx(1 - 6 / 8)  # 0.25
+    assert paged["decode_step"] < dense["decode_step"]  # the paged-KV win
+
+
+def test_g503_exact_fit_has_zero_waste():
+    waste = bucket_waste([8, 8], 0, 2, 8, 8, block_size=None)
+    assert waste["prefill_insert"] == 0.0
+    assert waste["decode_step"] == 0.0
+
+
+def test_g503_doubled_waste_fails():
+    base = {"tolerance": 0.05,
+            "padding": {"engine.paged/decode_step": 0.25}}
+    found = compare_padding({"engine.paged/decode_step": 0.5}, base, "b")
+    assert _codes(found) == ["G503"]
+    assert "padded-FLOP fraction grew" in found[0].message
+
+
+def test_g503_committed_waste_is_clean_and_shrink_passes():
+    base = {"tolerance": 0.05,
+            "padding": {"engine.dense/decode_step": 0.625}}
+    assert compare_padding({"engine.dense/decode_step": 0.625},
+                           base, "b") == []
+    assert compare_padding({"engine.dense/decode_step": 0.25},
+                           base, "b") == []
+
+
+def test_g503_missing_budget_asks_for_rebaseline():
+    found = compare_padding({"p": 0.1}, {"padding": {}}, "b")
+    assert _codes(found) == ["G503"]
+    assert "no padding-waste budget" in found[0].message
+
+
+def test_g503_observe_padding_group_filter():
+    obs = observe_padding(["engine.paged"])
+    assert set(obs) == {"engine.paged/prefill_insert",
+                        "engine.paged/decode_step"}
+    assert set(observe_padding()) == {
+        f"{g}/{p}" for g in ("engine.dense", "engine.spec", "engine.paged")
+        for p in ("prefill_insert", "decode_step")}
+
+
+# ---------------------------------------------------------------- G504
+_HLO_FIXTURE = """\
+HloModule fixture
+
+ENTRY %main (p0: f32[4]) -> (f32[4]) {
+  %p0 = f32[4]{0} parameter(0)
+  %c = f32[4]{0} constant({1, 2, 3, 4})
+  %f1 = f32[4]{0} fusion(f32[4]{0} %p0, f32[4]{0} %c), kind=kLoop
+  %f2 = f32[4]{0} fusion(f32[4]{0} %f1), kind=kInput
+  %d = f32[4,4]{1,0} dot(f32[4]{0} %p0, f32[4]{0} %c)
+  // %ghost = f32[4]{0} add(%p0, %c) -- comments don't count
+  ROOT %t = (f32[4]{0}) tuple(f32[4]{0} %f2)
+}
+"""
+
+
+def test_g504_kernel_inventory_parses_fixture():
+    inv = kernel_inventory(_HLO_FIXTURE)
+    assert inv["fusions"] == 2
+    assert inv["ops"]["dot"] == 1
+    assert inv["ops"]["parameter"] == 1
+    assert inv["ops"]["tuple"] == 1
+    assert "fusion" not in inv["ops"]
+    assert "add" not in inv["ops"]  # the comment line
+
+
+def test_g504_fusion_growth_beyond_slack_fails():
+    base = {"tolerance": 0.05,
+            "fusion": {"p": {"fusions": 10, "ops": {"dot": 4}}}}
+    within = {"p": {"fusions": 10 + FUSION_SLACK, "ops": {"dot": 4}}}
+    assert compare_fusion(within, base, "b") == []
+    broken = {"p": {"fusions": 13, "ops": {"dot": 4}}}
+    found = compare_fusion(broken, base, "b")
+    assert _codes(found) == ["G504"]
+    assert "fusion count grew" in found[0].message
+
+
+def test_g504_op_histogram_drift_fails():
+    base = {"tolerance": 0.05,
+            "fusion": {"p": {"fusions": 10, "ops": {"dot": 4}}}}
+    within = {"p": {"fusions": 10, "ops": {"dot": 4 + OP_SLACK}}}
+    assert compare_fusion(within, base, "b") == []
+    drifted = {"p": {"fusions": 10, "ops": {"dot": 9}}}
+    found = compare_fusion(drifted, base, "b")
+    assert _codes(found) == ["G504"] and "'dot'" in found[0].message
+
+
+def test_g504_shrinkage_passes_and_missing_asks_rebaseline():
+    base = {"fusion": {"p": {"fusions": 10, "ops": {"dot": 4}}}}
+    assert compare_fusion({"p": {"fusions": 3, "ops": {}}}, base, "b") == []
+    found = compare_fusion({"q": {"fusions": 1, "ops": {}}}, base, "b")
+    assert _codes(found) == ["G504"]
+    assert "no fusion inventory" in found[0].message
+
+
+# ---------------------------------------------------------------- G505
+def test_g505_closed_form():
+    assert bubble_fraction(4, 4) == pytest.approx(3 / 7)
+    assert bubble_fraction(4, 8) == pytest.approx(3 / 11)
+    assert bubble_fraction(4, 16) == pytest.approx(3 / 19)
+    assert bubble_fraction(1, 4) == 0.0  # no pipeline, no bubble
+    # more microbatches -> smaller bubble, monotonically
+    fracs = [bubble_fraction(4, m) for m in (4, 8, 16, 32)]
+    assert fracs == sorted(fracs, reverse=True)
+
+
+def test_g505_interleaved_beats_plain_1f1b():
+    # virtual stages shrink the warmup/drain wedge at equal microbatches —
+    # the same numbers pp_schedule_bench.py reports (it imports this helper)
+    assert bubble_fraction(4, 8, virtual=2) < bubble_fraction(4, 8)
+
+
+def test_g505_observe_covers_the_bench_matrix():
+    obs = observe_bubbles()
+    assert set(obs) == {key for key, *_ in BUBBLE_CONFIGS}
+    assert obs["pp4/m4"] == pytest.approx(3 / 7, abs=1e-6)
+
+
+def test_g505_growth_fails_shrink_passes():
+    base = {"tolerance": 0.05, "bubble": {"pp4/m8": bubble_fraction(4, 8)}}
+    assert compare_bubble({"pp4/m8": bubble_fraction(4, 8)}, base, "b") == []
+    assert compare_bubble({"pp4/m8": 0.1}, base, "b") == []
+    # shrinking microbatches 8 -> 4 grows the bubble past any tolerance
+    found = compare_bubble({"pp4/m8": bubble_fraction(4, 4)}, base, "b")
+    assert _codes(found) == ["G505"]
+    assert "bubble fraction grew" in found[0].message
+
+
+def test_g505_missing_budget_asks_for_rebaseline():
+    found = compare_bubble({"pp8/m8": 0.1}, {"bubble": {}}, "b")
+    assert _codes(found) == ["G505"]
+    assert "no bubble budget" in found[0].message
+
+
+# ---------------------------------------------------------------- witness
+def test_check_order_contradiction_fails():
+    # predictor says A is 2x slower; the clock confidently disagrees
+    found = check_order("t", 2.0, 1.0, 1.0, 2.0)
+    assert _codes(found) == ["G501"]
+    assert "contradicts" in found[0].message
+    assert found[0].program == "witness.t"
+
+
+def test_check_order_agreement_passes():
+    assert check_order("t", 2.0, 1.0, 3.0, 1.0) == []
+    assert check_order("t", 1.0, 2.0, 1.0, 3.0) == []
+
+
+def test_check_order_tie_band_absorbs_noise():
+    # measured ratio inside ±25%: a tie, never a contradiction
+    assert check_order("t", 2.0, 1.0, 1.0, 1.2) == []
+    # predicted tie, measured confident: also fine
+    assert check_order("t", 1.0, 1.1, 1.0, 3.0) == []
+
+
+def test_check_order_ignores_degenerate_inputs():
+    assert check_order("t", 0.0, 1.0, 1.0, 2.0) == []
+
+
+# ---------------------------------------------------------------- baseline
+def test_make_baseline_preserves_reviewed_content():
+    prior = {"chip": "v5e", "tolerance": 0.1, "order_tolerance": 0.5,
+             "programs": {"old/prog": {"predicted_s": 1.0}},
+             "waivers": {"G502": {"pat": "reason"}}}
+    new = make_perf_baseline(
+        {"programs": {"p": {"predicted_s": 2.0, "t_compute_s": 1.5}},
+         "padding": {"p/decode_step": 0.25},
+         "fusion": {"p": {"fusions": 1, "ops": {}}},
+         "bubble": {"pp4/m4": 0.42}},
+        prior)
+    assert new["chip"] == "v5e"
+    assert new["tolerance"] == 0.1 and new["order_tolerance"] == 0.5
+    assert new["waivers"] == prior["waivers"]
+    assert "old/prog" in new["programs"]  # partial runs merge
+    assert new["programs"]["p"] == {"predicted_s": 2.0}  # t_compute_s dropped
+    assert new["padding"]["p/decode_step"] == 0.25
+    assert new["bubble"]["pp4/m4"] == 0.42
+
+
+def test_update_baseline_routes_through_sink(tmp_path):
+    # the atomic five-file protocol: with a sink, NOTHING is written — the
+    # CLI commits every staged baseline together after all levels ran
+    path = str(tmp_path / "perf_baseline.json")
+    sink = []
+    found = run_perf_checks(baseline_path=path, update_baseline=True,
+                            groups=[], with_witness=False,
+                            baseline_sink=sink, repo_root=_ROOT)
+    assert found == []
+    assert not os.path.exists(path)
+    assert len(sink) == 1 and sink[0][0] == path
+    staged = sink[0][1]
+    assert set(staged) == {"chip", "tolerance", "order_tolerance",
+                           "programs", "padding", "fusion", "bubble",
+                           "waivers"}
+    assert staged["bubble"]  # lowering skipped nothing that is pure math
+
+
+def test_update_baseline_without_sink_writes_atomically(tmp_path):
+    path = str(tmp_path / "perf_baseline.json")
+    run_perf_checks(baseline_path=path, update_baseline=True, groups=[],
+                    with_witness=False, repo_root=_ROOT)
+    with open(path) as f:
+        written = json.load(f)
+    assert written["chip"] == "v5p"
+    assert not [p for p in os.listdir(tmp_path)
+                if p != "perf_baseline.json"]  # no temp file left behind
+
+
+def test_missing_baseline_is_a_finding(tmp_path):
+    found = run_perf_checks(baseline_path=str(tmp_path / "nope.json"),
+                            groups=[], with_witness=False, repo_root=_ROOT)
+    assert _codes(found) == ["G501"]
+    assert "baseline missing" in found[0].message
+
+
+# ---------------------------------------------------------------- changed-only
+def test_expand_groups():
+    assert _expand_groups(None) is None
+    assert _expand_groups(["engine.dense"]) == ["engine.dense"]
+    expanded = _expand_groups(["engine.paged", "train_step"])
+    assert expanded[0] == "engine.paged"
+    assert set(expanded[1:]) == {tag for tag, _ in TRAIN_VARIANTS}
+
+
+@pytest.mark.parametrize("path", [
+    "Makefile",
+    "runs/perf_baseline.json",
+    "runs/static_baseline.json",
+    "runs/sharding_baseline.json",
+    "accelerate_tpu/analysis/perf.py",
+])
+def test_changed_baseline_or_makefile_forces_full_run(path, monkeypatch):
+    # a relaxed budget or Makefile edit must never skip the level it relaxes
+    monkeypatch.setattr(num, "changed_paths", lambda root: [path])
+    assert num.changed_groups(_ROOT) == (None, True)
+
+
+def test_changed_engine_module_skips_train_variants(monkeypatch):
+    monkeypatch.setattr(num, "changed_paths",
+                        lambda root: ["accelerate_tpu/kvcache.py"])
+    groups, _ = num.changed_groups(_ROOT)
+    assert groups is not None and all(g.startswith("engine.") for g in groups)
+    assert _expand_groups(groups) == groups  # no train tags sneak in
+
+
+# ---------------------------------------------------------------- clean tree
+def test_perf_engine_dense_group_is_clean():
+    # one-group lowering keeps the fast suite honest without the full sweep
+    assert run_perf_checks(baseline_path=_BASELINE,
+                           groups=["engine.dense"],
+                           with_witness=False, repo_root=_ROOT) == []
+
+
+@pytest.mark.slow
+def test_perf_full_run_with_witness_is_clean():
+    assert run_perf_checks(baseline_path=_BASELINE, repo_root=_ROOT) == []
+
+
+def test_committed_baseline_matches_pure_observations():
+    # the pure-math halves of the committed baseline can be re-derived
+    # instantly — a drifted constant in perf.py fails here, not in CI lag
+    baseline = load_perf_baseline(_BASELINE)
+    assert baseline["bubble"] == observe_bubbles()
+    assert baseline["padding"] == observe_padding()

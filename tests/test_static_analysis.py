@@ -487,6 +487,7 @@ def test_finding_render():
         "G201", "G202", "G203", "G204", "G205",
         "G301", "G302", "G303", "G304", "G305", "G306",
         "G401", "G402", "G403", "G404", "G405",
+        "G501", "G502", "G503", "G504", "G505",
     }
 
 
