@@ -9,7 +9,7 @@
 PY := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu python
 PY_SLOW := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu RUN_SLOW=1 python
 
-.PHONY: test test_all test_core test_data test_parallel test_models test_cli test_big_modeling test-fault test-serving check-static check-sharding check-concurrency check-numerics check-perf check-all install-hooks bench bench-telemetry bench-serving bench-continuous bench-recovery bench-kv bench-spec bench-fleet
+.PHONY: test test_all test_core test_data test_parallel test_models test_cli test_big_modeling test-fault test-serving check-static check-sharding check-concurrency check-numerics check-perf check-all install-hooks bench bench-telemetry bench-serving bench-continuous bench-recovery bench-kv bench-spec bench-fleet bench-trace
 
 test: check-static
 	$(PY) -m pytest tests/ -q
@@ -159,6 +159,14 @@ bench-spec:
 # worse with prefill/decode disaggregation than without (docs/serving.md)
 bench-fleet:
 	$(PY) benchmarks/serving_bench.py --fleet-gate
+
+# tracing gate: span-spine overhead (tracing-on serving goodput >= 0.98x
+# off) + flight-recorder chaos forensics — kill a replica mid-batch and the
+# dump must show, per affected request, the failed dispatch span, a typed
+# error event, and the successful failover dispatch, with zero dropped
+# futures and zero dropped spans (docs/observability.md)
+bench-trace:
+	$(PY) benchmarks/tracing_bench.py --gate
 
 # elastic-recovery gate: MTTR per restore path (local / replica / elastic
 # reshard, restart-to-resumed wall clock) + consensus/replication must stay

@@ -104,6 +104,10 @@ _LAZY = {
     "DeferredReadbackRing": ("telemetry", "DeferredReadbackRing"),
     "AsyncTrackerFlusher": ("telemetry", "AsyncTrackerFlusher"),
     "LatencyReservoir": ("telemetry", "LatencyReservoir"),
+    "tracing": ("tracing", None),
+    "Tracer": ("tracing", "Tracer"),
+    "MetricsRegistry": ("tracing", "MetricsRegistry"),
+    "TracingConfig": ("utils.dataclasses", "TracingConfig"),
 }
 
 
@@ -114,5 +118,5 @@ def __getattr__(name):
 
         module_name, attr = _LAZY[name]
         module = importlib.import_module(f".{module_name}", __name__)
-        return getattr(module, attr)
+        return module if attr is None else getattr(module, attr)
     raise AttributeError(f"module 'accelerate_tpu' has no attribute {name!r}")

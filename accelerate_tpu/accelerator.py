@@ -30,6 +30,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from . import tracing
 from .data_loader import DataLoaderDispatcher, DataLoaderShard, prepare_data_loader, skip_first_batches
 from .logging import get_logger
 from .model import Model
@@ -1484,15 +1485,20 @@ class Accelerator:
                 in_params, in_opt = pp, po
             else:
                 in_params, in_opt = model.params, optimizer.opt_state
-            params, opt_state, accum, count, scaler_state, psgd_state, loss = compiled(
-                in_params,
-                in_opt,
-                state["accum"],
-                state["count"],
-                state["scaler"],
-                state["psgd"],
-                *batch,
-            )
+            # host-side dispatch span only (the fused program runs async on
+            # device); sampled so steady-state cost stays one modulo
+            with tracing.step_span(
+                "train.step_dispatch", optimizer._step_count, flat=use_flat
+            ):
+                params, opt_state, accum, count, scaler_state, psgd_state, loss = compiled(
+                    in_params,
+                    in_opt,
+                    state["accum"],
+                    state["count"],
+                    state["scaler"],
+                    state["psgd"],
+                    *batch,
+                )
             if use_flat:
                 model._set_packed_params(params, param_spec, _unpack_params)
                 optimizer._set_packed_opt_state(opt_state, opt_spec, _unpack_opt)
@@ -1793,11 +1799,12 @@ class Accelerator:
         ring = self._health_ring
         if ring is None:
             return True
-        while len(ring):
-            # popleft one at a time: a restore verdict clears the ring
-            # (the newer in-flight summaries predate the reload — stale)
-            step, summary = ring.popleft()
-            ok = self._apply_health_verdict(telemetry.read_summary(summary, step)) and ok
+        with tracing.span("train.ring_drain", pending=len(ring)):
+            while len(ring):
+                # popleft one at a time: a restore verdict clears the ring
+                # (the newer in-flight summaries predate the reload — stale)
+                step, summary = ring.popleft()
+                ok = self._apply_health_verdict(telemetry.read_summary(summary, step)) and ok
         return ok
 
     def _apply_health_verdict(self, health) -> bool:

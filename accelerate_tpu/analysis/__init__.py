@@ -34,6 +34,8 @@ Level 2 — host lint (``analysis/host.py``):
 * **G104** tracker/metrics I/O while holding the server lock
 * **G105** fault-injection point referenced by tests/docs but absent from
   the code's ``fault_point`` registry
+* **G107** tracing discipline: host clock / tracer call inside a jitted
+  function, or ``tracing.span``/``step_span`` used outside a ``with``
 
 Level 3 — sharding & memory audit (``analysis/sharding.py``):
 
@@ -119,7 +121,8 @@ Level 6 budgets and program-scoped waivers live in
 Waivers are line-scoped comments, same line or the line above:
 ``# graft: sync-ok`` (G101), ``# graft: wait-ok`` (G102),
 ``# graft: raise-ok`` (G103), ``# graft: lock-ok`` (G104),
-``# graft: fault-ok`` (G105), ``# graft: block-ok`` (G302),
+``# graft: fault-ok`` (G105), ``# graft: trace-ok`` (G107),
+``# graft: block-ok`` (G302),
 ``# graft: race-ok`` (G303), ``# graft: thread-ok`` (G304),
 ``# graft: resolve-ok`` (G305), ``# graft: gang-ok`` (G306),
 ``# graft: key-ok`` (G404), or the universal ``# graft: GXXX-ok``.
@@ -143,6 +146,7 @@ RULES = {
     "G103": "untyped raise where a fault-taxonomy type exists",
     "G104": "tracker/metrics call while holding the server lock",
     "G105": "referenced fault-injection point missing from the registry",
+    "G107": "tracer/clock call in jitted code or span used outside 'with'",
     "G201": "large state tensor replicated where the config claims sharding",
     "G202": "GSPMD reshard collective not implied by the declared specs",
     "G203": "static per-device HBM footprint grew past the committed budget",

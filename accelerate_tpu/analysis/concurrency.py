@@ -94,6 +94,7 @@ AUDITED_MODULES = (
     "telemetry.py",
     "state.py",
     "data_loader.py",
+    "tracing.py",
 )
 
 # Modules where G305 applies: the Future-resolution discipline modules.
@@ -102,8 +103,11 @@ RESOLVE_MODULES = {"serving.py", "fleet.py"}
 RESOLVER_NAMES = {"_resolve", "resolve_future"}
 
 # Lock-looking attributes (superset of Level 2's server-lock regex:
-# condition variables participate in the lock-order graph too).
-_LOCK_ATTR_RE = re.compile(r"^(_lock|_cond|_wake|_mu)\w*$|^lock$")
+# condition variables participate in the lock-order graph too). Both
+# prefix (`_lock_x`) and suffix (`_x_lock`) naming conventions count.
+_LOCK_ATTR_RE = re.compile(
+    r"^(_lock|_cond|_wake|_mu)\w*$|^\w+_(lock|cond|mu)$|^lock$"
+)
 # Receivers that look like queues for the G302 timeout-less .get() check.
 _QUEUEISH_RE = re.compile(r"(^|_)q(ueue)?s?$|queue")
 

@@ -1,4 +1,4 @@
-"""graftcheck Level 2: AST lint over the host-side code (rules G101–G105).
+"""graftcheck Level 2: AST lint over the host-side code (rules G101–G107).
 
 Pure-stdlib (ast + re) — no jax import, so ``--level host`` runs in well
 under a second. Rules are repo-specific by design; each one encodes an
@@ -15,10 +15,16 @@ invariant a past PR or review cycle established:
   review's lock-held-flush stall).
 * G105 — a fault-injection point referenced by tests/docs must exist in
   code, or the test silently stops testing anything (PR 1 harness).
+* G107 — tracing discipline (PR 11 flight recorder): no host clocks or
+  tracer calls inside jitted functions (they run once at trace time and
+  bake a constant — or worse, retrace), and ``tracing.span``/``step_span``
+  only as ``with`` context managers (a span that is never ``__exit__``-ed
+  never lands in the ring, so it silently records nothing).
 
 Waivers are line-scoped comments on the finding line or the line above:
 the per-rule token (``sync-ok``, ``wait-ok``, ``raise-ok``, ``lock-ok``,
-``fault-ok``) or the universal ``gXXX-ok`` form, e.g. ``# graft: g101-ok``.
+``fault-ok``, ``trace-ok``) or the universal ``gXXX-ok`` form, e.g.
+``# graft: g101-ok``.
 """
 
 from __future__ import annotations
@@ -47,7 +53,7 @@ _DEVICE_CALL_RE = re.compile(r"(_jit|_generate_fn)$")
 # Lock attributes guarding the serving dispatch/admission path.
 _LOCK_ATTR_RE = re.compile(r"^(_lock|_wake|_mu)\w*$|^lock$")
 # Tracker/metrics I/O entry points that must never run under those locks.
-_TRACKER_SINKS = {"_flush_metrics", "_emit_snapshot", "log_batch"}
+_TRACKER_SINKS = {"_flush_metrics", "maybe_flush", "log_registry", "log_batch"}
 
 _WAIVER_RE = re.compile(r"#\s*graft:\s*([\w ,-]+)")
 _RULE_TOKENS = {
@@ -56,6 +62,7 @@ _RULE_TOKENS = {
     "G103": "raise-ok",
     "G104": "lock-ok",
     "G105": "fault-ok",
+    "G107": "trace-ok",
     # Level 5's AST half (analysis/numerics.py) shares this waiver table
     "G404": "key-ok",
 }
@@ -283,6 +290,12 @@ def lint_source(text: str, relpath: str) -> List[Finding]:
     # G104 — tracker I/O under the server lock
     _lint_lock_held(tree, relpath, waivers, findings)
 
+    # G107 — tracing discipline (tracing.py implements the machinery and is
+    # exempt from the span-usage half; the jit half applies everywhere)
+    _lint_jitted_tracing(tree, relpath, waivers, findings)
+    if base != "tracing.py":
+        _lint_span_discipline(tree, relpath, waivers, findings)
+
     return _dedupe(findings)
 
 
@@ -312,6 +325,105 @@ def _lint_lock_held(tree, relpath, waivers, findings) -> None:
             visit(child, child_held)
 
     visit(tree, False)
+
+
+# ------------------------------------------------------------------- G107
+# Host clocks: called at trace time they bake a constant into the program
+# (and a tracer ring append inside traced code is pure overhead/retrace bait).
+_CLOCK_FUNCS = {"time", "monotonic", "perf_counter", "perf_counter_ns", "monotonic_ns"}
+_SPAN_FUNCS = {"span", "step_span"}
+_TRACER_FUNCS = _SPAN_FUNCS | {"flight_dump", "new_trace_id", "get_tracer"}
+
+
+def _jit_wrapped_names(tree: ast.AST) -> Set[str]:
+    """Function names passed positionally to a ``*jit*(...)`` call, e.g.
+    ``self._decode_jit = jax.jit(_decode_impl, ...)``."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = _attr_chain(node.func)
+        if not chain or "jit" not in chain[-1]:
+            continue
+        for arg in node.args[:1]:
+            if isinstance(arg, ast.Name):
+                names.add(arg.id)
+            elif isinstance(arg, ast.Attribute):
+                names.add(arg.attr)
+    return names
+
+
+def _is_jit_decorated(fn: ast.AST) -> bool:
+    for dec in getattr(fn, "decorator_list", []):
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        if any("jit" in part for part in _attr_chain(target)):
+            return True
+    return False
+
+
+def _lint_jitted_tracing(tree, relpath, waivers, findings) -> None:
+    """G107 (jit half): no host clocks or tracer calls inside code jax will
+    trace. A function counts as jitted when it is decorated with ``*jit*``,
+    passed to a ``*jit*(...)`` call, or follows the repo's ``*_impl`` naming
+    convention for staged-out program bodies."""
+    jit_names = _jit_wrapped_names(tree)
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not (
+            fn.name.endswith("_impl")
+            or fn.name in jit_names
+            or _is_jit_decorated(fn)
+        ):
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _attr_chain(node.func)
+            if not chain:
+                continue
+            leaf = chain[-1]
+            offense = None
+            if len(chain) >= 2 and chain[0] == "time" and leaf in _CLOCK_FUNCS:
+                offense = f"host clock {'.'.join(chain)}()"
+            elif "tracing" in chain[:-1] or leaf in _TRACER_FUNCS:
+                offense = f"tracer call {'.'.join(chain)}()"
+            if offense and not _waived("G107", node.lineno, waivers):
+                findings.append(Finding(
+                    "G107", relpath, node.lineno,
+                    f"{offense} inside jitted function {fn.name!r}: runs once "
+                    "at trace time (baked constant / retrace hazard) — hoist "
+                    "to the host wrapper or waive with '# graft: trace-ok'",
+                ))
+
+
+def _lint_span_discipline(tree, relpath, waivers, findings) -> None:
+    """G107 (usage half): ``span(...)``/``step_span(...)`` must be the
+    context expression of a ``with`` — any other use (assignment, bare
+    expression, argument) skips ``__exit__`` and records nothing."""
+    with_ctx_ids: Set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                with_ctx_ids.add(id(item.context_expr))
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or id(node) in with_ctx_ids:
+            continue
+        chain = _attr_chain(node.func)
+        if not chain or chain[-1] not in _SPAN_FUNCS:
+            continue
+        # only the tracing API, not unrelated helpers that happen to be
+        # named span: require a tracing/tracer qualifier or a bare import
+        root = chain[0]
+        if len(chain) > 1 and root not in ("tracing", "tracer", "self"):
+            continue
+        if not _waived("G107", node.lineno, waivers):
+            findings.append(Finding(
+                "G107", relpath, node.lineno,
+                f"{'.'.join(chain)}() used outside a 'with' statement: the "
+                "span never __exit__s, so it is never recorded — use "
+                "'with tracing.span(...):' (or waive with '# graft: trace-ok')",
+            ))
 
 
 # ------------------------------------------------------------------- G105

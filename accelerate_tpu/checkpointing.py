@@ -45,6 +45,7 @@ import numpy as np
 
 import jax
 
+from . import tracing
 from .logging import get_logger
 from .state import PartialState
 from .utils.constants import (
@@ -339,6 +340,13 @@ def _commit_staged(staging: str, final: str, accelerator) -> None:
     ``<final>.old`` until the rename lands — the previous committed state is
     only ever deleted after the new one is durable."""
     state = PartialState()
+    with tracing.span(
+        "ckpt.commit", step=int(getattr(accelerator, "step", 0) or 0), final=final
+    ):
+        _commit_staged_inner(staging, final, accelerator, state)
+
+
+def _commit_staged_inner(staging: str, final: str, accelerator, state) -> None:
     # every host's staged writes are on disk
     state.wait_for_everyone("accelerate_tpu.checkpointing.pre_commit")
     fault_point("before_commit")
@@ -478,12 +486,18 @@ def _latest_committed(base: str) -> str:
     committed = [p for p in entries if is_checkpoint_committed(p)]
     if committed:
         chosen = committed[-1]
+        rolled_back = False
         for newer in entries[entries.index(chosen) + 1 :]:
+            rolled_back = True
             logger.warning(
                 f"ignoring uncommitted checkpoint {newer} (interrupted save: "
                 f"no {CHECKPOINT_COMMITTED_MARKER} manifest); rolling back to "
                 f"last committed checkpoint {chosen}"
             )
+        if rolled_back:
+            # typed-failure hook: preserve the recent span history showing
+            # what led to the interrupted save being skipped
+            tracing.flight_dump("checkpoint_rollback")
         return chosen
     logger.warning(
         f"no checkpoint under {base} carries a {CHECKPOINT_COMMITTED_MARKER} "

@@ -34,6 +34,7 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from . import tracing
 from .logging import get_logger
 from .state import GradientState, PartialState
 from .utils.random import synchronize_rng_states
@@ -402,6 +403,7 @@ class _DevicePrefetcher:
         self.q: queue.Queue = queue.Queue(maxsize=depth)
         self.error: Optional[BaseException] = None
         self._stop = threading.Event()
+        self._fetches = 0
         self.thread = threading.Thread(target=self._worker, daemon=True)
         self.thread.start()
 
@@ -432,7 +434,12 @@ class _DevicePrefetcher:
         return self
 
     def __next__(self):
-        item = self.q.get()
+        # the blocking get IS the data wait: span duration shows how long
+        # the step loop stalled on input (sampled; see TracingConfig)
+        step = self._fetches
+        self._fetches += 1
+        with tracing.step_span("train.data_wait", step):
+            item = self.q.get()
         if item is self._SENTINEL:
             if self.error is not None:
                 raise self.error

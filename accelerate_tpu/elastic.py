@@ -48,6 +48,7 @@ import time
 from dataclasses import dataclass
 from typing import Optional
 
+from . import tracing
 from .logging import get_logger
 from .state import PartialState
 from .utils.constants import (
@@ -400,6 +401,10 @@ class CheckpointReplicator:
                     self._cond.notify_all()
 
     def _mirror_with_retry(self, src: str) -> None:
+        with tracing.span("elastic.replicate", src=src) as sp:
+            self._mirror_with_retry_inner(src, sp)
+
+    def _mirror_with_retry_inner(self, src: str, sp) -> None:
         name = os.path.basename(src.rstrip(os.sep))
         failures: list = []
         succeeded = 0
@@ -433,6 +438,8 @@ class CheckpointReplicator:
             succeeded += 1
             if self.config.keep:
                 _gc_replicas(root, self.config.keep)
+        sp.set("succeeded", succeeded)
+        sp.set("failed", len(failures))
         if failures:
             if len(failures) == 1 and succeeded == 0:
                 raise failures[0][1]

@@ -71,6 +71,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from . import tracing
 from .logging import get_logger
 from .utils.fault import EngineCapacityError, EngineInvariantError
 
@@ -104,6 +105,11 @@ class SlotOccupant:
     spec_ewma: float = 0.3
     spec_skips: int = 0
     spec_cooldown: int = 8
+    # request trace ID (copied from the tag at insert) and the number of
+    # fused programs that emitted tokens for this occupant — the
+    # ``ServingResult.decode_steps`` span-summary source
+    trace_id: Optional[str] = None
+    decode_steps: int = 0
 
     def output_row(self) -> np.ndarray:
         """prompt + emitted tokens, padded with ``pad_id`` to the full
@@ -704,20 +710,28 @@ class ContinuousBatchingEngine:
             else (eos_token_id if eos_token_id is not None else 0)
         )
         kd = jax.random.key_data(jax.random.key(seed))
+        trace_id = getattr(tag, "trace_id", None)
         self._record("prefill_insert", (self.prompt_bucket,))
-        self._donated, self._carried, t0, d0 = self._prefill_jit(
-            self._donated, self._carried, self.model.params,
-            jnp.asarray(padded), jnp.int32(len(prompt)), jnp.int32(slot), kd,
-            jnp.float32(temperature),
-            jnp.int32(top_k if top_k is not None else 0),
-            jnp.float32(top_p if top_p is not None else 1.0),
-            jnp.int32(eos_token_id if eos_token_id is not None else -1),
-            jnp.int32(pad_id), jnp.int32(max_new_tokens),
-            jnp.asarray(table_row),
-        )
+        # host-side dispatch span: the jitted body never sees the tracer
+        # (G107) — this times the interleaved prefill on the decode thread
+        with tracing.span(
+            "engine.prefill", trace_id=trace_id,
+            slot=slot, prompt_len=len(prompt),
+        ):
+            self._donated, self._carried, t0, d0 = self._prefill_jit(
+                self._donated, self._carried, self.model.params,
+                jnp.asarray(padded), jnp.int32(len(prompt)), jnp.int32(slot), kd,
+                jnp.float32(temperature),
+                jnp.int32(top_k if top_k is not None else 0),
+                jnp.float32(top_p if top_p is not None else 1.0),
+                jnp.int32(eos_token_id if eos_token_id is not None else -1),
+                jnp.int32(pad_id), jnp.int32(max_new_tokens),
+                jnp.asarray(table_row),
+            )
         occ = SlotOccupant(
             slot=slot, tag=tag, prompt=prompt, budget=max_new_tokens,
             pad_id=pad_id, eos_id=eos_token_id, inserted_s=self._clock(),
+            trace_id=trace_id,
         )
         self._occupants[slot] = occ
         self.inserted += 1
@@ -737,6 +751,7 @@ class ContinuousBatchingEngine:
         eos_token_id: Optional[int] = None,
         pad_token_id: Optional[int] = None,
         seed: int = 0,
+        trace_id: Optional[str] = None,
     ) -> RemotePrefill:
         """Run a request's prompt forward WITHOUT admitting it: the
         compute-bound half of prefill, safe from any thread (touches no
@@ -751,12 +766,16 @@ class ContinuousBatchingEngine:
         padded[0, : len(prompt)] = prompt
         kd = jax.random.key_data(jax.random.key(seed))
         self._record("prefill_forward", (self.prompt_bucket,))
-        new_cache, t0, next_key = self._prefill_fwd_jit(
-            self.model.params, jnp.asarray(padded), jnp.int32(len(prompt)), kd,
-            jnp.float32(temperature),
-            jnp.int32(top_k if top_k is not None else 0),
-            jnp.float32(top_p if top_p is not None else 1.0),
-        )
+        with tracing.span(
+            "engine.prefill", trace_id=trace_id,
+            remote=True, prompt_len=len(prompt),
+        ):
+            new_cache, t0, next_key = self._prefill_fwd_jit(
+                self.model.params, jnp.asarray(padded), jnp.int32(len(prompt)), kd,
+                jnp.float32(temperature),
+                jnp.int32(top_k if top_k is not None else 0),
+                jnp.float32(top_p if top_p is not None else 1.0),
+            )
         self.remote_prefills += 1
         return RemotePrefill(
             prompt=prompt, max_new_tokens=max_new_tokens,
@@ -819,20 +838,26 @@ class ContinuousBatchingEngine:
             pre.pad_token_id if pre.pad_token_id is not None
             else (pre.eos_token_id if pre.eos_token_id is not None else 0)
         )
+        trace_id = getattr(tag, "trace_id", None)
         self._record("prefill_commit", ())
-        self._donated, self._carried, t0, d0 = self._prefill_commit_jit(
-            self._donated, self._carried, pre.cache, pre.t0, pre.next_key,
-            jnp.int32(slot), jnp.int32(len(prompt)),
-            jnp.float32(pre.temperature),
-            jnp.int32(pre.top_k if pre.top_k is not None else 0),
-            jnp.float32(pre.top_p if pre.top_p is not None else 1.0),
-            jnp.int32(pre.eos_token_id if pre.eos_token_id is not None else -1),
-            jnp.int32(pad_id), jnp.int32(budget),
-            jnp.asarray(table_row),
-        )
+        with tracing.span(
+            "engine.insert_prefilled", trace_id=trace_id,
+            slot=slot, prompt_len=len(prompt),
+        ):
+            self._donated, self._carried, t0, d0 = self._prefill_commit_jit(
+                self._donated, self._carried, pre.cache, pre.t0, pre.next_key,
+                jnp.int32(slot), jnp.int32(len(prompt)),
+                jnp.float32(pre.temperature),
+                jnp.int32(pre.top_k if pre.top_k is not None else 0),
+                jnp.float32(pre.top_p if pre.top_p is not None else 1.0),
+                jnp.int32(pre.eos_token_id if pre.eos_token_id is not None else -1),
+                jnp.int32(pad_id), jnp.int32(budget),
+                jnp.asarray(table_row),
+            )
         occ = SlotOccupant(
             slot=slot, tag=tag, prompt=prompt, budget=budget,
             pad_id=pad_id, eos_id=pre.eos_token_id, inserted_s=self._clock(),
+            trace_id=trace_id,
         )
         self._occupants[slot] = occ
         self.inserted += 1
@@ -854,10 +879,18 @@ class ContinuousBatchingEngine:
 
     def _dispatch_decode(self) -> bool:
         self._record("decode_step", ())
-        self._donated, self._carried = self._decode_jit(
-            self._donated, self._carried, self.model.params,
-            self._backend.device_tables(),
-        )
+        # per-decode-step aggregates, SAMPLED every decode_sample_every
+        # steps (tracing this hot loop unsampled would be the overhead the
+        # bench gate forbids); the span times the host dispatch only — the
+        # jitted body itself never sees the tracer (G107)
+        with tracing.step_span(
+            "engine.decode_step", self.steps,
+            live=self.live_count(), tick=self._tick,
+        ):
+            self._donated, self._carried = self._decode_jit(
+                self._donated, self._carried, self.model.params,
+                self._backend.device_tables(),
+            )
         self.steps += 1
         self._tick += 1
         self._ring.append(
@@ -1038,10 +1071,14 @@ class ContinuousBatchingEngine:
         # does the host->device transfer cheaper than an explicit
         # device_put, and this sits on the serial critical path (each spec
         # step blocks on the previous verify before it can draft)
-        (self._donated, self._carried, emitted, m, a) = self._verify_jit(
-            self._donated, self._carried, self.model.params,
-            self._backend.device_tables(), draft, dlen,
-        )
+        with tracing.step_span(
+            "engine.spec_verify", self.steps,
+            drafted=total, live=self.live_count(),
+        ):
+            (self._donated, self._carried, emitted, m, a) = self._verify_jit(
+                self._donated, self._carried, self.model.params,
+                self._backend.device_tables(), draft, dlen,
+            )
         self.steps += 1
         self.spec_verify_steps += 1
         self.spec_drafted += total
@@ -1074,6 +1111,7 @@ class ContinuousBatchingEngine:
                 for occ in occs:
                     if occ is None or occ.finished:
                         continue
+                    occ.decode_steps += 1
                     self._absorb(occ, int(toks[occ.slot]), bool(dones[occ.slot]), retired)
             else:  # verify: up to W tokens per slot, done applies to the last
                 occs, emitted, ms, accs, dlens, dones = payload
@@ -1083,6 +1121,7 @@ class ContinuousBatchingEngine:
                 for occ in occs:
                     if occ is None or occ.finished:
                         continue
+                    occ.decode_steps += 1
                     s = occ.slot
                     dl = int(dlens[s])
                     if dl > 0:
@@ -1127,14 +1166,18 @@ class ContinuousBatchingEngine:
             self._retire(occ, retired)
 
     def _retire(self, occ: SlotOccupant, retired: list) -> None:
-        occ.finished = True
-        self._occupants[occ.slot] = None
-        self._free.append(occ.slot)
-        # drops block refcounts AND resets the slot's table row to the null
-        # block, so the ghost slot's masked decode writes (it rides every
-        # step until a new prefill resets it) land in the garbage sink, not
-        # in blocks recycled to someone else
-        self._backend.release(occ.slot)
+        with tracing.span(
+            "engine.retire", trace_id=occ.trace_id, slot=occ.slot,
+            tokens=len(occ.tokens), decode_steps=occ.decode_steps,
+        ):
+            occ.finished = True
+            self._occupants[occ.slot] = None
+            self._free.append(occ.slot)
+            # drops block refcounts AND resets the slot's table row to the
+            # null block, so the ghost slot's masked decode writes (it rides
+            # every step until a new prefill resets it) land in the garbage
+            # sink, not in blocks recycled to someone else
+            self._backend.release(occ.slot)
         self.retired += 1
         retired.append(occ)
 

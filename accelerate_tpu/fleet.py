@@ -69,9 +69,11 @@ from typing import Any, Callable, Dict, Optional
 
 import numpy as np
 
+from . import tracing
 from .elastic import FleetMembership
 from .logging import get_logger
 from .serving import InferenceServer, _CircuitBreaker, resolve_future
+from .tracing import MetricsRegistry
 from .utils.dataclasses import FleetConfig
 from .utils.fault import (
     FailoverExhaustedError,
@@ -127,7 +129,10 @@ class _TokenBucket:
 class FleetMetrics:
     """Thread-safe fleet counters (monotonic) + gauges; :meth:`snapshot`
     flattens everything into one ``fleet/...`` dict, the router-level twin
-    of :class:`~accelerate_tpu.serving.ServingMetrics`."""
+    of :class:`~accelerate_tpu.serving.ServingMetrics` — and, like it, a
+    thin facade over one :class:`~accelerate_tpu.tracing.MetricsRegistry`
+    (which owns the lock and the periodic tracker-flush cadence, so that
+    logic lives in exactly one place)."""
 
     _COUNTERS = (
         "submitted",
@@ -150,32 +155,24 @@ class FleetMetrics:
         "prefill_fallbacks",  # disaggregation unavailable → plain submit
     )
 
-    def __init__(self):
-        self._lock = threading.Lock()
-        self._counts = {name: 0 for name in self._COUNTERS}
-        self._gauges: Dict[str, float] = {
-            "replicas": 0,
-            "routable_replicas": 0,
-            "retry_budget": 0.0,
-        }
+    def __init__(self, clock: Callable[[], float] = time.monotonic):
+        self.registry = MetricsRegistry(
+            prefix="fleet/", counters=self._COUNTERS, clock=clock
+        )
+        for name in ("replicas", "routable_replicas", "retry_budget"):
+            self.registry.gauge(name, 0.0)
 
     def bump(self, name: str, by: int = 1) -> None:
-        with self._lock:
-            self._counts[name] += by
+        self.registry.bump(name, by)
 
     def gauge(self, name: str, value: float) -> None:
-        with self._lock:
-            self._gauges[name] = value
+        self.registry.gauge(name, value)
 
     def __getitem__(self, name: str) -> int:
-        with self._lock:
-            return self._counts[name]
+        return self.registry[name]
 
     def snapshot(self) -> dict:
-        with self._lock:
-            out = {f"fleet/{k}": v for k, v in self._counts.items()}
-            out.update({f"fleet/{k}": v for k, v in self._gauges.items()})
-        return out
+        return self.registry.snapshot()
 
 
 # ------------------------------------------------------------ replica handles
@@ -218,6 +215,10 @@ class _FleetRequest:
     tried: set = field(default_factory=set)
     # pending (handle, inner_future) pairs — losers cancelled on delivery
     inner: list = field(default_factory=list)
+    # root trace ID minted at router admission; every dispatch (including
+    # failover re-dispatches and remote prefills) submits under it, so one
+    # trace shows the request's whole fleet lifetime
+    trace_id: Optional[str] = None
 
     def submit_kwargs(
         self, remaining_deadline: Optional[float], arrival_s: Optional[float]
@@ -232,6 +233,7 @@ class _FleetRequest:
             pad_token_id=self.pad_token_id,
             seed=self.seed,
             arrival_s=arrival_s,
+            trace_id=self.trace_id,
         )
 
 
@@ -278,6 +280,7 @@ class FleetRouter:
         membership: Optional[FleetMembership] = None,
         replica_factory: Optional[Callable[[str], InferenceServer]] = None,
         clock: Callable[[], float] = time.monotonic,
+        trackers=(),
     ):
         self.config = config or FleetConfig()
         self._clock = clock
@@ -287,7 +290,8 @@ class FleetRouter:
         self._rr = 0
         self._replica_factory = replica_factory
         self._membership = membership if membership is not None else FleetMembership()
-        self.metrics = FleetMetrics()
+        self.trackers = list(trackers)
+        self.metrics = FleetMetrics(clock=clock)
         self._budget = _TokenBucket(
             self.config.retry_budget_capacity,
             self.config.retry_budget_refill_per_s,
@@ -472,9 +476,16 @@ class FleetRouter:
             pad_token_id=pad_token_id,
             seed=seed,
             submitted_at=now,
+            trace_id=(
+                tracing.new_trace_id() if tracing.get_tracer().enabled else None
+            ),
         )
         try:
-            self._dispatch(freq)
+            with tracing.span(
+                "fleet.submit", trace_id=freq.trace_id,
+                prompt_len=int(ids.shape[0]),
+            ):
+                self._dispatch(freq)
         except ServingError as exc:
             if isinstance(exc, NoHealthyReplicaError):
                 self.metrics.bump("rejected_no_replica")
@@ -589,10 +600,17 @@ class FleetRouter:
                 handle.outstanding += 1
             self._prefill_q.put((freq, handle))
             return
-        inner = handle.server.submit(
-            freq.input_ids,
-            **freq.submit_kwargs(self._remaining(freq), self._arrival(freq)),
-        )
+        # one span per dispatch attempt: a failed-over request shows BOTH
+        # attempts under one trace (admission refusals exit this span with
+        # the typed error recorded; async failures land on fleet.failover)
+        with tracing.span(
+            "fleet.dispatch", trace_id=freq.trace_id,
+            replica=handle.replica_id, hedge=hedge, attempt=freq.failovers,
+        ):
+            inner = handle.server.submit(
+                freq.input_ids,
+                **freq.submit_kwargs(self._remaining(freq), self._arrival(freq)),
+            )
         self._track(freq, handle, inner, hedge=hedge)
 
     def _track(
@@ -628,7 +646,11 @@ class FleetRouter:
         freq.hedged = True
         handle = ordered[1][0]
         try:
-            self._submit_to(handle, freq, hedge=True)
+            with tracing.span(
+                "fleet.hedge", trace_id=freq.trace_id,
+                replica=handle.replica_id,
+            ):
+                self._submit_to(handle, freq, hedge=True)
         except ServingError:
             return  # the primary dispatch stands; hedging is best-effort
         self.metrics.bump("hedges")
@@ -674,45 +696,71 @@ class FleetRouter:
             failed_on = handle.replica_id
             handle.breaker.record_failure()
         retriable = isinstance(exc, ServingError) and exc.retriable
-        if not retriable or self._closedf():
-            if self._finish(freq, exception=exc):
-                self.metrics.bump("failed")
-            return
-        if freq.future.done():
-            return  # a hedge sibling already delivered
-        planned = isinstance(exc, ServerDrainingError)
-        with freq.lock:
-            freq.tried.add(failed_on)
-            if freq.failovers >= self.config.max_failovers:
-                denied = "cap"
-            elif planned or self._budget.try_acquire():
-                freq.failovers += 1
-                denied = None
-            else:
-                denied = "budget"
-        if denied is not None:
-            self.metrics.bump(f"failover_denied_{denied}")
-            err = FailoverExhaustedError(
-                f"failover denied ({denied}) after {freq.failovers} "
-                f"attempt(s); last error from replica "
-                f"{failed_on!r}: {type(exc).__name__}: {exc}",
+        exhausted = False
+        # the failover decision is itself a span: its "error" event carries
+        # the typed taxonomy (class name, retriable, __cause__ chain), so a
+        # flight dump of this trace explains WHY the request moved replicas
+        with tracing.span(
+            "fleet.failover", trace_id=freq.trace_id,
+            replica=failed_on, retriable=retriable,
+        ) as sp:
+            sp.event(
+                "error",
+                type=type(exc).__name__,
+                retriable=retriable,
                 replica_id=failed_on,
+                cause=(
+                    type(exc.__cause__).__name__
+                    if exc.__cause__ is not None
+                    else None
+                ),
             )
-            err.__cause__ = exc
-            if self._finish(freq, exception=err):
-                self.metrics.bump("failed")
-            return
-        fault_point("fleet_failover")
-        self.metrics.bump("failovers")
-        if planned:
-            self.metrics.bump("redistributed")
-        try:
-            self._dispatch(freq)
-        except (ServingError, ValueError) as exc2:
-            if isinstance(exc2, ServingError):
-                exc2.__cause__ = exc
-            if self._finish(freq, exception=exc2):
-                self.metrics.bump("failed")
+            if not retriable or self._closedf():
+                sp.set("outcome", "failed")
+                if self._finish(freq, exception=exc):
+                    self.metrics.bump("failed")
+            elif freq.future.done():
+                sp.set("outcome", "hedge_delivered")  # a sibling delivered
+            else:
+                planned = isinstance(exc, ServerDrainingError)
+                with freq.lock:
+                    freq.tried.add(failed_on)
+                    if freq.failovers >= self.config.max_failovers:
+                        denied = "cap"
+                    elif planned or self._budget.try_acquire():
+                        freq.failovers += 1
+                        denied = None
+                    else:
+                        denied = "budget"
+                if denied is not None:
+                    sp.set("outcome", f"denied_{denied}")
+                    self.metrics.bump(f"failover_denied_{denied}")
+                    err = FailoverExhaustedError(
+                        f"failover denied ({denied}) after {freq.failovers} "
+                        f"attempt(s); last error from replica "
+                        f"{failed_on!r}: {type(exc).__name__}: {exc}",
+                        replica_id=failed_on,
+                    )
+                    err.__cause__ = exc
+                    if self._finish(freq, exception=err):
+                        self.metrics.bump("failed")
+                    exhausted = True
+                else:
+                    fault_point("fleet_failover")
+                    sp.set("outcome", "resubmitted")
+                    self.metrics.bump("failovers")
+                    if planned:
+                        self.metrics.bump("redistributed")
+                    try:
+                        self._dispatch(freq)
+                    except (ServingError, ValueError) as exc2:
+                        if isinstance(exc2, ServingError):
+                            exc2.__cause__ = exc
+                        if self._finish(freq, exception=exc2):
+                            self.metrics.bump("failed")
+        if exhausted:
+            # dump AFTER the span closed so the recorder has the error event
+            tracing.flight_dump("failover_exhausted")
 
     def _finish(
         self, freq: _FleetRequest, *, result=None,
@@ -722,6 +770,10 @@ class FleetRouter:
         cancel and hedge siblings); on delivery, cancel every still-pending
         inner future so a hedge loser stops consuming replica capacity as
         soon as it can."""
+        if result is not None and hasattr(result, "failover_count"):
+            # router-only knowledge: the replica that served the request
+            # cannot know how many hops preceded it
+            result.failover_count = freq.failovers
         delivered = resolve_future(
             freq.future, result=result, exception=exception
         )
@@ -758,16 +810,22 @@ class FleetRouter:
                     else handle.server.config.default_max_new_tokens
                 )
                 try:
-                    pre = eng.prefill_remote(
-                        freq.input_ids,
-                        max_new_tokens=budget,
-                        temperature=freq.temperature,
-                        top_k=freq.top_k,
-                        top_p=freq.top_p,
-                        eos_token_id=freq.eos_token_id,
-                        pad_token_id=freq.pad_token_id,
-                        seed=freq.seed,
-                    )
+                    with tracing.span(
+                        "fleet.prefill_remote", trace_id=freq.trace_id,
+                        replica=handle.replica_id,
+                        prompt_len=int(freq.input_ids.shape[0]),
+                    ):
+                        pre = eng.prefill_remote(
+                            freq.input_ids,
+                            max_new_tokens=budget,
+                            temperature=freq.temperature,
+                            top_k=freq.top_k,
+                            top_p=freq.top_p,
+                            eos_token_id=freq.eos_token_id,
+                            pad_token_id=freq.pad_token_id,
+                            seed=freq.seed,
+                            trace_id=freq.trace_id,
+                        )
                     self.metrics.bump("prefills")
                 except Exception as exc:  # noqa: BLE001 — fall back to plain submit
                     pre = None
@@ -823,6 +881,11 @@ class FleetRouter:
                 total = len(self._handles)
             self.metrics.gauge("replicas", total)
             self.metrics.gauge("routable_replicas", len(self._candidates()))
+            # same single periodic-flush implementation the serving layer
+            # uses — prober thread, no router lock held (G104)
+            self.metrics.registry.maybe_flush(
+                self.trackers, self.config.metrics_interval_s
+            )
 
     def _respawn(self, handle: ReplicaHandle) -> None:
         """Supervisor-style scale-up: relaunch a dead replica via the

@@ -62,8 +62,10 @@ from typing import Any, Callable, Optional, Sequence
 
 import numpy as np
 
+from . import tracing
 from .logging import get_logger
 from .telemetry import LatencyReservoir
+from .tracing import MetricsRegistry
 from .utils.dataclasses import ServingConfig
 from .utils.fault import (
     PREEMPTION_EXIT_CODE,
@@ -110,6 +112,9 @@ class _Request:
     # disaggregation — the fleet's prefill workers ran the prompt forward
     # already; admission scatters it instead of re-running the forward)
     prefill: Any = None
+    # request-scoped trace ID (tracing.new_trace_id); propagated fleet →
+    # server → engine so one trace shows every hop including failovers
+    trace_id: Optional[str] = None
 
     def group_key(self) -> tuple:
         """Requests sharing this key can ride one ``generate()`` batch: the
@@ -147,6 +152,15 @@ class ServingResult:
     # which replica served it (None outside a fleet) — lets clients and the
     # router attribute latency without guessing
     replica_id: Optional[str] = None
+    # span summary: where this request's latency went. Static mode has no
+    # per-slot clocks, so queue_wait is latency minus in-batch time and
+    # prefill_s stays None; continuous mode reads the occupant's stamps.
+    queue_wait_s: Optional[float] = None
+    prefill_s: Optional[float] = None
+    decode_steps: int = 0
+    # dispatch attempts minus one (filled by the fleet router on resolve;
+    # a request served by its first replica reports 0)
+    failover_count: int = 0
 
 
 # ---------------------------------------------------------- future resolution
@@ -178,7 +192,11 @@ def resolve_future(
 class ServingMetrics:
     """Thread-safe serving counters + latency reservoirs.
 
-    Counters are monotonic; :meth:`snapshot` flattens everything into one
+    A thin facade over :class:`tracing.MetricsRegistry` (one registry per
+    server, prefix ``serving/``) — the registry owns the lock, the flush
+    cadence, and the tracker bridge, so the periodic-flush logic is no
+    longer duplicated here and in ``FleetMetrics``. Counters are
+    monotonic; :meth:`snapshot` flattens everything into one
     ``serving/...`` dict suitable for ``GeneralTracker.log_batch`` — queue
     depth and breaker state are sampled at snapshot time."""
 
@@ -201,40 +219,36 @@ class ServingMetrics:
         "engine_retired",  # occupants retired (EOS / budget / cancel)
     )
 
-    def __init__(self):
-        self._lock = threading.Lock()
-        self._counts = {name: 0 for name in self._COUNTERS}
+    def __init__(self, clock=time.monotonic):
+        self.registry = MetricsRegistry(
+            prefix="serving/", counters=self._COUNTERS, clock=clock
+        )
         self.latency = LatencyReservoir()  # seconds, accepted+completed only
         self.queue_wait = LatencyReservoir()  # seconds spent queued
-        self._gauges: dict[str, float] = {
-            "queue_depth": 0,
-            "breaker_state": 0,
-            "kv_hbm_bytes": 0,
-            "kv_utilization": 0.0,
-            "prefix_hit_rate": 0.0,
-            "spec_acceptance_rate": 0.0,
-            "spec_tokens_per_step": 0.0,
-        }
+        self.registry.attach_reservoir("latency", self.latency)
+        self.registry.attach_reservoir("queue_wait", self.queue_wait)
+        for name in (
+            "queue_depth",
+            "breaker_state",
+            "kv_hbm_bytes",
+            "kv_utilization",
+            "prefix_hit_rate",
+            "spec_acceptance_rate",
+            "spec_tokens_per_step",
+        ):
+            self.registry.gauge(name, 0.0)
 
     def bump(self, name: str, by: int = 1) -> None:
-        with self._lock:
-            self._counts[name] += by
+        self.registry.bump(name, by)
 
     def gauge(self, name: str, value: float) -> None:
-        with self._lock:
-            self._gauges[name] = value
+        self.registry.gauge(name, value)
 
     def __getitem__(self, name: str) -> int:
-        with self._lock:
-            return self._counts[name]
+        return self.registry[name]
 
     def snapshot(self) -> dict:
-        with self._lock:
-            out = {f"serving/{k}": v for k, v in self._counts.items()}
-            out.update({f"serving/{k}": v for k, v in self._gauges.items()})
-        out.update(self.latency.snapshot(prefix="serving/latency_"))
-        out.update(self.queue_wait.snapshot(prefix="serving/queue_wait_"))
-        return out
+        return self.registry.snapshot()
 
 
 # ------------------------------------------------------------ circuit breaker
@@ -385,12 +399,11 @@ class InferenceServer:
         self._closed = False
         self._worker_error: Optional[BaseException] = None
         self._drained = threading.Event()
-        self.metrics = ServingMetrics()
+        self.metrics = ServingMetrics(clock=clock)
         self._breaker = _CircuitBreaker(
             self.config.breaker_threshold, self.config.breaker_reset_s, clock
         )
         self._batch_time_ewma = 0.0
-        self._last_metrics_flush = clock()
         self._rng = random.Random(0)  # backoff jitter only
         self._worker = threading.Thread(
             target=self._serve_loop, name="inference-server", daemon=True
@@ -412,6 +425,7 @@ class InferenceServer:
         seed: int = 0,
         prefilled=None,
         arrival_s: Optional[float] = None,
+        trace_id: Optional[str] = None,
     ) -> Future:
         """Admit one request; returns a Future resolving to
         :class:`ServingResult` (or raising the typed serving error that
@@ -447,6 +461,11 @@ class InferenceServer:
         hop would reset the clock and under-report client-observed
         latency. Deadlines are unaffected (``deadline_s`` is always
         relative to now).
+
+        ``trace_id`` joins this request to an existing trace (a fleet
+        router submits with the root trace it minted); standalone servers
+        mint one per request when the tracer is enabled so every span the
+        request touches shares one ID.
         """
         fault_point("serving_submit")
         if self._closed or self._draining or preemption_requested():
@@ -498,6 +517,8 @@ class InferenceServer:
             seed=seed,
             submitted_at=arrival_s if arrival_s is not None else now,
             prefill=prefilled,
+            trace_id=trace_id
+            or (tracing.new_trace_id() if tracing.get_tracer().enabled else None),
         )
         with self._wake:
             if self._draining or self._closed:
@@ -640,6 +661,9 @@ class InferenceServer:
                 self._worker_error = exc
                 self._draining = True
             logger.exception("serving worker died; failing queued requests")
+            # postmortem: persist the last N seconds of spans so the death
+            # is debuggable after the process is gone
+            tracing.flight_dump("worker_death")
             raise
         finally:
             with self._lock:
@@ -776,30 +800,39 @@ class InferenceServer:
                 self.metrics.bump("degraded")
             try:
                 fault_point("serving_before_batch")
-                if (
-                    req.prefill is not None
-                    and req.effective_max_new_tokens <= req.prefill.max_new_tokens
-                    and getattr(eng, "accepts_prefill", lambda _p: False)(req.prefill)
-                ):
-                    # disaggregated path: the prompt forward already ran on
-                    # a prefill worker — scatter it (commit-only program)
-                    eng.insert_prefilled(
-                        req.prefill,
-                        max_new_tokens=req.effective_max_new_tokens,
-                        tag=req,
-                    )
-                else:
-                    eng.insert(
-                        req.input_ids,
-                        max_new_tokens=req.effective_max_new_tokens,
-                        temperature=req.temperature,
-                        top_k=req.top_k,
-                        top_p=req.top_p,
-                        eos_token_id=req.eos_token_id,
-                        pad_token_id=req.pad_token_id,
-                        seed=req.seed,
-                        tag=req,
-                    )
+                with tracing.span(
+                    "serving.admit",
+                    trace_id=req.trace_id,
+                    queue_wait_s=max(0.0, now - req.submitted_at),
+                    degraded=req.degraded,
+                ) as sp:
+                    if (
+                        req.prefill is not None
+                        and req.effective_max_new_tokens <= req.prefill.max_new_tokens
+                        and getattr(eng, "accepts_prefill", lambda _p: False)(req.prefill)
+                    ):
+                        # disaggregated path: the prompt forward already ran
+                        # on a prefill worker — scatter it (commit-only
+                        # program)
+                        sp.set("path", "insert_prefilled")
+                        eng.insert_prefilled(
+                            req.prefill,
+                            max_new_tokens=req.effective_max_new_tokens,
+                            tag=req,
+                        )
+                    else:
+                        sp.set("path", "insert")
+                        eng.insert(
+                            req.input_ids,
+                            max_new_tokens=req.effective_max_new_tokens,
+                            temperature=req.temperature,
+                            top_k=req.top_k,
+                            top_p=req.top_p,
+                            eos_token_id=req.eos_token_id,
+                            pad_token_id=req.pad_token_id,
+                            seed=req.seed,
+                            tag=req,
+                        )
             except BaseException as exc:  # noqa: BLE001 — classified below
                 if isinstance(exc, (KeyboardInterrupt, SystemExit)):
                     self._fail_batch(
@@ -905,6 +938,13 @@ class InferenceServer:
                         degraded=req.degraded,
                         ttft_s=max(0.0, ttft),
                         replica_id=self.replica_id,
+                        queue_wait_s=max(0.0, occ.inserted_s - req.submitted_at),
+                        prefill_s=(
+                            max(0.0, occ.first_token_s - occ.inserted_s)
+                            if occ.first_token_s is not None
+                            else None
+                        ),
+                        decode_steps=int(getattr(occ, "decode_steps", 0)),
                     ),
                 )
                 if delivered:
@@ -928,6 +968,10 @@ class InferenceServer:
         decoding acceptance (acceptance rate, emitted tokens per verify
         step) as serving gauges, refreshed every tick."""
         stats = self._engine.stats()
+        # the full engine stats tree also lands in the unified registry
+        # (flattened to serving/engine/... gauges) so one snapshot carries
+        # all three former surfaces
+        self.metrics.registry.ingest(stats, prefix="engine")
         kv = stats.get("kv")
         if kv:
             self.metrics.gauge("kv_hbm_bytes", kv.get("hbm_bytes", 0))
@@ -1138,7 +1182,13 @@ class InferenceServer:
             try:
                 fault_point("serving_before_batch")
                 t0 = self._clock()
-                out = self._run_batch(batch)
+                with tracing.span(
+                    "serving.batch",
+                    trace_id=batch[0].trace_id,
+                    batch_size=len(batch),
+                    attempt=attempt,
+                ):
+                    out = self._run_batch(batch)
                 dt = self._clock() - t0
                 fault_point("serving_after_batch")
             except BaseException as exc:  # noqa: BLE001 — classified below
@@ -1218,6 +1268,8 @@ class InferenceServer:
                         degraded=req.degraded,
                         ttft_s=latency,  # whole batch materializes at once
                         replica_id=self.replica_id,
+                        queue_wait_s=max(0.0, latency - dt),
+                        decode_steps=req.effective_max_new_tokens,
                     ),
                 )
                 if delivered:
@@ -1264,35 +1316,21 @@ class InferenceServer:
 
     # --------------------------------------------------------------- metrics
     def _flush_due(self) -> bool:
-        interval = self.config.metrics_interval_s
-        return (
-            bool(self.trackers)
-            and interval is not None
-            and self._clock() - self._last_metrics_flush >= interval
+        return bool(self.trackers) and self.metrics.registry.due(
+            self.config.metrics_interval_s
         )
 
     def _flush_metrics(self, force: bool = False) -> None:
+        """Periodic tracker flush, deduped through the registry (the cadence
+        bookkeeping and ``log_batch`` bridge live in
+        :meth:`MetricsRegistry.flush` — ``FleetMetrics`` rides the same
+        path). Always called with the server lock released (G104)."""
         if not self.trackers:
             return
-        interval = self.config.metrics_interval_s
-        if force or (
-            interval is not None
-            and self._clock() - self._last_metrics_flush >= interval
-        ):
-            # graft: race-ok — monotonic timestamp; a lost update costs one extra snapshot, never corruption
-            self._last_metrics_flush = self._clock()
-            self._emit_snapshot()
-
-    def _emit_snapshot(self) -> None:
-        self.metrics.gauge("breaker_state", self._breaker.state())
-        entries = [(self.metrics.snapshot(), None, {})]
-        for tracker in self.trackers:
-            try:
-                tracker.log_batch(entries)
-            except Exception as exc:  # noqa: BLE001 — metrics never kill serving
-                logger.warning(
-                    "serving metrics flush failed: %s: %s", type(exc).__name__, exc
-                )
+        reg = self.metrics.registry
+        if force or reg.due(self.config.metrics_interval_s):
+            self.metrics.gauge("breaker_state", self._breaker.state())
+            reg.flush(self.trackers)
 
     def log_metrics(self, step: Optional[int] = None, trackers: Optional[Sequence] = None):
         """Push one metrics snapshot through ``GeneralTracker.log_batch``

@@ -39,6 +39,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from . import tracing
 from .logging import get_logger
 
 logger = get_logger(__name__)
@@ -289,21 +290,24 @@ class AsyncTrackerFlusher:
                 return
 
     def _write(self, entries):
-        materialized = []
-        for values, step, log_kwargs in entries:
-            try:
-                materialized.append((materialize_metrics(values), step, log_kwargs))
-            except Exception as exc:  # noqa: BLE001 — never kill the thread
-                self._record(exc)
-        for tracker in self.trackers:
-            per_tracker = [
-                (values, step, kw.get(tracker.name, {}))
-                for values, step, kw in materialized
-            ]
-            try:
-                tracker.log_batch(per_tracker)
-            except Exception as exc:  # noqa: BLE001
-                self._record(exc)
+        with tracing.span(
+            "telemetry.flush_drain", batches=len(entries), trackers=len(self.trackers)
+        ):
+            materialized = []
+            for values, step, log_kwargs in entries:
+                try:
+                    materialized.append((materialize_metrics(values), step, log_kwargs))
+                except Exception as exc:  # noqa: BLE001 — never kill the thread
+                    self._record(exc)
+            for tracker in self.trackers:
+                per_tracker = [
+                    (values, step, kw.get(tracker.name, {}))
+                    for values, step, kw in materialized
+                ]
+                try:
+                    tracker.log_batch(per_tracker)
+                except Exception as exc:  # noqa: BLE001
+                    self._record(exc)
 
     def _record(self, exc: BaseException) -> None:
         if not self._errors:

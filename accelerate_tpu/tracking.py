@@ -38,7 +38,26 @@ __all__ = [
     "filter_trackers",
     "register_tracker_class",
     "on_main_process",
+    "log_registry",
 ]
+
+
+def log_registry(trackers, registry, step: Optional[int] = None) -> None:
+    """Bridge one ``tracing.MetricsRegistry`` snapshot to every tracker
+    through the existing ``log_batch`` batching path — the single flush
+    implementation the serving worker and the fleet prober both call
+    (outside their locks; the snapshot itself only briefly takes the
+    registry's own lock)."""
+    snap = registry.snapshot()
+    if not snap:
+        return
+    entries = [(snap, step, {})]
+    for tracker in trackers:
+        try:
+            tracker.log_batch(entries)
+        except Exception as exc:  # tracker I/O must never kill a worker
+            logger.error(f"tracker {getattr(tracker, 'name', '?')} "
+                         f"registry flush failed: {exc}")
 
 
 def on_main_process(function):

@@ -334,6 +334,54 @@ class ReplicationConfig(KwargsHandler):
 
 
 @dataclass
+class TracingConfig(KwargsHandler):
+    """Policy knobs for the span tracer + flight recorder
+    (:mod:`accelerate_tpu.tracing`, docs/observability.md).
+
+    * ``enabled`` — master switch. The default tracer reads the
+      ``ACCELERATE_TRACING`` env var (anything but ``0``/``false``/
+      ``off``/``no`` keeps the always-on recorder); a config passed to
+      ``tracing.configure`` wins outright. Disabled spans cost one
+      attribute check (no allocation, no clock read).
+    * ``ring_capacity`` — spans retained per thread ring; overflow drops
+      the OLDEST span and counts it (``dropped_spans``).
+    * ``retain_s`` — flight-recorder window: a dump serializes only spans
+      that ended within the last ``retain_s`` seconds.
+    * ``decode_sample_every`` — the engine opens a ``engine.decode_step``
+      span every N decode steps (per-step spans would dominate the ring
+      and the overhead budget).
+    * ``dump_dir``/``max_dumps`` — where auto-dumps land and how many a
+      process may write (a crash loop must not fill the disk).
+    * ``dump_on_failure`` — auto-dump on typed failures (worker death,
+      ``FailoverExhaustedError``, checkpoint rollback). SIGUSR1 dumps are
+      installed separately via ``tracing.install_signal_handlers``.
+    """
+
+    enabled: bool = True
+    ring_capacity: int = 2048
+    retain_s: float = 30.0
+    decode_sample_every: int = 16
+    dump_dir: str = "runs"
+    max_dumps: int = 8
+    dump_on_failure: bool = True
+
+    def __post_init__(self):
+        if self.ring_capacity < 16:
+            raise ValueError(
+                f"ring_capacity must be >= 16, got {self.ring_capacity}"
+            )
+        if self.retain_s <= 0:
+            raise ValueError(f"retain_s must be > 0, got {self.retain_s}")
+        if self.decode_sample_every < 1:
+            raise ValueError(
+                "decode_sample_every must be >= 1, got "
+                f"{self.decode_sample_every}"
+            )
+        if self.max_dumps < 0:
+            raise ValueError(f"max_dumps must be >= 0, got {self.max_dumps}")
+
+
+@dataclass
 class ServingConfig(KwargsHandler):
     """Policy knobs for :class:`accelerate_tpu.serving.InferenceServer`
     (docs/serving.md). Robustness-first defaults: bounded everything.
@@ -626,6 +674,10 @@ class FleetConfig(KwargsHandler):
     respawn_backoff_s: float = 0.5
     drain_timeout_s: float = 30.0
     default_deadline_s: Optional[float] = None
+    # push a fleet metrics snapshot to the router's trackers at most this
+    # often (seconds; None disables) — same MetricsRegistry flush cadence
+    # the serving layer uses for ServingConfig.metrics_interval_s
+    metrics_interval_s: Optional[float] = None
 
     def __post_init__(self):
         if self.placement not in ("least_loaded", "round_robin"):

@@ -1,0 +1,322 @@
+"""Tracing overhead A/B + flight-recorder chaos verification (PR 11).
+
+Two claims the flight recorder ships on:
+
+1. **Overhead** — the always-on span spine must be invisible in serving
+   goodput. The same fixed-service-time server is driven open-loop at 1x
+   capacity with tracing fully disabled, then fully enabled, best-of-N
+   each; the gate fails when on/off goodput drops below
+   ``TRB_GATE_RATIO`` (default 0.98). An informational microbench row
+   also prints the raw per-span cost (disabled and enabled paths).
+
+2. **Crash forensics** — kill one of three fleet replicas mid-batch
+   under load, then take a flight dump. For every affected request
+   (trace with a ``fleet.failover`` span) the dump must contain the
+   failed dispatch span, a typed ``error`` instant event, and the
+   successful re-dispatch span on a *different* replica — with zero
+   dropped futures and zero dropped spans (the existing fleet bar,
+   unchanged by tracing).
+
+Prints one JSON line per phase plus a gate line. ``--gate`` (also
+``make bench-trace``) turns the acceptance criteria into a nonzero exit.
+"""
+
+from __future__ import annotations
+
+import os
+import sys as _sys
+
+_sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))  # runnable as `python benchmarks/x.py`
+
+import json
+import shutil
+import tempfile
+import time
+
+import numpy as np
+
+SERVICE_S = float(os.environ.get("TRB_SERVICE_S", "0.04"))
+MAX_BATCH = int(os.environ.get("TRB_MAX_BATCH", "8"))
+PHASE_S = float(os.environ.get("TRB_PHASE_S", "1.2"))
+REPEATS = int(os.environ.get("TRB_REPEATS", "3"))
+GATE_RATIO = float(os.environ.get("TRB_GATE_RATIO", "0.98"))
+MICRO_N = int(os.environ.get("TRB_MICRO_N", "200000"))
+PROMPT = np.arange(1, 9, dtype=np.int32)
+
+
+class _SyntheticEngine:
+    """generate_fn with a fixed per-batch service time (capacity is exactly
+    ``max_batch / service_s`` rps), optionally killing the serving worker
+    on demand — the in-process analogue of SIGKILLing a replica mid-batch."""
+
+    def __init__(self, service_s: float):
+        self.service_s = service_s
+        self.kill_next = False
+
+    def __call__(self, model, ids, max_new_tokens=4, **kw):
+        if self.kill_next:
+            self.kill_next = False
+            raise SystemExit(1)
+        time.sleep(self.service_s)
+        new = np.repeat(ids[:, :1], max_new_tokens, axis=1)
+        return np.concatenate([ids, new], axis=1)
+
+
+def _span_microbench() -> dict:
+    """Raw per-span cost, both paths: the disabled call (one attribute
+    check + shared no-op CM) and the enabled enter/exit/ring-append."""
+    from accelerate_tpu import tracing
+    from accelerate_tpu.utils.dataclasses import TracingConfig
+
+    tracing.configure(TracingConfig(enabled=False))
+    t0 = time.perf_counter()
+    for _ in range(MICRO_N):
+        with tracing.span("bench.noop"):
+            pass
+    off_ns = (time.perf_counter() - t0) / MICRO_N * 1e9
+
+    tracing.configure(TracingConfig(enabled=True, ring_capacity=4096))
+    t0 = time.perf_counter()
+    for _ in range(MICRO_N):
+        with tracing.span("bench.hot"):
+            pass
+    on_ns = (time.perf_counter() - t0) / MICRO_N * 1e9
+
+    row = {
+        "phase": "span_micro",
+        "n": MICRO_N,
+        "disabled_ns_per_span": round(off_ns, 1),
+        "enabled_ns_per_span": round(on_ns, 1),
+    }
+    print(json.dumps(row), flush=True)
+    return row
+
+
+def _goodput(label: str, enabled: bool, workdir: str) -> dict:
+    """Open-loop serving at 1x capacity; returns completed_rps."""
+    from accelerate_tpu import tracing
+    from accelerate_tpu.serving import InferenceServer
+    from accelerate_tpu.utils.dataclasses import ServingConfig, TracingConfig
+
+    tracing.configure(TracingConfig(
+        enabled=enabled, ring_capacity=16384, retain_s=60.0,
+        dump_dir=workdir,
+    ))
+    cfg = ServingConfig(
+        max_queue=256, max_batch_size=MAX_BATCH, batch_window_s=0.001,
+        default_max_new_tokens=4, max_retries=0, drain_timeout_s=10.0,
+    )
+    capacity = MAX_BATCH / SERVICE_S
+    completed = 0
+    untyped = 0
+    with InferenceServer(object(), cfg,
+                         generate_fn=_SyntheticEngine(SERVICE_S)) as srv:
+        futures = []
+        start = time.perf_counter()
+        i = 0
+        while True:
+            now = time.perf_counter()
+            if now - start >= PHASE_S:
+                break
+            next_t = start + i / capacity
+            if next_t > now:
+                time.sleep(min(next_t - now, 0.01))
+                continue
+            i += 1
+            futures.append(srv.submit(PROMPT, max_new_tokens=4))
+        for f in futures:
+            try:
+                f.result(timeout=30)
+                completed += 1
+            except Exception:  # noqa: BLE001 — gate counts anything unresolved
+                untyped += 1
+        elapsed = time.perf_counter() - start
+    return {
+        "phase": f"goodput_{label}",
+        "tracing": enabled,
+        "goodput_rps": round(completed / elapsed, 1),
+        "submitted": i,
+        "errors": untyped,
+    }
+
+
+def _best_goodput(label: str, enabled: bool, workdir: str) -> dict:
+    best = None
+    for _ in range(REPEATS):
+        row = _goodput(label, enabled, workdir)
+        if best is None or row["goodput_rps"] > best["goodput_rps"]:
+            best = row
+    print(json.dumps(best), flush=True)
+    return best
+
+
+# ------------------------------------------------------------------ chaos
+def _load_dump(path: str) -> dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+def _verify_failover_story(doc: dict) -> dict:
+    """For every affected trace (has a ``fleet.failover`` span), the dump
+    must tell the whole story: the dispatch to the dead replica, a typed
+    error event, and a later dispatch on a different replica."""
+    spans_by_trace: dict = {}
+    events_by_trace: dict = {}
+    for ev in doc["traceEvents"]:
+        tid = (ev.get("args") or {}).get("trace_id")
+        if tid is None:
+            continue
+        if ev["ph"] == "X":
+            spans_by_trace.setdefault(tid, []).append(ev)
+        elif ev["ph"] == "i":
+            events_by_trace.setdefault(tid, []).append(ev)
+
+    affected = [
+        t for t, spans in spans_by_trace.items()
+        if any(s["name"] == "fleet.failover" for s in spans)
+    ]
+    complete = 0
+    for t in affected:
+        dispatches = sorted(
+            (s for s in spans_by_trace[t] if s["name"] == "fleet.dispatch"),
+            key=lambda s: s["ts"],
+        )
+        replicas = {s["args"].get("replica") for s in dispatches}
+        typed_errors = [
+            e for e in events_by_trace.get(t, [])
+            if e["name"] == "error" and e["args"].get("type")
+        ]
+        if len(dispatches) >= 2 and len(replicas) >= 2 and typed_errors:
+            complete += 1
+    return {
+        "affected_traces": len(affected),
+        "complete_stories": complete,
+        "dropped_spans": doc["otherData"]["dropped_spans"],
+    }
+
+
+def _chaos(workdir: str) -> dict:
+    """Kill one of three replicas mid-batch at mid-phase under load, then
+    dump the flight recorder and verify the per-request failover story."""
+    from accelerate_tpu import tracing
+    from accelerate_tpu.fleet import FleetRouter
+    from accelerate_tpu.serving import InferenceServer
+    from accelerate_tpu.utils.dataclasses import (
+        FleetConfig,
+        ServingConfig,
+        TracingConfig,
+    )
+    from accelerate_tpu.utils.fault import ServingError
+
+    tracing.configure(TracingConfig(
+        enabled=True, ring_capacity=16384, retain_s=120.0,
+        dump_dir=workdir, max_dumps=16,
+    ))
+    scfg = ServingConfig(
+        max_queue=256, max_batch_size=MAX_BATCH, batch_window_s=0.001,
+        default_max_new_tokens=4, max_retries=0, drain_timeout_s=10.0,
+    )
+    engines = [_SyntheticEngine(SERVICE_S) for _ in range(3)]
+    servers = {
+        f"r{i}": InferenceServer(
+            object(), scfg, generate_fn=engines[i], replica_id=f"r{i}"
+        )
+        for i in range(3)
+    }
+    router = FleetRouter(servers, FleetConfig(probe_interval_s=0.05))
+    capacity = MAX_BATCH / SERVICE_S
+    try:
+        futures = []
+        start = time.perf_counter()
+        i = 0
+        killed = False
+        while True:
+            now = time.perf_counter()
+            if now - start >= PHASE_S:
+                break
+            if not killed and now - start >= PHASE_S / 2:
+                killed = True
+                engines[0].kill_next = True
+            next_t = start + i / (1.5 * capacity)
+            if next_t > now:
+                time.sleep(min(next_t - now, 0.01))
+                continue
+            i += 1
+            futures.append(router.submit(PROMPT, max_new_tokens=4))
+
+        completed = typed = dropped = untyped = 0
+        for f in futures:
+            try:
+                f.result(timeout=30)
+                completed += 1
+            except ServingError:
+                typed += 1
+            except TimeoutError:
+                dropped += 1  # the zero-drop gate: this must stay 0
+            except Exception:  # noqa: BLE001
+                untyped += 1
+        failovers = router.metrics["failovers"]
+    finally:
+        router.close(drain=False)
+
+    # dump AFTER every future resolved: only then are both dispatch spans
+    # and the failover decision (with its typed error event) in the rings
+    path = tracing.get_tracer().dump("chaos")
+    story = _verify_failover_story(_load_dump(path))
+    # the automatic worker-death dump must also have fired at kill time
+    auto_dumps = [
+        fn for fn in os.listdir(workdir) if fn.startswith("flight-worker_death-")
+    ]
+    row = {
+        "phase": "chaos_kill",
+        "submitted": i,
+        "completed": completed,
+        "typed_failures": typed,
+        "dropped_futures": dropped,
+        "untyped_errors": untyped,
+        "failovers": failovers,
+        "worker_death_dumps": len(auto_dumps),
+        "dump": os.path.basename(path),
+        **story,
+    }
+    print(json.dumps(row), flush=True)
+    return row
+
+
+def main(gate: bool = False) -> int:
+    workdir = tempfile.mkdtemp(prefix="tracing_bench_")
+    try:
+        micro = _span_microbench()
+        off = _best_goodput("off", False, workdir)
+        on = _best_goodput("on", True, workdir)
+        chaos = _chaos(workdir)
+
+        ratio = on["goodput_rps"] / max(off["goodput_rps"], 1e-9)
+        checks = {
+            "tracing_on_goodput": ratio >= GATE_RATIO,
+            "goodput_error_free": off["errors"] == 0 and on["errors"] == 0,
+            "chaos_zero_dropped": chaos["dropped_futures"] == 0
+            and chaos["untyped_errors"] == 0,
+            "chaos_failed_over": chaos["failovers"] >= 1,
+            "dump_has_affected_traces": chaos["affected_traces"] >= 1,
+            "dump_stories_complete": chaos["complete_stories"]
+            == chaos["affected_traces"],
+            "dump_zero_span_drops": chaos["dropped_spans"] == 0,
+            "worker_death_auto_dumped": chaos["worker_death_dumps"] >= 1,
+        }
+        ok = all(checks.values())
+        print(json.dumps({
+            "metric": "tracing_gate",
+            "on_vs_off": round(ratio, 3),
+            "threshold": GATE_RATIO,
+            "enabled_ns_per_span": micro["enabled_ns_per_span"],
+            "checks": checks,
+            "pass": ok,
+        }), flush=True)
+        return 0 if (ok or not gate) else 1
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(gate="--gate" in _sys.argv))

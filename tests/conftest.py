@@ -36,6 +36,21 @@ def pytest_configure(config):
     )
 
 
+@pytest.fixture(scope="session", autouse=True)
+def _tracing_dumps_to_tmp(tmp_path_factory):
+    """Point the default tracer's flight dumps at a session tmp dir —
+    worker-death tests would otherwise litter runs/ with flight-*.json
+    on every suite run. Tests that need their own tracer (test_tracing)
+    still configure/replace the default themselves."""
+    from accelerate_tpu import tracing
+    from accelerate_tpu.utils.dataclasses import TracingConfig
+
+    tracing.configure(TracingConfig(
+        dump_dir=str(tmp_path_factory.mktemp("flight_dumps"))
+    ))
+    yield
+
+
 def pytest_collection_modifyitems(config, items):
     """Without RUN_SLOW=1, skip tests marked slow — keeps the default suite
     inside a CI-sized budget; `make test_all` runs everything."""

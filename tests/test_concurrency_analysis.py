@@ -503,23 +503,24 @@ def test_g306_gang_ok_waiver():
 
 
 # ------------------------------------------------------- runtime witness
-def test_witness_records_real_repo_lock_nesting():
-    from accelerate_tpu.fleet import FleetMetrics
-    from accelerate_tpu.serving import ServingMetrics
+def test_witness_records_real_repo_lock_nesting(tmp_path):
+    from accelerate_tpu.tracing import Tracer
+    from accelerate_tpu.utils.dataclasses import TracingConfig
 
     witness = LockOrderWitness()
     with witness.patch():
-        fm = FleetMetrics()
-        sm = ServingMetrics()
+        tracer = Tracer(TracingConfig(enabled=True, dump_dir=str(tmp_path)))
+        with tracer.span("witness.check"):
+            pass
         # stdlib internals must keep real (unproxied) locks and stay usable
         q = queue.Queue()
         q.put(1)
         assert q.get(timeout=1.0) == 1
-        with fm._lock:
-            sm.bump("submitted")
+        # dump() holds _dump_lock while serializing the rings (_rings_lock)
+        tracer.dump("witness", path=str(tmp_path / "w.json"))
     # factories restored
     assert threading.Lock is not None and not hasattr(threading.Lock, "_real")
-    edge = "fleet:FleetMetrics._lock -> serving:ServingMetrics._lock"
+    edge = "tracing:Tracer._dump_lock -> tracing:Tracer._rings_lock"
     assert edge in witness.observed_edges()
     witness.assert_subgraph({edge})
     try:
@@ -531,19 +532,19 @@ def test_witness_records_real_repo_lock_nesting():
 
 
 def test_witness_cross_thread_stacks_are_independent():
-    from accelerate_tpu.serving import ServingMetrics
+    from accelerate_tpu.tracing import MetricsRegistry
 
     witness = LockOrderWitness()
     with witness.patch():
-        sm = ServingMetrics()
+        reg = MetricsRegistry(prefix="t/", counters=("submitted",))
         done = threading.Event()
 
         def other():
-            sm.gauge("queue_depth", 1)  # acquires with main NOT holding
+            reg.bump("submitted")  # acquires with main NOT holding
             done.set()
 
         t = threading.Thread(target=other)
-        with sm._lock:
+        with reg._lock:
             pass
         t.start()
         assert done.wait(2.0)

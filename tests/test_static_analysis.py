@@ -484,6 +484,7 @@ def test_finding_render():
     assert f.render() == "accelerate_tpu/engine.py:7: G101 boom"
     assert set(RULES) == {
         "G001", "G002", "G003", "G004", "G101", "G102", "G103", "G104", "G105",
+        "G107",
         "G201", "G202", "G203", "G204", "G205",
         "G301", "G302", "G303", "G304", "G305", "G306",
         "G401", "G402", "G403", "G404", "G405",

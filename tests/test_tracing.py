@@ -1,0 +1,335 @@
+"""Flight recorder & request tracing suite (docs/observability.md):
+
+* trace propagation — a failed-over request is ONE trace: both dispatch
+  spans (dead replica + survivor) and the typed failover decision with
+  its ``__cause__``-chained error event share the fleet-minted trace ID,
+  and the result carries ``failover_count``;
+* flight dumps — a serving worker death auto-dumps the retained window
+  as Chrome-trace JSON (the batch span carries the SystemExit error
+  event); the dump budget (``max_dumps``) is enforced;
+* ring discipline — bounded per-thread rings drop oldest-first with an
+  exact ``dropped_spans`` count; disabled tracing hands back ONE shared
+  no-op context manager (no per-call allocation);
+* latency surface — ``ServingResult`` reports queue_wait_s / prefill_s /
+  decode_steps for every completed request;
+* MetricsRegistry — the unified counters/gauges/reservoir surface and
+  the single periodic tracker flush (due/flush/maybe_flush).
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from accelerate_tpu import tracing
+from accelerate_tpu.fleet import FleetRouter
+from accelerate_tpu.serving import InferenceServer
+from accelerate_tpu.tracing import MetricsRegistry, Tracer
+from accelerate_tpu.utils.dataclasses import (
+    FleetConfig,
+    ServingConfig,
+    TracingConfig,
+)
+from accelerate_tpu.utils.fault import ServingError
+
+PROMPT = np.arange(1, 6, dtype=np.int32)
+
+
+def echo_gen(delay=0.0):
+    def fn(model, ids, max_new_tokens=8, **kw):
+        if delay:
+            time.sleep(delay)
+        new = np.repeat(ids[:, :1], max_new_tokens, axis=1)
+        return np.concatenate([ids, new], axis=1)
+
+    return fn
+
+
+def killable_gen(kill_event, delay=0.005):
+    def fn(model, ids, max_new_tokens=8, **kw):
+        if kill_event.is_set():
+            kill_event.clear()
+            raise SystemExit(1)
+        if delay:
+            time.sleep(delay)
+        new = np.repeat(ids[:, :1], max_new_tokens, axis=1)
+        return np.concatenate([ids, new], axis=1)
+
+    return fn
+
+
+def wait_until(predicate, timeout=5.0, interval=0.005):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def make_server(gen_fn, replica_id=None, **cfg_kw):
+    cfg_kw.setdefault("max_queue", 128)
+    cfg_kw.setdefault("max_batch_size", 4)
+    cfg_kw.setdefault("batch_window_s", 0.001)
+    cfg_kw.setdefault("max_retries", 0)
+    cfg = ServingConfig(**cfg_kw)
+    return InferenceServer(object(), cfg, generate_fn=gen_fn, replica_id=replica_id)
+
+
+@pytest.fixture
+def tracer(tmp_path):
+    """A fresh enabled default tracer dumping into tmp_path; the previous
+    default config (the session-wide tmp dump dir from conftest) is
+    restored afterwards so other suites keep their usual tracer."""
+    prev_cfg = tracing.get_tracer().config
+    t = tracing.configure(TracingConfig(
+        enabled=True, ring_capacity=4096, retain_s=60.0,
+        dump_dir=str(tmp_path), max_dumps=4,
+    ))
+    yield t
+    tracing.configure(prev_cfg)
+
+
+# -------------------------------------------------------- trace propagation
+def test_failover_is_one_trace_with_both_dispatches(tracer):
+    """Kill r0 mid-batch: the affected request's trace must contain the
+    dispatch to the dead replica, the typed failover decision (with the
+    error recorded as a span event), and the re-dispatch to a survivor."""
+    kill = threading.Event()
+    servers = {
+        "r0": make_server(killable_gen(kill), replica_id="r0"),
+        "r1": make_server(echo_gen(), replica_id="r1"),
+    }
+    router = FleetRouter(servers, FleetConfig(probe_interval_s=0.05))
+    try:
+        kill.set()
+        futs = [router.submit(PROMPT, max_new_tokens=2) for _ in range(6)]
+        results = [f.result(timeout=10) for f in futs]
+        assert wait_until(lambda: router.metrics["failovers"] >= 1)
+    finally:
+        router.close(drain=False)
+
+    failover_spans = tracer.spans(name="fleet.failover")
+    assert failover_spans, "no failover decision span recorded"
+    sp = failover_spans[0]
+    assert sp.trace_id is not None
+    assert sp.attrs["outcome"] == "resubmitted"
+    # the typed error event: taxonomy attributes, never prose
+    events = {name: attrs for _, name, attrs in sp.events}
+    assert "error" in events
+    assert events["error"]["type"]  # e.g. ReplicaDeadError
+    assert events["error"]["retriable"] is True
+    assert "cause" in events["error"]  # the __cause__ chain is surfaced
+
+    # ONE trace, two dispatch spans, two distinct replicas
+    dispatches = tracer.spans(trace_id=sp.trace_id, name="fleet.dispatch")
+    assert len(dispatches) >= 2
+    assert len({d.attrs["replica"] for d in dispatches}) >= 2
+    # the whole submit is under the same trace
+    assert tracer.spans(trace_id=sp.trace_id, name="fleet.submit")
+    # and the client-visible result reports the hop count
+    failed_over = [r for r in results if r.failover_count >= 1]
+    assert failed_over and all(r.replica_id == "r1" for r in failed_over)
+
+
+def test_trace_id_threads_submit_to_batch(tracer):
+    srv = make_server(echo_gen())
+    try:
+        fut = srv.submit(PROMPT, max_new_tokens=2)
+        fut.result(timeout=10)
+    finally:
+        srv.close()
+    tids = {s.trace_id for s in tracer.spans(name="serving.batch")}
+    assert None not in tids and len(tids) == 1
+
+
+# -------------------------------------------------------------- flight dump
+def test_worker_death_dumps_flight_recording(tracer, tmp_path):
+    kill = threading.Event()
+    srv = make_server(killable_gen(kill))
+    try:
+        kill.set()
+        fut = srv.submit(PROMPT, max_new_tokens=2)
+        with pytest.raises(ServingError):
+            fut.result(timeout=10)
+        assert wait_until(lambda: any(
+            fn.startswith("flight-worker_death-") for fn in os.listdir(tmp_path)
+        ))
+    finally:
+        srv.close()
+    path = next(
+        tmp_path / fn for fn in os.listdir(tmp_path)
+        if fn.startswith("flight-worker_death-")
+    )
+    doc = json.loads(path.read_text())
+    assert doc["otherData"]["reason"] == "worker_death"
+    batch = [e for e in doc["traceEvents"]
+             if e["ph"] == "X" and e["name"] == "serving.batch"]
+    assert batch and batch[0]["args"]["trace_id"]
+    errors = [e for e in doc["traceEvents"]
+              if e["ph"] == "i" and e["name"] == "error"]
+    assert any(e["args"]["type"] == "SystemExit" for e in errors)
+
+
+def test_dump_budget_is_bounded(tracer, tmp_path):
+    with tracer.span("x"):
+        pass
+    paths = [tracer.dump("budget") for _ in range(10)]
+    written = [p for p in paths if p is not None]
+    assert len(written) == tracer.config.max_dumps
+    assert all(os.path.exists(p) for p in written)
+
+
+def test_disabled_tracer_never_dumps(tmp_path):
+    t = Tracer(TracingConfig(enabled=False, dump_dir=str(tmp_path)))
+    assert t.dump("nope") is None and t.maybe_dump("nope") is None
+    assert os.listdir(tmp_path) == []
+
+
+# ----------------------------------------------------------- ring discipline
+def test_ring_drops_oldest_and_counts():
+    t = Tracer(TracingConfig(enabled=True, ring_capacity=16))
+    for i in range(40):
+        with t.span("s", None, i=i):
+            pass
+    assert t.dropped_spans() == 24
+    kept = t.spans(name="s")
+    assert len(kept) == 16
+    # drop-oldest: the survivors are exactly the 16 newest
+    assert {s.attrs["i"] for s in kept} == set(range(24, 40))
+
+
+def test_disabled_span_is_shared_noop():
+    t = Tracer(TracingConfig(enabled=False))
+    cms = {id(t.span("a")), id(t.span("b", "tid", k=1))}
+    assert len(cms) == 1  # ONE shared object: no per-call allocation
+    with t.span("a") as sp:
+        sp.set("k", 1)  # no-op, no error
+        sp.event("e")
+    assert t.spans() == [] and t.dropped_spans() == 0
+
+
+def test_step_span_samples_by_period(tracer, tmp_path):
+    tracing.configure(TracingConfig(
+        enabled=True, decode_sample_every=4, dump_dir=str(tmp_path),
+    ))
+    for step in range(8):
+        with tracing.step_span("hot", step):
+            pass
+    recorded = tracing.get_tracer().spans(name="hot")
+    assert len(recorded) == 2  # steps 0 and 4
+    # non-sampled steps return the shared no-op CM
+    assert tracing.step_span("hot", 1) is tracing.step_span("hot", 2)
+
+
+def test_span_records_exception_as_typed_event(tracer):
+    with pytest.raises(ValueError):
+        with tracing.span("boom"):
+            raise ValueError("nope")
+    sp = tracer.spans(name="boom")[0]
+    events = {name: attrs for _, name, attrs in sp.events}
+    assert events["error"]["type"] == "ValueError"
+
+
+# ----------------------------------------------------------- result surface
+def test_serving_result_carries_latency_breakdown(tracer):
+    srv = make_server(echo_gen(delay=0.01))
+    try:
+        res = srv.submit(PROMPT, max_new_tokens=3).result(timeout=10)
+    finally:
+        srv.close()
+    assert res.queue_wait_s is not None and res.queue_wait_s >= 0.0
+    assert res.decode_steps == 3
+    assert res.failover_count == 0
+
+
+# --------------------------------------------------------- metrics registry
+class _FakeTracker:
+    name = "fake"
+
+    def __init__(self):
+        self.batches = []
+
+    def log_batch(self, entries):
+        self.batches.append(entries)
+
+
+def test_registry_counters_gauges_snapshot():
+    reg = MetricsRegistry(prefix="t/", counters=("a",))
+    reg.bump("a")
+    reg.bump("a", 2)
+    reg.gauge("g", 1.5)
+    assert reg["a"] == 3 and reg["g"] == 1.5
+    snap = reg.snapshot()
+    assert snap == {"t/a": 3, "t/g": 1.5}
+
+
+def test_registry_ingest_flattens_nested_stats():
+    reg = MetricsRegistry(prefix="serving/")
+    reg.ingest({"kv": {"hbm_bytes": 42, "blocks": {"free": 7}},
+                "live": 3, "note": "ignored-not-numeric"}, prefix="engine")
+    snap = reg.snapshot()
+    assert snap["serving/engine/kv/hbm_bytes"] == 42
+    assert snap["serving/engine/kv/blocks/free"] == 7
+    assert snap["serving/engine/live"] == 3
+    assert "serving/engine/note" not in snap
+
+
+def test_registry_observe_expands_percentiles():
+    reg = MetricsRegistry(prefix="t/")
+    for v in range(100):
+        reg.observe("lat", v / 100.0)
+    snap = reg.snapshot()
+    assert any(k.startswith("t/lat_") for k in snap)
+
+
+def test_registry_flush_is_the_single_periodic_path():
+    clock = [100.0]
+    reg = MetricsRegistry(prefix="t/", counters=("a",), clock=lambda: clock[0])
+    tracker = _FakeTracker()
+    assert not reg.due(5.0)  # just constructed
+    assert reg.maybe_flush([tracker], 5.0) is False
+    clock[0] += 6.0
+    assert reg.due(5.0)
+    assert reg.maybe_flush([tracker], 5.0, step=7) is True
+    assert len(tracker.batches) == 1
+    (values, step, _kw), = tracker.batches[0]
+    assert step == 7 and "t/a" in values
+    # the flush reset the interval
+    assert not reg.due(5.0)
+    assert reg.due(None) is False  # None interval: never due
+
+
+def test_serving_and_fleet_share_registry_flush(tracer):
+    """Both periodic flushes route through MetricsRegistry.maybe_flush —
+    the serving worker and the fleet prober each push their own snapshot
+    to trackers, outside their respective locks."""
+    tracker = _FakeTracker()
+    srv = make_server(echo_gen(), metrics_interval_s=0.05)
+    srv.trackers = [tracker]
+    try:
+        srv.submit(PROMPT, max_new_tokens=2).result(timeout=10)
+        assert wait_until(lambda: any(
+            any(k.startswith("serving/") for k in values)
+            for batch in tracker.batches for values, _s, _kw in batch
+        ))
+    finally:
+        srv.close()
+
+    fleet_tracker = _FakeTracker()
+    router = FleetRouter(
+        {"r0": make_server(echo_gen(), replica_id="r0")},
+        FleetConfig(probe_interval_s=0.02, metrics_interval_s=0.05),
+        trackers=[fleet_tracker],
+    )
+    try:
+        router.submit(PROMPT, max_new_tokens=2).result(timeout=10)
+        assert wait_until(lambda: any(
+            any(k.startswith("fleet/") for k in values)
+            for batch in fleet_tracker.batches for values, _s, _kw in batch
+        ))
+    finally:
+        router.close(drain=False)
