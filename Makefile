@@ -9,7 +9,7 @@
 PY := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu python
 PY_SLOW := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu RUN_SLOW=1 python
 
-.PHONY: test test_all test_core test_data test_parallel test_models test_cli test_big_modeling test-fault test-serving check-static check-sharding check-concurrency check-numerics check-perf check-all install-hooks bench bench-telemetry bench-serving bench-continuous bench-recovery bench-kv bench-spec bench-fleet bench-trace
+.PHONY: test test_all test_core test_data test_parallel test_models test_cli test_big_modeling test-fault test-serving check-static check-sharding check-concurrency check-numerics check-perf check-all install-hooks bench bench-telemetry bench-serving bench-continuous bench-recovery bench-kv bench-spec bench-fleet bench-trace bench-obs
 
 test: check-static
 	$(PY) -m pytest tests/ -q
@@ -167,6 +167,14 @@ bench-fleet:
 # futures and zero dropped spans (docs/observability.md)
 bench-trace:
 	$(PY) benchmarks/tracing_bench.py --gate
+
+# perf-observatory gate: observatory-on serving goodput >= 0.98x off with a
+# live /metrics scraper attached, scrape p99 under 50ms against a loaded
+# server, and drift-sentinel chaos — a fault-injected slowdown raises
+# exactly one typed PerfDriftError + one budgeted drift dump
+# (docs/observability.md)
+bench-obs:
+	$(PY) benchmarks/obs_bench.py --gate
 
 # elastic-recovery gate: MTTR per restore path (local / replica / elastic
 # reshard, restart-to-resumed wall clock) + consensus/replication must stay

@@ -108,6 +108,11 @@ _LAZY = {
     "Tracer": ("tracing", "Tracer"),
     "MetricsRegistry": ("tracing", "MetricsRegistry"),
     "TracingConfig": ("utils.dataclasses", "TracingConfig"),
+    "perfwatch": ("perfwatch", None),
+    "PerfWatch": ("perfwatch", "PerfWatch"),
+    "MetricsExporter": ("perfwatch", "MetricsExporter"),
+    "ObservabilityConfig": ("utils.dataclasses", "ObservabilityConfig"),
+    "PerfDriftError": ("utils.fault", "PerfDriftError"),
 }
 
 

@@ -23,6 +23,7 @@ from __future__ import annotations
 import contextlib
 import functools
 import os
+import time
 from typing import Any, Callable, Optional, Sequence, Union
 
 import numpy as np
@@ -233,6 +234,10 @@ class Accelerator:
         self._last_committed_checkpoint: Optional[str] = None
         self._health_ring = None
         self._health_seq = 0
+        # perf observatory window mark: the interval between consecutive
+        # materialized health verdicts IS the fused-step throughput, read
+        # at a point that already synchronizes the host (no new readback)
+        self._pw_mark = None
         self.last_health = None
         from .utils.environment import parse_flag_from_env as _flag
 
@@ -1779,12 +1784,19 @@ class Accelerator:
         step = self._health_seq
         self._health_seq += 1
         if cfg.sync:
-            return self._apply_health_verdict(telemetry.read_summary(summary, step))
+            verdict = self._apply_health_verdict(
+                telemetry.read_summary(summary, step)
+            )
+            self._pw_note_train(1)
+            return verdict
         if self._health_ring is None:
             self._health_ring = telemetry.DeferredReadbackRing(cfg.readback_depth)
         ok = True
+        matured_n = 0
         for s, matured in self._health_ring.push((step, summary)):
             ok = self._apply_health_verdict(telemetry.read_summary(matured, s)) and ok
+            matured_n += 1
+        self._pw_note_train(matured_n)
         return ok
 
     def health_drain(self) -> bool:
@@ -1806,6 +1818,42 @@ class Accelerator:
                 step, summary = ring.popleft()
                 ok = self._apply_health_verdict(telemetry.read_summary(summary, step)) and ok
         return ok
+
+    def _pw_note_train(self, verdicts: int) -> None:
+        """Bill the wall time since the previous materialized health
+        verdict to the fused train step (perf observatory window
+        accounting, docs/observability.md). A verdict readback already
+        synchronized the host, so this adds a clock read at a sync point
+        and nothing else; ``verdicts == 0`` (deferred ring still
+        filling) leaves the window open."""
+        if verdicts <= 0:
+            return
+        from . import perfwatch
+
+        now = time.monotonic()
+        mark, self._pw_mark = self._pw_mark, now
+        if mark is None:
+            return
+        perfwatch.get_watch().record(
+            f"train.{self._pw_variant()}/fused_train_step",
+            (now - mark) / verdicts,
+            calls=verdicts,
+        )
+
+    def _pw_variant(self) -> str:
+        """The baseline program variant this process's mesh matches
+        (``runs/perf_baseline.json`` keys: dp8, fsdp8, tp2, hsdp2x4)."""
+        pc = self.parallelism_config
+        r = getattr(pc, "dp_replicate_size", 1) or 1
+        s = getattr(pc, "dp_shard_size", 1) or 1
+        t = getattr(pc, "tp_size", 1) or 1
+        if t > 1:
+            return f"tp{t}"
+        if r > 1 and s > 1:
+            return f"hsdp{r}x{s}"
+        if s > 1:
+            return f"fsdp{s}"
+        return f"dp{r}"
 
     def _apply_health_verdict(self, health) -> bool:
         """Apply the configured nonfinite policy to one realized

@@ -36,6 +36,10 @@ Level 2 — host lint (``analysis/host.py``):
   the code's ``fault_point`` registry
 * **G107** tracing discipline: host clock / tracer call inside a jitted
   function, or ``tracing.span``/``step_span`` used outside a ``with``
+* **G108** metric-name discipline: ``bump``/``gauge``/``observe`` call
+  site whose metric name is not a ``[a-z0-9_/]+`` literal (or
+  literal-fragment f-string) — computed names fork ad-hoc namespaces
+  the exporter and dashboards never see
 
 Level 3 — sharding & memory audit (``analysis/sharding.py``):
 
@@ -122,7 +126,7 @@ Waivers are line-scoped comments, same line or the line above:
 ``# graft: sync-ok`` (G101), ``# graft: wait-ok`` (G102),
 ``# graft: raise-ok`` (G103), ``# graft: lock-ok`` (G104),
 ``# graft: fault-ok`` (G105), ``# graft: trace-ok`` (G107),
-``# graft: block-ok`` (G302),
+``# graft: metric-ok`` (G108), ``# graft: block-ok`` (G302),
 ``# graft: race-ok`` (G303), ``# graft: thread-ok`` (G304),
 ``# graft: resolve-ok`` (G305), ``# graft: gang-ok`` (G306),
 ``# graft: key-ok`` (G404), or the universal ``# graft: GXXX-ok``.
@@ -147,6 +151,7 @@ RULES = {
     "G104": "tracker/metrics call while holding the server lock",
     "G105": "referenced fault-injection point missing from the registry",
     "G107": "tracer/clock call in jitted code or span used outside 'with'",
+    "G108": "metric name is not a [a-z0-9_/]+ literal (namespace discipline)",
     "G201": "large state tensor replicated where the config claims sharding",
     "G202": "GSPMD reshard collective not implied by the declared specs",
     "G203": "static per-device HBM footprint grew past the committed budget",

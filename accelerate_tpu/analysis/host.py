@@ -20,11 +20,19 @@ invariant a past PR or review cycle established:
   bake a constant — or worse, retrace), and ``tracing.span``/``step_span``
   only as ``with`` context managers (a span that is never ``__exit__``-ed
   never lands in the ring, so it silently records nothing).
+* G108 — metric-name discipline (PR 15 observatory): every
+  ``bump``/``gauge``/``observe`` call site names its metric with a
+  literal (or literal-fragment f-string) matching ``[a-z0-9_/]+`` —
+  Prometheus-mappable, grep-able, and impossible to typo into a fresh
+  ad-hoc namespace nobody scrapes. Forwarding wrappers named
+  ``bump``/``gauge``/``observe`` themselves (the registered-prefix
+  dialects ``ServingMetrics``/``FleetMetrics``) are the one sanctioned
+  pass-through.
 
 Waivers are line-scoped comments on the finding line or the line above:
 the per-rule token (``sync-ok``, ``wait-ok``, ``raise-ok``, ``lock-ok``,
-``fault-ok``, ``trace-ok``) or the universal ``gXXX-ok`` form, e.g.
-``# graft: g101-ok``.
+``fault-ok``, ``trace-ok``, ``metric-ok``) or the universal ``gXXX-ok``
+form, e.g. ``# graft: g101-ok``.
 """
 
 from __future__ import annotations
@@ -63,6 +71,7 @@ _RULE_TOKENS = {
     "G104": "lock-ok",
     "G105": "fault-ok",
     "G107": "trace-ok",
+    "G108": "metric-ok",
     # Level 5's AST half (analysis/numerics.py) shares this waiver table
     "G404": "key-ok",
 }
@@ -296,7 +305,120 @@ def lint_source(text: str, relpath: str) -> List[Finding]:
     if base != "tracing.py":
         _lint_span_discipline(tree, relpath, waivers, findings)
 
+    # G108 — metric-name discipline, package-wide
+    _lint_metric_names(tree, relpath, waivers, findings)
+
     return _dedupe(findings)
+
+
+# G108 — metric-name discipline. The registry maps names straight into
+# the exporter's Prometheus families; a name outside [a-z0-9_/]+ (or a
+# computed one) is a metric that silently lands in a namespace nobody
+# scrapes or greps for.
+_METRIC_METHODS = {"bump", "gauge", "observe"}
+_METRIC_NAME_RE = re.compile(r"^[a-z0-9_/]+$")
+_METRIC_FRAG_RE = re.compile(r"^[a-z0-9_/]*$")
+
+
+def _lint_metric_names(tree, relpath, waivers, findings) -> None:
+    # Forwarding wrappers named bump/gauge/observe (ServingMetrics,
+    # FleetMetrics, MetricsRegistry itself) ARE the registered-prefix
+    # path: their own call sites are checked, the variable they forward
+    # is not re-flagged.
+    wrapper_spans = [
+        (node.lineno, node.end_lineno or node.lineno)
+        for node in ast.walk(tree)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and node.name in _METRIC_METHODS
+    ]
+
+    def in_wrapper(line: int) -> bool:
+        return any(lo <= line <= hi for lo, hi in wrapper_spans)
+
+    # `for name in ("a", "b"): registry.gauge(name, 0.0)` — the names ARE
+    # literals, hoisted into a loop; accept the loop variable inside the
+    # loop body and validate the tuple's elements instead (only for loops
+    # a metric call actually consumes).
+    literal_loops = []  # (var, lo, hi, elts)
+    for node in ast.walk(tree):
+        if not (isinstance(node, (ast.For, ast.AsyncFor))
+                and isinstance(node.target, ast.Name)
+                and isinstance(node.iter, (ast.Tuple, ast.List, ast.Set))):
+            continue
+        elts = node.iter.elts
+        if elts and all(
+            isinstance(e, ast.Constant) and isinstance(e.value, str)
+            for e in elts
+        ):
+            literal_loops.append((
+                node.target.id, node.lineno,
+                node.end_lineno or node.lineno, elts,
+            ))
+
+    def literal_loop_check(name_arg: ast.AST, line: int) -> bool:
+        """True when ``name_arg`` is a literal-tuple loop variable; the
+        elements themselves are validated (and flagged) here."""
+        if not isinstance(name_arg, ast.Name):
+            return False
+        for var, lo, hi, elts in literal_loops:
+            if name_arg.id != var or not lo <= line <= hi:
+                continue
+            for e in elts:
+                if (not _METRIC_NAME_RE.match(e.value)
+                        and not _waived("G108", e.lineno, waivers)):
+                    findings.append(Finding(
+                        "G108", relpath, e.lineno,
+                        f"metric name {e.value!r} must match [a-z0-9_/]+ "
+                        "(Prometheus-mappable; '# graft: metric-ok' waives)",
+                    ))
+            return True
+        return False
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if not (isinstance(func, ast.Attribute)
+                and func.attr in _METRIC_METHODS):
+            continue
+        if node.args:
+            name_arg = node.args[0]
+        else:
+            name_arg = next(
+                (kw.value for kw in node.keywords if kw.arg == "name"), None
+            )
+        if name_arg is None:
+            continue
+        line = node.lineno
+        if _waived("G108", line, waivers):
+            continue
+        if isinstance(name_arg, ast.Constant) and isinstance(name_arg.value, str):
+            if not _METRIC_NAME_RE.match(name_arg.value):
+                findings.append(Finding(
+                    "G108", relpath, line,
+                    f"metric name {name_arg.value!r} must match "
+                    "[a-z0-9_/]+ (Prometheus-mappable; '# graft: "
+                    "metric-ok' waives)",
+                ))
+        elif isinstance(name_arg, ast.JoinedStr):
+            for part in name_arg.values:
+                if (isinstance(part, ast.Constant)
+                        and isinstance(part.value, str)
+                        and not _METRIC_FRAG_RE.match(part.value)):
+                    findings.append(Finding(
+                        "G108", relpath, line,
+                        f"metric name fragment {part.value!r} must match "
+                        "[a-z0-9_/]* (Prometheus-mappable; '# graft: "
+                        "metric-ok' waives)",
+                    ))
+                    break
+        elif not in_wrapper(line) and not literal_loop_check(name_arg, line):
+            findings.append(Finding(
+                "G108", relpath, line,
+                f".{func.attr}() metric name is not a literal — computed "
+                "names fork ad-hoc namespaces; use a literal/f-string or "
+                "a registered-prefix wrapper ('# graft: metric-ok' waives)",
+            ))
 
 
 def _lint_lock_held(tree, relpath, waivers, findings) -> None:

@@ -71,7 +71,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from . import tracing
+from . import perfwatch, tracing
 from .logging import get_logger
 from .utils.fault import EngineCapacityError, EngineInvariantError
 
@@ -362,6 +362,12 @@ class ContinuousBatchingEngine:
         # the "<= 2 compiled programs" acceptance stat (one prompt bucket →
         # one prefill signature + one decode signature)
         self._programs: dict[str, set] = {}
+        # perf observatory (docs/observability.md): wall time is only read
+        # at poll() — the deferred-readback ring's synchronizing point —
+        # and split across the programs that retired in the window. The
+        # dispatch path never gains a clock read or a readback (G101).
+        self._perfwatch = perfwatch.get_watch()
+        self._pw_mark = self._clock()
 
     # ----------------------------------------------------------- state init
     def _init_state(self):
@@ -1096,10 +1102,12 @@ class ContinuousBatchingEngine:
         finished (or were cancelled) earlier are skipped — their token
         values are pad by construction."""
         retired: List[SlotOccupant] = []
+        popped: collections.Counter = collections.Counter()
         while self._ring and (
             force or self._tick - self._ring[0][0] >= self.readback_lag
         ):
             _, kind, payload = self._ring.popleft()
+            popped[kind] += 1
             if kind == "prefill":
                 occ, tok, done = payload
                 # graft: sync-ok — the ring IS the readback point (K programs late)
@@ -1152,7 +1160,31 @@ class ContinuousBatchingEngine:
                         self._absorb(
                             occ, int(emitted[s, j]), d and j == m - 1, retired
                         )
+        if popped:
+            self._pw_flush(popped)
+        elif not self._ring:
+            # idle poll: move the window mark so dead time between
+            # requests is never billed to the next program window
+            self._pw_mark = self._clock()
         return retired
+
+    def _pw_flush(self, popped: "collections.Counter") -> None:
+        """Bill the wall time since the previous synchronizing poll to
+        the programs that retired from the ring in that window (weighted
+        by their committed roofline predictions — perfwatch splits)."""
+        now = self._clock()
+        dt, self._pw_mark = now - self._pw_mark, now
+        if self.spec is not None:
+            family = "engine.spec"
+        elif self._backend.kind.startswith("paged"):
+            family = "engine.paged"
+        else:
+            family = "engine.dense"
+        self._perfwatch.record_window(
+            family,
+            {perfwatch.RING_KIND_PROGRAM[k]: n for k, n in popped.items()},
+            dt,
+        )
 
     def _absorb(self, occ: SlotOccupant, token: int, done: bool, retired: list) -> None:
         if occ.finished:

@@ -69,7 +69,7 @@ from typing import Any, Callable, Dict, Optional
 
 import numpy as np
 
-from . import tracing
+from . import perfwatch, tracing
 from .elastic import FleetMembership
 from .logging import get_logger
 from .serving import InferenceServer, _CircuitBreaker, resolve_future
@@ -326,6 +326,13 @@ class FleetRouter:
             target=self._probe_loop, name="fleet-probe", daemon=True
         )
         self._prober.start()
+        # fleet-wide metrics endpoint (docs/observability.md): the prober
+        # aggregates every replica's snapshot into this router's registry,
+        # so ONE scrape carries goodput, per-class latency percentiles, KV
+        # utilization, prefix hit rate, spec acceptance, breaker states and
+        # the retry-budget level for the whole fleet. Armed only by
+        # ACCELERATE_METRICS_PORT (off by default).
+        self._exporter = perfwatch.maybe_exporter(self.metrics_snapshot)
 
     # ------------------------------------------------------------- lifecycle
     @property
@@ -414,6 +421,9 @@ class FleetRouter:
         for t in self._prefill_threads:
             t.join(timeout=5.0)
         self._prober.join(timeout=5.0)
+        if self._exporter is not None:
+            self._exporter.close()
+            self._exporter = None
         for handle in handles:
             try:
                 handle.server.close(drain=drain, timeout=timeout)
@@ -869,6 +879,20 @@ class FleetRouter:
                     self.metrics.bump("probes")
                     health = handle.server.health()
                     dead = not health["worker_alive"]
+                    # fold this replica's health + full metrics snapshot
+                    # into the router registry (fleet/replica/<id>/...):
+                    # the fleet-wide aggregation the exporter serves. The
+                    # snapshot path re-ingests engine gauges, so an IDLE
+                    # replica's KV state still reaches the scrape.
+                    rid = handle.replica_id
+                    self.metrics.registry.ingest(
+                        health, prefix=f"replica/{rid}/health"
+                    )
+                    snap_fn = getattr(handle.server, "metrics_snapshot", None)
+                    if snap_fn is not None:
+                        self.metrics.registry.ingest(
+                            snap_fn(), prefix=f"replica/{rid}"
+                        )
                 except Exception:  # noqa: BLE001 — an unprobeable replica is dead
                     dead = True
                 if dead:
@@ -925,6 +949,15 @@ class FleetRouter:
         )
 
     # --------------------------------------------------------------- stats
+    def metrics_snapshot(self) -> dict:
+        """The fleet-wide flat metrics dict the exporter serves: router
+        counters/gauges/percentiles, every replica's aggregated snapshot
+        (``fleet/replica/<id>/...``, refreshed by the prober) and this
+        process's perf observatory (``perf/<program>/...``)."""
+        out = self.metrics.registry.snapshot()
+        out.update(perfwatch.get_watch().snapshot())
+        return out
+
     def stats(self) -> dict:
         """Router + per-replica observability: metrics snapshot, membership
         snapshot, retry-budget level, and each replica's handle state."""

@@ -62,7 +62,7 @@ from typing import Any, Callable, Optional, Sequence
 
 import numpy as np
 
-from . import tracing
+from . import perfwatch, tracing
 from .logging import get_logger
 from .telemetry import LatencyReservoir
 from .tracing import MetricsRegistry
@@ -409,6 +409,14 @@ class InferenceServer:
             target=self._serve_loop, name="inference-server", daemon=True
         )
         self._worker.start()
+        # pull-based metrics endpoint (docs/observability.md), armed only
+        # by ACCELERATE_METRICS_PORT / ObservabilityConfig — and only on a
+        # STANDALONE server: fleet replicas are aggregated and exported by
+        # the router, not scraped one socket each
+        self._exporter = (
+            perfwatch.maybe_exporter(self.metrics_snapshot)
+            if replica_id is None else None
+        )
 
     # ------------------------------------------------------------- admission
     def submit(
@@ -607,6 +615,20 @@ class InferenceServer:
             "batch_ewma_s": self._batch_time_ewma,
         }
 
+    def metrics_snapshot(self) -> dict:
+        """One flat metrics dict for exporters and fleet aggregation:
+        the unified registry snapshot plus the process perf observatory
+        (``perf/<program>/...``). Engine gauges are re-ingested HERE, not
+        only per worker tick, so an idle replica's KV utilization, prefix
+        hit rate and spec acceptance stay current in every scrape (the
+        registry is thread-safe; ``engine.stats()`` reads host counters
+        only — same cross-thread discipline as :meth:`health`)."""
+        if self._engine is not None:
+            self._sync_kv_gauges()
+        out = self.metrics.registry.snapshot()
+        out.update(perfwatch.get_watch().snapshot())
+        return out
+
     def drain(self, timeout: Optional[float] = None) -> bool:
         """Stop admission, finish the in-flight batch, reject everything
         still queued with a retriable :class:`ServerDrainingError`. Returns
@@ -636,6 +658,9 @@ class InferenceServer:
         # yourself deadlocks.
         if self._worker is not threading.current_thread():
             self._worker.join(timeout=self.config.drain_timeout_s)
+        if self._exporter is not None:
+            self._exporter.close()
+            self._exporter = None
         if self.trackers:
             self._flush_metrics(force=True)
         return done
@@ -1180,8 +1205,11 @@ class InferenceServer:
         attempt = 0
         while True:
             try:
-                fault_point("serving_before_batch")
+                # clock first: an armed serving_before_batch sleep (the
+                # obs-bench drift chaos) must land inside the measured
+                # window, exactly like a genuinely slow batch would
                 t0 = self._clock()
+                fault_point("serving_before_batch")
                 with tracing.span(
                     "serving.batch",
                     trace_id=batch[0].trace_id,
@@ -1243,6 +1271,10 @@ class InferenceServer:
                 dt if self._batch_time_ewma == 0.0
                 else 0.8 * self._batch_time_ewma + 0.2 * dt
             )
+            # static batches have no baseline program; the observatory
+            # still tracks them (measured-only row) — dt is the wall time
+            # this loop already measured, no new sync point
+            perfwatch.get_watch().record("serving.static/batch", dt)
             fault_point("serving_before_reply")
             now = self._clock()
             for i, req in enumerate(batch):

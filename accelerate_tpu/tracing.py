@@ -346,6 +346,36 @@ class Tracer:
         )
         return path
 
+    def dump_payload(self, reason: str, payload: Any,
+                     prefix: str = "metrics") -> Optional[str]:
+        """Write an arbitrary JSON-serializable document under
+        ``dump_dir`` with the SAME atomic tmp+rename discipline and the
+        SAME per-process ``max_dumps`` budget as :meth:`dump` — the
+        perfwatch SIGUSR2 snapshot and drift-sentinel table land through
+        here, so a metrics-dump loop cannot fill the disk any more than
+        a crash loop can. Returns the written path, or None when tracing
+        is disabled / the budget is spent."""
+        if not self._config.enabled:
+            return None
+        with self._dump_lock:
+            if self._dump_count >= self._config.max_dumps:
+                return None
+            stamp = time.strftime("%Y%m%d-%H%M%S")
+            os.makedirs(self._config.dump_dir, exist_ok=True)
+            path = os.path.join(
+                self._config.dump_dir,
+                f"{prefix}-{reason}-{stamp}-{os.getpid()}"
+                f"-{self._dump_count}.json",
+            )
+            self._dump_count += 1
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(payload, f, indent=2, sort_keys=True, default=str)
+            os.replace(tmp, path)
+        logger.warning(f"perf observatory: dumped {prefix} to {path} "
+                       f"(reason: {reason})")
+        return path
+
     def maybe_dump(self, reason: str) -> Optional[str]:
         """The typed-failure hook (worker death, failover exhaustion,
         checkpoint rollback): dump iff enabled and ``dump_on_failure``."""

@@ -382,6 +382,88 @@ class TracingConfig(KwargsHandler):
 
 
 @dataclass
+class ObservabilityConfig(KwargsHandler):
+    """Policy knobs for the runtime performance observatory
+    (:mod:`accelerate_tpu.perfwatch`, docs/observability.md).
+
+    * ``enabled`` — master switch for program timers. The default watch
+      reads the ``ACCELERATE_PERFWATCH`` env var (``0``/``false``/
+      ``off``/``no`` disables — perfwatch is **on by default** because a
+      disabled record is one attribute check); a config passed to
+      ``perfwatch.configure`` wins outright.
+    * ``ewma_alpha`` — weight of the newest sample in the per-program
+      EWMA gauge (``perf/<program>/ewma_s``).
+    * ``window`` — ``LatencyReservoir`` size per program (percentiles
+      are computed over the last ``window`` samples).
+    * ``baseline_path`` — where the committed per-program roofline
+      predictions live (``runs/perf_baseline.json``). Missing file =
+      measured-only mode, never an error.
+    * ``drift_enabled`` — arm the drift sentinel. Off by default: the
+      committed predictions model v5p hardware, so comparing them
+      against CPU-simulator wall times would page someone every run.
+      Turn on where measured and modeled hardware actually match.
+    * ``drift_tolerance`` — override of the baseline file's committed
+      ``tolerance`` band (``None`` = use the file's).
+    * ``drift_min_samples`` — a program's median is only compared once
+      this many samples landed (cold-start compile steps would
+      otherwise trip the band instantly).
+    * ``drift_consecutive`` — evaluations in a row the median must sit
+      outside the band before the sentinel fires ("sustained drift",
+      not one noisy window).
+    * ``drift_interval_s`` — minimum seconds between sentinel
+      evaluations (driven opportunistically from the record path — no
+      dedicated thread).
+    * ``exporter_port`` — serve ``/metrics`` (Prometheus text) and
+      ``/snapshot.json`` on this port. 0 (default) = no HTTP thread at
+      all; the ``ACCELERATE_METRICS_PORT`` env var seeds the default
+      config's port.
+    * ``exporter_host`` — bind address for the exporter (loopback by
+      default; an operator who wants a fleet-wide scrape binds the
+      router's exporter, not every replica's).
+    """
+
+    enabled: bool = True
+    ewma_alpha: float = 0.2
+    window: int = 512
+    baseline_path: str = os.path.join("runs", "perf_baseline.json")
+    drift_enabled: bool = False
+    drift_tolerance: Optional[float] = None
+    drift_min_samples: int = 8
+    drift_consecutive: int = 2
+    drift_interval_s: float = 1.0
+    exporter_port: int = 0
+    exporter_host: str = "127.0.0.1"
+
+    def __post_init__(self):
+        if not 0.0 < self.ewma_alpha <= 1.0:
+            raise ValueError(
+                f"ewma_alpha must be in (0, 1], got {self.ewma_alpha}"
+            )
+        if self.window < 1:
+            raise ValueError(f"window must be >= 1, got {self.window}")
+        if self.drift_tolerance is not None and self.drift_tolerance <= 0:
+            raise ValueError(
+                f"drift_tolerance must be > 0, got {self.drift_tolerance}"
+            )
+        if self.drift_min_samples < 1:
+            raise ValueError(
+                f"drift_min_samples must be >= 1, got {self.drift_min_samples}"
+            )
+        if self.drift_consecutive < 1:
+            raise ValueError(
+                f"drift_consecutive must be >= 1, got {self.drift_consecutive}"
+            )
+        if self.drift_interval_s < 0:
+            raise ValueError(
+                f"drift_interval_s must be >= 0, got {self.drift_interval_s}"
+            )
+        if not 0 <= self.exporter_port <= 65535:
+            raise ValueError(
+                f"exporter_port must be in [0, 65535], got {self.exporter_port}"
+            )
+
+
+@dataclass
 class ServingConfig(KwargsHandler):
     """Policy knobs for :class:`accelerate_tpu.serving.InferenceServer`
     (docs/serving.md). Robustness-first defaults: bounded everything.

@@ -26,6 +26,7 @@ import os
 import signal
 import sys
 import threading
+import time
 from typing import Optional
 
 __all__ = [
@@ -51,6 +52,7 @@ __all__ = [
     "EngineCapacityError",
     "EngineInvariantError",
     "ComponentClosedError",
+    "PerfDriftError",
     "FaultInjected",
     "fault_point",
     "install_preemption_handler",
@@ -241,6 +243,26 @@ class ComponentClosedError(RuntimeError):
     working."""
 
 
+class PerfDriftError(RuntimeError):
+    """A program's measured step time drifted past the committed tolerance
+    band around its roofline prediction (``runs/perf_baseline.json``) for
+    ``drift_consecutive`` evaluations in a row. Raised/recorded by the
+    perfwatch drift sentinel (docs/observability.md); carries the program
+    name and both sides of the comparison so a dump or log line is
+    attributable without re-deriving anything."""
+
+    def __init__(self, program: str, measured_s: float, predicted_s: float,
+                 tolerance: float):
+        self.program = program
+        self.measured_s = measured_s
+        self.predicted_s = predicted_s
+        self.tolerance = tolerance
+        super().__init__(
+            f"perf drift on {program}: measured {measured_s:.6f}s vs "
+            f"predicted {predicted_s:.6f}s (tolerance {tolerance:.0%})"
+        )
+
+
 class FaultInjected(RuntimeError):
     """Raised by :func:`fault_point` for ``point:raise`` injection specs."""
 
@@ -255,7 +277,12 @@ def fault_point(name: str) -> None:
       OOM-killer mid-save; nothing (atexit, finally, orbax commit threads)
       gets to run;
     * ``exit`` — ``os._exit(17)``;
-    * ``raise`` — raise :class:`FaultInjected` (in-process error paths).
+    * ``raise`` — raise :class:`FaultInjected` (in-process error paths);
+    * ``sleep=<seconds>`` — block here for the given wall time (default
+      0.05), then continue. A survivable slowdown rather than a death:
+      this is how the drift-sentinel chaos probe (``benchmarks/
+      obs_bench.py``) makes a step path measurably slower without
+      changing any program.
 
     Checkpointing calls this at the named moments of the save lifecycle
     (``after_model_save``, ``after_optimizer_save``, ``before_commit``,
@@ -289,10 +316,13 @@ def fault_point(name: str) -> None:
             os._exit(17)
         elif action == "raise":
             raise FaultInjected(name)
+        elif action == "sleep" or action.startswith("sleep="):
+            _, _, dur = action.partition("=")
+            time.sleep(float(dur) if dur else 0.05)
         else:
             raise ValueError(
                 f"unknown fault action {action!r} for point {name!r} "
-                f"(expected kill|exit|raise)"
+                f"(expected kill|exit|raise|sleep[=s])"
             )
 
 

@@ -718,6 +718,16 @@ if __name__ == "__main__":
             ["--level", "perf"]
             + [a for a in sys.argv[1:] if a != "--perf-gate"]
         ))
+    if "--obs-gate" in sys.argv:
+        # perf-observatory gate: observatory-on serving goodput >= 0.98x
+        # off (timers + live /metrics scraping), scrape p99 under budget,
+        # and the drift-sentinel chaos probe — a fault-injected slowdown
+        # must raise exactly one typed PerfDriftError and exactly one
+        # budgeted drift dump (docs/observability.md)
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from benchmarks.obs_bench import main as obs_main
+
+        sys.exit(obs_main(gate=True))
     if "--continuous-gate" in sys.argv:
         # continuous-batching gate: mixed-length/mixed-budget workload must
         # reach >= 1.3x static-mode goodput with TTFT p99 no worse, <= 2

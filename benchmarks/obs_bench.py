@@ -1,0 +1,301 @@
+"""Perf-observatory overhead A/B + scrape latency + drift-sentinel chaos.
+
+Three claims the runtime performance observatory ships on:
+
+1. **Overhead** — program timers plus a live exporter being scraped must
+   be invisible in serving goodput. The same fixed-service-time server
+   is driven open-loop at 1x capacity with the observatory fully
+   disabled, then enabled with a scraper hammering ``/metrics``; the
+   gate fails when on/off goodput drops below ``OBS_GATE_RATIO``
+   (default 0.98).
+
+2. **Scrape latency** — a ``/metrics`` scrape against a server under
+   load stays cheap (p99 under ``OBS_SCRAPE_P99_MS``, default 50ms):
+   the exporter only touches the registry's small lock, never the
+   server lock or the device.
+
+3. **Drift forensics** — calibrate a baseline from healthy traffic,
+   then arm a fault-injected sleep (``serving_before_batch:sleep=...``)
+   so every batch is measurably slower without changing any program.
+   The sentinel must raise exactly ONE typed :class:`PerfDriftError`
+   finding for the slowed program and write exactly ONE budgeted drift
+   dump, no matter how long the slowdown persists.
+
+Prints one JSON line per phase plus a gate line. ``--gate`` (also
+``make bench-obs``) turns the acceptance criteria into a nonzero exit.
+"""
+
+from __future__ import annotations
+
+import os
+import sys as _sys
+
+_sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))  # runnable as `python benchmarks/x.py`
+
+import json
+import shutil
+import tempfile
+import threading
+import time
+import urllib.request
+
+import numpy as np
+
+SERVICE_S = float(os.environ.get("OBS_SERVICE_S", "0.04"))
+MAX_BATCH = int(os.environ.get("OBS_MAX_BATCH", "8"))
+PHASE_S = float(os.environ.get("OBS_PHASE_S", "1.2"))
+REPEATS = int(os.environ.get("OBS_REPEATS", "3"))
+GATE_RATIO = float(os.environ.get("OBS_GATE_RATIO", "0.98"))
+SCRAPE_P99_MS = float(os.environ.get("OBS_SCRAPE_P99_MS", "50"))
+DRIFT_SLEEP_S = float(os.environ.get("OBS_DRIFT_SLEEP_S", str(SERVICE_S)))
+PROMPT = np.arange(1, 9, dtype=np.int32)
+
+PROGRAM = "serving.static/batch"  # the measured-only static-batch row
+
+
+def _synthetic_gen(service_s: float):
+    """generate_fn with a fixed per-batch service time (capacity is
+    exactly ``max_batch / service_s`` rps)."""
+
+    def fn(model, ids, max_new_tokens=4, **kw):
+        time.sleep(service_s)
+        new = np.repeat(ids[:, :1], max_new_tokens, axis=1)
+        return np.concatenate([ids, new], axis=1)
+
+    return fn
+
+
+def _server(workdir: str):
+    from accelerate_tpu.serving import InferenceServer
+    from accelerate_tpu.utils.dataclasses import ServingConfig
+
+    cfg = ServingConfig(
+        max_queue=256, max_batch_size=MAX_BATCH, batch_window_s=0.001,
+        default_max_new_tokens=4, max_retries=0, drain_timeout_s=10.0,
+    )
+    return InferenceServer(object(), cfg, generate_fn=_synthetic_gen(SERVICE_S))
+
+
+def _drive(srv, phase_s: float, rate_x: float = 1.0,
+           scrape_port: int = 0, scrape_lat=None) -> dict:
+    """Open-loop load at ``rate_x`` times capacity; optionally scrape
+    ``/metrics`` between submissions, appending latencies to
+    ``scrape_lat``."""
+    capacity = rate_x * MAX_BATCH / SERVICE_S
+    futures = []
+    completed = untyped = 0
+    last_scrape = 0.0
+    start = time.perf_counter()
+    i = 0
+    while True:
+        now = time.perf_counter()
+        if now - start >= phase_s:
+            break
+        if scrape_port and now - last_scrape >= 0.05:
+            last_scrape = now
+            t0 = time.perf_counter()
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{scrape_port}/metrics",
+                    timeout=5) as resp:
+                body = resp.read()
+            if scrape_lat is not None:
+                scrape_lat.append((time.perf_counter() - t0, body))
+        next_t = start + i / capacity
+        if next_t > now:
+            time.sleep(min(next_t - now, 0.01))
+            continue
+        i += 1
+        futures.append(srv.submit(PROMPT, max_new_tokens=4))
+    for f in futures:
+        try:
+            f.result(timeout=30)
+            completed += 1
+        except Exception:  # noqa: BLE001 — gate counts anything unresolved
+            untyped += 1
+    elapsed = time.perf_counter() - start
+    return {
+        "goodput_rps": round(completed / elapsed, 1),
+        "submitted": i,
+        "errors": untyped,
+    }
+
+
+# --------------------------------------------------------------- phase 1
+def _goodput(label: str, enabled: bool, workdir: str) -> dict:
+    from accelerate_tpu import perfwatch
+    from accelerate_tpu.perfwatch import MetricsExporter
+    from accelerate_tpu.utils.dataclasses import ObservabilityConfig
+
+    perfwatch.configure(ObservabilityConfig(enabled=enabled))
+    best = None
+    for _ in range(REPEATS):
+        with _server(workdir) as srv:
+            exp = None
+            stop = threading.Event()
+            scraper = None
+            if enabled:
+                # the observatory "on" condition includes being scraped
+                exp = MetricsExporter(srv.metrics_snapshot, port=0)
+
+                def _scrape_loop():
+                    url = f"http://127.0.0.1:{exp.port}/metrics"
+                    while not stop.is_set():
+                        try:
+                            with urllib.request.urlopen(url, timeout=5) as r:
+                                r.read()
+                        except OSError:
+                            pass
+                        stop.wait(0.05)
+
+                scraper = threading.Thread(target=_scrape_loop, daemon=True)
+                scraper.start()
+            try:
+                row = _drive(srv, PHASE_S)
+            finally:
+                stop.set()
+                if scraper is not None:
+                    scraper.join(timeout=5)
+                if exp is not None:
+                    exp.close()
+        if best is None or row["goodput_rps"] > best["goodput_rps"]:
+            best = row
+    best = {"phase": f"goodput_{label}", "observatory": enabled, **best}
+    print(json.dumps(best), flush=True)
+    return best
+
+
+# --------------------------------------------------------------- phase 2
+def _scrape_under_load(workdir: str) -> dict:
+    from accelerate_tpu import perfwatch
+    from accelerate_tpu.perfwatch import MetricsExporter
+    from accelerate_tpu.utils.dataclasses import ObservabilityConfig
+
+    perfwatch.configure(ObservabilityConfig(enabled=True))
+    lat: list = []
+    with _server(workdir) as srv:
+        exp = MetricsExporter(srv.metrics_snapshot, port=0)
+        try:
+            row = _drive(srv, PHASE_S, scrape_port=exp.port, scrape_lat=lat)
+        finally:
+            exp.close()
+    times = sorted(t for t, _ in lat)
+    p99 = times[min(len(times) - 1, int(round(0.99 * (len(times) - 1))))]
+    last_body = lat[-1][1].decode() if lat else ""
+    out = {
+        "phase": "scrape_under_load",
+        "scrapes": len(lat),
+        "scrape_p50_ms": round(times[len(times) // 2] * 1e3, 2),
+        "scrape_p99_ms": round(p99 * 1e3, 2),
+        "has_serving_namespace": "accelerate_serving_" in last_body,
+        "has_perf_namespace": "accelerate_perf_" in last_body,
+        **row,
+    }
+    print(json.dumps(out), flush=True)
+    return out
+
+
+# --------------------------------------------------------------- phase 3
+def _drift_chaos(workdir: str) -> dict:
+    """Calibrate, slow every batch via an armed fault-point sleep, and
+    require exactly one typed finding + exactly one budgeted dump."""
+    from accelerate_tpu import perfwatch, tracing
+    from accelerate_tpu.analysis.lowering import atomic_write_json
+    from accelerate_tpu.utils.dataclasses import (
+        ObservabilityConfig,
+        TracingConfig,
+    )
+    from accelerate_tpu.utils.fault import FAULT_INJECT_ENV, PerfDriftError
+
+    # one dump of budget, and no failure-path flight dumps competing
+    tracing.configure(TracingConfig(
+        dump_dir=workdir, max_dumps=1, dump_on_failure=False,
+    ))
+
+    # calibrate: healthy traffic, measured-only
+    perfwatch.configure(ObservabilityConfig(enabled=True))
+    with _server(workdir) as srv:
+        _drive(srv, PHASE_S / 2)
+    healthy = perfwatch.get_watch().measured(PROGRAM)
+    baseline_path = os.path.join(workdir, "perf_baseline.json")
+    atomic_write_json({
+        "chip": "v5p",
+        "tolerance": 0.25,
+        "programs": {PROGRAM: {"predicted_s": healthy["median_s"],
+                               "bound": "hbm", "flops": 0.0}},
+    }, baseline_path)
+
+    # re-arm with the calibrated baseline + the sentinel on, then slow
+    # every batch by a full service time via the injected sleep
+    watch = perfwatch.configure(ObservabilityConfig(
+        enabled=True, baseline_path=baseline_path, drift_enabled=True,
+        drift_min_samples=4, drift_consecutive=2, drift_interval_s=0.05,
+    ))
+    os.environ[FAULT_INJECT_ENV] = (
+        f"serving_before_batch:sleep={DRIFT_SLEEP_S}"
+    )
+    try:
+        with _server(workdir) as srv:
+            row = _drive(srv, PHASE_S)
+    finally:
+        os.environ.pop(FAULT_INJECT_ENV, None)
+
+    findings = watch.drift_findings()
+    dumps = [f for f in os.listdir(workdir) if f.startswith("perfdrift-")]
+    drifted = watch.measured(PROGRAM)
+    out = {
+        "phase": "drift_chaos",
+        "healthy_median_s": round(healthy["median_s"], 4),
+        "drifted_median_s": round(drifted["median_s"], 4),
+        "typed_findings": len(findings),
+        "finding_is_typed": all(
+            isinstance(f, PerfDriftError) and f.program == PROGRAM
+            for f in findings),
+        "drift_dumps": len(dumps),
+        **row,
+    }
+    print(json.dumps(out), flush=True)
+    return out
+
+
+def main(gate: bool = False) -> int:
+    workdir = tempfile.mkdtemp(prefix="obs_bench_")
+    try:
+        off = _goodput("off", False, workdir)
+        on = _goodput("on", True, workdir)
+        scrape = _scrape_under_load(workdir)
+        drift = _drift_chaos(workdir)
+
+        ratio = on["goodput_rps"] / max(off["goodput_rps"], 1e-9)
+        checks = {
+            "observatory_on_goodput": ratio >= GATE_RATIO,
+            "goodput_error_free": off["errors"] == 0 and on["errors"] == 0,
+            "scrape_p99_under_budget": scrape["scrape_p99_ms"]
+            <= SCRAPE_P99_MS,
+            "scrape_serves_both_namespaces": scrape["has_serving_namespace"]
+            and scrape["has_perf_namespace"],
+            "drift_typed_finding": drift["typed_findings"] == 1
+            and drift["finding_is_typed"],
+            "drift_exactly_one_dump": drift["drift_dumps"] == 1,
+            "drift_error_free": drift["errors"] == 0,
+        }
+        ok = all(checks.values())
+        print(json.dumps({
+            "metric": "obs_gate",
+            "on_vs_off": round(ratio, 3),
+            "threshold": GATE_RATIO,
+            "scrape_p99_ms": scrape["scrape_p99_ms"],
+            "checks": checks,
+            "pass": ok,
+        }), flush=True)
+        return 0 if (ok or not gate) else 1
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+        # leave clean defaults behind for anything importing us in-process
+        from accelerate_tpu import perfwatch
+        from accelerate_tpu.utils.dataclasses import ObservabilityConfig
+
+        perfwatch.configure(ObservabilityConfig())
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(gate="--gate" in _sys.argv))

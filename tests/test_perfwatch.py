@@ -1,0 +1,633 @@
+"""Runtime performance observatory suite (docs/observability.md):
+
+* program timers — EWMA/reservoir accounting, window splitting weighted
+  by the committed roofline predictions, disabled-watch no-op;
+* the measured-vs-predicted table — statuses, drift ratio, and measured
+  MFU / tokens-per-s computed through the SAME ``analysis/lowering.py``
+  roofline helpers that produced the predictions;
+* the drift sentinel — a typed :class:`PerfDriftError` finding plus
+  exactly ONE budgeted flight dump per drifted program;
+* the metrics exporter — Prometheus text mapping (replica labels,
+  escaping), ``/metrics`` + ``/snapshot.json`` endpoints, env arming;
+* registry/reservoir edge cases and the snapshot-while-ingest witness;
+* SIGUSR2 snapshot dumps through the shared tracer dump budget;
+* integration — real engines (dense/paged/spec) and the fused train
+  step land their programs on the watch; an idle server's scrape still
+  refreshes engine gauges; the fleet prober aggregates replica
+  snapshots under ``fleet/replica/<id>/...``.
+"""
+
+import json
+import os
+import signal
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from accelerate_tpu import perfwatch, tracing
+from accelerate_tpu.analysis.lowering import (
+    atomic_write_json,
+    predicted_mfu,
+    predicted_tokens_per_s,
+)
+from accelerate_tpu.perfwatch import (
+    MetricsExporter,
+    PerfWatch,
+    prometheus_text,
+)
+from accelerate_tpu.telemetry import LatencyReservoir
+from accelerate_tpu.tracing import MetricsRegistry
+from accelerate_tpu.utils.dataclasses import ObservabilityConfig, TracingConfig
+from accelerate_tpu.utils.fault import PerfDriftError
+
+
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _baseline(tmp_path, programs=None, tolerance=0.05):
+    doc = {
+        "chip": "v5p",
+        "tolerance": tolerance,
+        "programs": programs if programs is not None else {
+            "engine.dense/decode_step": {
+                "predicted_s": 3e-3, "mfu": 0.2, "tok_s": 1000.0,
+                "flops": 1e9, "bound": "hbm",
+            },
+            "engine.dense/prefill_insert": {
+                "predicted_s": 1e-3, "mfu": 0.3, "flops": 5e8,
+                "bound": "flops",
+            },
+        },
+    }
+    path = str(tmp_path / "perf_baseline.json")
+    atomic_write_json(doc, path)
+    return path
+
+
+def _watch(tmp_path, clock=None, baseline=True, **cfg_kw):
+    cfg_kw.setdefault(
+        "baseline_path",
+        _baseline(tmp_path) if baseline else str(tmp_path / "missing.json"),
+    )
+    cfg = ObservabilityConfig(**cfg_kw)
+    return PerfWatch(cfg, clock=clock or FakeClock())
+
+
+@pytest.fixture
+def private_tracer(tmp_path):
+    """A throwaway default tracer whose dumps land in tmp; restores the
+    session tracer config afterwards (same idiom as test_tracing)."""
+    prev_cfg = tracing.get_tracer().config
+    t = tracing.configure(TracingConfig(
+        dump_dir=str(tmp_path / "dumps"), max_dumps=8,
+    ))
+    yield t
+    tracing.configure(prev_cfg)
+
+
+def wait_until(predicate, timeout=5.0, interval=0.005):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+# ------------------------------------------------- reservoir / registry
+def test_reservoir_empty_and_single_sample():
+    res = LatencyReservoir(size=4)
+    assert res.percentile(50) is None
+    assert res.snapshot(prefix="x_") == {"x_count": 0}
+    res.add(0.25)
+    assert res.percentile(50) == 0.25
+    assert res.percentile(99) == 0.25
+    snap = res.snapshot(prefix="x_")
+    assert snap == {"x_count": 1, "x_p50": 0.25, "x_p99": 0.25,
+                    "x_max": 0.25}
+
+
+def test_registry_observe_expands_percentiles():
+    reg = MetricsRegistry(prefix="perf/")
+    for v in (1.0, 2.0, 3.0):
+        reg.observe("step/t_s", v)
+    snap = reg.snapshot()
+    assert snap["perf/step/t_s_p50"] == 2.0
+    assert snap["perf/step/t_s_count"] == 3
+
+
+def test_snapshot_while_ingest_thread_witness():
+    """Scrapes race the ingest path by design (exporter thread vs worker
+    tick): hammer both and require every snapshot stays a coherent flat
+    dict — no exceptions, no half-written nests."""
+    reg = MetricsRegistry(prefix="s/")
+    stop = threading.Event()
+    errors = []
+
+    def _writer():
+        i = 0
+        while not stop.is_set():
+            try:
+                reg.ingest({"kv": {"free": i, "util": i / 7.0}},
+                           prefix="engine")
+                reg.bump("ticks")
+                reg.observe("lat", i * 1e-3)
+            except Exception as exc:  # pragma: no cover - the witness
+                errors.append(exc)
+                return
+            i += 1
+
+    t = threading.Thread(target=_writer)
+    t.start()
+    try:
+        for _ in range(300):
+            snap = reg.snapshot()
+            assert isinstance(snap, dict)
+            for k, v in snap.items():
+                assert isinstance(k, str)
+                assert not isinstance(v, dict)
+    finally:
+        stop.set()
+        t.join(timeout=5)
+    assert errors == []
+    assert reg["ticks"] > 0
+
+
+def test_observability_config_validation():
+    with pytest.raises(ValueError):
+        ObservabilityConfig(ewma_alpha=0.0)
+    with pytest.raises(ValueError):
+        ObservabilityConfig(ewma_alpha=1.5)
+    with pytest.raises(ValueError):
+        ObservabilityConfig(window=0)
+    with pytest.raises(ValueError):
+        ObservabilityConfig(drift_tolerance=-0.1)
+    with pytest.raises(ValueError):
+        ObservabilityConfig(drift_min_samples=0)
+    with pytest.raises(ValueError):
+        ObservabilityConfig(drift_consecutive=0)
+    with pytest.raises(ValueError):
+        ObservabilityConfig(exporter_port=70000)
+
+
+# -------------------------------------------------------- record / table
+def test_record_ewma_and_measured(tmp_path):
+    w = _watch(tmp_path, ewma_alpha=0.2)
+    w.record("engine.dense/decode_step", 1.0)
+    w.record("engine.dense/decode_step", 2.0)
+    m = w.measured("engine.dense/decode_step")
+    assert m["calls"] == 2
+    assert m["last_s"] == 2.0
+    assert m["ewma_s"] == pytest.approx(0.8 * 1.0 + 0.2 * 2.0)
+    snap = w.snapshot()
+    assert snap["perf/engine_dense/decode_step/calls"] == 2
+    assert snap["perf/engine_dense/decode_step/t_s_count"] == 2
+
+
+def test_disabled_watch_is_noop(tmp_path):
+    w = _watch(tmp_path, enabled=False)
+    w.record("engine.dense/decode_step", 1.0)
+    w.record_window("engine.dense", {"decode_step": 3}, 1.0)
+    assert w.measured("engine.dense/decode_step") == {}
+
+
+def test_record_window_weighted_by_predictions(tmp_path):
+    """2 decodes (predicted 3ms each) + 1 prefill (predicted 1ms) retire
+    in a 7ms window: the split must follow the roofline weights, not an
+    equal per-program cut."""
+    w = _watch(tmp_path)
+    w.record_window(
+        "engine.dense", {"decode_step": 2, "prefill_insert": 1}, 7e-3,
+    )
+    dec = w.measured("engine.dense/decode_step")
+    pre = w.measured("engine.dense/prefill_insert")
+    assert dec["calls"] == 2 and pre["calls"] == 1
+    assert dec["last_s"] == pytest.approx(3e-3)
+    assert pre["last_s"] == pytest.approx(1e-3)
+
+
+def test_record_window_equal_fallback_without_baseline(tmp_path):
+    w = _watch(tmp_path, baseline=False)
+    w.record_window("engine.dense", {"decode_step": 1, "prefill_insert": 1},
+                    2.0)
+    assert w.measured("engine.dense/decode_step")["last_s"] == \
+        pytest.approx(1.0)
+    assert w.measured("engine.dense/prefill_insert")["last_s"] == \
+        pytest.approx(1.0)
+
+
+def test_table_statuses_and_shared_roofline(tmp_path):
+    w = _watch(tmp_path)
+    for _ in range(5):
+        w.record("engine.dense/decode_step", 3e-3)     # exactly predicted
+    w.record("serving.static/batch", 0.5)              # not in baseline
+    rows = {r["program"]: r for r in w.table()}
+    dec = rows["engine.dense/decode_step"]
+    assert dec["status"] == "ok"
+    assert dec["ratio"] == pytest.approx(1.0)
+    # measured MFU / tok/s come from the SAME helpers as the predictions
+    assert dec["measured_mfu"] == pytest.approx(
+        predicted_mfu(1e9, 3e-3, chip="v5p"))
+    assert dec["measured_tok_s"] == pytest.approx(
+        predicted_tokens_per_s(1000.0 * 3e-3, 3e-3))
+    assert rows["engine.dense/prefill_insert"]["status"] == "no-data"
+    assert rows["serving.static/batch"]["status"] == "no-baseline"
+    # now drift the decode program far outside the band
+    for _ in range(9):
+        w.record("engine.dense/decode_step", 9e-3)
+    rows = {r["program"]: r for r in w.table()}
+    assert rows["engine.dense/decode_step"]["status"] == "drift"
+    text = w.render_table()
+    assert "engine.dense/decode_step" in text and "status" in text
+
+
+# ------------------------------------------------------- drift sentinel
+def test_drift_typed_finding_and_exactly_one_budgeted_dump(
+        tmp_path, private_tracer):
+    clk = FakeClock()
+    w = _watch(tmp_path, clock=clk, drift_enabled=True, drift_min_samples=4,
+               drift_consecutive=2, drift_interval_s=1.0)
+    # sustained 2x slowdown on the decode program, opportunistic checks
+    # driven from the record path (the clock crosses the interval)
+    for _ in range(20):
+        clk.advance(1.5)
+        w.record("engine.dense/decode_step", 6e-3)
+    findings = w.drift_findings()
+    assert len(findings) == 1
+    err = findings[0]
+    assert isinstance(err, PerfDriftError)
+    assert err.program == "engine.dense/decode_step"
+    assert err.measured_s == pytest.approx(6e-3)
+    assert err.predicted_s == pytest.approx(3e-3)
+    assert err.tolerance == pytest.approx(0.05)
+    assert "perf drift" in str(err)
+    snap = w.snapshot()
+    assert snap["perf/drift_findings"] == 1
+    assert snap["perf/engine_dense/decode_step/drift"] == 1.0
+    # exactly ONE dump pair per drifted program, despite 20 more samples
+    dump_dir = private_tracer.config.dump_dir
+    perfdrift = [f for f in os.listdir(dump_dir)
+                 if f.startswith("perfdrift-perf_drift")]
+    assert len(perfdrift) == 1
+    with open(os.path.join(dump_dir, perfdrift[0])) as f:
+        doc = json.load(f)
+    assert doc["finding"]["program"] == "engine.dense/decode_step"
+    assert any(r["program"] == "engine.dense/decode_step"
+               for r in doc["table"])
+
+
+def test_drift_respects_exhausted_dump_budget(tmp_path):
+    prev_cfg = tracing.get_tracer().config
+    tracing.configure(TracingConfig(
+        dump_dir=str(tmp_path / "dumps"), max_dumps=0,
+    ))
+    try:
+        clk = FakeClock()
+        w = _watch(tmp_path, clock=clk, drift_enabled=True,
+                   drift_min_samples=2, drift_consecutive=1,
+                   drift_interval_s=0.0)
+        for _ in range(4):
+            clk.advance(1.0)
+            w.record("engine.dense/decode_step", 9e-3)
+        # the typed finding still lands; the dump is budget-suppressed
+        assert len(w.drift_findings()) == 1
+        dumps = os.listdir(str(tmp_path / "dumps")) \
+            if os.path.isdir(str(tmp_path / "dumps")) else []
+        assert [f for f in dumps if f.startswith("perfdrift")] == []
+    finally:
+        tracing.configure(prev_cfg)
+
+
+def test_drift_recovery_clears_strikes(tmp_path):
+    clk = FakeClock()
+    # a huge interval keeps the opportunistic record-path checks quiet so
+    # the test drives check_drift() explicitly
+    w = _watch(tmp_path, clock=clk, drift_enabled=True, drift_min_samples=4,
+               drift_consecutive=3, drift_interval_s=1e9)
+    for _ in range(6):
+        w.record("engine.dense/decode_step", 9e-3)
+    w.check_drift()  # strike 1
+    assert w.drift_findings() == []
+    for _ in range(64):  # flood the window back inside the band
+        w.record("engine.dense/decode_step", 3e-3)
+    w.check_drift()  # back in band: strikes reset
+    w.check_drift()
+    assert w.drift_findings() == []
+
+
+# ------------------------------------------------------------- exporter
+def test_prometheus_text_mapping():
+    text = prometheus_text({
+        "perf/engine_dense/decode_step/calls": 10,
+        "serving/queue_depth": 3.5,
+        "fleet/replica/r\"0\\x/health/alive": True,
+        "serving/mode": "continuous",          # non-numeric: skipped
+    })
+    lines = text.splitlines()
+    assert "accelerate_perf_engine_dense_decode_step_calls 10" in lines
+    assert "accelerate_serving_queue_depth 3.5" in lines
+    # replica id becomes an escaped label on one fleet-wide family
+    assert ('accelerate_fleet_replica_health_alive'
+            '{replica="r\\"0\\\\x"} 1') in lines
+    assert not any("mode" in ln for ln in lines)
+    assert text.endswith("\n")
+
+
+def _get(url, timeout=5.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, resp.read()
+
+
+def test_exporter_endpoints(tmp_path):
+    w = _watch(tmp_path)
+    w.record("engine.dense/decode_step", 3e-3)
+    exp = MetricsExporter(w.snapshot, port=0)
+    try:
+        base = f"http://127.0.0.1:{exp.port}"
+        status, body = _get(f"{base}/metrics")
+        assert status == 200
+        assert b"accelerate_perf_engine_dense_decode_step_calls 1" in body
+        status, body = _get(f"{base}/snapshot.json")
+        assert status == 200
+        snap = json.loads(body)
+        assert snap["perf/engine_dense/decode_step/calls"] == 1
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(f"{base}/nope")
+        assert ei.value.code == 404
+    finally:
+        exp.close()
+
+
+def test_exporter_scrape_error_is_500_not_fatal():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("boom")
+        return {"ok": 1}
+
+    exp = MetricsExporter(flaky, port=0)
+    try:
+        base = f"http://127.0.0.1:{exp.port}"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(f"{base}/metrics")
+        assert ei.value.code == 500
+        status, body = _get(f"{base}/metrics")  # server survived
+        assert status == 200 and b"accelerate_ok 1" in body
+    finally:
+        exp.close()
+
+
+def test_maybe_exporter_arming(monkeypatch):
+    monkeypatch.delenv(perfwatch.METRICS_PORT_ENV, raising=False)
+    assert perfwatch.maybe_exporter(dict) is None          # off by default
+    monkeypatch.setenv(perfwatch.METRICS_PORT_ENV, "not-a-port")
+    assert perfwatch.maybe_exporter(dict) is None
+    exp = perfwatch.maybe_exporter(
+        lambda: {"x": 1}, ObservabilityConfig(exporter_port=0),
+    )
+    assert exp is None  # port 0 in config means "not armed" too
+    # a real ephemeral bind through the config path
+    probe = MetricsExporter(lambda: {}, port=0)
+    free_port = probe.port
+    probe.close()
+    exp = perfwatch.maybe_exporter(
+        lambda: {"x": 1}, ObservabilityConfig(exporter_port=free_port),
+    )
+    assert exp is not None
+    try:
+        assert exp.port == free_port
+        # the same port again: bind race is logged, never fatal
+        assert perfwatch.maybe_exporter(
+            lambda: {}, ObservabilityConfig(exporter_port=free_port),
+        ) is None
+    finally:
+        exp.close()
+
+
+# -------------------------------------------------------------- SIGUSR2
+def test_sigusr2_dumps_snapshot_and_table(tmp_path, private_tracer):
+    if not hasattr(signal, "SIGUSR2"):
+        pytest.skip("no SIGUSR2 on this platform")
+    w = _watch(tmp_path)
+    w.record("engine.dense/decode_step", 3e-3)
+    prev = signal.getsignal(signal.SIGUSR2)
+    try:
+        assert perfwatch.install_signal_handlers(w) is True
+        os.kill(os.getpid(), signal.SIGUSR2)
+        dump_dir = private_tracer.config.dump_dir
+
+        def _dumped():
+            return os.path.isdir(dump_dir) and any(
+                f.startswith("metrics-sigusr2") for f in os.listdir(dump_dir)
+            )
+
+        assert wait_until(_dumped)
+        name = next(f for f in os.listdir(dump_dir)
+                    if f.startswith("metrics-sigusr2"))
+        with open(os.path.join(dump_dir, name)) as f:
+            doc = json.load(f)
+        assert "perf/engine_dense/decode_step/calls" in doc["snapshot"]
+        assert any(r["program"] == "engine.dense/decode_step"
+                   for r in doc["table"])
+    finally:
+        signal.signal(signal.SIGUSR2, prev)
+
+
+def test_install_signal_handlers_refuses_off_main_thread(tmp_path):
+    if not hasattr(signal, "SIGUSR2"):
+        pytest.skip("no SIGUSR2 on this platform")
+    w = _watch(tmp_path)
+    out = {}
+    t = threading.Thread(
+        target=lambda: out.update(ok=perfwatch.install_signal_handlers(w)))
+    t.start()
+    t.join(timeout=5)
+    assert out["ok"] is False
+
+
+# ---------------------------------------------------------- integration
+@pytest.fixture(scope="module")
+def tiny_model():
+    import jax.numpy as jnp
+
+    from accelerate_tpu.models.llama import LlamaConfig, create_llama
+
+    return create_llama(LlamaConfig.tiny(compute_dtype=jnp.float32), seed=0)
+
+
+_ENGINES: dict = {}
+
+
+def _get_engine(model, **kw):
+    """Per-shape engine cache (same trick as test_engine: each shape pays
+    its compiles once per module)."""
+    from accelerate_tpu.engine import ContinuousBatchingEngine
+
+    kw.setdefault("slots", 2)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("prompt_bucket", 16)
+    kw.setdefault("readback_lag", 1)
+    key = tuple(sorted(kw.items()))
+    eng = _ENGINES.get(key)
+    if eng is None:
+        eng = _ENGINES[key] = ContinuousBatchingEngine(model, **kw)
+    eng.reset()
+    return eng
+
+
+@pytest.fixture
+def fresh_default_watch():
+    """A pristine process-default watch for integration tests (components
+    call perfwatch.get_watch()); always restores a clean default after."""
+    watch = perfwatch.configure(ObservabilityConfig())
+    yield watch
+    perfwatch.configure(ObservabilityConfig())
+
+
+def _run_prompts(eng, prompts, budget=6):
+    for i, p in enumerate(prompts):  # waves of <= slots prompts
+        if eng.free_slots() == 0:
+            eng.drain()
+        eng.insert(p, max_new_tokens=budget, pad_token_id=0, tag=i)
+    eng.drain()
+
+
+def test_table_covers_engine_and_train_programs(
+        tiny_model, fresh_default_watch):
+    """The acceptance sweep: real dense/paged/spec engines plus one fused
+    train step land ≥8 of the 11 committed baseline programs on the
+    watch, and every landed row carries roofline-derived measured MFU."""
+    import jax
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.models.llama import llama_loss
+    from accelerate_tpu.parallelism_config import ParallelismConfig
+    from accelerate_tpu.state import (
+        AcceleratorState, GradientState, PartialState,
+    )
+
+    watch = fresh_default_watch
+    rng = np.random.default_rng(0)
+    plain = [rng.integers(1, 255, size=n).tolist() for n in (5, 9, 12)]
+    # repetitive prompts are the n-gram drafter's best case: they
+    # guarantee the spec engine actually runs verify_step
+    spec_prompts = [[7, 8, 9] * 5, [3, 4] * 7]
+
+    dense = _get_engine(tiny_model)
+    dense._perfwatch = watch  # cached engine captured an older default
+    _run_prompts(dense, plain)
+
+    paged = _get_engine(tiny_model, kv_cache="paged", block_size=8)
+    paged._perfwatch = watch
+    _run_prompts(paged, plain)
+
+    spec = _get_engine(tiny_model, spec="ngram", spec_draft_len=4)
+    spec._perfwatch = watch
+    _run_prompts(spec, spec_prompts, budget=10)
+
+    for fam in ("engine.dense", "engine.paged", "engine.spec"):
+        assert watch.measured(f"{fam}/decode_step").get("calls", 0) > 0, fam
+        assert watch.measured(f"{fam}/prefill_insert").get("calls", 0) > 0
+    assert watch.measured("engine.spec/verify_step").get("calls", 0) > 0
+
+    for s in (AcceleratorState, GradientState, PartialState):
+        s._reset_state()
+    try:
+        acc = Accelerator(
+            parallelism_config=ParallelismConfig(dp_replicate_size=8))
+        from accelerate_tpu.models.llama import LlamaConfig, create_llama
+
+        model = create_llama(LlamaConfig.tiny(num_hidden_layers=2), seed=0)
+        model, _opt = acc.prepare(model, optax.adamw(1e-3))
+        model.policy = None
+        step = acc.train_step(llama_loss, max_grad_norm=1.0)
+        batch = {"input_ids": np.asarray(
+            rng.integers(1, 32, size=(8, 32)), np.int32)}
+        for _ in range(3):
+            loss = step(batch)
+            jax.block_until_ready(loss)
+            acc.check_step_health(loss=np.asarray(loss))
+    finally:
+        for s in (AcceleratorState, GradientState, PartialState):
+            s._reset_state()
+    assert watch.measured(
+        "train.dp8/fused_train_step").get("calls", 0) > 0
+
+    rows = watch.table()
+    landed = [r for r in rows if r["status"] in ("ok", "drift")]
+    assert len(landed) >= 8, [
+        (r["program"], r["status"]) for r in rows]
+    for r in landed:
+        assert r["measured_mfu"] is not None, r["program"]
+        assert r["ratio"] is not None
+
+
+def test_idle_server_scrape_refreshes_engine_gauges(tiny_model):
+    """The stale-gauge fix: a scrape on an IDLE continuous server must
+    re-ingest engine stats (KV utilization, free slots) instead of
+    serving whatever the last worker tick left behind."""
+    from accelerate_tpu.serving import InferenceServer
+    from accelerate_tpu.utils.dataclasses import ServingConfig
+
+    eng = _get_engine(tiny_model)
+    cfg = ServingConfig(
+        mode="continuous", engine_slots=2, engine_max_len=64,
+        engine_prompt_bucket=16, engine_readback_lag=1,
+    )
+    with InferenceServer(tiny_model, cfg, engine=eng) as srv:
+        snap = srv.metrics_snapshot()  # no traffic at all
+        assert snap["serving/engine/free"] == 2
+        assert snap["serving/engine/live"] == 0
+        assert any(k.startswith("serving/engine/kv/") for k in snap)
+        assert any(k.startswith("perf/") or k == "perf/drift_active"
+                   for k in snap)
+
+
+def test_fleet_aggregates_replica_snapshots():
+    """The prober folds every replica's snapshot into the router registry
+    under fleet/replica/<id>/... and the Prometheus mapping turns the id
+    into a label on one fleet-wide metric family."""
+    from accelerate_tpu.fleet import FleetRouter
+    from accelerate_tpu.serving import InferenceServer
+    from accelerate_tpu.utils.dataclasses import FleetConfig, ServingConfig
+
+    def echo(model, ids, max_new_tokens=8, **kw):
+        new = np.repeat(ids[:, :1], max_new_tokens, axis=1)
+        return np.concatenate([ids, new], axis=1)
+
+    servers = {
+        f"r{i}": InferenceServer(
+            object(),
+            ServingConfig(max_batch_size=4, batch_window_s=0.001),
+            generate_fn=echo, replica_id=f"r{i}",
+        )
+        for i in range(2)
+    }
+    router = FleetRouter(servers, FleetConfig(probe_interval_s=0.02))
+    try:
+        assert wait_until(lambda: any(
+            k.startswith("fleet/replica/r0/") and k.endswith("queue_depth")
+            for k in router.metrics_snapshot()))
+        snap = router.metrics_snapshot()
+        assert any(k.startswith("fleet/replica/r1/") for k in snap)
+        text = prometheus_text(snap)
+        assert 'replica="r0"' in text and 'replica="r1"' in text
+        # one family, fleet-wide: the replica id is a label, not a name
+        assert "accelerate_fleet_replica_r0" not in text
+    finally:
+        router.close()

@@ -397,6 +397,80 @@ def test_g105_waiver_and_env_refs(tmp_path):
     assert check_fault_registry(root) == []
 
 
+# ---------------------------------------------------------------- G108
+def test_g108_bad_literal_name():
+    src = _src("""
+        def f(m):
+            m.bump("Requests-Total")
+    """)
+    found = lint_source(src, "accelerate_tpu/serving.py")
+    assert _codes(found) == ["G108"]
+
+
+def test_g108_bad_fstring_fragment():
+    src = _src("""
+        def f(m, rid):
+            m.gauge(f"replica/{rid}/Queue Depth", 1.0)
+    """)
+    found = lint_source(src, "accelerate_tpu/fleet.py")
+    assert _codes(found) == ["G108"]
+
+
+def test_g108_nonliteral_name():
+    src = _src("""
+        def f(m, name):
+            m.observe(name, 0.5)
+    """)
+    found = lint_source(src, "accelerate_tpu/serving.py")
+    assert _codes(found) == ["G108"]
+    assert "not a literal" in found[0].message
+
+
+def test_g108_good_names_quiet():
+    src = _src("""
+        def f(m, rid, n):
+            m.bump("requests_total", n)
+            m.gauge(f"replica/{rid}/queue_depth", 1.0)
+            m.observe(name="batch/t_s", value=0.5)
+    """)
+    assert lint_source(src, "accelerate_tpu/serving.py") == []
+
+
+def test_g108_forwarding_wrapper_exempt():
+    # A method *named* bump/gauge/observe is the registered-prefix path —
+    # its call sites are checked instead of the forwarded variable.
+    src = _src("""
+        class Registry:
+            def bump(self, name, n=1):
+                self._inner.bump(name, n)
+    """)
+    assert lint_source(src, "accelerate_tpu/tracing.py") == []
+
+
+def test_g108_literal_loop_variable():
+    good = _src("""
+        def f(m):
+            for name in ("queue_depth", "batch_size"):
+                m.gauge(name, 0.0)
+    """)
+    assert lint_source(good, "accelerate_tpu/serving.py") == []
+    bad = _src("""
+        def f(m):
+            for name in ("queue_depth", "Batch Size"):
+                m.gauge(name, 0.0)
+    """)
+    found = lint_source(bad, "accelerate_tpu/serving.py")
+    assert _codes(found) == ["G108"]
+
+
+def test_g108_waiver():
+    src = _src("""
+        def f(m, name):
+            m.bump(name)  # graft: metric-ok
+    """)
+    assert lint_source(src, "accelerate_tpu/serving.py") == []
+
+
 # ------------------------------------------------------- waivers + parsing
 def test_waiver_parsing_variants():
     text = "a\nx = 1  # graft: sync-ok, wait-ok\n# graft: G103-ok\ny = 2\n"
@@ -484,7 +558,7 @@ def test_finding_render():
     assert f.render() == "accelerate_tpu/engine.py:7: G101 boom"
     assert set(RULES) == {
         "G001", "G002", "G003", "G004", "G101", "G102", "G103", "G104", "G105",
-        "G107",
+        "G107", "G108",
         "G201", "G202", "G203", "G204", "G205",
         "G301", "G302", "G303", "G304", "G305", "G306",
         "G401", "G402", "G403", "G404", "G405",
