@@ -9,7 +9,7 @@
 PY := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu python
 PY_SLOW := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu RUN_SLOW=1 python
 
-.PHONY: test test_all test_core test_data test_parallel test_models test_cli test_big_modeling test-fault test-serving check-static check-sharding check-concurrency check-numerics check-perf check-all install-hooks bench bench-telemetry bench-serving bench-continuous bench-recovery bench-kv bench-spec bench-fleet bench-trace bench-obs
+.PHONY: test test_all test_core test_data test_parallel test_models test_cli test_big_modeling test-fault test-serving check-static check-sharding check-concurrency check-numerics check-perf check-all install-hooks bench bench-telemetry bench-serving bench-continuous bench-recovery bench-kv bench-spec bench-fleet bench-trace bench-obs bench-autoscale
 
 test: check-static
 	$(PY) -m pytest tests/ -q
@@ -175,6 +175,16 @@ bench-trace:
 # (docs/observability.md)
 bench-obs:
 	$(PY) benchmarks/obs_bench.py --gate
+
+# self-healing fleet gate: under the seeded ramp + flash-crowd + drain
+# replay the SLO controller must hold TTFT p99 within the SLO with
+# measurably fewer replica-seconds than static peak provisioning (both
+# reported), replace exactly one replica on an injected perf-drift
+# finding, and fail static (frozen actuation + exactly one typed
+# ControllerStaleError) on a blinded observe path — zero dropped futures
+# throughout (docs/control_plane.md)
+bench-autoscale:
+	$(PY) benchmarks/autoscale_bench.py --gate
 
 # elastic-recovery gate: MTTR per restore path (local / replica / elastic
 # reshard, restart-to-resumed wall clock) + consensus/replication must stay

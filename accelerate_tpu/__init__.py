@@ -72,6 +72,9 @@ _LAZY = {
     "FleetRouter": ("fleet", "FleetRouter"),
     "FleetMetrics": ("fleet", "FleetMetrics"),
     "FleetConfig": ("utils.dataclasses", "FleetConfig"),
+    "SLOController": ("controller", "SLOController"),
+    "ControllerConfig": ("utils.dataclasses", "ControllerConfig"),
+    "ControllerStaleError": ("utils.fault", "ControllerStaleError"),
     "FleetMembership": ("elastic", "FleetMembership"),
     "RemotePrefill": ("engine", "RemotePrefill"),
     "BarrierTimeoutError": ("utils.fault", "BarrierTimeoutError"),

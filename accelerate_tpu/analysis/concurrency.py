@@ -95,6 +95,7 @@ AUDITED_MODULES = (
     "state.py",
     "data_loader.py",
     "tracing.py",
+    "controller.py",
 )
 
 # Modules where G305 applies: the Future-resolution discipline modules.

@@ -1,0 +1,690 @@
+"""Closed-loop SLO control plane over the fleet observatory.
+
+PRs 11–15 made the serving stack fully observable — roofline predictions
+committed per program, one-trace-id flight recording, and a fleet-wide
+measured-vs-predicted metrics snapshot that is one scrape — but nothing
+*acted* on any of it: ``PerfDriftError`` paged a human, flash crowds shed
+load until someone retuned ``engine_slots`` by hand, and a dead replica's
+capacity stayed gone until an operator called ``scale_up``. This module
+closes the loop (ROADMAP item 6; docs/control_plane.md):
+
+:class:`SLOController` is a control thread that each ``interval_s``
+
+1. **observes** — re-ingests every replica's ``engine.stats()`` KV/spec
+   gauges (never a stale picture off an idle exporter) and reads the
+   fleet-wide :class:`~accelerate_tpu.tracing.MetricsRegistry` snapshot:
+   TTFT/latency percentiles, queue occupancy, breaker states, retry
+   budget, and perfwatch's measured-vs-predicted residuals;
+2. **decides** — collapses the signals into one scalar *pressure* (worst
+   measured/objective ratio) and compares it against a hysteresis band:
+   above ``escalate_threshold`` escalate one rung, below
+   ``relax_threshold`` relax one rung, inside the band do NOTHING (the
+   anti-flapping dead band);
+3. **actuates** — walks a fixed escalation ladder of knobs that all exist
+   without recompile, cheapest shed first:
+
+   ========  ==========================================================
+   rung      knob
+   ========  ==========================================================
+   spec      halve the speculative draft window
+             (``ServingConfig.spec_draft_len`` + an immediate
+             ``engine.set_spec_draft_limit`` — operand clamp, no
+             recompile)
+   degrade   tighten the degradation ladder (halve
+             ``degrade_queue_fraction`` / ``degrade_hard_fraction`` /
+             ``degraded_max_new_tokens``) so budget clamping starts
+             earlier and bites harder
+   admission halve the bounded admission queue (``max_queue``) —
+             convert queueing latency into typed backpressure with
+             ``retry_after_s`` hints
+   hedge     disable hedged dispatch
+             (``FleetConfig.hedge_deadline_fraction = None``) — shed
+             the optional duplicated work
+   scale     add a replica via ``FleetRouter.scale_up`` +
+             ``replica_factory`` (repeatable up to ``max_replicas``);
+             relaxing drains controller-added replicas back out with
+             zero-drop ``scale_down``
+   ========  ==========================================================
+
+The controller must be MORE robust than what it controls:
+
+* **hysteresis + per-knob cooldowns** — the dead band absorbs
+  oscillating load; a knob that just moved cannot move again for
+  ``knob_cooldown_s`` (``scale_cooldown_s`` for replica changes);
+* **token-bucket rate limiting** — every actuation takes a token from a
+  bounded bucket, so a buggy signal cannot churn the fleet faster than
+  ``actuation_budget_refill_per_s``;
+* **fail-static** — stale (prober wedged past ``stale_after_s``) or
+  partial (replica coverage below ``min_coverage``) telemetry freezes
+  actuation and records exactly ONE typed
+  :class:`~accelerate_tpu.utils.fault.ControllerStaleError` finding per
+  episode: a controller acting on garbage is strictly worse than no
+  controller at all;
+* **drift is an input, not a page** — perfwatch
+  :class:`~accelerate_tpu.utils.fault.PerfDriftError` findings are
+  consumed and answered with a replica probe/replace (scale-up a fresh
+  replica, zero-drop drain the drifted one);
+* **auditable** — every actuation (and freeze) is a ``fleet.control``
+  trace span plus ``controller/...`` metrics merged into the router's
+  snapshot, so one flight dump carries the decisions next to the
+  telemetry that drove them;
+* **dry_run** — compute and log intended actions without touching the
+  fleet.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from . import perfwatch, tracing
+from .fleet import _TokenBucket
+from .logging import get_logger
+from .serving import _CircuitBreaker
+from .tracing import MetricsRegistry
+from .utils.dataclasses import ControllerConfig
+from .utils.fault import ControllerStaleError, PerfDriftError, fault_point
+
+logger = get_logger(__name__)
+
+__all__ = ["SLOController", "ControlSignals"]
+
+_FINDINGS_CAP = 32
+
+# escalation order of the in-place rungs; "scale" rides after them and is
+# the only repeatable rung (one replica per actuation)
+_RUNG_ORDER = ("spec", "degrade", "admission", "hedge")
+
+
+class ControlSignals:
+    """One observation tick's distilled control inputs (kept as a tiny
+    attribute bag so tests and spans can read exactly what the decision
+    saw)."""
+
+    def __init__(self, *, pressure: float, queue_fraction: float,
+                 ttft_p99_s: Optional[float], latency_p99_s: Optional[float],
+                 breaker_open_fraction: float, kv_utilization: float,
+                 replicas: int):
+        self.pressure = pressure
+        self.queue_fraction = queue_fraction
+        self.ttft_p99_s = ttft_p99_s
+        self.latency_p99_s = latency_p99_s
+        self.breaker_open_fraction = breaker_open_fraction
+        self.kv_utilization = kv_utilization
+        self.replicas = replicas
+
+
+class SLOController:
+    """Closed-loop SLO controller over a
+    :class:`~accelerate_tpu.fleet.FleetRouter` (module docstring;
+    docs/control_plane.md).
+
+    Parameters
+    ----------
+    router:
+        The fleet router to observe and actuate. Only its public surface
+        is used (``refresh_replica_metrics`` / ``metrics_snapshot`` /
+        ``servers`` / ``replica_ids`` / ``scale_up`` / ``scale_down`` /
+        ``config``), so tests can substitute a narrow fake.
+    config:
+        :class:`~accelerate_tpu.utils.dataclasses.ControllerConfig`.
+    watch:
+        Perfwatch instance whose drift findings are consumed (``None`` =
+        the process default, :func:`accelerate_tpu.perfwatch.get_watch`).
+    clock:
+        Monotonic time source (injectable for deterministic tests).
+
+    ``start()`` launches the control thread; ``tick()`` runs one
+    observe→decide→actuate cycle synchronously (what the thread calls,
+    and what deterministic tests drive directly).
+    """
+
+    def __init__(
+        self,
+        router,
+        config: Optional[ControllerConfig] = None,
+        *,
+        watch=None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.router = router
+        self.config = config or ControllerConfig()
+        self._watch = watch
+        self._clock = clock
+        self._lock = threading.Lock()  # findings + ladder bookkeeping only
+        self.metrics = MetricsRegistry(
+            prefix="controller/",
+            counters=(
+                "ticks",
+                "tick_errors",
+                "actuations",
+                "escalations",
+                "relaxations",
+                "stale_findings",
+                "stale_ticks",
+                "recoveries",
+                "drift_replacements",
+                "actuation_denied_budget",
+                "actuation_denied_cooldown",
+                "actuation_errors",
+                "dry_run_actions",
+            ),
+            clock=clock,
+        )
+        for name in ("pressure", "rung", "frozen", "replicas",
+                     "queue_fraction", "actuation_budget"):
+            self.metrics.gauge(name, 0.0)
+        self._bucket = _TokenBucket(
+            self.config.actuation_budget_capacity,
+            self.config.actuation_budget_refill_per_s,
+            clock,
+        )
+        self._frozen = False
+        self._stale_findings: List[ControllerStaleError] = []
+        self._first_tick_s: Optional[float] = None
+        self._sample_counts: Dict[str, float] = {}  # latency-stream counts
+        self._last_act: Dict[str, float] = {}
+        self._engaged: List[str] = []  # in-place rungs, in engage order
+        self._saved: Dict[str, dict] = {}  # rung -> restore state
+        self._added: List[str] = []  # controller-launched replica ids
+        self._seq = 0  # unique suffix for controller-launched replicas
+        self._trace_id = (
+            tracing.new_trace_id() if tracing.get_tracer().enabled else None
+        )
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # publish controller/... into the router's one-scrape snapshot
+        hook = getattr(router, "extra_metrics", None)
+        if hook is not None:
+            hook.append(self.metrics.snapshot)
+
+    # ------------------------------------------------------------- lifecycle
+    def start(self) -> "SLOController":
+        """Launch the control thread (idempotent)."""
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="slo-controller", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def close(self) -> None:
+        """Stop the control thread and detach from the router's snapshot.
+        Knobs are left where the ladder put them — relaxation is a policy
+        decision for whoever now owns the fleet, not a side effect of
+        shutting the controller down."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        hook = getattr(self.router, "extra_metrics", None)
+        if hook is not None and self.metrics.snapshot in hook:
+            hook.remove(self.metrics.snapshot)
+
+    def __enter__(self) -> "SLOController":
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.config.interval_s):
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 — the control loop must outlive one bad tick
+                self.metrics.bump("tick_errors")
+                logger.exception("controller tick failed; loop continues")
+
+    # ---------------------------------------------------------- observation
+    def stale_findings(self) -> List[ControllerStaleError]:
+        """Accumulated fail-static findings (bounded), oldest first —
+        exactly one per stale episode, however long the episode lasts."""
+        with self._lock:
+            return list(self._stale_findings)
+
+    @property
+    def frozen(self) -> bool:
+        """Whether fail-static currently freezes actuation."""
+        return self._frozen
+
+    def engaged_rungs(self) -> List[str]:
+        """Currently engaged in-place rungs, oldest first (controller-
+        added replicas are reported via ``controller/replicas_added``)."""
+        with self._lock:
+            return list(self._engaged)
+
+    def tick(self) -> None:
+        """One observe → decide → actuate cycle (thread-safe with respect
+        to the fleet; NOT meant to be called concurrently with itself)."""
+        cfg = self.config
+        now = self._clock()
+        if self._first_tick_s is None:
+            self._first_tick_s = now  # graft: race-ok — single ticker: the control thread OR a test driving tick() manually, never both
+        self.metrics.bump("ticks")
+        try:
+            fault_point("controller_observe")
+            # satellite fix: the controller's own tick refreshes every
+            # replica's engine.stats() KV/spec gauges — a scale decision
+            # never reads whatever the exporter happened to scrape last
+            fresh = self.router.refresh_replica_metrics()
+            snap = self.router.metrics_snapshot()
+            stale = self._staleness(snap, fresh, now)
+        except Exception as exc:  # noqa: BLE001 — unreadable telemetry = fail static
+            stale = ControllerStaleError(
+                f"observation failed: {type(exc).__name__}: {exc}"
+            )
+        if stale is not None:
+            self._freeze(stale)
+            return
+        self._thaw()
+        sig = self._signals(snap, fresh)
+        self.metrics.gauge("pressure", sig.pressure)
+        self.metrics.gauge("queue_fraction", sig.queue_fraction)
+        self.metrics.gauge("replicas", sig.replicas)
+        self.metrics.gauge("actuation_budget", self._bucket.available())
+        watch = self._watch if self._watch is not None else perfwatch.get_watch()
+        if cfg.replace_on_drift:
+            findings = watch.consume_drift_findings()
+            if findings:
+                self._replace_drifted(findings, fresh, now)
+        if sig.pressure >= cfg.escalate_threshold:
+            self._escalate(sig, now)
+        elif sig.pressure <= cfg.relax_threshold:
+            self._relax(sig, now)
+        # anything inside the band is the dead band: zero actuations
+
+    def _staleness(
+        self, snap: dict, fresh: Dict[str, dict], now: float
+    ) -> Optional[ControllerStaleError]:
+        """Fail-static rule: stale (prober wedged) or partial (replicas
+        unreadable) telemetry means the snapshot cannot be trusted."""
+        replicas = list(self.router.replica_ids())
+        if not replicas:
+            return None  # nothing to control, nothing to act on
+        coverage = len(fresh) / len(replicas)
+        if coverage < self.config.min_coverage:
+            return ControllerStaleError(
+                "partial telemetry — replicas unreadable",
+                coverage=coverage,
+            )
+        probed = snap.get("fleet/last_probe_s")
+        if probed is None:
+            # startup grace: the prober simply has not finished its first
+            # pass yet — measure the wait from our own first tick instead
+            # of paging a brand-new controller into fail-static
+            age = max(0.0, now - (self._first_tick_s or now))
+        else:
+            age = max(0.0, now - probed)
+        if age > self.config.stale_after_s:
+            return ControllerStaleError(
+                "stale telemetry — prober has not completed a pass",
+                age_s=None if probed is None else age,
+            )
+        return None
+
+    def _freeze(self, finding: ControllerStaleError) -> None:
+        self.metrics.bump("stale_ticks")
+        self.metrics.gauge("frozen", 1.0)
+        if self._frozen:
+            return  # one finding per episode, no matter how long it lasts
+        self._frozen = True  # graft: race-ok — single ticker: only tick() writes, one caller by contract
+        with self._lock:
+            if len(self._stale_findings) < _FINDINGS_CAP:
+                self._stale_findings.append(finding)
+        self.metrics.bump("stale_findings")
+        logger.error(str(finding))
+        with tracing.span(
+            "fleet.control", trace_id=self._trace_id, action="freeze",
+            reason=finding.reason,
+        ):
+            pass
+
+    def _thaw(self) -> None:
+        self.metrics.gauge("frozen", 0.0)
+        if not self._frozen:
+            return
+        self._frozen = False  # graft: race-ok — single ticker: only tick() writes, one caller by contract
+        self.metrics.bump("recoveries")
+        logger.warning("controller telemetry fresh again; actuation resumed")
+        with tracing.span(
+            "fleet.control", trace_id=self._trace_id, action="thaw",
+        ):
+            pass
+
+    def _signals(self, snap: dict, fresh: Dict[str, dict]) -> ControlSignals:
+        """Collapse the snapshot into the pressure scalar: the WORST
+        measured/objective ratio across queue occupancy, TTFT p99,
+        latency p99 and fleet-wide breaker state. KV utilization and spec
+        acceptance are observed (gauged, and consumed by operators via
+        the same scrape) but deliberately not pressure terms: a full
+        dense arena is the steady state of a well-packed fleet, not an
+        SLO violation."""
+        cfg = self.config
+        queue_fraction = 0.0
+        open_breakers = 0
+        for health in fresh.values():
+            depth = health.get("queue_depth", 0)
+            free = health.get("queue_free", 0)
+            cap = depth + free
+            if cap > 0:
+                queue_fraction = max(queue_fraction, depth / cap)
+            if health.get("breaker_state") == _CircuitBreaker.OPEN:
+                open_breakers += 1
+        breaker_frac = open_breakers / max(1, len(fresh))
+        ttft = self._worst(snap, "/serving/ttft_p99")
+        latency = self._worst(snap, "/serving/latency_p99")
+        kv = self._worst(snap, "/serving/kv_utilization") or 0.0
+        # Latency percentiles are sliding-window memories: with no new
+        # completions since the last tick they describe traffic that is
+        # GONE, and treating them as live pressure would pin the fleet at
+        # peak forever. Only count them while their streams are moving.
+        ttft_live = self._stream_active(snap, "/serving/ttft_count")
+        latency_live = self._stream_active(snap, "/serving/latency_count")
+        terms = [queue_fraction / cfg.target_queue_fraction]
+        if cfg.ttft_slo_s is not None and ttft is not None and ttft_live:
+            terms.append(ttft / cfg.ttft_slo_s)
+        if (cfg.latency_slo_s is not None and latency is not None
+                and latency_live):
+            terms.append(latency / cfg.latency_slo_s)
+        # half the fleet's breakers open is unambiguous overload/failure
+        terms.append(2.0 * breaker_frac)
+        return ControlSignals(
+            pressure=max(terms),
+            queue_fraction=queue_fraction,
+            ttft_p99_s=ttft,
+            latency_p99_s=latency,
+            breaker_open_fraction=breaker_frac,
+            kv_utilization=kv,
+            replicas=len(self.router.replica_ids()),
+        )
+
+    @staticmethod
+    def _worst(snap: dict, suffix: str) -> Optional[float]:
+        vals = [
+            v for k, v in snap.items()
+            if k.endswith(suffix) and isinstance(v, (int, float))
+        ]
+        return max(vals) if vals else None
+
+    def _stream_active(self, snap: dict, suffix: str) -> bool:
+        """True when the event stream behind a sliding-window percentile
+        gained samples since the previous tick (first sighting counts as
+        idle: there is no delta to judge yet)."""
+        total = sum(
+            v for k, v in snap.items()
+            if k.endswith(suffix) and isinstance(v, (int, float))
+        )
+        prev = self._sample_counts.get(suffix)
+        self._sample_counts[suffix] = total
+        return prev is not None and total > prev
+
+    # ------------------------------------------------------------- actuation
+    def _actuate(
+        self, knob: str, fn: Callable[[], None], now: float, **attrs
+    ) -> bool:
+        """The one gate every fleet mutation passes through: per-knob
+        cooldown, then dry-run short-circuit, then the token bucket, then
+        the action itself inside a ``fleet.control`` span. Returns True
+        only when the fleet actually changed."""
+        cooldown = (
+            self.config.scale_cooldown_s
+            if knob in ("scale", "replace")
+            else self.config.knob_cooldown_s
+        )
+        if now - self._last_act.get(knob, float("-inf")) < cooldown:
+            self.metrics.bump("actuation_denied_cooldown")
+            return False
+        if self.config.dry_run:
+            self._last_act[knob] = now
+            self.metrics.bump("dry_run_actions")
+            logger.warning(
+                "controller dry_run: would actuate %s (%s)", knob, attrs
+            )
+            with tracing.span(
+                "fleet.control", trace_id=self._trace_id, knob=knob,
+                dry_run=True, **attrs,
+            ):
+                pass
+            return False
+        if not self._bucket.try_acquire():
+            self.metrics.bump("actuation_denied_budget")
+            return False
+        self._last_act[knob] = now
+        try:
+            with tracing.span(
+                "fleet.control", trace_id=self._trace_id, knob=knob,
+                dry_run=False, **attrs,
+            ):
+                fn()
+        except Exception as exc:  # noqa: BLE001 — a failed actuation must not kill the loop
+            self.metrics.bump("actuation_errors")
+            logger.warning(
+                "controller actuation %s failed: %s: %s",
+                knob, type(exc).__name__, exc,
+            )
+            return False
+        self.metrics.bump("actuations")
+        return True
+
+    def _can_scale(self) -> bool:
+        return getattr(self.router, "can_scale", False)
+
+    def _next_rung(self) -> Optional[str]:
+        servers = self.router.servers()
+        with self._lock:
+            engaged = set(self._engaged)
+        for rung in _RUNG_ORDER:
+            if rung in engaged:
+                continue
+            if self._applicable(rung, servers):
+                return rung
+        if (
+            self._can_scale()
+            and len(self.router.replica_ids()) < self.config.max_replicas
+        ):
+            return "scale"
+        return None
+
+    def _applicable(self, rung: str, servers: dict) -> bool:
+        if rung == "spec":
+            return any(
+                getattr(getattr(s, "engine", None), "spec", None) is not None
+                and s.config.spec_draft_len > 1
+                for s in servers.values()
+            )
+        if rung == "degrade":
+            return bool(servers)
+        if rung == "admission":
+            return any(s.config.max_queue > 1 for s in servers.values())
+        if rung == "hedge":
+            return self.router.config.hedge_deadline_fraction is not None
+        return False
+
+    def _escalate(self, sig: ControlSignals, now: float) -> None:
+        rung = self._next_rung()
+        if rung is None:
+            return  # fully escalated; nothing left to shed or add
+        if rung == "scale":
+            acted = self._actuate(
+                "scale", self._scale_up_action(), now,
+                action="scale_up", pressure=round(sig.pressure, 3),
+            )
+        else:
+            acted = self._actuate(
+                rung, lambda r=rung: self._engage(r), now,
+                action="engage", pressure=round(sig.pressure, 3),
+            )
+            if acted:
+                with self._lock:
+                    self._engaged.append(rung)
+        if acted:
+            self.metrics.bump("escalations")
+            self.metrics.gauge(
+                "rung", len(self._engaged) + len(self._added)
+            )
+
+    def _relax(self, sig: ControlSignals, now: float) -> None:
+        if self._added:
+            if len(self.router.replica_ids()) <= self.config.min_replicas:
+                return
+            acted = self._actuate(
+                "scale", self._scale_down_action(), now,
+                action="scale_down", pressure=round(sig.pressure, 3),
+            )
+        else:
+            with self._lock:
+                rung = self._engaged[-1] if self._engaged else None
+            if rung is None:
+                return  # at baseline
+            acted = self._actuate(
+                rung, lambda r=rung: self._disengage(r), now,
+                action="disengage", pressure=round(sig.pressure, 3),
+            )
+            if acted:
+                with self._lock:
+                    if self._engaged and self._engaged[-1] == rung:
+                        self._engaged.pop()
+        if acted:
+            self.metrics.bump("relaxations")
+            self.metrics.gauge(
+                "rung", len(self._engaged) + len(self._added)
+            )
+
+    # -- in-place rungs
+    def _engage(self, rung: str) -> None:
+        servers = self.router.servers()
+        saved: dict = {}
+        if rung == "spec":
+            for rid, srv in servers.items():
+                eng = getattr(srv, "engine", None)
+                if eng is None or getattr(eng, "spec", None) is None:
+                    continue
+                orig = srv.config.spec_draft_len
+                if orig <= 1:
+                    continue
+                saved[rid] = orig
+                srv.config.spec_draft_len = max(1, orig // 2)
+                eng.set_spec_draft_limit(srv.config.spec_draft_len)
+        elif rung == "degrade":
+            for rid, srv in servers.items():
+                c = srv.config
+                saved[rid] = (
+                    c.degrade_queue_fraction,
+                    c.degrade_hard_fraction,
+                    c.degraded_max_new_tokens,
+                )
+                c.degrade_queue_fraction = max(0.05, c.degrade_queue_fraction * 0.5)
+                c.degrade_hard_fraction = max(
+                    c.degrade_queue_fraction, c.degrade_hard_fraction * 0.5
+                )
+                c.degraded_max_new_tokens = max(1, c.degraded_max_new_tokens // 2)
+        elif rung == "admission":
+            for rid, srv in servers.items():
+                saved[rid] = srv.config.max_queue
+                srv.config.max_queue = max(1, srv.config.max_queue // 2)
+        elif rung == "hedge":
+            saved["hedge_deadline_fraction"] = (
+                self.router.config.hedge_deadline_fraction
+            )
+            self.router.config.hedge_deadline_fraction = None
+        with self._lock:
+            self._saved[rung] = saved
+
+    def _disengage(self, rung: str) -> None:
+        with self._lock:
+            saved = self._saved.pop(rung, {})
+        if rung == "hedge":
+            self.router.config.hedge_deadline_fraction = saved.get(
+                "hedge_deadline_fraction"
+            )
+            return
+        servers = self.router.servers()
+        for rid, orig in saved.items():
+            srv = servers.get(rid)
+            if srv is None:
+                continue  # the replica left the fleet while the rung held
+            if rung == "spec":
+                srv.config.spec_draft_len = orig
+                eng = getattr(srv, "engine", None)
+                if eng is not None:
+                    eng.set_spec_draft_limit(orig)
+            elif rung == "degrade":
+                (
+                    srv.config.degrade_queue_fraction,
+                    srv.config.degrade_hard_fraction,
+                    srv.config.degraded_max_new_tokens,
+                ) = orig
+            elif rung == "admission":
+                srv.config.max_queue = orig
+
+    # -- replica count
+    def _scale_up_action(self) -> Callable[[], None]:
+        def act() -> None:
+            self._seq += 1  # graft: race-ok — single ticker: actuations only run inside tick(), one caller by contract
+            rid = f"ctl-{self._seq}"
+            self.router.scale_up(rid)
+            self._added.append(rid)
+            logger.warning("controller scaled up replica %s", rid)
+
+        return act
+
+    def _scale_down_action(self) -> Callable[[], None]:
+        def act() -> None:
+            rid = self._added.pop()
+            try:
+                self.router.scale_down(
+                    rid, timeout=self.config.replace_drain_timeout_s
+                )
+            except Exception:
+                self._added.append(rid)
+                raise
+            logger.warning("controller scaled down replica %s", rid)
+
+        return act
+
+    def _replace_drifted(
+        self, findings: List[PerfDriftError], fresh: Dict[str, dict],
+        now: float,
+    ) -> None:
+        """Drift is an input, not a page: answer a perf-drift finding by
+        replacing the slowest replica — scale a fresh one up first, then
+        zero-drop drain the drifted one (its queued work fails over)."""
+        if not self._can_scale() or not fresh:
+            logger.warning(
+                "perf drift finding(s) received (%s) but the fleet cannot "
+                "replace replicas (no replica_factory)",
+                ", ".join(f.program for f in findings),
+            )
+            return
+        victim = max(
+            fresh, key=lambda rid: fresh[rid].get("batch_ewma_s", 0.0)
+        )
+
+        def act() -> None:
+            self._seq += 1  # graft: race-ok — single ticker: actuations only run inside tick(), one caller by contract
+            rid = f"ctl-{self._seq}"
+            self.router.scale_up(rid)
+            try:
+                self.router.scale_down(
+                    victim, timeout=self.config.replace_drain_timeout_s
+                )
+            finally:
+                if victim in self._added:
+                    # a surge replica was replaced: the fresh one inherits
+                    # its surge bookkeeping (it will drain on relax); a
+                    # baseline replica's replacement stays baseline
+                    self._added.remove(victim)
+                    self._added.append(rid)
+            logger.warning(
+                "controller replaced drifted replica %s with %s "
+                "(programs: %s)",
+                victim, rid, ", ".join(f.program for f in findings),
+            )
+
+        if self._actuate(
+            "replace", act, now, action="replace", victim=victim,
+            programs=",".join(f.program for f in findings),
+        ):
+            self.metrics.bump("drift_replacements")

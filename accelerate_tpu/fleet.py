@@ -190,6 +190,10 @@ class ReplicaHandle:
     last_respawn_s: float = float("-inf")
     completed: int = 0
     failed: int = 0
+    # the replica's own ``retry_after_s`` hint (ServerOverloaded /
+    # CircuitOpenError): not a placement candidate until this clock time,
+    # while any alternative exists — the replica told us when to come back
+    backoff_until_s: float = 0.0
 
 
 @dataclass
@@ -312,6 +316,10 @@ class FleetRouter:
         for replica_id, server in items:
             self.add_replica(replica_id, server)
         self._stop = threading.Event()
+        # extra flat-dict sources merged into metrics_snapshot() — the SLO
+        # controller attaches its controller/... registry here so one
+        # scrape (and one flight dump) carries decisions + telemetry
+        self.extra_metrics: list = []
         self._prefill_q: "queue.Queue" = queue.Queue()
         self._prefill_threads: list = []
         if self.config.disaggregate_prefill:
@@ -369,6 +377,13 @@ class FleetRouter:
             replica_id,
             {"mode": server.config.mode, "generation": handle.generation},
         )
+
+    @property
+    def can_scale(self) -> bool:
+        """Whether replica-count actuations (``scale_up``, the SLO
+        controller's surge/replace moves) are possible — i.e. a
+        ``replica_factory`` was provided."""
+        return self._replica_factory is not None
 
     def scale_up(self, replica_id: str) -> InferenceServer:
         """Launch a replica via ``replica_factory`` and register it."""
@@ -511,10 +526,16 @@ class FleetRouter:
     def _candidates(self, exclude=frozenset()) -> list:
         """Routable replicas (with their health samples): not leaving, not
         draining, worker alive, router breaker not OPEN, replica's own
-        breaker not OPEN, not in ``exclude``."""
+        breaker not OPEN, not in ``exclude``, not inside a
+        ``retry_after_s`` backoff window it asked for. A replica sitting
+        out its hinted backoff is preferred over rejecting outright: when
+        honoring every hint would leave NO candidate, the backed-off set
+        is returned instead (an overloaded replica beats
+        NoHealthyReplicaError)."""
+        now = self._clock()
         with self._lock:
             handles = list(self._handles.values())
-        out = []
+        out, backed_off = [], []
         for h in handles:
             if h.leaving or h.replica_id in exclude:
                 continue
@@ -528,8 +549,11 @@ class FleetRouter:
                 continue
             if hh["breaker_state"] == _CircuitBreaker.OPEN:
                 continue
+            if h.backoff_until_s > now:
+                backed_off.append((h, hh))
+                continue
             out.append((h, hh))
-        return out
+        return out or backed_off
 
     def _score(self, handle: ReplicaHandle, health: dict) -> float:
         """Estimated completion cost: outstanding work × recent batch-time
@@ -575,6 +599,7 @@ class FleetRouter:
             try:
                 self._submit_to(handle, freq)
             except ServingError as exc:
+                self._note_backoff(handle, exc)
                 last_exc = exc
                 continue
             if i == 0:
@@ -583,6 +608,18 @@ class FleetRouter:
         raise last_exc if last_exc is not None else NoHealthyReplicaError(
             "every routable replica refused admission"
         )
+
+    def _note_backoff(self, handle: ReplicaHandle, exc: BaseException) -> None:
+        """Honor a replica's ``retry_after_s`` hint: keep it out of
+        placement until the clock time it named (instead of the fixed
+        jittered guessing a hint-less error falls back to). A zero hint
+        (draining — go elsewhere now) clears any earlier window."""
+        hint = getattr(exc, "retry_after_s", None)
+        if hint is None:
+            return
+        until = self._clock() + max(0.0, hint)
+        with self._lock:
+            handle.backoff_until_s = until if hint > 0 else 0.0
 
     def _remaining(self, freq: _FleetRequest) -> Optional[float]:
         if freq.deadline is None:
@@ -694,6 +731,7 @@ class FleetRouter:
         survivor, under the per-request cap and — for unplanned failures —
         the fleet-wide token bucket. Planned drains are budget-exempt so
         scale-down redistribution can never be starved by outage retries."""
+        self._note_backoff(handle, exc)
         if isinstance(exc, ServingError):
             failed_on = exc.replica_id or handle.replica_id
             if not isinstance(exc, (ServerDrainingError, RequestDeadlineExceeded)):
@@ -893,6 +931,9 @@ class FleetRouter:
                         self.metrics.registry.ingest(
                             snap_fn(), prefix=f"replica/{rid}"
                         )
+                    self.metrics.gauge(
+                        f"replica/{rid}/probed_at_s", self._clock()
+                    )
                 except Exception:  # noqa: BLE001 — an unprobeable replica is dead
                     dead = True
                 if dead:
@@ -900,6 +941,10 @@ class FleetRouter:
                     handle.breaker.record_failure()
                     if self.config.auto_respawn and self._replica_factory:
                         self._respawn(handle)
+            # freshness stamp the SLO controller's fail-static rule reads:
+            # a wedged prober leaves this gauge stale and the controller
+            # freezes instead of acting on a frozen picture of the fleet
+            self.metrics.gauge("last_probe_s", self._clock())
             self.metrics.gauge("retry_budget", self._budget.available())
             with self._lock:
                 total = len(self._handles)
@@ -949,13 +994,61 @@ class FleetRouter:
         )
 
     # --------------------------------------------------------------- stats
+    def servers(self) -> Dict[str, InferenceServer]:
+        """Live ``{replica_id: server}`` view (excluding replicas mid
+        scale-down) — the SLO controller actuates in-place knobs (spec
+        clamp, degradation thresholds, admission quotas) through this."""
+        with self._lock:
+            return {
+                rid: h.server
+                for rid, h in self._handles.items()
+                if not h.leaving
+            }
+
+    def refresh_replica_metrics(self) -> Dict[str, dict]:
+        """Re-ingest every live replica's health + full metrics snapshot
+        (which itself re-reads ``engine.stats()``, so KV utilization and
+        spec acceptance are CURRENT, not the exporter's last scrape) into
+        the fleet registry, exactly as one prober pass would. Called by
+        the SLO controller at each observation tick so a scale decision
+        never reads a stale KV picture off an idle exporter. Returns
+        ``{replica_id: health}`` for the replicas that answered —
+        a missing replica is the caller's partial-telemetry signal."""
+        with self._lock:
+            handles = [h for h in self._handles.values() if not h.leaving]
+        out: Dict[str, dict] = {}
+        for h in handles:
+            try:
+                health = h.server.health()
+                rid = h.replica_id
+                self.metrics.registry.ingest(
+                    health, prefix=f"replica/{rid}/health"
+                )
+                snap_fn = getattr(h.server, "metrics_snapshot", None)
+                if snap_fn is not None:
+                    self.metrics.registry.ingest(
+                        snap_fn(), prefix=f"replica/{rid}"
+                    )
+                out[rid] = health
+            except Exception:  # noqa: BLE001 — unreadable replica = not covered
+                continue
+        return out
+
     def metrics_snapshot(self) -> dict:
         """The fleet-wide flat metrics dict the exporter serves: router
         counters/gauges/percentiles, every replica's aggregated snapshot
-        (``fleet/replica/<id>/...``, refreshed by the prober) and this
-        process's perf observatory (``perf/<program>/...``)."""
+        (``fleet/replica/<id>/...``, refreshed by the prober), this
+        process's perf observatory (``perf/<program>/...``), and any
+        attached extra sources (the SLO controller publishes its
+        ``controller/...`` registry here, so ONE scrape carries the
+        decisions next to the telemetry that drove them)."""
         out = self.metrics.registry.snapshot()
         out.update(perfwatch.get_watch().snapshot())
+        for fn in list(self.extra_metrics):
+            try:
+                out.update(fn())
+            except Exception:  # noqa: BLE001 — a broken attachment must not kill scrapes
+                continue
         return out
 
     def stats(self) -> dict:

@@ -419,6 +419,18 @@ class PerfWatch:
         with self._lock:
             return list(self._findings)
 
+    def consume_drift_findings(self) -> List[PerfDriftError]:
+        """Drain the findings list (oldest first) — the handoff used by a
+        consumer that *acts* on drift instead of paging on it (the SLO
+        controller's replica probe/replace). A drained finding is handled:
+        it will not be re-delivered, and the per-program dump budget
+        (``_drift_dumped``) is left intact so a recurrence after the
+        consumer's remediation still cannot storm dumps."""
+        with self._lock:
+            out = list(self._findings)
+            self._findings.clear()
+        return out
+
 
 # ------------------------------------------------------------ exporter
 def _escape_label(value: str) -> str:

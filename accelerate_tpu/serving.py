@@ -225,8 +225,12 @@ class ServingMetrics:
         )
         self.latency = LatencyReservoir()  # seconds, accepted+completed only
         self.queue_wait = LatencyReservoir()  # seconds spent queued
+        # ttft feeds the SLO controller's pressure signal: keep the window
+        # short so p99 tracks CURRENT service, not ten seconds of history
+        self.ttft = LatencyReservoir(size=256)  # seconds to first token
         self.registry.attach_reservoir("latency", self.latency)
         self.registry.attach_reservoir("queue_wait", self.queue_wait)
+        self.registry.attach_reservoir("ttft", self.ttft)
         for name in (
             "queue_depth",
             "breaker_state",
@@ -479,7 +483,8 @@ class InferenceServer:
         if self._closed or self._draining or preemption_requested():
             self.metrics.bump("rejected_draining")
             raise ServerDrainingError(
-                self._drain_reason(), replica_id=self.replica_id
+                self._drain_reason(), replica_id=self.replica_id,
+                retry_after_s=0.0,  # another replica can take it NOW
             )
         if self._breaker.rejects_admission:
             self.metrics.bump("rejected_breaker")
@@ -487,6 +492,7 @@ class InferenceServer:
                 "circuit breaker open after repeated batch failures; retry "
                 f"in {self._breaker.seconds_until_probe():.2f}s",
                 replica_id=self.replica_id,
+                retry_after_s=self._breaker.seconds_until_probe(),
             )
         ids = np.asarray(input_ids, dtype=np.int32)
         if ids.ndim == 2:
@@ -532,14 +538,17 @@ class InferenceServer:
             if self._draining or self._closed:
                 self.metrics.bump("rejected_draining")
                 raise ServerDrainingError(
-                    self._drain_reason(), replica_id=self.replica_id
+                    self._drain_reason(), replica_id=self.replica_id,
+                    retry_after_s=0.0,
                 )
             if len(self._queue) >= self.config.max_queue:
                 self.metrics.bump("rejected_queue_full")
+                hint = self._retry_after_hint(len(self._queue))
                 raise ServerOverloaded(
                     f"admission queue full ({self.config.max_queue}); apply "
-                    "backpressure and resubmit after backoff",
+                    f"backpressure and resubmit in ~{hint:.2f}s",
                     replica_id=self.replica_id,
+                    retry_after_s=hint,
                 )
             self._queue.append(req)
             self.metrics.bump("submitted")
@@ -975,6 +984,7 @@ class InferenceServer:
                 if delivered:
                     self.metrics.bump("completed")
                     self.metrics.latency.add(latency)
+                    self.metrics.ttft.add(max(0.0, ttft))
                     self.metrics.queue_wait.add(
                         max(0.0, occ.inserted_s - req.submitted_at)
                     )
@@ -1044,6 +1054,26 @@ class InferenceServer:
 
     def _estimated_batch_s(self) -> float:
         return self._batch_time_ewma
+
+    def _retry_after_hint(self, depth: int) -> float:
+        """Backpressure hint attached to :class:`ServerOverloaded`: the
+        estimated wall time until a queue slot frees, derived from the
+        batch-time EWMA and the current depth. Static mode drains the
+        queue ``max_batch_size`` requests per EWMA batch; continuous mode
+        frees a slot roughly every ``engine_slots``-th share of a retiring
+        budget (the EWMA there is per-step, so scale by the degraded token
+        budget). A cold EWMA falls back to the batch window. Clamped so a
+        pathological EWMA can never tell clients to go away for minutes."""
+        ewma = self._batch_time_ewma
+        if self._engine is not None:
+            per_free = (ewma or 0.01) * max(1, self.config.degraded_max_new_tokens)
+            per_free /= max(1, self.config.engine_slots)
+        else:
+            waves = (max(1, depth) + self.config.max_batch_size - 1) // max(
+                1, self.config.max_batch_size
+            )
+            per_free = (ewma or self.config.batch_window_s or 0.01) * waves
+        return float(min(5.0, max(1e-3, per_free)))
 
     def _degrade_level(self, depth: int) -> int:
         frac = depth / self.config.max_queue
@@ -1307,6 +1337,7 @@ class InferenceServer:
                 if delivered:
                     self.metrics.bump("completed")
                     self.metrics.latency.add(latency)
+                    self.metrics.ttft.add(latency)  # batch materializes at once
                     self.metrics.queue_wait.add(max(0.0, latency - dt))
         except BaseException as exc:  # noqa: BLE001 — never strand a batch
             self._fail_batch(batch, exc, "batch executed but the reply failed")
@@ -1341,6 +1372,7 @@ class InferenceServer:
                     "server drained before this request was batched — "
                     "resubmit to another replica",
                     replica_id=self.replica_id,
+                    retry_after_s=0.0,
                 ),
             )
             if rejected:

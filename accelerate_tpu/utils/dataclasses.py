@@ -815,6 +815,149 @@ class FleetConfig(KwargsHandler):
 
 
 @dataclass
+class ControllerConfig(KwargsHandler):
+    """Policy knobs for :class:`accelerate_tpu.controller.SLOController`
+    (docs/control_plane.md) — the closed-loop SLO control plane over the
+    fleet observatory. The design center is that the controller must be
+    MORE robust than what it controls: every destabilizing failure mode
+    (flapping, actuation storms, acting on stale telemetry) has a
+    dedicated guard, and every guard has a knob here.
+
+    Loop / objectives:
+
+    * ``interval_s`` — observation-tick cadence of the control thread.
+    * ``ttft_slo_s`` — the TTFT p99 objective (seconds). The controller's
+      pressure signal is the worst ratio of measured/objective across the
+      active signals; ``None`` disables the TTFT term.
+    * ``latency_slo_s`` — optional end-to-end latency p99 objective.
+    * ``target_queue_fraction`` — queue occupancy (depth / max_queue)
+      the fleet should sit at; occupancy above it contributes pressure.
+
+    Hysteresis / anti-flapping:
+
+    * ``escalate_threshold`` / ``relax_threshold`` — the hysteresis band.
+      Pressure >= ``escalate_threshold`` escalates one rung of the knob
+      ladder; pressure <= ``relax_threshold`` relaxes one rung; anything
+      between is the dead band and actuates NOTHING. The gap is the
+      anti-flapping margin — an oscillating signal inside the band
+      produces zero actuations.
+    * ``knob_cooldown_s`` — minimum seconds between actuations of the
+      same in-place knob (spec clamp, degradation, admission quota,
+      hedging).
+    * ``scale_cooldown_s`` — minimum seconds between replica-count
+      changes (scale-up/-down/replace); replica moves are the most
+      expensive actuation, so they get the longest cooldown.
+
+    Actuation storm control:
+
+    * ``actuation_budget_capacity`` / ``actuation_budget_refill_per_s``
+      — a token bucket every actuation (escalate, relax, replace) must
+      take a token from; an empty bucket denies the actuation. Bounds
+      how fast a buggy signal can churn the fleet.
+
+    Fail-static (stale telemetry):
+
+    * ``stale_after_s`` — maximum age of the fleet snapshot (the
+      prober's last completed pass) before telemetry counts as stale.
+    * ``min_coverage`` — minimum fraction of live replicas whose health
+      must be readable at a tick; below it telemetry counts as partial.
+      Stale or partial ⇒ actuation freezes and exactly one typed
+      :class:`~accelerate_tpu.utils.fault.ControllerStaleError` finding
+      is recorded per episode.
+
+    Replica elasticity:
+
+    * ``min_replicas`` / ``max_replicas`` — bounds on the controller's
+      replica-count actuation (scale-up requires the router to have a
+      ``replica_factory``).
+    * ``replace_on_drift`` — consume perfwatch
+      :class:`~accelerate_tpu.utils.fault.PerfDriftError` findings as a
+      control input: probe/replace the slowest replica (scale-up a fresh
+      one, zero-drop drain the drifted one) instead of paging a human.
+    * ``replace_drain_timeout_s`` — drain bound for the replaced
+      replica (its queued work fails over to survivors either way).
+
+    ``dry_run`` — compute decisions, emit ``fleet.control`` spans and
+    ``controller/...`` metrics, but touch NOTHING. The audit mode: run
+    it against production telemetry and read what it would have done.
+    """
+
+    interval_s: float = 0.5
+    ttft_slo_s: Optional[float] = 1.0
+    latency_slo_s: Optional[float] = None
+    target_queue_fraction: float = 0.5
+    escalate_threshold: float = 1.0
+    relax_threshold: float = 0.6
+    knob_cooldown_s: float = 2.0
+    scale_cooldown_s: float = 5.0
+    actuation_budget_capacity: int = 8
+    actuation_budget_refill_per_s: float = 0.5
+    stale_after_s: float = 2.0
+    min_coverage: float = 1.0
+    min_replicas: int = 1
+    max_replicas: int = 8
+    replace_on_drift: bool = True
+    replace_drain_timeout_s: float = 5.0
+    dry_run: bool = False
+
+    def __post_init__(self):
+        if self.interval_s <= 0:
+            raise ValueError(f"interval_s must be > 0, got {self.interval_s}")
+        if self.ttft_slo_s is not None and self.ttft_slo_s <= 0:
+            raise ValueError(
+                f"ttft_slo_s must be None or > 0, got {self.ttft_slo_s}"
+            )
+        if self.latency_slo_s is not None and self.latency_slo_s <= 0:
+            raise ValueError(
+                f"latency_slo_s must be None or > 0, got {self.latency_slo_s}"
+            )
+        if not 0 < self.target_queue_fraction <= 1:
+            raise ValueError(
+                "target_queue_fraction must be in (0, 1], got "
+                f"{self.target_queue_fraction}"
+            )
+        if self.relax_threshold < 0 or self.escalate_threshold <= self.relax_threshold:
+            raise ValueError(
+                "hysteresis band requires 0 <= relax_threshold < "
+                f"escalate_threshold, got {self.relax_threshold}/"
+                f"{self.escalate_threshold}"
+            )
+        if self.knob_cooldown_s < 0 or self.scale_cooldown_s < 0:
+            raise ValueError(
+                "cooldowns must be >= 0, got "
+                f"{self.knob_cooldown_s}/{self.scale_cooldown_s}"
+            )
+        if self.actuation_budget_capacity < 1:
+            raise ValueError(
+                "actuation_budget_capacity must be >= 1, got "
+                f"{self.actuation_budget_capacity}"
+            )
+        if self.actuation_budget_refill_per_s < 0:
+            raise ValueError(
+                "actuation_budget_refill_per_s must be >= 0, got "
+                f"{self.actuation_budget_refill_per_s}"
+            )
+        if self.stale_after_s <= 0:
+            raise ValueError(
+                f"stale_after_s must be > 0, got {self.stale_after_s}"
+            )
+        if not 0 < self.min_coverage <= 1:
+            raise ValueError(
+                f"min_coverage must be in (0, 1], got {self.min_coverage}"
+            )
+        if not 1 <= self.min_replicas <= self.max_replicas:
+            raise ValueError(
+                "replica bounds require 1 <= min_replicas <= max_replicas, "
+                f"got {self.min_replicas}/{self.max_replicas}"
+            )
+        if self.replace_drain_timeout_s < 0:
+            raise ValueError(
+                "replace_drain_timeout_s must be >= 0, got "
+                f"{self.replace_drain_timeout_s}"
+            )
+
+
+@dataclass
 class FSDPPlugin(KwargsHandler):
     """FSDP strategy knobs mapped to GSPMD equivalents
     (reference FullyShardedDataParallelPlugin, utils/dataclasses.py:1586-2191).

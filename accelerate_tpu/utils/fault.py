@@ -53,6 +53,7 @@ __all__ = [
     "EngineInvariantError",
     "ComponentClosedError",
     "PerfDriftError",
+    "ControllerStaleError",
     "FaultInjected",
     "fault_point",
     "install_preemption_handler",
@@ -139,14 +140,27 @@ class ServingError(RuntimeError):
       a lost cause;
     * ``replica_id`` — which replica raised it (``None`` when the server
       was not given an identity), so failover can exclude the failed
-      replica instead of bouncing the request straight back to it.
+      replica instead of bouncing the request straight back to it;
+    * ``retry_after_s`` — the raiser's own estimate of when a retry
+      could succeed (``None`` = no estimate, use your default backoff).
+      An overloaded server derives it from its batch-time EWMA and queue
+      depth; a draining server reports ``0.0`` (resubmit elsewhere NOW);
+      an open breaker reports its remaining reset window. Routers and
+      clients honor the hint instead of guessing with fixed jittered
+      backoff.
     """
 
     retriable: bool = False
 
-    def __init__(self, *args, replica_id: Optional[str] = None):
+    def __init__(
+        self,
+        *args,
+        replica_id: Optional[str] = None,
+        retry_after_s: Optional[float] = None,
+    ):
         super().__init__(*args)
         self.replica_id = replica_id
+        self.retry_after_s = retry_after_s
 
 
 class ServerOverloaded(ServingError):
@@ -263,6 +277,34 @@ class PerfDriftError(RuntimeError):
         )
 
 
+class ControllerStaleError(RuntimeError):
+    """The SLO controller's telemetry was stale or partial at an
+    observation tick — the prober has not refreshed the fleet snapshot
+    within ``stale_after_s``, or fewer than ``min_coverage`` of the live
+    replicas answered a health read. Recorded (never raised across the
+    control loop) by :class:`accelerate_tpu.controller.SLOController` as
+    its fail-static finding: actuation freezes until telemetry is fresh
+    again, because a controller acting on garbage is strictly worse than
+    no controller at all. Carries the staleness evidence so the finding
+    is attributable without re-deriving anything."""
+
+    def __init__(self, reason: str, *, age_s: Optional[float] = None,
+                 coverage: Optional[float] = None):
+        self.reason = reason
+        self.age_s = age_s
+        self.coverage = coverage
+        detail = []
+        if age_s is not None:
+            detail.append(f"snapshot age {age_s:.3f}s")
+        if coverage is not None:
+            detail.append(f"replica coverage {coverage:.0%}")
+        suffix = f" ({', '.join(detail)})" if detail else ""
+        super().__init__(
+            f"controller telemetry unusable: {reason}{suffix} — "
+            "actuation frozen (fail-static)"
+        )
+
+
 class FaultInjected(RuntimeError):
     """Raised by :func:`fault_point` for ``point:raise`` injection specs."""
 
@@ -299,7 +341,10 @@ def fault_point(name: str) -> None:
     request; ``fleet_failover`` — a retriable replica failure is about to
     be resubmitted to a surviving replica; ``fleet_probe`` — the health
     prober is about to read one replica's health; ``fleet_scale_down`` —
-    a replica is about to be drained out of the fleet). The env var is
+    a replica is about to be drained out of the fleet); and the SLO
+    controller at the top of each observation tick
+    (``controller_observe`` — arm ``raise`` here to simulate unreadable
+    telemetry and prove the fail-static freeze). The env var is
     read at call time so a test script can arm a point between two saves.
     """
     spec = os.environ.get(FAULT_INJECT_ENV)

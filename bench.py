@@ -728,6 +728,16 @@ if __name__ == "__main__":
         from benchmarks.obs_bench import main as obs_main
 
         sys.exit(obs_main(gate=True))
+    if "--controller-gate" in sys.argv:
+        # self-healing fleet gate: SLO controller vs static peak under the
+        # seeded ramp/flash-crowd/drain replay (TTFT p99 within SLO with
+        # fewer replica-seconds), drift-finding replica replacement, and
+        # fail-static freeze with exactly one typed ControllerStaleError
+        # (docs/control_plane.md)
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from benchmarks.autoscale_bench import main as autoscale_main
+
+        sys.exit(autoscale_main(gate=True))
     if "--continuous-gate" in sys.argv:
         # continuous-batching gate: mixed-length/mixed-budget workload must
         # reach >= 1.3x static-mode goodput with TTFT p99 no worse, <= 2

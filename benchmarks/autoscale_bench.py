@@ -1,0 +1,437 @@
+"""Self-healing fleet gate: closed-loop SLO controller vs static peak.
+
+Three claims the control plane (docs/control_plane.md) ships on:
+
+1. **Elasticity** — under the seeded ramp + flash-crowd + drain replay
+   (benchmarks/loadgen, fixed PRNG seed), a fleet that starts at one
+   replica with the :class:`~accelerate_tpu.controller.SLOController`
+   holding the wheel must keep TTFT p99 within the SLO while burning
+   **measurably fewer replica-seconds** than static peak provisioning
+   (the same replay against ``N_peak`` always-on replicas). Both
+   integrals are reported. Zero dropped futures in either run.
+
+2. **Self-healing** — arm a fault-injected per-batch sleep
+   (``serving_before_batch:sleep=...``) against a calibrated perfwatch
+   baseline: the drift sentinel raises exactly one typed finding, the
+   controller consumes it and replaces exactly one replica (probe/
+   replace instead of a page), and every in-flight future resolves.
+   Zero human action.
+
+3. **Fail-static** — arm ``controller_observe:raise``: the controller
+   must freeze actuation and record exactly ONE typed
+   :class:`ControllerStaleError` finding no matter how many ticks the
+   outage spans, then resume (and log recovery) once telemetry returns.
+
+Prints one JSON line per phase plus a gate line. ``--gate`` (also
+``bench.py --controller-gate`` / ``make bench-autoscale``) turns the
+acceptance criteria into a nonzero exit.
+"""
+
+from __future__ import annotations
+
+import os
+import sys as _sys
+
+_sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))  # runnable as `python benchmarks/x.py`
+
+import json
+import shutil
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+from benchmarks import loadgen
+
+SERVICE_S = float(os.environ.get("ASB_SERVICE_S", "0.04"))
+MAX_BATCH = int(os.environ.get("ASB_MAX_BATCH", "8"))
+RAMP_S = float(os.environ.get("ASB_RAMP_S", "2.0"))
+FLASH_S = float(os.environ.get("ASB_FLASH_S", "1.5"))
+DRAIN_S = float(os.environ.get("ASB_DRAIN_S", "2.0"))
+SEED = int(os.environ.get("ASB_SEED", "1234"))
+# post-replay settle window, paid by BOTH sides of the A/B: static peak
+# keeps burning N_peak replicas after the traffic leaves; the controller
+# is expected to hand capacity back during it
+TAIL_S = float(os.environ.get("ASB_TAIL_S", "2.0"))
+TTFT_SLO_S = float(os.environ.get("ASB_TTFT_SLO_S", "0.75"))
+# controller must beat static peak by at least this margin
+GATE_RS_RATIO = float(os.environ.get("ASB_GATE_RS_RATIO", "0.85"))
+PROMPT = np.arange(1, 9, dtype=np.int32)
+
+CAPACITY = MAX_BATCH / SERVICE_S  # one replica's exact throughput ceiling
+
+
+def _synthetic_gen(service_s: float):
+    def fn(model, ids, max_new_tokens=4, **kw):
+        time.sleep(service_s)
+        new = np.repeat(ids[:, :1], max_new_tokens, axis=1)
+        return np.concatenate([ids, new], axis=1)
+
+    return fn
+
+
+def _serving_config():
+    from accelerate_tpu.utils.dataclasses import ServingConfig
+
+    return ServingConfig(
+        max_queue=256, max_batch_size=MAX_BATCH, batch_window_s=0.001,
+        default_max_new_tokens=4, max_retries=0, drain_timeout_s=10.0,
+    )
+
+
+def _replica_factory(scfg):
+    from accelerate_tpu.serving import InferenceServer
+
+    def factory(replica_id: str):
+        return InferenceServer(
+            object(), scfg, generate_fn=_synthetic_gen(SERVICE_S),
+            replica_id=replica_id,
+        )
+
+    return factory
+
+
+def _fleet(n_replicas: int, *, factory=None):
+    from accelerate_tpu.fleet import FleetRouter
+    from accelerate_tpu.utils.dataclasses import FleetConfig
+
+    scfg = _serving_config()
+    servers = {
+        f"r{i}": _replica_factory(scfg)(f"r{i}") for i in range(n_replicas)
+    }
+    return FleetRouter(
+        servers,
+        FleetConfig(probe_interval_s=0.05),
+        replica_factory=_replica_factory(scfg) if factory else None,
+    )
+
+
+class _ReplicaSecondsMeter:
+    """Integrates ``len(replica_ids())`` over wall time on a sampler
+    thread — the provisioning cost both sides of the A/B pay in."""
+
+    def __init__(self, router, dt: float = 0.02):
+        self._router = router
+        self._dt = dt
+        self._stop = threading.Event()
+        self.replica_seconds = 0.0
+        self.max_replicas = 0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        last = time.perf_counter()
+        while not self._stop.is_set():
+            self._stop.wait(self._dt)
+            now = time.perf_counter()
+            n = len(self._router.replica_ids())
+            self.replica_seconds += n * (now - last)
+            self.max_replicas = max(self.max_replicas, n)
+            last = now
+
+    def __enter__(self):
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        self._thread.join(timeout=5)
+        return False
+
+
+def _schedule():
+    return loadgen.ramp_flash_crowd_drain(
+        base_rps=0.5 * CAPACITY, peak_rps=1.2 * CAPACITY,
+        ramp_s=RAMP_S, flash_s=FLASH_S, drain_s=DRAIN_S,
+        flash_multiplier=2.0, seed=SEED,
+    )
+
+
+def _replay(router, schedule) -> dict:
+    """Replay the schedule open-loop; resolve every future. Static-batch
+    mode materializes all tokens at once, so client latency IS the time
+    to first token — reported as ttft."""
+    from accelerate_tpu.utils.fault import ServingError
+
+    futures = []
+    counts = schedule.replay(
+        lambda phase: futures.append(router.submit(PROMPT, max_new_tokens=4))
+    )
+    lat = []
+    completed = typed_retriable = typed_final = untyped = dropped = 0
+    for f in futures:
+        try:
+            res = f.result(timeout=60)
+            completed += 1
+            lat.append(res.latency_s)
+        except ServingError as exc:
+            if exc.retriable:
+                typed_retriable += 1
+            else:
+                typed_final += 1
+        except TimeoutError:
+            dropped += 1  # the zero-drop gate: must stay 0
+        except Exception:  # noqa: BLE001 — gate counts anything untyped
+            untyped += 1
+    lat.sort()
+    return {
+        "offered": sum(counts.values()),
+        "offered_by_phase": counts,
+        "completed": completed,
+        "goodput_rps": round(completed / schedule.duration_s, 1),
+        "typed_retriable": typed_retriable,
+        "typed_final": typed_final,
+        "untyped_errors": untyped,
+        "dropped_futures": dropped,
+        "ttft_p50_s": round(lat[len(lat) // 2], 4) if lat else None,
+        "ttft_p99_s": (
+            round(lat[min(len(lat) - 1, int(0.99 * len(lat)))], 4)
+            if lat else None
+        ),
+    }
+
+
+# ----------------------------------------------------- phase 1: elasticity
+def _controller_config():
+    from accelerate_tpu.utils.dataclasses import ControllerConfig
+
+    return ControllerConfig(
+        interval_s=0.05,
+        ttft_slo_s=TTFT_SLO_S,
+        target_queue_fraction=0.2,
+        escalate_threshold=1.0,
+        relax_threshold=0.5,
+        knob_cooldown_s=0.1,
+        scale_cooldown_s=0.25,
+        actuation_budget_capacity=16,
+        actuation_budget_refill_per_s=8.0,
+        stale_after_s=2.0,
+        min_replicas=1,
+        max_replicas=4,
+    )
+
+
+def _autoscale_run() -> dict:
+    from accelerate_tpu.controller import SLOController
+
+    schedule = _schedule()
+    router = _fleet(1, factory=True)
+    ctl = SLOController(router, _controller_config())
+    try:
+        with _ReplicaSecondsMeter(router) as meter:
+            ctl.start()
+            row = _replay(router, schedule)
+            time.sleep(TAIL_S)  # settle: the relax path gives capacity back
+        row.update({
+            "phase": "autoscale",
+            "replica_seconds": round(meter.replica_seconds, 2),
+            "max_replicas": meter.max_replicas,
+            "final_replicas": len(router.replica_ids()),
+            "escalations": ctl.metrics["escalations"],
+            "relaxations": ctl.metrics["relaxations"],
+            "actuations": ctl.metrics["actuations"],
+        })
+    finally:
+        ctl.close()
+        router.close(drain=False)
+    print(json.dumps(row), flush=True)
+    return row
+
+
+def _static_peak_run(n_peak: int) -> dict:
+    schedule = _schedule()
+    router = _fleet(n_peak)
+    try:
+        with _ReplicaSecondsMeter(router) as meter:
+            row = _replay(router, schedule)
+            time.sleep(TAIL_S)  # static peak keeps paying through the tail
+        row.update({
+            "phase": f"static_peak_{n_peak}x",
+            "replica_seconds": round(meter.replica_seconds, 2),
+            "max_replicas": meter.max_replicas,
+        })
+    finally:
+        router.close(drain=False)
+    print(json.dumps(row), flush=True)
+    return row
+
+
+# --------------------------------------------------- phase 2: drift chaos
+def _drift_chaos(workdir: str) -> dict:
+    """Calibrated baseline + injected per-batch sleep ⇒ the sentinel finds
+    drift, the controller replaces the drifted replica, nothing drops."""
+    from accelerate_tpu import perfwatch, tracing
+    from accelerate_tpu.analysis.lowering import atomic_write_json
+    from accelerate_tpu.controller import SLOController
+    from accelerate_tpu.utils.dataclasses import (
+        ControllerConfig,
+        ObservabilityConfig,
+        TracingConfig,
+    )
+    from accelerate_tpu.utils.fault import FAULT_INJECT_ENV
+
+    program = "serving.static/batch"
+    tracing.configure(TracingConfig(
+        dump_dir=workdir, max_dumps=1, dump_on_failure=False,
+    ))
+    # calibrate from healthy traffic
+    perfwatch.configure(ObservabilityConfig(enabled=True))
+    router = _fleet(1)
+    try:
+        _replay(router, loadgen.constant(0.5 * CAPACITY, 0.8, seed=SEED))
+    finally:
+        router.close(drain=False)
+    healthy = perfwatch.get_watch().measured(program)
+    baseline_path = os.path.join(workdir, "perf_baseline.json")
+    atomic_write_json({
+        "chip": "v5p",
+        "tolerance": 0.25,
+        "programs": {program: {"predicted_s": healthy["median_s"],
+                               "bound": "hbm", "flops": 0.0}},
+    }, baseline_path)
+
+    watch = perfwatch.configure(ObservabilityConfig(
+        enabled=True, baseline_path=baseline_path, drift_enabled=True,
+        drift_min_samples=4, drift_consecutive=2, drift_interval_s=0.05,
+    ))
+    router = _fleet(2, factory=True)
+    cfg = ControllerConfig(
+        interval_s=0.05, ttft_slo_s=None, escalate_threshold=100.0,
+        relax_threshold=0.0,  # pin the ladder: this phase isolates replace
+        scale_cooldown_s=60.0,  # one replacement per episode, by budget
+        min_replicas=1, max_replicas=4,
+    )
+    ctl = SLOController(router, cfg, watch=watch)
+    os.environ[FAULT_INJECT_ENV] = f"serving_before_batch:sleep={SERVICE_S}"
+    try:
+        ctl.start()
+        row = _replay(router, loadgen.constant(0.6 * CAPACITY, 1.5, seed=SEED))
+    finally:
+        os.environ.pop(FAULT_INJECT_ENV, None)
+    # disarmed: drive briefly so recovery is futures-clean end to end
+    try:
+        row2 = _replay(router, loadgen.constant(0.6 * CAPACITY, 0.6, seed=SEED))
+        replacements = ctl.metrics["drift_replacements"]
+        replicas = sorted(router.replica_ids())
+    finally:
+        ctl.close()
+        router.close(drain=False)
+    out = {
+        "phase": "drift_chaos",
+        "healthy_median_s": round(healthy["median_s"], 4),
+        "drift_replacements": replacements,
+        "replicas_after": replicas,
+        "replaced": any(r.startswith("ctl-") for r in replicas),
+        "dropped_futures": row["dropped_futures"] + row2["dropped_futures"],
+        "untyped_errors": row["untyped_errors"] + row2["untyped_errors"],
+        "recovered_goodput_rps": row2["goodput_rps"],
+    }
+    print(json.dumps(out), flush=True)
+    return out
+
+
+# ------------------------------------------------- phase 3: stale freeze
+def _stale_freeze() -> dict:
+    """controller_observe:raise ⇒ exactly one typed finding, frozen loop,
+    zero actuations; thaw on disarm."""
+    from accelerate_tpu.controller import SLOController
+    from accelerate_tpu.utils.dataclasses import ControllerConfig
+    from accelerate_tpu.utils.fault import (
+        FAULT_INJECT_ENV,
+        ControllerStaleError,
+    )
+
+    router = _fleet(1, factory=True)
+    cfg = ControllerConfig(interval_s=0.03, ttft_slo_s=TTFT_SLO_S,
+                           min_replicas=1, max_replicas=4)
+    ctl = SLOController(router, cfg)
+    try:
+        ctl.start()
+        time.sleep(0.2)  # healthy ticks first: freeze must be a transition
+        acts_before = ctl.metrics["actuations"]
+        findings_before = len(ctl.stale_findings())
+        os.environ[FAULT_INJECT_ENV] = "controller_observe:raise"
+        try:
+            time.sleep(0.5)  # ~16 blinded ticks
+            frozen_during = ctl.frozen
+            findings = ctl.stale_findings()[findings_before:]
+            acts_during = ctl.metrics["actuations"]
+        finally:
+            os.environ.pop(FAULT_INJECT_ENV, None)
+        time.sleep(0.3)
+        out = {
+            "phase": "stale_freeze",
+            "frozen_during_outage": frozen_during,
+            "typed_findings": len(findings),
+            "finding_is_typed": all(
+                isinstance(f, ControllerStaleError) for f in findings
+            ),
+            "actuations_while_frozen": acts_during - acts_before,
+            "stale_ticks": ctl.metrics["stale_ticks"],
+            "recovered": not ctl.frozen,
+            "recoveries": ctl.metrics["recoveries"],
+        }
+    finally:
+        ctl.close()
+        router.close(drain=False)
+    print(json.dumps(out), flush=True)
+    return out
+
+
+def main(gate: bool = False) -> int:
+    workdir = tempfile.mkdtemp(prefix="autoscale_bench_")
+    try:
+        n_peak = 3  # ceil(flash 2.0 × peak 1.2×capacity / capacity)
+        auto = _autoscale_run()
+        static = _static_peak_run(n_peak)
+        drift = _drift_chaos(workdir)
+        stale = _stale_freeze()
+
+        rs_ratio = auto["replica_seconds"] / max(static["replica_seconds"],
+                                                 1e-9)
+        checks = {
+            "slo_ttft_p99": auto["ttft_p99_s"] is not None
+            and auto["ttft_p99_s"] <= TTFT_SLO_S,
+            "fewer_replica_seconds": rs_ratio <= GATE_RS_RATIO,
+            "controller_scaled": auto["max_replicas"] >= 2,
+            "gave_capacity_back": auto["final_replicas"]
+            < auto["max_replicas"],
+            "elastic_zero_dropped": auto["dropped_futures"] == 0
+            and auto["untyped_errors"] == 0,
+            "static_zero_dropped": static["dropped_futures"] == 0
+            and static["untyped_errors"] == 0,
+            "drift_replaced_exactly_one": drift["drift_replacements"] == 1
+            and drift["replaced"],
+            "drift_zero_dropped": drift["dropped_futures"] == 0
+            and drift["untyped_errors"] == 0,
+            "stale_exactly_one_finding": stale["typed_findings"] == 1
+            and stale["finding_is_typed"],
+            "stale_froze_actuation": stale["frozen_during_outage"]
+            and stale["actuations_while_frozen"] == 0,
+            "stale_recovered": stale["recovered"]
+            and stale["recoveries"] >= 1,
+        }
+        ok = all(checks.values())
+        print(json.dumps({
+            "metric": "autoscale_gate",
+            "replica_seconds_controller": auto["replica_seconds"],
+            "replica_seconds_static_peak": static["replica_seconds"],
+            "replica_seconds_ratio": round(rs_ratio, 3),
+            "ratio_threshold": GATE_RS_RATIO,
+            "ttft_p99_controller_s": auto["ttft_p99_s"],
+            "ttft_p99_static_s": static["ttft_p99_s"],
+            "ttft_slo_s": TTFT_SLO_S,
+            "checks": checks,
+            "pass": ok,
+        }), flush=True)
+        return 0 if (ok or not gate) else 1
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+        from accelerate_tpu import perfwatch
+        from accelerate_tpu.utils.dataclasses import ObservabilityConfig
+
+        perfwatch.configure(ObservabilityConfig())
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(gate="--gate" in _sys.argv))

@@ -1,0 +1,178 @@
+"""Deterministic seeded load-replay generator for serving benches.
+
+Every load-dependent gate in this repo (fleet ramp, autoscale/controller)
+wants the *same* arrival process on every run, or a failed gate is noise
+instead of a regression. This module builds an arrival **schedule** — a
+list of absolute submit offsets tagged with a phase name — from a fixed
+PRNG seed, then replays it open-loop against any ``submit()`` callable.
+
+Two layers:
+
+- Builders (:func:`constant`, :func:`ramp_flash_crowd_drain`) turn a
+  piecewise rate profile into a :class:`Schedule` via a seeded Poisson
+  process (exponential inter-arrivals from ``random.Random(seed)``).
+  Same seed + same profile ⇒ bit-identical offsets, forever.
+- :meth:`Schedule.replay` paces wall-clock through the offsets, calling
+  ``submit(phase)`` per arrival and returning per-phase counts. Pacing
+  is best-effort (a slow submit slips later arrivals — that is the
+  open-loop property the benches want: offered load does not back off).
+
+Used by ``serving_bench.py --fleet`` (ramp phases) and
+``autoscale_bench.py`` (the controller gate's ramp + flash-crowd + drain
+scenario). Pure stdlib; no accelerator imports.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "Arrival",
+    "Phase",
+    "Schedule",
+    "constant",
+    "from_phases",
+    "ramp_flash_crowd_drain",
+]
+
+Arrival = Tuple[float, str]  # (absolute offset from t=0 in seconds, phase)
+
+
+class Phase:
+    """One segment of the rate profile.
+
+    ``rate_rps`` may be a float (constant over the segment) or a callable
+    ``f(u) -> rps`` of normalized position ``u ∈ [0, 1)`` within the
+    segment (for linear ramps). Rates are sampled at each arrival, so a
+    ramp is approximated by the thinning-free "current rate" process —
+    deterministic and close enough for a bench profile.
+    """
+
+    def __init__(self, name: str, duration_s: float, rate_rps):
+        if duration_s <= 0:
+            raise ValueError(f"phase {name!r}: duration_s must be > 0")
+        self.name = name
+        self.duration_s = float(duration_s)
+        self.rate_rps = rate_rps
+
+    def rate_at(self, u: float) -> float:
+        r = self.rate_rps(u) if callable(self.rate_rps) else self.rate_rps
+        return max(float(r), 0.0)
+
+
+class Schedule:
+    """A replayable, deterministic arrival schedule."""
+
+    def __init__(self, arrivals: Sequence[Arrival], phases: Sequence[Phase],
+                 seed: int):
+        self.arrivals: List[Arrival] = list(arrivals)
+        self.phases: List[Phase] = list(phases)
+        self.seed = seed
+        self.duration_s = sum(p.duration_s for p in phases)
+
+    def __len__(self) -> int:
+        return len(self.arrivals)
+
+    def offsets(self) -> List[float]:
+        return [t for t, _ in self.arrivals]
+
+    def phase_counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {p.name: 0 for p in self.phases}
+        for _, name in self.arrivals:
+            out[name] = out.get(name, 0) + 1
+        return out
+
+    def replay(
+        self,
+        submit: Callable[[str], None],
+        *,
+        clock: Callable[[], float] = time.perf_counter,
+        sleep: Callable[[float], None] = time.sleep,
+        on_phase: Optional[Callable[[str], None]] = None,
+    ) -> Dict[str, int]:
+        """Play the schedule against ``submit(phase_name)`` in wall time.
+
+        ``on_phase`` (if given) fires once at each phase boundary with the
+        entering phase's name — benches hook chaos injection there.
+        Returns submitted counts per phase. Exceptions from ``submit``
+        propagate: admission errors are the *caller's* data, not ours.
+        """
+        counts: Dict[str, int] = {p.name: 0 for p in self.phases}
+        start = clock()
+        current_phase = None
+        for t, name in self.arrivals:
+            if name != current_phase:
+                current_phase = name
+                if on_phase is not None:
+                    on_phase(name)
+            while True:
+                lag = start + t - clock()
+                if lag <= 0:
+                    break
+                sleep(min(lag, 0.01))
+            submit(name)
+            counts[name] = counts.get(name, 0) + 1
+        # run out the clock so trailing quiet time (e.g. a drain tail with
+        # few arrivals) still elapses for the caller's rate math
+        while clock() - start < self.duration_s:
+            sleep(min(0.01, self.duration_s - (clock() - start)))
+        return counts
+
+
+def from_phases(phases: Sequence[Phase], *, seed: int = 0) -> Schedule:
+    """Poisson arrivals over a piecewise rate profile, fully seeded."""
+    rng = random.Random(seed)
+    arrivals: List[Arrival] = []
+    t0 = 0.0
+    for phase in phases:
+        t = 0.0
+        while True:
+            rate = phase.rate_at(t / phase.duration_s)
+            if rate <= 0.0:
+                break  # zero-rate segment contributes silence, not spin
+            t += rng.expovariate(rate)
+            if t >= phase.duration_s:
+                break
+            arrivals.append((t0 + t, phase.name))
+        t0 += phase.duration_s
+    return Schedule(arrivals, phases, seed)
+
+
+def constant(rate_rps: float, duration_s: float, *, seed: int = 0,
+             name: str = "load") -> Schedule:
+    """Seeded Poisson arrivals at a constant mean rate."""
+    return from_phases([Phase(name, duration_s, rate_rps)], seed=seed)
+
+
+def ramp_flash_crowd_drain(
+    *,
+    base_rps: float,
+    peak_rps: float,
+    ramp_s: float,
+    flash_s: float,
+    drain_s: float,
+    flash_multiplier: float = 2.0,
+    seed: int = 0,
+) -> Schedule:
+    """The controller-gate scenario: three stress regimes in one replay.
+
+    - ``ramp``  — linear climb from ``base_rps`` to ``peak_rps``: the
+      controller should escalate smoothly (no flapping on the way up);
+    - ``flash`` — an immediate step to ``flash_multiplier × peak_rps``:
+      the flash crowd that forces the ladder to its scale rung;
+    - ``drain`` — linear fall from ``peak_rps`` back to ``base_rps``:
+      the controller must give capacity back (relax path).
+    """
+    if base_rps <= 0 or peak_rps < base_rps:
+        raise ValueError("need 0 < base_rps <= peak_rps")
+    span = peak_rps - base_rps
+    return from_phases(
+        [
+            Phase("ramp", ramp_s, lambda u: base_rps + span * u),
+            Phase("flash", flash_s, flash_multiplier * peak_rps),
+            Phase("drain", drain_s, lambda u: peak_rps - span * u),
+        ],
+        seed=seed,
+    )

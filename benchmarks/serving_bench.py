@@ -304,6 +304,7 @@ FLEET_PHASE_S = float(os.environ.get("SB_FLEET_PHASE_S", "1.5"))
 FLEET_OFFERED_X = float(os.environ.get("SB_FLEET_OFFERED_X", "2.5"))
 FLEET_GATE_SCALE = float(os.environ.get("SB_FLEET_GATE_SCALE", "1.8"))
 FLEET_TTFT_TOL = float(os.environ.get("SB_FLEET_TTFT_TOL", "1.10"))
+FLEET_SEED = int(os.environ.get("SB_FLEET_SEED", "0"))
 
 
 class _KillableEngine(_SyntheticEngine):
@@ -459,35 +460,42 @@ def _fleet_imports():
 
 
 def _run_fleet_phase(router, name, rate_rps, duration_s, deadline_s=None,
-                     mid_phase=None):
-    """Open-loop arrivals against the router. The router's contract is
+                     mid_phase=None, schedule=None):
+    """Seeded open-loop arrivals against the router (benchmarks/loadgen —
+    same seed ⇒ same offered sequence every run). The router's contract is
     "always a Future", so admission failures surface on the futures —
     the gate wants exactly: every future resolves, failures are typed and
     retriable, nothing is dropped."""
+    from benchmarks import loadgen
+
     from accelerate_tpu.utils.fault import (
         RequestDeadlineExceeded,
         ServingError,
     )
 
+    if schedule is None:
+        schedule = loadgen.constant(rate_rps, duration_s, seed=FLEET_SEED,
+                                    name=name)
     futures = []
     start = time.perf_counter()
-    i = 0
     fired_mid = mid_phase is None
-    while True:
+    i = 0
+    for t, _phase in schedule.arrivals:
         now = time.perf_counter()
-        if now - start >= duration_s:
-            break
-        if not fired_mid and now - start >= duration_s / 2:
+        if not fired_mid and now - start >= schedule.duration_s / 2:
             fired_mid = True
             mid_phase()
-        next_t = start + i / rate_rps
-        if next_t > now:
-            time.sleep(min(next_t - now, 0.01))
-            continue
+        while True:
+            lag = start + t - time.perf_counter()
+            if lag <= 0:
+                break
+            time.sleep(min(lag, 0.01))
         i += 1
         futures.append(
             router.submit(PROMPT, max_new_tokens=4, deadline_s=deadline_s)
         )
+    if not fired_mid:  # schedule ended before midpoint (shouldn't happen)
+        mid_phase()
 
     ttfts, latencies = [], []
     completed = shed = typed_retriable = typed_final = untyped = dropped = 0

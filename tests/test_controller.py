@@ -1,0 +1,379 @@
+"""Closed-loop SLO controller suite (docs/control_plane.md):
+
+* ladder — escalation walks the rung order (spec → degrade → admission →
+  hedge → scale), relax restores every knob to its saved baseline and
+  drains controller-added replicas first;
+* hysteresis — load oscillating inside the dead band produces ZERO
+  actuations; per-knob cooldowns and the token bucket each bound the
+  actuation rate independently;
+* fail-static — a stale or partial snapshot (or a blinded observe path,
+  via fault injection) freezes actuation with exactly ONE typed
+  :class:`ControllerStaleError` finding per episode, and actuation
+  resumes when telemetry returns;
+* drift — a consumed :class:`PerfDriftError` finding answers with exactly
+  one replica replace (scale-up then zero-drop scale-down), not a page;
+* dry_run — decisions are computed and counted but nothing is touched.
+
+All tests drive ``tick()`` directly with an injected clock against a
+narrow FakeRouter — the controller is pure host-side control plane, so
+everything is deterministic and compile-free.
+"""
+
+import pytest
+
+from accelerate_tpu.controller import SLOController
+from accelerate_tpu.utils.dataclasses import (
+    ControllerConfig,
+    FleetConfig,
+    ServingConfig,
+)
+from accelerate_tpu.utils.fault import ControllerStaleError, PerfDriftError
+
+QUEUE_CAP = 256
+
+
+class FakeWatch:
+    def __init__(self, findings=()):
+        self.findings = list(findings)
+
+    def consume_drift_findings(self):
+        out, self.findings = self.findings, []
+        return out
+
+
+class FakeSpecEngine:
+    spec = object()  # truthy: the spec rung applies
+
+    def __init__(self):
+        self.limits = []
+
+    def set_spec_draft_limit(self, n):
+        self.limits.append(n)
+
+
+class FakeServer:
+    def __init__(self, engine=None, **cfg_overrides):
+        self.config = ServingConfig(**cfg_overrides)
+        self.engine = engine
+
+
+class FakeRouter:
+    """The narrow surface SLOController actually uses, with injectable
+    queue depth / breaker / probe-stamp state."""
+
+    def __init__(self, n=2, clock=None, hedge=0.5, can_scale=True, **srv_kw):
+        self._servers = {f"r{i}": FakeServer(**srv_kw) for i in range(n)}
+        self.config = FleetConfig(hedge_deadline_fraction=hedge)
+        self.extra_metrics = []
+        self.can_scale = can_scale
+        self.scaled = []
+        self.clock = clock or (lambda: 0.0)
+        self.depth = 0
+        self.breaker_open = set()
+        self.unreadable = set()
+        self.ttft_p99 = None
+        self.ttft_count = 0
+
+    def replica_ids(self):
+        return sorted(self._servers)
+
+    def servers(self):
+        return dict(self._servers)
+
+    def refresh_replica_metrics(self):
+        return {
+            rid: {
+                "queue_depth": self.depth,
+                "queue_free": QUEUE_CAP - self.depth,
+                "breaker_state": 1 if rid in self.breaker_open else 0,
+                "batch_ewma_s": 0.01 * (1 + i),
+            }
+            for i, rid in enumerate(self.replica_ids())
+            if rid not in self.unreadable
+        }
+
+    def metrics_snapshot(self):
+        snap = {"fleet/last_probe_s": self.clock()}
+        if self.ttft_p99 is not None:
+            snap["fleet/replica/r0/serving/ttft_p99"] = self.ttft_p99
+            snap["fleet/replica/r0/serving/ttft_count"] = self.ttft_count
+        for fn in list(self.extra_metrics):
+            snap.update(fn())
+        return snap
+
+    def scale_up(self, rid):
+        self._servers[rid] = FakeServer()
+        self.scaled.append(("up", rid))
+        return self._servers[rid]
+
+    def scale_down(self, rid, timeout=None):
+        self._servers.pop(rid)
+        self.scaled.append(("down", rid))
+        return True
+
+
+def make(router=None, watch=None, **cfg):
+    clock = {"t": 100.0}
+    router = router or FakeRouter(clock=lambda: clock["t"])
+    defaults = dict(
+        knob_cooldown_s=0.0, scale_cooldown_s=0.0,
+        actuation_budget_capacity=100, actuation_budget_refill_per_s=10.0,
+    )
+    defaults.update(cfg)
+    ctl = SLOController(
+        router, ControllerConfig(**defaults),
+        watch=watch or FakeWatch(), clock=lambda: clock["t"],
+    )
+
+    def tick(dt=1.0):
+        clock["t"] += dt
+        router.clock = lambda: clock["t"]
+        ctl.tick()
+
+    return ctl, router, tick
+
+
+# ------------------------------------------------------------------- ladder
+def test_escalates_rungs_in_order_then_scales():
+    eng = FakeSpecEngine()
+    clock = {"t": 100.0}
+    router = FakeRouter(clock=lambda: clock["t"], spec_draft_len=8)
+    for srv in router._servers.values():
+        srv.engine = eng
+    ctl, router, tick = make(router=router)
+    router.depth = int(0.9 * QUEUE_CAP)  # pressure well above 1.0
+    for _ in range(4):
+        tick()
+    assert ctl.engaged_rungs() == ["spec", "degrade", "admission", "hedge"]
+    srv = router._servers["r0"]
+    assert srv.config.spec_draft_len == 4  # halved
+    assert 4 in eng.limits  # and clamped on the engine immediately
+    assert srv.config.max_queue == ServingConfig().max_queue // 2
+    assert router.config.hedge_deadline_fraction is None
+    tick()
+    assert router.scaled == [("up", "ctl-1")]  # ladder exhausted -> scale
+
+
+def test_relax_restores_baseline_and_drains_added_replicas_first():
+    ctl, router, tick = make()
+    orig_queue = router._servers["r0"].config.max_queue
+    router.depth = int(0.9 * QUEUE_CAP)
+    for _ in range(6):
+        tick()
+    assert any(op == "up" for op, _ in router.scaled)
+    router.depth = 0
+    for _ in range(10):
+        tick()
+    downs = [rid for op, rid in router.scaled if op == "down"]
+    assert downs and all(rid.startswith("ctl-") for rid in downs)
+    assert ctl.engaged_rungs() == []
+    assert router._servers["r0"].config.max_queue == orig_queue
+    assert router.config.hedge_deadline_fraction == 0.5
+    assert router.replica_ids() == ["r0", "r1"]  # never below the seed
+
+
+def test_relax_respects_min_replicas():
+    ctl, router, tick = make(min_replicas=2)
+    router.depth = 0
+    for _ in range(5):
+        tick()
+    assert router.scaled == []  # 2 replicas == min_replicas: nothing to drain
+
+
+def test_ttft_slo_breach_escalates():
+    ctl, router, tick = make(ttft_slo_s=0.5, target_queue_fraction=0.9)
+    router.ttft_p99 = 1.0  # 2x the SLO
+    router.ttft_count = 10
+    tick()  # first sighting of the stream: no delta yet, idle
+    assert ctl.metrics["escalations"] == 0
+    router.ttft_count = 20  # stream moving: the percentile is live
+    tick()
+    assert ctl.metrics["escalations"] == 1
+
+
+def test_stale_latency_window_does_not_pin_pressure():
+    # a high p99 left over from departed traffic (count not advancing)
+    # must NOT hold the fleet at peak: pressure falls back to queue terms
+    ctl, router, tick = make(ttft_slo_s=0.5, target_queue_fraction=0.9)
+    router.ttft_p99 = 1.0
+    router.ttft_count = 10
+    tick()
+    router.ttft_count = 20
+    tick()
+    assert ctl.metrics["escalations"] == 1
+    router.depth = 0  # traffic gone; count frozen at 20
+    for _ in range(3):
+        tick()
+    assert ctl.metrics["relaxations"] >= 1
+
+
+# --------------------------------------------------------------- hysteresis
+def test_oscillating_load_inside_dead_band_zero_actuations():
+    ctl, router, tick = make(
+        escalate_threshold=1.0, relax_threshold=0.5,
+        target_queue_fraction=0.5,
+    )
+    for i in range(40):
+        # queue fraction flips 0.3 <-> 0.45 => pressure 0.6 <-> 0.9,
+        # always inside (relax, escalate) — the dead band
+        router.depth = int(QUEUE_CAP * (0.3 if i % 2 else 0.45))
+        tick()
+    assert ctl.metrics["actuations"] == 0
+    assert ctl.metrics["escalations"] == 0
+    assert ctl.metrics["relaxations"] == 0
+    assert router.scaled == []
+
+
+def test_knob_cooldown_blocks_repeat_actuation():
+    ctl, router, tick = make(scale_cooldown_s=100.0, knob_cooldown_s=100.0)
+    router.depth = int(0.9 * QUEUE_CAP)
+    for _ in range(6):
+        tick()  # 1s apart, cooldown 100s: each knob moves at most once
+    assert ctl.metrics["actuations"] <= len(ctl.engaged_rungs()) + 1
+    assert ctl.metrics["actuation_denied_cooldown"] >= 1
+
+
+def test_token_bucket_bounds_actuation_rate():
+    ctl, router, tick = make(
+        actuation_budget_capacity=1, actuation_budget_refill_per_s=0.0,
+    )
+    router.depth = int(0.9 * QUEUE_CAP)
+    for _ in range(6):
+        tick()
+    assert ctl.metrics["actuations"] == 1  # one token, then dry
+    assert ctl.metrics["actuation_denied_budget"] >= 1
+
+
+# --------------------------------------------------------------- fail-static
+def test_observe_fault_freezes_with_exactly_one_typed_finding(fault_inject):
+    ctl, router, tick = make()
+    router.depth = int(0.9 * QUEUE_CAP)  # overload the controller can see
+    tick()  # healthy tick first: the freeze must be a transition
+    acts = ctl.metrics["actuations"]
+    fault_inject("controller_observe:raise")
+    for _ in range(8):
+        tick()
+    assert ctl.frozen
+    findings = ctl.stale_findings()
+    assert len(findings) == 1  # one finding per episode, not per tick
+    assert isinstance(findings[0], ControllerStaleError)
+    assert "fail-static" in str(findings[0])
+    assert ctl.metrics["actuations"] == acts  # frozen = zero actuations
+    assert ctl.metrics["stale_ticks"] == 8
+
+
+def test_recovery_after_observe_fault(fault_inject):
+    ctl, router, tick = make()
+    tick()
+    fault_inject("controller_observe:raise")
+    tick()
+    assert ctl.frozen
+    import os
+
+    from accelerate_tpu.utils.fault import FAULT_INJECT_ENV
+
+    os.environ.pop(FAULT_INJECT_ENV, None)
+    tick()
+    assert not ctl.frozen
+    assert ctl.metrics["recoveries"] == 1
+    router.depth = int(0.9 * QUEUE_CAP)
+    tick()
+    assert ctl.metrics["escalations"] == 1  # actuation resumed
+
+
+def test_partial_coverage_freezes():
+    ctl, router, tick = make(min_coverage=1.0)
+    tick()
+    router.unreadable.add("r1")
+    for _ in range(3):
+        tick()
+    assert ctl.frozen
+    findings = ctl.stale_findings()
+    assert len(findings) == 1
+    assert findings[0].coverage == 0.5
+
+
+def test_stale_probe_stamp_freezes_and_second_episode_gets_new_finding():
+    ctl, router, tick = make(stale_after_s=2.0)
+    tick()
+    stamp = router.clock()  # prober stops stamping here
+    router.clock = lambda: stamp
+    now = stamp + 3.0  # 3s past the stamp > stale_after 2s
+    ctl._clock = lambda: now
+    ctl.tick()
+    ctl.tick()
+    assert ctl.frozen
+    assert len(ctl.stale_findings()) == 1
+    assert ctl.stale_findings()[0].age_s == pytest.approx(3.0)
+    router.clock = lambda: now  # prober catches up: episode ends
+    ctl.tick()
+    assert not ctl.frozen
+    router.clock = lambda: now - 3.0  # and wedges again: a NEW episode
+    ctl.tick()
+    assert ctl.frozen
+    assert len(ctl.stale_findings()) == 2
+
+
+# -------------------------------------------------------------------- drift
+def test_drift_finding_replaces_exactly_one_replica():
+    watch = FakeWatch([PerfDriftError("p", 2.0, 1.0, 0.25)])
+    ctl, router, tick = make(watch=watch, scale_cooldown_s=1000.0)
+    tick()
+    tick()  # finding already consumed; cooldown pins further replaces
+    # exactly one replace: one up + one down, victim = worst batch EWMA (r1)
+    assert router.scaled == [("up", "ctl-1"), ("down", "r1")]
+    assert ctl.metrics["drift_replacements"] == 1
+    assert router.replica_ids() == ["ctl-1", "r0"]
+
+
+def test_drift_without_factory_logs_not_replaces():
+    watch = FakeWatch([PerfDriftError("p", 2.0, 1.0, 0.25)])
+    clock = {"t": 100.0}
+    router = FakeRouter(clock=lambda: clock["t"], can_scale=False)
+    ctl, router, tick = make(router=router, watch=watch)
+    tick()
+    assert router.scaled == []
+    assert ctl.metrics["drift_replacements"] == 0
+
+
+def test_drift_findings_not_consumed_while_frozen(fault_inject):
+    watch = FakeWatch([PerfDriftError("p", 2.0, 1.0, 0.25)])
+    ctl, router, tick = make(watch=watch)
+    fault_inject("controller_observe:raise")
+    tick()
+    assert watch.findings  # untouched: frozen controllers change nothing
+    assert router.scaled == []
+
+
+# ------------------------------------------------------------------ dry run
+def test_dry_run_counts_decisions_but_touches_nothing():
+    ctl, router, tick = make(dry_run=True)
+    orig_queue = router._servers["r0"].config.max_queue
+    router.depth = int(0.9 * QUEUE_CAP)
+    for _ in range(6):
+        tick()
+    assert ctl.metrics["dry_run_actions"] >= 1
+    assert ctl.metrics["actuations"] == 0
+    assert router.scaled == []
+    assert router._servers["r0"].config.max_queue == orig_queue
+    assert router.config.hedge_deadline_fraction == 0.5
+
+
+# ------------------------------------------------------------ observability
+def test_controller_metrics_ride_the_router_snapshot():
+    ctl, router, tick = make()
+    tick()
+    snap = router.metrics_snapshot()
+    assert snap["controller/ticks"] == 1
+    assert "controller/pressure" in snap
+    ctl.close()
+    assert ctl.metrics.snapshot not in router.extra_metrics
+
+
+def test_config_validation():
+    with pytest.raises(ValueError):
+        ControllerConfig(relax_threshold=1.5, escalate_threshold=1.0)
+    with pytest.raises(ValueError):
+        ControllerConfig(min_replicas=5, max_replicas=2)
+    with pytest.raises(ValueError):
+        ControllerConfig(interval_s=0.0)

@@ -136,6 +136,52 @@ def test_least_loaded_avoids_busy_replica():
         router.close()
 
 
+def test_retry_after_hint_backs_replica_off_routing():
+    from accelerate_tpu.utils.fault import ServerOverloaded
+
+    router = make_fleet(2, fleet_kw={"placement": "round_robin"})
+    try:
+        handle = router._handles["r0"]
+        # an overload rejection carrying a retry_after_s hint parks the
+        # replica out of the candidate set for the hinted window
+        router._note_backoff(handle, ServerOverloaded("full", retry_after_s=30.0))
+        assert handle.backoff_until_s > router._clock()
+        res = [
+            router.submit(PROMPT, max_new_tokens=2).result(10)
+            for _ in range(6)
+        ]
+        assert {r.replica_id for r in res} == {"r1"}
+        # window expires -> the replica rejoins the rotation
+        handle.backoff_until_s = 0.0
+        res = [
+            router.submit(PROMPT, max_new_tokens=2).result(10)
+            for _ in range(6)
+        ]
+        assert {r.replica_id for r in res} == {"r0", "r1"}
+        # a zero hint clears any standing backoff instead of setting one
+        router._note_backoff(handle, ServerOverloaded("d", retry_after_s=0.0))
+        assert handle.backoff_until_s == 0.0
+    finally:
+        router.close()
+
+
+def test_all_replicas_backed_off_still_serves():
+    from accelerate_tpu.utils.fault import ServerOverloaded
+
+    router = make_fleet(2)
+    try:
+        for handle in router._handles.values():
+            router._note_backoff(
+                handle, ServerOverloaded("full", retry_after_s=30.0)
+            )
+        # hints are advisory: with every replica backed off the router
+        # must still dispatch (degraded service beats no service)
+        res = router.submit(PROMPT, max_new_tokens=2).result(10)
+        assert res.replica_id in {"r0", "r1"}
+    finally:
+        router.close()
+
+
 def test_results_and_errors_carry_replica_id():
     router = make_fleet(1)
     try:

@@ -125,6 +125,43 @@ def test_queue_full_rejects_with_typed_error():
         srv.close()
 
 
+def test_overload_rejection_carries_retry_after_hint():
+    gate = threading.Event()
+
+    def gated(model, ids, max_new_tokens=4, **kw):
+        gate.wait(10)
+        return np.concatenate(
+            [ids, np.ones((ids.shape[0], max_new_tokens), np.int32)], axis=1
+        )
+
+    cfg = ServingConfig(max_queue=2, max_batch_size=1, batch_window_s=0.0)
+    srv = InferenceServer(object(), cfg, generate_fn=gated)
+    try:
+        srv.submit(np.arange(3))
+        assert wait_until(lambda: srv.queue_depth() == 0)
+        for _ in range(2):
+            srv.submit(np.arange(3))
+        with pytest.raises(ServerOverloaded) as exc_info:
+            srv.submit(np.arange(3))
+        # the hint is EWMA-derived, positive, bounded, and in the message
+        hint = exc_info.value.retry_after_s
+        assert hint is not None and 0.0 < hint <= 5.0
+        assert "resubmit" in str(exc_info.value)
+    finally:
+        gate.set()
+        srv.close()
+
+
+def test_draining_rejection_hints_zero_retry_after():
+    cfg = ServingConfig(max_batch_size=1, batch_window_s=0.0)
+    srv = InferenceServer(object(), cfg, generate_fn=echo_gen())
+    srv.close()
+    with pytest.raises(ServerDrainingError) as exc_info:
+        srv.submit(np.arange(3))
+    # draining = permanent for THIS replica: retry elsewhere immediately
+    assert exc_info.value.retry_after_s == 0.0
+
+
 # ------------------------------------------------------------------ deadlines
 def test_deadline_shed_at_dequeue():
     gate = threading.Event()
