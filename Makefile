@@ -1,18 +1,29 @@
 # Test shards mirroring the reference's Makefile:18-56.
 # PALLAS_AXON_POOL_IPS is unset so CPU runs never touch the TPU relay.
 #
-# `make test`     — CI-sized default (~4 min): slow-marked compile-heavy
-#                   integration tests are skipped (RUN_SLOW gate, the
-#                   reference's slow-test convention).
+# `make test`     — CI-sized default (~7 min): graftcheck + the Pallas
+#                   kernel-validation suite, then the fast pytest shard;
+#                   slow-marked compile-heavy integration tests are skipped
+#                   (RUN_SLOW gate, the reference's slow-test convention).
 # `make test_all` — the FULL suite (incl. slow) in documented shards; total
 #                   ~18 min of mostly jit compile time on the 8-dev CPU mesh.
 PY := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu python
 PY_SLOW := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu RUN_SLOW=1 python
 
-.PHONY: test test_all test_core test_data test_parallel test_models test_cli test_big_modeling test-fault test-serving check-static check-sharding check-concurrency check-numerics check-perf check-all install-hooks bench bench-telemetry bench-serving bench-continuous bench-recovery bench-kv bench-spec bench-fleet bench-trace bench-obs bench-autoscale
+.PHONY: test test_all test_core test_data test_parallel test_models test_cli test_big_modeling test-fault test-serving check-static check-kernels check-sharding check-concurrency check-numerics check-perf check-all install-hooks bench bench-telemetry bench-serving bench-continuous bench-recovery bench-kv bench-spec bench-fleet bench-trace bench-obs bench-autoscale
 
-test: check-static
+test: check-static check-kernels
 	$(PY) -m pytest tests/ -q
+
+# CPU interpret-mode validation of EVERY Pallas kernel entry point (flash
+# variants + the paged flash-decode / fused-verify / fused-sampling serving
+# kernels) against their reference ops, regenerating the committed artifact
+# write-to-temp + rename so a failing run never clobbers the last good one.
+# Same suite as `python bench.py --kernel-gate` (which prints to stdout
+# without touching the artifact).
+check-kernels:
+	$(PY) benchmarks/kernel_validation.py > runs/kernel_validation_cpu_interpret.jsonl.tmp
+	mv runs/kernel_validation_cpu_interpret.jsonl.tmp runs/kernel_validation_cpu_interpret.jsonl
 
 # graftcheck: static invariant analysis (docs/static_analysis.md).
 # Level 1 AOT-lowers the registered hot programs (fused train step, engine

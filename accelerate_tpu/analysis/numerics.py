@@ -643,7 +643,7 @@ def compare_nondeterminism(observed: Dict[str, Dict[str, int]],
 # --------------------------------------------------------------------------
 
 WITNESS_NAMES = ("forward", "train_step", "engine.dense", "engine.paged",
-                 "engine.spec", "kv.int8_dequant")
+                 "engine.paged_pallas", "engine.spec", "kv.int8_dequant")
 
 
 def _tiny(compute_dtype):
@@ -714,6 +714,8 @@ def _witness_engine(kind: str) -> Dict[str, float]:
         "engine.dense": {},
         "engine.spec": {"spec": "ngram"},
         "engine.paged": {"kv_cache": "paged", "block_size": 4},
+        "engine.paged_pallas": {"kv_cache": "paged", "block_size": 4,
+                                "attention_impl": "pallas"},
     }[kind]
     rng = np.random.default_rng(0)
     prompts = [rng.integers(1, 32, size=n).tolist() for n in (3, 5, 4)]
@@ -815,7 +817,7 @@ def compare_drift(observed: Dict[str, dict], baseline: Dict[str, Any],
 # module prefix (repo-relative, '/'-separated) -> affected program groups.
 # None = every group (a change here invalidates everything lowered).
 _ENGINE_GROUPS = ("engine.dense", "engine.spec", "engine.paged",
-                  "engine.paged_int8")
+                  "engine.paged_pallas", "engine.paged_int8")
 _MODULE_GROUPS = (
     ("accelerate_tpu/analysis/", None),
     # ANY committed baseline edit must trigger a full run: a relaxed budget

@@ -56,6 +56,8 @@ _GROUP_SOURCE = {
     "engine.dense": os.path.join("accelerate_tpu", "engine.py"),
     "engine.spec": os.path.join("accelerate_tpu", "engine.py"),
     "engine.paged": os.path.join("accelerate_tpu", "engine.py"),
+    # the Pallas flash-decode + fused-sampling variant (ops/paged_decode.py)
+    "engine.paged_pallas": os.path.join("accelerate_tpu", "engine.py"),
     # lowered only by Level 5 (analysis/numerics.py): the int8 KV variant
     "engine.paged_int8": os.path.join("accelerate_tpu", "engine.py"),
 }
@@ -145,6 +147,10 @@ def build_engine_programs(groups: Optional[Sequence[str]] = None) -> List[Progra
         ("engine.dense", {}),
         ("engine.spec", {"spec": "ngram"}),
         ("engine.paged", {"kv_cache": "paged", "block_size": 4}),
+        # spec rides along so the pallas config exercises all three
+        # programs (prefill + decode + verify) under the same G004 ceiling
+        ("engine.paged_pallas", {"kv_cache": "paged", "block_size": 4,
+                                 "attention_impl": "pallas", "spec": "ngram"}),
     ]
     model = None
     records: List[ProgramRecord] = []

@@ -62,6 +62,7 @@ from __future__ import annotations
 
 import collections
 import time
+import warnings
 from dataclasses import dataclass, field
 from typing import Any, Callable, List, Optional
 
@@ -250,6 +251,7 @@ class ContinuousBatchingEngine:
         kv_cache: str = "dense",
         block_size: int = 16,
         pool_blocks: Optional[int] = None,
+        attention_impl: str = "reference",
         spec: Optional[str] = None,
         spec_draft_len: int = 4,
         spec_ngram: int = 3,
@@ -296,10 +298,30 @@ class ContinuousBatchingEngine:
             )
         self.readback_lag = readback_lag
         self._clock = clock
+        if attention_impl not in ("reference", "pallas"):
+            raise ValueError(
+                f"attention_impl must be 'reference' or 'pallas', got "
+                f"{attention_impl!r}"
+            )
+        if (
+            attention_impl == "pallas"
+            and getattr(self.config, "sliding_window", None) is not None
+        ):
+            # the paged flash kernels walk the FULL live block table; a
+            # sliding-window mask would need per-block skip logic the kernel
+            # doesn't implement — downgrade up-front (the model-side
+            # _use_pallas_attention check is the belt-and-braces twin)
+            warnings.warn(
+                "attention_impl='pallas' does not support sliding-window "
+                "configs; falling back to the reference paged attention op",
+                stacklevel=2,
+            )
+            attention_impl = "reference"
+        self.attention_impl = attention_impl
         self._backend = make_kv_backend(
             kv_cache, config=self.config, slots=slots, max_len=max_len,
             prompt_bucket=self.prompt_bucket, block_size=block_size,
-            pool_blocks=pool_blocks,
+            pool_blocks=pool_blocks, attention_impl=attention_impl,
         )
         if isinstance(self.config, GPT2Config):
             self._prefill_at_fn, self._decode_fn = gpt2_prefill_at, gpt2_decode_step
@@ -414,7 +436,24 @@ class ContinuousBatchingEngine:
         pairs = jax.vmap(jax.random.split)(jax.random.wrap_key_data(key_data))
         next_kd = jax.random.key_data(pairs[:, 0])
         subs = pairs[:, 1]
-        nxt = _sample_rows(logits, subs, carried["temp"], carried["top_k"], carried["top_p"])
+        if self.attention_impl == "pallas":
+            # fused sampling epilogue kernel: bitwise the same draw as
+            # _sample_rows (categorical == argmax(filtered + gumbel), and
+            # the kernel's sort-free filter matches _filter_logits exactly).
+            # Gumbel noise is generated outside the kernel — pltpu.prng is
+            # unavailable in CPU interpret mode, and this keeps the PRNG
+            # stream byte-identical to the reference path.
+            from .ops.paged_decode import fused_sample
+
+            v = logits.shape[-1]
+            noise = jax.vmap(
+                lambda kk: jax.random.gumbel(kk, (v,), jnp.float32)
+            )(subs)
+            nxt = fused_sample(
+                logits, noise, carried["temp"], carried["top_k"], carried["top_p"]
+            )
+        else:
+            nxt = _sample_rows(logits, subs, carried["temp"], carried["top_k"], carried["top_p"])
         emitting = ~done
         nxt = jnp.where(emitting, nxt, carried["pad"])
         budget = carried["budget"] - emitting.astype(jnp.int32)
@@ -1174,7 +1213,9 @@ class ContinuousBatchingEngine:
         by their committed roofline predictions — perfwatch splits)."""
         now = self._clock()
         dt, self._pw_mark = now - self._pw_mark, now
-        if self.spec is not None:
+        if self.attention_impl == "pallas":
+            family = "engine.paged_pallas"
+        elif self.spec is not None:
             family = "engine.spec"
         elif self._backend.kind.startswith("paged"):
             family = "engine.paged"

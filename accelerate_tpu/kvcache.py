@@ -125,10 +125,16 @@ class PagedKVLayout:
     and scatters it back (quantizing int8). Everything else in attention is
     untouched — one KV story for dense and paged."""
 
-    def __init__(self, tables, block_size: int, compute_dtype):
+    def __init__(self, tables, block_size: int, compute_dtype,
+                 attention_impl: str = "reference"):
         self.tables = tables  # (B, blocks_per_row) int32, traced
         self.block_size = block_size
         self.compute_dtype = compute_dtype
+        # "reference": model gathers view() and commits after attending;
+        # "pallas": model commits the new column first (commit_column) and
+        # the fused flash-decode kernel walks the tables itself — no dense
+        # view is ever materialized (ops/paged_decode.py)
+        self.attention_impl = attention_impl
 
     def view(self, layer_cache):
         """Gather one layer's pool slice into the dense per-slot view:
@@ -154,6 +160,29 @@ class PagedKVLayout:
         if jnp.ndim(pos) == 0:
             pos = jnp.broadcast_to(pos, (self.tables.shape[0],))
         col = jnp.take_along_axis(view, pos[:, None, None, None], axis=1)[:, 0]
+        blk = jnp.take_along_axis(
+            self.tables, (pos // self.block_size)[:, None], axis=1
+        )[:, 0]
+        off = pos % self.block_size
+        if isinstance(layer_cache, dict):
+            q, s = kv_quantize(col)
+            return {
+                "q": layer_cache["q"].at[blk, off].set(q),
+                "s": layer_cache["s"].at[blk, off].set(s),
+            }
+        return layer_cache.at[blk, off].set(col.astype(layer_cache.dtype))
+
+    def commit_column(self, layer_cache, col, pos):
+        """Scatter one freshly-computed K (or V) column ``col`` (B, 1, kvh,
+        hd) at ``pos`` directly into the pool slice — the Pallas decode
+        path's commit-BEFORE-attend: the kernel then reads the column back
+        from the pool (store→load identity in f32; one bounded quantization
+        for int8), so no dense view is ever gathered. Same ghost-slot
+        safety as :meth:`commit`: released rows' table entries are the null
+        block, a garbage sink."""
+        if jnp.ndim(pos) == 0:
+            pos = jnp.broadcast_to(pos, (self.tables.shape[0],))
+        col = col[:, 0]
         blk = jnp.take_along_axis(
             self.tables, (pos // self.block_size)[:, None], axis=1
         )[:, 0]
@@ -524,7 +553,9 @@ class DenseKVBackend(KVCacheBackend):
     def stats(self):
         return {
             "backend": self.kind,
+            # dense decode reads the whole arena every step: live == pool
             "hbm_bytes": self.hbm_bytes(),
+            "hbm_bytes_live": self.hbm_bytes(),
             "reserved_tokens": self.reserved_tokens(),
         }
 
@@ -539,7 +570,12 @@ class PagedKVBackend(KVCacheBackend):
 
     def __init__(self, *, config, slots: int, max_len: int, prompt_bucket: int,
                  block_size: int = 16, pool_blocks: Optional[int] = None,
-                 quantized: bool = False):
+                 quantized: bool = False, attention_impl: str = "reference"):
+        if attention_impl not in ("reference", "pallas"):
+            raise ValueError(
+                f"attention_impl must be 'reference' or 'pallas', "
+                f"got {attention_impl!r}"
+            )
         if block_size < 1:
             raise ValueError(f"block_size must be >= 1, got {block_size}")
         if max_len % block_size != 0:
@@ -569,6 +605,7 @@ class PagedKVBackend(KVCacheBackend):
         self._layers = config.num_hidden_layers
         self._dtype = config.compute_dtype
         self.kind = "paged_int8" if quantized else "paged"
+        self.attention_impl = attention_impl
         self.pool = PagedBlockPool(
             num_blocks=pool_blocks, block_size=block_size, slots=slots,
             blocks_per_row=self.blocks_per_row,
@@ -587,7 +624,10 @@ class PagedKVBackend(KVCacheBackend):
         return {"k": jnp.zeros(shape, self._dtype), "v": jnp.zeros(shape, self._dtype)}
 
     def make_layout(self, tables):
-        return PagedKVLayout(tables, self.block_size, self._dtype)
+        return PagedKVLayout(
+            tables, self.block_size, self._dtype,
+            attention_impl=self.attention_impl,
+        )
 
     def prefill_write(self, cache, new_cache, slot, table_row):
         """Per-block ``dynamic_update_slice`` writes of the bucketed prefill
@@ -663,14 +703,26 @@ class PagedKVBackend(KVCacheBackend):
         self.pool.reset()
         self._device_tables_cache = None
 
-    def hbm_bytes(self):
+    def _per_block_bytes(self):
         per_block = self._layers * self.block_size * self._kvh * self._hd
         if self.quantized:
             # int8 payload + f32 per-position scales
             per_block = per_block * 1 + self._layers * self.block_size * 4
         else:
             per_block *= jnp.dtype(self._dtype).itemsize
-        return 2 * self.pool_blocks * per_block
+        return per_block
+
+    def hbm_bytes(self):
+        return 2 * self.pool_blocks * self._per_block_bytes()
+
+    def hbm_bytes_live(self):
+        """Bytes the Pallas flash-decode kernel actually reads per step:
+        allocated (refcounted) blocks only — the dead tail of each table
+        row is compute-skipped and the null block is never live. The pool
+        footprint (:meth:`hbm_bytes`) stays what HBM *holds*; this is what
+        a decode step *touches* — the runtime counterpart of the G203
+        per-program HBM table's pallas rows."""
+        return 2 * self.pool.active_blocks() * self._per_block_bytes()
 
     def reserved_tokens(self):
         return (self.pool.active_blocks()) * self.block_size
@@ -680,7 +732,9 @@ class PagedKVBackend(KVCacheBackend):
             "backend": self.kind,
             "block_size": self.block_size,
             "pool_blocks": self.pool_blocks,
+            "attention_impl": self.attention_impl,
             "hbm_bytes": self.hbm_bytes(),
+            "hbm_bytes_live": self.hbm_bytes_live(),
             "reserved_tokens": self.reserved_tokens(),
             **self.pool.stats(),
         }
@@ -688,15 +742,23 @@ class PagedKVBackend(KVCacheBackend):
 
 def make_kv_backend(kind: str, *, config, slots: int, max_len: int,
                     prompt_bucket: int, block_size: int = 16,
-                    pool_blocks: Optional[int] = None) -> KVCacheBackend:
+                    pool_blocks: Optional[int] = None,
+                    attention_impl: str = "reference") -> KVCacheBackend:
     """Factory the engine (and ``ServingConfig.kv_cache``) selects through."""
     if kind == "dense":
+        if attention_impl != "reference":
+            raise ValueError(
+                "attention_impl='pallas' requires a paged KV cache "
+                "(kv_cache='paged' or 'paged_int8'); the dense arena has no "
+                "block tables for the kernel to walk"
+            )
         return DenseKVBackend(config=config, slots=slots, max_len=max_len)
     if kind in ("paged", "paged_int8"):
         return PagedKVBackend(
             config=config, slots=slots, max_len=max_len,
             prompt_bucket=prompt_bucket, block_size=block_size,
             pool_blocks=pool_blocks, quantized=(kind == "paged_int8"),
+            attention_impl=attention_impl,
         )
     raise ValueError(
         f"kv_cache must be one of {KV_BACKENDS}, got {kind!r}"

@@ -30,7 +30,10 @@ from ..parallel.sharding import constrain_activation, replicate_over_fsdp
 from .bert import _apply_dense, _dense, layer_norm
 from .llama import (
     _ce_from_hidden,
+    _pallas_decode_override,
+    _pallas_verify_override,
     _remat_policy,
+    _use_pallas_attention,
     _write_kv_at,
     _write_kv_window,
     llama_ce_denominator,
@@ -384,11 +387,14 @@ def gpt2_prefill_at(config: GPT2Config, params, input_ids, max_len: int, last_in
     return _gpt2_head(config, params, x_last), cache
 
 
-def _gpt2_decode_layer(config: GPT2Config, lp, x, cache_k, cache_v, pos):
+def _gpt2_decode_layer(config: GPT2Config, lp, x, cache_k, cache_v, pos,
+                       attention_override=None):
     """One block, one new position; updates the (B, max_len, h, hd) caches.
     ``pos`` is a traced scalar (lockstep batch) or (B,) vector (per-row
     positions — continuous-batching slots), same contract as llama's
-    ``_decode_layer``."""
+    ``_decode_layer`` including the Pallas ``attention_override`` hook
+    (takes the new-position q/k/v, owns the KV commit, returns the
+    attended output plus updated caches)."""
     cdt = config.compute_dtype
     b, s, d = x.shape  # s == 1
     h, hd = config.num_attention_heads, config.head_dim
@@ -397,16 +403,20 @@ def _gpt2_decode_layer(config: GPT2Config, lp, x, cache_k, cache_v, pos):
     q = _apply_dense(lp["attn"]["c_attn_q"], y, cdt).reshape(b, s, h, hd)
     k = _apply_dense(lp["attn"]["c_attn_k"], y, cdt).reshape(b, s, h, hd)
     v = _apply_dense(lp["attn"]["c_attn_v"], y, cdt).reshape(b, s, h, hd)
-    cache_k = _write_kv_at(cache_k, k, pos)
-    cache_v = _write_kv_at(cache_v, v, pos)
-    scores = jnp.einsum(
-        "bqhd,bkhd->bhqk", q * (1.0 / np.sqrt(hd)), cache_k.astype(cdt)
-    ).astype(jnp.float32)
-    k_pos = lax.broadcasted_iota(jnp.int32, scores.shape, 3)
-    pos_b = pos if jnp.ndim(pos) == 0 else pos[:, None, None, None]
-    scores = jnp.where(k_pos <= pos_b, scores, -1e6)
-    weights = jax.nn.softmax(scores, axis=-1)
-    attn = jnp.einsum("bhqk,bkhd->bqhd", weights.astype(cdt), cache_v.astype(cdt))
+    if attention_override is not None:
+        attn, cache_k, cache_v = attention_override(q, k, v)
+        attn = attn.astype(cdt)
+    else:
+        cache_k = _write_kv_at(cache_k, k, pos)
+        cache_v = _write_kv_at(cache_v, v, pos)
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", q * (1.0 / np.sqrt(hd)), cache_k.astype(cdt)
+        ).astype(jnp.float32)
+        k_pos = lax.broadcasted_iota(jnp.int32, scores.shape, 3)
+        pos_b = pos if jnp.ndim(pos) == 0 else pos[:, None, None, None]
+        scores = jnp.where(k_pos <= pos_b, scores, -1e6)
+        weights = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", weights.astype(cdt), cache_v.astype(cdt))
     attn = _apply_dense(lp["attn"]["c_proj"], attn.reshape(b, s, d), cdt)
     x = x + attn
 
@@ -431,8 +441,15 @@ def gpt2_decode_step(config: GPT2Config, params, cache, token, pos, *,
     else:
         x = x + jnp.take(wpe, pos, axis=0)[:, None]
 
+    pallas = _use_pallas_attention(config, kv_layout)
+
     def body(x, inputs):
         lp, ck, cv = inputs
+        if pallas:
+            override = _pallas_decode_override(config, kv_layout, pos, ck, cv)
+            x, ck, cv = _gpt2_decode_layer(config, lp, x, None, None, pos,
+                                           attention_override=override)
+            return x, (ck, cv)
         if kv_layout is not None:
             ck_pool, cv_pool = ck, cv
             ck, cv = kv_layout.view(ck), kv_layout.view(cv)
@@ -448,12 +465,15 @@ def gpt2_decode_step(config: GPT2Config, params, cache, token, pos, *,
     return logits[:, 0].astype(jnp.float32), {"k": new_k, "v": new_v}
 
 
-def _gpt2_verify_layer(config: GPT2Config, lp, x, cache_k, cache_v, pos):
+def _gpt2_verify_layer(config: GPT2Config, lp, x, cache_k, cache_v, pos,
+                       attention_override=None):
     """One block over a W-token speculative-verify window at positions
     ``pos .. pos+W-1`` (``pos`` a traced (B,) vector). Same read-only-cache
     contract as llama's ``_verify_layer``: the window's K/V go into a
-    temporary scatter-written copy for the causal attend, and the raw
-    window K/V are returned for the caller's accepted-prefix commit."""
+    temporary scatter-written copy for the causal attend (or straight to
+    the Pallas ``attention_override``, which attends them in-register),
+    and the raw window K/V are returned for the caller's accepted-prefix
+    commit."""
     cdt = config.compute_dtype
     b, w, d = x.shape
     h, hd = config.num_attention_heads, config.head_dim
@@ -463,17 +483,20 @@ def _gpt2_verify_layer(config: GPT2Config, lp, x, cache_k, cache_v, pos):
     k = _apply_dense(lp["attn"]["c_attn_k"], y, cdt).reshape(b, w, h, hd)
     v = _apply_dense(lp["attn"]["c_attn_v"], y, cdt).reshape(b, w, h, hd)
     win_k, win_v = k, v
-    cache_k = _write_kv_window(cache_k, k, pos)
-    cache_v = _write_kv_window(cache_v, v, pos)
-    scores = jnp.einsum(
-        "bqhd,bkhd->bhqk", q * (1.0 / np.sqrt(hd)), cache_k.astype(cdt)
-    ).astype(jnp.float32)
-    k_pos = lax.broadcasted_iota(jnp.int32, scores.shape, 3)
-    q_idx = lax.broadcasted_iota(jnp.int32, scores.shape, 2)
-    pos_b = pos[:, None, None, None]
-    scores = jnp.where(k_pos <= pos_b + q_idx, scores, -1e6)
-    weights = jax.nn.softmax(scores, axis=-1)
-    attn = jnp.einsum("bhqk,bkhd->bqhd", weights.astype(cdt), cache_v.astype(cdt))
+    if attention_override is not None:
+        attn = attention_override(q, k, v).astype(cdt)
+    else:
+        cache_k = _write_kv_window(cache_k, k, pos)
+        cache_v = _write_kv_window(cache_v, v, pos)
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", q * (1.0 / np.sqrt(hd)), cache_k.astype(cdt)
+        ).astype(jnp.float32)
+        k_pos = lax.broadcasted_iota(jnp.int32, scores.shape, 3)
+        q_idx = lax.broadcasted_iota(jnp.int32, scores.shape, 2)
+        pos_b = pos[:, None, None, None]
+        scores = jnp.where(k_pos <= pos_b + q_idx, scores, -1e6)
+        weights = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", weights.astype(cdt), cache_v.astype(cdt))
     attn = _apply_dense(lp["attn"]["c_proj"], attn.reshape(b, w, d), cdt)
     x = x + attn
 
@@ -499,8 +522,15 @@ def gpt2_verify_step(config: GPT2Config, params, cache, tokens, pos, *,
     abs_pos = pos[:, None] + jnp.arange(w, dtype=pos.dtype)[None, :]  # (B, W)
     x = x + jnp.take(wpe, abs_pos, axis=0)
 
+    pallas = _use_pallas_attention(config, kv_layout)
+
     def body(x, inputs):
         lp, ck, cv = inputs
+        if pallas:
+            override = _pallas_verify_override(config, kv_layout, pos, ck, cv)
+            x, wk, wv = _gpt2_verify_layer(config, lp, x, None, None, pos,
+                                           attention_override=override)
+            return x, (wk, wv)
         if kv_layout is not None:
             ck, cv = kv_layout.view(ck), kv_layout.view(cv)
         x, wk, wv = _gpt2_verify_layer(config, lp, x, ck, cv, pos)

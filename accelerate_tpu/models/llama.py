@@ -1187,14 +1187,19 @@ def init_kv_cache(config: LlamaConfig, batch_size: int, max_len: int, dtype=None
 
 
 def _decode_layer(config: LlamaConfig, layer_params, x, cache_k, cache_v, pos,
-                  sliding=None):
+                  sliding=None, attention_override=None):
     """One block, one new position; returns updated (cache_k, cache_v).
     ``pos`` is a traced scalar (whole batch at one position — the fused
     generate scan) or a traced (B,) vector (per-row positions — the
     continuous-batching engine's slot decode). ``sliding``: None = uniform
     config.sliding_window behavior; a traced bool applies the window only
     when true (Gemma-2 alternating layers — the flag rides the decode scan
-    as a per-layer xs array)."""
+    as a per-layer xs array). ``attention_override``: the Pallas paged
+    path — a callable ``(q, k_new, v_new) -> (attn, cache_k, cache_v)``
+    receiving the rope-rotated projections; it owns both the KV store
+    write and the attention (cache_k/cache_v operands are then whatever
+    the override's store carries, e.g. pool slices — never touched
+    here)."""
     h, kvh, hd = config.num_attention_heads, config.num_key_value_heads, config.head_dim
     b, s, d = x.shape  # s == 1
     cdt = config.compute_dtype
@@ -1213,33 +1218,40 @@ def _decode_layer(config: LlamaConfig, layer_params, x, cache_k, cache_v, pos,
     v = _dproj("v_proj").reshape(b, s, kvh, hd)
     q = apply_rope_at(q, pos, config.rope_theta, config._rope_scaling_key())
     k = apply_rope_at(k, pos, config.rope_theta, config._rope_scaling_key())
-    cache_k = _write_kv_at(cache_k, k, pos)
-    cache_v = _write_kv_at(cache_v, v, pos)
-    # attend over positions 0..pos (mask the tail). GQA attends GROUPED: q is
-    # reshaped (B, 1, Hkv, n_rep, hd) and each kv head broadcasts over its
-    # n_rep query heads inside the einsum — the cache is never physically
-    # tiled n_rep×, so decode reads Hkv heads of KV, not H.
-    n_rep = h // kvh
-    attn_scale = 1.0 / np.sqrt(config.query_pre_attn_scalar or hd)
-    qg = (q * attn_scale).reshape(b, s, kvh, n_rep, hd)
-    scores = jnp.einsum(
-        "bqgrd,bkgd->bgrqk", qg, cache_k.astype(cdt),
-        preferred_element_type=jnp.float32,  # G402: f32 score accumulation
-    )
-    scores = _tanh_softcap(scores, config.attn_logit_softcap)  # pre-mask
-    k_pos = lax.broadcasted_iota(jnp.int32, scores.shape, 4)
-    pos_b = pos if jnp.ndim(pos) == 0 else pos[:, None, None, None, None]
-    scores = jnp.where(k_pos <= pos_b, scores, -1e6)
-    if config.sliding_window is not None:
-        in_window = pos_b - k_pos < config.sliding_window
-        if sliding is not None:  # per-layer alternating flag (traced)
-            in_window = jnp.logical_or(jnp.logical_not(sliding), in_window)
-        scores = jnp.where(in_window, scores, -1e6)
-    weights = jax.nn.softmax(scores, axis=-1)
-    attn = jnp.einsum(
-        "bgrqk,bkgd->bqgrd", weights.astype(cdt), cache_v.astype(cdt),
-        preferred_element_type=jnp.float32,  # G402: f32 PV accumulation
-    ).astype(cdt)
+    if attention_override is not None:
+        # Pallas paged path: the override commits the new column into the
+        # pool FIRST, then the flash-decode kernel reads it back along the
+        # block-table walk — same k_pos <= pos semantics, no dense view.
+        attn, cache_k, cache_v = attention_override(q, k, v)
+        attn = attn.astype(cdt)
+    else:
+        cache_k = _write_kv_at(cache_k, k, pos)
+        cache_v = _write_kv_at(cache_v, v, pos)
+        # attend over positions 0..pos (mask the tail). GQA attends GROUPED: q
+        # is reshaped (B, 1, Hkv, n_rep, hd) and each kv head broadcasts over
+        # its n_rep query heads inside the einsum — the cache is never
+        # physically tiled n_rep×, so decode reads Hkv heads of KV, not H.
+        n_rep = h // kvh
+        attn_scale = 1.0 / np.sqrt(config.query_pre_attn_scalar or hd)
+        qg = (q * attn_scale).reshape(b, s, kvh, n_rep, hd)
+        scores = jnp.einsum(
+            "bqgrd,bkgd->bgrqk", qg, cache_k.astype(cdt),
+            preferred_element_type=jnp.float32,  # G402: f32 score accumulation
+        )
+        scores = _tanh_softcap(scores, config.attn_logit_softcap)  # pre-mask
+        k_pos = lax.broadcasted_iota(jnp.int32, scores.shape, 4)
+        pos_b = pos if jnp.ndim(pos) == 0 else pos[:, None, None, None, None]
+        scores = jnp.where(k_pos <= pos_b, scores, -1e6)
+        if config.sliding_window is not None:
+            in_window = pos_b - k_pos < config.sliding_window
+            if sliding is not None:  # per-layer alternating flag (traced)
+                in_window = jnp.logical_or(jnp.logical_not(sliding), in_window)
+            scores = jnp.where(in_window, scores, -1e6)
+        weights = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum(
+            "bgrqk,bkgd->bqgrd", weights.astype(cdt), cache_v.astype(cdt),
+            preferred_element_type=jnp.float32,  # G402: f32 PV accumulation
+        ).astype(cdt)
     attn = attn.reshape(b, s, h * hd) @ layer_params["attn"]["o_proj"]["kernel"].astype(cdt)
     if config.post_block_norms:
         attn = rms_norm(attn, layer_params["attn_out_norm"]["scale"],
@@ -1273,7 +1285,7 @@ def _decode_layer(config: LlamaConfig, layer_params, x, cache_k, cache_v, pos,
 
 
 def _verify_layer(config: LlamaConfig, layer_params, x, cache_k, cache_v, pos,
-                  sliding=None):
+                  sliding=None, attention_override=None):
     """One block over a W-token speculative-verify window: ``x`` is
     (B, W, D) — the carried token plus k draft tokens — at positions
     ``pos .. pos+W-1`` (``pos`` a traced (B,) vector). The cache operands
@@ -1304,34 +1316,42 @@ def _verify_layer(config: LlamaConfig, layer_params, x, cache_k, cache_v, pos,
     q = apply_rope_window(q, pos, config.rope_theta, config._rope_scaling_key())
     k = apply_rope_window(k, pos, config.rope_theta, config._rope_scaling_key())
     win_k, win_v = k, v
-    cache_k = _write_kv_window(cache_k, k, pos)
-    cache_v = _write_kv_window(cache_v, v, pos)
-    # Causal over past + window: query j (absolute position pos+j) attends
-    # k_pos <= pos+j. Same grouped-GQA einsum as _decode_layer — per-(q, k)
-    # score elements are independent dot products, so the q_idx=0 row of
-    # this window reproduces the single-token decode scores bitwise.
-    n_rep = h // kvh
-    attn_scale = 1.0 / np.sqrt(config.query_pre_attn_scalar or hd)
-    qg = (q * attn_scale).reshape(b, w, kvh, n_rep, hd)
-    scores = jnp.einsum(
-        "bqgrd,bkgd->bgrqk", qg, cache_k.astype(cdt),
-        preferred_element_type=jnp.float32,  # G402: f32 score accumulation
-    )
-    scores = _tanh_softcap(scores, config.attn_logit_softcap)  # pre-mask
-    k_pos = lax.broadcasted_iota(jnp.int32, scores.shape, 4)
-    q_idx = lax.broadcasted_iota(jnp.int32, scores.shape, 3)
-    pos_b = pos[:, None, None, None, None]
-    scores = jnp.where(k_pos <= pos_b + q_idx, scores, -1e6)
-    if config.sliding_window is not None:
-        in_window = (pos_b + q_idx) - k_pos < config.sliding_window
-        if sliding is not None:  # per-layer alternating flag (traced)
-            in_window = jnp.logical_or(jnp.logical_not(sliding), in_window)
-        scores = jnp.where(in_window, scores, -1e6)
-    weights = jax.nn.softmax(scores, axis=-1)
-    attn = jnp.einsum(
-        "bgrqk,bkgd->bqgrd", weights.astype(cdt), cache_v.astype(cdt),
-        preferred_element_type=jnp.float32,  # G402: f32 PV accumulation
-    ).astype(cdt)
+    if attention_override is not None:
+        # Pallas paged path: the kernel reads committed history from the
+        # pool (strictly k_pos < pos) and attends the fresh window columns
+        # in-register — nothing is scatter-written, matching this layer's
+        # read-only cache contract exactly.
+        attn = attention_override(q, k, v).astype(cdt)
+    else:
+        cache_k = _write_kv_window(cache_k, k, pos)
+        cache_v = _write_kv_window(cache_v, v, pos)
+        # Causal over past + window: query j (absolute position pos+j)
+        # attends k_pos <= pos+j. Same grouped-GQA einsum as _decode_layer —
+        # per-(q, k) score elements are independent dot products, so the
+        # q_idx=0 row of this window reproduces the single-token decode
+        # scores bitwise.
+        n_rep = h // kvh
+        attn_scale = 1.0 / np.sqrt(config.query_pre_attn_scalar or hd)
+        qg = (q * attn_scale).reshape(b, w, kvh, n_rep, hd)
+        scores = jnp.einsum(
+            "bqgrd,bkgd->bgrqk", qg, cache_k.astype(cdt),
+            preferred_element_type=jnp.float32,  # G402: f32 score accumulation
+        )
+        scores = _tanh_softcap(scores, config.attn_logit_softcap)  # pre-mask
+        k_pos = lax.broadcasted_iota(jnp.int32, scores.shape, 4)
+        q_idx = lax.broadcasted_iota(jnp.int32, scores.shape, 3)
+        pos_b = pos[:, None, None, None, None]
+        scores = jnp.where(k_pos <= pos_b + q_idx, scores, -1e6)
+        if config.sliding_window is not None:
+            in_window = (pos_b + q_idx) - k_pos < config.sliding_window
+            if sliding is not None:  # per-layer alternating flag (traced)
+                in_window = jnp.logical_or(jnp.logical_not(sliding), in_window)
+            scores = jnp.where(in_window, scores, -1e6)
+        weights = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum(
+            "bgrqk,bkgd->bqgrd", weights.astype(cdt), cache_v.astype(cdt),
+            preferred_element_type=jnp.float32,  # G402: f32 PV accumulation
+        ).astype(cdt)
     attn = attn.reshape(b, w, h * hd) @ layer_params["attn"]["o_proj"]["kernel"].astype(cdt)
     if config.post_block_norms:
         attn = rms_norm(attn, layer_params["attn_out_norm"]["scale"],
@@ -1519,6 +1539,85 @@ def llama_prefill_at(config: LlamaConfig, params, input_ids, max_len: int, last_
     return _prefill_head(config, params, x_last), _pad_prefill_cache(ks, vs, max_len)
 
 
+def _use_pallas_attention(config, kv_layout) -> bool:
+    """Whether this dispatch routes attention through the Pallas paged
+    flash kernels (ops/paged_decode.py): opted in on the layout
+    (``KVCacheBackend.attention_impl``) and structurally unsupported for
+    sliding-window configs — the engine downgrades those to the reference
+    op up-front, this is the belt-and-braces model-side check. ``getattr``
+    keeps it usable from model families whose configs lack the llama-only
+    fields (gpt2 has no sliding window, softcap or query scalar)."""
+    return (
+        kv_layout is not None
+        and getattr(kv_layout, "attention_impl", "reference") == "pallas"
+        and getattr(config, "sliding_window", None) is None
+    )
+
+
+def _pallas_attn_scale(config) -> float:
+    return float(
+        1.0 / np.sqrt(getattr(config, "query_pre_attn_scalar", None) or config.head_dim)
+    )
+
+
+def _pallas_decode_override(config, kv_layout, pos, ck_pool, cv_pool):
+    """Decode-step attention override: commit the rope-rotated new K/V
+    column into the pool FIRST (``commit_column`` — no dense view), then
+    run the flash-decode kernel over the block tables. Store→load identity
+    makes this exact in f32; int8 pools pay one bounded quantization on
+    the current column (the same 4e-3·amax bound as every other committed
+    position)."""
+    from ..ops.paged_decode import paged_flash_decode
+
+    attn_scale = _pallas_attn_scale(config)
+    softcap = getattr(config, "attn_logit_softcap", None)
+
+    def override(q, k_new, v_new):
+        ck = kv_layout.commit_column(ck_pool, k_new, pos)
+        cv = kv_layout.commit_column(cv_pool, v_new, pos)
+        p = pos if jnp.ndim(pos) != 0 else jnp.broadcast_to(pos, (q.shape[0],))
+        if isinstance(ck, dict):
+            out = paged_flash_decode(
+                q, ck["q"], cv["q"], kv_layout.tables, p,
+                k_scale=ck["s"], v_scale=cv["s"],
+                scale=attn_scale, softcap=softcap,
+            )
+        else:
+            out = paged_flash_decode(
+                q, ck, cv, kv_layout.tables, p,
+                scale=attn_scale, softcap=softcap,
+            )
+        return out, ck, cv
+
+    return override
+
+
+def _pallas_verify_override(config, kv_layout, pos, ck_pool, cv_pool):
+    """Verify-step attention override: the kernel walks committed history
+    in the pool (strictly ``k_pos < pos``) and attends the fresh window
+    K/V in-register — read-only on the pool, commit-after-accept stays
+    with the engine."""
+    from ..ops.paged_decode import paged_flash_verify
+
+    attn_scale = _pallas_attn_scale(config)
+    softcap = getattr(config, "attn_logit_softcap", None)
+
+    def override(q, k_win, v_win):
+        if isinstance(ck_pool, dict):
+            return paged_flash_verify(
+                q, ck_pool["q"], cv_pool["q"], k_win, v_win,
+                kv_layout.tables, pos,
+                k_scale=ck_pool["s"], v_scale=cv_pool["s"],
+                scale=attn_scale, softcap=softcap,
+            )
+        return paged_flash_verify(
+            q, ck_pool, cv_pool, k_win, v_win, kv_layout.tables, pos,
+            scale=attn_scale, softcap=softcap,
+        )
+
+    return override
+
+
 def llama_decode_step(config: LlamaConfig, params, cache, token, pos, *,
                       kv_layout=None):
     """One decode step: token (B, 1) at position ``pos`` — a traced scalar
@@ -1536,7 +1635,13 @@ def llama_decode_step(config: LlamaConfig, params, cache, token, pos, *,
     if config.scale_embeddings:
         x = x * jnp.asarray(config.hidden_size**0.5, dtype=cdt)
 
+    pallas = _use_pallas_attention(config, kv_layout)
+
     def layer_step(x, layer_params, ck, cv, sliding=None):
+        if pallas:
+            override = _pallas_decode_override(config, kv_layout, pos, ck, cv)
+            return _decode_layer(config, layer_params, x, None, None, pos,
+                                 sliding=sliding, attention_override=override)
         if kv_layout is not None:
             ck_pool, cv_pool = ck, cv
             ck, cv = kv_layout.view(ck), kv_layout.view(cv)
@@ -1597,7 +1702,13 @@ def llama_verify_step(config: LlamaConfig, params, cache, tokens, pos, *,
     if config.scale_embeddings:
         x = x * jnp.asarray(config.hidden_size**0.5, dtype=cdt)
 
+    pallas = _use_pallas_attention(config, kv_layout)
+
     def layer_verify(x, layer_params, ck, cv, sliding=None):
+        if pallas:
+            override = _pallas_verify_override(config, kv_layout, pos, ck, cv)
+            return _verify_layer(config, layer_params, x, None, None, pos,
+                                 sliding=sliding, attention_override=override)
         if kv_layout is not None:
             ck, cv = kv_layout.view(ck), kv_layout.view(cv)
         return _verify_layer(config, layer_params, x, ck, cv, pos,

@@ -145,6 +145,7 @@ def paged_attention(
     k_scale: Optional[jax.Array] = None,
     v_scale: Optional[jax.Array] = None,
     softmax_dtype=jnp.float32,
+    softcap: Optional[float] = None,
 ) -> jax.Array:
     """Single-token decode attention over a paged KV pool — the reference
     semantics (and kernel contract) for the kvcache subsystem's decode path.
@@ -191,6 +192,7 @@ def paged_attention(
     scores = jnp.einsum(
         "bqgrd,bkgd->bgrqk", qg, k, preferred_element_type=softmax_dtype
     ) * scale
+    scores = tanh_softcap(scores, softcap)  # Gemma-2 capping, pre-mask
     k_pos = jnp.arange(sk, dtype=jnp.int32)
     live = k_pos[None, :] <= pos[:, None]  # (B, sk)
     scores = jnp.where(live[:, None, None, None, :], scores, NEG_INF)
@@ -212,6 +214,7 @@ def verify_attention(
     k_scale: Optional[jax.Array] = None,
     v_scale: Optional[jax.Array] = None,
     softmax_dtype=jnp.float32,
+    softcap: Optional[float] = None,
 ) -> jax.Array:
     """Masked multi-query speculative-verify attention over a paged KV
     pool — the reference semantics (and kernel contract) for the engine's
@@ -252,6 +255,7 @@ def verify_attention(
     scores = jnp.einsum(
         "bqgrd,bkgd->bgrqk", qg, k, preferred_element_type=softmax_dtype
     ) * scale
+    scores = tanh_softcap(scores, softcap)  # Gemma-2 capping, pre-mask
     k_pos = jnp.arange(sk, dtype=jnp.int32)
     q_idx = jnp.arange(sq, dtype=jnp.int32)
     live = k_pos[None, None, :] <= pos[:, None, None] + q_idx[None, :, None]

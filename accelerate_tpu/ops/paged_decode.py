@@ -1,0 +1,513 @@
+"""Pallas TPU flash-decode kernels over the paged KV pool + fused sampling.
+
+The paged decode path's reference semantics (`ops/attention.py::
+paged_attention` / `verify_attention`) first gather ``pool[tables]`` into a
+dense per-slot context — HBM traffic scales with *arena capacity* (every
+table entry, live or null, is materialized and, for int8 pools, dequantized
+in full) rather than with live tokens. The kernels here walk each slot's
+block table *inside* the kernel instead:
+
+* **`paged_flash_decode`** — one query token per slot. Grid ``(slots,
+  kv_heads, blocks_per_row)``; the block tables and per-slot positions ride
+  in as scalar-prefetch operands so every kv tile's BlockSpec index map
+  resolves ``tables[slot, j]`` directly — the DMA fetches pool block
+  ``tables[slot, j]``, nothing else. Blocks wholly past a slot's position
+  are *skipped* (``@pl.when``), never partially weighted — exactly the
+  contract documented on ``paged_attention`` (masked scores softmax to an
+  exp-underflow-exact 0.0, so skipping == computing). For a live slot the
+  skipped tail *is* the row's null-block padding (allocation covers every
+  position ``<= pos``), so released/unallocated entries are never read as
+  real context. int8 pools dequantize per fetched tile from the
+  per-(block, position) scales — only live blocks' scales are ever applied.
+  Online softmax (acc/m/l VMEM scratch, init at j==0, finalize at the last
+  block) with the grouped-GQA layout: q is blocked ``(1, 1, n_rep, d)`` per
+  kv head, so KV is read once per *group*, never repeated ``n_rep``×.
+
+* **`paged_flash_verify`** — the W-token speculative-verify window. Same
+  table walk over committed history, masked *strictly* ``k_pos < pos``
+  (the window's own columns are NOT in the pool — the engine commits only
+  the accepted prefix afterwards); one extra grid step attends the window
+  K/V operands causally (``k_idx <= q_idx``), reproducing
+  ``verify_attention``'s ``k_pos <= pos + q_idx`` mask without ever
+  scatter-writing a temporary view.
+
+* **`fused_sample`** — the sampling epilogue, semantics pinned by
+  ``engine.py::_filter_logits`` / ``_sample_rows``: temperature scaling,
+  top-k, top-p ("nucleus") filtering and the categorical draw fused into
+  one kernel, one program instance per slot row. Instead of materializing
+  a sorted copy of the logits (the reference's ``sort``/``cumsum``), both
+  filters reduce to *threshold* comparisons computed by a 32-step binary
+  search over the order-preserving uint32 image of f32 — the k-th largest
+  value exactly, and the top-p cutoff via the value-level characterization
+  ``keep x  iff  sum(exp(y - m) for kept y > x) < p * Z`` (provably equal
+  to the reference's sorted-cutoff rule, ties included; see the comment on
+  ``_sample_kernel``). The categorical draw takes pre-generated Gumbel
+  noise as an operand — ``argmax(filtered + gumbel(key))`` is bitwise what
+  ``jax.random.categorical`` computes, and TPU in-kernel PRNG
+  (``pltpu.prng_seed``) has no CPU interpret lowering, which would break
+  the tier-1 validation story.
+
+All three follow ``flash_attention.py``'s platform idiom: ``interpret=None``
+resolves to ``jax.default_backend() != "tpu"``, so the same call sites run
+the Mosaic kernel on TPU and the interpret-mode evaluator (bit-identical
+semantics, CPU) everywhere else — the basis of
+``runs/kernel_validation_cpu_interpret.jsonl``.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .attention import NEG_INF
+
+__all__ = ["paged_flash_decode", "paged_flash_verify", "fused_sample"]
+
+
+def _dot_f32(a, b, transpose_b=False):
+    """MXU matmul with an f32 accumulator (G402), operands in storage dtype."""
+    dims = (((1,), (1 if transpose_b else 0,)), ((), ()))
+    return lax.dot_general(a, b, dims, preferred_element_type=jnp.float32)
+
+
+def _resolve_interpret(interpret):
+    if interpret is None:
+        return jax.default_backend() != "tpu"
+    return interpret
+
+
+# ------------------------------------------------------------ decode kernel
+def _decode_kernel(tables_ref, pos_ref, q_ref, k_ref, v_ref, *rest,
+                   block_size, scale, softcap, quantized):
+    if quantized:
+        ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        o_ref, acc_ref, m_ref, l_ref = rest
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    nb = pl.num_programs(2)
+    p = pos_ref[b]
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # Block j holds positions [j*bs, (j+1)*bs): skip it entirely once its
+    # first position is past the query — the paged_attention contract (a
+    # masked block's softmax weight is exactly 0, so skip == compute). For
+    # live slots every surviving j is a real allocated block (allocation
+    # covers all positions <= pos), so the skipped tail IS the row's
+    # null-block padding. Block 0 (positions <= pos always non-empty at
+    # j==0 since pos >= 0) guarantees l > 0 at finalize.
+    @pl.when(j * block_size <= p)
+    def _compute():
+        q = q_ref[0, 0]          # (n_rep, d) — the kv head's whole GQA group
+        k = k_ref[0, :, 0, :]    # (bs, d)
+        v = v_ref[0, :, 0, :]
+        if quantized:
+            k = k.astype(jnp.float32) * ks_ref[0][:, None]
+            v = v.astype(jnp.float32) * vs_ref[0][:, None]
+        s = _dot_f32(q, k, transpose_b=True) * scale  # (n_rep, bs), f32
+        if softcap is not None:  # Gemma-2 tanh capping, pre-mask
+            s = softcap * jnp.tanh(s / softcap)
+        k_pos = j * block_size + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(k_pos <= p, s, NEG_INF)
+
+        m_prev = m_ref[:, 0]
+        l_prev = l_ref[:, 0]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m_prev - m_cur)
+        pexp = jnp.exp(s - m_cur[:, None])
+        l_ref[:, 0] = alpha * l_prev + jnp.sum(pexp, axis=-1)
+        acc_ref[:] = acc_ref[:] * alpha[:, None] + _dot_f32(pexp.astype(v.dtype), v)
+        m_ref[:, 0] = m_cur
+
+    @pl.when(j == nb - 1)
+    def _finalize():
+        l = l_ref[:, 0]
+        o_ref[0, 0] = (acc_ref[:] / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+def paged_flash_decode(
+    q: jax.Array,
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    block_tables: jax.Array,
+    pos: jax.Array,
+    *,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
+    scale: Optional[float] = None,
+    softcap: Optional[float] = None,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Single-token paged decode attention as a Pallas flash kernel.
+
+    Shapes match :func:`~accelerate_tpu.ops.attention.paged_attention`
+    (the reference this kernel is parity-gated against): ``q`` (B, 1, h, d),
+    ``k_pool``/``v_pool`` (num_blocks, block_size, h_kv, d) — int8 with
+    ``k_scale``/``v_scale`` (num_blocks, block_size) — ``block_tables``
+    (B, blocks_per_row) int32, ``pos`` (B,) int32. Returns (B, 1, h, d).
+
+    HBM bytes per step are ``live_blocks * block_size * h_kv * d *
+    itemsize * 2`` (+ scales) instead of the reference gather's
+    ``B * blocks_per_row * block_size * ...`` materialization: the table
+    walk happens in the BlockSpec index map, so only addressed blocks are
+    DMA'd, dead tail blocks are compute-skipped, and int8 stays int8 in HBM
+    (dequantized per tile in VMEM). ``scale`` defaults to ``1/sqrt(d)``;
+    the model path passes its ``query_pre_attn_scalar`` override.
+    ``softcap`` is the static Gemma-2 tanh cap. Sliding-window masking is
+    NOT supported — callers with a sliding-window config must use the
+    reference op (the engine enforces this fallback).
+    """
+    b, sq, h, d = q.shape
+    if sq != 1:
+        raise ValueError(f"paged_flash_decode takes one query token, got {sq}")
+    nb_pool, bs, h_kv, _ = k_pool.shape
+    if h % h_kv != 0:
+        raise ValueError(f"num heads {h} not divisible by kv heads {h_kv}")
+    n_rep = h // h_kv
+    bpr = block_tables.shape[1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    interpret = _resolve_interpret(interpret)
+    quantized = k_scale is not None
+
+    qg = q.reshape(b, h_kv, n_rep, d)
+    kv_spec = pl.BlockSpec((1, bs, 1, d), lambda bb, g, j, t, p: (t[bb, j], 0, g, 0))
+    in_specs = [
+        pl.BlockSpec((1, 1, n_rep, d), lambda bb, g, j, t, p: (bb, g, 0, 0)),
+        kv_spec,
+        kv_spec,
+    ]
+    args = [qg, k_pool, v_pool]
+    if quantized:
+        s_spec = pl.BlockSpec((1, bs), lambda bb, g, j, t, p: (t[bb, j], 0))
+        in_specs += [s_spec, s_spec]
+        args += [k_scale, v_scale]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, h_kv, bpr),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, n_rep, d), lambda bb, g, j, t, p: (bb, g, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((n_rep, d), jnp.float32),
+            pltpu.VMEM((n_rep, 1), jnp.float32),
+            pltpu.VMEM((n_rep, 1), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _decode_kernel, block_size=bs, scale=scale, softcap=softcap,
+            quantized=quantized,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h_kv, n_rep, d), q.dtype),
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), pos.astype(jnp.int32), *args)
+    return out.reshape(b, 1, h, d)
+
+
+# ------------------------------------------------------------ verify kernel
+def _verify_kernel(tables_ref, pos_ref, q_ref, k_ref, v_ref, wk_ref, wv_ref,
+                   *rest, block_size, w, n_rep, scale, softcap, quantized):
+    if quantized:
+        ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        o_ref, acc_ref, m_ref, l_ref = rest
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    nj = pl.num_programs(2)  # blocks_per_row + 1 (last step = the window)
+    p = pos_ref[b]
+    rows = n_rep * w
+    # q row layout: (head-in-group r) * w + (window index q_idx)
+    q_idx = lax.broadcasted_iota(jnp.int32, (rows, 1), 0) % w
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    def _accumulate(s, v):
+        m_prev = m_ref[:, 0]
+        l_prev = l_ref[:, 0]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m_prev - m_cur)
+        pexp = jnp.exp(s - m_cur[:, None])
+        l_ref[:, 0] = alpha * l_prev + jnp.sum(pexp, axis=-1)
+        acc_ref[:] = acc_ref[:] * alpha[:, None] + _dot_f32(pexp.astype(v.dtype), v)
+        m_ref[:, 0] = m_cur
+
+    # History phase: committed pool blocks, masked STRICTLY k_pos < pos —
+    # the window's own positions [pos, pos+W) are not in the pool (the
+    # engine commits only the accepted prefix afterwards), they arrive as
+    # the wk/wv operands below. k_pos < p <= p + q_idx, so the strict
+    # history mask is uniform across the window's queries, matching
+    # verify_attention's k_pos <= pos + q_idx on every committed position.
+    @pl.when((j < nj - 1) & (j * block_size < p))
+    def _history():
+        q = q_ref[0, 0]          # (rows, d)
+        k = k_ref[0, :, 0, :]    # (bs, d)
+        v = v_ref[0, :, 0, :]
+        if quantized:
+            k = k.astype(jnp.float32) * ks_ref[0][:, None]
+            v = v.astype(jnp.float32) * vs_ref[0][:, None]
+        s = _dot_f32(q, k, transpose_b=True) * scale  # (rows, bs)
+        if softcap is not None:
+            s = softcap * jnp.tanh(s / softcap)
+        k_pos = j * block_size + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(k_pos < p, s, NEG_INF)
+        _accumulate(s, v)
+
+    # Window phase (last grid step): the W fresh K/V columns, attended
+    # causally within the window — query q_idx sees window key k_idx iff
+    # pos + k_idx <= pos + q_idx. Query 0 always sees key 0, so l > 0 at
+    # finalize even when no history block survives (pos == 0).
+    @pl.when(j == nj - 1)
+    def _window():
+        q = q_ref[0, 0]
+        k = wk_ref[0, :, 0, :]   # (w, d) — full precision, never quantized
+        v = wv_ref[0, :, 0, :]
+        s = _dot_f32(q, k, transpose_b=True) * scale  # (rows, w)
+        if softcap is not None:
+            s = softcap * jnp.tanh(s / softcap)
+        k_idx = lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(k_idx <= q_idx, s, NEG_INF)
+        _accumulate(s, v)
+        l = l_ref[:, 0]
+        o_ref[0, 0] = (acc_ref[:] / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+def paged_flash_verify(
+    q: jax.Array,
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    win_k: jax.Array,
+    win_v: jax.Array,
+    block_tables: jax.Array,
+    pos: jax.Array,
+    *,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
+    scale: Optional[float] = None,
+    softcap: Optional[float] = None,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Speculative-verify window attention as a Pallas flash kernel.
+
+    ``q`` (B, W, h, d) at absolute positions ``pos[b] + q_idx``; committed
+    history comes from the paged pool (same table walk and int8 dequant as
+    :func:`paged_flash_decode`, masked strictly ``k_pos < pos``), while the
+    window's own K/V — NOT yet committed — ride in as ``win_k``/``win_v``
+    (B, W, h_kv, d) operands attended causally in-register. Together that
+    reproduces :func:`~accelerate_tpu.ops.attention.verify_attention`'s
+    ``k_pos <= pos + q_idx`` mask without the reference path's
+    scatter-write of a temporary dense view. Returns (B, W, h, d).
+    """
+    b, w, h, d = q.shape
+    nb_pool, bs, h_kv, _ = k_pool.shape
+    if h % h_kv != 0:
+        raise ValueError(f"num heads {h} not divisible by kv heads {h_kv}")
+    n_rep = h // h_kv
+    bpr = block_tables.shape[1]
+    rows = n_rep * w
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    interpret = _resolve_interpret(interpret)
+    quantized = k_scale is not None
+
+    # (B, W, h, d) -> (B, h_kv, n_rep * W, d), row = r * W + q_idx
+    qf = q.reshape(b, w, h_kv, n_rep, d).transpose(0, 2, 3, 1, 4)
+    qf = qf.reshape(b, h_kv, rows, d)
+
+    def _pool_index(bb, g, j, t, p):
+        # clamped on the (skipped) window step so the map stays total
+        return (t[bb, jnp.minimum(j, bpr - 1)], 0, g, 0)
+
+    kv_spec = pl.BlockSpec((1, bs, 1, d), _pool_index)
+    win_spec = pl.BlockSpec((1, w, 1, d), lambda bb, g, j, t, p: (bb, 0, g, 0))
+    in_specs = [
+        pl.BlockSpec((1, 1, rows, d), lambda bb, g, j, t, p: (bb, g, 0, 0)),
+        kv_spec,
+        kv_spec,
+        win_spec,
+        win_spec,
+    ]
+    args = [qf, k_pool, v_pool, win_k, win_v]
+    if quantized:
+        s_spec = pl.BlockSpec(
+            (1, bs), lambda bb, g, j, t, p: (t[bb, jnp.minimum(j, bpr - 1)], 0)
+        )
+        in_specs += [s_spec, s_spec]
+        args += [k_scale, v_scale]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, h_kv, bpr + 1),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, rows, d), lambda bb, g, j, t, p: (bb, g, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((rows, d), jnp.float32),
+            pltpu.VMEM((rows, 1), jnp.float32),
+            pltpu.VMEM((rows, 1), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _verify_kernel, block_size=bs, w=w, n_rep=n_rep, scale=scale,
+            softcap=softcap, quantized=quantized,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h_kv, rows, d), q.dtype),
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), pos.astype(jnp.int32), *args)
+    out = out.reshape(b, h_kv, n_rep, w, d).transpose(0, 3, 1, 2, 4)
+    return out.reshape(b, w, h, d)
+
+
+# ---------------------------------------------------------- fused sampling
+def _float_key(x):
+    """Order-preserving map f32 -> uint32: ``a < b  iff  key(a) < key(b)``
+    (total order; -0.0 keys just below +0.0, which float comparisons on the
+    selected *values* downstream never observe). Positive floats flip the
+    sign bit, negative floats flip every bit."""
+    u = lax.bitcast_convert_type(x, jnp.uint32)
+    neg = (u >> 31) == 1
+    return jnp.where(neg, ~u, u | jnp.uint32(0x80000000))
+
+
+def _sample_kernel(temp_ref, tk_ref, tp_ref, logits_ref, noise_ref, out_ref,
+                   *, vocab):
+    # Semantics contract: engine._filter_logits + engine._sample_rows, one
+    # row per program. The reference sorts the row and derives (a) the
+    # k-th largest value `kth` and (b) the top-p cutoff `sorted_f[c-1]`
+    # where c = #(exclusive-cumsum(softmax(top-k-kept, sorted)) < p); its
+    # final rule is value-level: keep x iff [~k_on or x >= kth] and
+    # [x >= cutoff]. Both thresholds are recovered here WITHOUT a sort:
+    #   * kth — exact k-th order statistic by 32-step binary search over
+    #     the monotone uint32 float image (count(key >= t) >= k).
+    #   * cutoff — `x >= cutoff  iff  S(x) < p * Z` for every top-k-kept x,
+    #     where S(x) = sum of exp(y - m) over kept y > x and Z the kept
+    #     normalizer (everything strictly greater than a kept value is
+    #     itself kept, so S needs no top-k correction). This is the
+    #     reference rule exactly, ties included: cutoff = min{kept v :
+    #     mass-strictly-above(v) < p}, and both sides of the iff are
+    #     monotone steps in x changing only at element values. The binary
+    #     search finds the minimal float key satisfying S < p*Z; summation
+    #     order differs from the reference cumsum only in last-ulp rounding
+    #     AT the p boundary (measure-zero on real logits).
+    i = pl.program_id(0)
+    t = temp_ref[i]
+    tk = tk_ref[i]
+    tp = tp_ref[i]
+    x = logits_ref[...]  # (1, V) f32
+    noise = noise_ref[...]
+    iota = lax.broadcasted_iota(jnp.int32, (1, vocab), 1)
+    neg_inf = jnp.float32(-jnp.inf)
+
+    # greedy = argmax of the RAW logits (first max index), per _sample_rows
+    m_raw = jnp.max(x)
+    greedy = jnp.min(jnp.where(x == m_raw, iota, vocab))
+
+    safe_t = jnp.where(t > 0, t, jnp.float32(1.0))
+    scaled = x / safe_t
+    key = _float_key(scaled)
+
+    k_on = jnp.logical_and(tk > 0, tk < vocab)
+    k_eff = jnp.clip(tk, 1, vocab)
+    # maximal key with count(key >= key0) >= k_eff == key of the k-th
+    # largest element (count() only steps at element keys)
+    kkey = jnp.uint32(0)
+    for bit in range(31, -1, -1):
+        cand = kkey | jnp.uint32(1 << bit)
+        cnt = jnp.sum(jnp.where(key >= cand, 1, 0))
+        kkey = jnp.where(cnt >= k_eff, cand, kkey)
+    kth = jnp.max(jnp.where(key == kkey, scaled, neg_inf))
+    keep_k = jnp.logical_or(jnp.logical_not(k_on), scaled >= kth)
+
+    # top-p over the top-k survivors' distribution (reference: softmax of
+    # the SORTED top-k row, so Z counts exactly k_eff entries — ties at
+    # kth beyond k_eff are kept by the filter but excluded from Z)
+    m_s = jnp.max(scaled)
+    e = jnp.exp(scaled - m_s)
+    cnt_gt = jnp.sum(jnp.where(scaled > kth, 1, 0))
+    z_k = (jnp.sum(jnp.where(scaled > kth, e, 0.0))
+           + (k_eff - cnt_gt).astype(jnp.float32) * jnp.exp(kth - m_s))
+    z = jnp.where(k_on, z_k, jnp.sum(e))
+    p_on = tp < 1.0
+    pz = jnp.where(p_on, tp, jnp.float32(1.0)) * z
+    # minimal key u0 with S(u0) < p*Z, via maximal key with S >= p*Z
+    u1 = jnp.uint32(0)
+    for bit in range(31, -1, -1):
+        cand = u1 | jnp.uint32(1 << bit)
+        s_above = jnp.sum(jnp.where(key > cand, e, 0.0))
+        u1 = jnp.where(s_above >= pz, cand, u1)
+    s_at_u1 = jnp.sum(jnp.where(key > u1, e, 0.0))
+    u0 = jnp.where(s_at_u1 >= pz, u1 + jnp.uint32(1), u1)
+    keep_p = jnp.logical_or(jnp.logical_not(p_on), key >= u0)
+
+    final = jnp.where(jnp.logical_and(keep_k, keep_p), scaled, neg_inf)
+    # categorical == argmax(final + gumbel) with the caller's per-row noise
+    g = final + noise
+    m_g = jnp.max(g)
+    sampled = jnp.min(jnp.where(g == m_g, iota, vocab))
+    out_ref[0, 0] = jnp.where(t > 0, sampled, greedy)
+
+
+def fused_sample(
+    logits: jax.Array,
+    noise: jax.Array,
+    temperature: jax.Array,
+    top_k: jax.Array,
+    top_p: jax.Array,
+    *,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Fused sampling epilogue: temperature / top-k / top-p filter +
+    categorical draw in one kernel, one grid step per row.
+
+    ``logits`` (S, V) f32 raw logits, ``noise`` (S, V) f32 per-row Gumbel
+    noise — generate it as ``vmap(lambda k: jax.random.gumbel(k, (V,),
+    jnp.float32))(subkeys)`` so the draw is bitwise what
+    ``vmap(jax.random.categorical)(subkeys, filtered)`` returns (categorical
+    IS argmax(logits + gumbel(key)); in-kernel TPU PRNG has no interpret
+    lowering). ``temperature``/``top_k``/``top_p`` are the (S,) per-row
+    knobs with `engine._sample_rows` semantics: temperature <= 0 is greedy
+    argmax over the RAW logits. Returns (S,) int32 token ids.
+    """
+    s, v = logits.shape
+    interpret = _resolve_interpret(interpret)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(s,),
+        in_specs=[
+            pl.BlockSpec((1, v), lambda i, t, k, p: (i, 0)),
+            pl.BlockSpec((1, v), lambda i, t, k, p: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1), lambda i, t, k, p: (i, 0)),
+        scratch_shapes=[],
+    )
+    out = pl.pallas_call(
+        functools.partial(_sample_kernel, vocab=v),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((s, 1), jnp.int32),
+        interpret=interpret,
+    )(
+        temperature.astype(jnp.float32),
+        top_k.astype(jnp.int32),
+        top_p.astype(jnp.float32),
+        logits.astype(jnp.float32),
+        noise.astype(jnp.float32),
+    )
+    return out[:, 0]

@@ -392,6 +392,7 @@ class InferenceServer:
                     kv_cache=self.config.kv_cache,
                     block_size=self.config.engine_block_size,
                     pool_blocks=self.config.engine_pool_blocks,
+                    attention_impl=self.config.attention_impl,
                     spec=self.config.speculative,
                     spec_draft_len=self.config.spec_draft_len,
                     clock=clock,

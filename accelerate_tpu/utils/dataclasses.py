@@ -541,6 +541,15 @@ class ServingConfig(KwargsHandler):
     mode ``kv_cache`` selects :func:`~accelerate_tpu.inference.generate`'s
     ``kv_backend`` so both paths share one KV story.
 
+    ``attention_impl`` selects the decode/verify attention implementation
+    over a paged pool — ``"reference"`` (the XLA gather-then-attend op,
+    default) or ``"pallas"`` (the fused TPU flash-decode kernels in
+    ``ops/paged_decode.py``: the block table is walked inside the kernel so
+    HBM traffic scales with LIVE blocks, int8 dequantizes in-register, and
+    sampling runs as a fused epilogue kernel). Requires a paged
+    ``kv_cache``; on CPU the kernels run under ``interpret=True`` with
+    exact (f32) / bounded (int8, 4.0e-3·amax) parity vs the reference op.
+
     Speculative decoding (docs/serving.md "Speculative decoding"):
     ``speculative`` — ``None`` (off, default) or ``"ngram"``: continuous
     mode drafts up to ``spec_draft_len`` tokens per live slot from a
@@ -563,6 +572,7 @@ class ServingConfig(KwargsHandler):
     kv_cache: str = "dense"
     engine_block_size: int = 16
     engine_pool_blocks: Optional[int] = None
+    attention_impl: str = "reference"
     speculative: Optional[str] = None
     spec_draft_len: int = 4
     max_queue: int = 256
@@ -624,6 +634,24 @@ class ServingConfig(KwargsHandler):
                 f"engine_max_len ({self.engine_max_len}) must be a multiple "
                 f"of engine_block_size ({self.engine_block_size}) so a block "
                 "table row covers the arena length exactly"
+            )
+        if self.attention_impl not in ("reference", "pallas"):
+            raise ValueError(
+                "attention_impl must be 'reference' or 'pallas', got "
+                f"{self.attention_impl!r}"
+            )
+        if self.attention_impl == "pallas" and self.kv_cache not in (
+            "paged", "paged_int8"
+        ):
+            raise ValueError(
+                "attention_impl='pallas' requires a paged KV cache "
+                "(kv_cache='paged' or 'paged_int8'); the flash-decode kernel "
+                "walks block tables, which the dense arena does not have"
+            )
+        if self.attention_impl == "pallas" and self.mode != "continuous":
+            raise ValueError(
+                "attention_impl='pallas' requires mode='continuous' (the "
+                "static generate() path has no paged decode hot loop to fuse)"
             )
         if self.engine_pool_blocks is not None and self.engine_pool_blocks < 2:
             raise ValueError(

@@ -641,6 +641,18 @@ if __name__ == "__main__":
         from benchmarks.serving_bench import fleet_main
 
         sys.exit(fleet_main(gate=True))
+    if "--kernel-gate" in sys.argv:
+        # kernel gate: every Pallas entry point — the flash-attention
+        # variants plus the paged serving kernels (flash-decode, fused
+        # verify, fused sampling epilogue) — must pass the shared
+        # relative-leaf / exact-parity gates vs the reference ops.
+        # Exit code = number of failing variants. On CPU the kernels run
+        # in interpret mode (harness validation; see make check-kernels
+        # for the committed artifact regen).
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from benchmarks.kernel_validation import main as kernel_main
+
+        sys.exit(kernel_main())
     if "--kv-gate" in sys.argv:
         # paged KV-cache gate: >= 4x concurrent slots at fixed pool HBM with
         # bitwise dense parity + <= 2 engine programs, >= 90% shared-prefix

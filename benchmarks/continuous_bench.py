@@ -41,6 +41,14 @@ caching"):
 - **int8** — the capacity workload on ``paged_int8``: bitwise run-to-run
   determinism, reported HBM ratio vs the f32 pool and greedy-token
   agreement vs dense (bounded divergence, not gated).
+- **pallas A/B** — the capacity workload once more with
+  ``attention_impl="pallas"`` (the fused flash-decode kernel): bitwise
+  parity vs the reference paged engine, <= 2 compiled programs, and the
+  committed G501/G203 direction — the kernel's predicted step time and
+  decode HBM bytes must sit below the reference paged rows. The measured
+  tokens/s direction is additionally gated on TPU; on CPU the kernel runs
+  in interpret mode (an emulator, slower by construction) so walls are
+  report-only there.
 
 ``--spec-gate`` (also ``bench.py --spec-gate`` / ``make bench-spec``) runs
 the speculative-decoding phases (docs/serving.md "Speculative decoding"):
@@ -392,6 +400,52 @@ def kv_main(gate: bool = False) -> int:
         "greedy_agreement_vs_dense": round(agree / total, 4),
     }), flush=True)
 
+    # pallas A/B phase: the same capacity workload (the bench's large
+    # slots x max_len point) through the reference paged engine vs the
+    # fused Pallas flash-decode kernel. Output must stay bitwise identical
+    # and the engine at <= 2 programs. The throughput DIRECTION is gated
+    # on TPU only — on CPU the kernel runs in interpret mode (an emulator,
+    # slower by construction), so there the committed G501/G203 baselines
+    # carry the direction: pallas predicted step time / decode HBM bytes
+    # must sit BELOW the reference paged rows they were re-baselined from.
+    import jax
+
+    on_tpu = jax.default_backend() == "tpu"
+    pallas_eng = ContinuousBatchingEngine(
+        model, slots=KV_PAGED_SLOTS, max_len=MAX_LEN,
+        prompt_bucket=PROMPT_BUCKET, readback_lag=2,
+        kv_cache="paged", block_size=KV_BLOCK, pool_blocks=KV_POOL_BLOCKS,
+        attention_impl="pallas",
+    )
+    pallas_out, pallas_wall = _run_engine(pallas_eng, reqs)
+    pallas_stats = pallas_eng.stats()
+    pallas_parity = all(
+        np.array_equal(a, b) for a, b in zip(paged_out, pallas_out))
+    runs_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "runs")
+    with open(os.path.join(runs_dir, "perf_baseline.json")) as f:
+        perf_rows = json.load(f)["programs"]
+    with open(os.path.join(runs_dir, "sharding_baseline.json")) as f:
+        hbm_rows = json.load(f)["hbm"]
+    pred_ref = perf_rows["engine.paged/decode_step"]["predicted_s"]
+    pred_pal = perf_rows["engine.paged_pallas/decode_step"]["predicted_s"]
+    hbm_ref = hbm_rows["engine.paged/decode_step"]["hbm_live"]
+    hbm_pal = hbm_rows["engine.paged_pallas/decode_step"]["hbm_live"]
+    measured_ok = paged_wall >= pallas_wall if on_tpu else None
+    print(json.dumps({
+        "phase": "kv_pallas_ab",
+        "slots": KV_PAGED_SLOTS, "max_len": MAX_LEN,
+        "reference_wall_s": round(paged_wall, 3),
+        "pallas_wall_s": round(pallas_wall, 3),
+        "on_tpu": on_tpu,
+        "measured_direction_ok": measured_ok,
+        "predicted_step_s": {"reference": pred_ref, "pallas": pred_pal},
+        "decode_hbm_live": {"reference": hbm_ref, "pallas": hbm_pal},
+        "engine_programs": pallas_stats["program_count"],
+        "greedy_parity": pallas_parity,
+        "kv_live_bytes": pallas_stats["kv"]["hbm_bytes_live"],
+    }), flush=True)
+
     checks = {
         "concurrency_4x": paged_eng.peak_live >= 4 * dense_eng.peak_live,
         "fixed_hbm": paged_kv["hbm_bytes"] <= 1.05 * dense_kv["hbm_bytes"],
@@ -399,7 +453,13 @@ def kv_main(gate: bool = False) -> int:
         "engine_programs_le_2": paged_stats["program_count"] <= 2,
         "prefix_dedup_ge_90": dedup >= 0.90,
         "int8_deterministic": deterministic,
+        "pallas_parity": pallas_parity,
+        "pallas_programs_le_2": pallas_stats["program_count"] <= 2,
+        "pallas_predicted_floor": pred_pal < pred_ref,
+        "pallas_hbm_shrinks": hbm_pal < hbm_ref,
     }
+    if on_tpu:
+        checks["pallas_measured_direction"] = bool(measured_ok)
     ok = all(checks.values())
     print(json.dumps({
         "metric": "paged_kv_gate",

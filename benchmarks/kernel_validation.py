@@ -11,8 +11,16 @@ Variants: base causal (bench tiling), GQA, sliding window, softcap,
 packed segment_ids, non-causal, with_lse (lse output + lse-cotangent
 backward), and the ring-style cross-length with_lse shape.
 
+The paged serving kernels (``ops/paged_decode.py``) are validated in a
+second section: ``paged_flash_decode`` vs ``ops.attention.paged_attention``
+(f32 exact <= 1e-5; int8 dequant; softcap; all-null tables at pos=0;
+single live block; exactly-full last block), ``paged_flash_verify`` vs
+``verify_attention`` over a window-committed pool copy, and
+``fused_sample`` bitwise vs the engine's ``_filter_logits``/
+``_sample_rows`` reference across mixed greedy/top-k/top-p rows.
+
 One JSON row per variant; exit code = number of failures (0 = all pass).
-On CPU the kernel runs in interpret mode — rows are then harness
+On CPU the kernels run in interpret mode — rows are then harness
 validation only.
 """
 
@@ -166,6 +174,147 @@ def main():
         sq=S // 2,
         skv=S,
     )
+
+    # ------------------------------------------------------------------
+    # Paged serving kernels: flash-decode / fused-verify / fused-sample
+    # vs the reference ops that pin their semantics.
+    # ------------------------------------------------------------------
+    from accelerate_tpu.engine import _sample_rows
+    from accelerate_tpu.ops.attention import paged_attention, verify_attention
+    from accelerate_tpu.ops.paged_decode import (
+        fused_sample,
+        paged_flash_decode,
+        paged_flash_verify,
+    )
+
+    def paged_case(name, fn):
+        """Scaffold for the paged kernels: fn() returns (max_abs_err, tol)
+        or raises; err <= tol passes. Same JSON row shape as run_case."""
+        nonlocal failures
+        t0 = time.time()
+        try:
+            err, tol = fn()
+            ok = bool(err <= tol)
+            detail = {"max_abs_err": float(err), "tol": float(tol)}
+        except Exception as exc:  # noqa: BLE001 — record, don't die
+            print(json.dumps({"variant": name, "ok": False,
+                              "error": f"{type(exc).__name__}: {exc}"[:300]}),
+                  flush=True)
+            failures += 1
+            return
+        failures += 0 if ok else 1
+        print(json.dumps({"variant": name, "ok": ok, "on_tpu": on_tpu,
+                          "secs": round(time.time() - t0, 1),
+                          "detail": detail}), flush=True)
+
+    prng = np.random.default_rng(7)
+    PB, PBPR, PBS, PH, PHKV, PD, PNB = 3, 4, 4, 4, 2, 8, 12
+
+    def mk_paged(nb=PNB):
+        q = jnp.asarray(prng.normal(size=(PB, 1, PH, PD)), jnp.float32)
+        kp = jnp.asarray(prng.normal(size=(nb, PBS, PHKV, PD)), jnp.float32)
+        vp = jnp.asarray(prng.normal(size=(nb, PBS, PHKV, PD)), jnp.float32)
+        tables = jnp.asarray(prng.integers(1, nb, size=(PB, PBPR)), jnp.int32)
+        # pos per row: fresh slot, mid-sequence, exactly-full last block
+        pos = jnp.asarray([0, 5, PBPR * PBS - 1], jnp.int32)
+        return q, kp, vp, tables, pos
+
+    def decode_err(**kwargs):
+        q, kp, vp, tables, pos = mk_paged()
+        ref = paged_attention(q, kp, vp, tables, pos, **kwargs)
+        out = paged_flash_decode(q, kp, vp, tables, pos, **kwargs)
+        return float(jnp.max(jnp.abs(ref - out))), 1e-5
+
+    paged_case("paged_decode_f32", decode_err)
+    paged_case("paged_decode_softcap", lambda: decode_err(softcap=30.0))
+
+    def decode_int8():
+        q, kp, vp, tables, pos = mk_paged()
+        kq = jnp.asarray(prng.integers(-127, 128, size=kp.shape), jnp.int8)
+        vq = jnp.asarray(prng.integers(-127, 128, size=vp.shape), jnp.int8)
+        ks = jnp.asarray(prng.uniform(1e-3, 2e-2, size=kp.shape[:2]), jnp.float32)
+        vs = jnp.asarray(prng.uniform(1e-3, 2e-2, size=vp.shape[:2]), jnp.float32)
+        # zero-scale blocks (released/never-written) must contribute exact 0
+        ks = ks.at[3].set(0.0)
+        vs = vs.at[3].set(0.0)
+        ref = paged_attention(q, kq, vq, tables, pos, k_scale=ks, v_scale=vs)
+        out = paged_flash_decode(q, kq, vq, tables, pos, k_scale=ks, v_scale=vs)
+        return float(jnp.max(jnp.abs(ref - out))), 1e-5
+
+    paged_case("paged_decode_int8_dequant", decode_int8)
+
+    def decode_null_tables():
+        q, kp, vp, _, _ = mk_paged()
+        tables = jnp.zeros((PB, PBPR), jnp.int32)  # all slots released
+        pos = jnp.zeros((PB,), jnp.int32)
+        ref = paged_attention(q, kp, vp, tables, pos)
+        out = paged_flash_decode(q, kp, vp, tables, pos)
+        return float(jnp.max(jnp.abs(ref - out))), 1e-5
+
+    paged_case("paged_decode_all_null_pos0", decode_null_tables)
+
+    def decode_single_block():
+        q, kp, vp, _, _ = mk_paged()
+        # one live block per row, rest null: pos inside block 0 of the table
+        tables = jnp.zeros((PB, PBPR), jnp.int32)
+        tables = tables.at[:, 0].set(jnp.asarray([2, 5, 9], jnp.int32))
+        pos = jnp.asarray([1, 2, PBS - 1], jnp.int32)
+        ref = paged_attention(q, kp, vp, tables, pos)
+        out = paged_flash_decode(q, kp, vp, tables, pos)
+        return float(jnp.max(jnp.abs(ref - out))), 1e-5
+
+    paged_case("paged_decode_single_block", decode_single_block)
+
+    def verify_f32():
+        b, w = 2, 3
+        qw = jnp.asarray(prng.normal(size=(b, w, PH, PD)), jnp.float32)
+        kp = jnp.asarray(prng.normal(size=(PNB, PBS, PHKV, PD)), jnp.float32)
+        vp = jnp.asarray(prng.normal(size=(PNB, PBS, PHKV, PD)), jnp.float32)
+        # disjoint per-row tables (the allocator's invariant): the reference
+        # commits each row's window into one shared pool copy
+        tables = jnp.asarray(
+            1 + prng.permutation(PNB - 1)[: b * PBPR].reshape(b, PBPR),
+            jnp.int32,
+        )
+        pos = jnp.asarray([0, 6], jnp.int32)
+        wk = jnp.asarray(prng.normal(size=(b, w, PHKV, PD)), jnp.float32)
+        wv = jnp.asarray(prng.normal(size=(b, w, PHKV, PD)), jnp.float32)
+        # reference reads a pool copy with the draft window committed at
+        # pos..pos+w-1; the kernel keeps the window in registers instead
+        kp_ref, vp_ref = kp, vp
+        for bb in range(b):
+            for j in range(w):
+                ap = int(pos[bb]) + j
+                if ap >= PBPR * PBS:
+                    continue
+                blk = int(tables[bb, ap // PBS])
+                kp_ref = kp_ref.at[blk, ap % PBS].set(wk[bb, j])
+                vp_ref = vp_ref.at[blk, ap % PBS].set(wv[bb, j])
+        ref = verify_attention(qw, kp_ref, vp_ref, tables, pos)
+        out = paged_flash_verify(qw, kp, vp, wk, wv, tables, pos)
+        return float(jnp.max(jnp.abs(ref - out))), 1e-5
+
+    paged_case("paged_verify_f32", verify_f32)
+
+    def sample_bitwise():
+        # mixed rows: greedy (temp=0), pure top-k incl. k=1 and k=V,
+        # aggressive top-p — tokens must match _sample_rows BITWISE
+        S, V = 6, 64
+        logits = jnp.asarray(prng.normal(size=(S, V)) * 3, jnp.float32)
+        temp = jnp.asarray([0.0, 0.7, 1.3, 1.0, 0.5, 2.0], jnp.float32)
+        top_k = jnp.asarray([0, 5, 1, V, 3, 7], jnp.int32)
+        top_p = jnp.asarray([1.0, 0.9, 0.5, 0.95, 1.0, 0.3], jnp.float32)
+        mismatches = 0
+        for trial in range(8):
+            subs = jax.random.split(jax.random.key(trial), S)
+            ref = _sample_rows(logits, subs, temp, top_k, top_p)
+            noise = jax.vmap(
+                lambda k: jax.random.gumbel(k, (V,), jnp.float32))(subs)
+            out = fused_sample(logits, noise, temp, top_k, top_p)
+            mismatches += int(np.sum(np.asarray(ref) != np.asarray(out)))
+        return float(mismatches), 0.0
+
+    paged_case("fused_sample_bitwise", sample_bitwise)
 
     print(json.dumps({"summary": "kernel_validation", "on_tpu": on_tpu,
                       "failures": failures}), flush=True)

@@ -217,7 +217,8 @@ def test_g503_observe_padding_group_filter():
     assert set(obs) == {"engine.paged/prefill_insert",
                         "engine.paged/decode_step"}
     assert set(observe_padding()) == {
-        f"{g}/{p}" for g in ("engine.dense", "engine.spec", "engine.paged")
+        f"{g}/{p}" for g in ("engine.dense", "engine.spec", "engine.paged",
+                             "engine.paged_pallas")
         for p in ("prefill_insert", "decode_step")}
 
 

@@ -310,6 +310,39 @@ def test_drift_respects_exhausted_dump_budget(tmp_path):
         tracing.configure(prev_cfg)
 
 
+def test_drift_sentinel_watches_pallas_kernel_rows(private_tracer):
+    """The COMMITTED perf baseline carries engine.paged_pallas rows (the
+    re-baselined G501 floor for the fused decode/verify kernels) and the
+    sentinel treats them like every other program: the kernel's predicted
+    decode step must stay below the reference paged program's (the floor
+    is the optimization, not a free pass), and a sustained slowdown on the
+    pallas decode program raises a typed PerfDriftError for exactly that
+    program."""
+    repo_baseline = os.path.join(
+        os.path.dirname(__file__), os.pardir, "runs", "perf_baseline.json")
+    with open(repo_baseline) as f:
+        rows = json.load(f)["programs"]
+    assert "engine.paged_pallas/decode_step" in rows
+    assert "engine.paged_pallas/verify_step" in rows
+    assert rows["engine.paged_pallas/decode_step"]["predicted_s"] < \
+        rows["engine.paged/decode_step"]["predicted_s"]
+
+    clk = FakeClock()
+    cfg = ObservabilityConfig(
+        baseline_path=repo_baseline, drift_enabled=True, drift_min_samples=4,
+        drift_consecutive=2, drift_interval_s=1e9)
+    w = PerfWatch(cfg, clock=clk)
+    slow = rows["engine.paged_pallas/decode_step"]["predicted_s"] * 3.0
+    for _ in range(8):
+        w.record("engine.paged_pallas/decode_step", slow)
+    w.check_drift()  # strike 1
+    w.check_drift()  # strike 2 -> finding
+    findings = w.drift_findings()
+    assert [e.program for e in findings] == \
+        ["engine.paged_pallas/decode_step"]
+    assert isinstance(findings[0], PerfDriftError)
+
+
 def test_drift_recovery_clears_strikes(tmp_path):
     clk = FakeClock()
     # a huge interval keeps the opportunistic record-path checks quiet so

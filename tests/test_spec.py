@@ -58,9 +58,10 @@ def get_engine(model):
     counters are lifetime, so tests snapshot them and assert deltas."""
 
     def _get(slots=4, max_len=64, prompt_bucket=16, readback_lag=0,
-             kv_cache="dense", block_size=8, spec=None, spec_draft_len=4):
+             kv_cache="dense", block_size=8, spec=None, spec_draft_len=4,
+             attention_impl="reference"):
         key = (slots, max_len, prompt_bucket, readback_lag, kv_cache,
-               block_size, spec, spec_draft_len)
+               block_size, spec, spec_draft_len, attention_impl)
         eng = _ENGINES.get(key)
         if eng is None:
             eng = _ENGINES[key] = ContinuousBatchingEngine(
@@ -68,6 +69,7 @@ def get_engine(model):
                 prompt_bucket=prompt_bucket, readback_lag=readback_lag,
                 kv_cache=kv_cache, block_size=block_size,
                 spec=spec, spec_draft_len=spec_draft_len,
+                attention_impl=attention_impl,
             )
         eng.reset()
         eng.set_spec_draft_limit(eng.spec_draft_len)  # undo any test's clamp
@@ -146,6 +148,26 @@ def test_greedy_spec_dense_vs_paged_bitwise_identical(model, get_engine):
     assert dense == paged
     for p, toks in zip(prompts, dense):
         np.testing.assert_array_equal(toks, _ref(model, p, 16)[len(p):])
+
+
+def test_greedy_spec_pallas_kernel_bitwise_identical(model, get_engine):
+    """Regression: spec greedy parity must survive attention_impl="pallas" —
+    the fused verify kernel replaces verify_attention inside verify_step,
+    and its committed-history + in-register-window math must be invisible
+    in the output. Repetitive prompts force real verify dispatches (the
+    n-gram drafter never sparks on incompressible prompts, which would make
+    this test vacuously pass on the decode path alone)."""
+    prompts = _rep_prompts(3, seed=0)
+    eng = get_engine(spec="ngram", kv_cache="paged", attention_impl="pallas")
+    before = _spec_snapshot(eng)
+    pallas = _run(eng, prompts, 20)
+    d = _spec_delta(eng, before)
+    assert d["verify_steps"] > 0 and d["drafted"] > 0  # the kernel really ran
+    assert d["accepted"] > 0  # drafts landed THROUGH the fused verify kernel
+    paged = _run(get_engine(spec="ngram", kv_cache="paged"), prompts, 20)
+    assert pallas == paged
+    for p, toks in zip(prompts, pallas):
+        np.testing.assert_array_equal(toks, _ref(model, p, 20)[len(p):])
 
 
 def test_spec_budget_exact_and_eos_inside_window_retires(model, get_engine):
