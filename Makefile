@@ -10,7 +10,7 @@
 PY := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu python
 PY_SLOW := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu RUN_SLOW=1 python
 
-.PHONY: test test_all test_core test_data test_parallel test_models test_cli test_big_modeling test-fault test-serving check-static check-kernels check-sharding check-concurrency check-numerics check-perf check-all install-hooks bench bench-telemetry bench-serving bench-continuous bench-recovery bench-kv bench-spec bench-fleet bench-trace bench-obs bench-autoscale
+.PHONY: test test_all test_core test_data test_parallel test_models test_cli test_big_modeling test-fault test-serving check-static check-kernels check-sharding check-concurrency check-numerics check-perf check-all install-hooks bench bench-telemetry bench-serving bench-continuous bench-recovery bench-kv bench-spec bench-fleet bench-trace bench-obs bench-autoscale bench-chaos
 
 test: check-static check-kernels
 	$(PY) -m pytest tests/ -q
@@ -94,7 +94,8 @@ install-hooks:
 # replication kill points, consensus, replica restore, topology-change
 # resume — fast, on 8 virtual CPU devices (XLA_FLAGS from tests/conftest.py)
 test-fault:
-	$(PY) -m pytest tests/test_durability.py tests/test_checkpointing.py tests/test_serving.py tests/test_elastic.py tests/test_fleet.py -q
+	$(PY) -m pytest tests/test_durability.py tests/test_checkpointing.py tests/test_serving.py tests/test_elastic.py tests/test_fleet.py tests/test_chaos.py -q
+	$(PY) benchmarks/chaos_bench.py --gate
 
 # resilient-serving suite (docs/serving.md): dynamic batching, deadline
 # shedding, backpressure, retry/backoff, circuit breaker, SIGTERM drain,
@@ -196,6 +197,16 @@ bench-obs:
 # throughout (docs/control_plane.md)
 bench-autoscale:
 	$(PY) benchmarks/autoscale_bench.py --gate
+
+# gray-failure gate: one seeded chaos schedule (10x straggler + flaky=0.2
+# probe hops + one kill-mid-batch) against the load replay, invariant
+# monitors armed throughout — goodput >= 0.85x and TTFT p99 <= 1.5x of the
+# no-chaos run, zero dropped futures / untyped errors, complete trace
+# trees, the browned-out replica quarantined then drained-and-replaced
+# automatically, and the recorded hit log replaying to a bit-identical
+# firing sequence (docs/fault_tolerance.md)
+bench-chaos:
+	$(PY) benchmarks/chaos_bench.py --gate
 
 # elastic-recovery gate: MTTR per restore path (local / replica / elastic
 # reshard, restart-to-resumed wall clock) + consensus/replication must stay

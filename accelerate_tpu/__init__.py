@@ -116,6 +116,13 @@ _LAZY = {
     "MetricsExporter": ("perfwatch", "MetricsExporter"),
     "ObservabilityConfig": ("utils.dataclasses", "ObservabilityConfig"),
     "PerfDriftError": ("utils.fault", "PerfDriftError"),
+    "ReplicaBrownoutError": ("utils.fault", "ReplicaBrownoutError"),
+    "chaos": ("chaos", None),
+    "ChaosRule": ("chaos", "ChaosRule"),
+    "ChaosSchedule": ("chaos", "ChaosSchedule"),
+    "ChaosConductor": ("chaos", "ChaosConductor"),
+    "InvariantMonitors": ("chaos", "InvariantMonitors"),
+    "InvariantViolation": ("chaos", "InvariantViolation"),
 }
 
 

@@ -1,0 +1,531 @@
+"""Deterministic chaos conductor + always-on invariant monitors
+(docs/fault_tolerance.md "Gray failures"; docs/control_plane.md
+"Chaos-conductor runbook").
+
+The ``ACCELERATE_TPU_FAULT_INJECT`` env string is perfect for one-shot
+deaths ("kill the process at ``before_commit``") but cannot express the
+gray-failure weather real TPU fleets live in: a straggler that is slow
+*for a while*, a probe hop that fails one time in five, a hang that
+starts mid-flash-crowd. This module adds the missing half:
+
+* :class:`ChaosRule` / :class:`ChaosSchedule` — a **seeded, declarative**
+  plan over the existing :func:`~accelerate_tpu.utils.fault.fault_point`
+  registry: per-rule action (``raise``/``sleep``/``hang``/``kill``/
+  ``exit``), seeded firing probability, ``after``/``every`` hit
+  counters, wall-clock phase windows (composable with
+  ``benchmarks/loadgen.Phase`` profiles via :func:`phase_windows`), and
+  context matching (scope a rule to ONE replica).
+* :class:`ChaosConductor` — installs the schedule as the process-wide
+  programmatic hook (:func:`~accelerate_tpu.utils.fault
+  .install_conductor`), records every hit and every firing, and can
+  **replay** a recorded hit log through a fresh conductor
+  (:meth:`ChaosConductor.replay`): the firing decisions are a pure
+  function of ``(seed, per-rule hit ordinals, hit timestamps)``, so the
+  same seed reproduces a bit-identical firing sequence — chaos you can
+  put in CI.
+* :class:`InvariantMonitors` — the invariants that must hold UNDER any
+  chaos, checked while it runs: no dropped/unresolved client future, no
+  untyped error reaching a client, no trace id with an incomplete span
+  tree, no metrics counter going backwards. A chaos run that "passes"
+  without these armed has proven nothing.
+
+Import-light (stdlib only at module scope) so tests and benches can use
+it without touching the accelerator runtime.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+import zlib
+from concurrent.futures import Future
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from .utils.fault import (
+    FaultInjected,
+    ServingError,
+    install_conductor,
+    uninstall_conductor,
+)
+
+__all__ = [
+    "ChaosRule",
+    "ChaosSchedule",
+    "ChaosConductor",
+    "InvariantViolation",
+    "InvariantMonitors",
+    "phase_windows",
+]
+
+
+# ------------------------------------------------------------------- schedule
+@dataclass(frozen=True)
+class ChaosRule:
+    """One declarative injection rule over a named fault point.
+
+    * ``point`` — the :func:`~accelerate_tpu.utils.fault.fault_point`
+      name this rule listens on (``fleet_probe``,
+      ``serving_before_batch``, ...).
+    * ``action`` — ``raise`` (default; typed
+      :class:`~accelerate_tpu.utils.fault.FaultInjected`),
+      ``sleep[=seconds]`` (survivable slowdown — the straggler
+      primitive), ``hang[=cap_seconds]`` (block until the conductor
+      stops or the cap passes — the wedged-RPC primitive), ``kill``,
+      ``exit``.
+    * ``prob`` — seeded per-hit firing probability (1.0 = every eligible
+      hit). Draws come from this rule's own RNG stream, so schedules are
+      bit-reproducible per seed.
+    * ``after``/``every`` — skip the first ``after`` eligible hits, then
+      fire on every ``every``-th.
+    * ``start_s``/``end_s`` — wall-clock window relative to
+      :meth:`ChaosConductor.start` (``None`` = unbounded); pair with
+      :func:`phase_windows` to align chaos with ``loadgen.Phase``
+      boundaries.
+    * ``max_fires`` — hard cap on firings (``None`` = unbounded); this is
+      how "one kill mid-batch" stays ONE kill.
+    * ``match`` — context subset the call site must supply (e.g.
+      ``{"replica": "r1"}`` only fires on ``fault_point(...,
+      replica="r1")``), which is what scopes a straggler to one replica.
+    * ``label`` — name used in the firing log (defaults to
+      ``point:action``).
+    """
+
+    point: str
+    action: str = "raise"
+    prob: float = 1.0
+    after: int = 0
+    every: int = 1
+    start_s: Optional[float] = None
+    end_s: Optional[float] = None
+    max_fires: Optional[int] = None
+    match: Optional[Tuple[Tuple[str, Any], ...]] = None
+    label: str = ""
+
+    def __post_init__(self):
+        if not (0.0 <= self.prob <= 1.0):
+            raise ValueError(f"prob must be in [0, 1], got {self.prob}")
+        if self.after < 0 or self.every < 1:
+            raise ValueError(
+                f"need after >= 0 and every >= 1, got "
+                f"after={self.after} every={self.every}"
+            )
+        base = self.action.partition("=")[0]
+        if base not in ("raise", "sleep", "hang", "kill", "exit"):
+            raise ValueError(
+                f"unknown chaos action {self.action!r} "
+                "(expected raise|sleep[=s]|hang[=s]|kill|exit)"
+            )
+        # dicts are not hashable and this dataclass is frozen — normalize
+        # a dict match into a sorted item tuple once, at construction
+        if isinstance(self.match, dict):
+            object.__setattr__(
+                self, "match", tuple(sorted(self.match.items()))
+            )
+        if not self.label:
+            object.__setattr__(self, "label", f"{self.point}:{self.action}")
+
+    def matches(self, name: str, context: Dict[str, Any]) -> bool:
+        if name != self.point:
+            return False
+        if self.match:
+            for key, value in self.match:
+                if context.get(key) != value:
+                    return False
+        return True
+
+    def in_window(self, t_rel: float) -> bool:
+        if self.start_s is not None and t_rel < self.start_s:
+            return False
+        if self.end_s is not None and t_rel >= self.end_s:
+            return False
+        return True
+
+
+@dataclass(frozen=True)
+class ChaosSchedule:
+    """A named, seeded set of :class:`ChaosRule` — the whole chaos plan
+    for one run, in one declarative value."""
+
+    rules: Tuple[ChaosRule, ...]
+    seed: int = 0
+    name: str = "chaos"
+
+    def __post_init__(self):
+        object.__setattr__(self, "rules", tuple(self.rules))
+
+
+def phase_windows(phases: Sequence) -> List[Tuple[str, float, float]]:
+    """Cumulative ``(name, start_s, end_s)`` windows of a
+    ``benchmarks/loadgen.Phase`` sequence (anything with ``name`` and
+    ``duration_s``), for building phase-aligned :class:`ChaosRule`
+    windows: chaos that starts exactly when the flash crowd does."""
+    out, t = [], 0.0
+    for ph in phases:
+        out.append((ph.name, t, t + ph.duration_s))
+        t += ph.duration_s
+    return out
+
+
+# ------------------------------------------------------------------ conductor
+class _RuleState:
+    __slots__ = ("hits", "fires", "rng")
+
+    def __init__(self, seed: int, index: int, rule: ChaosRule):
+        self.hits = 0
+        self.fires = 0
+        # crc32, not hash(): Python string hashes are salted per process,
+        # and the whole point is cross-process reproducibility
+        self.rng = random.Random(
+            zlib.crc32(f"{seed}|{index}|{rule.label}".encode())
+        )
+
+
+class ChaosConductor:
+    """Run one :class:`ChaosSchedule` against the live process.
+
+    ``start()`` installs the conductor as the process-wide programmatic
+    hook behind every :func:`~accelerate_tpu.utils.fault.fault_point`;
+    ``stop()`` uninstalls it and releases any rule still hanging.
+    Context-manager friendly.
+
+    Every hit is appended to the **hit log** ``(t_rel, point, context)``
+    and every firing to the **firing log** ``(rule_label, rule_hit_index,
+    action)`` — both under one lock, so the per-rule hit ordinals are
+    well-defined even when probes hit concurrently. Firing decisions are
+    a pure function of the seed and the hit log, which is what
+    :meth:`replay` exploits: feeding a recorded hit log through a fresh
+    conductor with the same schedule MUST reproduce the firing log
+    bit-for-bit (the reproducibility gate in
+    ``benchmarks/chaos_bench.py``)."""
+
+    def __init__(
+        self,
+        schedule: ChaosSchedule,
+        *,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.schedule = schedule
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._states = [
+            _RuleState(schedule.seed, i, r)
+            for i, r in enumerate(schedule.rules)
+        ]
+        self._hang_event = threading.Event()
+        # one stable reference: each `self._hook` attribute access builds a
+        # fresh bound method, and uninstall_conductor matches by identity —
+        # passing a fresh one would leave the hook installed forever
+        self._installed_hook = self._hook
+        self._t0: Optional[float] = None
+        self._hit_log: List[Tuple[float, str, Tuple[Tuple[str, Any], ...]]] = []
+        self._firing_log: List[Tuple[str, int, str]] = []
+
+    # -- lifecycle
+    def start(self) -> "ChaosConductor":
+        self._t0 = self._clock()
+        install_conductor(self._installed_hook)
+        return self
+
+    def stop(self) -> None:
+        uninstall_conductor(self._installed_hook)
+        self._hang_event.set()  # release anything parked on a hang rule
+
+    def __enter__(self) -> "ChaosConductor":
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.stop()
+
+    # -- observability
+    def firing_sequence(self) -> Tuple[Tuple[str, int, str], ...]:
+        """``(rule_label, rule_hit_index, action)`` per firing, in firing
+        order — the value two same-seed runs must agree on bit-for-bit."""
+        with self._lock:
+            return tuple(self._firing_log)
+
+    def hit_log(self) -> Tuple[Tuple[float, str, Tuple[Tuple[str, Any], ...]], ...]:
+        with self._lock:
+            return tuple(self._hit_log)
+
+    def fires(self, label: Optional[str] = None) -> int:
+        with self._lock:
+            if label is None:
+                return len(self._firing_log)
+            return sum(1 for lab, _h, _a in self._firing_log if lab == label)
+
+    def replay(self, hit_log) -> Tuple[Tuple[str, int, str], ...]:
+        """Feed a recorded hit log through a FRESH conductor of the same
+        schedule (same seed, zeroed counters/RNGs) without performing any
+        action, and return the firing sequence it decides — the pure
+        replay that proves determinism. Two replays of the same log are
+        bit-identical by construction; a live run's firing log must match
+        its own hit log's replay."""
+        twin = ChaosConductor(self.schedule, clock=self._clock)
+        for t_rel, name, ctx in hit_log:
+            twin._decide(name, dict(ctx), t_rel)
+        return twin.firing_sequence()
+
+    # -- the hook
+    def _hook(self, name: str, context: Dict[str, Any]) -> None:
+        if self._t0 is None:
+            return
+        t_rel = self._clock() - self._t0
+        action = self._decide(name, context, t_rel)
+        if action is not None:
+            self._perform(name, action)
+
+    def _decide(
+        self, name: str, context: Dict[str, Any], t_rel: float
+    ) -> Optional[str]:
+        """Pure decision step (no side effects beyond logs/counters):
+        returns the action to perform, or None. One lock acquisition per
+        hit keeps per-rule ordinals and RNG draws well-ordered."""
+        fired_action: Optional[str] = None
+        with self._lock:
+            self._hit_log.append(
+                (t_rel, name, tuple(sorted(context.items())))
+            )
+            for rule, state in zip(self.schedule.rules, self._states):
+                if not rule.matches(name, context):
+                    continue
+                if not rule.in_window(t_rel):
+                    continue
+                state.hits += 1
+                if rule.max_fires is not None and state.fires >= rule.max_fires:
+                    continue
+                if state.hits <= rule.after:
+                    continue
+                if (state.hits - rule.after - 1) % rule.every != 0:
+                    continue
+                # the draw happens on every counter-eligible hit whether
+                # or not an earlier rule already fired — stream position
+                # stays a pure function of this rule's own hit ordinals
+                if rule.prob < 1.0 and state.rng.random() >= rule.prob:
+                    continue
+                state.fires += 1
+                self._firing_log.append((rule.label, state.hits, rule.action))
+                if fired_action is None:
+                    fired_action = rule.action
+        return fired_action
+
+    def _perform(self, name: str, action: str) -> None:
+        base, _, arg = action.partition("=")
+        if base == "raise":
+            raise FaultInjected(f"{name} (chaos: {self.schedule.name})")
+        if base == "sleep":
+            time.sleep(float(arg) if arg else 0.05)
+            return
+        if base == "hang":
+            self._hang_event.wait(float(arg) if arg else 30.0)
+            return
+        import os
+        import signal
+
+        if base == "exit":
+            os._exit(17)
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+# ---------------------------------------------------------------- invariants
+class InvariantViolation(RuntimeError):
+    """An always-on invariant broke during a chaos run. ``kind`` is
+    machine-readable: ``dropped_future`` / ``untyped_error`` /
+    ``incomplete_trace`` / ``counter_regression``."""
+
+    def __init__(self, kind: str, detail: str):
+        self.kind = kind
+        self.detail = detail
+        super().__init__(f"invariant violated [{kind}]: {detail}")
+
+
+class InvariantMonitors:
+    """The four invariants any chaos run must hold, checked while it runs:
+
+    1. **No dropped future** — every tracked client future resolves
+       (result, typed error, or explicit cancel); an unresolved future
+       after quiesce is lost work.
+    2. **No untyped error** — a tracked future that fails must carry a
+       typed error (:class:`~accelerate_tpu.utils.fault.ServingError`
+       taxonomy, or ``ValueError`` for structural misuse). A bare
+       exception reaching a client means some layer leaked its guts.
+    3. **Complete trace trees** — a tracked request's trace must contain
+       its ``fleet.submit`` root span and, when a result was delivered,
+       at least one ``fleet.dispatch`` span (the PR-14 spine: spans
+       commit on ``__exit__``, so a missing span means a code path
+       skipped or never closed its bracket).
+    4. **Monotonic counters** — between any two :meth:`sample` calls, no
+       counter in any registered registry may decrease.
+
+    ``check()`` returns every violation found; :meth:`assert_clean`
+    raises the first. Trace tracking is bounded (``max_traces``) so the
+    monitor itself cannot outgrow the tracer's rings under load."""
+
+    def __init__(
+        self,
+        *,
+        tracer=None,
+        typed_errors: Tuple[type, ...] = (ServingError, ValueError),
+        max_traces: int = 256,
+    ):
+        self._tracer = tracer
+        self._typed = typed_errors
+        self._max_traces = max_traces
+        self._lock = threading.Lock()
+        self._futures: List[Tuple[str, Future]] = []
+        self._traces: List[Tuple[str, Future]] = []
+        self._trace_overflow = 0
+        self._registries: List[Tuple[str, Callable[[], Dict[str, int]]]] = []
+        self._last_counters: Dict[str, Dict[str, int]] = {}
+        self._violations: List[InvariantViolation] = []
+
+    # -- registration
+    def watch_registry(self, label: str, registry) -> None:
+        """Register a counters source: a ``MetricsRegistry`` (its
+        ``counters()`` method) or any zero-arg callable returning a
+        ``{name: int}`` dict."""
+        fn = registry.counters if hasattr(registry, "counters") else registry
+        with self._lock:
+            self._registries.append((label, fn))
+
+    def track(self, request_id: str, future: Future,
+              trace_id: Optional[str] = None) -> Future:
+        """Track one client future (and optionally its trace id); returns
+        the future for call-through convenience."""
+        with self._lock:
+            self._futures.append((request_id, future))
+            if trace_id is not None:
+                if len(self._traces) < self._max_traces:
+                    self._traces.append((trace_id, future))
+                else:
+                    # bounded tracking is not silent: check() reports how
+                    # many traces went unverified
+                    self._trace_overflow += 1
+        return future
+
+    # -- sampling (call at phase boundaries and after quiesce)
+    def sample(self) -> List[InvariantViolation]:
+        """Snapshot every registered registry's counters and compare to
+        the previous sample: any decrease is a ``counter_regression``
+        (new violations are also returned)."""
+        new: List[InvariantViolation] = []
+        with self._lock:
+            registries = list(self._registries)
+        for label, fn in registries:
+            try:
+                counters = dict(fn())
+            except Exception as exc:  # noqa: BLE001 — a broken source is itself a finding
+                new.append(InvariantViolation(
+                    "counter_regression",
+                    f"registry {label!r} unreadable: "
+                    f"{type(exc).__name__}: {exc}",
+                ))
+                continue
+            with self._lock:
+                prev = self._last_counters.get(label, {})
+                for key, value in counters.items():
+                    if key in prev and value < prev[key]:
+                        new.append(InvariantViolation(
+                            "counter_regression",
+                            f"{label}:{key} went backwards "
+                            f"({prev[key]} -> {value})",
+                        ))
+                self._last_counters[label] = counters
+        with self._lock:
+            self._violations.extend(new)
+        return new
+
+    # -- verdict
+    def check(self, quiesce_timeout_s: float = 10.0) -> List[InvariantViolation]:
+        """Final verdict: wait up to ``quiesce_timeout_s`` for tracked
+        futures to resolve, then evaluate all four invariants. Returns
+        every violation (including those found by earlier samples)."""
+        deadline = time.monotonic() + quiesce_timeout_s
+        with self._lock:
+            futures = list(self._futures)
+            traces = list(self._traces)
+        out: List[InvariantViolation] = []
+        for rid, fut in futures:
+            remaining = deadline - time.monotonic()
+            if not fut.done() and remaining > 0:
+                try:
+                    fut.exception(timeout=remaining)
+                except Exception:  # noqa: BLE001 — classified below
+                    pass
+            if not fut.done():
+                out.append(InvariantViolation(
+                    "dropped_future",
+                    f"request {rid} unresolved after quiesce",
+                ))
+                continue
+            if fut.cancelled():
+                continue  # explicit cancel is a resolution, not a drop
+            exc = fut.exception()
+            if exc is not None and not isinstance(exc, self._typed):
+                out.append(InvariantViolation(
+                    "untyped_error",
+                    f"request {rid} failed with untyped "
+                    f"{type(exc).__name__}: {exc}",
+                ))
+        out.extend(self._check_traces(traces))
+        out.extend(self.sample())
+        with self._lock:
+            self._violations.extend(
+                v for v in out if v not in self._violations
+            )
+            return list(self._violations)
+
+    def _check_traces(self, traces) -> List[InvariantViolation]:
+        out: List[InvariantViolation] = []
+        if not traces:
+            return out
+        tracer = self._tracer
+        if tracer is None:
+            from . import tracing
+
+            tracer = tracing.get_tracer()
+        if not getattr(tracer, "enabled", False):
+            return out
+        spans = tracer.spans()
+        by_trace: Dict[str, List[str]] = {}
+        for sp in spans:
+            if sp.trace_id is not None:
+                by_trace.setdefault(sp.trace_id, []).append(sp.name)
+        for trace_id, fut in traces:
+            names = by_trace.get(trace_id, [])
+            if "fleet.submit" not in names:
+                out.append(InvariantViolation(
+                    "incomplete_trace",
+                    f"trace {trace_id} has no fleet.submit root "
+                    f"(spans present: {sorted(set(names))})",
+                ))
+                continue
+            delivered = (
+                fut.done() and not fut.cancelled() and fut.exception() is None
+            )
+            if delivered and "fleet.dispatch" not in names:
+                out.append(InvariantViolation(
+                    "incomplete_trace",
+                    f"trace {trace_id} delivered a result but shows no "
+                    f"fleet.dispatch span ({sorted(set(names))})",
+                ))
+        return out
+
+    @property
+    def unverified_traces(self) -> int:
+        """Traces dropped past ``max_traces`` — a bounded monitor must
+        never silently read as "all traces verified" (report this next
+        to the verdict)."""
+        with self._lock:
+            return self._trace_overflow
+
+    def assert_clean(self, quiesce_timeout_s: float = 10.0) -> None:
+        violations = self.check(quiesce_timeout_s)
+        if violations:
+            raise violations[0]
+
+    @property
+    def violations(self) -> List[InvariantViolation]:
+        with self._lock:
+            return list(self._violations)

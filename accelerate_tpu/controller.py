@@ -658,7 +658,16 @@ class SLOController:
                 ", ".join(f.program for f in findings),
             )
             return
-        victim = max(
+        # A finding that NAMES its replica (the fleet's brown-out detector
+        # sets ``replica_id``) picks the victim directly; the perfwatch
+        # sentinel's program-level findings fall back to the slowest
+        # replica by batch EWMA — the best proxy available.
+        named = [
+            rid for rid in (
+                getattr(f, "replica_id", None) for f in findings
+            ) if rid in fresh
+        ]
+        victim = named[0] if named else max(
             fresh, key=lambda rid: fresh[rid].get("batch_ewma_s", 0.0)
         )
 

@@ -78,6 +78,7 @@ from .utils.dataclasses import FleetConfig
 from .utils.fault import (
     FailoverExhaustedError,
     NoHealthyReplicaError,
+    ReplicaBrownoutError,
     RequestDeadlineExceeded,
     ServerDrainingError,
     ServingError,
@@ -148,7 +149,12 @@ class FleetMetrics:
         "hedge_wins",
         "probes",
         "probe_failures",
+        "probe_timeouts",  # a health() read overran probe_timeout_s
+        "brownouts",  # healthy -> brown-out transitions
+        "brownout_clears",  # brown-out -> healthy transitions (hysteresis)
+        "brownout_findings",  # sustained brown-out filed for replacement
         "respawns",
+        "respawn_failures",  # replica_factory raised (crash-looping factory)
         "replicas_added",
         "replicas_removed",
         "prefills",  # prompt forwards run on dedicated prefill workers
@@ -194,6 +200,23 @@ class ReplicaHandle:
     # CircuitOpenError): not a placement candidate until this clock time,
     # while any alternative exists — the replica told us when to come back
     backoff_until_s: float = 0.0
+    # --- gray-failure / brown-out state (docs/fault_tolerance.md).
+    # Written by the prober (and the controller's timeout-bounded health
+    # reads), read by placement/hedging under the router lock.
+    brownout: bool = False  # quarantined: slow/flaky, not dead
+    brownout_since_s: float = 0.0  # router-clock time the episode began
+    brownout_score: float = 0.0  # >= 1.0 engages; hysteresis clears
+    brownout_reported: bool = False  # one drain finding per episode
+    probe_ewma_s: float = 0.0  # EWMA of health() wall latency
+    probe_hung: bool = False  # the in-flight probe overran its timeout
+    perf_ratio: float = 0.0  # worst perf/<prog>/ratio in its last snapshot
+    last_health: Optional[dict] = None  # last completed health sample
+    probe_state: Any = None  # in-flight _Probe (single-flight)
+    respawn_failures: int = 0  # consecutive factory failures
+    # live _FleetRequests routed here (keyed by object id — the request
+    # dataclass is by-value-eq, hence unhashable) — the brown-out hedge
+    # source
+    inflight: dict = field(default_factory=dict)
 
 
 @dataclass
@@ -239,6 +262,32 @@ class _FleetRequest:
             arrival_s=arrival_s,
             trace_id=self.trace_id,
         )
+
+
+class _Probe:
+    """One single-flight, timeout-bounded health read of one replica.
+
+    The actual ``health()`` + ``metrics_snapshot()`` calls run on a
+    dedicated daemon thread; waiters block on :attr:`done` with a
+    deadline. A hung replica leaves its probe thread parked (released by
+    the hang latch or the replica's eventual answer) while every waiter
+    moves on with the cached sample — the prober pass and the SLO
+    controller's observation tick are bounded by ``probe_timeout_s`` no
+    matter what one replica does. Single-flight: a still-running probe is
+    joined, never duplicated, so a wedged replica accumulates exactly one
+    parked thread, not one per tick."""
+
+    __slots__ = ("done", "health", "snap", "error", "started_s", "elapsed_s")
+
+    def __init__(self):
+        self.done = threading.Event()
+        self.health: Optional[dict] = None
+        self.snap: Optional[dict] = None
+        self.error: Optional[BaseException] = None
+        # real wall clock, not the injected router clock: probe latency is
+        # a measured property of the replica, not of simulated time
+        self.started_s = time.monotonic()
+        self.elapsed_s = 0.0
 
 
 # --------------------------------------------------------------------- router
@@ -402,7 +451,7 @@ class FleetRouter:
         surviving replicas (exempt from the retry budget: an orderly drain
         fails each request exactly once). Returns True when the drain
         finished within ``timeout`` (default ``config.drain_timeout_s``)."""
-        fault_point("fleet_scale_down")
+        fault_point("fleet_scale_down", replica=replica_id)
         with self._lock:
             handle = self._handles.get(replica_id)
             if handle is None:
@@ -541,10 +590,22 @@ class FleetRouter:
                 continue
             if h.breaker.rejects_admission:
                 continue
-            try:
-                hh = h.server.health()
-            except Exception:  # noqa: BLE001 — an unprobeable replica is unroutable
-                continue
+            # Route on the prober's cached sample, NEVER an inline
+            # health() call: a wedged health endpoint must park only the
+            # timeout-bounded probe thread, not whoever is placing work
+            # (including the prober's own routable gauge — an inline call
+            # here raced the hang once and froze brown-out detection).
+            # Staleness is one probe interval and is absorbed by the
+            # admission-refusal spillover and failover paths; the ONE
+            # blocking touch is bootstrap, before the first probe lands.
+            hh = h.last_health
+            if hh is None:
+                if h.probe_hung:
+                    continue  # hung before ever answering: unroutable
+                try:
+                    hh = h.server.health()
+                except Exception:  # noqa: BLE001 — an unprobeable replica is unroutable
+                    continue
             if hh["draining"] or not hh["worker_alive"]:
                 continue
             if hh["breaker_state"] == _CircuitBreaker.OPEN:
@@ -558,9 +619,15 @@ class FleetRouter:
     def _score(self, handle: ReplicaHandle, health: dict) -> float:
         """Estimated completion cost: outstanding work × recent batch-time
         EWMA. With no deadline this still orders by load (the EWMA floor
-        keeps the product monotonic in load)."""
+        keeps the product monotonic in load). A browned-out replica's
+        score is multiplied by ``brownout_placement_penalty`` — still
+        routable (it is not dead, and it may be the only replica) but
+        last resort while any healthy candidate exists."""
         load = max(handle.outstanding, health["queue_depth"] + health["inflight"])
-        return (load + 1) * max(health["batch_ewma_s"], 1e-4)
+        score = (load + 1) * max(health["batch_ewma_s"], 1e-4)
+        if handle.brownout:
+            score *= self.config.brownout_placement_penalty
+        return score
 
     def _order(self, cands: list, freq: _FleetRequest) -> list:
         if self.config.placement == "round_robin":
@@ -668,26 +735,37 @@ class FleetRouter:
             freq.inner.append((handle, inner))
         with self._lock:
             handle.outstanding += 1
+            handle.inflight[id(freq)] = freq
         self.metrics.bump("routed")
         inner.add_done_callback(
             lambda f, h=handle, hg=hedge: self._on_inner_done(freq, h, f, hg)
         )
 
     def _maybe_hedge(self, freq: _FleetRequest, ordered: list) -> None:
-        """Near-deadline hedged dispatch: when the remaining deadline is
-        under ``hedge_deadline_fraction`` × the primary's estimated
-        completion and a second candidate exists, dispatch there too —
-        first result wins. Spends a retry-budget token so hedging is
-        bounded by the same storm control as failover."""
-        frac = self.config.hedge_deadline_fraction
-        if frac is None or freq.deadline is None or freq.hedged:
+        """Hedged dispatch, two triggers: (1) near-deadline — the
+        remaining deadline is under ``hedge_deadline_fraction`` × the
+        primary's estimated completion; (2) brown-out — placement had to
+        put the request on a quarantined replica (every healthy candidate
+        refused or scored worse) while a healthy second choice exists,
+        so the request is not left stranded on the gray replica. Either
+        way: dispatch to the runner-up too, first result wins. Spends a
+        retry-budget token so hedging is bounded by the same storm
+        control as failover."""
+        if freq.hedged or len(ordered) < 2:
             return
-        if len(ordered) < 2:
-            return
-        remaining = freq.deadline - self._clock()
-        est = self._score(ordered[0][0], ordered[0][1])
-        if remaining >= frac * est:
-            return
+        primary, runner_up = ordered[0][0], ordered[1][0]
+        if not (
+            self.config.hedge_brownout
+            and primary.brownout
+            and not runner_up.brownout
+        ):
+            frac = self.config.hedge_deadline_fraction
+            if frac is None or freq.deadline is None:
+                return
+            remaining = freq.deadline - self._clock()
+            est = self._score(ordered[0][0], ordered[0][1])
+            if remaining >= frac * est:
+                return
         if not self._budget.try_acquire():
             return
         freq.hedged = True
@@ -709,6 +787,7 @@ class FleetRouter:
     ) -> None:
         with self._lock:
             handle.outstanding = max(0, handle.outstanding - 1)
+            handle.inflight.pop(id(freq), None)
         if fut.cancelled():
             return  # hedge loser, or client-side cancel
         exc = fut.exception()
@@ -907,43 +986,12 @@ class FleetRouter:
     # ------------------------------------------------------------ health probes
     def _probe_loop(self) -> None:
         while not self._stop.wait(self.config.probe_interval_s):
-            with self._lock:
-                handles = list(self._handles.values())
-            for handle in handles:
-                if handle.leaving:
-                    continue
-                try:
-                    fault_point("fleet_probe")
-                    self.metrics.bump("probes")
-                    health = handle.server.health()
-                    dead = not health["worker_alive"]
-                    # fold this replica's health + full metrics snapshot
-                    # into the router registry (fleet/replica/<id>/...):
-                    # the fleet-wide aggregation the exporter serves. The
-                    # snapshot path re-ingests engine gauges, so an IDLE
-                    # replica's KV state still reaches the scrape.
-                    rid = handle.replica_id
-                    self.metrics.registry.ingest(
-                        health, prefix=f"replica/{rid}/health"
-                    )
-                    snap_fn = getattr(handle.server, "metrics_snapshot", None)
-                    if snap_fn is not None:
-                        self.metrics.registry.ingest(
-                            snap_fn(), prefix=f"replica/{rid}"
-                        )
-                    self.metrics.gauge(
-                        f"replica/{rid}/probed_at_s", self._clock()
-                    )
-                except Exception:  # noqa: BLE001 — an unprobeable replica is dead
-                    dead = True
-                if dead:
-                    self.metrics.bump("probe_failures")
-                    handle.breaker.record_failure()
-                    if self.config.auto_respawn and self._replica_factory:
-                        self._respawn(handle)
-            # freshness stamp the SLO controller's fail-static rule reads:
-            # a wedged prober leaves this gauge stale and the controller
-            # freezes instead of acting on a frozen picture of the fleet
+            self._probe_pass()
+            # freshness stamp the SLO controller's fail-static rule reads.
+            # Stamped EVERY pass: probes are timeout-bounded and
+            # concurrent, so one hung replica degrades into a brown-out
+            # finding on THAT replica instead of staling this gauge and
+            # fail-static-freezing the controller for the whole fleet.
             self.metrics.gauge("last_probe_s", self._clock())
             self.metrics.gauge("retry_budget", self._budget.available())
             with self._lock:
@@ -956,6 +1004,253 @@ class FleetRouter:
                 self.trackers, self.config.metrics_interval_s
             )
 
+    def _probe_worker(self, handle: ReplicaHandle, probe: _Probe) -> None:
+        """Body of one probe thread: the only place the prober actually
+        touches the replica. Runs off the prober loop so a hung
+        ``health()`` parks THIS thread, never the pass."""
+        try:
+            fault_point("fleet_probe", replica=handle.replica_id)
+            probe.health = handle.server.health()
+            snap_fn = getattr(handle.server, "metrics_snapshot", None)
+            if snap_fn is not None:
+                probe.snap = snap_fn()
+        except BaseException as exc:  # noqa: BLE001 — typed triage happens at the collector
+            probe.error = exc
+        finally:
+            probe.elapsed_s = time.monotonic() - probe.started_s
+            probe.done.set()
+
+    def _start_probe(self, handle: ReplicaHandle):
+        """Start (or join) the single-flight probe of one replica.
+        Returns ``(probe, started)``; ``started=False`` means a previous
+        probe is still in flight — the wedged-replica case — and the
+        caller should not pay a fresh timeout for it."""
+        with self._lock:
+            probe = handle.probe_state
+            if probe is not None and not probe.done.is_set():
+                return probe, False
+            probe = _Probe()
+            handle.probe_state = probe
+        self.metrics.bump("probes")
+        threading.Thread(  # graft: thread-ok — a wedged health() can block forever; joining it would reintroduce the stall the timeout exists to bound
+            target=self._probe_worker, args=(handle, probe),
+            name=f"fleet-probe-{handle.replica_id}", daemon=True,
+        ).start()
+        return probe, True
+
+    def _note_probe(self, handle: ReplicaHandle, probe: _Probe) -> None:
+        """Fold one COMPLETED, successful probe into the handle: latency
+        EWMA, cached health, worst perfwatch measured-vs-predicted ratio
+        from the replica's own snapshot, and the registry ingest the
+        exporter serves."""
+        rid = handle.replica_id
+        with self._lock:
+            handle.probe_hung = False
+            handle.last_health = probe.health
+            handle.probe_ewma_s = (
+                probe.elapsed_s
+                if handle.probe_ewma_s == 0.0
+                else 0.6 * handle.probe_ewma_s + 0.4 * probe.elapsed_s
+            )
+            if probe.snap:
+                ratios = [
+                    v for k, v in probe.snap.items()
+                    if k.startswith("perf/") and k.endswith("/ratio")
+                    and isinstance(v, (int, float))
+                ]
+                handle.perf_ratio = max(ratios) if ratios else 0.0
+        # fold this replica's health + full metrics snapshot into the
+        # router registry (fleet/replica/<id>/...): the fleet-wide
+        # aggregation the exporter serves. The snapshot path re-ingests
+        # engine gauges, so an IDLE replica's KV state still reaches the
+        # scrape. No router lock held (G104).
+        self.metrics.registry.ingest(probe.health, prefix=f"replica/{rid}/health")
+        if probe.snap is not None:
+            self.metrics.registry.ingest(probe.snap, prefix=f"replica/{rid}")
+        self.metrics.gauge(f"replica/{rid}/probed_at_s", self._clock())
+
+    def _probe_pass(self) -> None:
+        """One concurrent, timeout-bounded sweep over every live replica.
+        All probes are started first, then collected against ONE shared
+        deadline — the pass costs at most ``probe_timeout_s`` regardless
+        of how many replicas hang. A timed-out probe marks its replica
+        brown-out (gray: it answers slowly or not at all, but liveness is
+        unknown — it is NOT respawned); a completed probe feeds the
+        brown-out score and the classic dead-replica path."""
+        with self._lock:
+            handles = [h for h in self._handles.values() if not h.leaving]
+        probes = [(h, *self._start_probe(h)) for h in handles]
+        deadline = time.monotonic() + self.config.probe_timeout_s
+        for handle, probe, started in probes:
+            if not started and handle.probe_hung:
+                # known-wedged: check without re-paying the timeout
+                remaining = 0.0
+            else:
+                remaining = deadline - time.monotonic()
+            probe.done.wait(max(0.0, remaining))
+            dead = False
+            if not probe.done.is_set():
+                if not handle.probe_hung:
+                    self.metrics.bump("probe_timeouts")
+                    logger.warning(
+                        "health probe of replica %s overran %.3fs — "
+                        "marking brown-out",
+                        handle.replica_id, self.config.probe_timeout_s,
+                    )
+                handle.probe_hung = True
+            elif probe.error is not None:
+                dead = True
+            else:
+                self._note_probe(handle, probe)
+                dead = not probe.health["worker_alive"]
+            if dead:
+                self.metrics.bump("probe_failures")
+                handle.breaker.record_failure()
+                if self.config.auto_respawn and self._replica_factory:
+                    self._respawn(handle)
+            else:
+                self._update_brownout(handle)
+
+    # ------------------------------------------------------- brown-out scoring
+    def _brownout_score(self, handle: ReplicaHandle) -> float:
+        """Gray-failure score; >= 1.0 engages quarantine. Terms: probe
+        latency EWMA vs ``brownout_probe_ewma_s``, the replica's worst
+        perfwatch measured-vs-predicted ratio vs
+        ``brownout_residual_ratio`` (the signal no external system has:
+        G501 committed predictions), and an outright hung probe (instant
+        quarantine — the strongest gray signal there is).
+
+        The residual term is PEER-RELATIVE in a multi-replica fleet:
+        gray failure means THIS replica is sick while its siblings are
+        fine, so the term measures the EXCESS of the replica's ratio
+        over the fleet's peer median — zero at parity, 1.0 (engage) at
+        ``brownout_residual_ratio`` times the median. A fleet-wide
+        elevated ratio (miscommitted baseline, whole-pod slowdown, or —
+        in-process — the shared perfwatch observatory) is the drift
+        sentinel's problem and must not quarantine every replica at
+        once; and until the peers have reported a ratio at all there is
+        no differential signal, not an absolute one (the bootstrap
+        probe must not quarantine whoever happens to be probed first).
+        Only a single-replica fleet, which has nobody to deviate from,
+        uses the absolute ratio."""
+        cfg = self.config
+        if handle.probe_hung:
+            return 2.0
+        score = handle.probe_ewma_s / cfg.brownout_probe_ewma_s
+        ratio = handle.perf_ratio
+        if ratio > 0.0:
+            with self._lock:
+                multi = len(self._handles) > 1
+                peers = sorted(
+                    h.perf_ratio for h in self._handles.values()
+                    if h is not handle and h.perf_ratio > 0.0
+                )
+            if not multi:
+                score = max(score, ratio / cfg.brownout_residual_ratio)
+            elif peers:
+                rel = ratio / max(peers[len(peers) // 2], 1e-9)
+                score = max(
+                    score,
+                    (rel - 1.0) / (cfg.brownout_residual_ratio - 1.0),
+                )
+        return score
+
+    def _update_brownout(self, handle: ReplicaHandle) -> None:
+        """Advance one replica's healthy/brown-out state machine
+        (hysteresis: engage at score >= 1, clear below
+        ``brownout_clear_fraction``); on engagement hedge its in-flight
+        requests elsewhere, and after ``brownout_drain_after_s`` of
+        sustained quarantine file ONE typed
+        :class:`~accelerate_tpu.utils.fault.ReplicaBrownoutError` into
+        perfwatch so the controller's drift path drains and replaces it."""
+        cfg = self.config
+        score = self._brownout_score(handle)
+        now = self._clock()
+        rid = handle.replica_id
+        engaged = cleared = False
+        with self._lock:
+            handle.brownout_score = score
+            if not handle.brownout and score >= 1.0:
+                handle.brownout = True
+                handle.brownout_since_s = now
+                handle.brownout_reported = False
+                engaged = True
+            elif handle.brownout and score < cfg.brownout_clear_fraction:
+                handle.brownout = False
+                handle.brownout_reported = False
+                cleared = True
+            sustained = now - handle.brownout_since_s
+            file_finding = (
+                handle.brownout
+                and not handle.brownout_reported
+                and sustained >= cfg.brownout_drain_after_s
+            )
+            if file_finding:
+                handle.brownout_reported = True
+        if engaged:
+            self.metrics.bump("brownouts")
+            logger.warning(
+                "replica %s browned out (score %.2f, probe ewma %.4fs, "
+                "perf ratio %.2f) — deprioritized and hedging in-flight",
+                rid, score, handle.probe_ewma_s, handle.perf_ratio,
+            )
+            if cfg.hedge_brownout:
+                self._hedge_inflight(handle)
+        elif cleared:
+            self.metrics.bump("brownout_clears")
+            logger.warning(
+                "replica %s brown-out cleared (score %.2f)", rid, score
+            )
+        if file_finding:
+            err = ReplicaBrownoutError(
+                rid,
+                score=score,
+                probe_ewma_s=handle.probe_ewma_s,
+                threshold_s=cfg.brownout_probe_ewma_s,
+                sustained_s=sustained,
+            )
+            perfwatch.get_watch().add_finding(err)
+            self.metrics.bump("brownout_findings")
+            logger.error(str(err))
+        self.metrics.gauge(f"replica/{rid}/brownout", 1.0 if handle.brownout else 0.0)
+        self.metrics.gauge(f"replica/{rid}/brownout_score", score)
+        self.metrics.gauge(f"replica/{rid}/probe_ewma_s", handle.probe_ewma_s)
+
+    def _hedge_inflight(self, handle: ReplicaHandle) -> None:
+        """A replica entering brown-out becomes the preferred hedge
+        *source*: every request still in flight on it is dispatched to a
+        healthy replica too (first result wins, loser cancelled), each
+        hedge spending one retry-budget token — quarantine accelerates
+        the requests already trapped on the slow replica instead of only
+        protecting future ones."""
+        with self._lock:
+            freqs = list(handle.inflight.values())
+        for freq in freqs:
+            if freq.future.done() or freq.hedged:
+                continue
+            with freq.lock:
+                exclude = set(freq.tried) | {handle.replica_id}
+            cands = [
+                (h, hh)
+                for h, hh in self._candidates(exclude=exclude)
+                if not h.brownout
+            ]
+            if not cands:
+                continue
+            if not self._budget.try_acquire():
+                return  # budget empty: storm control outranks quarantine
+            freq.hedged = True
+            target = self._order(cands, freq)[0][0]
+            try:
+                with tracing.span(
+                    "fleet.hedge", trace_id=freq.trace_id,
+                    replica=target.replica_id, source=handle.replica_id,
+                ):
+                    self._submit_to(target, freq, hedge=True)
+            except ServingError:
+                continue  # the original dispatch stands; hedging is best-effort
+            self.metrics.bump("hedges")
+
     def _respawn(self, handle: ReplicaHandle) -> None:
         """Supervisor-style scale-up: relaunch a dead replica via the
         factory (bounded by ``respawn_backoff_s``), swap it into the
@@ -967,11 +1262,21 @@ class FleetRouter:
         try:
             server = self._replica_factory(handle.replica_id)
         except Exception as exc:  # noqa: BLE001 — a failed respawn retries next probe
+            # a crash-looping factory must be visible in one scrape, not
+            # buried in a log line: monotonic counter + per-replica gauge
+            handle.respawn_failures += 1
+            self.metrics.bump("respawn_failures")
+            self.metrics.gauge(
+                f"replica/{handle.replica_id}/respawn_failing", 1.0
+            )
             logger.warning(
-                "respawn of replica %s failed: %s: %s",
-                handle.replica_id, type(exc).__name__, exc,
+                "respawn of replica %s failed (%d consecutive): %s: %s",
+                handle.replica_id, handle.respawn_failures,
+                type(exc).__name__, exc,
             )
             return
+        handle.respawn_failures = 0
+        self.metrics.gauge(f"replica/{handle.replica_id}/respawn_failing", 0.0)
         if getattr(server, "replica_id", None) is None:
             server.replica_id = handle.replica_id
         old = handle.server
@@ -1018,20 +1323,37 @@ class FleetRouter:
             handles = [h for h in self._handles.values() if not h.leaving]
         out: Dict[str, dict] = {}
         for h in handles:
-            try:
-                health = h.server.health()
-                rid = h.replica_id
-                self.metrics.registry.ingest(
-                    health, prefix=f"replica/{rid}/health"
-                )
-                snap_fn = getattr(h.server, "metrics_snapshot", None)
-                if snap_fn is not None:
-                    self.metrics.registry.ingest(
-                        snap_fn(), prefix=f"replica/{rid}"
+            # single-flight, timeout-bounded read (shared with the prober)
+            # — the controller's observation tick is bounded no matter
+            # what one replica does. Three outcomes: fresh sample (fold +
+            # covered), typed error (unreadable = NOT covered, the
+            # partial-telemetry fail-static signal), hang (brown-out; the
+            # cached sample keeps the replica covered so the controller
+            # keeps actuating while the quarantine handles it).
+            probe, started = self._start_probe(h)
+            timeout = (
+                0.0 if (not started and h.probe_hung)
+                else self.config.probe_timeout_s
+            )
+            done = probe.done.wait(timeout)
+            if done and probe.error is None and probe.health is not None:
+                self._note_probe(h, probe)
+                self._update_brownout(h)
+                out[h.replica_id] = probe.health
+            elif done:
+                continue  # noqa — unreadable replica = not covered
+            else:
+                if not h.probe_hung:
+                    self.metrics.bump("probe_timeouts")
+                    logger.warning(
+                        "health read of replica %s overran %.3fs — "
+                        "marking brown-out",
+                        h.replica_id, self.config.probe_timeout_s,
                     )
-                out[rid] = health
-            except Exception:  # noqa: BLE001 — unreadable replica = not covered
-                continue
+                h.probe_hung = True
+                self._update_brownout(h)
+                if h.last_health is not None:
+                    out[h.replica_id] = h.last_health
         return out
 
     def metrics_snapshot(self) -> dict:
@@ -1058,9 +1380,17 @@ class FleetRouter:
             handles = list(self._handles.values())
         replicas = {}
         for h in handles:
-            try:
-                health = h.server.health()
-            except Exception:  # noqa: BLE001 — report what is reportable
+            # cached sample, same rule as _candidates: only the prober's
+            # timeout-bounded threads ever block on a replica, so a gray
+            # replica can never wedge a stats caller (or the controller's
+            # observe phase, which reads this)
+            health = h.last_health
+            if health is None and not h.probe_hung:
+                try:
+                    health = h.server.health()
+                except Exception:  # noqa: BLE001 — report what is reportable
+                    health = None
+            if health is None:
                 health = {"worker_alive": False}
             replicas[h.replica_id] = {
                 "outstanding": h.outstanding,
@@ -1069,6 +1399,9 @@ class FleetRouter:
                 "generation": h.generation,
                 "leaving": h.leaving,
                 "breaker_state": h.breaker.state(),
+                "brownout": h.brownout,
+                "brownout_score": h.brownout_score,
+                "respawn_failures": h.respawn_failures,
                 "health": health,
             }
         return {

@@ -431,6 +431,21 @@ class PerfWatch:
             self._findings.clear()
         return out
 
+    def add_finding(self, err: PerfDriftError) -> None:
+        """Record an externally-produced typed drift finding into the same
+        bounded findings list the sentinel feeds. The fleet's brown-out
+        detector files its
+        :class:`~accelerate_tpu.utils.fault.ReplicaBrownoutError` (a
+        :class:`PerfDriftError` subclass) here, so the SLO controller's
+        existing ``consume_drift_findings()`` drain-and-replace path
+        retires a gray-failed replica with zero new control-plane
+        plumbing. Same cap, same counter as sentinel findings."""
+        with self._lock:
+            if len(self._findings) >= _FINDINGS_CAP:
+                return
+            self._findings.append(err)
+        self.registry.bump("drift_findings")
+
 
 # ------------------------------------------------------------ exporter
 def _escape_label(value: str) -> str:

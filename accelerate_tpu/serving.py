@@ -480,7 +480,7 @@ class InferenceServer:
         mint one per request when the tracer is enabled so every span the
         request touches shares one ID.
         """
-        fault_point("serving_submit")
+        fault_point("serving_submit", replica=self.replica_id)
         if self._closed or self._draining or preemption_requested():
             self.metrics.bump("rejected_draining")
             raise ServerDrainingError(
@@ -834,7 +834,7 @@ class InferenceServer:
             if req.degraded:
                 self.metrics.bump("degraded")
             try:
-                fault_point("serving_before_batch")
+                fault_point("serving_before_batch", replica=self.replica_id)
                 with tracing.span(
                     "serving.admit",
                     trace_id=req.trace_id,
@@ -897,7 +897,7 @@ class InferenceServer:
             eng.step()
             retired = eng.poll()
             dt = self._clock() - t0
-            fault_point("serving_after_batch")
+            fault_point("serving_after_batch", replica=self.replica_id)
         except BaseException as exc:  # noqa: BLE001 — classified below
             if isinstance(exc, (KeyboardInterrupt, SystemExit)):
                 self._fail_batch(
@@ -941,7 +941,7 @@ class InferenceServer:
             return
         reqs = [occ.tag for occ in retired]
         try:
-            fault_point("serving_before_reply")
+            fault_point("serving_before_reply", replica=self.replica_id)
             now = self._clock()
             occupancy = self._engine.live_count() + len(retired)
             for occ in retired:
@@ -1240,7 +1240,7 @@ class InferenceServer:
                 # obs-bench drift chaos) must land inside the measured
                 # window, exactly like a genuinely slow batch would
                 t0 = self._clock()
-                fault_point("serving_before_batch")
+                fault_point("serving_before_batch", replica=self.replica_id)
                 with tracing.span(
                     "serving.batch",
                     trace_id=batch[0].trace_id,
@@ -1249,7 +1249,7 @@ class InferenceServer:
                 ):
                     out = self._run_batch(batch)
                 dt = self._clock() - t0
-                fault_point("serving_after_batch")
+                fault_point("serving_after_batch", replica=self.replica_id)
             except BaseException as exc:  # noqa: BLE001 — classified below
                 if isinstance(exc, (KeyboardInterrupt, SystemExit)):
                     # the worker is about to die — the in-flight batch must
@@ -1306,7 +1306,7 @@ class InferenceServer:
             # still tracks them (measured-only row) — dt is the wall time
             # this loop already measured, no new sync point
             perfwatch.get_watch().record("serving.static/batch", dt)
-            fault_point("serving_before_reply")
+            fault_point("serving_before_reply", replica=self.replica_id)
             now = self._clock()
             for i, req in enumerate(batch):
                 if req.deadline is not None and now > req.deadline:

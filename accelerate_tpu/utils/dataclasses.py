@@ -760,6 +760,24 @@ class FleetConfig(KwargsHandler):
     (first result wins, the loser is cancelled); each hedge also spends a
     retry-budget token so hedging can never storm.
 
+    Brown-out quarantine (gray failures — docs/fault_tolerance.md): every
+    probe is timeout-bounded (``probe_timeout_s``) and the prober pass is
+    concurrent, so one hung ``health()`` can never stall the loop or
+    stale the controller's freshness stamp. A replica whose probe-latency
+    EWMA crosses ``brownout_probe_ewma_s``, whose perfwatch
+    measured-vs-predicted ratio (``perf/<prog>/ratio``, from its own
+    snapshot) crosses ``brownout_residual_ratio``, or whose probe hangs
+    outright, enters the **brown-out** state: still routable (it is not
+    dead), but its placement score is multiplied by
+    ``brownout_placement_penalty``, it becomes the preferred hedge
+    *source* (with ``hedge_brownout``, its in-flight requests are hedged
+    to a healthy replica, one retry-budget token each), and after
+    ``brownout_drain_after_s`` of sustained brown-out a typed
+    :class:`~accelerate_tpu.utils.fault.ReplicaBrownoutError` is filed
+    into perfwatch's findings so the SLO controller drains and replaces
+    it zero-drop. The state clears (hysteresis) only when the score falls
+    below ``brownout_clear_fraction`` of the engage threshold.
+
     Prefill/decode disaggregation: ``disaggregate_prefill`` routes
     continuous-mode requests through ``prefill_workers`` dedicated worker
     threads that run the engine's prompt forward
@@ -782,6 +800,14 @@ class FleetConfig(KwargsHandler):
     prefill_workers: int = 2
     auto_respawn: bool = False
     respawn_backoff_s: float = 0.5
+    # gray-failure / brown-out quarantine (docstring section above)
+    probe_timeout_s: float = 0.5
+    brownout_probe_ewma_s: float = 0.05
+    brownout_residual_ratio: float = 2.0
+    brownout_clear_fraction: float = 0.5
+    brownout_drain_after_s: float = 5.0
+    brownout_placement_penalty: float = 4.0
+    hedge_brownout: bool = True
     drain_timeout_s: float = 30.0
     default_deadline_s: Optional[float] = None
     # push a fleet metrics snapshot to the router's trackers at most this
@@ -835,6 +861,35 @@ class FleetConfig(KwargsHandler):
         if self.respawn_backoff_s < 0:
             raise ValueError(
                 f"respawn_backoff_s must be >= 0, got {self.respawn_backoff_s}"
+            )
+        if self.probe_timeout_s <= 0:
+            raise ValueError(
+                f"probe_timeout_s must be > 0, got {self.probe_timeout_s}"
+            )
+        if self.brownout_probe_ewma_s <= 0:
+            raise ValueError(
+                "brownout_probe_ewma_s must be > 0, got "
+                f"{self.brownout_probe_ewma_s}"
+            )
+        if self.brownout_residual_ratio <= 1:
+            raise ValueError(
+                "brownout_residual_ratio must be > 1, got "
+                f"{self.brownout_residual_ratio}"
+            )
+        if not (0 < self.brownout_clear_fraction < 1):
+            raise ValueError(
+                "brownout_clear_fraction must be in (0, 1), got "
+                f"{self.brownout_clear_fraction}"
+            )
+        if self.brownout_drain_after_s < 0:
+            raise ValueError(
+                "brownout_drain_after_s must be >= 0, got "
+                f"{self.brownout_drain_after_s}"
+            )
+        if self.brownout_placement_penalty < 1:
+            raise ValueError(
+                "brownout_placement_penalty must be >= 1, got "
+                f"{self.brownout_placement_penalty}"
             )
         if self.drain_timeout_s < 0:
             raise ValueError(

@@ -53,12 +53,19 @@ __all__ = [
     "EngineInvariantError",
     "ComponentClosedError",
     "PerfDriftError",
+    "ReplicaBrownoutError",
     "ControllerStaleError",
     "FaultInjected",
     "fault_point",
+    "install_conductor",
+    "uninstall_conductor",
+    "release_hang",
+    "release_all_hangs",
+    "reset_fault_state",
     "install_preemption_handler",
     "preemption_requested",
     "PREEMPTION_EXIT_CODE",
+    "FAULT_SEED_ENV",
 ]
 
 # 128 + SIGTERM: the conventional "terminated on request" code. The launch
@@ -67,6 +74,11 @@ __all__ = [
 PREEMPTION_EXIT_CODE = 143
 
 FAULT_INJECT_ENV = "ACCELERATE_TPU_FAULT_INJECT"
+
+# Seed for the per-entry RNG streams behind ``flaky=p`` injection specs.
+# Read at first use of each entry; same seed => bit-identical firing
+# sequence (the chaos conductor's reproducibility contract).
+FAULT_SEED_ENV = "ACCELERATE_TPU_FAULT_SEED"
 
 
 # ------------------------------------------------------------ error taxonomy
@@ -277,6 +289,38 @@ class PerfDriftError(RuntimeError):
         )
 
 
+class ReplicaBrownoutError(PerfDriftError):
+    """A replica has been **browned out** — gray-failed, not dead — for
+    longer than ``FleetConfig.brownout_drain_after_s``: its health probes
+    are slow/hanging and/or its perfwatch measured-vs-predicted residual
+    sits past the committed tolerance, while its liveness checks still
+    pass. Recorded (never raised across the probe loop) by
+    :class:`accelerate_tpu.fleet.FleetRouter` into the perfwatch findings
+    list, so the SLO controller's existing :class:`PerfDriftError`
+    drain-and-replace path retires the replica zero-drop with no new
+    control-plane plumbing. Subclasses :class:`PerfDriftError` precisely
+    so that path applies; ``replica_id`` names the victim directly
+    (``program``/``measured_s``/``predicted_s`` keep the drift-finding
+    shape for dumps and logs)."""
+
+    def __init__(self, replica_id: str, *, score: float,
+                 probe_ewma_s: float, threshold_s: float,
+                 sustained_s: float):
+        self.replica_id = replica_id
+        self.score = score
+        self.sustained_s = sustained_s
+        self.program = f"fleet/replica/{replica_id}"
+        self.measured_s = probe_ewma_s
+        self.predicted_s = threshold_s
+        self.tolerance = 0.0
+        RuntimeError.__init__(
+            self,
+            f"replica {replica_id} browned out for {sustained_s:.1f}s "
+            f"(score {score:.2f}, probe ewma {probe_ewma_s:.4f}s vs "
+            f"threshold {threshold_s:.4f}s) — drain and replace"
+        )
+
+
 class ControllerStaleError(RuntimeError):
     """The SLO controller's telemetry was stale or partial at an
     observation tick — the prober has not refreshed the fleet snapshot
@@ -310,10 +354,115 @@ class FaultInjected(RuntimeError):
 
 
 # ------------------------------------------------------------ fault injection
-def fault_point(name: str) -> None:
+# Per-entry injection state. Keyed by the raw spec entry (e.g.
+# "fleet_probe:raise:flaky=0.2") so two entries arming the same point keep
+# independent hit counters and RNG streams. Guarded by _FAULT_LOCK; the
+# dicts are tiny (one slot per armed entry) and only touched when a spec
+# or conductor is armed, so the hot no-injection path stays lock-free.
+_FAULT_LOCK = threading.Lock()
+_FAULT_HITS: dict = {}  # entry key -> hit count (post-increment)
+_FAULT_RNGS: dict = {}  # entry key -> seeded random.Random for flaky=p
+_HANG_EVENTS: dict = {}  # point name -> Event released by release_hang()
+_HANG_DEFAULT_CAP_S = 30.0
+
+# Programmatic injection hook installed by a ChaosConductor
+# (accelerate_tpu.chaos). Consulted before the env spec on every
+# fault_point() hit with the point name and call-site context; the
+# conductor applies its own seeded schedule. Module-global (not
+# thread-local): chaos targets the whole process.
+_CONDUCTOR = None
+
+
+def install_conductor(fn) -> None:
+    """Install a programmatic injection hook ``fn(name, context)`` consulted
+    by every :func:`fault_point` hit *before* the env-var spec. Used by
+    :class:`accelerate_tpu.chaos.ChaosConductor` for seeded, declarative,
+    phase-windowed schedules that an env string cannot express. Only one
+    conductor at a time; installing over a live one replaces it."""
+    global _CONDUCTOR
+    _CONDUCTOR = fn
+
+
+def uninstall_conductor(fn=None) -> None:
+    """Remove the programmatic injection hook. With ``fn`` given, only
+    remove it if it is still the installed one (a conductor stopping late
+    must not tear down its successor)."""
+    global _CONDUCTOR
+    if fn is None or _CONDUCTOR is fn:
+        _CONDUCTOR = None
+
+
+def release_hang(name: str) -> bool:
+    """Release threads blocked at a ``hang``-armed fault point. Returns
+    whether any hang was armed at ``name``. Idempotent."""
+    with _FAULT_LOCK:
+        event = _HANG_EVENTS.get(name)
+    if event is None:
+        return False
+    event.set()
+    return True
+
+
+def release_all_hangs() -> None:
+    """Release every thread blocked at any ``hang``-armed point (test/bench
+    teardown: a hung probe thread must not outlive its test)."""
+    with _FAULT_LOCK:
+        events = list(_HANG_EVENTS.values())
+    for event in events:
+        event.set()
+
+
+def reset_fault_state() -> None:
+    """Reset hit counters, flaky RNG streams, and hang latches. Chaos runs
+    call this between repetitions so the same seed replays the same firing
+    sequence bit-for-bit from a clean slate."""
+    release_all_hangs()
+    with _FAULT_LOCK:
+        _FAULT_HITS.clear()
+        _FAULT_RNGS.clear()
+        _HANG_EVENTS.clear()
+
+
+def _entry_rng(key: str):
+    """Seeded per-entry RNG stream for ``flaky=p``: crc32 of seed+entry
+    (NOT ``hash()``, which is salted per process) so the firing sequence
+    is reproducible across processes and runs."""
+    import random
+    import zlib
+
+    seed = os.environ.get(FAULT_SEED_ENV, "0")
+    return random.Random(zlib.crc32(f"{seed}|{key}".encode()))
+
+
+def _fire_action(name: str, action: str) -> None:
+    if action == "kill":
+        os.kill(os.getpid(), signal.SIGKILL)
+    elif action == "exit":
+        os._exit(17)
+    elif action == "raise":
+        raise FaultInjected(name)
+    elif action == "sleep" or action.startswith("sleep="):
+        _, _, dur = action.partition("=")
+        time.sleep(float(dur) if dur else 0.05)
+    elif action == "hang" or action.startswith("hang="):
+        _, _, cap = action.partition("=")
+        with _FAULT_LOCK:
+            event = _HANG_EVENTS.setdefault(name, threading.Event())
+        event.wait(float(cap) if cap else _HANG_DEFAULT_CAP_S)
+    else:
+        raise ValueError(
+            f"unknown fault action {action!r} for point {name!r} "
+            f"(expected kill|exit|raise|sleep[=s]|hang[=s])"
+        )
+
+
+_FAULT_MODIFIERS = ("flaky", "after", "every")
+
+
+def fault_point(name: str, **context) -> None:
     """Fault-injection hook: if ``ACCELERATE_TPU_FAULT_INJECT`` names this
-    point, die here. The spec is a comma-separated list of ``point[:action]``
-    entries; actions are
+    point, die (or degrade) here. The spec is a comma-separated list of
+    ``point[:action][:modifier...]`` entries; actions are
 
     * ``kill`` (default) — SIGKILL this process, exactly like a host loss or
       OOM-killer mid-save; nothing (atexit, finally, orbax commit threads)
@@ -324,7 +473,32 @@ def fault_point(name: str) -> None:
       0.05), then continue. A survivable slowdown rather than a death:
       this is how the drift-sentinel chaos probe (``benchmarks/
       obs_bench.py``) makes a step path measurably slower without
-      changing any program.
+      changing any program;
+    * ``hang=<cap_seconds>`` — block on a latch until
+      :func:`release_hang`/:func:`release_all_hangs` (or the cap, default
+      30s, a backstop so an orphaned hang can't wedge CI forever), then
+      continue. The gray-failure primitive: the caller neither dies nor
+      errors, it just *stops answering* — exactly what a wedged
+      ``health()`` RPC looks like.
+
+    Modifiers refine *when* an entry fires (all must agree; hit counters
+    and RNG streams are per-entry, so two entries arming the same point
+    are independent):
+
+    * ``flaky=<p>`` — fire with probability ``p`` per hit, from an RNG
+      stream seeded by ``ACCELERATE_TPU_FAULT_SEED`` + the entry text:
+      the same seed replays a bit-identical firing sequence (call
+      :func:`reset_fault_state` between runs). ``flaky=p`` in action
+      position implies ``raise``.
+    * ``after=<N>`` — skip the first N hits (arm a fault deep into a run);
+    * ``every=<N>`` — after ``after``, fire on every Nth hit only.
+
+    So ``fleet_probe:raise:flaky=0.2`` makes one in five probe hops fail
+    (seeded), and ``serving_before_batch:hang:after=10`` wedges the 11th
+    batch. Keyword ``context`` (e.g. ``replica=...``) is ignored by the
+    env path but forwarded to an installed chaos conductor
+    (:func:`install_conductor`) so declarative schedules can scope a rule
+    to one replica.
 
     Checkpointing calls this at the named moments of the save lifecycle
     (``after_model_save``, ``after_optimizer_save``, ``before_commit``,
@@ -347,28 +521,60 @@ def fault_point(name: str) -> None:
     telemetry and prove the fail-static freeze). The env var is
     read at call time so a test script can arm a point between two saves.
     """
+    conductor = _CONDUCTOR
+    if conductor is not None:
+        conductor(name, context)
     spec = os.environ.get(FAULT_INJECT_ENV)
     if not spec:
         return
     for item in spec.split(","):
-        point, _, action = item.strip().partition(":")
+        entry = item.strip()
+        point, _, tail = entry.partition(":")
         if point != name:
             continue
-        action = action or "kill"
-        if action == "kill":
-            os.kill(os.getpid(), signal.SIGKILL)
-        elif action == "exit":
-            os._exit(17)
-        elif action == "raise":
-            raise FaultInjected(name)
-        elif action == "sleep" or action.startswith("sleep="):
-            _, _, dur = action.partition("=")
-            time.sleep(float(dur) if dur else 0.05)
-        else:
-            raise ValueError(
-                f"unknown fault action {action!r} for point {name!r} "
-                f"(expected kill|exit|raise|sleep[=s])"
-            )
+        action = None
+        flaky = None
+        after = 0
+        every = 1
+        for token in filter(None, tail.split(":")):
+            mod, _, value = token.partition("=")
+            if mod in _FAULT_MODIFIERS:
+                if mod == "flaky":
+                    flaky = float(value)
+                elif mod == "after":
+                    after = int(value)
+                else:
+                    every = max(1, int(value))
+            elif action is None:
+                action = token
+            else:
+                raise ValueError(
+                    f"fault entry {entry!r}: second action {token!r} "
+                    f"(one action per entry; modifiers are "
+                    f"{'/'.join(_FAULT_MODIFIERS)})"
+                )
+        if action is None:
+            # Bare point defaults to kill; a modifier-only entry (e.g.
+            # "fleet_probe:flaky=0.2") defaults to raise — a flaky hop is
+            # an error, not a host loss.
+            action = "kill" if flaky is None and tail == "" else "raise"
+        with _FAULT_LOCK:
+            hits = _FAULT_HITS.get(entry, 0) + 1
+            _FAULT_HITS[entry] = hits
+            if flaky is not None and entry not in _FAULT_RNGS:
+                _FAULT_RNGS[entry] = _entry_rng(entry)
+            rng = _FAULT_RNGS.get(entry)
+            if hits <= after:
+                continue
+            if (hits - after - 1) % every != 0:
+                continue
+            # Draw INSIDE the lock and only on hits that passed the
+            # counters: the stream position is then a pure function of
+            # (seed, entry, firing-eligible hit index) — bit-reproducible
+            # even when probes hit this point from several threads.
+            if flaky is not None and rng.random() >= flaky:
+                continue
+        _fire_action(name, action)
 
 
 # ---------------------------------------------------------------- preemption

@@ -750,6 +750,17 @@ if __name__ == "__main__":
         from benchmarks.autoscale_bench import main as autoscale_main
 
         sys.exit(autoscale_main(gate=True))
+    if "--chaos-gate" in sys.argv:
+        # gray-failure gate: seeded chaos conductor (10x straggler, flaky
+        # probe hops, one kill-mid-batch) vs a no-chaos run of the same
+        # arrivals — goodput >= 0.85x, TTFT p99 <= 1.5x, zero dropped
+        # futures, invariant monitors clean, brown-out quarantine +
+        # drain-and-replace observed, and a bit-identical firing-sequence
+        # replay (docs/fault_tolerance.md)
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from benchmarks.chaos_bench import main as chaos_main
+
+        sys.exit(chaos_main(gate=True))
     if "--continuous-gate" in sys.argv:
         # continuous-batching gate: mixed-length/mixed-budget workload must
         # reach >= 1.3x static-mode goodput with TTFT p99 no worse, <= 2

@@ -1,0 +1,428 @@
+"""Gray-failure gate: seeded chaos conductor vs the quarantine loop.
+
+The question this bench answers (docs/fault_tolerance.md "Gray
+failures"): when a fleet is hit with the canonical gray-failure weather —
+one straggler replica at 10x step latency, flaky probe hops at p=0.2,
+and one batch killed mid-flight — does the brown-out quarantine +
+hedging + drain-and-replace machinery hold the service together with
+**no human action and no silent corruption**?
+
+One seeded :class:`~accelerate_tpu.chaos.ChaosSchedule` (phase windows
+aligned with the ``benchmarks/loadgen`` replay via
+:func:`~accelerate_tpu.chaos.phase_windows`) drives everything:
+
+* ``straggler`` / ``straggler-probe`` — replica ``r0`` slows 10x per
+  batch and its health probes slow past the brown-out threshold, for the
+  storm phase. The quarantine must engage (brown-out, deprioritized,
+  in-flight hedged), then the sustained episode must file ONE typed
+  :class:`~accelerate_tpu.utils.fault.ReplicaBrownoutError` that the SLO
+  controller answers by draining and replacing ``r0``.
+* ``flaky-probe`` — every probe hop fails with probability 0.2 (seeded).
+  The breaker and coverage rules must absorb this as noise.
+* ``kill-mid-batch`` — exactly one batch on ``r1`` dies mid-flight
+  (``max_fires=1``); its requests must fail over, not drop.
+
+Gates (vs a no-chaos run of the SAME seeded arrival schedule):
+goodput >= 0.85x, TTFT p99 <= 1.5x, zero dropped futures, zero untyped
+errors, complete trace trees (every ``fleet.submit`` root that delivered
+a result shows a ``fleet.dispatch``), always-on
+:class:`~accelerate_tpu.chaos.InvariantMonitors` clean, quarantine +
+replacement observed, and the recorded hit log replays to a
+**bit-identical** firing sequence through a fresh same-seed conductor —
+twice (chaos you can put in CI).
+
+Prints one JSON line per phase plus a gate line. ``--gate`` (also
+``bench.py --chaos-gate`` / ``make bench-chaos``) turns the acceptance
+criteria into a nonzero exit.
+"""
+
+from __future__ import annotations
+
+import os
+import sys as _sys
+
+_sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))  # runnable as `python benchmarks/x.py`
+
+import json
+import shutil
+import tempfile
+import time
+
+import numpy as np
+
+from benchmarks import loadgen
+
+SERVICE_S = float(os.environ.get("CHB_SERVICE_S", "0.05"))
+MAX_BATCH = int(os.environ.get("CHB_MAX_BATCH", "8"))
+SEED = int(os.environ.get("CHB_SEED", "4242"))
+WARM_S = float(os.environ.get("CHB_WARM_S", "1.5"))
+STORM_S = float(os.environ.get("CHB_STORM_S", "12.0"))
+RECOVER_S = float(os.environ.get("CHB_RECOVER_S", "1.5"))
+STRAGGLER_X = float(os.environ.get("CHB_STRAGGLER_X", "10.0"))
+FLAKY_P = float(os.environ.get("CHB_FLAKY_P", "0.2"))
+GATE_GOODPUT_RATIO = float(os.environ.get("CHB_GATE_GOODPUT", "0.85"))
+GATE_TTFT_RATIO = float(os.environ.get("CHB_GATE_TTFT", "1.5"))
+PROMPT = np.arange(1, 9, dtype=np.int32)
+
+CAPACITY = MAX_BATCH / SERVICE_S  # one replica's throughput ceiling
+
+
+def _synthetic_gen():
+    def fn(model, ids, max_new_tokens=4, **kw):
+        time.sleep(SERVICE_S)
+        new = np.repeat(ids[:, :1], max_new_tokens, axis=1)
+        return np.concatenate([ids, new], axis=1)
+
+    return fn
+
+
+def _replica_factory():
+    from accelerate_tpu.serving import InferenceServer
+    from accelerate_tpu.utils.dataclasses import ServingConfig
+
+    scfg = ServingConfig(
+        max_queue=256, max_batch_size=MAX_BATCH, batch_window_s=0.001,
+        default_max_new_tokens=4, max_retries=0, drain_timeout_s=10.0,
+    )
+
+    def factory(replica_id: str):
+        return InferenceServer(
+            object(), scfg, generate_fn=_synthetic_gen(),
+            replica_id=replica_id,
+        )
+
+    return factory
+
+
+def _fleet(n_replicas: int):
+    from accelerate_tpu.fleet import FleetRouter
+    from accelerate_tpu.utils.dataclasses import FleetConfig
+
+    factory = _replica_factory()
+    servers = {f"r{i}": factory(f"r{i}") for i in range(n_replicas)}
+    return FleetRouter(
+        servers,
+        FleetConfig(
+            probe_interval_s=0.05,
+            # below the straggler's 0.2s probe delay: a straggling
+            # replica's probe OVERRUNS => probe_hung engages brown-out at
+            # the timeout instead of waiting out the slowed probe, which
+            # halves detection latency and with it the trapped-request
+            # cohort at storm onset
+            probe_timeout_s=0.15,
+            brownout_probe_ewma_s=0.06,
+            brownout_drain_after_s=0.2,
+            # flaky probe errors are the breaker's problem, not a reason
+            # to churn healthy replicas through the factory
+            auto_respawn=False,
+        ),
+        replica_factory=factory,
+    )
+
+
+def _controller(router):
+    from accelerate_tpu.controller import SLOController
+    from accelerate_tpu.utils.dataclasses import ControllerConfig
+
+    return SLOController(router, ControllerConfig(
+        interval_s=0.05,
+        ttft_slo_s=None,
+        escalate_threshold=100.0,  # pin the ladder: this gate isolates
+        relax_threshold=0.0,       # the quarantine -> replace loop
+        scale_cooldown_s=60.0,
+        min_coverage=0.6,  # flaky probe hops must read as noise, not freeze
+        min_replicas=1,
+        max_replicas=5,
+    ))
+
+
+def _schedule():
+    base, storm = 0.7 * CAPACITY, 0.9 * CAPACITY
+    return loadgen.from_phases(
+        [
+            loadgen.Phase("warm", WARM_S, base),
+            loadgen.Phase("storm", STORM_S, storm),
+            loadgen.Phase("recover", RECOVER_S, base),
+        ],
+        seed=SEED,
+    )
+
+
+def _chaos_schedule(schedule):
+    """The full chaos plan, phase-aligned with the load replay: chaos
+    starts exactly when the storm phase does."""
+    from accelerate_tpu.chaos import ChaosRule, ChaosSchedule, phase_windows
+
+    windows = dict(
+        (name, (start, end))
+        for name, start, end in phase_windows(schedule.phases)
+    )
+    storm_start, storm_end = windows["storm"]
+    return ChaosSchedule(
+        name="gray-failure-storm",
+        seed=SEED,
+        rules=(
+            # r0 straggles: every batch pays (STRAGGLER_X - 1) extra
+            # service times => 10x step latency while the rule is active
+            ChaosRule(
+                point="serving_before_batch",
+                action=f"sleep={(STRAGGLER_X - 1.0) * SERVICE_S}",
+                match={"replica": "r0"},
+                start_s=storm_start,
+                label="straggler",
+            ),
+            # ... and its probe hops slow past the brown-out threshold —
+            # the gray signal the quarantine scores on. Listed BEFORE the
+            # flaky rule: the first fired action wins, so r0's probes
+            # slow down rather than error out.
+            ChaosRule(
+                point="fleet_probe",
+                action="sleep=0.2",
+                match={"replica": "r0"},
+                start_s=storm_start,
+                label="straggler-probe",
+            ),
+            # every probe hop (any replica) flakes at p=0.2, seeded
+            ChaosRule(
+                point="fleet_probe",
+                action="raise",
+                prob=FLAKY_P,
+                start_s=storm_start,
+                end_s=storm_end,
+                label="flaky-probe",
+            ),
+            # exactly one batch on r1 dies mid-flight (typed
+            # BatchExecutionError inside the worker => failover)
+            ChaosRule(
+                point="serving_before_batch",
+                action="raise",
+                match={"replica": "r1"},
+                start_s=storm_start,
+                end_s=storm_end,
+                max_fires=1,
+                label="kill-mid-batch",
+            ),
+        ),
+    )
+
+
+def _replay(router, schedule, monitors=None) -> dict:
+    """Replay the schedule open-loop, resolve every future, and classify
+    outcomes the way the invariant monitors do. Static-batch mode
+    materializes all tokens at once, so client latency IS time to first
+    token — reported as ttft."""
+    from accelerate_tpu.utils.fault import ServingError
+
+    futures = []
+    if monitors is not None:
+        monitors.watch_registry("fleet", router.metrics.registry)
+
+    def submit(phase):
+        futures.append(router.submit(PROMPT, max_new_tokens=4))
+
+    counts = schedule.replay(
+        submit,
+        on_phase=(lambda name: monitors.sample()) if monitors else None,
+    )
+    lat = []
+    completed = typed_retriable = typed_final = untyped = dropped = 0
+    for f in futures:
+        try:
+            res = f.result(timeout=60)
+            completed += 1
+            lat.append(res.latency_s)
+        except ServingError as exc:
+            if exc.retriable:
+                typed_retriable += 1
+            else:
+                typed_final += 1
+        except TimeoutError:
+            dropped += 1  # the zero-drop gate: must stay 0
+        except Exception:  # noqa: BLE001 — gate counts anything untyped
+            untyped += 1
+    lat.sort()
+    if os.environ.get("CHB_DEBUG_TAIL"):
+        print("tail:", [round(x, 3) for x in lat[-20:]], flush=True)
+    return {
+        "offered": sum(counts.values()),
+        "offered_by_phase": counts,
+        "completed": completed,
+        "goodput_rps": round(completed / schedule.duration_s, 1),
+        "typed_retriable": typed_retriable,
+        "typed_final": typed_final,
+        "untyped_errors": untyped,
+        "dropped_futures": dropped,
+        "ttft_p50_s": round(lat[len(lat) // 2], 4) if lat else None,
+        "ttft_p99_s": (
+            round(lat[min(len(lat) - 1, int(0.99 * len(lat)))], 4)
+            if lat else None
+        ),
+        "futures": futures,
+    }
+
+
+def _trace_verdict(monitors, futures, tracer) -> dict:
+    """Feed every request's trace into the monitors post-hoc. The bench
+    submits from ONE thread, so the i-th committed ``fleet.submit`` span
+    belongs to the i-th future — that ordering recovers the trace ids the
+    router minted internally."""
+    submits = [sp for sp in tracer.spans() if sp.name == "fleet.submit"]
+    matched = len(submits) == len(futures)
+    if matched:
+        for i, (sp, fut) in enumerate(zip(submits, futures)):
+            monitors.track(f"trace-{i}", fut, trace_id=sp.trace_id)
+    return {
+        "submit_spans": len(submits),
+        "futures": len(futures),
+        "trace_ids_recovered": matched,
+        "unverified_traces": monitors.unverified_traces,
+    }
+
+
+def _baseline_run(schedule) -> dict:
+    """The no-chaos side of the A/B: same seeded arrivals, same fleet,
+    same live controller and same tracing overhead. The ONLY difference
+    from the chaos run is the conductor — so the gate's ratios isolate
+    the injected faults, not the instrumentation (which matters on small
+    hosts where the control plane shares cores with the data path)."""
+    from accelerate_tpu import perfwatch, tracing
+    from accelerate_tpu.utils.dataclasses import TracingConfig
+
+    tracing.configure(TracingConfig(
+        enabled=True, ring_capacity=65536, dump_on_failure=False,
+    ))
+    perfwatch.get_watch().consume_drift_findings()  # drain leftovers
+    router = _fleet(3)
+    ctl = _controller(router)
+    try:
+        ctl.start()
+        row = _replay(router, schedule)
+    finally:
+        ctl.close()
+        router.close(drain=False)
+        tracing.configure(TracingConfig())
+        perfwatch.get_watch().consume_drift_findings()
+    row.pop("futures")
+    row["phase"] = "no_chaos"
+    print(json.dumps(row), flush=True)
+    return row
+
+
+def _chaos_run(schedule, workdir: str) -> dict:
+    from accelerate_tpu import chaos as chaos_mod
+    from accelerate_tpu import perfwatch, tracing
+    from accelerate_tpu.utils.dataclasses import TracingConfig
+
+    tracing.configure(TracingConfig(
+        enabled=True, ring_capacity=65536,
+        dump_dir=workdir, max_dumps=1, dump_on_failure=False,
+    ))
+    tracer = tracing.get_tracer()
+    perfwatch.get_watch().consume_drift_findings()  # drain leftovers
+    monitors = chaos_mod.InvariantMonitors(tracer=tracer, max_traces=4096)
+    conductor = chaos_mod.ChaosConductor(_chaos_schedule(schedule))
+    router = _fleet(3)
+    ctl = _controller(router)
+    monitors.watch_registry("controller", ctl.metrics)
+    try:
+        ctl.start()
+        conductor.start()
+        row = _replay(router, schedule, monitors=monitors)
+        conductor.stop()
+        time.sleep(0.3)  # let the replacement drain settle
+        futures = row.pop("futures")
+        trace_row = _trace_verdict(monitors, futures, tracer)
+        violations = monitors.check(quiesce_timeout_s=10.0)
+        replicas = sorted(router.replica_ids())
+        fleet_m = router.metrics
+        row.update({
+            "phase": "chaos",
+            "violations": [str(v) for v in violations],
+            "violation_kinds": sorted({v.kind for v in violations}),
+            **trace_row,
+            "brownouts": fleet_m["brownouts"],
+            "brownout_findings": fleet_m["brownout_findings"],
+            "hedges": fleet_m["hedges"],
+            "failovers": fleet_m["failovers"],
+            "drift_replacements": ctl.metrics["drift_replacements"],
+            "replicas_after": replicas,
+            "straggler_replaced": "r0" not in replicas
+            and any(r.startswith("ctl-") for r in replicas),
+            "fires_by_rule": {
+                label: conductor.fires(label)
+                for label in ("straggler", "straggler-probe",
+                              "flaky-probe", "kill-mid-batch")
+            },
+        })
+    finally:
+        conductor.stop()
+        ctl.close()
+        router.close(drain=False)
+        tracing.configure(TracingConfig())
+        perfwatch.get_watch().consume_drift_findings()
+    # determinism: the recorded hit log through a FRESH same-seed
+    # conductor must reproduce the live firing log bit-for-bit — twice
+    live = conductor.firing_sequence()
+    hits = conductor.hit_log()
+    row["firings"] = len(live)
+    row["replay_identical"] = (
+        conductor.replay(hits) == live and conductor.replay(hits) == live
+    )
+    print(json.dumps(row), flush=True)
+    return row
+
+
+def main(gate: bool = False) -> int:
+    workdir = tempfile.mkdtemp(prefix="chaos_bench_")
+    try:
+        schedule = _schedule()
+        base = _baseline_run(schedule)
+        chaotic = _chaos_run(schedule, workdir)
+
+        goodput_ratio = chaotic["goodput_rps"] / max(base["goodput_rps"], 1e-9)
+        ttft_ratio = (
+            chaotic["ttft_p99_s"] / max(base["ttft_p99_s"], 1e-9)
+            if chaotic["ttft_p99_s"] is not None
+            and base["ttft_p99_s"] is not None
+            else float("inf")
+        )
+        checks = {
+            "goodput_held": goodput_ratio >= GATE_GOODPUT_RATIO,
+            "ttft_p99_held": ttft_ratio <= GATE_TTFT_RATIO,
+            "zero_dropped": base["dropped_futures"] == 0
+            and chaotic["dropped_futures"] == 0,
+            "zero_untyped": base["untyped_errors"] == 0
+            and chaotic["untyped_errors"] == 0,
+            "monitors_clean": chaotic["violations"] == [],
+            "traces_complete": chaotic["trace_ids_recovered"]
+            and chaotic["unverified_traces"] == 0,
+            "quarantined": chaotic["brownouts"] >= 1
+            and chaotic["brownout_findings"] >= 1,
+            "drained_and_replaced": chaotic["drift_replacements"] >= 1
+            and chaotic["straggler_replaced"],
+            "killed_exactly_once": chaotic["fires_by_rule"]["kill-mid-batch"] == 1,
+            "chaos_actually_fired": chaotic["fires_by_rule"]["straggler"] >= 1
+            and chaotic["fires_by_rule"]["flaky-probe"] >= 1,
+            "replay_bit_identical": chaotic["replay_identical"]
+            and chaotic["firings"] > 0,
+        }
+        ok = all(checks.values())
+        print(json.dumps({
+            "metric": "chaos_gate",
+            "seed": SEED,
+            "goodput_ratio": round(goodput_ratio, 3),
+            "goodput_threshold": GATE_GOODPUT_RATIO,
+            "ttft_p99_ratio": round(ttft_ratio, 3),
+            "ttft_threshold": GATE_TTFT_RATIO,
+            "ttft_p99_no_chaos_s": base["ttft_p99_s"],
+            "ttft_p99_chaos_s": chaotic["ttft_p99_s"],
+            "checks": checks,
+            "pass": ok,
+        }), flush=True)
+        return 0 if (ok or not gate) else 1
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(gate="--gate" in _sys.argv))

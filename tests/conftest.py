@@ -78,6 +78,13 @@ def fault_inject():
         yield _arm
     finally:
         os.environ.pop(FAULT_INJECT_ENV, None)
+        os.environ.pop("ACCELERATE_TPU_FAULT_SEED", None)
+        # clear per-entry hit counters / flaky RNG streams and release any
+        # hang latch a test left armed (a parked probe thread must not
+        # outlive its test)
+        from accelerate_tpu.utils.fault import reset_fault_state
+
+        reset_fault_state()
 
 
 @pytest.fixture(autouse=True)

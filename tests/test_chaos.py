@@ -1,0 +1,339 @@
+"""Chaos-conductor + invariant-monitor suite (docs/fault_tolerance.md
+"Gray failures"; docs/control_plane.md "Chaos-conductor runbook"):
+
+* extended ``fault_point`` grammar — ``hang`` blocks until released (and
+  respects its cap), ``flaky=p`` fires from a seeded per-entry RNG
+  stream (same seed ⇒ bit-identical firing sequence), ``after=N``/
+  ``every=N`` hit counters compose with both;
+* the declarative conductor — per-replica scoping via call-site context,
+  phase windows, ``max_fires`` caps, and the replay contract: a recorded
+  hit log fed through a fresh same-seed conductor reproduces the firing
+  log bit-for-bit;
+* invariant monitors — a dropped future, an untyped error, an
+  incomplete trace tree, and a counter going backwards are each caught;
+  a healthy fleet run under monitors is clean.
+
+All tests run on static-mode servers with fake generate_fns — chaos and
+its monitors are pure host-side control plane.
+"""
+
+import os
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+from accelerate_tpu import tracing
+from accelerate_tpu.chaos import (
+    ChaosConductor,
+    ChaosRule,
+    ChaosSchedule,
+    InvariantMonitors,
+    InvariantViolation,
+    phase_windows,
+)
+from accelerate_tpu.utils.fault import (
+    FaultInjected,
+    ServerOverloaded,
+    fault_point,
+    release_hang,
+    reset_fault_state,
+)
+
+PROMPT = np.arange(1, 6, dtype=np.int32)
+
+
+# ------------------------------------------------------- extended grammar
+def _firing_pattern(point: str, n: int = 80) -> list:
+    out = []
+    for _ in range(n):
+        try:
+            fault_point(point)
+            out.append(0)
+        except FaultInjected:
+            out.append(1)
+    return out
+
+
+def test_flaky_is_seeded_and_bit_reproducible(fault_inject):
+    os.environ["ACCELERATE_TPU_FAULT_SEED"] = "1234"
+    fault_inject("fleet_probe:raise:flaky=0.3")
+    first = _firing_pattern("fleet_probe")
+    reset_fault_state()
+    second = _firing_pattern("fleet_probe")
+    assert first == second  # bit-identical, not statistically similar
+    assert 0 < sum(first) < len(first)  # actually flaky, not all-or-nothing
+
+
+def test_flaky_sequence_changes_with_seed(fault_inject):
+    fault_inject("fleet_probe:raise:flaky=0.5")
+    os.environ["ACCELERATE_TPU_FAULT_SEED"] = "1"
+    first = _firing_pattern("fleet_probe")
+    reset_fault_state()
+    os.environ["ACCELERATE_TPU_FAULT_SEED"] = "2"
+    second = _firing_pattern("fleet_probe")
+    assert first != second
+
+
+def test_modifier_only_entry_defaults_to_raise(fault_inject):
+    # a flaky hop is an error, not a host loss: bare "point:flaky=p" must
+    # never default to the kill action
+    fault_inject("fleet_probe:flaky=1.0")
+    with pytest.raises(FaultInjected):
+        fault_point("fleet_probe")
+
+
+def test_after_and_every_hit_counters(fault_inject):
+    fault_inject("fleet_route:raise:after=3:every=2")
+    assert _firing_pattern("fleet_route", 9) == [0, 0, 0, 1, 0, 1, 0, 1, 0]
+
+
+def test_counters_are_per_entry_not_per_point(fault_inject):
+    # two entries arming the SAME point keep independent hit counters
+    fault_inject("fleet_route:raise:after=2,fleet_route:raise:after=4")
+    pattern = _firing_pattern("fleet_route", 5)
+    assert pattern == [0, 0, 1, 1, 1]
+
+
+def test_hang_blocks_until_released(fault_inject):
+    fault_inject("fleet_probe:hang=30")
+    passed = threading.Event()
+
+    def hit():
+        fault_point("fleet_probe")
+        passed.set()
+
+    t = threading.Thread(target=hit, daemon=True)
+    t.start()
+    time.sleep(0.1)
+    assert not passed.is_set()  # parked, not raising, not returning
+    assert release_hang("fleet_probe")
+    t.join(2.0)
+    assert passed.is_set()
+
+
+def test_hang_cap_bounds_the_block(fault_inject):
+    fault_inject("fleet_probe:hang=0.05")
+    t0 = time.monotonic()
+    fault_point("fleet_probe")  # returns at the cap, nobody released it
+    assert 0.04 <= time.monotonic() - t0 < 2.0
+
+
+def test_unknown_action_and_double_action_are_typed_errors(fault_inject):
+    fault_inject("fleet_probe:explode")
+    with pytest.raises(ValueError, match="unknown fault action"):
+        fault_point("fleet_probe")
+    fault_inject("fleet_probe:raise:sleep")
+    with pytest.raises(ValueError, match="second action"):
+        fault_point("fleet_probe")
+
+
+# ------------------------------------------------------------- conductor
+def test_conductor_scopes_rules_by_context():
+    sched = ChaosSchedule(rules=(
+        ChaosRule(point="fleet_probe", action="raise",
+                  match={"replica": "r1"}, label="r1-only"),
+    ), seed=3)
+    with ChaosConductor(sched) as con:
+        fault_point("fleet_probe", replica="r0")  # no match: silent
+        with pytest.raises(FaultInjected):
+            fault_point("fleet_probe", replica="r1")
+        fault_point("fleet_probe")  # no context: no match either
+    assert con.fires("r1-only") == 1
+
+
+def test_conductor_phase_windows_follow_the_clock():
+    now = [0.0]
+    sched = ChaosSchedule(rules=(
+        ChaosRule(point="fleet_route", action="raise",
+                  start_s=1.0, end_s=2.0, label="windowed"),
+    ))
+    con = ChaosConductor(sched, clock=lambda: now[0]).start()
+    try:
+        fault_point("fleet_route")  # t=0: before the window
+        now[0] = 1.5
+        with pytest.raises(FaultInjected):
+            fault_point("fleet_route")  # inside
+        now[0] = 2.5
+        fault_point("fleet_route")  # past end_s
+    finally:
+        con.stop()
+    assert con.fires("windowed") == 1
+
+
+def test_conductor_max_fires_caps_a_kill_style_rule():
+    sched = ChaosSchedule(rules=(
+        ChaosRule(point="fleet_route", action="raise", max_fires=1,
+                  label="once"),
+    ))
+    with ChaosConductor(sched) as con:
+        with pytest.raises(FaultInjected):
+            fault_point("fleet_route")
+        for _ in range(5):
+            fault_point("fleet_route")  # capped: never fires again
+    assert con.fires("once") == 1
+
+
+def test_conductor_replay_reproduces_firing_log_bit_for_bit():
+    sched = ChaosSchedule(rules=(
+        ChaosRule(point="fleet_probe", action="raise", prob=0.4,
+                  label="flaky-probe"),
+        ChaosRule(point="fleet_route", action="sleep=0", prob=0.7,
+                  every=2, label="slow-route"),
+    ), seed=99)
+    con = ChaosConductor(sched).start()
+    try:
+        for i in range(60):
+            try:
+                fault_point("fleet_probe", replica=f"r{i % 3}")
+            except FaultInjected:
+                pass
+            fault_point("fleet_route")
+    finally:
+        con.stop()
+    live = con.firing_sequence()
+    assert len(live) > 0
+    # decisions are a pure function of (seed, hit log): replaying the hit
+    # log through a fresh conductor reproduces the live log exactly, twice
+    assert con.replay(con.hit_log()) == live
+    assert con.replay(con.hit_log()) == live
+
+
+def test_conductor_hang_rule_released_by_stop():
+    sched = ChaosSchedule(rules=(
+        ChaosRule(point="fleet_probe", action="hang=30", label="wedge"),
+    ))
+    con = ChaosConductor(sched).start()
+    passed = threading.Event()
+
+    def hit():
+        fault_point("fleet_probe")
+        passed.set()
+
+    t = threading.Thread(target=hit, daemon=True)
+    t.start()
+    time.sleep(0.1)
+    assert not passed.is_set()
+    con.stop()  # releases every parked hit
+    t.join(2.0)
+    assert passed.is_set()
+
+
+def test_conductor_stop_fully_uninstalls_the_hook():
+    """Regression: the hook is a bound method and every ``self._hook``
+    access builds a fresh object — stop() must pass the exact object
+    start() installed or the identity-checked uninstall is a no-op and
+    the conductor outlives its scope, firing into unrelated code."""
+    import accelerate_tpu.utils.fault as fault_mod
+
+    sched = ChaosSchedule(rules=(
+        ChaosRule(point="fleet_probe", action="raise", label="leak"),
+    ))
+    with ChaosConductor(sched):
+        assert fault_mod._CONDUCTOR is not None
+        with pytest.raises(FaultInjected):
+            fault_point("fleet_probe")
+    assert fault_mod._CONDUCTOR is None
+    fault_point("fleet_probe")  # nothing armed, nothing installed: silent
+
+
+def test_phase_windows_cumulative():
+    class Ph:
+        def __init__(self, name, duration_s):
+            self.name, self.duration_s = name, duration_s
+
+    wins = phase_windows([Ph("ramp", 2.0), Ph("crowd", 1.0), Ph("drain", 3.0)])
+    assert wins == [("ramp", 0.0, 2.0), ("crowd", 2.0, 3.0),
+                    ("drain", 3.0, 6.0)]
+
+
+def test_rule_validation_is_typed():
+    with pytest.raises(ValueError, match="prob"):
+        ChaosRule(point="fleet_probe", prob=1.5)
+    with pytest.raises(ValueError, match="unknown chaos action"):
+        ChaosRule(point="fleet_probe", action="explode")
+
+
+# ----------------------------------------------------- invariant monitors
+def test_monitor_flags_dropped_future():
+    mon = InvariantMonitors()
+    mon.track("req-0", Future())  # never resolved
+    violations = mon.check(quiesce_timeout_s=0.05)
+    assert [v.kind for v in violations] == ["dropped_future"]
+    with pytest.raises(InvariantViolation, match="dropped_future"):
+        mon.assert_clean(quiesce_timeout_s=0.05)
+
+
+def test_monitor_flags_untyped_error_but_accepts_taxonomy():
+    mon = InvariantMonitors()
+    bad, ok, cancelled = Future(), Future(), Future()
+    bad.set_exception(RuntimeError("guts leaked"))
+    ok.set_exception(ServerOverloaded("backpressure"))
+    cancelled.cancel()
+    mon.track("bad", bad)
+    mon.track("ok", ok)
+    mon.track("cancelled", cancelled)
+    violations = mon.check(quiesce_timeout_s=0.05)
+    assert [v.kind for v in violations] == ["untyped_error"]
+    assert "bad" in violations[0].detail
+
+
+def test_monitor_flags_counter_regression():
+    mon = InvariantMonitors()
+    values = {"completed": 5}
+    mon.watch_registry("fake", lambda: dict(values))
+    assert mon.sample() == []
+    values["completed"] = 3  # monotonic counter going backwards
+    regressions = mon.sample()
+    assert [v.kind for v in regressions] == ["counter_regression"]
+    assert "fake:completed" in regressions[0].detail
+
+
+def test_monitor_flags_incomplete_trace():
+    from accelerate_tpu.utils.dataclasses import TracingConfig
+
+    tracer = tracing.Tracer(TracingConfig(enabled=True))
+    delivered = Future()
+    delivered.set_result(object())
+    mon = InvariantMonitors(tracer=tracer)
+    mon.track("req-0", delivered, trace_id="trace-with-no-spans")
+    violations = mon.check(quiesce_timeout_s=0.05)
+    assert [v.kind for v in violations] == ["incomplete_trace"]
+
+
+def _echo_gen(params, prompt, max_new_tokens, **kw):
+    return np.concatenate([prompt, prompt[:max_new_tokens]])
+
+
+def _small_fleet(n=2):
+    from accelerate_tpu.fleet import FleetRouter
+    from accelerate_tpu.serving import InferenceServer
+    from accelerate_tpu.utils.dataclasses import FleetConfig, ServingConfig
+
+    cfg = ServingConfig(max_queue=128, max_batch_size=4,
+                        batch_window_s=0.001, max_retries=0)
+    servers = {
+        f"r{i}": InferenceServer(object(), cfg, generate_fn=_echo_gen,
+                                 replica_id=f"r{i}")
+        for i in range(n)
+    }
+    return FleetRouter(servers, FleetConfig(probe_interval_s=0.05))
+
+
+def test_monitor_clean_on_healthy_fleet_run():
+    router = _small_fleet(2)
+    mon = InvariantMonitors()
+    mon.watch_registry("fleet", router.metrics.registry)
+    try:
+        futs = [
+            mon.track(f"req-{i}", router.submit(PROMPT, max_new_tokens=2))
+            for i in range(8)
+        ]
+        for f in futs:
+            f.result(10)
+        mon.sample()
+    finally:
+        router.close()
+    assert mon.check(quiesce_timeout_s=2.0) == []

@@ -575,3 +575,233 @@ def test_stats_shape_and_metrics_namespace():
         assert st["membership"]["version"] >= 2
     finally:
         router.close()
+
+
+# ---------------------------------------------------- gray-failure quarantine
+def hang_health(server, release):
+    """Swap ``server.health`` for one that parks until ``release`` is set
+    — the wedged-RPC gray failure: the worker is alive and serving, but
+    the health endpoint never answers."""
+    real_health = server.health
+
+    def hung():
+        release.wait(30.0)
+        return real_health()
+
+    server.health = hung
+
+
+def test_hung_probe_is_brownout_not_fleet_stall():
+    """Satellite regression: a replica whose health() hangs forever must
+    become a brown-out finding on THAT replica — not a stalled probe
+    loop, not a stale fleet clock, not a wedged submit path."""
+    from accelerate_tpu import perfwatch
+
+    release = threading.Event()
+    router = make_fleet(2, fleet_kw={
+        "probe_interval_s": 0.03, "probe_timeout_s": 0.15,
+        "brownout_drain_after_s": 0.0,
+        # isolate the HUNG-probe signal: on a loaded host the healthy
+        # peer's own probe latency must never cross into brown-out
+        "brownout_probe_ewma_s": 5.0,
+    })
+    perfwatch.get_watch().consume_drift_findings()  # drain leftovers
+    try:
+        # let a healthy pass cache r0's last_health before wedging it,
+        # and require a clean slate (a scheduling hiccup on a busy host
+        # can transiently over-run a healthy replica's probe)
+        assert wait_until(lambda: router.metrics["probes"] >= 4)
+        assert wait_until(lambda: not any(
+            s["brownout"] for s in router.stats()["replicas"].values()
+        ))
+        hang_health(router.servers()["r0"], release)
+        assert wait_until(lambda: router.metrics["probe_timeouts"] >= 1)
+        assert wait_until(
+            lambda: router.stats()["replicas"]["r0"]["brownout"]
+        )
+        st = router.stats()["replicas"]["r0"]
+        assert st["brownout_score"] >= 1.0
+        # the gauge trails the handle flag by a few statements of the
+        # same probe pass — poll it, don't demand instant coherence
+        assert wait_until(
+            lambda: router.metrics["replica/r0/brownout"] == 1.0
+        )
+        # the probe loop keeps stamping: one wedged replica is that
+        # replica's problem, never the whole fleet's freshness
+        before = router.metrics["last_probe_s"]
+        assert wait_until(lambda: router.metrics["last_probe_s"] > before)
+        # quarantine deprioritizes: idle r1 beats penalized idle r0
+        res = router.submit(PROMPT, max_new_tokens=2).result(10)
+        assert res.replica_id == "r1"
+        # the sustained brown-out filed ONE typed finding naming r0
+        assert wait_until(lambda: router.metrics["brownout_findings"] >= 1,
+                          timeout=15.0)
+        findings = perfwatch.get_watch().consume_drift_findings()
+        named = [
+            f for f in findings
+            if getattr(f, "replica_id", None) == "r0"
+        ]
+        assert len(named) == 1, findings
+        assert "browned out" in str(named[0])
+    finally:
+        release.set()
+        router.close(drain=False)
+
+
+def test_one_hung_replica_never_freezes_controller():
+    """The full gray-failure loop: hung health -> brown-out -> typed
+    finding -> the SLO controller (NOT frozen: the cached sample keeps
+    the replica covered) drains and replaces the replica automatically."""
+    from accelerate_tpu import perfwatch
+    from accelerate_tpu.controller import SLOController
+    from accelerate_tpu.utils.dataclasses import ControllerConfig
+
+    release = threading.Event()
+
+    def factory(replica_id):
+        return make_server(echo_gen(), replica_id=replica_id)
+
+    router = make_fleet(
+        2,
+        fleet_kw={"probe_interval_s": 0.03, "probe_timeout_s": 0.15,
+                  "brownout_drain_after_s": 0.0,
+                  "brownout_probe_ewma_s": 5.0},
+        replica_factory=factory,
+    )
+    perfwatch.get_watch().consume_drift_findings()  # drain leftovers
+    ctl = SLOController(router, ControllerConfig(min_coverage=1.0))
+    try:
+        assert wait_until(lambda: router.metrics["probes"] >= 4)
+        assert wait_until(lambda: not any(
+            s["brownout"] for s in router.stats()["replicas"].values()
+        ))
+        hang_health(router.servers()["r0"], release)
+        assert wait_until(lambda: router.metrics["brownout_findings"] >= 1,
+                          timeout=15.0)
+        ctl.tick()
+        # fail-static did NOT trip: r0's cached health kept it covered
+        assert not ctl.frozen
+        assert ctl.stale_findings() == []
+        # ... and an actuation landed: drain-and-replace of the named r0
+        assert ctl.metrics["drift_replacements"] == 1
+        assert wait_until(lambda: "r0" not in router.replica_ids())
+        assert any(r.startswith("ctl-") for r in router.replica_ids())
+        res = router.submit(PROMPT, max_new_tokens=2).result(10)
+        assert res.replica_id in router.replica_ids()
+    finally:
+        release.set()
+        ctl.close()
+        router.close(drain=False)
+
+
+def test_brownout_hedges_inflight_request_exactly_once():
+    """A request already in flight on a replica entering brown-out is
+    hedged to a healthy replica: first result wins, the slow original is
+    discarded, and exactly one retry-budget token is spent."""
+    release = threading.Event()
+    router = make_fleet(
+        2,
+        # r0's batch is LONG: brown-out detection plus the hedge must win
+        # the race against it even when a loaded host stalls the probe
+        # loop for a second or two
+        gen=[echo_gen(delay=3.0), echo_gen(delay=0.005)],
+        fleet_kw={"probe_interval_s": 0.03, "probe_timeout_s": 0.25,
+                  "brownout_drain_after_s": 60.0,
+                  "brownout_probe_ewma_s": 5.0,
+                  "retry_budget_capacity": 4,
+                  "retry_budget_refill_per_s": 0.0},
+    )
+    try:
+        assert wait_until(lambda: router.metrics["probes"] >= 4)
+        # a scheduling hiccup can transiently over-run a probe on a busy
+        # host; the tie-break below needs BOTH replicas clean
+        assert wait_until(lambda: not any(
+            s["brownout"] for s in router.stats()["replicas"].values()
+        ))
+        # both replicas idle -> placement ties -> slow r0 is primary; the
+        # request is trapped behind its 0.8s batch when r0 browns out
+        t0 = time.monotonic()
+        fut = router.submit(PROMPT, max_new_tokens=2)
+        hang_health(router.servers()["r0"], release)
+        res = fut.result(10)
+        elapsed = time.monotonic() - t0
+        assert res.replica_id == "r1"
+        assert elapsed < 2.5  # nobody waited out r0's batch
+        assert router.metrics["brownouts"] >= 1
+        assert router.metrics["hedges"] == 1
+        # the losing original resolves late and is discarded, not dropped
+        assert wait_until(lambda: router.metrics["hedge_wins"] >= 1,
+                          timeout=10.0)
+        # exactly one token charged (refill disabled to make it exact)
+        assert router._budget.available() == pytest.approx(3.0)
+    finally:
+        release.set()
+        router.close(drain=False)
+
+
+def test_brownout_residual_is_peer_relative():
+    """Gray failure is a DIFFERENTIAL signal: a perf residual the whole
+    fleet reports (miscommitted baseline, shared in-process perfwatch)
+    must not quarantine anyone — that is the drift sentinel's job — while
+    one replica deviating from its peers still engages."""
+    router = make_fleet(3)
+    try:
+        handles = router._handles
+        # bootstrap: r0 probed first, peers have not reported yet — no
+        # differential signal exists, so no quarantine either
+        handles["r0"].perf_ratio = 3.2e6
+        assert router._brownout_score(handles["r0"]) < 1.0
+        for h in handles.values():
+            h.perf_ratio = 3.2e6  # fleet-wide: e.g. CPU run vs TPU baseline
+        assert router._brownout_score(handles["r0"]) < 1.0
+        handles["r0"].perf_ratio = 3.2e6 * 10  # r0 alone is 10x its peers
+        assert router._brownout_score(handles["r0"]) >= 1.0
+        # single-replica fleets have no peers: the ratio stays absolute
+        solo = make_fleet(1)
+        try:
+            solo._handles["r0"].perf_ratio = 8.0
+            assert solo._brownout_score(solo._handles["r0"]) >= 1.0
+        finally:
+            solo.close(drain=False)
+    finally:
+        router.close(drain=False)
+
+
+def test_respawn_factory_failures_are_visible_then_reset():
+    """Satellite: a crash-looping replica factory is visible in one
+    scrape — monotonic ``respawn_failures`` counter + per-replica
+    ``respawn_failing`` gauge — and both reset when the factory heals."""
+    kill = threading.Event()
+    fail = threading.Event()
+    fail.set()
+
+    def factory(replica_id):
+        if fail.is_set():
+            raise RuntimeError("allocator out of capacity")
+        return make_server(echo_gen(), replica_id=replica_id)
+
+    router = make_fleet(
+        1,
+        gen=killable_gen(kill),
+        fleet_kw={"auto_respawn": True, "respawn_backoff_s": 0.01,
+                  "probe_interval_s": 0.03},
+        replica_factory=factory,
+    )
+    try:
+        kill.set()
+        with pytest.raises(ServingError):
+            router.submit(PROMPT, max_new_tokens=2).result(10)
+        assert wait_until(lambda: router.metrics["respawn_failures"] >= 2)
+        assert router.metrics["replica/r0/respawn_failing"] == 1.0
+        assert router.stats()["replicas"]["r0"]["respawn_failures"] >= 2
+        fail.clear()  # the factory heals; the next probe pass relaunches
+        assert wait_until(lambda: router.metrics["respawns"] >= 1)
+        assert router.metrics["replica/r0/respawn_failing"] == 0.0
+        assert router.stats()["replicas"]["r0"]["respawn_failures"] == 0
+        assert wait_until(
+            lambda: router.stats()["replicas"]["r0"]["health"].get("worker_alive"),
+        )
+        res = router.submit(PROMPT, max_new_tokens=2).result(10)
+        assert res.replica_id == "r0"
+    finally:
+        router.close(drain=False)
