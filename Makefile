@@ -10,7 +10,7 @@
 PY := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu python
 PY_SLOW := env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu RUN_SLOW=1 python
 
-.PHONY: test test_all test_core test_data test_parallel test_models test_cli test_big_modeling test-fault test-serving check-static check-kernels check-sharding check-concurrency check-numerics check-perf check-all install-hooks bench bench-telemetry bench-serving bench-continuous bench-recovery bench-kv bench-spec bench-fleet bench-trace bench-obs bench-autoscale bench-chaos
+.PHONY: test test_all test_core test_data test_parallel test_models test_cli test_big_modeling test-fault test-serving check-static check-kernels check-sharding check-concurrency check-numerics check-perf check-all install-hooks bench bench-telemetry bench-serving bench-continuous bench-recovery bench-kv bench-spec bench-fleet bench-trace bench-obs bench-autoscale bench-chaos bench-longctx
 
 test: check-static check-kernels
 	$(PY) -m pytest tests/ -q
@@ -171,6 +171,14 @@ bench-spec:
 # worse with prefill/decode disaggregation than without (docs/serving.md)
 bench-fleet:
 	$(PY) benchmarks/serving_bench.py --fleet-gate
+
+# long-context gate: a prompt >= 4x the single-shot prompt bucket admitted
+# via chunked prefill with bitwise greedy parity vs single-shot (dense +
+# paged), co-resident decode p99 <= 1.1x a short-only run, and the host-RAM
+# KV spill tier beating chunked prefix recompute at a measured, reported
+# crossover length (docs/serving.md "Long-context serving")
+bench-longctx:
+	$(PY) benchmarks/longctx_bench.py --gate
 
 # tracing gate: span-spine overhead (tracing-on serving goodput >= 0.98x
 # off) + flight-recorder chaos forensics — kill a replica mid-batch and the

@@ -60,6 +60,8 @@ _GROUP_SOURCE = {
     "engine.paged_pallas": os.path.join("accelerate_tpu", "engine.py"),
     # lowered only by Level 5 (analysis/numerics.py): the int8 KV variant
     "engine.paged_int8": os.path.join("accelerate_tpu", "engine.py"),
+    # chunked prefill + host-tier restore (docs/serving.md long-context)
+    "engine.longctx": os.path.join("accelerate_tpu", "engine.py"),
 }
 
 _CALLBACK_CUSTOM_CALL_RE = re.compile(
@@ -80,6 +82,11 @@ class ProgramRecord:
     # for inputs the program never reads — e.g. the accum tree when grad
     # accumulation is off). Allowed, not required, to alias.
     donated_optional: Set[int] = dataclasses.field(default_factory=set)
+    # family member tag ("chunk"/"restore" for the chunked-prefill members
+    # of prefill_insert): G004 counts families by `name`; the perf/HBM
+    # levels key budgets by "<group>/<name>.<variant>" so each member gets
+    # its own committed row
+    variant: str = ""
 
     @property
     def source(self) -> str:
@@ -110,11 +117,11 @@ def _engine_records(group: str, engine, model) -> List[ProgramRecord]:
     n_donated = leaf_count(donated)
     expected = set(range(n_donated))
 
-    def rec(name, jitted, args) -> ProgramRecord:
+    def rec(name, jitted, args, variant="") -> ProgramRecord:
         traced = jitted.trace(*args)
         return ProgramRecord(
             group=group, name=name, lowered=traced.lower(),
-            donated=expected, jaxpr=traced.jaxpr,
+            donated=expected, jaxpr=traced.jaxpr, variant=variant,
         )
 
     # prefill_insert: borrow a backend row for the trace shapes, then put
@@ -136,6 +143,37 @@ def _engine_records(group: str, engine, model) -> List[ProgramRecord]:
         dlen = jnp.zeros((engine.slots,), jnp.int32)
         out.append(rec("verify_step", engine._verify_jit,
                        (donated, carried, params, tables, draft, dlen)))
+    if engine.prefill_chunk is not None:
+        # the chunked-prefill members of the prefill_insert FAMILY: one
+        # fixed-(S, chunk) append-at-offset program + (paged) the host-tier
+        # restore scatter. They record under the family name so the
+        # ≤3-programs-per-config ceiling counts families, not members —
+        # G001/G002/G003 still run per member.
+        chunk_tokens = jnp.zeros((engine.slots, engine.prefill_chunk), jnp.int32)
+        out.append(rec("prefill_insert", engine._chunk_jit, (
+            donated, carried, params, chunk_tokens, jnp.int32(0),
+            jnp.int32(engine.prefill_chunk), jnp.int32(0), kd,
+            jnp.float32(0.0), jnp.int32(0), jnp.float32(1.0), jnp.int32(-1),
+            jnp.int32(0), jnp.int32(2), jnp.int32(engine.prefill_chunk + 1),
+            tables,
+        ), variant="chunk"))
+        if engine._backend.kind.startswith("paged"):
+            rows = engine._backend.blocks_per_row
+
+            def payload_like(ref):
+                if isinstance(ref, dict):
+                    return {w: payload_like(v) for w, v in ref.items()}
+                return jnp.zeros(
+                    (rows, ref.shape[0]) + tuple(ref.shape[2:]), ref.dtype
+                )
+
+            payload = {
+                "k": payload_like(donated["cache"]["k"]),
+                "v": payload_like(donated["cache"]["v"]),
+            }
+            out.append(rec("prefill_insert", engine._restore_jit, (
+                donated, payload, jnp.zeros((rows,), jnp.int32),
+            ), variant="restore"))
     return out
 
 
@@ -151,6 +189,10 @@ def build_engine_programs(groups: Optional[Sequence[str]] = None) -> List[Progra
         # programs (prefill + decode + verify) under the same G004 ceiling
         ("engine.paged_pallas", {"kv_cache": "paged", "block_size": 4,
                                  "attention_impl": "pallas", "spec": "ngram"}),
+        # chunked prefill over a paged pool: traces the chunk + restore
+        # members of the prefill_insert family alongside decode_step
+        ("engine.longctx", {"kv_cache": "paged", "block_size": 4,
+                            "prefill_chunk": 4}),
     ]
     model = None
     records: List[ProgramRecord] = []
@@ -316,7 +358,10 @@ def observe(records: Sequence[ProgramRecord],
     for rec in records:
         programs.setdefault(rec.group, []).append(rec.name)
     observed: Dict[str, Any] = {
-        "programs": {g: sorted(names) for g, names in sorted(programs.items())},
+        # dedup to program FAMILIES: the chunked-prefill members (chunk
+        # forward, host-tier restore) record under "prefill_insert", so a
+        # config's count stays prefill + decode + verify ≤ 3
+        "programs": {g: sorted(set(names)) for g, names in sorted(programs.items())},
     }
     if with_collectives:
         coll: Dict[str, Dict[str, int]] = {}

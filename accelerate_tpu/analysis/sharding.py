@@ -252,8 +252,9 @@ def build_engine_sharded(groups: Optional[Sequence[str]] = None) -> List[Sharded
                 (tuple(av.shape), str(av.dtype))
                 for av in rec.jaxpr.out_avals
             ]
+        suffix = f".{rec.variant}" if getattr(rec, "variant", "") else ""
         out.append(ShardedProgram(
-            name=f"{rec.group}/{rec.name}",
+            name=f"{rec.group}/{rec.name}{suffix}",
             source=rec.source,
             lowered=rec.lowered,
             state_leaves=kv_leaves,

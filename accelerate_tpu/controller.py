@@ -30,6 +30,11 @@ closes the loop (ROADMAP item 6; docs/control_plane.md):
              (``ServingConfig.spec_draft_len`` + an immediate
              ``engine.set_spec_draft_limit`` — operand clamp, no
              recompile)
+   longctx   halve the chunked-prefill schedule
+             (``engine.set_prefill_chunk_limit`` — max prompt chunks
+             dispatched per tick; 1 -> 0 pauses long-prompt prefill
+             entirely, shedding admission work before anyone's output
+             budget is touched; operand clamp, no recompile)
    degrade   tighten the degradation ladder (halve
              ``degrade_queue_fraction`` / ``degrade_hard_fraction`` /
              ``degraded_max_new_tokens``) so budget clamping starts
@@ -93,8 +98,11 @@ __all__ = ["SLOController", "ControlSignals"]
 _FINDINGS_CAP = 32
 
 # escalation order of the in-place rungs; "scale" rides after them and is
-# the only repeatable rung (one replica per actuation)
-_RUNG_ORDER = ("spec", "degrade", "admission", "hedge")
+# the only repeatable rung (one replica per actuation). "longctx" (pause
+# chunked-prefill scheduling — an operand clamp like "spec") sits BEFORE
+# "degrade": long-prompt admission work is shed before anyone's output
+# budget is touched.
+_RUNG_ORDER = ("spec", "longctx", "degrade", "admission", "hedge")
 
 
 class ControlSignals:
@@ -494,6 +502,13 @@ class SLOController:
                 and s.config.spec_draft_len > 1
                 for s in servers.values()
             )
+        if rung == "longctx":
+            return any(
+                getattr(getattr(s, "engine", None), "prefill_chunk", None)
+                is not None
+                and getattr(s.engine, "prefill_chunk_limit", 0) > 0
+                for s in servers.values()
+            )
         if rung == "degrade":
             return bool(servers)
         if rung == "admission":
@@ -567,6 +582,20 @@ class SLOController:
                 saved[rid] = orig
                 srv.config.spec_draft_len = max(1, orig // 2)
                 eng.set_spec_draft_limit(srv.config.spec_draft_len)
+        elif rung == "longctx":
+            for rid, srv in servers.items():
+                eng = getattr(srv, "engine", None)
+                if eng is None or getattr(eng, "prefill_chunk", None) is None:
+                    continue
+                orig = eng.prefill_chunk_limit
+                if orig <= 0:
+                    continue
+                saved[rid] = orig
+                # halving 1 -> 0 PAUSES chunked prefill: admitted long
+                # prompts hold their slots but stop burning ticks, so
+                # decode latency recovers first (host-side operand clamp,
+                # no recompile)
+                eng.set_prefill_chunk_limit(orig // 2)
         elif rung == "degrade":
             for rid, srv in servers.items():
                 c = srv.config
@@ -610,6 +639,10 @@ class SLOController:
                 eng = getattr(srv, "engine", None)
                 if eng is not None:
                     eng.set_spec_draft_limit(orig)
+            elif rung == "longctx":
+                eng = getattr(srv, "engine", None)
+                if eng is not None:
+                    eng.set_prefill_chunk_limit(orig)
             elif rung == "degrade":
                 (
                     srv.config.degrade_queue_fraction,

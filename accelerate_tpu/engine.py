@@ -96,6 +96,14 @@ class SlotOccupant:
     tokens: List[int] = field(default_factory=list)  # emitted new tokens
     finished: bool = False
     first_token_s: Optional[float] = None  # host clock at first popped token
+    # chunked-prefill state (long prompts only): PREFILLING slots ride every
+    # decode step masked (done=True on device) until their last chunk
+    # commits; ``prefill_pos`` is the next chunk's start offset and
+    # ``chunk_args`` the stashed request params the deferred last chunk
+    # needs (key data, sampling operands)
+    prefilling: bool = False
+    prefill_pos: int = 0
+    chunk_args: Optional[dict] = None
     # speculative-decoding state: per-slot acceptance EWMA (starts above
     # the gate floor so fresh occupants draft immediately, but low enough
     # that a few rejected drafts gate an incompressible slot off fast), a
@@ -252,6 +260,8 @@ class ContinuousBatchingEngine:
         block_size: int = 16,
         pool_blocks: Optional[int] = None,
         attention_impl: str = "reference",
+        prefill_chunk: Optional[int] = None,
+        host_tier_bytes: int = 0,
         spec: Optional[str] = None,
         spec_draft_len: int = 4,
         spec_ngram: int = 3,
@@ -296,6 +306,17 @@ class ContinuousBatchingEngine:
                 f"prompt_bucket must be in [1, max_len-1], got "
                 f"{self.prompt_bucket} (max_len={max_len})"
             )
+        # chunked prefill (docs/serving.md "Long-context serving"): when
+        # enabled, prompts LONGER than the bucket are admitted and fed one
+        # `prefill_chunk`-wide chunk per scheduler tick through the
+        # prefill_insert program family, interleaved with other slots'
+        # decode steps. None keeps the legacy hard rejection.
+        if prefill_chunk is not None and not 1 <= prefill_chunk <= max_len - 1:
+            raise ValueError(
+                f"prefill_chunk must be None or in [1, max_len-1], got "
+                f"{prefill_chunk} (max_len={max_len})"
+            )
+        self.prefill_chunk = prefill_chunk
         self.readback_lag = readback_lag
         self._clock = clock
         if attention_impl not in ("reference", "pallas"):
@@ -322,7 +343,13 @@ class ContinuousBatchingEngine:
             kv_cache, config=self.config, slots=slots, max_len=max_len,
             prompt_bucket=self.prompt_bucket, block_size=block_size,
             pool_blocks=pool_blocks, attention_impl=attention_impl,
+            host_tier_bytes=host_tier_bytes,
         )
+        if hasattr(self._backend, "bind_cache_reader"):
+            # spill gathers read the engine's CURRENT donated cache: after
+            # any dispatch self._donated is rebound to the program's output
+            # arrays, so this closure always sees the live pool
+            self._backend.bind_cache_reader(lambda: self._donated["cache"])
         if isinstance(self.config, GPT2Config):
             self._prefill_at_fn, self._decode_fn = gpt2_prefill_at, gpt2_decode_step
             self._verify_fn = gpt2_verify_step
@@ -367,6 +394,22 @@ class ContinuousBatchingEngine:
         self._prefill_commit_jit = jax.jit(
             self._prefill_commit_impl, donate_argnums=(0,)
         )
+        # chunked-prefill members of the prefill_insert program family:
+        # `_chunk_jit` runs one prompt chunk as a verify-style window
+        # forward at the slot's offset (teacher forcing — commit every
+        # window column, emit nothing until the last chunk samples t0);
+        # `_restore_jit` scatters host-tier block payloads into the pool
+        # ahead of the first chunk. Neither compiles unless long prompts
+        # are actually served.
+        self._chunk_jit = jax.jit(self._chunk_impl, donate_argnums=(0,))
+        self._restore_jit = jax.jit(self._restore_impl, donate_argnums=(0,))
+        # round-robin queue of PREFILLING occupants; the per-tick dispatch
+        # clamp is a host-side operand knob (no recompile), the degradation
+        # ladder's long-context rung
+        self._prefill_queue: collections.deque = collections.deque()
+        self._prefill_chunk_limit = 1
+        self.prefill_chunks = 0  # lifetime chunk programs dispatched
+        self.kv_restores = 0  # lifetime restore programs dispatched
 
         self._occupants: List[Optional[SlotOccupant]] = [None] * slots
         self._free: List[int] = list(range(slots))
@@ -670,6 +713,104 @@ class ContinuousBatchingEngine:
         }
         return new_donated, new_carried, t0, done0
 
+    def _chunk_impl(
+        self, donated, carried, params, tokens, offset, chunk_len, slot,
+        key_data, temp, top_k, top_p, eos, pad, budget, length, tables,
+    ):
+        """One prompt chunk of a chunked prefill: a verify-style window
+        forward (``*_verify_step`` — the cache-read-only multi-token body
+        speculative decoding already compiles) at the slot's append offset,
+        teacher-forced on the prompt's own tokens, committing every window
+        column's KV via ``commit_window``. ``tokens`` is (S, C) with only
+        ``slot``'s row real (other rows' outputs are discarded: commit
+        count is a one-hot, and the window forward never writes the cache).
+
+        The LAST chunk (``offset + chunk_len >= length``, a traced
+        predicate — one compiled program regardless) reproduces
+        ``_prefill_impl``'s epilogue bitwise: the same single
+        ``split(key, 2)``, the same ``_sample_rows`` on the final prompt
+        position's logits, the same done/budget install. Non-last chunks
+        leave the slot masked (done=True, pad token, zero budget) so the
+        interleaved decode steps treat it as a ghost — its unconditional
+        masked write lands at the NEXT chunk's first position, which that
+        chunk rewrites before anything attends it (write-before-attend)."""
+        cache = donated["cache"]
+        pos = donated["pos"].at[slot].set(offset)
+        layout = self._backend.make_layout(tables)
+        if layout is None:
+            logits, win_kv = self._verify_fn(
+                self.config, params, cache, tokens, pos
+            )
+        else:
+            logits, win_kv = self._verify_fn(
+                self.config, params, cache, tokens, pos, kv_layout=layout
+            )
+        count = jnp.zeros((self.slots,), jnp.int32).at[slot].set(chunk_len)
+        cache = self._backend.commit_window(cache, win_kv, tables, pos, count)
+        is_last = offset + chunk_len >= length
+        # t0 from the logits after the final REAL prompt token — only
+        # meaningful (and only consumed) on the last chunk
+        last_idx = jnp.clip(length - 1 - offset, 0, tokens.shape[1] - 1)
+        row_logits = lax.dynamic_slice_in_dim(logits, slot, 1, axis=0)[0]
+        l_last = lax.dynamic_slice_in_dim(row_logits, last_idx, 1, axis=0)
+        keys = jax.random.split(jax.random.wrap_key_data(key_data), 2)
+        t0 = _sample_rows(l_last, keys[1:2], temp[None], top_k[None], top_p[None])[0]
+        hit_eos = (eos >= 0) & (t0 == eos)
+        budget_left = budget - 1
+        done0 = hit_eos | (budget_left <= 0)
+        new_donated = {
+            "cache": cache,
+            "pos": donated["pos"].at[slot].set(offset + chunk_len),
+            # the key stream is untouched until the last chunk consumes
+            # exactly one split — bitwise the single-shot discipline
+            "key": jnp.where(
+                is_last,
+                donated["key"].at[slot].set(jax.random.key_data(keys[0])),
+                donated["key"],
+            ),
+        }
+        sel = lambda last_v, mid_v: jnp.where(is_last, last_v, mid_v)
+        new_carried = {
+            # mid-prefill the slot must ride decode steps as a ghost even if
+            # a cancelled predecessor left done=False: force the mask here
+            "token": carried["token"].at[slot].set(sel(t0, pad)),
+            "done": carried["done"].at[slot].set(sel(done0, True)),
+            "budget": carried["budget"].at[slot].set(sel(budget_left, 0)),
+            "temp": carried["temp"].at[slot].set(temp),
+            "top_k": carried["top_k"].at[slot].set(top_k),
+            "top_p": carried["top_p"].at[slot].set(top_p),
+            "eos": carried["eos"].at[slot].set(eos),
+            "pad": carried["pad"].at[slot].set(pad),
+        }
+        return new_donated, new_carried, t0, done0
+
+    def _restore_impl(self, donated, payload, ids):
+        """Scatter host-tier block payloads into the pool (the restore half
+        of the spill/restore plan): ``payload`` mirrors the pool's leaf
+        structure with a leading restore-batch axis — f32 ``{"k","v"}`` of
+        (R, L, bs, kvh, hd), int8 adds per-position scales — and ``ids``
+        (R,) names the target blocks, padded with the null block (write to
+        the garbage sink, never a live block). R is fixed at blocks_per_row
+        so every restore shares one compiled program."""
+        cache = donated["cache"]
+        out = {}
+        for w in ("k", "v"):
+            leaf = cache[w]
+            if isinstance(leaf, dict):
+                out[w] = {
+                    "q": leaf["q"].at[:, ids].set(
+                        jnp.moveaxis(payload[w]["q"], 0, 1)
+                    ),
+                    "s": leaf["s"].at[:, ids].set(
+                        jnp.moveaxis(payload[w]["s"], 0, 1)
+                    ),
+                }
+            else:
+                out[w] = leaf.at[:, ids].set(
+                    jnp.moveaxis(payload[w], 0, 1).astype(leaf.dtype)
+                )
+        return {**donated, "cache": out}
+
     def _record(self, name: str, sig: tuple) -> None:
         self._programs.setdefault(name, set()).add(sig)
 
@@ -688,11 +829,14 @@ class ContinuousBatchingEngine:
     def validate_request(self, prompt_len: int, max_new_tokens: int) -> None:
         """Raise ValueError when a request cannot fit this engine's arena
         (checked at admission so the typed error reaches the submitter)."""
-        if prompt_len < 1 or prompt_len > self.prompt_bucket:
+        if prompt_len < 1:
+            raise ValueError(f"prompt length must be >= 1, got {prompt_len}")
+        if prompt_len > self.prompt_bucket and self.prefill_chunk is None:
             raise ValueError(
                 f"prompt length {prompt_len} exceeds the engine prompt "
                 f"bucket ({self.prompt_bucket}); raise "
-                "ServingConfig.engine_prompt_bucket or shorten the prompt"
+                "ServingConfig.engine_prompt_bucket, enable chunked prefill "
+                "(engine_prefill_chunk), or shorten the prompt"
             )
         if max_new_tokens < 1:
             raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
@@ -732,9 +876,18 @@ class ContinuousBatchingEngine:
         tag: Any = None,
     ) -> SlotOccupant:
         """Admit one request into a free slot: bucketed prefill, KV scatter,
-        first token sampled inside the same program."""
+        first token sampled inside the same program. Prompts longer than
+        the bucket (chunked prefill enabled) take the chunked path: the
+        first chunk dispatches here, the rest interleave one per
+        :meth:`step` tick."""
         prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
         self.validate_request(len(prompt), max_new_tokens)
+        if len(prompt) > self.prompt_bucket:
+            return self._insert_chunked(
+                prompt, max_new_tokens=max_new_tokens, temperature=temperature,
+                top_k=top_k, top_p=top_p, eos_token_id=eos_token_id,
+                pad_token_id=pad_token_id, seed=seed, tag=tag,
+            )
         if not self._free:
             raise EngineCapacityError(
                 "no free arena slot (caller must gate on free_slots())"
@@ -785,6 +938,204 @@ class ContinuousBatchingEngine:
         self._ring.append((self._tick, "prefill", (occ, t0, d0)))
         return occ
 
+    # ------------------------------------------------------- chunked prefill
+    def _insert_chunked(
+        self, prompt, *, max_new_tokens, temperature, top_k, top_p,
+        eos_token_id, pad_token_id, seed, tag,
+    ) -> SlotOccupant:
+        """Admit a long prompt (> prompt_bucket): allocate its blocks with
+        DEFERRED prefix registration (content does not exist yet), restore
+        any host-tier spilled prefix with one scatter program, then
+        dispatch the first chunk. Remaining chunks interleave one per
+        :meth:`step` tick — the slot rides every decode step masked until
+        the last chunk installs its first token."""
+        if not self._free:
+            raise EngineCapacityError(
+                "no free arena slot (caller must gate on free_slots())"
+            )
+        slot = self._free.pop()
+        try:
+            table_row, shared = self._backend.acquire(
+                slot, prompt, max_new_tokens, defer_register=True
+            )
+        except BaseException:
+            self._free.append(slot)
+            raise
+        pad_id = (
+            pad_token_id if pad_token_id is not None
+            else (eos_token_id if eos_token_id is not None else 0)
+        )
+        trace_id = getattr(tag, "trace_id", None)
+        occ = SlotOccupant(
+            slot=slot, tag=tag, prompt=prompt, budget=max_new_tokens,
+            pad_id=pad_id, eos_id=eos_token_id, inserted_s=self._clock(),
+            trace_id=trace_id, prefilling=True,
+        )
+        # host-tier restore: consecutive spilled blocks past the device
+        # registry's shared depth scatter back in ONE program — a host hit
+        # beats recomputing those chunks (the bench-longctx crossover)
+        restored_tokens = 0
+        if hasattr(self._backend, "restore_plan"):
+            plan = self._backend.restore_plan(slot, prompt, shared, table_row)
+            if plan is not None:
+                n, payloads, ids = plan
+                self._dispatch_restore(occ, payloads, ids)
+                # restored content is the original bytes — valid now, so its
+                # registrations promote immediately and serve prefix hits
+                self._backend.promote_deferred(slot, n)
+                restored_tokens = n * self._backend.block_size
+        shared_tokens = (
+            shared * getattr(self._backend, "block_size", 0) + restored_tokens
+        )
+        # chunks before the first offset covering unwritten content are
+        # skipped entirely; the min(.., P-1) keeps the LAST position inside
+        # the final chunk so t0's logits are always computed
+        chunk = self.prefill_chunk
+        occ.prefill_pos = (min(shared_tokens, len(prompt) - 1) // chunk) * chunk
+        occ.chunk_args = dict(
+            length=len(prompt),
+            kd=jax.random.key_data(jax.random.key(seed)),
+            temp=jnp.float32(temperature),
+            top_k=jnp.int32(top_k if top_k is not None else 0),
+            top_p=jnp.float32(top_p if top_p is not None else 1.0),
+            eos=jnp.int32(eos_token_id if eos_token_id is not None else -1),
+            pad=jnp.int32(pad_id),
+            budget=jnp.int32(max_new_tokens),
+        )
+        self._occupants[slot] = occ
+        self._prefill_queue.append(occ)
+        self.inserted += 1
+        self.peak_live = max(self.peak_live, self.live_count())
+        # the first chunk dispatches inside the admission, installing the
+        # slot's pos/ghost mask before any interleaved decode step runs
+        self._dispatch_chunk(occ)
+        return occ
+
+    def _dispatch_chunk(self, occ: SlotOccupant) -> None:
+        args = occ.chunk_args
+        chunk = self.prefill_chunk
+        length = args["length"]
+        offset = occ.prefill_pos
+        chunk_len = min(chunk, length - offset)
+        is_last = offset + chunk_len >= length
+        tokens = np.zeros((self.slots, chunk), np.int32)
+        tokens[occ.slot, :chunk_len] = occ.prompt[offset: offset + chunk_len]
+        self._record("prefill_insert", ("chunk", chunk))
+        with tracing.span(
+            "engine.prefill_chunk", trace_id=occ.trace_id,
+            slot=occ.slot, offset=offset, chunk_len=chunk_len,
+        ):
+            self._donated, self._carried, t0, d0 = self._chunk_jit(
+                self._donated, self._carried, self.model.params,
+                jnp.asarray(tokens), jnp.int32(offset), jnp.int32(chunk_len),
+                jnp.int32(occ.slot), args["kd"], args["temp"], args["top_k"],
+                args["top_p"], args["eos"], args["pad"], args["budget"],
+                jnp.int32(length), self._backend.device_tables(),
+            )
+        self.prefill_chunks += 1
+        occ.prefill_pos = offset + chunk_len
+        self._tick += 1
+        if is_last:
+            occ.prefilling = False
+            occ.chunk_args = None
+            try:
+                self._prefill_queue.remove(occ)
+            except ValueError:
+                pass
+            # the prompt's content now exists (the final commit is ordered
+            # before any sharer's program): promote the parked prefix
+            # registrations so the NEXT request with this prefix COW-shares
+            if hasattr(self._backend, "promote_deferred"):
+                self._backend.promote_deferred(occ.slot)
+            self._ring.append((self._tick, "prefill", (occ, t0, d0)))
+        else:
+            self._ring.append((self._tick, "chunk", (occ,)))
+
+    def _dispatch_restore(self, occ: SlotOccupant, payloads, ids) -> None:
+        n = len(payloads)
+        rows = self._backend.blocks_per_row
+        ids_full = np.zeros((rows,), np.int32)  # pad -> null block (sink)
+        ids_full[:n] = ids
+
+        def assemble(w):
+            first = payloads[0][w]
+            if isinstance(first, dict):
+                pad_q = jnp.zeros_like(first["q"])
+                pad_s = jnp.zeros_like(first["s"])
+                return {
+                    "q": jnp.stack(
+                        [p[w]["q"] for p in payloads] + [pad_q] * (rows - n)
+                    ),
+                    "s": jnp.stack(
+                        [p[w]["s"] for p in payloads] + [pad_s] * (rows - n)
+                    ),
+                }
+            pad = jnp.zeros_like(first)
+            return jnp.stack([p[w] for p in payloads] + [pad] * (rows - n))
+
+        payload = {"k": assemble("k"), "v": assemble("v")}
+        self._record("prefill_insert", ("restore", rows))
+        with tracing.span(
+            "engine.kv_restore", trace_id=occ.trace_id,
+            slot=occ.slot, blocks=n,
+        ):
+            self._donated = self._restore_jit(
+                self._donated, payload, jnp.asarray(ids_full)
+            )
+        self.kv_restores += 1
+        self._tick += 1
+        self._ring.append((self._tick, "chunk", (occ,)))
+
+    def prefill_step(self, limit: Optional[int] = None) -> bool:
+        """Dispatch up to ``limit`` (default: the runtime clamp set by
+        :meth:`set_prefill_chunk_limit`) pending prompt chunks, round-robin
+        across PREFILLING slots. Returns True when anything dispatched."""
+        n = self._prefill_chunk_limit if limit is None else limit
+        dispatched = False
+        for _ in range(n):
+            if not self._prefill_queue:
+                break
+            occ = self._prefill_queue[0]
+            self._prefill_queue.rotate(-1)
+            self._dispatch_chunk(occ)
+            dispatched = True
+        return dispatched
+
+    def set_prefill_chunk_limit(self, n: int) -> None:
+        """Clamp how many prompt chunks each :meth:`step` tick may dispatch
+        — a host-side scheduling knob (operands only, no recompile), the
+        degradation ladder's long-context rung. 0 pauses chunked prefill
+        entirely (admitted long prompts hold their slots but burn no
+        compute); restore with a larger value once pressure subsides."""
+        self._prefill_chunk_limit = max(0, int(n))
+
+    @property
+    def prefill_chunk_limit(self) -> int:
+        return self._prefill_chunk_limit
+
+    def prefill_chunks_pending(self) -> int:
+        """Chunks still owed across all PREFILLING slots (the
+        ``engine/prefill_chunks_pending`` gauge)."""
+        chunk = self.prefill_chunk or self.prompt_bucket
+        return sum(
+            -(-(len(occ.prompt) - occ.prefill_pos) // chunk)
+            for occ in self._prefill_queue
+        )
+
+    def _decoding_count(self) -> int:
+        return sum(
+            1 for o in self._occupants
+            if o is not None and not o.finished and not o.prefilling
+        )
+
+    def prefetch(self, prompt) -> None:
+        """Admission-time async prefetch: start host-tier -> device copies
+        for any spilled prefix of ``prompt`` so the restore payload is in
+        flight before the decode thread admits the request. Safe from any
+        thread; a no-op without a host tier."""
+        if hasattr(self._backend, "prefetch"):
+            self._backend.prefetch(np.asarray(prompt, np.int32).reshape(-1))
+
     def prefill_remote(
         self,
         prompt,
@@ -807,6 +1158,11 @@ class ContinuousBatchingEngine:
         ``ServingResult.ttft_s`` is the metric)."""
         prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
         self.validate_request(len(prompt), max_new_tokens)
+        if len(prompt) > self.prompt_bucket:
+            raise ValueError(
+                "prefill_remote cannot disaggregate a chunked (long) prompt; "
+                "admit it via insert() so chunks interleave with decode"
+            )
         padded = np.zeros((1, self.prompt_bucket), np.int32)
         padded[0, : len(prompt)] = prompt
         kd = jax.random.key_data(jax.random.key(seed))
@@ -912,15 +1268,19 @@ class ContinuousBatchingEngine:
         return occ
 
     def step(self) -> bool:
-        """One fused step over every slot (vacant/finished slots ride
-        masked): a ``verify_step`` when speculative drafting produced any
-        draft this tick, the plain ``decode_step`` otherwise. Returns
-        False (no dispatch) when nothing is live."""
-        if self.live_count() == 0:
-            return False
+        """One scheduler tick: first dispatch up to the runtime clamp of
+        pending prompt chunks (chunked prefill interleaves with decode —
+        each tick costs one bucket-sized forward, not the whole prompt),
+        then one fused step over every DECODING slot (vacant/finished/
+        PREFILLING slots ride masked): a ``verify_step`` when speculative
+        drafting produced any draft this tick, the plain ``decode_step``
+        otherwise. Returns False when nothing dispatched."""
+        dispatched = self.prefill_step()
+        if self._decoding_count() == 0:
+            return dispatched
         if self.spec is not None:
-            return self._step_spec()
-        return self._dispatch_decode()
+            return self._step_spec() or dispatched
+        return self._dispatch_decode() or dispatched
 
     def _dispatch_decode(self) -> bool:
         self._record("decode_step", ())
@@ -940,9 +1300,22 @@ class ContinuousBatchingEngine:
         self._tick += 1
         self._ring.append(
             (self._tick, "decode",
-             (tuple(self._occupants), self._carried["token"], self._carried["done"]))
+             (self._ring_occupants(), self._carried["token"], self._carried["done"]))
         )
         return True
+
+    def _ring_occupants(self) -> tuple:
+        """Occupant snapshot for a decode/verify ring entry. A PREFILLING
+        slot rode this program masked — vacant-done, pad token — so
+        absorbing its row at poll would retire the request with one pad
+        token; the snapshot holds None in its place instead. Snapshot-TIME
+        state is the correct test (not poll-time): by the time the entry
+        is popped the slot may have finished prefilling, but this entry's
+        program predates that commit."""
+        return tuple(
+            None if (o is not None and o.prefilling) else o
+            for o in self._occupants
+        )
 
     def set_spec_draft_limit(self, n: int) -> None:
         """Clamp the host drafter's proposal length at runtime WITHOUT
@@ -961,6 +1334,8 @@ class ContinuousBatchingEngine:
         this only moves the host transfer earlier for spec-mode steps,
         which need fresh history before they can propose drafts."""
         for i, (tick, kind, payload) in enumerate(self._ring):
+            if kind == "chunk":
+                continue  # progress marker only — no tokens to materialize
             if kind == "prefill":
                 occ, tok, done = payload
                 if not isinstance(tok, (int, np.integer)):
@@ -990,6 +1365,8 @@ class ContinuousBatchingEngine:
         toks: List[int] = []
         done = False
         for _, kind, payload in self._ring:
+            if kind == "chunk":
+                continue  # chunk entries emit no tokens
             if kind == "prefill":
                 p_occ, tok, d = payload
                 if p_occ is occ:
@@ -1051,7 +1428,7 @@ class ContinuousBatchingEngine:
         # instead of paying a per-step sync it gets nothing for.
         gated = []
         for occ in self._occupants:
-            if occ is None or occ.finished:
+            if occ is None or occ.finished or occ.prefilling:
                 continue
             if not (occ.spec_ewma < self._SPEC_MIN_ACCEPT
                     and occ.spec_skips + 1 < occ.spec_cooldown):
@@ -1067,7 +1444,7 @@ class ContinuousBatchingEngine:
         draft = np.zeros((self.slots, k), np.int32)
         dlen = np.zeros((self.slots,), np.int32)
         for occ in self._occupants:
-            if occ is None or occ.finished:
+            if occ is None or occ.finished or occ.prefilling:
                 continue
             pending, pending_done = self._pending_tokens(occ)
             if pending_done:
@@ -1130,7 +1507,7 @@ class ContinuousBatchingEngine:
         self._tick += 1
         self._ring.append(
             (self._tick, "verify",
-             (tuple(self._occupants), emitted, m, a, dlen, self._carried["done"]))
+             (self._ring_occupants(), emitted, m, a, dlen, self._carried["done"]))
         )
         return True
 
@@ -1147,6 +1524,8 @@ class ContinuousBatchingEngine:
         ):
             _, kind, payload = self._ring.popleft()
             popped[kind] += 1
+            if kind == "chunk":
+                continue  # no tokens — the last chunk's entry carries t0
             if kind == "prefill":
                 occ, tok, done = payload
                 # graft: sync-ok — the ring IS the readback point (K programs late)
@@ -1261,6 +1640,15 @@ class ContinuousBatchingEngine:
         if occ.finished:
             return
         occ.finished = True
+        if occ.prefilling:
+            # mid-prefill cancel: stop burning ticks on its chunks; the
+            # slot's deferred (unpromoted) registrations die with release()
+            occ.prefilling = False
+            occ.chunk_args = None
+            try:
+                self._prefill_queue.remove(occ)
+            except ValueError:
+                pass
         if self._occupants[occ.slot] is occ:
             self._occupants[occ.slot] = None
             self._free.append(occ.slot)
@@ -1271,7 +1659,10 @@ class ContinuousBatchingEngine:
         """Step until every occupant retires (bounded by the per-slot budget
         mask: at most ~max_len + readback_lag steps)."""
         retired: List[SlotOccupant] = []
-        guard = 2 * self.max_len + self.readback_lag + 4
+        guard = (
+            2 * self.max_len + self.readback_lag + 4
+            + 2 * self.prefill_chunks_pending()
+        )
         while self.live_count() > 0:
             if guard <= 0:
                 raise EngineInvariantError(
@@ -1279,6 +1670,10 @@ class ContinuousBatchingEngine:
                     "caught up with live occupants)"
                 )
             guard -= 1
+            # drain must converge even when the ladder paused chunked
+            # prefill (limit 0): drive one chunk per iteration directly
+            if self._prefill_queue and self._prefill_chunk_limit < 1:
+                self.prefill_step(limit=1)
             self.step()
             retired.extend(self.poll())
         retired.extend(self.poll(force=True))
@@ -1295,6 +1690,7 @@ class ContinuousBatchingEngine:
         self._occupants = [None] * self.slots
         self._free = list(range(self.slots))
         self._ring.clear()
+        self._prefill_queue.clear()
         self._backend.reset()  # fresh pool + empty prefix registry/tables
         self._donated, self._carried = self._init_state()
         return orphans
@@ -1303,7 +1699,7 @@ class ContinuousBatchingEngine:
         """Positions actually holding useful KV right now: each live
         occupant's prompt + emitted tokens (host-side, no device sync)."""
         return sum(
-            len(o.prompt) + len(o.tokens)
+            (o.prefill_pos if o.prefilling else len(o.prompt)) + len(o.tokens)
             for o in self._occupants
             if o is not None and not o.finished
         )
@@ -1342,6 +1738,11 @@ class ContinuousBatchingEngine:
             "free": len(self._free),
             "inserted": self.inserted,
             "remote_prefills": self.remote_prefills,
+            "prefill_chunk": self.prefill_chunk,
+            "prefill_chunk_limit": self._prefill_chunk_limit,
+            "prefill_chunks": self.prefill_chunks,
+            "prefill_chunks_pending": self.prefill_chunks_pending(),
+            "kv_restores": self.kv_restores,
             "steps": self.steps,
             "retired": self.retired,
             "programs": programs,

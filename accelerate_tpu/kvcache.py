@@ -58,7 +58,10 @@ Backends:
 from __future__ import annotations
 
 import collections
-from typing import Any, Dict, List, Optional, Tuple
+import queue
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -76,6 +79,7 @@ __all__ = [
     "PagedKVBackend",
     "PagedBlockPool",
     "PagedKVLayout",
+    "HostKVTier",
     "make_kv_backend",
     "kv_quantize",
     "kv_dequantize",
@@ -226,6 +230,109 @@ class PagedKVLayout:
         return layer_cache.at[:, blk, off].set(window.astype(layer_cache.dtype))
 
 
+# ----------------------------------------------------------- host spill tier
+class HostKVTier:
+    """Pinned host-RAM spill tier below the pool's zero-ref cached-LRU
+    (docs/serving.md "Long-context serving"). Evicted *registered* prefix
+    blocks land here (payload exactly as the pool stores it: f32, or int8
+    bytes + per-position f32 scales) instead of dying, keyed by the same
+    exact block-aligned prefix bytes as the device registry — so a host hit
+    restores the identical bytes a never-evicted block would have held
+    (bitwise in f32; the int8 payload dequantizes within the committed
+    4.0e-3·amax bound because it IS the original quantization).
+
+    Content-addressed keys make staleness structurally impossible: a key is
+    the full token prefix, and deterministic quantization maps identical
+    prefixes to identical bytes, so a "stale" host block can only exist
+    across a model/config swap — which resets the engine and clears the
+    tier (docs/fault_tolerance.md failure-mode table).
+
+    Thread contract: ``insert`` is called from the backend's background
+    spill thread, ``lookup``/``stats``/``clear`` from the engine (serving
+    worker) thread — every mutation holds ``_lock``. Capacity is enforced
+    in blocks (``capacity_bytes // block_bytes``), LRU-evicted on insert;
+    the tier never grows past ``capacity_bytes`` of host RAM."""
+
+    def __init__(self, capacity_bytes: int, block_bytes: int):
+        if capacity_bytes < 0:
+            raise ValueError(
+                f"capacity_bytes must be >= 0, got {capacity_bytes}"
+            )
+        if block_bytes < 1:
+            raise ValueError(f"block_bytes must be >= 1, got {block_bytes}")
+        self.capacity_bytes = capacity_bytes
+        self.block_bytes = block_bytes
+        self.capacity_blocks = capacity_bytes // block_bytes
+        self._blocks: "collections.OrderedDict[bytes, Any]" = collections.OrderedDict()
+        self._lock = threading.Lock()
+        self.spill_blocks = 0
+        self.spill_bytes = 0
+        self.restore_hits = 0
+        self.restore_bytes = 0
+        self.restore_misses = 0
+        self.dropped = 0  # LRU-evicted out of the tier (truly dead now)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._blocks)
+
+    def bytes_used(self) -> int:
+        with self._lock:
+            return len(self._blocks) * self.block_bytes
+
+    def insert(self, key: bytes, payload: Any) -> bool:
+        """Insert one spilled block (host numpy payload). Returns False when
+        the tier has zero capacity (spill accounting still advances so the
+        eviction pressure stays observable)."""
+        with self._lock:
+            self.spill_blocks += 1
+            self.spill_bytes += self.block_bytes
+            if self.capacity_blocks < 1:
+                self.dropped += 1
+                return False
+            while len(self._blocks) >= self.capacity_blocks:
+                self._blocks.popitem(last=False)
+                self.dropped += 1
+            self._blocks[key] = payload
+            self._blocks.move_to_end(key)
+            return True
+
+    def lookup(self, key: bytes) -> Optional[Any]:
+        """Host-tier probe; a hit refreshes LRU recency. Hit/restore
+        counters advance at *restore* time (see ``count_restore``) so a
+        probe that is never consumed doesn't inflate the win."""
+        with self._lock:
+            payload = self._blocks.get(key)
+            if payload is None:
+                self.restore_misses += 1
+                return None
+            self._blocks.move_to_end(key)
+            return payload
+
+    def count_restore(self, n_blocks: int) -> None:
+        with self._lock:
+            self.restore_hits += n_blocks
+            self.restore_bytes += n_blocks * self.block_bytes
+
+    def clear(self) -> None:
+        with self._lock:
+            self._blocks.clear()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "host_tier_capacity_bytes": self.capacity_bytes,
+                "host_tier_blocks": len(self._blocks),
+                "host_tier_bytes": len(self._blocks) * self.block_bytes,
+                "spill_blocks": self.spill_blocks,
+                "spill_bytes": self.spill_bytes,
+                "restore_hits": self.restore_hits,
+                "restore_bytes": self.restore_bytes,
+                "restore_misses": self.restore_misses,
+                "host_tier_dropped": self.dropped,
+            }
+
+
 # ------------------------------------------------------------ host block pool
 class PagedBlockPool:
     """Host-side allocator for the device block pool: free list, refcounts,
@@ -256,6 +363,12 @@ class PagedBlockPool:
         self.block_size = block_size
         self.slots = slots
         self.blocks_per_row = blocks_per_row
+        # host-tier spill interception: when set, _evict_one hands every
+        # still-registered LRU victim's (key, block) to the owner BEFORE
+        # the registry entry dies, so the backend can snapshot the device
+        # bytes ahead of the block's reallocation (engine dispatches the
+        # overwriting prefill only after acquire returns)
+        self.spill_fn: Optional[Callable[[bytes, int], None]] = None
         self.reset()
 
     # -------------------------------------------------------------- lifecycle
@@ -266,6 +379,10 @@ class PagedBlockPool:
         self._key_of: Dict[int, bytes] = {}
         self._cached: "collections.OrderedDict[int, None]" = collections.OrderedDict()
         self._rows: List[List[int]] = [[] for _ in range(self.slots)]
+        # chunked-prefill COW safety: fresh prompt blocks of a PREFILLING
+        # slot must not serve prefix hits until their content exists, so
+        # their registrations are parked here and promoted at completion
+        self._deferred: Dict[int, List[Tuple[bytes, int]]] = {}
         self.tables = np.zeros((self.slots, self.blocks_per_row), dtype=np.int32)
         self.prefix_hits = 0
         self.prefix_misses = 0
@@ -318,6 +435,11 @@ class PagedBlockPool:
         # block (acquire deregisters superseded mappings, so a mismatch here
         # would mean a newer block owns the key)
         if self._registry.get(key) == blk:
+            # host-tier spill: the victim still owns its key, so its device
+            # bytes are the canonical content for that prefix — hand it to
+            # the spill hook before the registry entry dies
+            if self.spill_fn is not None:
+                self.spill_fn(key, blk)
             del self._registry[key]
         return blk
 
@@ -326,13 +448,39 @@ class PagedBlockPool:
             return self._free.pop()
         return self._evict_one()
 
-    def acquire(self, slot: int, prompt: np.ndarray, budget: int) -> Tuple[np.ndarray, int]:
+    def _register(self, key: bytes, blk: int) -> None:
+        """Map ``key`` -> ``blk`` in the prefix registry, deregistering any
+        superseded mapping first. A stale registration can exist here:
+        evicting a shallow prefix block orphans deeper extensions (the
+        depth walk stops at the first miss), so this key may still map to
+        an old block. Deregister it first — otherwise the old block's
+        eventual eviction would delete the NEW registry entry, and evicting
+        the new block afterwards would KeyError."""
+        old = self._registry.get(key)
+        if old is not None and old != blk:
+            del self._key_of[old]
+            if old in self._cached:  # orphan at ref 0: plain free now
+                del self._cached[old]
+                self._free.append(old)
+        self._registry[key] = blk
+        self._key_of[blk] = key
+
+    def acquire(self, slot: int, prompt: np.ndarray, budget: int,
+                defer_register: bool = False) -> Tuple[np.ndarray, int]:
         """Allocate (or COW-share) the blocks for one admitted request and
         install the slot's table row. Returns ``(row, shared_blocks)`` where
         ``row`` is the full ``(blocks_per_row,)`` int32 table row (null
         beyond the allocation). Raises ``EngineCapacityError`` (a retriable
         RuntimeError) when the pool lacks capacity — callers gate on
-        :meth:`can_admit` first."""
+        :meth:`can_admit` first.
+
+        ``defer_register=True`` (chunked prefill) parks the fresh prompt
+        blocks' registry entries instead of installing them: their content
+        does not exist until the slot's chunks commit, so serving prefix
+        hits off them would share garbage. :meth:`promote_deferred`
+        installs them (host-tier restores make content valid early);
+        :meth:`release` before promotion simply drops them — the blocks
+        free unregistered, exactly as if they had never been shareable."""
         from .utils.fault import EngineCapacityError
 
         prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
@@ -357,6 +505,7 @@ class PagedBlockPool:
             row.append(blk)
         self.prefix_hits += len(hits)
         self.prefix_misses += full - len(hits)
+        deferred: List[Tuple[bytes, int]] = []
         # private blocks; full prompt blocks past the shared depth register
         # so the NEXT request with this prefix shares them
         for j in range(len(hits), total):
@@ -364,25 +513,36 @@ class PagedBlockPool:
             self._ref[blk] = 1
             if j < full:
                 key = prompt[: (j + 1) * bs].tobytes()
-                # A stale registration can exist here: evicting a shallow
-                # prefix block orphans deeper extensions (the depth walk
-                # stops at the first miss), so this key may still map to an
-                # old block. Deregister it first — otherwise the old block's
-                # eventual eviction would delete OUR registry entry, and
-                # evicting this block afterwards would KeyError.
-                old = self._registry.get(key)
-                if old is not None and old != blk:
-                    del self._key_of[old]
-                    if old in self._cached:  # orphan at ref 0: plain free now
-                        del self._cached[old]
-                        self._free.append(old)
-                self._registry[key] = blk
-                self._key_of[blk] = key
+                if defer_register:
+                    deferred.append((key, blk))
+                else:
+                    self._register(key, blk)
             row.append(blk)
+        if deferred:
+            self._deferred[slot] = deferred
+        else:
+            self._deferred.pop(slot, None)
         self._rows[slot] = row
         self.tables[slot] = _NULL_BLOCK
         self.tables[slot, : len(row)] = row
         return self.tables[slot].copy(), len(hits)
+
+    def promote_deferred(self, slot: int, count: Optional[int] = None) -> int:
+        """Install up to ``count`` (all when None) of the slot's parked
+        registrations, shallowest-first — called once a chunked prefill's
+        content actually exists (host-tier restore made the leading blocks
+        valid early; the final chunk's commit validates the rest). Returns
+        how many were promoted."""
+        deferred = self._deferred.get(slot, [])
+        n = len(deferred) if count is None else min(count, len(deferred))
+        for key, blk in deferred[:n]:
+            self._register(key, blk)
+        rest = deferred[n:]
+        if rest:
+            self._deferred[slot] = rest
+        else:
+            self._deferred.pop(slot, None)
+        return n
 
     def release(self, slot: int) -> None:
         """Drop the slot's references; zero-ref registered blocks park in
@@ -390,6 +550,7 @@ class PagedBlockPool:
         The table row resets to the null block so the ghost slot's masked
         decode writes stop touching real blocks — this is what makes block
         recycling safe under the deferred-readback ring."""
+        self._deferred.pop(slot, None)  # cancelled mid-prefill: never shareable
         for blk in self._rows[slot]:
             self._ref[blk] -= 1
             if self._ref[blk] == 0:
@@ -447,7 +608,8 @@ class KVCacheBackend:
     def device_tables(self):
         raise NotImplementedError
 
-    def acquire(self, slot: int, prompt: np.ndarray, budget: int) -> Tuple[np.ndarray, int]:
+    def acquire(self, slot: int, prompt: np.ndarray, budget: int,
+                defer_register: bool = False) -> Tuple[np.ndarray, int]:
         raise NotImplementedError
 
     def release(self, slot: int) -> None:
@@ -532,7 +694,7 @@ class DenseKVBackend(KVCacheBackend):
     def device_tables(self):
         return self._tables
 
-    def acquire(self, slot, prompt, budget):
+    def acquire(self, slot, prompt, budget, defer_register: bool = False):
         return np.zeros((1,), np.int32), 0
 
     def release(self, slot):
@@ -570,7 +732,8 @@ class PagedKVBackend(KVCacheBackend):
 
     def __init__(self, *, config, slots: int, max_len: int, prompt_bucket: int,
                  block_size: int = 16, pool_blocks: Optional[int] = None,
-                 quantized: bool = False, attention_impl: str = "reference"):
+                 quantized: bool = False, attention_impl: str = "reference",
+                 host_tier_bytes: int = 0):
         if attention_impl not in ("reference", "pallas"):
             raise ValueError(
                 f"attention_impl must be 'reference' or 'pallas', "
@@ -611,6 +774,29 @@ class PagedKVBackend(KVCacheBackend):
             blocks_per_row=self.blocks_per_row,
         )
         self._device_tables_cache = None
+        # ---------------------------------------------- host-RAM spill tier
+        # Evicted registered blocks spill to pinned host RAM instead of
+        # dying (docs/serving.md "Long-context serving"). The hot path only
+        # dispatches a device-side gather (read-only on the pool — a crash
+        # anywhere after that point cannot corrupt device state); a
+        # background thread materializes the gather to host numpy and
+        # inserts it into the tier.
+        self.host_tier: Optional[HostKVTier] = None
+        self._cache_reader: Optional[Callable[[], Any]] = None
+        self._spill_batch: List[Tuple[bytes, int]] = []
+        self._spill_q: Optional["queue.Queue"] = None
+        self._spill_thread: Optional[threading.Thread] = None
+        # admission-time async prefetch: key -> device payload already in
+        # flight via jax.device_put, consumed (or discarded) at restore
+        self._prefetched: Dict[bytes, Any] = {}
+        self.prefetch_hits = 0
+        if host_tier_bytes > 0:
+            self.host_tier = HostKVTier(
+                host_tier_bytes, self.host_block_bytes()
+            )
+            self.pool.spill_fn = (
+                lambda key, blk: self._spill_batch.append((key, blk))
+            )
 
     # ------------------------------------------------------------ device side
     def init_device_state(self):
@@ -675,10 +861,176 @@ class PagedKVBackend(KVCacheBackend):
             self._device_tables_cache = jnp.asarray(self.pool.tables)
         return self._device_tables_cache
 
-    def acquire(self, slot, prompt, budget):
-        row, shared = self.pool.acquire(slot, prompt, budget)
+    def acquire(self, slot, prompt, budget, defer_register: bool = False):
+        row, shared = self.pool.acquire(
+            slot, prompt, budget, defer_register=defer_register
+        )
         self._device_tables_cache = None
+        self._flush_spills()
         return row, shared
+
+    # ---------------------------------------------------- host tier: spill
+    def host_block_bytes(self) -> int:
+        """Host bytes one spilled block occupies (K + V payload; int8 keeps
+        the quantized bytes + f32 scales — a spilled block restores to the
+        identical pool bytes it held)."""
+        return 2 * self._per_block_bytes()
+
+    def bind_cache_reader(self, reader: Callable[[], Any]) -> None:
+        """The engine hands us a zero-cost view of its CURRENT donated
+        device cache — the spill gather reads through this right after
+        ``pool.acquire`` evicted a victim and BEFORE the caller dispatches
+        the program that overwrites the block."""
+        self._cache_reader = reader
+
+    def _flush_spills(self) -> None:
+        """Snapshot this acquire's eviction victims with ONE device-side
+        gather (read-only on the pool) and queue the host materialization
+        on the background spill thread. Called while still inside the
+        admission path — the overwriting prefill has not dispatched yet, so
+        the gathered bytes are the victims' canonical content."""
+        batch, self._spill_batch = self._spill_batch, []
+        if not batch or self.host_tier is None or self._cache_reader is None:
+            return
+        cache = self._cache_reader()
+        if cache is None:
+            return
+        keys = [key for key, _ in batch]
+        ids = jnp.asarray([blk for _, blk in batch], jnp.int32)
+        if self.quantized:
+            payload = {
+                w: {"q": cache[w]["q"][:, ids], "s": cache[w]["s"][:, ids]}
+                for w in ("k", "v")
+            }
+        else:
+            payload = {w: cache[w][:, ids] for w in ("k", "v")}
+        self._spill_worker_q().put((keys, payload))
+
+    def _spill_worker_q(self) -> "queue.Queue":
+        if self._spill_q is None:
+            self._spill_q = queue.Queue()
+            self._spill_thread = threading.Thread(
+                target=self._spill_worker, name="kv-spill", daemon=True
+            )
+            self._spill_thread.start()
+        return self._spill_q
+
+    def _spill_worker(self) -> None:
+        from .utils.fault import fault_point
+
+        while True:
+            item = self._spill_q.get()
+            try:
+                if item is None:
+                    return
+                keys, payload = item
+                # kill point: dying here (mid device_get, tier half-written)
+                # must never corrupt the device pool — the gather upstream
+                # was read-only and the tier is host-only state
+                fault_point("kvcache.spill_mid")
+                host = jax.tree_util.tree_map(np.asarray, payload)
+                for i, key in enumerate(keys):
+                    if self.quantized:
+                        block = {
+                            w: {"q": host[w]["q"][:, i], "s": host[w]["s"][:, i]}
+                            for w in ("k", "v")
+                        }
+                    else:
+                        block = {w: host[w][:, i] for w in ("k", "v")}
+                    self.host_tier.insert(key, block)
+            except Exception:  # noqa: BLE001 — a failed spill only loses a cache win
+                logger.exception(
+                    "host-tier spill failed; the evicted block is lost to "
+                    "the tier (device pool unaffected)"
+                )
+            finally:
+                self._spill_q.task_done()
+
+    def spill_flush(self, timeout_s: float = 30.0) -> None:
+        """Block (bounded) until every queued spill has landed in the tier
+        (tests/benches; the serving hot path never calls this)."""
+        if self._spill_q is None:
+            return
+        deadline = time.monotonic() + timeout_s
+        while self._spill_q.unfinished_tasks:  # graft: race-ok — monotone counter, polled
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"host-tier spill queue did not drain in {timeout_s}s "
+                    f"({self._spill_q.unfinished_tasks} task(s) pending)"
+                )
+            time.sleep(0.002)
+
+    # -------------------------------------------------- host tier: restore
+    def _host_chain(self, prompt: np.ndarray, start_depth: int) -> List[bytes]:
+        """Consecutive host-tier hits for ``prompt`` starting at block depth
+        ``start_depth`` (first miss stops the walk, mirroring the device
+        registry's depth walk)."""
+        if self.host_tier is None:
+            return []
+        bs = self.block_size
+        keys: List[bytes] = []
+        for depth in range(start_depth, len(prompt) // bs):
+            key = prompt[: (depth + 1) * bs].tobytes()
+            if key in self._prefetched:
+                keys.append(key)
+                continue
+            if self.host_tier.lookup(key) is None:
+                break
+            keys.append(key)
+        return keys
+
+    def prefetch(self, prompt) -> int:
+        """Admission-time async prefetch: start ``jax.device_put`` for every
+        host-tier block this prompt would restore, so the transfer overlaps
+        queue wait instead of sitting on the admission path. Returns how
+        many blocks are now in flight."""
+        if self.host_tier is None:
+            return 0
+        prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
+        shared = len(self.pool._shared_prefix(prompt))
+        n = 0
+        for key in self._host_chain(prompt, shared):
+            if key not in self._prefetched:
+                payload = self.host_tier.lookup(key)
+                if payload is None:
+                    break
+                self._prefetched[key] = jax.device_put(payload)
+            n += 1
+        return n
+
+    def restore_plan(self, slot: int, prompt: np.ndarray, shared: int,
+                     row: np.ndarray):
+        """Build the spill-tier restore plan for a chunked admission:
+        device payloads (prefetched when possible, ``device_put`` now
+        otherwise) for the consecutive host-tier hits past the device
+        registry's ``shared`` depth, targeted at the slot's freshly
+        allocated blocks ``row[shared : shared+n]``. Returns ``(n_blocks,
+        payloads, target_ids)`` or ``None`` on a cold tier. The caller
+        scatters the payloads with its restore program, then promotes the
+        slot's first ``n_blocks`` deferred registrations — restored content
+        is valid (it IS the original bytes), so it may serve prefix hits
+        immediately."""
+        if self.host_tier is None:
+            return None
+        prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
+        keys = self._host_chain(prompt, shared)
+        payloads = []
+        for key in keys:
+            dev = self._prefetched.pop(key, None)
+            if dev is None:
+                host = self.host_tier.lookup(key)
+                if host is None:  # raced out of the tier since the probe
+                    break
+                dev = jax.device_put(host)
+            else:
+                self.prefetch_hits += 1
+            payloads.append(dev)
+        if not payloads:
+            return None
+        n = len(payloads)
+        self.host_tier.count_restore(n)
+        target_ids = np.asarray(row[shared: shared + n], np.int32)
+        return n, payloads, target_ids
 
     def release(self, slot):
         self.pool.release(slot)
@@ -699,9 +1051,19 @@ class PagedKVBackend(KVCacheBackend):
                 "the budget"
             )
 
+    def promote_deferred(self, slot: int, count: Optional[int] = None) -> int:
+        return self.pool.promote_deferred(slot, count)
+
     def reset(self):
         self.pool.reset()
         self._device_tables_cache = None
+        # the host tier SURVIVES a device reset: its keys are content-
+        # addressed (exact prefix bytes + deterministic quantization), so
+        # recovered engines restore instead of recomputing warm prefixes.
+        # In-flight prefetches are dropped (their device buffers die with
+        # the arena they were destined for).
+        self._spill_batch = []
+        self._prefetched = {}
 
     def _per_block_bytes(self):
         per_block = self._layers * self.block_size * self._kvh * self._hd
@@ -728,7 +1090,7 @@ class PagedKVBackend(KVCacheBackend):
         return (self.pool.active_blocks()) * self.block_size
 
     def stats(self):
-        return {
+        out = {
             "backend": self.kind,
             "block_size": self.block_size,
             "pool_blocks": self.pool_blocks,
@@ -738,12 +1100,17 @@ class PagedKVBackend(KVCacheBackend):
             "reserved_tokens": self.reserved_tokens(),
             **self.pool.stats(),
         }
+        if self.host_tier is not None:
+            out.update(self.host_tier.stats())
+            out["prefetch_hits"] = self.prefetch_hits
+        return out
 
 
 def make_kv_backend(kind: str, *, config, slots: int, max_len: int,
                     prompt_bucket: int, block_size: int = 16,
                     pool_blocks: Optional[int] = None,
-                    attention_impl: str = "reference") -> KVCacheBackend:
+                    attention_impl: str = "reference",
+                    host_tier_bytes: int = 0) -> KVCacheBackend:
     """Factory the engine (and ``ServingConfig.kv_cache``) selects through."""
     if kind == "dense":
         if attention_impl != "reference":
@@ -752,13 +1119,19 @@ def make_kv_backend(kind: str, *, config, slots: int, max_len: int,
                 "(kv_cache='paged' or 'paged_int8'); the dense arena has no "
                 "block tables for the kernel to walk"
             )
+        if host_tier_bytes > 0:
+            raise ValueError(
+                "kv_host_tier_bytes requires a paged KV cache (kv_cache="
+                "'paged' or 'paged_int8'); the dense arena has no blocks "
+                "to spill"
+            )
         return DenseKVBackend(config=config, slots=slots, max_len=max_len)
     if kind in ("paged", "paged_int8"):
         return PagedKVBackend(
             config=config, slots=slots, max_len=max_len,
             prompt_bucket=prompt_bucket, block_size=block_size,
             pool_blocks=pool_blocks, quantized=(kind == "paged_int8"),
-            attention_impl=attention_impl,
+            attention_impl=attention_impl, host_tier_bytes=host_tier_bytes,
         )
     raise ValueError(
         f"kv_cache must be one of {KV_BACKENDS}, got {kind!r}"

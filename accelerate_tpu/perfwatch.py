@@ -94,6 +94,9 @@ RING_KIND_PROGRAM = {
     "prefill": "prefill_insert",
     "decode": "decode_step",
     "verify": "verify_step",
+    # chunked-prefill progress entries (one per prompt chunk / KV restore);
+    # billed as their own program so long-prompt admission cost is visible
+    "chunk": "prefill_chunk",
 }
 
 _FINDINGS_CAP = 32

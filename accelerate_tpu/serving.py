@@ -395,6 +395,8 @@ class InferenceServer:
                     attention_impl=self.config.attention_impl,
                     spec=self.config.speculative,
                     spec_draft_len=self.config.spec_draft_len,
+                    prefill_chunk=self.config.engine_prefill_chunk,
+                    host_tier_bytes=self.config.kv_host_tier_bytes,
                     clock=clock,
                 )
         self._lock = threading.Lock()
@@ -512,6 +514,14 @@ class InferenceServer:
             self._engine.validate_request(
                 ids.shape[0], max_new_tokens or self.config.default_max_new_tokens
             )
+            if self.config.kv_prefetch and hasattr(self._engine, "prefetch"):
+                # admission-time async prefetch: start the host-tier ->
+                # device copy of any spilled prefix NOW, on the submitter's
+                # thread, so the payload is resident (or in flight) by the
+                # time the decode thread admits the request. hasattr-gated:
+                # injected engines (fleet benches, tests) need not grow the
+                # long-context surface
+                self._engine.prefetch(ids)
         if prefilled is not None and self._engine is None:
             raise ValueError(
                 "prefilled= requires mode='continuous' (no slot engine to "
@@ -1016,6 +1026,17 @@ class InferenceServer:
             misses = kv.get("prefix_misses", 0)
             if hits + misses:
                 self.metrics.gauge("prefix_hit_rate", hits / (hits + misses))
+            if "host_tier_bytes" in kv:
+                # host-RAM spill tier economics (docs/serving.md metric table)
+                self.metrics.gauge("kv_host_tier_bytes", kv["host_tier_bytes"])
+                self.metrics.gauge("kv_host_tier_blocks", kv.get("host_tier_blocks", 0))
+                self.metrics.gauge("kv_restore_hits", kv.get("restore_hits", 0))
+                self.metrics.gauge("kv_restore_bytes", kv.get("restore_bytes", 0))
+                self.metrics.gauge("kv_spill_bytes", kv.get("spill_bytes", 0))
+        if "prefill_chunks_pending" in stats:
+            self.metrics.gauge(
+                "prefill_chunks_pending", stats["prefill_chunks_pending"]
+            )
         spec = stats.get("spec")
         if spec and spec.get("mode") != "off":
             self.metrics.gauge(

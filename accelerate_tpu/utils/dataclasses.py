@@ -562,6 +562,21 @@ class ServingConfig(KwargsHandler):
     degradation ladder) and restores it when pressure subsides; the
     engine itself falls back to plain ``decode_step`` for slots whose
     acceptance EWMA collapses. Requires ``mode="continuous"``.
+
+    Long-context serving (docs/serving.md "Long-context serving"):
+    ``engine_prefill_chunk`` — when set, prompts longer than
+    ``engine_prompt_bucket`` are admitted anyway and prefilled in chunks
+    of this many positions, ONE chunk per scheduler tick interleaved
+    with other slots' decode steps (Sarathi-style stall-free batching);
+    greedy f32 output is bitwise identical to a single-shot prefill.
+    ``kv_host_tier_bytes`` — capacity of a pinned host-RAM tier below
+    the paged pool's zero-ref cached-LRU: evicted prefix blocks spill
+    there (payload + scales on a background thread) instead of dying,
+    and a later request with the same prefix restores them with one
+    device scatter instead of recomputing the prompt forward. Requires a
+    paged ``kv_cache``. ``kv_prefetch`` — start the host-to-device copy
+    of a spilled prefix at ``submit()`` time (async, submitter's thread)
+    so the payload is already in flight when the request is admitted.
     """
 
     mode: str = "static"
@@ -575,6 +590,9 @@ class ServingConfig(KwargsHandler):
     attention_impl: str = "reference"
     speculative: Optional[str] = None
     spec_draft_len: int = 4
+    engine_prefill_chunk: Optional[int] = None
+    kv_host_tier_bytes: int = 0
+    kv_prefetch: bool = True
     max_queue: int = 256
     max_batch_size: int = 8
     batch_window_s: float = 0.002
@@ -719,6 +737,31 @@ class ServingConfig(KwargsHandler):
             raise ValueError(
                 "degraded_max_new_tokens must be >= 1, got "
                 f"{self.degraded_max_new_tokens}"
+            )
+        if self.engine_prefill_chunk is not None and not (
+            1 <= self.engine_prefill_chunk <= self.engine_max_len - 1
+        ):
+            raise ValueError(
+                "engine_prefill_chunk must be in [1, engine_max_len-1], got "
+                f"{self.engine_prefill_chunk} (engine_max_len="
+                f"{self.engine_max_len})"
+            )
+        if self.engine_prefill_chunk is not None and self.mode != "continuous":
+            raise ValueError(
+                "engine_prefill_chunk requires mode='continuous' (chunked "
+                "prefill is a slot-engine scheduling feature)"
+            )
+        if self.kv_host_tier_bytes < 0:
+            raise ValueError(
+                f"kv_host_tier_bytes must be >= 0, got {self.kv_host_tier_bytes}"
+            )
+        if self.kv_host_tier_bytes > 0 and self.kv_cache not in (
+            "paged", "paged_int8"
+        ):
+            raise ValueError(
+                "kv_host_tier_bytes requires a paged KV cache (the host tier "
+                "spills/restores pool blocks, which the dense arena does not "
+                "have)"
             )
 
 

@@ -31,9 +31,11 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 __all__ = [
     "Arrival",
     "Phase",
+    "PromptMix",
     "Schedule",
     "constant",
     "from_phases",
+    "mixed_prompt_lengths",
     "ramp_flash_crowd_drain",
 ]
 
@@ -144,6 +146,61 @@ def constant(rate_rps: float, duration_s: float, *, seed: int = 0,
              name: str = "load") -> Schedule:
     """Seeded Poisson arrivals at a constant mean rate."""
     return from_phases([Phase(name, duration_s, rate_rps)], seed=seed)
+
+
+class PromptMix:
+    """A seeded, bit-reproducible mixed long/short prompt-length stream.
+
+    Serving benches that exercise long-context admission (``bench-longctx``)
+    and the fleet replay (``bench-fleet``) must offer the SAME prompt-length
+    sequence on every run, or a p99 gate failure is noise. The mix is a
+    Bernoulli(``long_fraction``) choice between a short and a long length
+    range, each sampled uniformly inclusive — all draws from one
+    ``random.Random(seed)`` stream, so same seed ⇒ bit-identical lengths,
+    forever. Token VALUES are derived per prompt from the same stream, so a
+    full prompt corpus replays identically too.
+    """
+
+    def __init__(self, *, short_lens: Tuple[int, int] = (4, 24),
+                 long_lens: Tuple[int, int] = (96, 224),
+                 long_fraction: float = 0.2, vocab: int = 255,
+                 seed: int = 0):
+        if not 0.0 <= long_fraction <= 1.0:
+            raise ValueError(f"long_fraction must be in [0, 1], got {long_fraction}")
+        for name, (lo, hi) in (("short_lens", short_lens), ("long_lens", long_lens)):
+            if not 1 <= lo <= hi:
+                raise ValueError(f"{name} must satisfy 1 <= lo <= hi, got {(lo, hi)}")
+        self.short_lens = (int(short_lens[0]), int(short_lens[1]))
+        self.long_lens = (int(long_lens[0]), int(long_lens[1]))
+        self.long_fraction = float(long_fraction)
+        self.vocab = int(vocab)
+        self.seed = int(seed)
+        self._rng = random.Random(seed)
+
+    def next_length(self) -> Tuple[int, str]:
+        """One draw: ``(prompt_len, kind)`` with kind "long" | "short"."""
+        if self._rng.random() < self.long_fraction:
+            lo, hi = self.long_lens
+            return self._rng.randint(lo, hi), "long"
+        lo, hi = self.short_lens
+        return self._rng.randint(lo, hi), "short"
+
+    def next_prompt(self) -> Tuple[List[int], str]:
+        """One draw: ``(token_ids, kind)`` — ids in ``[1, vocab]`` (0 is
+        conventionally the pad id, never offered)."""
+        n, kind = self.next_length()
+        return [self._rng.randint(1, self.vocab) for _ in range(n)], kind
+
+    def reset(self) -> None:
+        """Rewind to the first draw (replay the identical stream)."""
+        self._rng = random.Random(self.seed)
+
+
+def mixed_prompt_lengths(n: int, *, seed: int = 0, **mix_kwargs) -> List[Tuple[int, str]]:
+    """The first ``n`` ``(prompt_len, kind)`` draws of a :class:`PromptMix`
+    — the convenience form benches log next to their gate numbers."""
+    mix = PromptMix(seed=seed, **mix_kwargs)
+    return [mix.next_length() for _ in range(n)]
 
 
 def ramp_flash_crowd_drain(

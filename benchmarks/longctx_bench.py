@@ -1,0 +1,325 @@
+"""Long-context serving bench: chunked prefill + host-RAM KV spill tier.
+
+Exercises the long-context path (docs/serving.md "Long-context serving")
+end to end against the real compiled engine on a tiny llama, in three
+phases:
+
+- **admit** (dense + paged) — a prompt ``LCX_LONG_X`` (default 4) times the
+  engine's single-shot prompt bucket is admitted through chunked prefill
+  (``prefill_chunk = bucket``) alongside short co-resident requests, and
+  its greedy f32 output must be **bitwise identical** to a single-shot
+  prefill of the same prompt on a wide-bucket reference engine. The same
+  config without ``prefill_chunk`` must *reject* the prompt — the bucket
+  really was the old admission limit. Compiled program FAMILIES must stay
+  within the G004 ceiling (<= 3): chunked prefill rides the
+  ``prefill_insert`` family, it does not add one.
+- **decode_p99** — the same seeded :class:`benchmarks.loadgen.PromptMix`
+  short workload is decoded twice through one server: alone, and with a
+  long prompt chunk-prefilling co-resident. Per-request decode latency
+  (time per output token) p99 must stay <= ``LCX_P99_TOL`` (default 1.10)
+  of the short-only run — chunked prefill steals bounded time per tick,
+  it does not starve decode.
+- **crossover** — a prefix-length ladder where each prefix is cached,
+  churned out of the device pool, then re-admitted: once via the pinned
+  host-RAM spill tier (restore plan), once on an identical engine with the
+  tier disabled (full chunked recompute). Both paths must stay bitwise
+  identical to the first run; restore must beat recompute at the top of
+  the ladder, and the measured crossover length (smallest prefix where
+  restore wins) is reported in the gate JSON — that number is the sizing
+  guidance docs/serving.md quotes, measured not asserted.
+
+Prints one JSON line per phase plus a gate line. ``--gate`` (also reached
+via ``bench.py --longctx-gate`` / ``make bench-longctx``) turns the
+acceptance criteria into a nonzero exit.
+"""
+
+from __future__ import annotations
+
+import os
+import sys as _sys
+
+_sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))  # runnable as `python benchmarks/x.py`
+
+import json
+import time
+
+import numpy as np
+
+SLOTS = int(os.environ.get("LCX_SLOTS", "8"))
+MAX_LEN = int(os.environ.get("LCX_MAX_LEN", "160"))
+BUCKET = int(os.environ.get("LCX_BUCKET", "16"))
+LONG_X = int(os.environ.get("LCX_LONG_X", "4"))
+DECODE_BUDGET = int(os.environ.get("LCX_DECODE_BUDGET", "96"))
+N_SHORTS = int(os.environ.get("LCX_SHORTS", "6"))
+P99_TOL = float(os.environ.get("LCX_P99_TOL", "1.10"))
+REPS = int(os.environ.get("LCX_REPS", "3"))
+LADDER = tuple(
+    int(x) for x in os.environ.get("LCX_LADDER", "24,48,96,144").split(",")
+)
+KV_BLOCK = int(os.environ.get("LCX_KV_BLOCK", "8"))
+POOL_BLOCKS = int(os.environ.get("LCX_POOL_BLOCKS", "20"))
+TIER_MB = int(os.environ.get("LCX_TIER_MB", "64"))
+SEED = int(os.environ.get("LCX_SEED", "0"))
+
+LONG_LEN = LONG_X * BUCKET
+
+
+def _p(values, q):
+    if not values:
+        return None
+    s = sorted(values)
+    return s[min(len(s) - 1, int(q * len(s)))]
+
+
+def _mix_prompts():
+    """The shared seeded profile: short co-resident decodes + one long
+    prompt, all drawn from :class:`benchmarks.loadgen.PromptMix` streams
+    so every run (and the fleet replay) offers bit-identical traffic."""
+    from benchmarks.loadgen import PromptMix
+
+    shorts_mix = PromptMix(short_lens=(4, 12), long_fraction=0.0, seed=SEED + 7)
+    shorts = [shorts_mix.next_prompt()[0] for _ in range(N_SHORTS)]
+    long_mix = PromptMix(long_lens=(LONG_LEN, LONG_LEN), long_fraction=1.0,
+                         seed=SEED + 8)
+    long_prompt = long_mix.next_prompt()[0]
+    return shorts, long_prompt
+
+
+def _drain_outputs(eng, reqs):
+    """Insert every (prompt, budget) pair, drain, return bitwise rows."""
+    occs = [
+        eng.insert(list(p), max_new_tokens=b, pad_token_id=0) for p, b in reqs
+    ]
+    eng.drain()
+    return [np.asarray(o.output_row()) for o in occs]
+
+
+def _admit_phase(model, kv_cache):
+    """Long prompt through chunked prefill vs single-shot reference."""
+    from accelerate_tpu.engine import ContinuousBatchingEngine
+
+    shorts, long_prompt = _mix_prompts()
+    reqs = [(long_prompt, 8)] + [(s, 8) for s in shorts[:2]]
+    paged = dict(kv_cache="paged", block_size=KV_BLOCK) if kv_cache == "paged" else {}
+
+    chunked = ContinuousBatchingEngine(
+        model, slots=4, max_len=MAX_LEN, prompt_bucket=BUCKET,
+        readback_lag=2, prefill_chunk=BUCKET, **paged,
+    )
+    out_chunked = _drain_outputs(chunked, reqs)
+    st = chunked.stats()
+
+    reference = ContinuousBatchingEngine(
+        model, slots=4, max_len=MAX_LEN, prompt_bucket=LONG_LEN,
+        readback_lag=2, **paged,
+    )
+    out_ref = _drain_outputs(reference, reqs)
+
+    # the old admission limit: same config minus prefill_chunk must reject
+    rejected = False
+    try:
+        ContinuousBatchingEngine(
+            model, slots=4, max_len=MAX_LEN, prompt_bucket=BUCKET,
+            readback_lag=2, **paged,
+        ).validate_request(len(long_prompt), 8)
+    except ValueError:
+        rejected = True
+
+    parity = all(np.array_equal(a, b) for a, b in zip(out_chunked, out_ref))
+    row = {
+        "phase": f"longctx_admit_{kv_cache}",
+        "long_prompt_len": len(long_prompt),
+        "prompt_bucket": BUCKET,
+        "long_over_bucket_x": len(long_prompt) / BUCKET,
+        "prefill_chunks": st["prefill_chunks"],
+        "programs": st["programs"],
+        "program_families": len(st["programs"]),
+        "greedy_parity_vs_single_shot": parity,
+        "unchunked_engine_rejects": rejected,
+    }
+    print(json.dumps(row), flush=True)
+    return row
+
+
+def _decode_p99_phase(model):
+    """Short-workload decode p99 with vs without a co-resident long
+    chunked prefill, through a real InferenceServer."""
+    from accelerate_tpu.serving import InferenceServer
+    from accelerate_tpu.utils.dataclasses import ServingConfig
+
+    shorts, long_prompt = _mix_prompts()
+    cfg = ServingConfig(
+        mode="continuous", engine_slots=SLOTS, engine_max_len=MAX_LEN,
+        engine_prompt_bucket=BUCKET, engine_readback_lag=2,
+        engine_prefill_chunk=BUCKET, max_queue=64, drain_timeout_s=120.0,
+    )
+
+    def one_run(srv, with_long):
+        long_fut = None
+        if with_long:
+            long_fut = srv.submit(long_prompt, max_new_tokens=8, pad_token_id=0)
+        futs = [
+            srv.submit(p, max_new_tokens=DECODE_BUDGET, pad_token_id=0)
+            for p in shorts
+        ]
+        results = [f.result(timeout=120) for f in futs]
+        if long_fut is not None:
+            long_fut.result(timeout=120)
+        tpots = []
+        for r in results:
+            ttft = r.ttft_s if r.ttft_s is not None else r.latency_s
+            tpots.append((r.latency_s - ttft) / max(1, DECODE_BUDGET - 1))
+        return _p(tpots, 0.99)
+
+    with InferenceServer(model, cfg) as srv:
+        one_run(srv, True)  # compile both paths before any timing
+        one_run(srv, False)
+        # interleave reps so clock drift hits both scenarios equally
+        base, mixed = [], []
+        for _ in range(REPS):
+            base.append(one_run(srv, False))
+            mixed.append(one_run(srv, True))
+        stats = srv._engine.stats()  # noqa: SLF001
+
+    ratio = min(mixed) / max(min(base), 1e-9)
+    row = {
+        "phase": "longctx_decode_p99",
+        "shorts": len(shorts),
+        "decode_budget": DECODE_BUDGET,
+        "tpot_p99_short_only_s": round(min(base), 6),
+        "tpot_p99_coresident_s": round(min(mixed), 6),
+        "ratio": round(ratio, 4),
+        "tolerance": P99_TOL,
+        "prefill_chunks": stats["prefill_chunks"],
+    }
+    print(json.dumps(row), flush=True)
+    return row
+
+
+def _crossover_phase(model):
+    """Host-tier restore vs full chunked recompute over a prefix ladder."""
+    from accelerate_tpu.engine import ContinuousBatchingEngine
+
+    def make(host_tier):
+        return ContinuousBatchingEngine(
+            model, slots=2, max_len=MAX_LEN, prompt_bucket=BUCKET,
+            readback_lag=2, kv_cache="paged", block_size=KV_BLOCK,
+            pool_blocks=POOL_BLOCKS, prefill_chunk=BUCKET,
+            host_tier_bytes=(TIER_MB << 20) if host_tier else 0,
+        )
+
+    def measure(eng, prefix_len, seed):
+        """Cache the prefix, churn it out of the device pool, then time
+        the re-admission (insert + drain). Bitwise parity with the first
+        run is asserted every rep — a fast-but-wrong restore is a bug,
+        not a bench win."""
+        prompt = np.random.default_rng(seed).integers(
+            1, 255, size=prefix_len).tolist()
+        occ = eng.insert(prompt, max_new_tokens=2, pad_token_id=0)
+        eng.drain()
+        ref = list(occ.tokens)
+        walls = []
+        for rep in range(REPS):
+            for s in range(10):
+                churn = np.random.default_rng(
+                    100_000 + seed * 1_000 + rep * 100 + s
+                ).integers(1, 255, size=30).tolist()
+                eng.insert(churn, max_new_tokens=2, pad_token_id=0)
+                eng.drain()
+            if eng._backend.host_tier is not None:  # noqa: SLF001
+                eng._backend.spill_flush()  # noqa: SLF001
+            t0 = time.perf_counter()
+            occ2 = eng.insert(prompt, max_new_tokens=2, pad_token_id=0)
+            eng.drain()
+            walls.append(time.perf_counter() - t0)
+            if list(occ2.tokens) != ref:
+                raise AssertionError(
+                    f"re-admission changed output at prefix_len={prefix_len}"
+                )
+        return min(walls)
+
+    restore_eng = make(True)
+    recompute_eng = make(False)
+    ladder_rows = []
+    for prefix_len in LADDER:
+        restore_s = measure(restore_eng, prefix_len, prefix_len)
+        recompute_s = measure(recompute_eng, prefix_len, prefix_len)
+        ladder_rows.append({
+            "prefix_len": prefix_len,
+            "restore_s": round(restore_s, 5),
+            "recompute_s": round(recompute_s, 5),
+            "restore_wins": restore_s < recompute_s,
+        })
+    crossover = next(
+        (r["prefix_len"] for r in ladder_rows if r["restore_wins"]), None
+    )
+    st = restore_eng.stats()
+    kv = st["kv"]
+    row = {
+        "phase": "longctx_crossover",
+        "ladder": ladder_rows,
+        "crossover_prefix_len": crossover,
+        "kv_restores": st["kv_restores"],
+        "host_tier_blocks": kv.get("host_tier_blocks", 0),
+        "spill_blocks": kv.get("spill_blocks", 0),
+        "restore_hits": kv.get("restore_hits", 0),
+        "restore_bytes": kv.get("restore_bytes", 0),
+    }
+    print(json.dumps(row), flush=True)
+    return row
+
+
+def main(gate: bool = False) -> int:
+    import jax.numpy as jnp
+
+    from accelerate_tpu.models.llama import LlamaConfig, create_llama
+
+    model = create_llama(LlamaConfig.tiny(compute_dtype=jnp.float32), seed=0)
+    print(json.dumps({
+        "phase": "setup", "long_prompt_len": LONG_LEN,
+        "prompt_bucket": BUCKET, "ladder": list(LADDER),
+    }), flush=True)
+
+    admit_dense = _admit_phase(model, "dense")
+    admit_paged = _admit_phase(model, "paged")
+    p99 = _decode_p99_phase(model)
+    cross = _crossover_phase(model)
+
+    top = cross["ladder"][-1]
+    checks = {
+        "long_admitted_4x": admit_dense["long_over_bucket_x"] >= LONG_X,
+        "dense_parity_bitwise": admit_dense["greedy_parity_vs_single_shot"],
+        "paged_parity_bitwise": admit_paged["greedy_parity_vs_single_shot"],
+        "bucket_was_the_limit": (
+            admit_dense["unchunked_engine_rejects"]
+            and admit_paged["unchunked_engine_rejects"]
+        ),
+        "program_families_le_3": max(
+            admit_dense["program_families"], admit_paged["program_families"]
+        ) <= 3,
+        "decode_p99_within_tol": p99["ratio"] <= P99_TOL,
+        "restore_used": cross["restore_hits"] > 0,
+        "restore_beats_recompute_at_top": top["restore_wins"],
+        "crossover_measured": cross["crossover_prefix_len"] is not None,
+    }
+    ok = all(checks.values())
+    print(json.dumps({
+        "metric": "longctx_gate",
+        "long_prompt_len": LONG_LEN,
+        "prompt_bucket": BUCKET,
+        "decode_p99_ratio": p99["ratio"],
+        "decode_p99_tolerance": P99_TOL,
+        "crossover_prefix_len": cross["crossover_prefix_len"],
+        "restore_vs_recompute_at_top": {
+            "prefix_len": top["prefix_len"],
+            "restore_s": top["restore_s"],
+            "recompute_s": top["recompute_s"],
+        },
+        "checks": checks,
+        "pass": ok,
+    }), flush=True)
+    return 0 if (ok or not gate) else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(gate="--gate" in _sys.argv))

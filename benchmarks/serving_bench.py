@@ -305,6 +305,15 @@ FLEET_OFFERED_X = float(os.environ.get("SB_FLEET_OFFERED_X", "2.5"))
 FLEET_GATE_SCALE = float(os.environ.get("SB_FLEET_GATE_SCALE", "1.8"))
 FLEET_TTFT_TOL = float(os.environ.get("SB_FLEET_TTFT_TOL", "1.10"))
 FLEET_SEED = int(os.environ.get("SB_FLEET_SEED", "0"))
+# mixed long/short prompt profile for the fleet replay — the same seeded
+# loadgen.PromptMix the long-context bench draws from, so both benches
+# offer bit-identical length sequences run over run. The two-point length
+# ranges keep the static batcher's group keys bounded (group key includes
+# exact prompt length): the mix stresses mixed-length scheduling without
+# dissolving every batch into singletons.
+FLEET_MIX_LONG_FRAC = float(os.environ.get("SB_FLEET_MIX_LONG_FRAC", "0.2"))
+FLEET_MIX_SHORT_LEN = int(os.environ.get("SB_FLEET_MIX_SHORT_LEN", "8"))
+FLEET_MIX_LONG_LEN = int(os.environ.get("SB_FLEET_MIX_LONG_LEN", "32"))
 
 
 class _KillableEngine(_SyntheticEngine):
@@ -476,7 +485,13 @@ def _run_fleet_phase(router, name, rate_rps, duration_s, deadline_s=None,
     if schedule is None:
         schedule = loadgen.constant(rate_rps, duration_s, seed=FLEET_SEED,
                                     name=name)
+    mix = loadgen.PromptMix(
+        short_lens=(FLEET_MIX_SHORT_LEN, FLEET_MIX_SHORT_LEN),
+        long_lens=(FLEET_MIX_LONG_LEN, FLEET_MIX_LONG_LEN),
+        long_fraction=FLEET_MIX_LONG_FRAC, seed=FLEET_SEED,
+    )
     futures = []
+    mix_counts = {"short": 0, "long": 0}
     start = time.perf_counter()
     fired_mid = mid_phase is None
     i = 0
@@ -491,8 +506,11 @@ def _run_fleet_phase(router, name, rate_rps, duration_s, deadline_s=None,
                 break
             time.sleep(min(lag, 0.01))
         i += 1
+        prompt, kind = mix.next_prompt()
+        mix_counts[kind] += 1
         futures.append(
-            router.submit(PROMPT, max_new_tokens=4, deadline_s=deadline_s)
+            router.submit(np.asarray(prompt, np.int32), max_new_tokens=4,
+                          deadline_s=deadline_s)
         )
     if not fired_mid:  # schedule ended before midpoint (shouldn't happen)
         mid_phase()
@@ -520,6 +538,7 @@ def _run_fleet_phase(router, name, rate_rps, duration_s, deadline_s=None,
     elapsed = time.perf_counter() - start
     row = {
         "phase": name,
+        "prompt_mix": mix_counts,
         "offered_rps": round(i / elapsed, 1),
         "goodput_rps": round(completed / elapsed, 1),
         "shed": shed,

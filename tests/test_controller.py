@@ -1,8 +1,8 @@
 """Closed-loop SLO controller suite (docs/control_plane.md):
 
-* ladder — escalation walks the rung order (spec → degrade → admission →
-  hedge → scale), relax restores every knob to its saved baseline and
-  drains controller-added replicas first;
+* ladder — escalation walks the rung order (spec → longctx → degrade →
+  admission → hedge → scale), relax restores every knob to its saved
+  baseline and drains controller-added replicas first;
 * hysteresis — load oscillating inside the dead band produces ZERO
   actuations; per-knob cooldowns and the token bucket each bound the
   actuation rate independently;
@@ -49,6 +49,22 @@ class FakeSpecEngine:
 
     def set_spec_draft_limit(self, n):
         self.limits.append(n)
+
+
+class FakeChunkEngine:
+    """Engine surface the longctx rung drives: a chunked-prefill schedule
+    clamp (host-side operand, no recompile)."""
+
+    spec = None  # the spec rung skips us
+    prefill_chunk = 16
+
+    def __init__(self, limit=4):
+        self.prefill_chunk_limit = limit
+        self.limits = []
+
+    def set_prefill_chunk_limit(self, n):
+        self.prefill_chunk_limit = max(0, int(n))
+        self.limits.append(self.prefill_chunk_limit)
 
 
 class FakeServer:
@@ -152,6 +168,34 @@ def test_escalates_rungs_in_order_then_scales():
     assert router.config.hedge_deadline_fraction is None
     tick()
     assert router.scaled == [("up", "ctl-1")]  # ladder exhausted -> scale
+
+
+def test_longctx_rung_halves_chunk_schedule_then_relaxes():
+    # r0 runs a healthy schedule (4 chunks/tick), r1 is already clamped to
+    # 1 — engaging the rung halves both (4 -> 2; 1 -> 0, a full pause:
+    # admitted long prompts hold their slots but stop burning ticks), and
+    # relax restores each engine's own baseline
+    clock = {"t": 100.0}
+    router = FakeRouter(clock=lambda: clock["t"])
+    engines = {"r0": FakeChunkEngine(limit=4), "r1": FakeChunkEngine(limit=1)}
+    for rid, srv in router._servers.items():
+        srv.engine = engines[rid]
+    ctl, router, tick = make(router=router)
+    router.depth = int(0.9 * QUEUE_CAP)
+    tick()
+    # no spec engines anywhere: longctx is the first applicable rung
+    assert ctl.engaged_rungs() == ["longctx"]
+    assert engines["r0"].prefill_chunk_limit == 2
+    assert engines["r1"].prefill_chunk_limit == 0
+    for _ in range(3):
+        tick()
+    assert ctl.engaged_rungs() == ["longctx", "degrade", "admission", "hedge"]
+    router.depth = 0
+    for _ in range(10):
+        tick()
+    assert ctl.engaged_rungs() == []
+    assert engines["r0"].prefill_chunk_limit == 4
+    assert engines["r1"].prefill_chunk_limit == 1
 
 
 def test_relax_restores_baseline_and_drains_added_replicas_first():

@@ -1,0 +1,447 @@
+"""Long-context serving suite (docs/serving.md "Long-context serving"):
+
+* chunked-prefill admission — a prompt several times the single-shot
+  prompt bucket drains to a **bitwise** greedy match of static
+  ``generate()`` (dense AND paged), with short decodes co-resident the
+  whole time (the deferred-readback ring must mask PREFILLING slots at
+  snapshot time, or a masked pad row falsely retires the long request);
+* the compiled-program budget — chunked prefill rides the
+  ``prefill_insert`` family (new signatures, no new family), so a chunked
+  engine stays within the G004 family ceiling;
+* degradation-ladder hooks — ``set_prefill_chunk_limit(0)`` freezes chunk
+  progress without wedging decode, and a mid-prefill ``cancel()`` frees
+  the slot and the chunk queue;
+* :class:`benchmarks.loadgen.PromptMix` — the seeded mixed-length profile
+  shared by ``bench-longctx`` and the ``bench-fleet`` replay must be
+  bit-reproducible (same seed ⇒ identical corpus, forever);
+* the host-RAM KV spill tier — eviction of a registered prefix block
+  *spills* its exact device bytes instead of freeing them; a restore is
+  bitwise in f32 and byte-identical quantized payload in int8 (so the
+  dequantized error vs the pre-quantization values stays within the
+  committed 4.0e-3·amax bound); the PR 9 partial-prefix re-registration
+  sequence holds with the spill hook armed; and a crash at the
+  ``kvcache.spill_mid`` kill point loses at most a cache win — never
+  device-pool integrity (docs/fault_tolerance.md);
+* the ``ServingConfig`` validation surface and the serving exporter
+  gauges (``serving/kv_host_tier_*``, ``serving/prefill_chunks_pending``).
+
+Engines compile a handful of programs each, so tests share per-shape
+engines via a module-scoped cache (``reset()`` restores a pristine pool;
+the host tier intentionally SURVIVES reset — content-addressed keys stay
+valid — so tier tests clear it explicitly and assert on counter deltas).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from accelerate_tpu.engine import ContinuousBatchingEngine
+from accelerate_tpu.inference import generate
+from accelerate_tpu.kvcache import (
+    PagedBlockPool,
+    kv_dequantize,
+    kv_quantize,
+)
+from accelerate_tpu.models.llama import LlamaConfig, create_llama
+from accelerate_tpu.serving import InferenceServer
+from accelerate_tpu.utils.dataclasses import ServingConfig
+
+from benchmarks.loadgen import PromptMix, mixed_prompt_lengths
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = LlamaConfig.tiny(compute_dtype=jnp.float32)
+    return create_llama(cfg, seed=0)
+
+
+_ENGINES: dict = {}
+
+# the tier engines' shared shape: small pool so a handful of churn rounds
+# forces registered-block eviction (and therefore spills)
+_TIER_SHAPE = dict(slots=2, max_len=64, prompt_bucket=16, readback_lag=2,
+                   kv_cache="paged", block_size=8, pool_blocks=8,
+                   prefill_chunk=16, host_tier_bytes=8 << 20)
+
+
+@pytest.fixture
+def get_engine(model):
+    """Engine per shape, cached across the module so each config pays its
+    compiles once; reset (and chunk-limit restored) before handout."""
+
+    def _get(slots=4, max_len=96, prompt_bucket=16, readback_lag=2,
+             kv_cache="dense", block_size=8, pool_blocks=None,
+             prefill_chunk=16, host_tier_bytes=0):
+        key = (slots, max_len, prompt_bucket, readback_lag, kv_cache,
+               block_size, pool_blocks, prefill_chunk, host_tier_bytes)
+        eng = _ENGINES.get(key)
+        if eng is None:
+            paged = {}
+            if kv_cache != "dense":
+                paged = dict(kv_cache=kv_cache, block_size=block_size,
+                             pool_blocks=pool_blocks)
+            eng = _ENGINES[key] = ContinuousBatchingEngine(
+                model, slots=slots, max_len=max_len,
+                prompt_bucket=prompt_bucket, readback_lag=readback_lag,
+                prefill_chunk=prefill_chunk,
+                host_tier_bytes=host_tier_bytes, **paged,
+            )
+        eng.reset()
+        eng.set_prefill_chunk_limit(1)  # a paused ladder must not leak
+        return eng
+
+    return _get
+
+
+def _long_prompt(n=64, seed=3):
+    rng = np.random.default_rng(seed)
+    return rng.integers(1, 255, size=n).tolist()
+
+
+def _shorts(n=2, lens=(5, 11), seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, 255, size=lens[i % len(lens)]).tolist()
+            for i in range(n)]
+
+
+def _ref(model, prompt, budget):
+    out = generate(
+        model, jnp.asarray([prompt], jnp.int32), max_new_tokens=budget,
+        pad_token_id=0,
+    )
+    return np.asarray(out)[0]
+
+
+def _registry_inverse_ok(pool):
+    return {k: b for b, k in pool._key_of.items()} == dict(pool._registry)
+
+
+def _block_key(prompt, depth, block_size=8):
+    return np.asarray(
+        prompt[: (depth + 1) * block_size], np.int32
+    ).tobytes()
+
+
+def _churn(eng, rounds, seed, length=12):
+    """Distinct short prompts that cycle the pool's free list and evict
+    (→ spill) the LRU cached prefix blocks."""
+    for i in range(rounds):
+        p = np.random.default_rng(9_000 + seed * 100 + i).integers(
+            1, 255, size=length).tolist()
+        eng.insert(p, max_new_tokens=2, pad_token_id=0)
+        eng.drain()
+
+
+# ------------------------------------------------------- chunked admission
+def test_unchunked_engine_rejects_past_bucket(model):
+    # the prompt bucket really is the admission limit without chunking —
+    # and the ValueError names the knob that lifts it
+    eng = ContinuousBatchingEngine(
+        model, slots=2, max_len=96, prompt_bucket=16, readback_lag=2,
+    )
+    with pytest.raises(ValueError, match="engine_prefill_chunk"):
+        eng.validate_request(64, 8)
+
+
+@pytest.mark.parametrize("kv_cache", ["dense", "paged"])
+def test_chunked_prefill_bitwise_parity_with_coresident_decodes(
+    model, get_engine, kv_cache
+):
+    # the regression the ring-snapshot bug taught: the long prompt must
+    # survive decode programs dispatched WHILE it is still prefilling (its
+    # masked pad row must never be absorbed as a real token)
+    eng = get_engine(kv_cache=kv_cache)
+    long = _long_prompt(64)
+    reqs = [(long, 8)] + [(s, 8) for s in _shorts()]
+    occs = [eng.insert(p, max_new_tokens=b, pad_token_id=0) for p, b in reqs]
+    eng.drain()
+    for occ, (p, b) in zip(occs, reqs):
+        np.testing.assert_array_equal(occ.output_row(), _ref(model, p, b))
+    st = eng.stats()
+    assert st["prefill_chunks"] >= 3  # 64-token prompt, 16-wide chunks
+    # chunked prefill adds SIGNATURES to prefill_insert, not a new family
+    assert len(st["programs"]) <= 3
+    assert ("chunk", 16) in eng._programs["prefill_insert"]
+
+
+def test_chunk_limit_zero_pauses_progress_then_resumes(model, get_engine):
+    eng = get_engine(kv_cache="dense")
+    long = _long_prompt(64, seed=5)
+    occ = eng.insert(long, max_new_tokens=6, pad_token_id=0)
+    eng.set_prefill_chunk_limit(0)
+    pending = eng.prefill_chunks_pending()
+    assert pending > 0 and occ.prefilling
+    for _ in range(4):  # decode keeps ticking; chunk progress is frozen
+        eng.step()
+        eng.poll()
+    assert eng.prefill_chunks_pending() == pending and occ.prefilling
+    eng.set_prefill_chunk_limit(2)
+    eng.drain()
+    np.testing.assert_array_equal(occ.output_row(), _ref(model, long, 6))
+
+
+def test_cancel_mid_prefill_frees_slot_and_chunk_queue(model, get_engine):
+    eng = get_engine(kv_cache="dense")
+    free0 = len(eng._free)
+    eng.set_prefill_chunk_limit(0)
+    occ = eng.insert(_long_prompt(64, seed=6), max_new_tokens=6,
+                     pad_token_id=0)
+    assert eng.prefill_chunks_pending() > 0
+    eng.cancel(occ)
+    assert occ.finished and not occ.prefilling
+    assert eng.prefill_chunks_pending() == 0
+    assert eng.live_count() == 0 and len(eng._free) == free0
+    # the freed slot admits and completes a fresh request
+    eng.set_prefill_chunk_limit(1)
+    short = _shorts(1)[0]
+    occ2 = eng.insert(short, max_new_tokens=4, pad_token_id=0)
+    eng.drain()
+    np.testing.assert_array_equal(occ2.output_row(), _ref(model, short, 4))
+
+
+# --------------------------------------------------- seeded prompt profile
+def test_promptmix_is_bit_reproducible():
+    kw = dict(short_lens=(4, 12), long_lens=(48, 64), long_fraction=0.3)
+    a = PromptMix(seed=11, **kw)
+    b = PromptMix(seed=11, **kw)
+    draws_a = [a.next_prompt() for _ in range(40)]
+    assert draws_a == [b.next_prompt() for _ in range(40)]
+    a.reset()  # rewind replays the identical corpus
+    assert draws_a == [a.next_prompt() for _ in range(40)]
+    # lengths helper is the same stream viewed through next_length()
+    c = PromptMix(seed=11, **kw)
+    lens = [c.next_length() for _ in range(40)]
+    assert mixed_prompt_lengths(40, seed=11, **kw) == lens
+    assert [(len(p), kind) for p, kind in draws_a] != lens  # values consumed
+    # a different seed must actually change the stream
+    assert [PromptMix(seed=12, **kw).next_prompt() for _ in range(40)] != draws_a
+    for p, _kind in draws_a:
+        assert p and all(1 <= t <= 255 for t in p)  # 0 (pad) never offered
+
+
+def test_promptmix_validation():
+    with pytest.raises(ValueError, match="long_fraction"):
+        PromptMix(long_fraction=1.5)
+    with pytest.raises(ValueError, match="short_lens"):
+        PromptMix(short_lens=(0, 4))
+    with pytest.raises(ValueError, match="long_lens"):
+        PromptMix(long_lens=(9, 3))
+
+
+# ----------------------------------------------------- host-RAM spill tier
+def test_eviction_spills_registered_blocks_instead_of_freeing(
+    model, get_engine
+):
+    eng = get_engine(**_TIER_SHAPE)
+    tier = eng._backend.host_tier
+    tier.clear()
+    spilled0 = tier.stats()["spill_blocks"]
+    prompt = _long_prompt(16, seed=21)  # bucket-sized: 2 registered blocks
+    eng.insert(prompt, max_new_tokens=2, pad_token_id=0)
+    eng.drain()
+    key0 = _block_key(prompt, 0)
+    blk = eng._backend.pool._registry[key0]
+    dev_k = np.asarray(eng._donated["cache"]["k"][:, blk])
+    _churn(eng, rounds=6, seed=1)
+    eng._backend.spill_flush()
+    st = tier.stats()
+    assert st["spill_blocks"] - spilled0 > 0
+    assert st["host_tier_bytes"] == len(tier) * tier.block_bytes > 0
+    # the spilled payload is the victim's exact device bytes
+    payload = tier.lookup(key0)
+    assert payload is not None
+    np.testing.assert_array_equal(payload["k"], dev_k)
+    # the device pool kept its registry/alias inverse through the spills
+    assert _registry_inverse_ok(eng._backend.pool)
+
+
+def test_host_restore_is_bitwise_f32(model, get_engine):
+    eng = get_engine(**_TIER_SHAPE)
+    tier = eng._backend.host_tier
+    tier.clear()
+    prompt = _long_prompt(40, seed=22)  # 5 full blocks, chunked admission
+    occ = eng.insert(prompt, max_new_tokens=4, pad_token_id=0)
+    eng.drain()
+    first = occ.output_row()
+    key0 = _block_key(prompt, 0)
+    blk = eng._backend.pool._registry[key0]
+    dev_k = np.asarray(eng._donated["cache"]["k"][:, blk])
+    dev_v = np.asarray(eng._donated["cache"]["v"][:, blk])
+    _churn(eng, rounds=8, seed=2)
+    eng._backend.spill_flush()
+    assert eng._backend.pool._shared_prefix(np.asarray(prompt, np.int32)) == []
+    restores0 = eng.kv_restores
+    hits0 = tier.stats()["restore_hits"]
+    occ2 = eng.insert(prompt, max_new_tokens=4, pad_token_id=0)
+    eng.drain()
+    assert eng.kv_restores - restores0 == 1  # one batched scatter program
+    assert tier.stats()["restore_hits"] - hits0 >= 4
+    # restored bytes == the original device bytes, and the output rides
+    # them to a bitwise-identical greedy row
+    blk2 = eng._backend.pool._registry[key0]
+    np.testing.assert_array_equal(
+        np.asarray(eng._donated["cache"]["k"][:, blk2]), dev_k)
+    np.testing.assert_array_equal(
+        np.asarray(eng._donated["cache"]["v"][:, blk2]), dev_v)
+    np.testing.assert_array_equal(occ2.output_row(), first)
+    np.testing.assert_array_equal(first, _ref(model, prompt, 4))
+
+
+def test_host_restore_int8_payload_identity_and_bound(model, get_engine):
+    # the committed int8 bound: dequantize(quantize(x)) stays within
+    # 4.0e-3 * per-position amax — and a tier restore re-installs the
+    # ORIGINAL quantized bytes, so a restored block inherits exactly that
+    # bound (no second quantization error stacks on top)
+    rng = np.random.default_rng(7)
+    x = jnp.asarray(rng.standard_normal((2, 8, 2, 4)), jnp.float32)
+    q, s = kv_quantize(x)
+    err = np.abs(np.asarray(kv_dequantize(q, s, jnp.float32)) - np.asarray(x))
+    amax = np.abs(np.asarray(x)).max(axis=(-1, -2))[..., None, None]
+    assert (err <= 4.0e-3 * amax + 1e-9).all()
+
+    shape = dict(_TIER_SHAPE, kv_cache="paged_int8")
+    eng = get_engine(**shape)
+    tier = eng._backend.host_tier
+    tier.clear()
+    prompt = _long_prompt(40, seed=23)
+    occ = eng.insert(prompt, max_new_tokens=4, pad_token_id=0)
+    eng.drain()
+    first = occ.output_row()
+    key0 = _block_key(prompt, 0)
+    blk = eng._backend.pool._registry[key0]
+    cache = eng._donated["cache"]
+    snap = {w: {p: np.asarray(cache[w][p][:, blk]) for p in ("q", "s")}
+            for w in ("k", "v")}
+    _churn(eng, rounds=8, seed=3)
+    eng._backend.spill_flush()
+    payload = tier.lookup(key0)
+    assert payload is not None
+    occ2 = eng.insert(prompt, max_new_tokens=4, pad_token_id=0)
+    eng.drain()
+    blk2 = eng._backend.pool._registry[key0]
+    cache = eng._donated["cache"]
+    for w in ("k", "v"):
+        for p in ("q", "s"):
+            np.testing.assert_array_equal(payload[w][p], snap[w][p])
+            np.testing.assert_array_equal(
+                np.asarray(cache[w][p][:, blk2]), snap[w][p])
+    # identical bytes ⇒ identical dequantized values ⇒ identical greedy row
+    np.testing.assert_array_equal(occ2.output_row(), first)
+
+
+def test_partial_prefix_reregistration_with_spill_hook_armed():
+    # PR 9 regression, re-run against a TIERED pool: the orphan-supersede
+    # path must free the stale block WITHOUT spilling it (the new block
+    # owns the same content), while genuine LRU evictions of key-owning
+    # blocks all reach the hook — and the registry/alias inverse survives
+    # the whole churn.
+    pool = PagedBlockPool(num_blocks=12, block_size=4, slots=4,
+                          blocks_per_row=4)
+    spilled = []
+    pool.spill_fn = lambda key, blk: spilled.append((key, blk))
+    prefix = np.arange(1, 9, dtype=np.int32)  # 8 tokens -> 2 full blocks
+    pool.acquire(0, prefix, budget=4)
+    pool.release(0)
+    pool.acquire(1, np.array([100], np.int32), budget=11)
+    pool.acquire(2, np.array([101], np.int32), budget=15)
+    pool.acquire(3, np.array([102], np.int32), budget=11)  # evicts depth 0
+    assert [k for k, _ in spilled] == [prefix[:4].tobytes()]
+    assert pool.stats()["blocks_cached"] == 1  # deep sibling orphaned
+    pool.release(1)
+    # repeat of the prefix re-registers both depths; the deep key collides
+    # with the orphan — superseding it must NOT fire the spill hook
+    row, shared = pool.acquire(0, prefix, budget=4)
+    assert shared == 0 and len(spilled) == 1
+    assert pool.stats()["blocks_cached"] == 0
+    assert {k: b for b, k in pool._key_of.items()} == dict(pool._registry)
+    pool.release(0)
+    row2, shared2 = pool.acquire(0, prefix, budget=4)
+    assert shared2 == 2 and (row2[:2] == row[:2]).all()
+    pool.release(0)
+    pool.release(2)
+    pool.release(3)
+    big = np.arange(50, 54, dtype=np.int32)
+    pool.acquire(0, big, budget=12)
+    pool.acquire(1, big + 100, budget=12)
+    pool.acquire(2, big + 200, budget=8)  # drains free, evicts the prefix
+    assert pool._shared_prefix(prefix) == []
+    # every spilled key was a registered full-prefix of `prefix`
+    assert {k for k, _ in spilled} == {
+        prefix[:4].tobytes(), prefix.tobytes(),
+    }
+    assert {k: b for b, k in pool._key_of.items()} == dict(pool._registry)
+
+
+def test_crash_mid_spill_never_corrupts_device_pool(
+    model, get_engine, fault_inject, caplog
+):
+    eng = get_engine(**_TIER_SHAPE)
+    tier = eng._backend.host_tier
+    tier.clear()
+    prompt = _long_prompt(16, seed=24)
+    occ = eng.insert(prompt, max_new_tokens=3, pad_token_id=0)
+    eng.drain()
+    first = occ.output_row()
+    # die at the kill point: the gather upstream was read-only, so a spill
+    # that never lands loses a cache win and nothing else
+    fault_inject("kvcache.spill_mid:raise")
+    _churn(eng, rounds=6, seed=4)
+    eng._backend.spill_flush()  # the worker must survive its own crash
+    # spills were attempted (the worker logged each crash)...
+    assert any("host-tier spill failed" in r.message for r in caplog.records)
+    assert len(tier) == 0  # ...but none landed
+    assert _registry_inverse_ok(eng._backend.pool)
+    # the device pool still serves: re-admission recomputes bitwise
+    occ2 = eng.insert(prompt, max_new_tokens=3, pad_token_id=0)
+    eng.drain()
+    np.testing.assert_array_equal(occ2.output_row(), first)
+
+
+# ------------------------------------------------- config + serving gauges
+def test_serving_config_longctx_validation():
+    base = dict(mode="continuous", engine_slots=2, engine_max_len=64,
+                engine_prompt_bucket=16, engine_readback_lag=2)
+    with pytest.raises(ValueError, match="engine_prefill_chunk must be in"):
+        ServingConfig(**base, engine_prefill_chunk=0)
+    with pytest.raises(ValueError, match="engine_prefill_chunk must be in"):
+        ServingConfig(**base, engine_prefill_chunk=64)
+    with pytest.raises(ValueError, match="requires mode='continuous'"):
+        ServingConfig(mode="static", engine_prefill_chunk=16)
+    with pytest.raises(ValueError, match="kv_host_tier_bytes must be >= 0"):
+        ServingConfig(**base, kv_host_tier_bytes=-1)
+    with pytest.raises(ValueError, match="requires a paged KV cache"):
+        ServingConfig(**base, kv_host_tier_bytes=1 << 20)
+    # the paged combination is the valid long-context surface
+    cfg = ServingConfig(**base, engine_prefill_chunk=16, kv_cache="paged",
+                        engine_block_size=8, kv_host_tier_bytes=1 << 20)
+    assert cfg.kv_prefetch  # prefetch defaults on wherever a tier exists
+
+
+def test_server_longctx_gauges_and_engine_stats(model):
+    cfg = ServingConfig(
+        mode="continuous", engine_slots=2, engine_max_len=64,
+        engine_prompt_bucket=16, engine_readback_lag=2,
+        kv_cache="paged", engine_block_size=8, engine_prefill_chunk=16,
+        kv_host_tier_bytes=8 << 20,
+    )
+    long = _long_prompt(40, seed=25)
+    short = _shorts(1)[0]
+    with InferenceServer(model, cfg) as srv:
+        futs = [srv.submit(long, max_new_tokens=4, pad_token_id=0),
+                srv.submit(short, max_new_tokens=4, pad_token_id=0)]
+        results = [f.result(timeout=120) for f in futs]
+        kv = srv._engine.stats()["kv"]
+        snap = srv.metrics.snapshot()
+    np.testing.assert_array_equal(results[0].tokens, _ref(model, long, 4))
+    np.testing.assert_array_equal(results[1].tokens, _ref(model, short, 4))
+    # engine stats carry the tier economics the exporter re-publishes
+    for k in ("host_tier_bytes", "host_tier_blocks", "spill_bytes",
+              "restore_hits", "restore_bytes", "prefetch_hits"):
+        assert k in kv
+    for g in ("serving/kv_host_tier_bytes", "serving/kv_host_tier_blocks",
+              "serving/kv_restore_hits", "serving/kv_restore_bytes",
+              "serving/kv_spill_bytes", "serving/prefill_chunks_pending"):
+        assert g in snap
+    assert snap["serving/kv_host_tier_bytes"] >= 0
+    assert snap["serving/prefill_chunks_pending"] == 0  # drained

@@ -219,7 +219,14 @@ def test_g503_observe_padding_group_filter():
     assert set(observe_padding()) == {
         f"{g}/{p}" for g in ("engine.dense", "engine.spec", "engine.paged",
                              "engine.paged_pallas")
-        for p in ("prefill_insert", "decode_step")}
+        for p in ("prefill_insert", "decode_step")
+    } | {
+        # the long-context group adds the chunked-prefill program's own
+        # padding row: a chunk is always full except the prompt's last
+        "engine.longctx/prefill_insert",
+        "engine.longctx/prefill_insert.chunk",
+        "engine.longctx/decode_step",
+    }
 
 
 # ---------------------------------------------------------------- G504
