@@ -168,9 +168,13 @@ bench-spec:
 # fleet gate: replica-ramp goodput scaling (>= 1.8x goodput at 2x
 # replicas), kill-one-replica-mid-batch chaos with zero dropped futures
 # (typed errors or completions only, failover observed), and TTFT p99 no
-# worse with prefill/decode disaggregation than without (docs/serving.md)
+# worse with prefill/decode disaggregation than without (docs/serving.md);
+# --cross-replica adds the wire KV-transfer phase: remote prefill over TCP
+# loopback must hold TTFT p99 <= 1.3x the in-process hand-off, with the
+# cross-replica prefix hit rate reported (docs/serving.md "Cross-host
+# disaggregated prefill")
 bench-fleet:
-	$(PY) benchmarks/serving_bench.py --fleet-gate
+	$(PY) benchmarks/serving_bench.py --fleet-gate --cross-replica
 
 # long-context gate: a prompt >= 4x the single-shot prompt bucket admitted
 # via chunked prefill with bitwise greedy parity vs single-shot (dense +

@@ -96,6 +96,7 @@ AUDITED_MODULES = (
     "data_loader.py",
     "tracing.py",
     "controller.py",
+    "kvtransfer.py",
 )
 
 # Modules where G305 applies: the Future-resolution discipline modules.

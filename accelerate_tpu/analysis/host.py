@@ -51,7 +51,7 @@ HOT_MODULES = {"engine.py", "serving.py"}
 # Modules where the fault taxonomy applies (they import/raise it already).
 TYPED_RAISE_MODULES = {
     "engine.py", "serving.py", "kvcache.py", "telemetry.py", "elastic.py",
-    "checkpointing.py", "fleet.py", "controller.py",
+    "checkpointing.py", "fleet.py", "controller.py", "kvtransfer.py",
 }
 
 # Device-value taint seeds: engine/serving state that holds jax Arrays.

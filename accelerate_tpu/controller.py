@@ -113,7 +113,7 @@ class ControlSignals:
     def __init__(self, *, pressure: float, queue_fraction: float,
                  ttft_p99_s: Optional[float], latency_p99_s: Optional[float],
                  breaker_open_fraction: float, kv_utilization: float,
-                 replicas: int):
+                 replicas: int, transfer_failure_fraction: float = 0.0):
         self.pressure = pressure
         self.queue_fraction = queue_fraction
         self.ttft_p99_s = ttft_p99_s
@@ -121,6 +121,9 @@ class ControlSignals:
         self.breaker_open_fraction = breaker_open_fraction
         self.kv_utilization = kv_utilization
         self.replicas = replicas
+        # fraction of this tick's KV transfer attempts that fell back due
+        # to transfer failure/stale fences (0.0 when the wire is idle)
+        self.transfer_failure_fraction = transfer_failure_fraction
 
 
 class SLOController:
@@ -181,7 +184,8 @@ class SLOController:
             clock=clock,
         )
         for name in ("pressure", "rung", "frozen", "replicas",
-                     "queue_fraction", "actuation_budget"):
+                     "queue_fraction", "actuation_budget",
+                     "transfer_failure_fraction"):
             self.metrics.gauge(name, 0.0)
         self._bucket = _TokenBucket(
             self.config.actuation_budget_capacity,
@@ -291,6 +295,9 @@ class SLOController:
         self.metrics.gauge("pressure", sig.pressure)
         self.metrics.gauge("queue_fraction", sig.queue_fraction)
         self.metrics.gauge("replicas", sig.replicas)
+        self.metrics.gauge(
+            "transfer_failure_fraction", sig.transfer_failure_fraction
+        )
         self.metrics.gauge("actuation_budget", self._bucket.available())
         watch = self._watch if self._watch is not None else perfwatch.get_watch()
         if cfg.replace_on_drift:
@@ -398,6 +405,14 @@ class SLOController:
             terms.append(latency / cfg.latency_slo_s)
         # half the fleet's breakers open is unambiguous overload/failure
         terms.append(2.0 * breaker_frac)
+        # KV-transfer health (docs/control_plane.md): requests falling
+        # back to local prefill still COMPLETE, so a dying cross-host
+        # data path is invisible to queue/latency terms until the slower
+        # fallback path backs the queues up — this term escalates on the
+        # failure fraction itself, one tick earlier
+        transfer_frac = self._transfer_failure_fraction(snap)
+        if self.config.transfer_pressure_weight > 0:
+            terms.append(self.config.transfer_pressure_weight * transfer_frac)
         return ControlSignals(
             pressure=max(terms),
             queue_fraction=queue_fraction,
@@ -406,6 +421,7 @@ class SLOController:
             breaker_open_fraction=breaker_frac,
             kv_utilization=kv,
             replicas=len(self.router.replica_ids()),
+            transfer_failure_fraction=transfer_frac,
         )
 
     @staticmethod
@@ -415,6 +431,35 @@ class SLOController:
             if k.endswith(suffix) and isinstance(v, (int, float))
         ]
         return max(vals) if vals else None
+
+    def _transfer_failure_fraction(self, snap: dict) -> float:
+        """This tick's KV-transfer failure fraction: the delta of
+        transfer-caused prefill fallbacks over the delta of transfer
+        attempts (shipped + failed) since the previous tick. 0.0 while
+        the wire is idle — an idle transport is healthy, not failing.
+        Uses the same previous-sample ledger as ``_stream_active``."""
+        failed = sum(
+            v for k, v in snap.items()
+            if isinstance(v, (int, float)) and (
+                k.endswith("prefill_fallback/transfer_failed")
+                or k.endswith("prefill_fallback/stale_epoch")
+            )
+        )
+        shipped = sum(
+            v for k, v in snap.items()
+            if isinstance(v, (int, float)) and k.endswith("/kv_transfers")
+        )
+        prev_f = self._sample_counts.get("kvtx_failed")
+        prev_a = self._sample_counts.get("kvtx_attempts")
+        attempts = shipped + failed
+        self._sample_counts["kvtx_failed"] = failed
+        self._sample_counts["kvtx_attempts"] = attempts
+        if prev_f is None or prev_a is None:
+            return 0.0
+        d_attempts = attempts - prev_a
+        if d_attempts <= 0:
+            return 0.0
+        return max(0.0, (failed - prev_f) / d_attempts)
 
     def _stream_active(self, snap: dict, suffix: str) -> bool:
         """True when the event stream behind a sliding-window percentile

@@ -61,10 +61,11 @@ Scheduling policy — deadlines, backpressure, degradation, drain — lives in
 from __future__ import annotations
 
 import collections
+import threading
 import time
 import warnings
 from dataclasses import dataclass, field
-from typing import Any, Callable, List, Optional
+from typing import Any, Callable, List, Optional, Tuple
 
 import numpy as np
 
@@ -74,7 +75,11 @@ from jax import lax
 
 from . import perfwatch, tracing
 from .logging import get_logger
-from .utils.fault import EngineCapacityError, EngineInvariantError
+from .utils.fault import (
+    EngineCapacityError,
+    EngineInvariantError,
+    TransferStaleEpochError,
+)
 
 logger = get_logger(__name__)
 
@@ -160,6 +165,36 @@ class RemotePrefill:
     engine_config: Any = None
     prompt_bucket: int = 0
     max_len: int = 0
+    # wire-transfer fence (accelerate_tpu.kvtransfer): ``(slot, epoch)``
+    # minted by the receiving engine's ``reserve_slot`` when this prefill
+    # arrived over a transport. ``insert_prefilled`` refuses to commit a
+    # reservation whose epoch the engine has since bumped (the slot was
+    # released/recycled mid-transfer) — TransferStaleEpochError, and the
+    # caller falls back to a local prefill. None for the by-reference
+    # same-process hand-off.
+    reservation: Optional[Tuple[int, int]] = None
+
+    def to_bytes(self) -> bytes:
+        """Versioned wire encoding of this prefill (magic + header + raw
+        leaf bytes) — see :func:`accelerate_tpu.kvtransfer
+        .encode_remote_prefill`. ``from_bytes`` on an engine with the
+        same structural stamp round-trips to a prefill whose
+        ``insert_prefilled`` output is bitwise identical to handing this
+        object over by reference."""
+        from .kvtransfer import encode_remote_prefill
+
+        return encode_remote_prefill(self)
+
+    @classmethod
+    def from_bytes(cls, data: bytes, *, engine=None) -> "RemotePrefill":
+        """Decode a :meth:`to_bytes` payload. ``engine`` (the receiving
+        decode engine) re-binds ``engine_config`` by identity after
+        verifying the structural stamp (prompt bucket / arena length)
+        matches — the compatibility check in ``accepts_prefill`` is an
+        ``is`` comparison, which raw bytes cannot carry across a wire."""
+        from .kvtransfer import decode_remote_prefill
+
+        return decode_remote_prefill(data, engine=engine)
 
 
 def _filter_logits(logits, temp, top_k, top_p):
@@ -413,6 +448,17 @@ class ContinuousBatchingEngine:
 
         self._occupants: List[Optional[SlotOccupant]] = [None] * slots
         self._free: List[int] = list(range(slots))
+        # slot-epoch fence for wire-shipped prefills (kvtransfer): every
+        # return of a slot to the free list bumps its epoch, and a
+        # reservation minted for an in-flight transfer is honored only
+        # while its epoch is still current — a late/duplicate COMMIT can
+        # never land in a recycled slot. The lock covers ONLY this
+        # free-list/epoch/reservation bookkeeping (transfer receiver
+        # threads reserve/release concurrently with the serving worker's
+        # admissions and retirements); no device work ever runs under it.
+        self._admission_lock = threading.Lock()
+        self._epochs: List[int] = [0] * slots
+        self._reservations: dict[int, float] = {}  # slot -> expiry time
         self.peak_live = 0
         # deferred-readback ring: (tick, kind, payload) — the same
         # K-programs-late trick as telemetry's DeferredReadbackRing, here
@@ -818,6 +864,101 @@ class ContinuousBatchingEngine:
     def free_slots(self) -> int:
         return len(self._free)
 
+    def _pop_free_slot(self) -> int:
+        with self._admission_lock:
+            if not self._free:
+                raise EngineCapacityError(
+                    "no free arena slot (caller must gate on free_slots())"
+                )
+            return self._free.pop()
+
+    def _return_slot(self, slot: int) -> None:
+        """Return a slot to the free list and bump its epoch — the fence
+        event: any reservation or in-flight transfer minted under the old
+        epoch is now permanently stale."""
+        with self._admission_lock:
+            self._epochs[slot] += 1
+            self._reservations.pop(slot, None)
+            self._free.append(slot)
+
+    # ------------------------------------------------ slot-epoch reservations
+    def slot_epoch(self, slot: int) -> int:
+        """Current epoch of ``slot`` (monotonic; bumped every time the slot
+        returns to the free list). The kvtransfer receiver fences COMMIT
+        frames against this."""
+        with self._admission_lock:
+            return self._epochs[slot]
+
+    def reserve_slot(self, ttl_s: float = 30.0) -> Tuple[int, int]:
+        """Reserve a free slot for an incoming KV transfer: the slot leaves
+        the free list NOW (so admission cannot recycle it mid-stream) and
+        the returned ``(slot, epoch)`` pair rides the transfer's frames.
+        ``insert_prefilled`` consumes the reservation iff the epoch is
+        still current; :meth:`release_reservation` (abort) or the ``ttl_s``
+        reaper (leaked transfer) returns the slot with an epoch bump, which
+        permanently fences the late stream. Safe from any thread."""
+        with self._admission_lock:
+            if not self._free:
+                raise EngineCapacityError(
+                    "no free arena slot to reserve for an incoming KV "
+                    "transfer (slots free as occupants retire)"
+                )
+            slot = self._free.pop()
+            self._reservations[slot] = self._clock() + ttl_s
+            return slot, self._epochs[slot]
+
+    def release_reservation(self, slot: int, epoch: int) -> bool:
+        """Cancel a transfer reservation (sender aborted / stream died):
+        the slot returns to the free list and its epoch bumps, so any
+        late COMMIT carrying the old epoch is refused. Idempotent —
+        returns False when the reservation is already gone (consumed,
+        reaped, or released twice). Safe from any thread."""
+        with self._admission_lock:
+            if slot not in self._reservations or self._epochs[slot] != epoch:
+                return False
+            del self._reservations[slot]
+            self._epochs[slot] += 1
+            self._free.append(slot)
+            return True
+
+    def _reap_reservations(self) -> None:
+        """Expire overdue transfer reservations (a sender that died after
+        BEGIN never sends ABORT — the TTL is the backstop that stops a
+        leaked reservation from holding a slot forever)."""
+        if not self._reservations:
+            return
+        now = self._clock()
+        with self._admission_lock:
+            expired = [s for s, exp in self._reservations.items() if now >= exp]
+            for slot in expired:
+                del self._reservations[slot]
+                self._epochs[slot] += 1
+                self._free.append(slot)
+
+    def kv_prefix_digest(self, limit: int = 512) -> dict:
+        """Compact content digest of the KV prefix registry:
+        ``{"block_size": B, "crcs": [crc32 of each registered
+        block-aligned prefix key, capped at limit]}`` — gossiped through
+        the fleet prober so placement can prefer replicas that already
+        hold a request's warm prefix (KV-affinity routing). The router
+        recomputes the same crc32 over a request's block-aligned prompt
+        prefixes, which needs ``block_size`` to slice identically. Empty
+        crcs for dense backends (no prefix registry)."""
+        fn = getattr(self._backend, "prefix_digest", None)
+        return {
+            "block_size": getattr(self._backend, "block_size", 0),
+            "crcs": fn(limit) if fn is not None else [],
+        }
+
+    @property
+    def kv_host_tier(self):
+        """The backend's :class:`~accelerate_tpu.kvcache.HostKVTier`
+        (``None`` when spill is off or the backend is dense) — exposed for
+        the fleet's hot-prefix replication, which copies MRU prefix blocks
+        across replicas' tiers so a popular system prompt restores warm
+        everywhere."""
+        return getattr(self._backend, "host_tier", None)
+
     def live_count(self) -> int:
         return sum(1 for o in self._occupants if o is not None and not o.finished)
 
@@ -888,18 +1029,14 @@ class ContinuousBatchingEngine:
                 top_k=top_k, top_p=top_p, eos_token_id=eos_token_id,
                 pad_token_id=pad_token_id, seed=seed, tag=tag,
             )
-        if not self._free:
-            raise EngineCapacityError(
-                "no free arena slot (caller must gate on free_slots())"
-            )
-        slot = self._free.pop()
+        slot = self._pop_free_slot()
         try:
             # paged: allocate/COW-share the request's blocks and install the
             # slot's table row; raises RuntimeError when the pool is out of
             # blocks (callers gate on can_admit()). Dense: a no-op row.
             table_row, _shared = self._backend.acquire(slot, prompt, max_new_tokens)
         except BaseException:
-            self._free.append(slot)
+            self._return_slot(slot)
             raise
         padded = np.zeros((1, self.prompt_bucket), np.int32)
         padded[0, : len(prompt)] = prompt
@@ -949,17 +1086,13 @@ class ContinuousBatchingEngine:
         dispatch the first chunk. Remaining chunks interleave one per
         :meth:`step` tick — the slot rides every decode step masked until
         the last chunk installs its first token."""
-        if not self._free:
-            raise EngineCapacityError(
-                "no free arena slot (caller must gate on free_slots())"
-            )
-        slot = self._free.pop()
+        slot = self._pop_free_slot()
         try:
             table_row, shared = self._backend.acquire(
                 slot, prompt, max_new_tokens, defer_register=True
             )
         except BaseException:
-            self._free.append(slot)
+            self._return_slot(slot)
             raise
         pad_id = (
             pad_token_id if pad_token_id is not None
@@ -1187,18 +1320,35 @@ class ContinuousBatchingEngine:
             max_len=self.max_len,
         )
 
-    def accepts_prefill(self, pre) -> bool:
-        """Whether :meth:`insert_prefilled` can commit this
-        :class:`RemotePrefill`: it must have been computed against the same
-        model config, prompt bucket, and arena length (after a failover to
-        a differently-shaped replica the caller falls back to a plain
-        :meth:`insert`, recomputing the forward)."""
+    def _structurally_accepts(self, pre) -> bool:
         return (
             isinstance(pre, RemotePrefill)
             and pre.engine_config is self.config
             and pre.prompt_bucket == self.prompt_bucket
             and pre.max_len == self.max_len
         )
+
+    def accepts_prefill(self, pre) -> bool:
+        """Whether :meth:`insert_prefilled` can commit this
+        :class:`RemotePrefill`: it must have been computed against the same
+        model config, prompt bucket, and arena length (after a failover to
+        a differently-shaped replica the caller falls back to a plain
+        :meth:`insert`, recomputing the forward). A wire-shipped prefill
+        whose slot reservation went stale mid-queue (released by deadline
+        shed, TTL reaper, or reset) is also refused here, so the serving
+        admission path falls back to a local prefill instead of tripping
+        the :meth:`insert_prefilled` fence."""
+        if not self._structurally_accepts(pre):
+            return False
+        if pre.reservation is not None:
+            slot, epoch = pre.reservation
+            with self._admission_lock:
+                if (
+                    slot not in self._reservations
+                    or self._epochs[slot] != epoch
+                ):
+                    return False
+        return True
 
     def insert_prefilled(
         self, pre: RemotePrefill, *, max_new_tokens: Optional[int] = None,
@@ -1211,7 +1361,10 @@ class ContinuousBatchingEngine:
         admission) the budget the prefill was computed with; the commit
         program re-derives done/budget state so the result is bitwise what
         :meth:`insert` with that budget would have produced."""
-        if not self.accepts_prefill(pre):
+        # structural check only — reservation freshness is fenced below so
+        # a stale wire transfer raises the TYPED TransferStaleEpochError,
+        # not this generic mismatch
+        if not self._structurally_accepts(pre):
             raise ValueError(
                 "RemotePrefill is not compatible with this engine (model "
                 "config / prompt_bucket / max_len mismatch) — recompute via "
@@ -1225,15 +1378,34 @@ class ContinuousBatchingEngine:
             )
         prompt = pre.prompt
         self.validate_request(len(prompt), budget)
-        if not self._free:
-            raise EngineCapacityError(
-                "no free arena slot (caller must gate on free_slots())"
-            )
-        slot = self._free.pop()
+        if pre.reservation is not None:
+            # the slot-epoch fence: a wire-shipped prefill commits into
+            # the exact slot its transfer reserved, and ONLY while the
+            # epoch it was reserved under is still current — a stale
+            # epoch means the slot was released (and possibly recycled)
+            # mid-transfer, so the late stream must never land
+            slot, epoch = pre.reservation
+            with self._admission_lock:
+                fresh = (
+                    slot in self._reservations
+                    and self._epochs[slot] == epoch
+                )
+                if fresh:
+                    del self._reservations[slot]
+            if not fresh:
+                raise TransferStaleEpochError(
+                    f"KV transfer reservation for slot {slot} is stale "
+                    f"(transfer epoch {epoch}, current "
+                    f"{self.slot_epoch(slot)}) — the slot was released "
+                    "while the stream was in flight; fall back to a "
+                    "local prefill"
+                )
+        else:
+            slot = self._pop_free_slot()
         try:
             table_row, _shared = self._backend.acquire(slot, prompt, budget)
         except BaseException:
-            self._free.append(slot)
+            self._return_slot(slot)
             raise
         pad_id = (
             pre.pad_token_id if pre.pad_token_id is not None
@@ -1517,6 +1689,7 @@ class ContinuousBatchingEngine:
         occupants retired by this poll. Entries referencing occupants that
         finished (or were cancelled) earlier are skipped — their token
         values are pad by construction."""
+        self._reap_reservations()  # TTL backstop for abandoned KV transfers
         retired: List[SlotOccupant] = []
         popped: collections.Counter = collections.Counter()
         while self._ring and (
@@ -1624,7 +1797,7 @@ class ContinuousBatchingEngine:
         ):
             occ.finished = True
             self._occupants[occ.slot] = None
-            self._free.append(occ.slot)
+            self._return_slot(occ.slot)  # epoch bump: fences late transfers
             # drops block refcounts AND resets the slot's table row to the
             # null block, so the ghost slot's masked decode writes (it rides
             # every step until a new prefill resets it) land in the garbage
@@ -1651,7 +1824,7 @@ class ContinuousBatchingEngine:
                 pass
         if self._occupants[occ.slot] is occ:
             self._occupants[occ.slot] = None
-            self._free.append(occ.slot)
+            self._return_slot(occ.slot)  # epoch bump: fences late transfers
             self._backend.release(occ.slot)
         self.retired += 1
 
@@ -1688,7 +1861,12 @@ class ContinuousBatchingEngine:
             occ.finished = True
         self.peak_live = 0
         self._occupants = [None] * self.slots
-        self._free = list(range(self.slots))
+        with self._admission_lock:
+            # every epoch bumps: any transfer reserved against the dead
+            # arena is permanently fenced (its KV died with the state)
+            self._epochs = [e + 1 for e in self._epochs]
+            self._reservations.clear()
+            self._free = list(range(self.slots))
         self._ring.clear()
         self._prefill_queue.clear()
         self._backend.reset()  # fresh pool + empty prefix registry/tables

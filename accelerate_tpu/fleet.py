@@ -63,6 +63,7 @@ from __future__ import annotations
 import queue
 import threading
 import time
+import zlib
 from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Optional
@@ -82,6 +83,7 @@ from .utils.fault import (
     RequestDeadlineExceeded,
     ServerDrainingError,
     ServingError,
+    TransferStaleEpochError,
     fault_point,
 )
 
@@ -158,7 +160,15 @@ class FleetMetrics:
         "replicas_added",
         "replicas_removed",
         "prefills",  # prompt forwards run on dedicated prefill workers
-        "prefill_fallbacks",  # disaggregation unavailable → plain submit
+        # disaggregation fallbacks, split by typed reason so a silent
+        # transfer regression can't hide inside one aggregate:
+        "prefill_fallback/unavailable",  # no engine / no prefill_remote
+        "prefill_fallback/transfer_failed",  # wire transfer died (typed)
+        "prefill_fallback/stale_epoch",  # slot recycled mid-transfer
+        "kv_transfers",  # RemotePrefills shipped over a transport
+        "kv_transfer_retries",  # re-attempts (budget-gated)
+        "kv_affinity_hits",  # placements that landed on a prefix holder
+        "hot_prefix_replicas",  # hot prefix blocks copied across tiers
     )
 
     def __init__(self, clock: Callable[[], float] = time.monotonic):
@@ -213,6 +223,11 @@ class ReplicaHandle:
     last_health: Optional[dict] = None  # last completed health sample
     probe_state: Any = None  # in-flight _Probe (single-flight)
     respawn_failures: int = 0  # consecutive factory failures
+    # gossiped KV prefix-registry digest (crc32s of the replica's cached
+    # block-aligned prefixes, from its last probe) — the KV-affinity
+    # placement signal. A set for O(1) membership in _score.
+    prefix_digest: frozenset = frozenset()
+    prefix_block: int = 0  # the replica's KV block size (digest slicing)
     # live _FleetRequests routed here (keyed by object id — the request
     # dataclass is by-value-eq, hence unhashable) — the brown-out hedge
     # source
@@ -277,12 +292,15 @@ class _Probe:
     joined, never duplicated, so a wedged replica accumulates exactly one
     parked thread, not one per tick."""
 
-    __slots__ = ("done", "health", "snap", "error", "started_s", "elapsed_s")
+    __slots__ = (
+        "done", "health", "snap", "digest", "error", "started_s", "elapsed_s",
+    )
 
     def __init__(self):
         self.done = threading.Event()
         self.health: Optional[dict] = None
         self.snap: Optional[dict] = None
+        self.digest: Optional[dict] = None  # kv_prefix_digest() gossip
         self.error: Optional[BaseException] = None
         # real wall clock, not the injected router clock: probe latency is
         # a measured property of the replica, not of simulated time
@@ -350,6 +368,23 @@ class FleetRouter:
             self.config.retry_budget_refill_per_s,
             clock,
         )
+        # wire-capable KV transfer (docs/serving.md): transfers spend the
+        # SAME retry budget as failovers — a transfer storm and an outage
+        # storm draw down one shared allowance
+        self._kvtx = None
+        if self.config.kv_transfer is not None:
+            from .kvtransfer import KVTransferManager
+
+            self._kvtx = KVTransferManager(
+                transport=self.config.kv_transfer,
+                chunk_bytes=self.config.kv_transfer_chunk_bytes,
+                chunk_deadline_s=self.config.kv_transfer_chunk_deadline_s,
+                retries=self.config.kv_transfer_retries,
+                backoff_s=self.config.kv_transfer_backoff_s,
+                budget=self._budget,
+                clock=clock,
+                on_retry=lambda: self.metrics.bump("kv_transfer_retries"),
+            )
         if isinstance(replicas, dict):
             items = list(replicas.items())
         elif replicas:
@@ -421,6 +456,8 @@ class FleetRouter:
             if replica_id in self._handles:
                 raise ValueError(f"replica {replica_id!r} already registered")
             self._handles[replica_id] = handle
+        if self._kvtx is not None and server.engine is not None:
+            self._kvtx.register(replica_id, server)
         self.metrics.bump("replicas_added")
         self._membership.join(
             replica_id,
@@ -463,6 +500,10 @@ class FleetRouter:
             self.config.drain_timeout_s if timeout is None else timeout
         )
         handle.server.close(drain=False)
+        if self._kvtx is not None:
+            # after close: a late in-flight transfer fails typed on the
+            # sender and falls back, never lands in a dead replica
+            self._kvtx.unregister(replica_id)
         with self._lock:
             self._handles.pop(replica_id, None)
         return ok
@@ -485,6 +526,8 @@ class FleetRouter:
         for t in self._prefill_threads:
             t.join(timeout=5.0)
         self._prober.join(timeout=5.0)
+        if self._kvtx is not None:
+            self._kvtx.close()
         if self._exporter is not None:
             self._exporter.close()
             self._exporter = None
@@ -629,12 +672,49 @@ class FleetRouter:
             score *= self.config.brownout_placement_penalty
         return score
 
+    def _prefix_crcs(self, prompt: np.ndarray, block: int) -> frozenset:
+        """crc32 of every full block-aligned prefix of ``prompt``, sliced
+        exactly like :class:`~accelerate_tpu.kvcache.PagedBlockPool`'s
+        registry keys (``prompt[:(d+1)*B].tobytes()``) — the request-side
+        half of the KV-affinity match."""
+        ids = np.ascontiguousarray(np.asarray(prompt, dtype=np.int32))
+        return frozenset(
+            zlib.crc32(ids[: (d + 1) * block].tobytes()) & 0xFFFFFFFF
+            for d in range(len(ids) // block)
+        )
+
+    def _has_affinity(self, handle: ReplicaHandle, freq: _FleetRequest,
+                      cache: dict) -> bool:
+        if not handle.prefix_digest or handle.prefix_block <= 0:
+            return False
+        crcs = cache.get(handle.prefix_block)
+        if crcs is None:
+            crcs = cache[handle.prefix_block] = self._prefix_crcs(
+                freq.input_ids, handle.prefix_block
+            )
+        return bool(crcs & handle.prefix_digest)
+
     def _order(self, cands: list, freq: _FleetRequest) -> list:
         if self.config.placement == "round_robin":
             with self._lock:
                 self._rr += 1
                 rot = self._rr % len(cands)
             return cands[rot:] + cands[:rot]
+        if self.config.kv_affinity:
+            # KV-affinity: a replica whose gossiped prefix registry
+            # already holds this request's block-aligned prefix gets its
+            # load score multiplied DOWN by kv_affinity_weight — the
+            # request lands where its KV lives (prefix blocks dedup via
+            # COW instead of recomputing), unless that replica is
+            # overloaded enough for raw load to win anyway
+            cache: dict = {}
+            return sorted(
+                cands,
+                key=lambda ch: self._score(ch[0], ch[1]) * (
+                    self.config.kv_affinity_weight
+                    if self._has_affinity(ch[0], freq, cache) else 1.0
+                ),
+            )
         return sorted(cands, key=lambda ch: self._score(ch[0], ch[1]))
 
     def _dispatch(self, freq: _FleetRequest) -> None:
@@ -669,6 +749,10 @@ class FleetRouter:
                 self._note_backoff(handle, exc)
                 last_exc = exc
                 continue
+            if self.config.kv_affinity and self._has_affinity(
+                handle, freq, {}
+            ):
+                self.metrics.bump("kv_affinity_hits")
             if i == 0:
                 self._maybe_hedge(freq, ordered)
             return
@@ -916,9 +1000,12 @@ class FleetRouter:
     def _prefill_loop(self) -> None:
         """Dedicated prefill worker: run the compute-bound prompt forward
         off the decode loop (``prefill_remote``), then hand the decode
-        replica a precomputed KV window (``submit(prefilled=...)``).
-        Any prefill problem falls back to a plain submit — disaggregation
-        is an optimization, never a new failure mode."""
+        replica a precomputed KV window (``submit(prefilled=...)``) —
+        by reference, or over the configured KV transport
+        (``config.kv_transfer``) as an epoch-fenced transactional chunk
+        stream. Any prefill OR transfer problem falls back to a plain
+        submit with a typed reason counter — disaggregation is an
+        optimization, never a new failure mode."""
         while True:
             item = self._prefill_q.get()
             if item is None:
@@ -956,14 +1043,16 @@ class FleetRouter:
                     self.metrics.bump("prefills")
                 except Exception as exc:  # noqa: BLE001 — fall back to plain submit
                     pre = None
-                    self.metrics.bump("prefill_fallbacks")
+                    self.metrics.bump("prefill_fallback/unavailable")
                     logger.warning(
                         "remote prefill failed on %s (%s: %s); falling back "
                         "to in-loop prefill",
                         handle.replica_id, type(exc).__name__, exc,
                     )
+                if pre is not None and self._kvtx is not None:
+                    pre = self._ship_prefill(pre, freq, handle)
             else:
-                self.metrics.bump("prefill_fallbacks")
+                self.metrics.bump("prefill_fallback/unavailable")
             try:
                 inner = handle.server.submit(
                     freq.input_ids,
@@ -983,10 +1072,42 @@ class FleetRouter:
             else:
                 self._track(freq, handle, inner)
 
+    def _ship_prefill(self, pre, freq, handle):
+        """Push one committed ``RemotePrefill`` through the configured KV
+        transport to ``handle``'s receiver and hand back the RECEIVER's
+        reconstructed copy (reservation attached, engine_config re-bound)
+        for the normal ``submit(prefilled=...)`` path. Any transfer death
+        — aborted, corrupt, stale epoch, even an injected fault that
+        escapes typed handling — returns ``None``: the request falls back
+        to a local prefill with a reason-labeled counter, never a dropped
+        future or a dead prefill worker."""
+        try:
+            tid = self._kvtx.ship(
+                pre, handle.replica_id, trace_id=freq.trace_id
+            )
+            wire_pre = self._kvtx.take(handle.replica_id, tid)
+            self.metrics.bump("kv_transfers")
+            return wire_pre
+        except TransferStaleEpochError as exc:
+            self.metrics.bump("prefill_fallback/stale_epoch")
+            logger.warning(
+                "KV transfer to %s fenced stale (%s); falling back to "
+                "in-loop prefill", handle.replica_id, exc,
+            )
+        except Exception as exc:  # noqa: BLE001 — transfer death must not kill the worker
+            self.metrics.bump("prefill_fallback/transfer_failed")
+            logger.warning(
+                "KV transfer to %s failed (%s: %s); falling back to "
+                "in-loop prefill",
+                handle.replica_id, type(exc).__name__, exc,
+            )
+        return None
+
     # ------------------------------------------------------------ health probes
     def _probe_loop(self) -> None:
         while not self._stop.wait(self.config.probe_interval_s):
             self._probe_pass()
+            self._replicate_hot_prefixes()
             # freshness stamp the SLO controller's fail-static rule reads.
             # Stamped EVERY pass: probes are timeout-bounded and
             # concurrent, so one hung replica degrades into a brown-out
@@ -1004,6 +1125,45 @@ class FleetRouter:
                 self.trackers, self.config.metrics_interval_s
             )
 
+    def _replicate_hot_prefixes(self) -> None:
+        """Fan each replica's N hottest host-tier prefix blocks out to its
+        siblings' tiers (``config.replicate_hot_prefixes``; 0 = off). A
+        popular prefix (shared system prompt) then restores WARM on every
+        replica, so KV-affinity routing degrades gracefully under
+        failover: losing the prefix's home replica does not cold-start the
+        prefix fleet-wide. Payloads are immutable committed block bytes —
+        sharing the same object across tiers is safe by construction."""
+        n = self.config.replicate_hot_prefixes
+        if n <= 0:
+            return
+        with self._lock:
+            handles = [h for h in self._handles.values() if not h.leaving]
+        tiers = []
+        for h in handles:
+            tier = getattr(
+                getattr(h.server, "engine", None), "kv_host_tier", None
+            )
+            if tier is not None:
+                tiers.append(tier)
+        if len(tiers) < 2:
+            return
+        for src in tiers:
+            for key in src.hot_keys(n):
+                payload = None
+                for dst in tiers:
+                    if (
+                        dst is src
+                        or dst.block_bytes != src.block_bytes
+                        or dst.contains(key)
+                    ):
+                        continue
+                    if payload is None:
+                        payload = src.lookup(key)
+                        if payload is None:
+                            break  # evicted between hot_keys and here
+                    if dst.insert(key, payload):
+                        self.metrics.bump("hot_prefix_replicas")
+
     def _probe_worker(self, handle: ReplicaHandle, probe: _Probe) -> None:
         """Body of one probe thread: the only place the prober actually
         touches the replica. Runs off the prober loop so a hung
@@ -1014,6 +1174,9 @@ class FleetRouter:
             snap_fn = getattr(handle.server, "metrics_snapshot", None)
             if snap_fn is not None:
                 probe.snap = snap_fn()
+            digest_fn = getattr(handle.server, "kv_prefix_digest", None)
+            if digest_fn is not None:
+                probe.digest = digest_fn()
         except BaseException as exc:  # noqa: BLE001 — typed triage happens at the collector
             probe.error = exc
         finally:
@@ -1059,6 +1222,12 @@ class FleetRouter:
                     and isinstance(v, (int, float))
                 ]
                 handle.perf_ratio = max(ratios) if ratios else 0.0
+            if probe.digest is not None:
+                # KV-affinity gossip: the replica's prefix-registry crcs
+                # ride the probe, not the metrics registry (hash-valued
+                # names would violate the G108 charset)
+                handle.prefix_digest = frozenset(probe.digest.get("crcs", ()))
+                handle.prefix_block = int(probe.digest.get("block_size", 0))
         # fold this replica's health + full metrics snapshot into the
         # router registry (fleet/replica/<id>/...): the fleet-wide
         # aggregation the exporter serves. The snapshot path re-ingests

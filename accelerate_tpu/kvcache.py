@@ -61,6 +61,7 @@ import collections
 import queue
 import threading
 import time
+import zlib
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -313,6 +314,18 @@ class HostKVTier:
         with self._lock:
             self.restore_hits += n_blocks
             self.restore_bytes += n_blocks * self.block_bytes
+
+    def hot_keys(self, n: int = 8) -> List[bytes]:
+        """Most-recently-used prefix keys — the replication candidates for
+        fleet-wide hot-prefix fan-out. MRU order (hottest first)."""
+        with self._lock:
+            return list(reversed(self._blocks.keys()))[: max(0, n)]
+
+    def contains(self, key: bytes) -> bool:
+        """Membership probe with NO stat side effects (``lookup`` counts a
+        miss and refreshes LRU) — the hot-prefix replicator's dedup check."""
+        with self._lock:
+            return key in self._blocks
 
     def clear(self) -> None:
         with self._lock:
@@ -868,6 +881,18 @@ class PagedKVBackend(KVCacheBackend):
         self._device_tables_cache = None
         self._flush_spills()
         return row, shared
+
+    def prefix_digest(self, limit: int = 512) -> List[int]:
+        """Compact fingerprint of this backend's prefix registry: crc32 of
+        each registered block-aligned prefix key, capped at ``limit``. The
+        fleet gossips these via probe snapshots so the router can score
+        KV-affinity (a replica already holding a request's prefix skips the
+        prefill work entirely). Collisions only cost a mis-scored bonus —
+        correctness never depends on the digest."""
+        # list() copy: the registry dict mutates on the serving thread while
+        # the prober reads it here; crc over a snapshot is race-free.
+        keys = list(self.pool._registry.keys())[: max(0, limit)]
+        return [zlib.crc32(k) & 0xFFFFFFFF for k in keys]
 
     # ---------------------------------------------------- host tier: spill
     def host_block_bytes(self) -> int:

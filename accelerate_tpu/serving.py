@@ -71,6 +71,7 @@ from .utils.fault import (
     PREEMPTION_EXIT_CODE,
     BatchExecutionError,
     CircuitOpenError,
+    KVTransferError,
     ReplicaDeadError,
     RequestDeadlineExceeded,
     ServerDrainingError,
@@ -217,6 +218,10 @@ class ServingMetrics:
         "engine_inserts",  # requests admitted into arena slots
         "engine_steps",  # fused decode steps dispatched
         "engine_retired",  # occupants retired (EOS / budget / cancel)
+        # a wire-shipped prefill lost its slot reservation between the
+        # accepts_prefill check and the commit (epoch fence) — re-ran the
+        # prompt forward locally instead
+        "prefill_commit_fallbacks",
     )
 
     def __init__(self, clock=time.monotonic):
@@ -597,6 +602,17 @@ class InferenceServer:
         else on the engine belongs to the serving worker thread."""
         return self._engine
 
+    def kv_prefix_digest(self) -> Optional[dict]:
+        """The engine's KV prefix-registry digest
+        (:meth:`~accelerate_tpu.engine.ContinuousBatchingEngine
+        .kv_prefix_digest`) — collected by the fleet prober alongside
+        ``health()`` to drive KV-affinity placement. ``None`` in static
+        mode (no prefix registry to gossip)."""
+        if self._engine is None:
+            return None
+        fn = getattr(self._engine, "kv_prefix_digest", None)
+        return fn() if fn is not None else None
+
     def queue_depth(self) -> int:
         with self._lock:
             return len(self._queue)
@@ -851,6 +867,7 @@ class InferenceServer:
                     queue_wait_s=max(0.0, now - req.submitted_at),
                     degraded=req.degraded,
                 ) as sp:
+                    committed = False
                     if (
                         req.prefill is not None
                         and req.effective_max_new_tokens <= req.prefill.max_new_tokens
@@ -860,12 +877,29 @@ class InferenceServer:
                         # on a prefill worker — scatter it (commit-only
                         # program)
                         sp.set("path", "insert_prefilled")
-                        eng.insert_prefilled(
-                            req.prefill,
-                            max_new_tokens=req.effective_max_new_tokens,
-                            tag=req,
-                        )
-                    else:
+                        try:
+                            eng.insert_prefilled(
+                                req.prefill,
+                                max_new_tokens=req.effective_max_new_tokens,
+                                tag=req,
+                            )
+                            committed = True
+                        except KVTransferError:
+                            # a wire-shipped prefill's slot reservation went
+                            # stale between accepts_prefill and the commit
+                            # (epoch fence) — the REQUEST is fine: re-run
+                            # the prompt forward locally below
+                            self.metrics.bump("prefill_commit_fallbacks")
+                    if not committed:
+                        pre = req.prefill
+                        if (
+                            pre is not None
+                            and getattr(pre, "reservation", None) is not None
+                        ):
+                            # free a still-fresh reservation NOW (e.g. the
+                            # budget clamp rejected the prefill) instead of
+                            # holding the slot until the TTL reaper
+                            eng.release_reservation(*pre.reservation)
                         sp.set("path", "insert")
                         eng.insert(
                             req.input_ids,

@@ -829,6 +829,28 @@ class FleetConfig(KwargsHandler):
     precomputed KV window to scatter (``insert_prefilled``). Decode slots
     stop stalling behind compute-bound prompt forwards;
     ``ServingResult.ttft_s`` is the metric.
+
+    Wire-capable KV transfer (``accelerate_tpu.kvtransfer``,
+    docs/serving.md "Cross-host disaggregated prefill"): ``kv_transfer``
+    selects a transport (``"inproc"`` — the bitwise-parity oracle, or
+    ``"tcp"`` — length-prefixed sockets, the genuinely cross-host path;
+    ``None`` keeps today's by-reference hand-off). The prefill worker
+    then *ships* each ``RemotePrefill`` as an epoch-fenced transactional
+    chunk stream: ``kv_transfer_chunk_bytes`` per CHUNK frame, each ACK
+    bounded by ``kv_transfer_chunk_deadline_s``, up to
+    ``kv_transfer_retries`` re-attempts with ``kv_transfer_backoff_s``
+    exponential backoff, every retry spending one fleet retry-budget
+    token (same bucket as failovers — a transfer storm cannot outspend an
+    outage). Any terminal transfer error falls back to a local prefill
+    (``fleet/prefill_fallback/transfer_failed`` or ``/stale_epoch``).
+
+    KV-affinity placement: with ``kv_affinity`` the prober gossips each
+    replica's prefix-registry digest (crc32 of its block-aligned cached
+    prefixes) and ``_score`` multiplies a replica's load score by
+    ``kv_affinity_weight`` when it already holds a request's prefix — the
+    request lands where its KV lives. ``replicate_hot_prefixes`` > 0
+    additionally copies each replica's N hottest host-tier prefix blocks
+    into the other replicas' host tiers on every probe pass (0 = off).
     """
 
     placement: str = "least_loaded"
@@ -841,6 +863,15 @@ class FleetConfig(KwargsHandler):
     hedge_deadline_fraction: Optional[float] = None
     disaggregate_prefill: bool = False
     prefill_workers: int = 2
+    # wire-capable KV transfer + affinity routing (docstring section above)
+    kv_transfer: Optional[str] = None
+    kv_transfer_chunk_bytes: int = 65536
+    kv_transfer_chunk_deadline_s: float = 2.0
+    kv_transfer_retries: int = 2
+    kv_transfer_backoff_s: float = 0.05
+    kv_affinity: bool = True
+    kv_affinity_weight: float = 0.5
+    replicate_hot_prefixes: int = 0
     auto_respawn: bool = False
     respawn_backoff_s: float = 0.5
     # gray-failure / brown-out quarantine (docstring section above)
@@ -938,6 +969,42 @@ class FleetConfig(KwargsHandler):
             raise ValueError(
                 f"drain_timeout_s must be >= 0, got {self.drain_timeout_s}"
             )
+        if self.kv_transfer not in (None, "inproc", "tcp"):
+            raise ValueError(
+                "kv_transfer must be None, 'inproc', or 'tcp', got "
+                f"{self.kv_transfer!r}"
+            )
+        if self.kv_transfer_chunk_bytes < 1:
+            raise ValueError(
+                "kv_transfer_chunk_bytes must be >= 1, got "
+                f"{self.kv_transfer_chunk_bytes}"
+            )
+        if self.kv_transfer_chunk_deadline_s <= 0:
+            raise ValueError(
+                "kv_transfer_chunk_deadline_s must be > 0, got "
+                f"{self.kv_transfer_chunk_deadline_s}"
+            )
+        if self.kv_transfer_retries < 0:
+            raise ValueError(
+                "kv_transfer_retries must be >= 0, got "
+                f"{self.kv_transfer_retries}"
+            )
+        if self.kv_transfer_backoff_s < 0:
+            raise ValueError(
+                "kv_transfer_backoff_s must be >= 0, got "
+                f"{self.kv_transfer_backoff_s}"
+            )
+        if not (0 < self.kv_affinity_weight <= 1):
+            raise ValueError(
+                "kv_affinity_weight must be in (0, 1] (a score multiplier "
+                f"— lower favors affinity harder), got "
+                f"{self.kv_affinity_weight}"
+            )
+        if self.replicate_hot_prefixes < 0:
+            raise ValueError(
+                "replicate_hot_prefixes must be >= 0, got "
+                f"{self.replicate_hot_prefixes}"
+            )
 
 
 @dataclass
@@ -1024,6 +1091,13 @@ class ControllerConfig(KwargsHandler):
     max_replicas: int = 8
     replace_on_drift: bool = True
     replace_drain_timeout_s: float = 5.0
+    # weight on the KV-transfer-failure pressure term: the fraction of
+    # this tick's remote prefills that fell back due to transfer failure
+    # (fleet/prefill_fallback/transfer_failed + /stale_epoch deltas over
+    # the prefills delta) times this weight joins the max() of pressure
+    # terms — a failing cross-host data path escalates BEFORE queues
+    # back up behind the slower local-prefill fallback. 0 disables.
+    transfer_pressure_weight: float = 2.0
     dry_run: bool = False
 
     def __post_init__(self):
@@ -1080,6 +1154,11 @@ class ControllerConfig(KwargsHandler):
             raise ValueError(
                 "replace_drain_timeout_s must be >= 0, got "
                 f"{self.replace_drain_timeout_s}"
+            )
+        if self.transfer_pressure_weight < 0:
+            raise ValueError(
+                "transfer_pressure_weight must be >= 0, got "
+                f"{self.transfer_pressure_weight}"
             )
 
 

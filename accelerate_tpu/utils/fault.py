@@ -51,6 +51,10 @@ __all__ = [
     "FailoverExhaustedError",
     "EngineCapacityError",
     "EngineInvariantError",
+    "KVTransferError",
+    "TransferAbortedError",
+    "TransferStaleEpochError",
+    "TransferCorruptError",
     "ComponentClosedError",
     "PerfDriftError",
     "ReplicaBrownoutError",
@@ -252,6 +256,52 @@ class EngineCapacityError(ServingError):
     occupants retire, so backing off and resubmitting can succeed.
     Subclasses :class:`ServingError` (hence ``RuntimeError``) so
     pre-taxonomy callers catching RuntimeError keep working."""
+
+    retriable = True
+
+
+class KVTransferError(ServingError):
+    """Base class for cross-host KV transfer failures
+    (:mod:`accelerate_tpu.kvtransfer` — the wire-capable disaggregated
+    prefill path). Every subclass is ``retriable``-annotated: a failed
+    transfer never dooms the *request*, because the decode replica can
+    always recompute the prompt forward locally (the
+    ``fleet/prefill_fallback/...`` path). The annotation keeps the router
+    string-match-free, exactly like the rest of the serving taxonomy."""
+
+    retriable = True
+
+
+class TransferAbortedError(KVTransferError):
+    """The transfer died mid-stream: the sender crashed or timed out, the
+    connection dropped, a per-chunk deadline passed, or an injected fault
+    fired. The receiver discards its staging buffers and releases the
+    slot reservation — the pool is untouched (nothing lands before a
+    verified COMMIT), so retrying the transfer (fresh transfer id, fresh
+    reservation) or falling back to a local prefill are both safe."""
+
+    retriable = True
+
+
+class TransferStaleEpochError(KVTransferError):
+    """The transfer's COMMIT presented a slot epoch that no longer
+    matches the receiver's: the reserved slot was released (deadline
+    shed, reservation TTL, engine reset) and possibly re-admitted while
+    the stream was in flight. The late transfer must never land —
+    the fence at ``insert_prefilled`` guarantees a recycled slot's new
+    occupant is untouched. Retriable for the *request* (a fresh transfer
+    gets a fresh reservation), but the sender must NOT replay this
+    transfer id; the fleet falls back to a local prefill instead."""
+
+    retriable = True
+
+
+class TransferCorruptError(KVTransferError):
+    """A transfer frame failed verification — per-chunk crc32 mismatch,
+    framing violation, or the COMMIT's whole-payload checksum disagreed
+    with the assembled bytes. The staging buffers are discarded (a
+    corrupt chunk can never poison the pool); retrying re-sends from the
+    sender's canonical copy."""
 
     retriable = True
 
@@ -515,11 +565,18 @@ def fault_point(name: str, **context) -> None:
     request; ``fleet_failover`` — a retriable replica failure is about to
     be resubmitted to a surviving replica; ``fleet_probe`` — the health
     prober is about to read one replica's health; ``fleet_scale_down`` —
-    a replica is about to be drained out of the fleet); and the SLO
+    a replica is about to be drained out of the fleet); the SLO
     controller at the top of each observation tick
     (``controller_observe`` — arm ``raise`` here to simulate unreadable
-    telemetry and prove the fail-static freeze). The env var is
-    read at call time so a test script can arm a point between two saves.
+    telemetry and prove the fail-static freeze); and the KV transfer
+    protocol (:mod:`accelerate_tpu.kvtransfer`) at the named moments of
+    a transfer's lifecycle (``kvtx.send_chunk`` — the sender is about to
+    put one framed chunk on the wire; ``kvtx.receive`` — the receiver is
+    about to fold one arrived frame into its staging buffers;
+    ``kvtx.commit`` — the receiver verified the COMMIT frame and is
+    about to fence the slot epoch and publish the transfer). The env var
+    is read at call time so a test script can arm a point between two
+    saves.
     """
     conductor = _CONDUCTOR
     if conductor is not None:

@@ -31,6 +31,16 @@ replacement observed, and the recorded hit log replays to a
 **bit-identical** firing sequence through a fresh same-seed conductor —
 twice (chaos you can put in CI).
 
+A second, independent storm targets the **wire KV-transfer path**
+(``accelerate_tpu.kvtransfer``): two continuous replicas ship every
+remote prefill over TCP loopback while a seeded conductor makes chunk
+sends flaky (``kvtx.send_chunk``), wedges a COMMIT on the receiver
+(``kvtx.commit`` hang), and kills exactly one stream mid-flight
+(``kvtx.receive``). Gates: zero dropped futures, zero untyped errors,
+fallback-to-local-prefill observed at least once (the transactional
+protocol's promise: a dead transfer costs a recompute, never a request),
+and the same bit-identical hit-log replay discipline.
+
 Prints one JSON line per phase plus a gate line. ``--gate`` (also
 ``bench.py --chaos-gate`` / ``make bench-chaos``) turns the acceptance
 criteria into a nonzero exit.
@@ -62,6 +72,10 @@ STRAGGLER_X = float(os.environ.get("CHB_STRAGGLER_X", "10.0"))
 FLAKY_P = float(os.environ.get("CHB_FLAKY_P", "0.2"))
 GATE_GOODPUT_RATIO = float(os.environ.get("CHB_GATE_GOODPUT", "0.85"))
 GATE_TTFT_RATIO = float(os.environ.get("CHB_GATE_TTFT", "1.5"))
+KVTX_STORM_S = float(os.environ.get("CHB_KVTX_STORM_S", "6.0"))
+KVTX_RATE_RPS = float(os.environ.get("CHB_KVTX_RATE_RPS", "40.0"))
+KVTX_FLAKY_P = float(os.environ.get("CHB_KVTX_FLAKY_P", "0.15"))
+KVTX_HANG_S = float(os.environ.get("CHB_KVTX_HANG_S", "0.2"))
 PROMPT = np.arange(1, 9, dtype=np.int32)
 
 CAPACITY = MAX_BATCH / SERVICE_S  # one replica's throughput ceiling
@@ -372,12 +386,153 @@ def _chaos_run(schedule, workdir: str) -> dict:
     return row
 
 
+def _kvtx_fleet():
+    """Two continuous-mode replicas whose remote prefills cross a REAL
+    TCP loopback socket (``kv_transfer="tcp"``): the storm below exercises
+    the transactional chunk stream, not a by-reference hand-off. The
+    synthetic engine (benchmarks/kv_synth) implements the genuine
+    epoch-fence surface, so a killed stream releases its reservation the
+    same way the real arena does."""
+    from benchmarks.kv_synth import SynthKVEngine
+
+    from accelerate_tpu.fleet import FleetRouter
+    from accelerate_tpu.serving import InferenceServer
+    from accelerate_tpu.utils.dataclasses import FleetConfig, ServingConfig
+
+    scfg = ServingConfig(
+        mode="continuous", max_queue=256, default_max_new_tokens=4,
+        drain_timeout_s=10.0,
+    )
+    servers = {
+        f"r{i}": InferenceServer(
+            object(), scfg,
+            engine=SynthKVEngine(slots=8, prefill_s=0.005,
+                                 decode_step_s=0.001),
+            replica_id=f"r{i}",
+        )
+        for i in range(2)
+    }
+    return FleetRouter(servers, FleetConfig(
+        probe_interval_s=0.05,
+        disaggregate_prefill=True,
+        prefill_workers=2,
+        kv_transfer="tcp",
+        kv_transfer_chunk_bytes=2048,  # ~5 chunks/transfer: flaky has teeth
+        kv_transfer_retries=1,
+        kv_transfer_backoff_s=0.01,
+        auto_respawn=False,
+    ))
+
+
+def _kvtx_schedule():
+    return loadgen.from_phases(
+        [
+            loadgen.Phase("warm", 1.0, KVTX_RATE_RPS),
+            loadgen.Phase("storm", KVTX_STORM_S, KVTX_RATE_RPS),
+            loadgen.Phase("recover", 0.5, KVTX_RATE_RPS),
+        ],
+        seed=SEED,
+    )
+
+
+def _kvtx_chaos_schedule(schedule):
+    """Storm plan over the three registered ``kvtx.*`` fault points. All
+    in-process actions (raise/hang) — ``kill`` is process-SIGKILL, so
+    "stream killed mid-flight" is modeled as an injected raise inside the
+    receiver's frame pump, which typed-aborts the transfer exactly like a
+    dropped connection does."""
+    from accelerate_tpu.chaos import ChaosRule, ChaosSchedule, phase_windows
+
+    windows = dict(
+        (name, (start, end))
+        for name, start, end in phase_windows(schedule.phases)
+    )
+    storm_start, storm_end = windows["storm"]
+    return ChaosSchedule(
+        name="kvtx-storm",
+        seed=SEED,
+        rules=(
+            # seeded flaky chunk sends: some transfers retry and recover,
+            # some exhaust retries => fallback-to-local-prefill
+            ChaosRule(
+                point="kvtx.send_chunk",
+                action="raise",
+                prob=KVTX_FLAKY_P,
+                start_s=storm_start,
+                end_s=storm_end,
+                label="kvtx-flaky-chunk",
+            ),
+            # wedge COMMIT handling on the receiver thread, capped below
+            # the sender's chunk deadline: a survivable stall, not a death
+            ChaosRule(
+                point="kvtx.commit",
+                action=f"hang={KVTX_HANG_S}",
+                prob=0.2,
+                start_s=storm_start,
+                end_s=storm_end,
+                label="kvtx-commit-hang",
+            ),
+            # exactly one stream dies mid-flight inside the frame pump
+            ChaosRule(
+                point="kvtx.receive",
+                action="raise",
+                start_s=storm_start,
+                end_s=storm_end,
+                max_fires=1,
+                label="kvtx-kill-stream",
+            ),
+        ),
+    )
+
+
+def _kvtx_run() -> dict:
+    """The kvtx storm phase: seeded load over the TCP transfer path under
+    flaky/hang/kill injection. The verdict the gate wants: requests NEVER
+    pay for a transfer death with anything worse than a local prefill."""
+    from accelerate_tpu import chaos as chaos_mod
+
+    schedule = _kvtx_schedule()
+    conductor = chaos_mod.ChaosConductor(_kvtx_chaos_schedule(schedule))
+    router = _kvtx_fleet()
+    try:
+        conductor.start()
+        row = _replay(router, schedule)
+        conductor.stop()
+        row.pop("futures")
+        m = router.metrics
+        row.update({
+            "phase": "kvtx_storm",
+            "kv_transfers": m["kv_transfers"],
+            "kv_transfer_retries": m["kv_transfer_retries"],
+            "fallback_transfer_failed": m["prefill_fallback/transfer_failed"],
+            "fallback_stale_epoch": m["prefill_fallback/stale_epoch"],
+            "fallback_unavailable": m["prefill_fallback/unavailable"],
+            "fires_by_rule": {
+                label: conductor.fires(label)
+                for label in ("kvtx-flaky-chunk", "kvtx-commit-hang",
+                              "kvtx-kill-stream")
+            },
+        })
+    finally:
+        conductor.stop()
+        router.close(drain=False)
+    live = conductor.firing_sequence()
+    hits = conductor.hit_log()
+    row["firings"] = len(live)
+    row["replay_identical"] = (
+        conductor.replay(hits) == live and conductor.replay(hits) == live
+    )
+    print(json.dumps(row), flush=True)
+    return row
+
+
 def main(gate: bool = False) -> int:
     workdir = tempfile.mkdtemp(prefix="chaos_bench_")
     try:
         schedule = _schedule()
         base = _baseline_run(schedule)
         chaotic = _chaos_run(schedule, workdir)
+        kvtx = _kvtx_run()
 
         goodput_ratio = chaotic["goodput_rps"] / max(base["goodput_rps"], 1e-9)
         ttft_ratio = (
@@ -405,6 +560,21 @@ def main(gate: bool = False) -> int:
             and chaotic["fires_by_rule"]["flaky-probe"] >= 1,
             "replay_bit_identical": chaotic["replay_identical"]
             and chaotic["firings"] > 0,
+            # kvtx storm: the wire transfer path under flaky/hang/kill
+            "kvtx_zero_dropped": kvtx["dropped_futures"] == 0,
+            "kvtx_zero_untyped": kvtx["untyped_errors"] == 0,
+            "kvtx_wire_flowed": kvtx["kv_transfers"] >= 1,
+            "kvtx_fallback_observed": (
+                kvtx["fallback_transfer_failed"]
+                + kvtx["fallback_stale_epoch"]
+            ) >= 1,
+            "kvtx_chaos_fired": (
+                kvtx["fires_by_rule"]["kvtx-flaky-chunk"] >= 1
+                and kvtx["fires_by_rule"]["kvtx-commit-hang"] >= 1
+                and kvtx["fires_by_rule"]["kvtx-kill-stream"] == 1
+            ),
+            "kvtx_replay_bit_identical": kvtx["replay_identical"]
+            and kvtx["firings"] > 0,
         }
         ok = all(checks.values())
         print(json.dumps({

@@ -1,0 +1,317 @@
+"""Synthetic continuous slot engine with a REAL wire-transfer surface.
+
+Shared by ``benchmarks/chaos_bench.py`` (kvtx storm phase) and
+``benchmarks/serving_bench.py`` (``--cross-replica``): a stand-in engine
+with explicit prefill/decode costs — like serving_bench's original
+``_SyntheticSlotEngine`` — that additionally speaks the full
+:mod:`accelerate_tpu.kvtransfer` protocol with none of the model math:
+
+* ``prefill_remote`` returns a genuine
+  :class:`~accelerate_tpu.engine.RemotePrefill` whose cache/t0/next_key
+  leaves are deterministic numpy arrays derived from the prompt — so the
+  codec, chunking, crc framing, and COMMIT-side decode all carry real
+  bytes, and a corrupted transfer would be *detectable*, not cosmetic;
+* ``reserve_slot`` / ``release_reservation`` / ``slot_epoch`` implement
+  the same epoch-fence contract as
+  :class:`~accelerate_tpu.engine.ContinuousBatchingEngine` (every slot
+  free bumps the epoch; reservations are check-then-consume-if-fresh),
+  so a mid-stream slot recycle raises the same typed
+  :class:`~accelerate_tpu.utils.fault.TransferStaleEpochError` the real
+  engine would;
+* ``kv_prefix_digest`` gossips crc32s of block-aligned prompt prefixes
+  using the exact slicing :class:`~accelerate_tpu.kvcache.PagedBlockPool`
+  registry keys use (``ids[:(d+1)*B].tobytes()`` over int32), so fleet
+  KV-affinity routing scores real hits against it.
+
+Costs are explicit (``prefill_s`` on the calling thread, ``decode_step_s``
+per step), so bench deltas measure *scheduling and transport*, never
+model math.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import zlib
+
+import numpy as np
+
+from accelerate_tpu.engine import RemotePrefill
+from accelerate_tpu.utils.fault import (
+    EngineCapacityError,
+    TransferStaleEpochError,
+)
+
+RESERVE_TTL_S = 30.0
+
+
+class SynthKVConfig:
+    """Per-engine identity sentinel: ``accepts_prefill`` compares
+    ``engine_config`` by ``is`` (exactly like the real engine), and the
+    wire decode re-binds to the RECEIVING engine's config."""
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging nicety
+        return f"SynthKVConfig@{id(self):x}"
+
+
+class SynthOccupant:
+    """Slot-occupant stand-in: tag/budget/token bookkeeping plus the two
+    attributes the reply epilogue reads (first_token_s, inserted_s)."""
+
+    def __init__(self, prompt, budget, tag, now, slot):
+        self.prompt = np.asarray(prompt, dtype=np.int32)
+        self.budget = budget
+        self.tag = tag
+        self.tokens = 0
+        self.inserted_s = now
+        self.first_token_s = None
+        self.slot = slot
+
+    def output_row(self):
+        new = np.repeat(self.prompt[:1], self.tokens)
+        return np.concatenate([self.prompt, new])
+
+
+class SynthKVEngine:
+    """Continuous-engine stand-in implementing the full surface
+    InferenceServer's continuous loop AND the KV transfer receiver drive:
+    insert/prefill_remote/accepts_prefill/insert_prefilled/step/poll/
+    occupants/cancel/reset/stats plus reserve_slot/release_reservation/
+    slot_epoch/kv_prefix_digest. Thread-safe where the fleet needs it:
+    prefill workers and transport handler threads call in while the
+    serving worker steps."""
+
+    spec = None  # no speculative decoding: the degrade ladder skips us
+
+    def __init__(self, slots=8, prefill_s=0.02, decode_step_s=0.002,
+                 prompt_bucket=64, max_len=128, block_size=8, kv_dim=16,
+                 clock=time.monotonic):
+        self.slots = slots
+        self.prefill_s = prefill_s
+        self.decode_step_s = decode_step_s
+        self.prompt_bucket = int(prompt_bucket)
+        self.max_len = int(max_len)
+        self.block_size = int(block_size)
+        self.kv_dim = int(kv_dim)
+        self.config = SynthKVConfig()
+        self._clock = clock
+        self._lock = threading.Lock()  # leaf: admission + slot bookkeeping
+        self._free = list(range(slots))
+        self._epochs = [0] * slots
+        self._reservations: dict = {}  # slot -> expiry (epoch is _epochs[slot])
+        self._live: list = []
+        self._retired: list = []
+        self._prefix_crcs: set = set()
+
+    # ----------------------------------------------------------- admission
+    def validate_request(self, prompt_len, max_new_tokens):
+        if prompt_len <= 0 or max_new_tokens <= 0:
+            raise ValueError("empty prompt or budget")
+        if prompt_len > self.prompt_bucket:
+            raise ValueError(
+                f"prompt_len {prompt_len} exceeds bucket {self.prompt_bucket}"
+            )
+
+    def can_admit(self, ids, max_new_tokens):
+        return self.free_slots() > 0
+
+    def free_slots(self):
+        with self._lock:
+            return len(self._free)
+
+    def live_count(self):
+        with self._lock:
+            return len(self._live)
+
+    def _pop_free_slot(self):
+        with self._lock:
+            if not self._free:
+                raise EngineCapacityError("no free synthetic slot")
+            return self._free.pop()
+
+    def _return_slot(self, slot):
+        with self._lock:
+            self._epochs[slot] += 1
+            self._reservations.pop(slot, None)
+            if slot not in self._free:
+                self._free.append(slot)
+
+    def insert(self, prompt, max_new_tokens, tag=None, **kw):
+        self._note_prefix(prompt)
+        time.sleep(self.prefill_s)  # prompt forward runs IN the decode loop
+        slot = self._pop_free_slot()
+        now = self._clock()
+        occ = SynthOccupant(prompt, max_new_tokens, tag, now, slot)
+        occ.first_token_s = now  # prefill emits the first token
+        with self._lock:
+            self._live.append(occ)
+        return occ
+
+    # ---------------------------------------------------- disaggregated path
+    def prefill_remote(self, prompt, *, max_new_tokens, temperature=0.0,
+                       top_k=None, top_p=None, eos_token_id=None,
+                       pad_token_id=None, seed=0, **kw):
+        self._note_prefix(prompt)
+        time.sleep(self.prefill_s)  # prompt forward on the PREFILL worker
+        ids = np.asarray(prompt, dtype=np.int32)
+        padded = np.zeros(self.prompt_bucket, dtype=np.float32)
+        padded[: len(ids)] = ids.astype(np.float32)
+        scale = np.arange(1, self.kv_dim + 1, dtype=np.float32)
+        # deterministic per-prompt "KV": the wire path carries real bytes
+        # whose corruption the crc framing (and any parity check) catches
+        cache = {
+            "k": np.outer(padded, scale),
+            "v": np.outer(padded, -scale),
+        }
+        return RemotePrefill(
+            prompt=ids,
+            max_new_tokens=max_new_tokens,
+            temperature=temperature,
+            top_k=top_k,
+            top_p=top_p,
+            eos_token_id=eos_token_id,
+            pad_token_id=pad_token_id,
+            seed=int(seed or 0),
+            cache=cache,
+            t0=np.int32(ids[0]),  # first token repeats the first prompt id
+            next_key=np.asarray([0, int(seed or 0)], dtype=np.uint32),
+            engine_config=self.config,
+            prompt_bucket=self.prompt_bucket,
+            max_len=self.max_len,
+        )
+
+    def accepts_prefill(self, pre):
+        if not (
+            isinstance(pre, RemotePrefill)
+            and pre.engine_config is self.config
+            and pre.prompt_bucket == self.prompt_bucket
+            and pre.max_len == self.max_len
+        ):
+            return False
+        res = pre.reservation
+        if res is not None:
+            slot, epoch = res
+            with self._lock:
+                if self._epochs[slot] != epoch or slot not in self._reservations:
+                    return False  # stale: soft-refuse so serving re-prefills
+        return True
+
+    def insert_prefilled(self, pre, *, max_new_tokens, tag=None):
+        res = pre.reservation
+        if res is not None:
+            slot, epoch = res
+            with self._lock:
+                fresh = (
+                    self._epochs[slot] == epoch
+                    and slot in self._reservations
+                )
+                if fresh:
+                    del self._reservations[slot]  # consume; slot now live
+            if not fresh:
+                raise TransferStaleEpochError(
+                    f"reservation (slot={slot}, epoch={epoch}) went stale "
+                    "before commit — recompute the prefill locally"
+                )
+        else:
+            slot = self._pop_free_slot()
+        now = self._clock()
+        occ = SynthOccupant(pre.prompt, max_new_tokens, tag, now, slot)
+        occ.first_token_s = now  # commit publishes the precomputed token
+        with self._lock:
+            self._live.append(occ)
+        return occ
+
+    # -------------------------------------------------- wire-transfer fence
+    def reserve_slot(self, ttl_s=RESERVE_TTL_S):
+        with self._lock:
+            if not self._free:
+                raise EngineCapacityError("no free synthetic slot to reserve")
+            slot = self._free.pop()
+            self._reservations[slot] = self._clock() + ttl_s
+            return slot, self._epochs[slot]
+
+    def release_reservation(self, slot, epoch):
+        with self._lock:
+            if slot in self._reservations and self._epochs[slot] == epoch:
+                del self._reservations[slot]
+                self._epochs[slot] += 1
+                self._free.append(slot)
+                return True
+            return False
+
+    def slot_epoch(self, slot):
+        with self._lock:
+            return self._epochs[slot]
+
+    def _reap_reservations(self):
+        now = self._clock()
+        with self._lock:
+            expired = [
+                s for s, exp in self._reservations.items() if now >= exp
+            ]
+            for slot in expired:
+                del self._reservations[slot]
+                self._epochs[slot] += 1
+                self._free.append(slot)
+
+    # ------------------------------------------------------- affinity gossip
+    def _note_prefix(self, prompt):
+        ids = np.ascontiguousarray(np.asarray(prompt, dtype=np.int32))
+        b = self.block_size
+        with self._lock:
+            for d in range(len(ids) // b):
+                self._prefix_crcs.add(
+                    zlib.crc32(ids[: (d + 1) * b].tobytes()) & 0xFFFFFFFF
+                )
+
+    def kv_prefix_digest(self, limit=512):
+        with self._lock:
+            crcs = sorted(self._prefix_crcs)[: int(limit)]
+        return {"block_size": self.block_size, "crcs": crcs}
+
+    # ------------------------------------------------------------ decode loop
+    def step(self):
+        time.sleep(self.decode_step_s)
+        done = []
+        with self._lock:
+            still = []
+            for occ in self._live:
+                occ.tokens += 1
+                (done if occ.tokens >= occ.budget else still).append(occ)
+            self._live = still
+            self._retired.extend(done)
+        for occ in done:
+            self._return_slot(occ.slot)
+
+    def poll(self, force=False):
+        self._reap_reservations()  # TTL backstop for abandoned transfers
+        with self._lock:
+            out, self._retired = self._retired, []
+        return out
+
+    def occupants(self):
+        with self._lock:
+            return list(self._live)
+
+    def cancel(self, occ):
+        with self._lock:
+            if occ not in self._live:
+                return
+            self._live.remove(occ)
+        self._return_slot(occ.slot)
+
+    def reset(self):
+        with self._lock:
+            orphans, self._live, self._retired = self._live, [], []
+            self._epochs = [e + 1 for e in self._epochs]
+            self._reservations.clear()
+            self._free = list(range(self.slots))
+        return orphans
+
+    def stats(self):
+        with self._lock:
+            return {
+                "slots": self.slots,
+                "live": len(self._live),
+                "reserved": len(self._reservations),
+            }

@@ -314,6 +314,12 @@ FLEET_SEED = int(os.environ.get("SB_FLEET_SEED", "0"))
 FLEET_MIX_LONG_FRAC = float(os.environ.get("SB_FLEET_MIX_LONG_FRAC", "0.2"))
 FLEET_MIX_SHORT_LEN = int(os.environ.get("SB_FLEET_MIX_SHORT_LEN", "8"))
 FLEET_MIX_LONG_LEN = int(os.environ.get("SB_FLEET_MIX_LONG_LEN", "32"))
+# --cross-replica phase: remote prefill over TCP loopback vs in-process
+# hand-off; the committed gate is TTFT p99 tcp <= 1.3x inproc
+CROSS_TTFT_RATIO = float(os.environ.get("SB_CROSS_TTFT_RATIO", "1.3"))
+CROSS_N = int(os.environ.get("SB_CROSS_N", "64"))
+CROSS_GAP_S = float(os.environ.get("SB_CROSS_GAP_S", "0.01"))
+CROSS_PROMPTS = int(os.environ.get("SB_CROSS_PROMPTS", "4"))
 
 
 class _KillableEngine(_SyntheticEngine):
@@ -653,6 +659,104 @@ def _fleet_ttft(disaggregate):
         router.close(drain=False)
 
 
+def _cross_replica_phase(transport):
+    """One cross-replica disaggregation run over the given KV transport
+    (``accelerate_tpu.kvtransfer``): two continuous replicas, every
+    remote prefill shipped through the transactional chunk protocol, a
+    repeated prompt set so gossiped prefix digests give KV-affinity
+    routing something to hit. The synthetic engine (benchmarks/kv_synth)
+    carries real bytes with real epoch fencing but explicit costs, so
+    the inproc-vs-tcp TTFT delta is pure transport."""
+    from benchmarks.kv_synth import SynthKVEngine
+
+    FleetRouter, InferenceServer, FleetConfig, ServingConfig = _fleet_imports()
+    scfg = ServingConfig(
+        mode="continuous", max_queue=256, default_max_new_tokens=4,
+        drain_timeout_s=10.0,
+    )
+    servers = {
+        f"r{i}": InferenceServer(
+            object(), scfg,
+            engine=SynthKVEngine(slots=8, prefill_s=0.02,
+                                 decode_step_s=0.002),
+            replica_id=f"r{i}",
+        )
+        for i in range(2)
+    }
+    router = FleetRouter(servers, FleetConfig(
+        probe_interval_s=0.05,
+        disaggregate_prefill=True,
+        prefill_workers=4,
+        kv_transfer=transport,
+        kv_transfer_chunk_bytes=2048,
+    ))
+    prompts = [
+        np.arange(p * 100 + 1, p * 100 + 17, dtype=np.int32)
+        for p in range(CROSS_PROMPTS)
+    ]
+    rng = np.random.default_rng(FLEET_SEED)
+    try:
+        # warm wave: seed every prompt's prefix blocks somewhere in the
+        # fleet, then let two probe passes gossip the digests
+        warm = [router.submit(p, max_new_tokens=4) for p in prompts]
+        for f in warm:
+            f.result(timeout=30)
+        time.sleep(0.15)
+        hits0 = router.metrics["kv_affinity_hits"]
+        transfers0 = router.metrics["kv_transfers"]
+        futs = []
+        for _ in range(CROSS_N):
+            futs.append(router.submit(
+                prompts[int(rng.integers(len(prompts)))], max_new_tokens=4,
+            ))
+            time.sleep(CROSS_GAP_S)  # paced: TTFT measures service, not queue
+        ttfts = [f.result(timeout=30).ttft_s for f in futs]
+        m = router.metrics
+        hits = m["kv_affinity_hits"] - hits0
+        row = {
+            "phase": f"cross_replica_{transport}",
+            "n": len(ttfts),
+            "ttft_p50_s": round(_p(ttfts, 0.50), 4),
+            "ttft_p99_s": round(_p(ttfts, 0.99), 4),
+            "kv_transfers": m["kv_transfers"] - transfers0,
+            "affinity_hits": hits,
+            "prefix_hit_rate": round(hits / max(len(ttfts), 1), 3),
+            "fallbacks": (
+                m["prefill_fallback/unavailable"]
+                + m["prefill_fallback/transfer_failed"]
+                + m["prefill_fallback/stale_epoch"]
+            ),
+        }
+        print(json.dumps(row), flush=True)
+        return row
+    finally:
+        router.close(drain=False)
+
+
+def cross_replica_main(gate: bool = False) -> int:
+    inproc = _cross_replica_phase("inproc")
+    tcp = _cross_replica_phase("tcp")
+    ratio = tcp["ttft_p99_s"] / max(inproc["ttft_p99_s"], 1e-9)
+    checks = {
+        "wire_flowed": inproc["kv_transfers"] >= 1 and tcp["kv_transfers"] >= 1,
+        "zero_fallbacks": inproc["fallbacks"] == 0 and tcp["fallbacks"] == 0,
+        "affinity_observed": tcp["affinity_hits"] >= 1,
+        "ttft_tcp_bounded": ratio <= CROSS_TTFT_RATIO,
+    }
+    ok = all(checks.values())
+    print(json.dumps({
+        "metric": "cross_replica_gate",
+        "ttft_p99_inproc": inproc["ttft_p99_s"],
+        "ttft_p99_tcp": tcp["ttft_p99_s"],
+        "ttft_ratio": round(ratio, 3),
+        "ttft_threshold": CROSS_TTFT_RATIO,
+        "prefix_hit_rate_tcp": tcp["prefix_hit_rate"],
+        "checks": checks,
+        "pass": ok,
+    }), flush=True)
+    return 0 if (ok or not gate) else 1
+
+
 def fleet_main(gate: bool = False) -> int:
     ramp = {n: _fleet_ramp(n) for n in (1, 2, 4)}
     chaos = _fleet_chaos()
@@ -702,5 +806,11 @@ if __name__ == "__main__":
     if "--sigterm-child" in _sys.argv:
         raise SystemExit(_sigterm_child())
     if "--fleet" in _sys.argv or "--fleet-gate" in _sys.argv:
-        raise SystemExit(fleet_main(gate="--fleet-gate" in _sys.argv))
+        _gate = "--fleet-gate" in _sys.argv
+        _rc = fleet_main(gate=_gate)
+        if "--cross-replica" in _sys.argv:
+            _rc = max(_rc, cross_replica_main(gate=_gate))
+        raise SystemExit(_rc)
+    if "--cross-replica" in _sys.argv:
+        raise SystemExit(cross_replica_main(gate="--gate" in _sys.argv))
     raise SystemExit(main(gate="--gate" in _sys.argv))

@@ -1,0 +1,380 @@
+"""Wire-capable KV transfer suite (docs/serving.md "Cross-host
+disaggregated prefill"):
+
+* versioned ``RemotePrefill`` codec round-trip — bitwise leaf fidelity
+  and greedy-output parity through ``insert_prefilled`` vs the
+  by-reference hand-off, across dense/paged f32 and paged int8 backends;
+* cross-engine wire transfer (in-process oracle AND the TCP loopback
+  socket) — the receiver's reconstructed prefill commits to bitwise the
+  same tokens the receiving engine would have produced locally, and the
+  sender's ``kvtx.send`` span rides the caller's trace id;
+* epoch fencing — a slot retired and re-admitted while a transfer is in
+  flight makes the late COMMIT (receiver side) and the late
+  ``insert_prefilled`` (sender side) raise the typed
+  ``TransferStaleEpochError``, with staging freed, the paged pool's
+  free-list invariant intact, and the new occupant's KV bitwise
+  untouched;
+* corrupt/malformed frames and payloads die typed
+  (``TransferCorruptError``/``TransferAbortedError``), never silently;
+* the whole fleet hop — submit → prefill → ``kvtx.send`` → admit — shows
+  up as ONE trace id (ROADMAP: "a remote-prefill hop must show up as one
+  trace, not two").
+
+Engines compile per shape+backend, so tests share per-config engines via
+a module-scoped cache (``reset()`` restores a pristine arena between
+tests).
+"""
+
+import types
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from accelerate_tpu import tracing
+from accelerate_tpu.engine import ContinuousBatchingEngine, RemotePrefill
+from accelerate_tpu.kvtransfer import (
+    KVReceiver,
+    KVTransferManager,
+    _FRAME_BEGIN,
+    _FRAME_CHUNK,
+    _FRAME_COMMIT,
+    _pack_frame,
+    _raise_on_error_ack,
+    encode_remote_prefill,
+)
+from accelerate_tpu.models.llama import LlamaConfig, create_llama
+from accelerate_tpu.utils.dataclasses import TracingConfig
+from accelerate_tpu.utils.fault import (
+    TransferAbortedError,
+    TransferCorruptError,
+    TransferStaleEpochError,
+)
+
+import json as _json
+import struct as _struct
+
+_U32 = _struct.Struct(">I")
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = LlamaConfig.tiny(compute_dtype=jnp.float32)
+    return create_llama(cfg, seed=0)
+
+
+_ENGINES: dict = {}
+
+
+@pytest.fixture
+def get_engine(model):
+    """Engine per (role, shape, backend), cached across the module so each
+    config pays its compiles once; reset before handout. ``role`` exists
+    so wire tests can hold a DISTINCT sender and receiver of the same
+    shape (cross-engine transfer is the point of the wire)."""
+
+    def _get(role="tx", slots=2, max_len=32, prompt_bucket=8,
+             kv_cache="dense", block_size=8, pool_blocks=None):
+        key = (role, slots, max_len, prompt_bucket, kv_cache, block_size,
+               pool_blocks)
+        eng = _ENGINES.get(key)
+        if eng is None:
+            eng = _ENGINES[key] = ContinuousBatchingEngine(
+                model, slots=slots, max_len=max_len,
+                prompt_bucket=prompt_bucket, readback_lag=2,
+                kv_cache=kv_cache, block_size=block_size,
+                pool_blocks=pool_blocks,
+            )
+        eng.reset()
+        return eng
+
+    return _get
+
+
+def _greedy_prefill(eng, prompt, budget=5):
+    return eng.prefill_remote(
+        prompt, max_new_tokens=budget, temperature=0.0, pad_token_id=0,
+    )
+
+
+def _commit_and_drain(eng, pre, tag="t"):
+    occ = eng.insert_prefilled(pre, tag=tag)
+    eng.drain()
+    return occ.output_row()
+
+
+def _leaves(tree):
+    return [np.asarray(jax.device_get(x)) for x in jax.tree_util.tree_leaves(tree)]
+
+
+# ------------------------------------------------------------------ codec
+@pytest.mark.parametrize("kv_cache", ["dense", "paged", "paged_int8"])
+def test_codec_roundtrip_bitwise_and_commit_parity(model, get_engine, kv_cache):
+    """to_bytes/from_bytes is leaf-exact (dtype + bytes), and a decoded
+    prefill commits to bitwise the same greedy tokens as the by-reference
+    object — the satellite-1 contract, across dense f32, paged f32, and
+    paged int8 payloads (int8 blocks ship ~4x fewer KV bytes)."""
+    eng = get_engine(kv_cache=kv_cache)
+    prompt = [3, 1, 4, 1, 5]
+    want = _commit_and_drain(eng, _greedy_prefill(eng, prompt))
+    eng.reset()
+
+    pre = _greedy_prefill(eng, prompt)
+    data = pre.to_bytes()
+    assert data[:4] == b"ATKV"
+    pre2 = RemotePrefill.from_bytes(data, engine=eng)
+    assert pre2.engine_config is eng.config
+    assert (pre2.max_new_tokens, pre2.temperature) == (5, 0.0)
+    assert pre2.prompt_bucket == pre.prompt_bucket
+    assert pre2.max_len == pre.max_len
+    for a, b in zip(_leaves((pre.cache, pre.t0, pre.next_key)),
+                    _leaves((pre2.cache, pre2.t0, pre2.next_key))):
+        assert a.dtype == b.dtype and a.shape == b.shape
+        np.testing.assert_array_equal(a, b)
+    assert eng.accepts_prefill(pre2)
+    got = _commit_and_drain(eng, pre2)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_codec_corrupt_payloads_die_typed(model, get_engine):
+    eng = get_engine()
+    data = _greedy_prefill(eng, [7, 7, 7]).to_bytes()
+    with pytest.raises(TransferCorruptError, match="magic"):
+        RemotePrefill.from_bytes(b"NOPE" + data[4:])
+    with pytest.raises(TransferCorruptError, match="version"):
+        RemotePrefill.from_bytes(data[:4] + b"\x00\x63" + data[6:])
+    with pytest.raises(TransferCorruptError, match="truncated"):
+        RemotePrefill.from_bytes(data[:-8])
+    with pytest.raises(TransferCorruptError, match="trailing"):
+        RemotePrefill.from_bytes(data + b"\x00")
+    # structural stamp mismatch: typed abort => recompute locally
+    alien = types.SimpleNamespace(prompt_bucket=999, max_len=7, config=object())
+    with pytest.raises(TransferAbortedError, match="stamp mismatch"):
+        RemotePrefill.from_bytes(data, engine=alien)
+
+
+# ------------------------------------------------------------ wire parity
+@pytest.mark.parametrize("transport", ["inproc", "tcp"])
+def test_wire_transfer_cross_engine_bitwise_parity(model, get_engine, transport):
+    """Ship a prefill computed on engine A into engine B over the real
+    transport stack (framing, chunk crcs, COMMIT verification, slot
+    reservation) — B's committed greedy output must be bitwise what B
+    would have produced prefilling locally, and the sender's ``kvtx.send``
+    span must carry the caller's trace id (one trace across the hop)."""
+    tx = get_engine(role="tx", kv_cache="paged")
+    rx = get_engine(role="rx", kv_cache="paged")
+    prompt = [11, 2, 9, 4, 6, 1]
+    want = _commit_and_drain(rx, _greedy_prefill(rx, prompt))
+    rx.reset()
+
+    prev_cfg = tracing.get_tracer().config
+    tracing.configure(TracingConfig(enabled=True, ring_capacity=4096))
+    mgr = KVTransferManager(transport=transport, chunk_bytes=1024)
+    try:
+        mgr.register("rx", types.SimpleNamespace(engine=rx))
+        pre = _greedy_prefill(tx, prompt)
+        tid = mgr.ship(pre, "rx", trace_id="trace-kvtx-hop")
+        wire_pre = mgr.take("rx", tid)
+        assert wire_pre.engine_config is rx.config
+        assert wire_pre.reservation is not None
+        assert rx.accepts_prefill(wire_pre)
+        got = _commit_and_drain(rx, wire_pre)
+        np.testing.assert_array_equal(got, want)
+        assert mgr.stats["shipped"] == 1 and mgr.stats["failed"] == 0
+        sends = tracing.get_tracer().spans(name="kvtx.send")
+        assert len(sends) == 1
+        assert sends[0].trace_id == "trace-kvtx-hop"
+        assert sends[0].attrs["transport"] == transport
+        assert sends[0].attrs["attempts"] == 1
+    finally:
+        mgr.close()
+        tracing.configure(prev_cfg)
+
+
+# ------------------------------------------------------------ epoch fence
+def test_epoch_fence_late_commit_frees_staging_and_spares_new_occupant(
+    model, get_engine,
+):
+    """Satellite 4, receiver side: a 1-slot engine's reservation is TTL-
+    reaped mid-stream and the slot re-admitted to a NEW local request. The
+    late COMMIT must raise the typed ``TransferStaleEpochError`` on the
+    sender (via the ACK relay), free the receiver's staging, keep the
+    paged pool's free-list invariant, and leave the new occupant's KV
+    bitwise untouched."""
+    tx = get_engine(role="tx", kv_cache="paged")
+    rx = get_engine(role="rx", kv_cache="paged", slots=1)
+    victim, survivor = [11, 2, 9, 4, 6, 1], [5, 3, 8]
+    occ0 = rx.insert(survivor, max_new_tokens=4, pad_token_id=0, tag="clean")
+    rx.drain()
+    want = occ0.output_row()
+    rx.reset()
+
+    # reservation expiry is stamped with the ENGINE clock — drive it
+    clock = [0.0]
+    orig_clock, rx._clock = rx._clock, lambda: clock[0]
+    try:
+        recv = KVReceiver(types.SimpleNamespace(engine=rx),
+                          reservation_ttl_s=1.0)
+        payload = encode_remote_prefill(_greedy_prefill(tx, victim))
+        mid = len(payload) // 2
+        chunks = [payload[:mid], payload[mid:]]
+        meta = {
+            "wire_version": 1, "trace_id": None, "n_chunks": 2,
+            "total_bytes": len(payload),
+            "payload_crc": _crc(payload), "prompt_len": len(victim),
+            "prefix_crc": 0,
+        }
+        _ok(recv.feed(_pack_frame(
+            _FRAME_BEGIN, "t-fence", _json.dumps(meta).encode())))
+        _ok(recv.feed(_pack_frame(
+            _FRAME_CHUNK, "t-fence",
+            _U32.pack(0) + _U32.pack(_crc(chunks[0])) + chunks[0])))
+        assert rx.free_slots() == 0  # the reservation holds the only slot
+
+        # mid-stream: the TTL reaper (poll's backstop) retires the
+        # abandoned reservation, then a NEW local request re-admits the
+        # same slot
+        clock[0] = 2.0
+        rx.poll()
+        assert rx.free_slots() == 1
+        occ = rx.insert(survivor, max_new_tokens=4, pad_token_id=0,
+                        tag="new")
+
+        _ok(recv.feed(_pack_frame(
+            _FRAME_CHUNK, "t-fence",
+            _U32.pack(1) + _U32.pack(_crc(chunks[1])) + chunks[1])))
+        with pytest.raises(TransferStaleEpochError):
+            _raise_on_error_ack(recv.feed(_pack_frame(
+                _FRAME_COMMIT, "t-fence", _U32.pack(_crc(payload)))))
+        assert recv.stats["stale"] == 1 and recv.stats["committed"] == 0
+
+        rx.drain()
+        np.testing.assert_array_equal(occ.output_row(), want)  # untouched
+        assert rx.free_slots() == 1
+    finally:
+        rx._clock = orig_clock
+    kv = rx.stats()["kv"]
+    # free-list invariant (blocks_total includes the reserved null block)
+    assert (
+        kv["blocks_free"] + kv["blocks_cached"] + kv["blocks_active"]
+        == kv["blocks_total"] - 1
+    )
+    with pytest.raises(TransferAbortedError):
+        recv.take("t-fence")  # staging freed: nothing committed to take
+
+
+def test_epoch_fence_insert_prefilled_raises_typed_on_sender(model, get_engine):
+    """Satellite 4, sender/commit side: a wire-delivered prefill whose
+    reservation was reaped and whose slot a new request re-admitted must
+    make ``insert_prefilled`` raise the typed fence error (NOT the generic
+    structural ValueError), and ``accepts_prefill`` soft-refuse — so
+    serving falls back to a local prefill."""
+    tx = get_engine(role="tx", kv_cache="paged")
+    rx = get_engine(role="rx", kv_cache="paged", slots=1)
+    mgr = KVTransferManager(transport="inproc", chunk_bytes=1024)
+    try:
+        mgr.register("rx", types.SimpleNamespace(engine=rx))
+        tid = mgr.ship(_greedy_prefill(tx, [11, 2, 9, 4, 6, 1]), "rx")
+        wire_pre = mgr.take("rx", tid)
+        slot, epoch = wire_pre.reservation
+        assert rx.release_reservation(slot, epoch)  # the reaper's move
+        occ = rx.insert([5, 3, 8], max_new_tokens=4, pad_token_id=0)
+        assert occ is not None
+        assert not rx.accepts_prefill(wire_pre)
+        with pytest.raises(TransferStaleEpochError):
+            rx.insert_prefilled(wire_pre, tag="late")
+        rx.drain()
+        assert rx.free_slots() == 1
+    finally:
+        mgr.close()
+
+
+# --------------------------------------------------------- typed wire death
+def test_receiver_corrupt_chunk_and_unknown_transfer_die_typed(
+    model, get_engine,
+):
+    rx = get_engine(role="rx", kv_cache="paged")
+    recv = KVReceiver(types.SimpleNamespace(engine=rx))
+    payload = encode_remote_prefill(_greedy_prefill(rx, [9, 9, 2]))
+    meta = {
+        "wire_version": 1, "trace_id": None, "n_chunks": 1,
+        "total_bytes": len(payload), "payload_crc": _crc(payload),
+        "prompt_len": 3, "prefix_crc": 0,
+    }
+    free_before = rx.free_slots()
+    _ok(recv.feed(_pack_frame(
+        _FRAME_BEGIN, "t-corrupt", _json.dumps(meta).encode())))
+    with pytest.raises(TransferCorruptError, match="crc32"):
+        _raise_on_error_ack(recv.feed(_pack_frame(
+            _FRAME_CHUNK, "t-corrupt",
+            _U32.pack(0) + _U32.pack(_crc(payload) ^ 1) + payload)))
+    # typed failure released the reservation — no slot leak
+    assert rx.free_slots() == free_before
+    assert recv.stats["corrupt"] == 1
+    with pytest.raises(TransferAbortedError, match="unknown transfer"):
+        _raise_on_error_ack(recv.feed(_pack_frame(
+            _FRAME_CHUNK, "t-never-began",
+            _U32.pack(0) + _U32.pack(_crc(b"x")) + b"x")))
+
+
+# ------------------------------------------------------- one trace per hop
+def test_fleet_hop_is_one_trace_id():
+    """ROADMAP acceptance: submit → fleet.prefill_remote → kvtx.send (TCP)
+    → serving.admit(path=insert_prefilled) all under ONE trace id — the
+    remote-prefill hop is one trace, not two."""
+    from benchmarks.kv_synth import SynthKVEngine
+
+    from accelerate_tpu.fleet import FleetRouter
+    from accelerate_tpu.serving import InferenceServer
+    from accelerate_tpu.utils.dataclasses import (
+        FleetConfig, ServingConfig,
+    )
+
+    prev_cfg = tracing.get_tracer().config
+    tracing.configure(TracingConfig(enabled=True, ring_capacity=4096))
+    scfg = ServingConfig(
+        mode="continuous", max_queue=16, default_max_new_tokens=4,
+        drain_timeout_s=10.0,
+    )
+    srv = InferenceServer(
+        object(), scfg, engine=SynthKVEngine(slots=4), replica_id="d0",
+    )
+    router = FleetRouter({"d0": srv}, FleetConfig(
+        probe_interval_s=0.1, disaggregate_prefill=True, prefill_workers=1,
+        kv_transfer="tcp", kv_transfer_chunk_bytes=2048,
+    ))
+    try:
+        fut = router.submit(np.arange(1, 17, dtype=np.int32), max_new_tokens=4)
+        fut.result(timeout=30)
+        assert router.metrics["kv_transfers"] == 1
+    finally:
+        router.close(drain=False)
+        tracer = tracing.get_tracer()
+        spans = tracer.spans()
+        tracing.configure(prev_cfg)
+    roots = [sp for sp in spans if sp.name == "fleet.submit"]
+    assert len(roots) == 1
+    tid = roots[0].trace_id
+    hop = {sp.name for sp in spans if sp.trace_id == tid}
+    assert {"fleet.submit", "fleet.prefill_remote", "kvtx.send",
+            "serving.admit"} <= hop
+    # the hop minted no SECOND trace: every span of these kinds is tid's
+    for name in ("fleet.prefill_remote", "kvtx.send", "serving.admit"):
+        assert all(
+            sp.trace_id == tid for sp in spans if sp.name == name
+        )
+    admit = [sp for sp in spans if sp.name == "serving.admit"]
+    assert admit and admit[0].attrs.get("path") == "insert_prefilled"
+
+
+# ----------------------------------------------------------------- helpers
+def _crc(data: bytes) -> int:
+    import zlib
+
+    return zlib.crc32(data) & 0xFFFFFFFF
+
+
+def _ok(ack: bytes) -> None:
+    _raise_on_error_ack(ack)
